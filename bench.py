@@ -1,0 +1,88 @@
+"""Headline bench: Llama training throughput, tokens/sec/chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no framework benchmarks (BASELINE.md — verified
+absence), so ``vs_baseline`` is measured against the target this repo
+establishes in BENCH_BASELINE.json (first run writes it; later runs compare).
+Runs on whatever jax.devices() offers: the real TPU chip under the driver, or
+CPU as a tiny-smoke fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+_BASELINE_PATH = Path(__file__).parent / "BENCH_BASELINE.json"
+
+
+def _bench_tpu():
+    import jax
+    import optax
+
+    from kubetorch_tpu.models import LlamaConfig
+    from kubetorch_tpu.parallel import MeshSpec
+    from kubetorch_tpu.training import Trainer
+
+    n_dev = len(jax.devices())
+    on_tpu = jax.devices()[0].platform != "cpu"
+
+    if on_tpu:
+        # ~0.8B-param Llama (tied embeddings) fits one v5e chip with fp32 Adam.
+        cfg = LlamaConfig(
+            vocab_size=32768, embed_dim=2048, n_layers=12, n_heads=16,
+            n_kv_heads=8, head_dim=128, mlp_dim=8192, tie_embeddings=True,
+            remat=True, dtype="bfloat16", param_dtype="bfloat16")
+        batch, seq, steps = 4, 2048, 10
+        metric = "llama_0.8b_train_tokens_per_sec_per_chip"
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps = 4, 128, 4
+        metric = "llama_tiny_cpu_train_tokens_per_sec_per_chip"
+
+    mesh = MeshSpec(fsdp=-1).build()
+    trainer = Trainer(cfg, mesh, optimizer=optax.adamw(1e-4))
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, (batch, seq + 1))
+    data = {
+        "inputs": jax.numpy.asarray(toks[:, :-1], jax.numpy.int32),
+        "targets": jax.numpy.asarray(toks[:, 1:], jax.numpy.int32),
+    }
+    result = trainer.benchmark(data, n_steps=steps, warmup=2)
+    per_chip = result["tokens_per_sec"] / n_dev
+    return metric, per_chip, result
+
+
+def main():
+    metric, value, detail = _bench_tpu()
+
+    baseline = None
+    if _BASELINE_PATH.exists():
+        try:
+            saved = json.loads(_BASELINE_PATH.read_text())
+            if saved.get("metric") == metric:
+                baseline = saved.get("value")
+        except Exception:
+            baseline = None
+    if baseline is None and os.environ.get("KT_BENCH_WRITE_BASELINE", "1") == "1":
+        _BASELINE_PATH.write_text(
+            json.dumps({"metric": metric, "value": value}))
+
+    vs = (value / baseline) if baseline else 1.0
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+    print(f"# detail: step_time={detail['step_time_s'] * 1e3:.1f}ms "
+          f"loss={detail['loss']:.3f}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""kubetorch_tpu — a TPU-native Kubernetes ML compute orchestrator.
+
+A from-scratch rebuild of the capabilities of kubetorch (reference:
+``python_client/kubetorch/__init__.py:1-70``) designed TPU-first:
+
+- the user API is the same shape (``kt.fn`` / ``kt.cls`` / ``kt.app`` /
+  ``kt.Compute`` / ``kt.Image`` / data-store verbs), but
+- ``Compute`` speaks TPU resources natively (``tpus="v5e-8"`` → slice/host/chip
+  topology math, ``google.com/tpu`` limits, GKE TPU node selectors),
+- the distributed path bootstraps ``jax.distributed`` process groups over
+  ICI/DCN instead of torchrun/NCCL, and
+- a first-class compute stack (``kubetorch_tpu.parallel`` / ``models`` /
+  ``ops`` / ``training``) provides mesh-parallel JAX training the reference
+  left to user code.
+
+Attributes resolve lazily (PEP 562) so ``import kubetorch_tpu as kt`` stays
+fast for CLI usage and so the pure-JAX compute stack can be imported without
+pulling the orchestration stack (and vice versa).
+"""
+
+from kubetorch_tpu.version import __version__
+
+# attribute name -> (module, symbol). Mirrors the reference's public surface
+# (python_client/kubetorch/__init__.py) plus the TPU compute stack.
+_LAZY = {
+    # callables
+    "fn": ("kubetorch_tpu.resources.callables.fn", "fn"),
+    "Fn": ("kubetorch_tpu.resources.callables.fn", "Fn"),
+    "cls": ("kubetorch_tpu.resources.callables.cls", "cls"),
+    "Cls": ("kubetorch_tpu.resources.callables.cls", "Cls"),
+    "app": ("kubetorch_tpu.resources.compute.app", "app"),
+    "App": ("kubetorch_tpu.resources.compute.app", "App"),
+    # resources
+    "Compute": ("kubetorch_tpu.resources.compute.compute", "Compute"),
+    "Image": ("kubetorch_tpu.resources.images.image", "Image"),
+    "images": ("kubetorch_tpu.resources.images.images", None),
+    "Volume": ("kubetorch_tpu.resources.volumes.volume", "Volume"),
+    "Secret": ("kubetorch_tpu.resources.secrets.secret", "Secret"),
+    "Endpoint": ("kubetorch_tpu.resources.compute.endpoint", "Endpoint"),
+    "AutoscalingConfig": ("kubetorch_tpu.provisioning.autoscaling", "AutoscalingConfig"),
+    # decorators
+    "compute": ("kubetorch_tpu.resources.compute.decorators", "compute"),
+    "distribute": ("kubetorch_tpu.resources.compute.decorators", "distribute"),
+    "autoscale": ("kubetorch_tpu.resources.compute.decorators", "autoscale"),
+    "async_": ("kubetorch_tpu.resources.compute.decorators", "async_"),
+    # data store
+    "put": ("kubetorch_tpu.data_store.commands", "put"),
+    "get": ("kubetorch_tpu.data_store.commands", "get"),
+    "ls": ("kubetorch_tpu.data_store.commands", "ls"),
+    "rm": ("kubetorch_tpu.data_store.commands", "rm"),
+    # runs
+    "note": ("kubetorch_tpu.runs.api", "note"),
+    "artifact": ("kubetorch_tpu.runs.api", "artifact"),
+    "run_id": ("kubetorch_tpu.runs.api", "run_id"),
+    # config
+    "config": ("kubetorch_tpu.config", "get_config"),
+    "configure": ("kubetorch_tpu.config", "configure"),
+    "KubetorchConfig": ("kubetorch_tpu.config", "KubetorchConfig"),
+    # subpackages (compute stack + helpers)
+    "distributed": ("kubetorch_tpu.distributed", None),
+    "parallel": ("kubetorch_tpu.parallel", None),
+    "models": ("kubetorch_tpu.models", None),
+    "ops": ("kubetorch_tpu.ops", None),
+    "training": ("kubetorch_tpu.training", None),
+    "serving": ("kubetorch_tpu.serving", None),
+}
+
+# exceptions are cheap and needed for `except kt.X` — import eagerly.
+from kubetorch_tpu.exceptions import (  # noqa: E402
+    EXCEPTION_REGISTRY,
+    KubetorchError,
+    ImagePullError,
+    PodContainerError,
+    PodTerminatedError,
+    QuorumTimeoutError,
+    RemoteException,
+    RsyncError,
+    DataStoreError,
+    ServiceTimeoutError,
+    StartupError,
+    VersionMismatchError,
+    WorkerMembershipChanged,
+    XlaRuntimeSurfacedError,
+    register_exception,
+)
+
+__all__ = sorted(set(_LAZY) | {
+    "__version__", "EXCEPTION_REGISTRY", "register_exception",
+    "KubetorchError", "RemoteException", "StartupError", "PodTerminatedError",
+    "ServiceTimeoutError", "ImagePullError", "PodContainerError",
+    "VersionMismatchError", "WorkerMembershipChanged", "QuorumTimeoutError",
+    "XlaRuntimeSurfacedError", "RsyncError", "DataStoreError",
+})
+
+
+def __getattr__(name):
+    import importlib
+
+    try:
+        module_name, symbol = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module 'kubetorch_tpu' has no attribute {name!r}")
+    module = importlib.import_module(module_name)
+    value = module if symbol is None else getattr(module, symbol)
+    if name == "config":  # kt.config is the live config object
+        value = value()
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return __all__

@@ -1,0 +1,138 @@
+"""Layered configuration: env vars > local file cache > cluster ConfigMap.
+
+Reference: ``python_client/kubetorch/config.py:29-230`` (KubetorchConfig) with
+the same precedence rules. Env vars are ``KT_*``; the file cache lives at
+``~/.ktpu/config`` (YAML); the cluster layer is fetched lazily from the
+controller (ConfigMap-backed) and merged lowest-precedence.
+"""
+
+from __future__ import annotations
+
+import getpass
+import os
+import threading
+from dataclasses import dataclass, field, fields
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import yaml
+
+_CONFIG_PATH = Path(os.environ.get("KT_CONFIG_PATH", "~/.ktpu/config")).expanduser()
+
+_ENV_MAP = {
+    "username": "KT_USERNAME",
+    "namespace": "KT_NAMESPACE",
+    "install_namespace": "KT_INSTALL_NAMESPACE",
+    "install_url": "KT_INSTALL_URL",
+    "prefix_username": "KT_PREFIX_USERNAME",
+    "stream_logs": "KT_STREAM_LOGS",
+    "stream_metrics": "KT_STREAM_METRICS",
+    "backend": "KT_BACKEND",
+    "serialization": "KT_SERIALIZATION",
+    "launch_timeout": "KT_LAUNCH_TIMEOUT",
+    "inactivity_ttl": "KT_INACTIVITY_TTL",
+    "log_level": "KT_LOG_LEVEL",
+    "store_url": "KT_STORE_URL",
+    "controller_url": "KT_CONTROLLER_URL",
+}
+
+_BOOLS = {"prefix_username", "stream_logs", "stream_metrics"}
+_INTS = {"launch_timeout"}
+
+
+def _coerce(name: str, value: Any) -> Any:
+    if value is None:
+        return None
+    if name in _BOOLS and isinstance(value, str):
+        return value.strip().lower() in ("1", "true", "yes", "on")
+    if name in _INTS and isinstance(value, str):
+        return int(value)
+    return value
+
+
+@dataclass
+class KubetorchConfig:
+    username: str = field(default_factory=lambda: os.environ.get("USER") or getpass.getuser())
+    namespace: str = "default"
+    install_namespace: str = "kubetorch"
+    install_url: Optional[str] = None
+    prefix_username: bool = True
+    stream_logs: bool = True
+    stream_metrics: bool = False
+    # "local" runs pods as subprocesses on this machine (tests / laptops with
+    # no cluster); "k8s" applies manifests through the controller.
+    backend: str = "local"
+    serialization: str = "json"   # default wire format; "pickle" must be allowed
+    allowed_serialization: tuple = ("json", "pickle")
+    launch_timeout: int = 600
+    inactivity_ttl: Optional[str] = None
+    log_level: str = "INFO"
+    store_url: Optional[str] = None
+    controller_url: Optional[str] = None
+
+    def refresh(self) -> None:
+        """Re-apply the precedence stack: file cache, then env vars on top."""
+        file_cfg: Dict[str, Any] = {}
+        if _CONFIG_PATH.exists():
+            try:
+                file_cfg = yaml.safe_load(_CONFIG_PATH.read_text()) or {}
+            except Exception:
+                file_cfg = {}
+        for f in fields(self):
+            if f.name in file_cfg:
+                setattr(self, f.name, _coerce(f.name, file_cfg[f.name]))
+        for name, env in _ENV_MAP.items():
+            if env in os.environ:
+                setattr(self, name, _coerce(name, os.environ[env]))
+
+    def merge_cluster(self, cluster_cfg: Dict[str, Any]) -> None:
+        """Merge cluster-level defaults at the *lowest* precedence."""
+        file_cfg: Dict[str, Any] = {}
+        if _CONFIG_PATH.exists():
+            try:
+                file_cfg = yaml.safe_load(_CONFIG_PATH.read_text()) or {}
+            except Exception:
+                file_cfg = {}
+        for key, value in (cluster_cfg or {}).items():
+            known = {f.name for f in fields(self)}
+            if key in known and key not in file_cfg and _ENV_MAP.get(key) not in os.environ:
+                setattr(self, key, _coerce(key, value))
+
+    def save(self, **updates: Any) -> None:
+        """Persist values to the local file cache."""
+        current: Dict[str, Any] = {}
+        if _CONFIG_PATH.exists():
+            try:
+                current = yaml.safe_load(_CONFIG_PATH.read_text()) or {}
+            except Exception:
+                current = {}
+        current.update(updates)
+        _CONFIG_PATH.parent.mkdir(parents=True, exist_ok=True)
+        _CONFIG_PATH.write_text(yaml.safe_dump(current))
+        self.refresh()
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+
+_config: Optional[KubetorchConfig] = None
+_lock = threading.Lock()
+
+
+def get_config() -> KubetorchConfig:
+    global _config
+    with _lock:
+        if _config is None:
+            _config = KubetorchConfig()
+            _config.refresh()
+        return _config
+
+
+def configure(**updates: Any) -> KubetorchConfig:
+    """Set config values for this process (not persisted)."""
+    cfg = get_config()
+    for key, value in updates.items():
+        if not hasattr(cfg, key):
+            raise AttributeError(f"unknown config key: {key}")
+        setattr(cfg, key, value)
+    return cfg

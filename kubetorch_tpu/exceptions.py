@@ -1,0 +1,203 @@
+"""Typed exception taxonomy + remote-exception rehydration registry.
+
+Mirrors the reference's exception surface (reference:
+``resources/compute/utils.py:57-130`` for the launch taxonomy,
+``serving/utils.py:107,111,193`` for runtime errors, and
+``python_client/kubetorch/__init__.py`` EXCEPTION_REGISTRY +
+``serving/http_client.py:88`` for rehydration of remote exceptions into real
+client-side exception classes).
+
+TPU addition: ``XlaRuntimeSurfacedError`` wraps libtpu/XLA runtime failures
+(slice-builder errors, coordinator timeouts, HBM OOM) so they propagate to the
+client as a typed exception instead of an opaque 500 — the reference has no
+accelerator-runtime equivalent (its NCCL errors surface as generic user-code
+exceptions).
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any, Dict, Optional, Type
+
+
+class KubetorchError(Exception):
+    """Base class for all framework errors."""
+
+
+class StartupError(KubetorchError):
+    """Pod server failed to set up the callable (image step, import, etc.)."""
+
+
+class PodTerminatedError(KubetorchError):
+    """Request hit a pod that received SIGTERM; carries recent K8s events."""
+
+    def __init__(self, message: str = "pod is terminating", events: Optional[list] = None):
+        super().__init__(message)
+        self.events = events or []
+
+
+class ServiceTimeoutError(KubetorchError):
+    """Service did not become ready within the launch timeout."""
+
+
+class ImagePullError(KubetorchError):
+    """Image pull backoff / not found during launch."""
+
+
+class PodContainerError(KubetorchError):
+    """Container crashed or errored during launch (CrashLoopBackOff etc.)."""
+
+
+class VersionMismatchError(KubetorchError):
+    """Client and in-cluster server versions are incompatible."""
+
+
+class QuorumTimeoutError(KubetorchError):
+    """Distributed quorum (worker discovery) not reached in time."""
+
+
+class WorkerMembershipChanged(KubetorchError):
+    """Raised into an in-flight distributed call when the worker set changes.
+
+    Reference: ``serving/utils.py:193`` + cancellation at
+    ``serving/spmd/spmd_supervisor.py:478-497``. On TPU a membership change is
+    *always* a restart boundary: XLA programs are compiled for a fixed
+    topology, so the caller must re-initialize (``jax.distributed``) on the new
+    slice shape rather than reshard in place.
+    """
+
+    def __init__(
+        self,
+        message: str = "distributed worker membership changed",
+        added: Optional[list] = None,
+        removed: Optional[list] = None,
+        current: Optional[list] = None,
+    ):
+        super().__init__(message)
+        self.added = added or []
+        self.removed = removed or []
+        self.current = current or []
+
+
+class XlaRuntimeSurfacedError(KubetorchError):
+    """A libtpu/XLA runtime error surfaced from a worker (typed, with origin)."""
+
+    def __init__(self, message: str, origin: str = ""):
+        super().__init__(message)
+        self.origin = origin
+
+
+class RsyncError(KubetorchError):
+    """Code/data sync between client, store, and pods failed."""
+
+
+class DataStoreError(KubetorchError):
+    """Data store operation failed (missing key, no source, etc.)."""
+
+
+class RemoteException(KubetorchError):
+    """Fallback wrapper when a remote exception type is unknown client-side.
+
+    A dynamic subclass named after the remote type is created so that
+    ``except`` clauses on the *name* still read naturally
+    (reference: serving/http_client.py:88 CustomResponse.raise_for_status).
+    """
+
+    def __init__(self, message: str, remote_type: str = "", remote_traceback: str = ""):
+        super().__init__(message)
+        self.remote_type = remote_type
+        self.remote_traceback = remote_traceback
+
+    def __str__(self) -> str:  # show the remote traceback like the reference
+        base = super().__str__()
+        if self.remote_traceback:
+            return f"{base}\n\n--- remote traceback ---\n{self.remote_traceback}"
+        return base
+
+
+# name -> class; remote servers package exceptions by name, clients rehydrate.
+EXCEPTION_REGISTRY: Dict[str, Type[BaseException]] = {}
+
+
+def register_exception(exc_class: Type[BaseException]) -> Type[BaseException]:
+    """Register an exception class for client-side rehydration by name."""
+    EXCEPTION_REGISTRY[exc_class.__name__] = exc_class
+    return exc_class
+
+
+for _exc in (
+    KubetorchError, StartupError, PodTerminatedError, ServiceTimeoutError,
+    ImagePullError, PodContainerError, VersionMismatchError, QuorumTimeoutError,
+    WorkerMembershipChanged, XlaRuntimeSurfacedError, RsyncError, DataStoreError,
+    RemoteException,
+):
+    register_exception(_exc)
+
+# Common builtins that frequently cross the wire.
+for _b in (ValueError, TypeError, KeyError, IndexError, RuntimeError,
+           FileNotFoundError, NotImplementedError, ZeroDivisionError,
+           AttributeError, OSError, PermissionError, StopIteration,
+           ArithmeticError, AssertionError):
+    register_exception(_b)
+
+
+def package_exception(exc: BaseException) -> Dict[str, Any]:
+    """Serialize an exception for the wire (reference: http_server.py:1478).
+
+    XLA runtime errors are rewrapped as ``XlaRuntimeSurfacedError`` so clients
+    get a typed accelerator failure rather than a generic error.
+    """
+    tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+    exc_type = type(exc).__name__
+    message = str(exc)
+    extra: Dict[str, Any] = {}
+    mod = type(exc).__module__ or ""
+    if "xla" in mod.lower() or exc_type in ("XlaRuntimeError",):
+        exc_type = "XlaRuntimeSurfacedError"
+        extra["origin"] = f"{mod}.{type(exc).__name__}"
+    if isinstance(exc, WorkerMembershipChanged):
+        extra = {"added": exc.added, "removed": exc.removed, "current": exc.current}
+    if isinstance(exc, PodTerminatedError):
+        extra = {"events": exc.events}
+    return {
+        "error": {
+            "type": exc_type,
+            "message": message,
+            "traceback": tb,
+            "extra": extra,
+        }
+    }
+
+
+def rehydrate_exception(payload: Dict[str, Any]) -> BaseException:
+    """Rebuild a typed exception from ``package_exception`` output.
+
+    Known types come back as their real class; unknown types become a dynamic
+    ``RemoteException`` subclass bearing the remote name.
+    """
+    err = payload.get("error", payload)
+    name = err.get("type", "RemoteException")
+    message = err.get("message", "")
+    tb = err.get("traceback", "")
+    extra = err.get("extra") or {}
+    klass = EXCEPTION_REGISTRY.get(name)
+    try:
+        if klass is WorkerMembershipChanged:
+            return WorkerMembershipChanged(
+                message, added=extra.get("added"), removed=extra.get("removed"),
+                current=extra.get("current"))
+        if klass is PodTerminatedError:
+            return PodTerminatedError(message, events=extra.get("events"))
+        if klass is XlaRuntimeSurfacedError:
+            return XlaRuntimeSurfacedError(message, origin=extra.get("origin", ""))
+        if klass is not None and issubclass(klass, RemoteException):
+            return klass(message, remote_type=name, remote_traceback=tb)
+        if klass is not None:
+            exc = klass(message)
+            exc.remote_traceback = tb  # type: ignore[attr-defined]
+            return exc
+    except Exception:
+        pass
+    dyn = type(name, (RemoteException,), {})
+    register_exception(dyn)  # future rehydrations of the same name reuse it
+    return dyn(message, remote_type=name, remote_traceback=tb)

@@ -1,0 +1,14 @@
+"""Model zoo: TPU-first implementations used by examples, benches, and tests.
+
+Functional style (pure init/apply over pytrees) rather than a module
+framework: params are plain nested dicts whose leaves carry *logical axis*
+metadata via :func:`kubetorch_tpu.models.llama.param_logical_axes`, so any
+parallel layout in :mod:`kubetorch_tpu.parallel` applies without touching
+model code. Layers are stacked and scanned (``lax.scan``) so compile time is
+O(1) in depth.
+"""
+
+from kubetorch_tpu.models.configs import LlamaConfig, MoEConfig, ViTConfig
+from kubetorch_tpu.models import llama
+
+__all__ = ["LlamaConfig", "MoEConfig", "ViTConfig", "llama"]

@@ -1,0 +1,117 @@
+"""Model configurations and named presets."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    expert_mlp_dim: int = 2048
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    """Llama-3-style decoder-only transformer (GQA + RoPE + SwiGLU)."""
+
+    vocab_size: int = 128256
+    embed_dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    mlp_dim: int = 14336
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: str = "bfloat16"        # compute dtype
+    param_dtype: str = "bfloat16"  # storage dtype
+    remat: bool = True             # rematerialize each block under scan
+    moe: Optional[MoEConfig] = None
+    max_seq_len: int = 8192
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def storage_dtype(self):
+        return jnp.dtype(self.param_dtype)
+
+    # ---- presets -------------------------------------------------------
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        return cls(**kw)
+
+    @classmethod
+    def llama3_1b(cls, **kw) -> "LlamaConfig":
+        """~1.2B params: fits a single v5e chip in bf16 with Adam for bench."""
+        base = dict(vocab_size=128256, embed_dim=2048, n_layers=16, n_heads=16,
+                    n_kv_heads=8, head_dim=128, mlp_dim=8192)
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        """CI config: runs on the 8-device virtual CPU mesh in seconds."""
+        base = dict(vocab_size=512, embed_dim=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, head_dim=16, mlp_dim=128, remat=False,
+                    dtype="float32", param_dtype="float32", max_seq_len=128)
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
+    def tiny_moe(cls, **kw) -> "LlamaConfig":
+        base = dict(moe=MoEConfig(num_experts=4, top_k=2, expert_mlp_dim=128))
+        base.update(kw)
+        return cls.tiny(**base)
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    """ViT-L/16-style image classifier (BASELINE config #4)."""
+
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    embed_dim: int = 1024
+    n_layers: int = 24
+    n_heads: int = 16
+    mlp_dim: int = 4096
+    dropout: float = 0.0
+    dtype: str = "bfloat16"
+    param_dtype: str = "bfloat16"
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.n_heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def storage_dtype(self):
+        return jnp.dtype(self.param_dtype)
+
+    @classmethod
+    def vit_l16(cls, **kw) -> "ViTConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "ViTConfig":
+        base = dict(image_size=32, patch_size=8, num_classes=10, embed_dim=64,
+                    n_layers=2, n_heads=4, mlp_dim=128,
+                    dtype="float32", param_dtype="float32")
+        base.update(kw)
+        return cls(**base)

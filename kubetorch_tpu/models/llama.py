@@ -1,0 +1,237 @@
+"""Llama-3-style decoder, functional and mesh-parallel.
+
+Design notes (TPU-first, not a torch translation):
+
+- **Stacked + scanned layers**: every per-layer weight has a leading
+  ``[n_layers, ...]`` dim and the forward pass is one ``lax.scan`` — compile
+  time stays O(1) in depth and XLA sees a single fused block body.
+- **Logical axes**: :func:`param_logical_axes` returns a pytree (same
+  structure as params) of logical-axis tuples; combined with
+  :class:`~kubetorch_tpu.parallel.sharding.ShardingRules` this yields
+  NamedShardings for any dp/fsdp/tp/sp/ep layout.
+- **GQA + RoPE + SwiGLU**, float32 softmax/norm accumulation, bf16 weights.
+- **Optional MoE** (top-k router, expert axis sharded over ``ep``): experts
+  are evaluated densely and combined with renormalized top-k gates — exact
+  top-k math, full-FLOP compute; a ragged Pallas dispatch is the planned
+  optimization.
+
+The reference framework has no model code at all (SURVEY.md §2.7 — parallelism
+and models live in user examples); this module is the "flagship model" a
+TPU-native framework must own to hit BASELINE.md targets #3/#5.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from kubetorch_tpu.models.configs import LlamaConfig
+from kubetorch_tpu.ops import apply_rope, dot_product_attention, rms_norm, rope_angles
+from kubetorch_tpu.parallel.sharding import ShardingRules, shard_constraint
+
+Params = Dict[str, Any]
+
+
+# --------------------------------------------------------------------------
+# init
+# --------------------------------------------------------------------------
+
+def _dense_init(key, shape, dtype, in_axis=-2):
+    fan_in = shape[in_axis]
+    return (jax.random.truncated_normal(key, -2.0, 2.0, shape, jnp.float32)
+            * (fan_in ** -0.5)).astype(dtype)
+
+
+def init(key: jax.Array, cfg: LlamaConfig) -> Params:
+    """Initialize parameters (host-side; wrap in jit with out_shardings to
+    initialize directly sharded on a mesh)."""
+    pdt = cfg.storage_dtype
+    L, E, H, Hkv, D, M, V = (cfg.n_layers, cfg.embed_dim, cfg.n_heads,
+                             cfg.n_kv_heads, cfg.head_dim, cfg.mlp_dim,
+                             cfg.vocab_size)
+    keys = jax.random.split(key, 16)
+    layers: Params = {
+        "attn_norm": jnp.ones((L, E), pdt),
+        "wq": _dense_init(keys[0], (L, E, H * D), pdt),
+        "wk": _dense_init(keys[1], (L, E, Hkv * D), pdt),
+        "wv": _dense_init(keys[2], (L, E, Hkv * D), pdt),
+        "wo": _dense_init(keys[3], (L, H * D, E), pdt),
+        "mlp_norm": jnp.ones((L, E), pdt),
+    }
+    if cfg.moe is None:
+        layers.update({
+            "w_gate": _dense_init(keys[4], (L, E, M), pdt),
+            "w_up": _dense_init(keys[5], (L, E, M), pdt),
+            "w_down": _dense_init(keys[6], (L, M, E), pdt),
+        })
+    else:
+        n_exp, em = cfg.moe.num_experts, cfg.moe.expert_mlp_dim
+        layers.update({
+            "router": _dense_init(keys[7], (L, E, n_exp), jnp.float32),
+            "we_gate": _dense_init(keys[8], (L, n_exp, E, em), pdt),
+            "we_up": _dense_init(keys[9], (L, n_exp, E, em), pdt),
+            "we_down": _dense_init(keys[10], (L, n_exp, em, E), pdt,
+                                   in_axis=-2),
+        })
+    params: Params = {
+        "embedding": (jax.random.normal(keys[11], (V, E), jnp.float32)
+                      * 0.02).astype(pdt),
+        "layers": layers,
+        "final_norm": jnp.ones((E,), pdt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _dense_init(keys[12], (E, V), pdt)
+    return params
+
+
+def param_logical_axes(cfg: LlamaConfig) -> Params:
+    """Pytree of logical-axis tuples matching :func:`init`'s structure."""
+    layers = {
+        "attn_norm": ("layer", "embed"),
+        "wq": ("layer", "embed_fsdp", "heads"),
+        "wk": ("layer", "embed_fsdp", "kv_heads"),
+        "wv": ("layer", "embed_fsdp", "kv_heads"),
+        "wo": ("layer", "heads", "embed_fsdp"),
+        "mlp_norm": ("layer", "embed"),
+    }
+    if cfg.moe is None:
+        layers.update({
+            "w_gate": ("layer", "embed_fsdp", "mlp"),
+            "w_up": ("layer", "embed_fsdp", "mlp"),
+            "w_down": ("layer", "mlp", "embed_fsdp"),
+        })
+    else:
+        layers.update({
+            "router": ("layer", "embed", None),
+            "we_gate": ("layer", "expert", "embed_fsdp", "mlp"),
+            "we_up": ("layer", "expert", "embed_fsdp", "mlp"),
+            "we_down": ("layer", "expert", "mlp", "embed_fsdp"),
+        })
+    axes = {
+        "embedding": ("vocab", "embed_fsdp"),
+        "layers": layers,
+        "final_norm": ("embed",),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed_fsdp", "vocab")
+    return axes
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def _moe_block(x, layer, cfg: LlamaConfig, rules: ShardingRules):
+    """Top-k MoE with renormalized gates; experts sharded over ``ep``."""
+    moe = cfg.moe
+    gates = jax.nn.softmax(
+        jnp.einsum("bse,en->bsn", x.astype(jnp.float32),
+                   layer["router"].astype(jnp.float32)), axis=-1)
+    top_vals, _ = jax.lax.top_k(gates, moe.top_k)
+    thresh = top_vals[..., -1:]
+    masked = jnp.where(gates >= thresh, gates, 0.0)
+    weights = masked / (jnp.sum(masked, axis=-1, keepdims=True) + 1e-9)
+
+    # Dense expert evaluation: [B,S,n_exp,em]; expert dim rides the ep axis,
+    # the contraction over n_exp below becomes a psum over ep under jit.
+    h_gate = jnp.einsum("bse,xem->bsxm", x, layer["we_gate"])
+    h_up = jnp.einsum("bse,xem->bsxm", x, layer["we_up"])
+    h = jax.nn.silu(h_gate) * h_up
+    h = shard_constraint(h, rules, "batch", "seq", "expert", "mlp")
+    out = jnp.einsum("bsxm,xme,bsx->bse", h, layer["we_down"],
+                     weights.astype(x.dtype))
+    return out
+
+
+def _block(x, layer, sin, cos, cfg: LlamaConfig, rules: ShardingRules,
+           segment_ids=None):
+    """One decoder block. ``x``: [B, S, E] in compute dtype."""
+    dt = cfg.compute_dtype
+    B, S, E = x.shape
+    H, Hkv, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+    q = jnp.einsum("bse,ehd->bshd", h,
+                   layer["wq"].reshape(E, H, D).astype(dt))
+    k = jnp.einsum("bse,ehd->bshd", h,
+                   layer["wk"].reshape(E, Hkv, D).astype(dt))
+    v = jnp.einsum("bse,ehd->bshd", h,
+                   layer["wv"].reshape(E, Hkv, D).astype(dt))
+    q = apply_rope(q, None, cfg.rope_theta, sin=sin, cos=cos)
+    k = apply_rope(k, None, cfg.rope_theta, sin=sin, cos=cos)
+    q = shard_constraint(q, rules, "batch", "seq", "heads", None)
+    # kv gathered over seq for attention (sequence parallelism collects here;
+    # ring attention in parallel/ring.py avoids the gather for long context).
+    k = shard_constraint(k, rules, "batch", None, "kv_heads", None)
+    v = shard_constraint(v, rules, "batch", None, "kv_heads", None)
+    attn = dot_product_attention(q, k, v, causal=True, segment_ids=segment_ids)
+    attn = attn.reshape(B, S, H * D)
+    x = x + jnp.einsum("bsf,fe->bse", attn, layer["wo"].astype(dt))
+    x = shard_constraint(x, rules, "batch", "seq", None)
+
+    h = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+    if cfg.moe is None:
+        gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"].astype(dt))
+        up = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(dt))
+        ff = shard_constraint(jax.nn.silu(gate) * up, rules,
+                              "batch", "seq", "mlp")
+        x = x + jnp.einsum("bsm,me->bse", ff, layer["w_down"].astype(dt))
+    else:
+        x = x + _moe_block(h, layer, cfg, rules).astype(dt)
+    return shard_constraint(x, rules, "batch", "seq", None)
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,                      # [B, S] int32
+    cfg: LlamaConfig,
+    rules: Optional[ShardingRules] = None,
+    segment_ids: Optional[jax.Array] = None,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Full-sequence forward pass → logits ``[B, S, vocab]`` (float32)."""
+    rules = rules or ShardingRules.default()
+    dt = cfg.compute_dtype
+    B, S = tokens.shape
+    x = params["embedding"].astype(dt)[tokens]
+    x = shard_constraint(x, rules, "batch", "seq", None)
+    if positions is None:
+        positions = jnp.arange(S)[None, :]
+    sin, cos = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(
+            _block, policy=jax.checkpoint_policies.nothing_saveable,
+            static_argnums=(4, 5))
+
+    def scan_body(carry, layer):
+        return block(carry, layer, sin, cos, cfg, rules, segment_ids), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = (params["embedding"].T if cfg.tie_embeddings
+            else params["lm_head"]).astype(dt)
+    logits = jnp.einsum("bse,ev->bsv", x, head)
+    logits = shard_constraint(logits, rules, "batch", "seq", "vocab")
+    return logits.astype(jnp.float32)
+
+
+def num_params(cfg: LlamaConfig) -> int:
+    """Analytic parameter count (for MFU/bench reporting)."""
+    E, H, Hkv, D, M, V, L = (cfg.embed_dim, cfg.n_heads, cfg.n_kv_heads,
+                             cfg.head_dim, cfg.mlp_dim, cfg.vocab_size,
+                             cfg.n_layers)
+    attn = E * H * D + 2 * E * Hkv * D + H * D * E
+    if cfg.moe is None:
+        ff = 3 * E * M
+    else:
+        ff = (cfg.moe.num_experts * 3 * E * cfg.moe.expert_mlp_dim
+              + E * cfg.moe.num_experts)
+    per_layer = attn + ff + 2 * E
+    total = L * per_layer + V * E + E
+    if not cfg.tie_embeddings:
+        total += E * V
+    return total

@@ -1,0 +1,13 @@
+"""TPU-tuned ops: norms, rotary embeddings, attention (XLA + Pallas paths).
+
+The reference has no op library (it orchestrates torch user code); this
+package exists because on TPU the framework owns the compute path. Every op
+keeps static shapes, bf16-friendly math (float32 accumulation where it
+matters), and XLA-fusable control flow.
+"""
+
+from kubetorch_tpu.ops.norms import rms_norm
+from kubetorch_tpu.ops.rope import apply_rope, rope_angles
+from kubetorch_tpu.ops.attention import dot_product_attention
+
+__all__ = ["rms_norm", "apply_rope", "rope_angles", "dot_product_attention"]

@@ -1,0 +1,69 @@
+"""Attention with GQA, causal masking, and float32 softmax accumulation.
+
+Default path is pure-XLA einsum attention: on TPU, XLA tiles these matmuls
+onto the MXU and fuses the mask/softmax chain; memory is O(S^2) per head
+group which is fine up to ~8k sequence on v5e. The Pallas flash-attention
+kernel (``kubetorch_tpu.ops.flash_attention``) is the long-sequence path, and
+``kubetorch_tpu.parallel.ring`` composes either with sequence parallelism.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from einops import rearrange
+
+
+def dot_product_attention(
+    q: jax.Array,            # [B, S, Hq, D]
+    k: jax.Array,            # [B, T, Hkv, D]
+    v: jax.Array,            # [B, T, Hkv, D]
+    *,
+    causal: bool = True,
+    bias: Optional[jax.Array] = None,      # broadcastable to [B, H, S, T]
+    segment_ids: Optional[jax.Array] = None,  # [B, S] packed-sequence ids
+    scale: Optional[float] = None,
+    q_offset: int = 0,
+) -> jax.Array:
+    """Grouped-query attention. Returns ``[B, S, Hq, D]``.
+
+    ``q_offset`` shifts the causal diagonal for decode (query block starts at
+    absolute position ``q_offset`` within the key sequence).
+    """
+    B, S, Hq, D = q.shape
+    _, T, Hkv, _ = k.shape
+    if Hq % Hkv:
+        raise ValueError(f"query heads {Hq} not a multiple of kv heads {Hkv}")
+    G = Hq // Hkv
+    scale = scale if scale is not None else D ** -0.5
+
+    qg = rearrange(q, "b s (h g) d -> b h g s d", h=Hkv, g=G)
+    logits = jnp.einsum(
+        "bhgsd,bhtd->bhgst",
+        (qg * scale).astype(jnp.float32),
+        rearrange(k, "b t h d -> b h t d").astype(jnp.float32),
+    )
+
+    mask = None
+    if causal:
+        qpos = jnp.arange(S) + q_offset
+        kpos = jnp.arange(T)
+        mask = qpos[:, None] >= kpos[None, :]          # [S, T]
+        mask = mask[None, None, None, :, :]
+    if segment_ids is not None:
+        seg = segment_ids[:, None, None, :, None] == segment_ids[:, None, None, None, :]
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.float32(-1e30))
+    if bias is not None:
+        logits = logits + rearrange(
+            jnp.broadcast_to(bias, (B, Hq, S, T)), "b (h g) s t -> b h g s t",
+            h=Hkv, g=G).astype(jnp.float32)
+
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bhgst,bhtd->bhgsd", probs,
+        rearrange(v, "b t h d -> b h t d").astype(jnp.float32))
+    return rearrange(out, "b h g s d -> b s (h g) d").astype(q.dtype)

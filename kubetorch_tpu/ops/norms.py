@@ -1,0 +1,20 @@
+"""Normalization ops (RMSNorm) with float32 accumulation under bf16 params."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """RMSNorm: ``x * rsqrt(mean(x^2) + eps) * scale``.
+
+    Statistics are computed in float32 regardless of input dtype (bf16 mean of
+    squares loses too much precision at embed >= 4k), output cast back to the
+    input dtype. XLA fuses this entire op into neighbors — no Pallas needed.
+    """
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * jax.lax.rsqrt(var + eps)
+    return (normed * scale.astype(jnp.float32)).astype(dtype)

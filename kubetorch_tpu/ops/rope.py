@@ -1,0 +1,47 @@
+"""Rotary position embeddings (RoPE), Llama-3 style (full-precision angles)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_angles(
+    positions: jax.Array, head_dim: int, theta: float = 500000.0
+) -> tuple:
+    """Return (sin, cos) of shape ``positions.shape + (head_dim // 2,)``.
+
+    Angles are computed in float32; callers cast after rotation. ``positions``
+    may be any integer array (e.g. ``[B, S]`` or ``[S]``), making this reusable
+    for both full-sequence training and single-token decode.
+    """
+    fraction = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    timescale = jnp.power(theta, fraction)          # [head_dim/2]
+    angles = positions.astype(jnp.float32)[..., None] / timescale
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(
+    x: jax.Array,
+    positions: jax.Array,
+    theta: float = 500000.0,
+    sin: Optional[jax.Array] = None,
+    cos: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Rotate ``x`` of shape ``[..., S, H, D]`` by position-dependent angles.
+
+    Uses the "split halves" convention (first/second half of the head dim),
+    matching Llama. Pass precomputed ``sin``/``cos`` to share across layers.
+    """
+    head_dim = x.shape[-1]
+    if sin is None or cos is None:
+        sin, cos = rope_angles(positions, head_dim, theta)
+    # x: [..., S, H, D]; sin/cos: [..., S, D/2] -> broadcast over heads.
+    sin = sin[..., None, :]
+    cos = cos[..., None, :]
+    first, second = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate(
+        [first * cos - second * sin, second * cos + first * sin], axis=-1)
+    return rotated.astype(x.dtype)

@@ -1,0 +1,102 @@
+"""Logical-axis sharding rules (maxtext-style) for mesh-parallel models.
+
+Arrays in :mod:`kubetorch_tpu.models` are annotated with *logical* axis names
+("batch", "seq", "embed", "heads", "mlp", "vocab", "expert", "layer", ...).
+:class:`ShardingRules` maps each logical name to zero or more mesh axes; the
+result is a ``PartitionSpec`` consumed by ``jax.jit`` shardings and
+``with_sharding_constraint``. This indirection is what lets one model source
+run pure-DP, FSDP, TP, SP, EP, or any combination by swapping a table — the
+TPU-idiomatic replacement for the reference's "parallelism lives in user code"
+posture (SURVEY.md §2.7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+# Default rules: batch shards over (dp, fsdp); params shard over fsdp on their
+# "long" dim and tp on the head/mlp dim; sequence shards over sp; experts over
+# ep; the scanned layer dim over pp (pipeline stages own contiguous layers).
+LOGICAL_AXIS_RULES: Dict[str, MeshAxes] = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed": None,
+    "embed_fsdp": "fsdp",      # param dim sharded ZeRO-3 style
+    "heads": "tp",
+    "kv_heads": "tp",
+    "head_dim": None,
+    "mlp": "tp",
+    "vocab": "tp",
+    "expert": "ep",
+    "layer": None,             # becomes "pp" under pipeline parallelism
+    "stage": "pp",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    rules: Tuple[Tuple[str, MeshAxes], ...] = tuple(LOGICAL_AXIS_RULES.items())
+
+    @classmethod
+    def default(cls, **overrides: MeshAxes) -> "ShardingRules":
+        merged = dict(LOGICAL_AXIS_RULES)
+        merged.update(overrides)
+        return cls(rules=tuple(merged.items()))
+
+    def mesh_axes(self, logical: Optional[str]) -> MeshAxes:
+        if logical is None:
+            return None
+        return dict(self.rules).get(logical)
+
+    def pspec(self, *logical_axes: Optional[str]) -> PartitionSpec:
+        return logical_to_pspec(logical_axes, self)
+
+
+def logical_to_pspec(
+    logical_axes: Tuple[Optional[str], ...], rules: ShardingRules
+) -> PartitionSpec:
+    """Translate a tuple of logical axis names into a PartitionSpec.
+
+    Mesh axes already consumed by an earlier array dimension are dropped
+    (an axis can shard at most one dimension of a given array).
+    """
+    used: set = set()
+    parts = []
+    for name in logical_axes:
+        axes = rules.mesh_axes(name)
+        if axes is None:
+            parts.append(None)
+            continue
+        if isinstance(axes, str):
+            axes = (axes,)
+        keep = tuple(a for a in axes if a not in used)
+        used.update(keep)
+        if not keep:
+            parts.append(None)
+        elif len(keep) == 1:
+            parts.append(keep[0])
+        else:
+            parts.append(keep)
+    return PartitionSpec(*parts)
+
+
+def named_sharding(
+    mesh: Mesh, rules: ShardingRules, *logical_axes: Optional[str]
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_pspec(logical_axes, rules))
+
+
+def shard_constraint(x, rules: ShardingRules, *logical_axes: Optional[str]):
+    """``with_sharding_constraint`` by logical axis names (no-op outside jit
+    or when no mesh is active)."""
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, logical_to_pspec(logical_axes, rules))
+    except (ValueError, RuntimeError):
+        return x

@@ -1,0 +1,8 @@
+from kubetorch_tpu.training.trainer import (
+    Trainer,
+    cross_entropy_loss,
+    init_train_state,
+    make_train_step,
+)
+
+__all__ = ["Trainer", "cross_entropy_loss", "init_train_state", "make_train_step"]

@@ -1,0 +1,15 @@
+__version__ = "0.1.0"
+
+# Client/server semver compatibility window (reference:
+# provisioning/utils.py:25-80 VersionMismatchError). Server and client must
+# share the same MAJOR.MINOR to interoperate.
+
+
+def compatible(client_version: str, server_version: str) -> bool:
+    """True when client and server share MAJOR.MINOR."""
+    try:
+        c = client_version.split(".")[:2]
+        s = server_version.split(".")[:2]
+        return c == s
+    except Exception:
+        return False

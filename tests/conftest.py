@@ -1,0 +1,50 @@
+"""Test harness: virtual 8-device CPU mesh + test levels.
+
+Mirrors the reference's leveling system (reference:
+``python_client/tests/conftest.py:27-41`` — markers unit|minimal|release|gpu
+selected via ``--level``), with the GPU tier replaced by a ``tpu`` tier.
+The multi-chip story is *better* than the reference's: JAX's
+``xla_force_host_platform_device_count`` fakes an 8-device mesh on CPU, so
+every sharding/collective path is exercised in CI without hardware
+(SURVEY.md §4 "implication for the TPU build").
+"""
+
+import os
+
+# Must run before any jax import anywhere in the test session.
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: session env may point at a TPU
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+# Keep test pods/processes off any real TPU tunnel.
+os.environ.setdefault("KT_BACKEND", "local")
+
+# A sitecustomize may already have imported jax and pointed it at a TPU
+# plugin before this conftest runs; override via the live config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+LEVELS = ["unit", "minimal", "release", "tpu"]
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--level", default="minimal", choices=LEVELS,
+        help="run tests at or below this level")
+
+
+def pytest_collection_modifyitems(config, items):
+    max_level = LEVELS.index(config.getoption("--level"))
+    skip_tpu = pytest.mark.skip(reason="needs --level tpu + real TPU")
+    for item in items:
+        marker = item.get_closest_marker("level")
+        level = LEVELS.index(marker.args[0]) if marker else 0
+        if level > max_level:
+            item.add_marker(
+                skip_tpu if level == LEVELS.index("tpu") else
+                pytest.mark.skip(reason=f"needs --level {LEVELS[level]}"))

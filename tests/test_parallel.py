@@ -1,0 +1,59 @@
+"""Mesh + sharding-rule unit tests on the virtual 8-device CPU mesh."""
+
+import jax
+import pytest
+from jax.sharding import PartitionSpec
+
+from kubetorch_tpu.parallel import (
+    MeshSpec,
+    ShardingRules,
+    best_spec_for,
+    logical_to_pspec,
+)
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_mesh_spec_fill():
+    spec = MeshSpec(fsdp=-1, tp=2)
+    sizes = spec.sizes(8)
+    assert sizes["fsdp"] == 4 and sizes["tp"] == 2
+    mesh = spec.build()
+    assert mesh.shape["fsdp"] == 4
+    assert mesh.shape["tp"] == 2
+    assert mesh.axis_names == ("pp", "dp", "fsdp", "sp", "ep", "tp")
+
+
+def test_mesh_spec_validation():
+    with pytest.raises(ValueError):
+        MeshSpec(dp=3).sizes(8)          # not divisible
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1, tp=-1).sizes(8)  # two fills
+    with pytest.raises(ValueError):
+        MeshSpec(dp=2, tp=2).sizes(8)    # product mismatch
+
+
+def test_best_spec_for():
+    spec = best_spec_for(8, want_tp=2, want_sp=2)
+    sizes = spec.sizes(8)
+    assert sizes["tp"] == 2 and sizes["sp"] == 2 and sizes["fsdp"] == 2
+    # non-dividing requests degrade to 1, remainder goes to fsdp
+    spec = best_spec_for(8, want_tp=3)
+    assert spec.sizes(8)["fsdp"] == 8
+
+
+def test_logical_to_pspec_dedup():
+    rules = ShardingRules.default()
+    # batch uses (dp, fsdp); a later fsdp-sharded dim must drop fsdp.
+    spec = logical_to_pspec(("batch", "embed_fsdp"), rules)
+    assert spec == PartitionSpec(("dp", "fsdp"), None)
+    spec = logical_to_pspec(("embed_fsdp", "heads"), rules)
+    assert spec == PartitionSpec("fsdp", "tp")
+
+
+def test_rules_override():
+    rules = ShardingRules.default(batch="dp", layer="pp")
+    assert rules.pspec("batch", "seq") == PartitionSpec("dp", "sp")
+    assert rules.pspec("layer") == PartitionSpec("pp")
