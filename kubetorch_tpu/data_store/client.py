@@ -1,0 +1,162 @@
+"""Data-store client: local filesystem backend now, HTTP store backend when a
+store server is configured.
+
+Reference: ``data_store/data_store_client.py:54`` (DataStoreClient with
+``locale="store"|"local"`` and P2P rsync) + ``services/data_store/server.py``
+(metadata server). The TPU rebuild ships:
+
+- a **local** backend (``~/.ktpu/store``) with the same verbs — zero setup,
+  used by tests and laptop mode;
+- an **HTTP** backend speaking to ``kubetorch_tpu.data_store.store_server``
+  (metadata + blob + delta-sync endpoints) when ``KT_STORE_URL`` /
+  ``config.store_url`` is set.
+
+File trees are transferred with the delta-sync protocol in ``sync.py``
+(content-hash scan in C when the native extension is built — our rsync
+replacement; this environment has no rsync binary).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from pathlib import Path
+from typing import Any, List, Optional
+
+import cloudpickle
+
+from kubetorch_tpu.config import get_config
+from kubetorch_tpu.exceptions import DataStoreError
+
+_LOCAL_STORE = Path(os.environ.get("KT_LOCAL_STORE",
+                                   "~/.ktpu/store")).expanduser()
+
+
+def _safe_key(key: str) -> str:
+    key = key.strip("/")
+    if not key or ".." in key.split("/"):
+        raise DataStoreError(f"invalid store key {key!r}")
+    return key
+
+
+class DataStoreClient:
+    """Facade choosing the backend per config."""
+
+    _default: Optional["DataStoreClient"] = None
+
+    def __init__(self, store_url: Optional[str] = None):
+        self.store_url = store_url
+
+    @classmethod
+    def default(cls) -> "DataStoreClient":
+        url = os.environ.get("KT_STORE_URL") or get_config().store_url
+        if cls._default is None or cls._default.store_url != url:
+            cls._default = cls(store_url=url)
+        return cls._default
+
+    # ------------------------------------------------------------------
+    def _backend(self):
+        if self.store_url:
+            from kubetorch_tpu.data_store.http_store import HttpStoreBackend
+
+            return HttpStoreBackend(self.store_url)
+        return LocalStoreBackend()
+
+    def put_path(self, key: str, src: Path, **kw) -> str:
+        return self._backend().put_path(_safe_key(key), src, **kw)
+
+    def get_path(self, key: str, dest: Path, **kw) -> Path:
+        return self._backend().get_path(_safe_key(key), dest, **kw)
+
+    def put_object(self, key: str, obj: Any, **kw) -> str:
+        return self._backend().put_blob(
+            _safe_key(key), cloudpickle.dumps(obj), **kw)
+
+    def get_object(self, key: str, **kw) -> Any:
+        return cloudpickle.loads(self._backend().get_blob(_safe_key(key), **kw))
+
+    def list_keys(self, prefix: str = "", **kw) -> List[dict]:
+        return self._backend().list_keys(prefix.strip("/"), **kw)
+
+    def delete(self, key: str, recursive: bool = False, **kw) -> int:
+        return self._backend().delete(_safe_key(key), recursive, **kw)
+
+
+class LocalStoreBackend:
+    """Filesystem store; metadata is the filesystem itself."""
+
+    def __init__(self, root: Optional[Path] = None):
+        self.root = root or _LOCAL_STORE
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, key: str) -> Path:
+        return self.root / key
+
+    def put_path(self, key: str, src: Path, **kw) -> str:
+        dest = self._path(key)
+        if src.is_dir():
+            from kubetorch_tpu.data_store.sync import sync_tree
+
+            sync_tree(src, dest)
+        else:
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copy2(src, dest)
+        return key
+
+    def get_path(self, key: str, dest: Path, **kw) -> Path:
+        src = self._path(key)
+        if not src.exists():
+            raise DataStoreError(f"no such key {key!r}")
+        if src.is_dir():
+            from kubetorch_tpu.data_store.sync import sync_tree
+
+            sync_tree(src, dest)
+        else:
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            if dest.is_dir():
+                dest = dest / src.name
+            shutil.copy2(src, dest)
+        return dest
+
+    def put_blob(self, key: str, blob: bytes, **kw) -> str:
+        path = self._path(key)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_bytes(blob)
+        return key
+
+    def get_blob(self, key: str, **kw) -> bytes:
+        path = self._path(key)
+        if not path.exists() or path.is_dir():
+            raise DataStoreError(f"no such key {key!r}")
+        return path.read_bytes()
+
+    def list_keys(self, prefix: str = "", **kw) -> List[dict]:
+        base = self.root / prefix if prefix else self.root
+        if not base.exists():
+            return []
+        out = []
+        for path in sorted(base.rglob("*")):
+            if path.is_file():
+                stat = path.stat()
+                out.append({
+                    "key": str(path.relative_to(self.root)),
+                    "size": stat.st_size,
+                    "mtime": stat.st_mtime,
+                })
+        return out
+
+    def delete(self, key: str, recursive: bool = False, **kw) -> int:
+        path = self._path(key)
+        if not path.exists():
+            return 0
+        if path.is_dir():
+            if not recursive:
+                raise DataStoreError(
+                    f"{key!r} is a prefix; pass recursive=True")
+            count = sum(1 for p in path.rglob("*") if p.is_file())
+            shutil.rmtree(path)
+            return count
+        path.unlink()
+        return 1

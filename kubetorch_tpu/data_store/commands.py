@@ -1,0 +1,62 @@
+"""``kt.put/get/ls/rm`` — data-store verbs (reference:
+``data_store/data_store_cmds.py:23,139,238,265``).
+
+Auto-detects payload type: filesystem paths sync as file trees; in-memory
+objects (arrays, state dicts) go through the device-transfer path
+(host-staged on TPU — no CUDA-IPC analogue exists, SURVEY.md §7 hard-part 3).
+
+The store resolves in order: explicit ``store_url`` config → in-cluster store
+service → local filesystem store at ``~/.ktpu/store`` (same verbs, zero
+setup — what tests and laptop mode use).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any, List, Optional, Union
+
+from kubetorch_tpu.config import get_config
+from kubetorch_tpu.exceptions import DataStoreError
+
+
+def _client():
+    from kubetorch_tpu.data_store.client import DataStoreClient
+
+    return DataStoreClient.default()
+
+
+def put(key: str, src: Union[str, Path, Any], **kwargs) -> str:
+    """Upload a file tree or object under ``key``.
+
+    ``src`` may be a path (synced as files) or any picklable object
+    (stored as a blob; arrays/state-dicts included).
+    """
+    if isinstance(src, (str, Path)) and Path(src).exists():
+        return _client().put_path(key, Path(src), **kwargs)
+    return _client().put_object(key, src, **kwargs)
+
+
+def get(key: str, dest: Optional[Union[str, Path]] = None, **kwargs) -> Any:
+    """Fetch ``key``: to ``dest`` directory if given (file trees), else
+    returns the stored object."""
+    if dest is not None:
+        return _client().get_path(key, Path(dest), **kwargs)
+    return _client().get_object(key, **kwargs)
+
+
+def ls(prefix: str = "", **kwargs) -> List[dict]:
+    return _client().list_keys(prefix, **kwargs)
+
+
+def rm(key: str, recursive: bool = False, **kwargs) -> int:
+    return _client().delete(key, recursive=recursive, **kwargs)
+
+
+def workdir_sync(key: str, dest: Union[str, Path]) -> Path:
+    """Pull a synced workdir at pod startup (reference: run_wrapper +
+    cached_image_setup rsync pulls)."""
+    dest = Path(dest)
+    dest.mkdir(parents=True, exist_ok=True)
+    _client().get_path(key, dest)
+    return dest

@@ -1,0 +1,5 @@
+"""User-facing distributed helpers (``kt.distributed``)."""
+
+from kubetorch_tpu.distributed.utils import pod_ips, slice_info
+
+__all__ = ["pod_ips", "slice_info"]

@@ -1,0 +1,100 @@
+"""Worker discovery with quorum — usable from user code for elastic training.
+
+Reference: ``python_client/kubetorch/distributed/utils.py:20 pod_ips`` —
+resolves the headless service's A records and waits until ``quorum_workers``
+appear within ``quorum_timeout``; honors a ``LOCAL_IPS`` env override outside
+Kubernetes (``:55-59``) which is also how multi-"pod" tests run on one
+machine.
+
+TPU addition: :func:`slice_info` reads the GKE TPU env contract
+(``TPU_WORKER_ID``, ``TPU_WORKER_HOSTNAMES``, topology) so rank assignment can
+follow the physical slice order, and discovery prefers ``TPU_WORKER_HOSTNAMES``
+over DNS when present (the device plugin already knows the gang membership).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+import time
+from typing import List, Optional
+
+from kubetorch_tpu.exceptions import QuorumTimeoutError
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceInfo:
+    worker_id: int
+    hostnames: List[str]
+    topology: str = ""
+    accelerator: str = ""
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.hostnames)
+
+
+def slice_info() -> Optional[SliceInfo]:
+    """TPU slice membership from the GKE device-plugin env, if present."""
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES")
+    if not hostnames:
+        return None
+    return SliceInfo(
+        worker_id=int(os.environ.get("TPU_WORKER_ID", "0")),
+        hostnames=[h.strip() for h in hostnames.split(",") if h.strip()],
+        topology=os.environ.get("TPU_TOPOLOGY",
+                                os.environ.get("GKE_TPU_TOPOLOGY", "")),
+        accelerator=os.environ.get("TPU_ACCELERATOR_TYPE", ""),
+    )
+
+
+def _resolve_dns(service: str) -> List[str]:
+    try:
+        _, _, ips = socket.gethostbyname_ex(service)
+        return sorted(ips)
+    except socket.gaierror:
+        return []
+
+
+def pod_ips(
+    service_name: Optional[str] = None,
+    quorum_workers: Optional[int] = None,
+    quorum_timeout: float = 300.0,
+    poll_interval: float = 2.0,
+) -> List[str]:
+    """Discover peer addresses, waiting for quorum.
+
+    Resolution order:
+    1. ``LOCAL_IPS`` env (comma-separated ``host[:port]`` — local mode/tests),
+    2. ``TPU_WORKER_HOSTNAMES`` (slice gang membership, already complete),
+    3. DNS A records of ``<service_name>-headless``.
+    """
+    local = os.environ.get("LOCAL_IPS") or os.environ.get("KT_POD_IPS")
+    if local:
+        ips = [x.strip() for x in local.split(",") if x.strip()]
+        if quorum_workers and len(ips) < quorum_workers:
+            raise QuorumTimeoutError(
+                f"LOCAL_IPS has {len(ips)} workers, quorum={quorum_workers}")
+        return ips
+
+    info = slice_info()
+    if info is not None:
+        return list(info.hostnames)
+
+    service_name = service_name or os.environ.get("KT_SERVICE_NAME")
+    if not service_name:
+        raise ValueError("service_name required outside local/TPU-slice mode")
+    headless = (service_name if service_name.endswith("-headless")
+                else f"{service_name}-headless")
+    deadline = time.time() + quorum_timeout
+    want = quorum_workers or 1
+    last: List[str] = []
+    while time.time() < deadline:
+        last = _resolve_dns(headless)
+        if len(last) >= want:
+            return last
+        time.sleep(poll_interval)
+    raise QuorumTimeoutError(
+        f"quorum {want} not reached for {headless} within {quorum_timeout}s "
+        f"(have {len(last)}: {last})")

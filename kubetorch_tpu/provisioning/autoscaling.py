@@ -1,0 +1,51 @@
+"""Autoscaling config → Knative annotations (reference:
+``provisioning/autoscaling.py:13`` + ``convert_to_annotations:109``)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+_METRICS = ("concurrency", "rps", "cpu", "memory")
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    target: Optional[float] = None
+    metric: str = "concurrency"
+    window: Optional[str] = None            # e.g. "60s"
+    min_scale: int = 0
+    max_scale: int = 0                      # 0 = unlimited
+    initial_scale: Optional[int] = None
+    scale_to_zero_grace: Optional[str] = None
+    container_concurrency: Optional[int] = None
+
+    def __post_init__(self):
+        if self.metric not in _METRICS:
+            raise ValueError(
+                f"metric must be one of {_METRICS}, got {self.metric!r}")
+
+    def to_annotations(self) -> Dict[str, str]:
+        cls = ("hpa.autoscaling.knative.dev"
+               if self.metric in ("cpu", "memory")
+               else "kpa.autoscaling.knative.dev")
+        ann = {
+            "autoscaling.knative.dev/class": cls,
+            "autoscaling.knative.dev/metric": self.metric,
+            "autoscaling.knative.dev/min-scale": str(self.min_scale),
+            "autoscaling.knative.dev/max-scale": str(self.max_scale),
+        }
+        if self.target is not None:
+            ann["autoscaling.knative.dev/target"] = str(self.target)
+        if self.window:
+            ann["autoscaling.knative.dev/window"] = self.window
+        if self.initial_scale is not None:
+            ann["autoscaling.knative.dev/initial-scale"] = str(
+                self.initial_scale)
+        if self.scale_to_zero_grace:
+            ann["autoscaling.knative.dev/scale-to-zero-pod-retention-period"] \
+                = self.scale_to_zero_grace
+        return ann
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)

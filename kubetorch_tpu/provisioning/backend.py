@@ -1,0 +1,275 @@
+"""Deployment backends: where pods actually run.
+
+``LocalBackend`` runs each "pod" as a local subprocess serving the same pod
+server on 127.0.0.1 ports — the moral equivalent of the reference's
+``LOCAL_IPS`` test mode (``distributed/utils.py:55``) promoted to a
+first-class backend so the entire control path (deploy → ready → call →
+distribute → teardown) runs identically with or without a cluster.
+
+``K8sBackend`` (provisioning/k8s_backend.py) renders manifests and applies
+them via the controller. Both implement the same interface, keeping the
+``ControllerClient`` seam from SURVEY.md §7 stage-1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from kubetorch_tpu.config import get_config
+from kubetorch_tpu.exceptions import ServiceTimeoutError
+from kubetorch_tpu.serving import http_client
+
+_LOCAL_ROOT = Path(os.environ.get("KT_LOCAL_STATE",
+                                  "~/.ktpu/local")).expanduser()
+
+
+def free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+class ServiceRecord(dict):
+    """Persisted service state (the local 'pool registry' row)."""
+
+    @property
+    def urls(self) -> List[str]:
+        return [f"http://127.0.0.1:{p['port']}" for p in self["pods"]]
+
+
+class LocalBackend:
+    name = "local"
+
+    # ------------------------------------------------------------------
+    def _service_dir(self, service_name: str) -> Path:
+        return _LOCAL_ROOT / service_name
+
+    def _record_path(self, service_name: str) -> Path:
+        return self._service_dir(service_name) / "service.json"
+
+    def lookup(self, service_name: str) -> Optional[ServiceRecord]:
+        path = self._record_path(service_name)
+        if not path.exists():
+            return None
+        record = ServiceRecord(json.loads(path.read_text()))
+        return record
+
+    def list_services(self) -> List[ServiceRecord]:
+        if not _LOCAL_ROOT.exists():
+            return []
+        out = []
+        for path in sorted(_LOCAL_ROOT.glob("*/service.json")):
+            try:
+                out.append(ServiceRecord(json.loads(path.read_text())))
+            except Exception:
+                continue
+        return out
+
+    # ------------------------------------------------------------------
+    def launch(
+        self,
+        service_name: str,
+        *,
+        module_env: Dict[str, str],
+        compute_dict: Dict[str, Any],
+        module_meta: Dict[str, Any],
+        num_pods: int = 1,
+        launch_timeout: int = 300,
+        launch_id: str = "",
+    ) -> ServiceRecord:
+        """Start (or replace) ``num_pods`` pod-server subprocesses."""
+        existing = self.lookup(service_name)
+        if existing:
+            self.teardown(service_name, quiet=True)
+
+        service_dir = self._service_dir(service_name)
+        service_dir.mkdir(parents=True, exist_ok=True)
+        ports = [free_port() for _ in range(num_pods)]
+        local_ips = ",".join(f"127.0.0.1:{p}" for p in ports)
+
+        # The pod-server subprocess must be able to import this package even
+        # when the client was launched from elsewhere.
+        pkg_root = str(Path(__file__).resolve().parents[2])
+        python_path = os.environ.get("PYTHONPATH", "")
+        if pkg_root not in python_path.split(os.pathsep):
+            python_path = (f"{pkg_root}{os.pathsep}{python_path}"
+                           if python_path else pkg_root)
+
+        pods = []
+        for index, port in enumerate(ports):
+            env = {
+                **os.environ,
+                **module_env,
+                "PYTHONPATH": python_path,
+                "KT_SERVICE_NAME": service_name,
+                "KT_SERVER_PORT": str(port),
+                "KT_REPLICA_INDEX": str(index),
+                "KT_LAUNCH_ID": launch_id,
+                "LOCAL_IPS": local_ips,
+                # workers must not inherit the client's TPU tunnel config
+                # unless the compute asked for TPUs.
+            }
+            log_path = service_dir / f"pod-{index}.log"
+            log_file = open(log_path, "ab")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "kubetorch_tpu.serving.server",
+                 "--host", "127.0.0.1", "--port", str(port)],
+                env=env, stdout=log_file, stderr=subprocess.STDOUT,
+                start_new_session=True)
+            log_file.close()
+            pods.append({"pid": proc.pid, "port": port, "index": index,
+                         "log": str(log_path)})
+
+        record = ServiceRecord({
+            "service_name": service_name,
+            "backend": "local",
+            "created_at": time.time(),
+            "launch_id": launch_id,
+            "pods": pods,
+            "module_env": module_env,
+            "module_meta": module_meta,
+            "compute": compute_dict,
+            "username": get_config().username,
+        })
+        self._record_path(service_name).write_text(json.dumps(record, indent=2))
+        self._wait_ready(record, launch_timeout, launch_id)
+        return record
+
+    # ------------------------------------------------------------------
+    def _wait_ready(self, record: ServiceRecord, timeout: int,
+                    launch_id: str):
+        """Poll /ready on every pod; on failure surface the pod log tail
+        (the local analog of the reference's pod-event extraction,
+        ``service_manager.py:682``)."""
+        deadline = time.time() + timeout
+        pending = {p["port"]: p for p in record["pods"]}
+        while pending and time.time() < deadline:
+            for port, pod in list(pending.items()):
+                if not _pid_alive(pod["pid"]):
+                    raise ServiceTimeoutError(
+                        f"pod {pod['index']} of {record['service_name']} "
+                        f"exited during launch\n{_log_tail(pod['log'])}")
+                if http_client.is_ready(
+                        f"http://127.0.0.1:{port}", launch_id):
+                    del pending[port]
+            if pending:
+                time.sleep(0.3)
+        if pending:
+            pod = next(iter(pending.values()))
+            raise ServiceTimeoutError(
+                f"{len(pending)} pod(s) of {record['service_name']} not "
+                f"ready after {timeout}s\n{_log_tail(pod['log'])}")
+
+    # ------------------------------------------------------------------
+    def service_url(self, service_name: str) -> str:
+        record = self.lookup(service_name)
+        if record is None:
+            raise KeyError(f"no local service {service_name!r}")
+        return record.urls[0]
+
+    def pod_urls(self, service_name: str) -> List[str]:
+        record = self.lookup(service_name)
+        if record is None:
+            raise KeyError(f"no local service {service_name!r}")
+        return record.urls
+
+    def reload(self, service_name: str, metadata: Dict[str, Any]):
+        """Push new metadata to every pod (controller push-reload analog)."""
+        for url in self.pod_urls(service_name):
+            resp = http_client.sync_client().post(
+                f"{url}/_reload", json=metadata, timeout=300.0)
+            if resp.status_code != 200:
+                from kubetorch_tpu.exceptions import rehydrate_exception
+
+                raise rehydrate_exception(resp.json())
+
+    def teardown(self, service_name: str, quiet: bool = False) -> bool:
+        record = self.lookup(service_name)
+        if record is None:
+            if quiet:
+                return False
+            raise KeyError(f"no local service {service_name!r}")
+        for pod in record["pods"]:
+            _kill_tree(pod["pid"])
+        shutil.rmtree(self._service_dir(service_name), ignore_errors=True)
+        return True
+
+    def logs(self, service_name: str, pod_index: Optional[int] = None,
+             tail: int = 200) -> str:
+        record = self.lookup(service_name)
+        if record is None:
+            raise KeyError(f"no local service {service_name!r}")
+        chunks = []
+        for pod in record["pods"]:
+            if pod_index is not None and pod["index"] != pod_index:
+                continue
+            chunks.append(f"=== pod {pod['index']} ===\n"
+                          f"{_log_tail(pod['log'], tail)}")
+        return "\n".join(chunks)
+
+    def is_up(self, service_name: str) -> bool:
+        record = self.lookup(service_name)
+        if record is None:
+            return False
+        return all(_pid_alive(p["pid"]) for p in record["pods"])
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def _kill_tree(pid: int):
+    """SIGTERM the pod server's process group (it leads a session)."""
+    try:
+        os.killpg(pid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+    deadline = time.time() + 3.0
+    while time.time() < deadline and _pid_alive(pid):
+        time.sleep(0.1)
+    if _pid_alive(pid):
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def _log_tail(log_path: str, lines: int = 60) -> str:
+    try:
+        content = Path(log_path).read_text(errors="replace").splitlines()
+        return "\n".join(content[-lines:])
+    except OSError:
+        return "(no log available)"
+
+
+_backends: Dict[str, Any] = {}
+
+
+def get_backend(name: Optional[str] = None):
+    name = name or get_config().backend
+    if name not in _backends:
+        if name == "local":
+            _backends[name] = LocalBackend()
+        elif name == "k8s":
+            from kubetorch_tpu.provisioning.k8s_backend import K8sBackend
+
+            _backends[name] = K8sBackend()
+        else:
+            raise ValueError(f"unknown backend {name!r}")
+    return _backends[name]

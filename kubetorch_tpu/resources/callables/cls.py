@@ -1,0 +1,64 @@
+"""``kt.cls`` — remote class proxy (reference: resources/callables/cls/cls.py).
+
+Methods of the deployed class become endpoints ``/{cls}/{method}``; attribute
+access on the proxy returns a callable method stub (sync ``__call__`` +
+``.acall``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from kubetorch_tpu.resources.callables.module import Module
+from kubetorch_tpu.resources.callables.pointers import extract_pointers
+
+
+class _MethodProxy:
+    def __init__(self, owner: "Cls", method: str):
+        self._owner = owner
+        self._method = method
+
+    def __call__(self, *args, serialization: Optional[str] = None,
+                 timeout: Optional[float] = None, **kwargs) -> Any:
+        return self._owner._call_remote(
+            method=self._method, args=args, kwargs=kwargs,
+            serialization=serialization, timeout=timeout)
+
+    async def acall(self, *args, serialization: Optional[str] = None,
+                    timeout: Optional[float] = None, **kwargs) -> Any:
+        return await self._owner._call_remote_async(
+            method=self._method, args=args, kwargs=kwargs,
+            serialization=serialization, timeout=timeout)
+
+    def __repr__(self):
+        return f"<remote method {self._owner.callable_name}.{self._method}>"
+
+
+class Cls(Module):
+    MODULE_TYPE = "cls"
+
+    def __getattr__(self, item: str) -> Any:
+        if item.startswith("_") or item in self.__dict__:
+            raise AttributeError(item)
+        return _MethodProxy(self, item)
+
+
+def cls(
+    klass_or_name: Callable | str,
+    init_args: Optional[list] = None,
+    init_kwargs: Optional[dict] = None,
+    name: Optional[str] = None,
+) -> Cls:
+    """Wrap a local class (or reconnect by name) for remote deploy.
+
+    ``init_args``/``init_kwargs`` are applied when the pod instantiates the
+    class (once per worker process).
+    """
+    if isinstance(klass_or_name, str):
+        return Cls.from_name(klass_or_name)
+    root, import_path, symbol = extract_pointers(klass_or_name)
+    init = None
+    if init_args or init_kwargs:
+        init = {"args": list(init_args or []), "kwargs": init_kwargs or {}}
+    return Cls(root_path=root, import_path=import_path, callable_name=symbol,
+               name=name or symbol, init_args=init)

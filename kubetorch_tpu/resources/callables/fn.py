@@ -1,0 +1,53 @@
+"""``kt.fn`` — remote function proxy (reference: resources/callables/fn/fn.py).
+
+``kt.fn(train).to(kt.Compute(tpus="v5e-8"))`` returns a proxy whose
+``__call__`` POSTs to the deployed service; distributed deployments return a
+list of per-rank results.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from kubetorch_tpu.resources.callables.module import Module
+from kubetorch_tpu.resources.callables.pointers import extract_pointers
+
+
+class Fn(Module):
+    MODULE_TYPE = "fn"
+
+    def __call__(self, *args: Any, serialization: Optional[str] = None,
+                 timeout: Optional[float] = None, workers: str = "",
+                 restart_procs: bool = False, **kwargs: Any) -> Any:
+        return self._call_remote(
+            args=args, kwargs=kwargs, serialization=serialization,
+            timeout=timeout, workers=workers, restart_procs=restart_procs)
+
+    async def acall(self, *args: Any, serialization: Optional[str] = None,
+                    timeout: Optional[float] = None, **kwargs: Any) -> Any:
+        return await self._call_remote_async(
+            args=args, kwargs=kwargs, serialization=serialization,
+            timeout=timeout)
+
+    # Keep the local function callable for tests/dev ergonomics.
+    def local(self, *args, **kwargs):
+        import importlib
+        import sys
+
+        if self.root_path and self.root_path not in sys.path:
+            sys.path.insert(0, self.root_path)
+        module = importlib.import_module(self.import_path)
+        return getattr(module, self.callable_name)(*args, **kwargs)
+
+
+def fn(callable_or_name: Callable | str, name: Optional[str] = None) -> Fn:
+    """Wrap a local function (or reconnect by name) for remote deploy.
+
+    ``kt.fn(train)`` extracts source pointers; ``kt.fn("train")`` reloads an
+    already-deployed service by name.
+    """
+    if isinstance(callable_or_name, str):
+        return Fn.from_name(callable_or_name)
+    root, import_path, symbol = extract_pointers(callable_or_name)
+    return Fn(root_path=root, import_path=import_path, callable_name=symbol,
+              name=name or symbol)

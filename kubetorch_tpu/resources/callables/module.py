@@ -1,0 +1,267 @@
+"""Module: the shared deploy machinery behind ``kt.fn`` / ``kt.cls`` /
+``kt.app``.
+
+Reference: ``resources/callables/module.py`` (``to:516``,
+``_launch_service:797``, ``from_name:361``, ``teardown:1003``,
+``_wait_for_http_health:1466``). A Module binds user code pointers to a
+Compute, launches through the configured backend, and exposes a typed remote
+proxy. Naming follows the reference: service names are optionally prefixed
+with the username so shared clusters don't collide.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import uuid
+from typing import Any, Dict, List, Optional
+
+from kubetorch_tpu.config import get_config
+from kubetorch_tpu.exceptions import KubetorchError
+from kubetorch_tpu.provisioning.backend import get_backend
+from kubetorch_tpu.resources.callables.pointers import reload_fallback_names
+from kubetorch_tpu.resources.compute.compute import Compute
+from kubetorch_tpu.serving import http_client
+
+
+def sanitize_service_name(name: str) -> str:
+    """DNS-1123 label: lowercase alphanumerics and dashes, ≤63 chars."""
+    name = re.sub(r"[^a-z0-9-]+", "-", name.lower()).strip("-")
+    return name[:63] or "svc"
+
+
+class Module:
+    MODULE_TYPE = "fn"
+
+    def __init__(
+        self,
+        root_path: str = "",
+        import_path: str = "",
+        callable_name: str = "",
+        name: Optional[str] = None,
+        init_args: Optional[dict] = None,
+    ):
+        self.root_path = root_path
+        self.import_path = import_path
+        self.callable_name = callable_name
+        self._name = name or callable_name
+        self.init_args = init_args
+        self.compute: Optional[Compute] = None
+        self.service_name: Optional[str] = None
+        self._backend = None
+        self._launch_id: Optional[str] = None
+
+    # ------------------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def _compute_service_name(self, name: Optional[str] = None) -> str:
+        cfg = get_config()
+        base = name or self._name
+        if cfg.prefix_username and cfg.username and not base.startswith(
+                f"{cfg.username}-"):
+            base = f"{cfg.username}-{base}"
+        return sanitize_service_name(base)
+
+    @property
+    def backend(self):
+        if self._backend is None:
+            self._backend = get_backend()
+        return self._backend
+
+    # ------------------------------------------------------------------
+    def module_metadata(self) -> Dict[str, Any]:
+        """The metadata contract consumed by the pod server
+        (serving/server.py metadata_from_env)."""
+        compute = self.compute or Compute()
+        dist = compute.distributed
+        num_procs = 1
+        framework = None
+        distributed = None
+        if dist is not None:
+            framework = dist.type
+            distributed = dist.to_dict()
+            if dist.num_procs:
+                num_procs = dist.num_procs
+            else:
+                from kubetorch_tpu.serving.frameworks import framework_class
+
+                num_procs = framework_class(dist.type).auto_num_procs()
+        return {
+            "service_name": self.service_name or self._name,
+            "callable_type": self.MODULE_TYPE,
+            "root_path": self.root_path,
+            "import_path": self.import_path,
+            "name": self.callable_name,
+            "init_args": self.init_args,
+            "num_procs": num_procs,
+            "framework": framework,
+            "distributed": distributed,
+            "allowed_serialization": list(compute.allowed_serialization),
+        }
+
+    def _module_env(self) -> Dict[str, str]:
+        meta = self.module_metadata()
+        env = {
+            "KT_CLS_OR_FN_NAME": self.callable_name,
+            "KT_CALLABLE_TYPE": meta["callable_type"],
+            "KT_ROOT_PATH": meta["root_path"],
+            "KT_IMPORT_PATH": meta["import_path"],
+            "KT_CALLABLE_NAME": meta["name"],
+            "KT_NUM_PROCS": str(meta["num_procs"]),
+            "KT_ALLOWED_SERIALIZATION": ",".join(
+                meta["allowed_serialization"]),
+        }
+        if meta.get("framework"):
+            env["KT_FRAMEWORK"] = meta["framework"]
+        if meta.get("init_args") is not None:
+            env["KT_INIT_ARGS"] = json.dumps(meta["init_args"])
+        if meta.get("distributed") is not None:
+            env["KT_DISTRIBUTED"] = json.dumps(meta["distributed"])
+        if self.compute is not None:
+            env.update(self.compute.env)
+            for secret in self.compute.secrets:
+                env.update(secret.local_env())
+        return env
+
+    # ------------------------------------------------------------------
+    def to(self, compute: Compute, name: Optional[str] = None) -> "Module":
+        """Deploy this module onto ``compute`` (reference: Module.to:516)."""
+        self.compute = compute
+        self.service_name = self._compute_service_name(name)
+        self._launch_id = uuid.uuid4().hex[:8]
+        self.backend.launch(
+            self.service_name,
+            module_env=self._module_env(),
+            compute_dict=compute.to_dict(),
+            module_meta=self.module_metadata(),
+            num_pods=compute.num_pods,
+            launch_timeout=compute.launch_timeout,
+            launch_id=self._launch_id,
+        )
+        return self
+
+    async def to_async(self, compute: Compute,
+                       name: Optional[str] = None) -> "Module":
+        import asyncio
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.to(compute, name))
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_name(cls, name: str) -> "Module":
+        """Reconnect to an already-deployed service by name (reference:
+        from_name:361 with username-prefixed fallbacks)."""
+        backend = get_backend()
+        record = None
+        for candidate in reload_fallback_names(
+                sanitize_service_name(name), get_config().username):
+            record = backend.lookup(candidate)
+            if record is not None:
+                break
+        if record is None:
+            raise KubetorchError(f"no deployed service found for {name!r}")
+        meta = record.get("module_meta", {})
+        module = cls(
+            root_path=meta.get("root_path", ""),
+            import_path=meta.get("import_path", ""),
+            callable_name=meta.get("name", ""),
+            name=record["service_name"],
+            init_args=meta.get("init_args"),
+        )
+        module.service_name = record["service_name"]
+        if record.get("compute"):
+            module.compute = Compute.from_dict(record["compute"])
+        return module
+
+    @classmethod
+    def get_if_exists(cls, name: str) -> Optional["Module"]:
+        try:
+            return cls.from_name(name)
+        except KubetorchError:
+            return None
+
+    # ------------------------------------------------------------------
+    def service_url(self) -> str:
+        self._ensure_deployed()
+        return self.backend.service_url(self.service_name)
+
+    def pod_urls(self) -> List[str]:
+        self._ensure_deployed()
+        return self.backend.pod_urls(self.service_name)
+
+    def is_up(self) -> bool:
+        if self.service_name is None:
+            return False
+        return self.backend.is_up(self.service_name)
+
+    def logs(self, pod: Optional[int] = None, tail: int = 200) -> str:
+        self._ensure_deployed()
+        return self.backend.logs(self.service_name, pod, tail)
+
+    def reload_code(self):
+        """Re-sync code + hot-reload the callable on every pod."""
+        self._ensure_deployed()
+        self.backend.reload(self.service_name, self.module_metadata())
+
+    def teardown(self):
+        """Tear down the deployed service (reference: teardown:1003)."""
+        if self.service_name is not None:
+            self.backend.teardown(self.service_name, quiet=True)
+
+    def _ensure_deployed(self):
+        if self.service_name is None:
+            raise KubetorchError(
+                f"{self._name} is not deployed; call .to(Compute(...)) first")
+
+    # ------------------------------------------------------------------
+    def _call_remote(
+        self,
+        method: Optional[str] = None,
+        args: tuple = (),
+        kwargs: Optional[dict] = None,
+        serialization: Optional[str] = None,
+        timeout: Optional[float] = None,
+        stream_logs: Optional[bool] = None,
+        **query: Any,
+    ) -> Any:
+        cfg = get_config()
+        allowed = (self.compute.allowed_serialization
+                   if self.compute else ("json", "pickle"))
+        return http_client.call_method(
+            self.service_url(),
+            self.callable_name or self.service_name,
+            method=method,
+            args=args,
+            kwargs=kwargs or {},
+            ser=serialization or cfg.serialization,
+            allowed=allowed,
+            timeout=timeout,
+            query={k: str(v).lower() for k, v in query.items() if v},
+        )
+
+    async def _call_remote_async(
+        self,
+        method: Optional[str] = None,
+        args: tuple = (),
+        kwargs: Optional[dict] = None,
+        serialization: Optional[str] = None,
+        timeout: Optional[float] = None,
+        **query: Any,
+    ) -> Any:
+        cfg = get_config()
+        allowed = (self.compute.allowed_serialization
+                   if self.compute else ("json", "pickle"))
+        return await http_client.call_method_async(
+            self.service_url(),
+            self.callable_name or self.service_name,
+            method=method,
+            args=args,
+            kwargs=kwargs or {},
+            ser=serialization or cfg.serialization,
+            allowed=allowed,
+            timeout=timeout,
+            query={k: str(v).lower() for k, v in query.items() if v},
+        )

@@ -1,0 +1,94 @@
+"""Pointer extraction: turn a live Python object into (root_path, import_path,
+name) so a remote pod can re-import it from synced source.
+
+Reference: ``resources/callables/utils.py:53`` (extract_pointers),
+``:23`` (notebook fns — source written to a real file), ``:259``
+(build_call_body).
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+import textwrap
+from pathlib import Path
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+def _module_root(module) -> Optional[Path]:
+    """Repo/package root that must be synced for ``module`` to import."""
+    mod_file = getattr(module, "__file__", None)
+    if not mod_file:
+        return None
+    path = Path(mod_file).resolve()
+    # Walk up past package __init__.py files to the first non-package dir.
+    root = path.parent
+    parts = (module.__name__ or "").split(".")
+    for _ in range(len(parts) - 1):
+        root = root.parent
+    return root
+
+
+def extract_pointers(obj: Callable) -> Tuple[str, str, str]:
+    """Return (root_path, import_path, name) for a function or class.
+
+    ``root_path`` is the directory to sync; ``import_path`` is the dotted
+    module path relative to it; ``name`` is the symbol to fetch.
+    """
+    if not (inspect.isfunction(obj) or inspect.isclass(obj)):
+        raise TypeError(
+            f"can only deploy functions or classes, got {type(obj)}")
+    name = obj.__qualname__
+    if "." in name and not inspect.isclass(obj):
+        raise ValueError(
+            f"{name} is a nested/bound callable; deploy a module-level "
+            f"function or class")
+    module = sys.modules.get(obj.__module__)
+    if module is None or obj.__module__ == "__main__":
+        return _pointers_for_main(obj)
+    root = _module_root(module)
+    if root is None:  # builtin / C module — not deployable from source
+        raise ValueError(f"cannot locate source for {name}")
+    return str(root), module.__name__, name
+
+
+def _pointers_for_main(obj: Callable) -> Tuple[str, str, str]:
+    """__main__ / notebook case: persist the source into a real module file
+    (reference: prepare_notebook_fn writes source to a file)."""
+    main_mod = sys.modules.get("__main__")
+    main_file = getattr(main_mod, "__file__", None)
+    if main_file and Path(main_file).suffix == ".py":
+        path = Path(main_file).resolve()
+        return str(path.parent), path.stem, obj.__qualname__
+    # True notebook / REPL: write source to .kt_generated/<name>.py in cwd.
+    gen_dir = Path.cwd() / ".kt_generated"
+    gen_dir.mkdir(exist_ok=True)
+    source = textwrap.dedent(inspect.getsource(obj))
+    target = gen_dir / f"{obj.__qualname__.lower()}_module.py"
+    target.write_text(source)
+    return str(Path.cwd()), f".kt_generated.{target.stem}", obj.__qualname__
+
+
+def build_call_body(
+    args: tuple, kwargs: dict, debug: Optional[dict] = None
+) -> Dict[str, Any]:
+    """Uniform request body for POST /{callable}[/{method}]."""
+    body: Dict[str, Any] = {"args": list(args), "kwargs": kwargs}
+    if debug:
+        body["debug"] = debug
+    return body
+
+
+def reload_fallback_names(name: str, username: Optional[str] = None) -> list:
+    """Name candidates for ``from_name`` reload, most-specific first
+    (reference: get_names_for_reload_fallbacks:186 — username/branch
+    prefixed names resolve before bare ones)."""
+    candidates = []
+    if username:
+        candidates.append(f"{username}-{name}")
+    env_user = os.environ.get("KT_USERNAME")
+    if env_user and f"{env_user}-{name}" not in candidates:
+        candidates.append(f"{env_user}-{name}")
+    candidates.append(name)
+    return candidates

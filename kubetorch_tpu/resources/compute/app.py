@@ -1,0 +1,77 @@
+"""``kt.app`` — arbitrary server/CLI command as a workload.
+
+Reference: ``resources/compute/app.py:20`` — deploy e.g. an inference server
+with optional HTTP proxying through the pod server's ``/http`` reverse proxy
+and a health-check path.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+from kubetorch_tpu.resources.callables.module import Module
+
+
+class App(Module):
+    MODULE_TYPE = "app"
+
+    def __init__(
+        self,
+        command: str,
+        name: str,
+        port: Optional[int] = None,
+        health_path: str = "",
+        root_path: str = "",
+    ):
+        super().__init__(root_path=root_path, import_path="",
+                         callable_name=name, name=name)
+        self.command = command
+        self.port = port
+        self.health_path = health_path
+
+    def module_metadata(self) -> Dict[str, Any]:
+        meta = super().module_metadata()
+        meta.update({
+            "app_cmd": self.command,
+            "app_port": self.port or 0,
+            "app_health_path": self.health_path,
+        })
+        return meta
+
+    def _module_env(self) -> Dict[str, str]:
+        env = super()._module_env()
+        env["KT_APP_CMD"] = self.command
+        if self.port:
+            env["KT_APP_PORT"] = str(self.port)
+        if self.health_path:
+            env["KT_APP_HEALTH_PATH"] = self.health_path
+        return env
+
+    # ---- interaction --------------------------------------------------
+    def status(self) -> Dict[str, Any]:
+        from kubetorch_tpu.serving.http_client import get_json
+
+        _, payload = get_json(self.service_url(), "/app/status")
+        return payload or {}
+
+    def request(self, path: str, method: str = "GET",
+                body: Optional[Any] = None, timeout: float = 60.0):
+        """Call the app through the pod server's /http reverse proxy."""
+        from kubetorch_tpu.serving.http_client import sync_client
+
+        url = f"{self.service_url()}/http/{path.lstrip('/')}"
+        resp = sync_client().request(
+            method, url,
+            content=json.dumps(body).encode() if body is not None else None,
+            timeout=timeout)
+        try:
+            return resp.json()
+        except ValueError:
+            return resp.text
+
+
+def app(command: str, name: str, port: Optional[int] = None,
+        health_path: str = "", root_path: str = "") -> App:
+    return App(command=command, name=name, port=port,
+               health_path=health_path, root_path=root_path)

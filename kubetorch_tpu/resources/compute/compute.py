@@ -1,0 +1,270 @@
+"""``kt.Compute`` — the workload resource spec.
+
+Reference: ``resources/compute/compute.py`` (ctor ``:34``, ``distribute:2596``,
+``autoscale:2696``, ``queue_name:1710``, ``deployment_mode:1613``). The TPU
+rebuild replaces ``gpus=``/``gpu_type=`` with a first-class ``tpus="v5e-8"``
+resource that expands into slice topology (one pod per TPU VM host, gang =
+all hosts of a slice, Kueue queue sized in slices — SURVEY.md §7 hard-part 2).
+
+A Compute is declarative and serializable; launching happens through the
+provisioning layer (``provisioning/service_manager.py``) against the
+configured backend ("local" subprocess pods or "k8s").
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+import dataclasses
+from typing import Any, Dict, List, Optional, Union
+
+from kubetorch_tpu.config import get_config
+from kubetorch_tpu.resources.compute.topology import TpuSpec, parse_tpus
+from kubetorch_tpu.resources.compute.endpoint import Endpoint
+from kubetorch_tpu.resources.images.image import Image
+from kubetorch_tpu.resources.secrets.secret import Secret
+from kubetorch_tpu.resources.volumes.volume import Volume
+
+KUEUE_QUEUE_LABEL = "kueue.x-k8s.io/queue-name"
+USERNAME_LABEL = "kubetorch.com/username"
+TTL_ANNOTATION = "kubetorch.com/inactivity-ttl"
+
+
+@dataclasses.dataclass
+class DistributedConfig:
+    """``.distribute(...)`` settings (reference: compute.py:2596)."""
+
+    type: str = "jax"               # jax | pytorch | tensorflow | spmd | ray
+    workers: int = 1                # pods (TPU: slices; each slice may be
+                                    # multiple pods/hosts)
+    num_procs: Optional[int] = None  # processes per pod; None = auto
+    quorum_timeout: float = 300.0
+    quorum_workers: Optional[int] = None  # None = all workers
+    monitor_members: bool = True
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, data) -> "DistributedConfig":
+        return cls(**data)
+
+
+class Compute:
+    def __init__(
+        self,
+        cpus: Optional[Union[str, float]] = None,
+        memory: Optional[str] = None,
+        disk_size: Optional[str] = None,
+        tpus: Optional[str] = None,
+        gpus: Optional[int] = None,
+        gpu_type: Optional[str] = None,
+        image: Optional[Image] = None,
+        env: Optional[Dict[str, str]] = None,
+        volumes: Optional[List[Volume]] = None,
+        secrets: Optional[List[Union[Secret, str]]] = None,
+        node_selector: Optional[Dict[str, str]] = None,
+        tolerations: Optional[List[Dict[str, Any]]] = None,
+        priority_class: Optional[str] = None,
+        queue_name: Optional[str] = None,
+        inactivity_ttl: Optional[str] = None,
+        launch_timeout: Optional[int] = None,
+        replicas: int = 1,
+        namespace: Optional[str] = None,
+        service_account: Optional[str] = None,
+        allowed_serialization: tuple = ("json", "pickle"),
+        endpoint: Optional[Endpoint] = None,
+        labels: Optional[Dict[str, str]] = None,
+        annotations: Optional[Dict[str, str]] = None,
+        freeze: bool = False,
+    ):
+        cfg = get_config()
+        self.cpus = str(cpus) if cpus is not None else None
+        self.memory = memory
+        self.disk_size = disk_size
+        self.tpus = tpus
+        if gpus or gpu_type:
+            # GPU workloads still launch (nvidia.com/gpu limits) but are not
+            # the optimized path of this framework.
+            self.gpus, self.gpu_type = gpus, gpu_type
+        else:
+            self.gpus, self.gpu_type = None, None
+        self.image = image or Image()
+        self.env = dict(env or {})
+        self.volumes = list(volumes or [])
+        self.secrets = [
+            s if isinstance(s, Secret) else Secret.from_provider(s)
+            for s in (secrets or [])
+        ]
+        self.node_selector = dict(node_selector or {})
+        self.tolerations = list(tolerations or [])
+        self.priority_class = priority_class
+        self.queue_name = queue_name
+        self.inactivity_ttl = inactivity_ttl or cfg.inactivity_ttl
+        self.launch_timeout = launch_timeout or cfg.launch_timeout
+        self.replicas = replicas
+        self.namespace = namespace or cfg.namespace
+        self.service_account = service_account
+        self.allowed_serialization = tuple(allowed_serialization)
+        self.endpoint = endpoint
+        self.labels = dict(labels or {})
+        self.annotations = dict(annotations or {})
+        self.freeze = freeze
+        self.distributed: Optional[DistributedConfig] = None
+        self.autoscaling = None  # AutoscalingConfig
+
+    # ------------------------------------------------------------------
+    @property
+    def tpu_spec(self) -> Optional[TpuSpec]:
+        return parse_tpus(self.tpus) if self.tpus else None
+
+    @property
+    def num_pods(self) -> int:
+        """Total pods: workers × hosts-per-slice (one pod per TPU host)."""
+        workers = self.distributed.workers if self.distributed else 1
+        hosts = self.tpu_spec.num_hosts if self.tpu_spec else 1
+        return max(self.replicas, workers * hosts)
+
+    @property
+    def deployment_mode(self) -> str:
+        """deployment | knative | jobset (reference: deployment_mode:1613)."""
+        if self.autoscaling is not None:
+            return "knative"
+        if self.tpu_spec is not None and self.tpu_spec.multi_host:
+            return "jobset"  # multi-host slices need stable per-host identity
+        return "deployment"
+
+    # ------------------------------------------------------------------
+    def distribute(
+        self,
+        type: str = "jax",
+        workers: int = 1,
+        num_procs: Optional[int] = None,
+        quorum_timeout: float = 300.0,
+        quorum_workers: Optional[int] = None,
+        monitor_members: bool = True,
+    ) -> "Compute":
+        """Declare the workload distributed: N workers with framework
+        bootstrap. Returns a copy (Computes are value-like)."""
+        new = self.copy()
+        new.distributed = DistributedConfig(
+            type=type, workers=workers, num_procs=num_procs,
+            quorum_timeout=quorum_timeout, quorum_workers=quorum_workers,
+            monitor_members=monitor_members)
+        return new
+
+    def autoscale(self, **kwargs) -> "Compute":
+        from kubetorch_tpu.provisioning.autoscaling import AutoscalingConfig
+
+        new = self.copy()
+        new.autoscaling = AutoscalingConfig(**kwargs)
+        return new
+
+    def copy(self) -> "Compute":
+        return _copy.deepcopy(self)
+
+    # ------------------------------------------------------------------
+    def pod_resources(self) -> Dict[str, Dict[str, str]]:
+        """K8s resources block for the workload container."""
+        requests: Dict[str, str] = {}
+        limits: Dict[str, str] = {}
+        if self.cpus:
+            requests["cpu"] = self.cpus
+        if self.memory:
+            requests["memory"] = self.memory
+        if self.disk_size:
+            requests["ephemeral-storage"] = self.disk_size
+        if self.tpu_spec:
+            limits.update(self.tpu_spec.resource_limits())
+        if self.gpus:
+            limits["nvidia.com/gpu"] = str(self.gpus)
+        return {"requests": requests, "limits": limits}
+
+    def all_node_selectors(self) -> Dict[str, str]:
+        selectors = dict(self.node_selector)
+        if self.tpu_spec:
+            selectors.update(self.tpu_spec.node_selectors())
+        if self.gpu_type:
+            selectors["cloud.google.com/gke-accelerator"] = self.gpu_type
+        return selectors
+
+    def workload_labels(self, service_name: str) -> Dict[str, str]:
+        cfg = get_config()
+        labels = {
+            "kubetorch.com/service": service_name,
+            USERNAME_LABEL: cfg.username,
+            "kubetorch.com/managed": "true",
+            **self.labels,
+        }
+        if self.queue_name:
+            labels[KUEUE_QUEUE_LABEL] = self.queue_name
+        return labels
+
+    def workload_annotations(self) -> Dict[str, str]:
+        annotations = dict(self.annotations)
+        if self.inactivity_ttl:
+            annotations[TTL_ANNOTATION] = str(self.inactivity_ttl)
+        return annotations
+
+    # ------------------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "cpus": self.cpus, "memory": self.memory,
+            "disk_size": self.disk_size, "tpus": self.tpus,
+            "gpus": self.gpus, "gpu_type": self.gpu_type,
+            "image": self.image.to_dict(),
+            "env": self.env,
+            "volumes": [v.to_dict() for v in self.volumes],
+            "node_selector": self.node_selector,
+            "tolerations": self.tolerations,
+            "priority_class": self.priority_class,
+            "queue_name": self.queue_name,
+            "inactivity_ttl": self.inactivity_ttl,
+            "launch_timeout": self.launch_timeout,
+            "replicas": self.replicas,
+            "namespace": self.namespace,
+            "service_account": self.service_account,
+            "allowed_serialization": list(self.allowed_serialization),
+            "labels": self.labels, "annotations": self.annotations,
+            "freeze": self.freeze,
+            "distributed": (self.distributed.to_dict()
+                            if self.distributed else None),
+            "autoscaling": (self.autoscaling.to_dict()
+                            if self.autoscaling else None),
+        }
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Compute":
+        data = dict(data)
+        distributed = data.pop("distributed", None)
+        autoscaling = data.pop("autoscaling", None)
+        image = data.pop("image", None)
+        volumes = data.pop("volumes", None) or []
+        data.pop("secrets", None)
+        compute = cls(
+            image=Image.from_dict(image) if image else None,
+            volumes=[Volume.from_dict(v) for v in volumes],
+            allowed_serialization=tuple(
+                data.pop("allowed_serialization", ("json", "pickle"))),
+            **data)
+        if distributed:
+            compute.distributed = DistributedConfig.from_dict(distributed)
+        if autoscaling:
+            from kubetorch_tpu.provisioning.autoscaling import (
+                AutoscalingConfig,
+            )
+
+            compute.autoscaling = AutoscalingConfig(**autoscaling)
+        return compute
+
+    def __repr__(self) -> str:
+        parts = []
+        if self.tpus:
+            parts.append(f"tpus={self.tpus!r}")
+        if self.cpus:
+            parts.append(f"cpus={self.cpus!r}")
+        if self.memory:
+            parts.append(f"memory={self.memory!r}")
+        if self.distributed:
+            parts.append(f"distributed={self.distributed.type}×"
+                         f"{self.distributed.workers}")
+        return f"Compute({', '.join(parts)})"

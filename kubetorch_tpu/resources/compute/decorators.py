@@ -1,0 +1,106 @@
+"""Declarative deploy decorators (reference: resources/compute/decorators.py —
+``@kt.compute(...)`` / ``@kt.distribute(...)`` / ``@kt.autoscale(...)`` /
+``@kt.async_`` consumed by ``kt deploy file.py``).
+
+Server-side no-op rule kept from the reference: when the pod's
+``KT_CLS_OR_FN_NAME`` matches the decorated symbol, decorators return the raw
+callable so the deployed code doesn't recursively redeploy itself.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+from typing import Any, Callable, Optional
+
+from kubetorch_tpu.resources.compute.compute import Compute
+
+
+class PartialModule:
+    """A callable annotated with deploy intent; materialized by
+    ``module()`` (used by `ktpu deploy`) or by calling ``.to()`` directly."""
+
+    def __init__(self, wrapped: Callable):
+        self.wrapped = wrapped
+        self.compute_spec: Optional[Compute] = None
+        self.distribute_args: Optional[dict] = None
+        self.autoscale_args: Optional[dict] = None
+        self.is_async = False
+
+    def __call__(self, *args, **kwargs):
+        return self.wrapped(*args, **kwargs)
+
+    def module(self):
+        from kubetorch_tpu.resources.callables.cls import cls as cls_factory
+        from kubetorch_tpu.resources.callables.fn import fn as fn_factory
+
+        factory = (cls_factory if inspect.isclass(self.wrapped)
+                   else fn_factory)
+        module = factory(self.wrapped)
+        compute = self.compute_spec or Compute()
+        if self.distribute_args:
+            compute = compute.distribute(**self.distribute_args)
+        if self.autoscale_args:
+            compute = compute.autoscale(**self.autoscale_args)
+        return module, compute
+
+    def deploy(self):
+        module, compute_spec = self.module()
+        return module.to(compute_spec)
+
+
+def _server_side_noop(obj: Callable) -> bool:
+    target = os.environ.get("KT_CLS_OR_FN_NAME")
+    return bool(target) and getattr(obj, "__qualname__", "") == target
+
+
+def _as_partial(obj: Any) -> PartialModule:
+    return obj if isinstance(obj, PartialModule) else PartialModule(obj)
+
+
+def compute(**compute_kwargs) -> Callable:
+    """``@kt.compute(tpus="v5e-8", memory="16Gi")``"""
+
+    def wrap(obj):
+        if _server_side_noop(obj):
+            return obj
+        partial = _as_partial(obj)
+        partial.compute_spec = Compute(**compute_kwargs)
+        return partial
+
+    return wrap
+
+
+def distribute(type: str = "jax", workers: int = 1, **kwargs) -> Callable:
+    """``@kt.distribute("jax", workers=4)``"""
+
+    def wrap(obj):
+        if _server_side_noop(obj):
+            return obj
+        partial = _as_partial(obj)
+        partial.distribute_args = {"type": type, "workers": workers, **kwargs}
+        return partial
+
+    return wrap
+
+
+def autoscale(**kwargs) -> Callable:
+    """``@kt.autoscale(min_scale=0, max_scale=8, target=10)``"""
+
+    def wrap(obj):
+        if _server_side_noop(obj):
+            return obj
+        partial = _as_partial(obj)
+        partial.autoscale_args = kwargs
+        return partial
+
+    return wrap
+
+
+def async_(obj: Callable) -> Callable:
+    """Mark the deploy as async (reference: @kt.async_)."""
+    if _server_side_noop(obj):
+        return obj
+    partial = _as_partial(obj)
+    partial.is_async = True
+    return partial

@@ -1,0 +1,28 @@
+"""Custom routing endpoints (reference: resources/compute/endpoint.py:9).
+
+Two modes: a user-supplied URL (no Service is created; calls go straight to
+it), or a custom pod selector (route to a subset of pods, e.g. a coordinator).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class Endpoint:
+    url: Optional[str] = None
+    selector: Optional[Dict[str, str]] = None
+    port: int = 32300
+
+    def __post_init__(self):
+        if not self.url and not self.selector:
+            raise ValueError("Endpoint needs url or selector")
+
+    @property
+    def external(self) -> bool:
+        return self.url is not None
+
+    def service_selector(self) -> Optional[Dict[str, str]]:
+        return self.selector

@@ -1,0 +1,123 @@
+"""TPU slice/host/chip math and the GKE scheduling contract.
+
+No reference equivalent exists (the reference speaks nvidia.com/gpu counts;
+SURVEY.md §7 hard-part #2). This module owns:
+
+- parsing ``tpus="v5e-64"`` / ``"v5p-128"`` / ``"v6e-8"`` into generation,
+  chip count, host count, and per-host chip count;
+- the ICI topology string GKE wants (``cloud.google.com/gke-tpu-topology``);
+- node selectors + ``google.com/tpu`` resource limits for the pod template;
+- gang sizing: one pod per TPU VM host, all hosts of a slice are one gang.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+# generation -> (chips_per_host, gke accelerator name, 3D topology?)
+_GENERATIONS = {
+    "v4": (4, "tpu-v4-podslice", True),
+    "v5e": (4, "tpu-v5-lite-podslice", False),
+    "v5p": (4, "tpu-v5p-slice", True),
+    "v6e": (4, "tpu-v6e-slice", False),
+}
+
+# Valid 2D topologies for v5e/v6e (chips -> "XxY"), per GKE docs.
+_2D_TOPOLOGIES = {
+    1: "1x1", 4: "2x2", 8: "2x4", 16: "4x4", 32: "4x8",
+    64: "8x8", 128: "8x16", 256: "16x16",
+}
+
+
+def _3d_topology(chips: int) -> str:
+    """Smallest-surface 3D box of 4-chip (2x2x1) host bricks."""
+    if chips == 1:
+        return "1x1x1"
+    best: Optional[Tuple[int, ...]] = None
+    for x in (2, 4, 8, 16, 32):
+        for y in (2, 4, 8, 16, 32):
+            for z in (1, 2, 4, 8, 16, 32):
+                if x * y * z == chips and (best is None or
+                                           x * y + y * z + x * z < best[0]):
+                    best = (x * y + y * z + x * z, x, y, z)
+    if best is None:
+        raise ValueError(f"no valid 3D TPU topology for {chips} chips")
+    return f"{best[1]}x{best[2]}x{best[3]}"
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuSpec:
+    """A parsed TPU request: everything provisioning needs to place it."""
+
+    generation: str
+    chips: int
+    chips_per_host: int
+    gke_accelerator: str
+    topology: str
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, math.ceil(self.chips / self.chips_per_host))
+
+    @property
+    def multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def chips_per_pod(self) -> int:
+        """``google.com/tpu`` limit per pod (one pod per host)."""
+        return min(self.chips, self.chips_per_host)
+
+    def node_selectors(self) -> Dict[str, str]:
+        return {
+            "cloud.google.com/gke-tpu-accelerator": self.gke_accelerator,
+            "cloud.google.com/gke-tpu-topology": self.topology,
+        }
+
+    def resource_limits(self) -> Dict[str, str]:
+        return {"google.com/tpu": str(self.chips_per_pod)}
+
+    def worker_hostnames(self, service_name: str, namespace: str) -> List[str]:
+        """Stable per-host DNS names for TPU_WORKER_HOSTNAMES injection."""
+        return [
+            f"{service_name}-{i}.{service_name}-headless."
+            f"{namespace}.svc.cluster.local"
+            for i in range(self.num_hosts)
+        ]
+
+    def describe(self) -> str:
+        return (f"{self.generation}-{self.chips} "
+                f"({self.num_hosts} host(s) × {self.chips_per_pod} chips, "
+                f"topology {self.topology})")
+
+
+def parse_tpus(tpus: str) -> TpuSpec:
+    """Parse ``"v5e-8"``, ``"v5p-128"``, ``"v4-32"``, ``"v6e-4"``.
+
+    Also accepts Cloud-style aliases ``"v5litepod-8"`` and bare chip counts
+    with a generation prefix.
+    """
+    s = tpus.strip().lower().replace("v5litepod", "v5e").replace(
+        "v5pod", "v5p")
+    m = re.fullmatch(r"(v4|v5e|v5p|v6e)[-_](\d+)", s)
+    if not m:
+        raise ValueError(
+            f"cannot parse tpus={tpus!r}; expected e.g. 'v5e-8', 'v5p-128'")
+    gen, chips = m.group(1), int(m.group(2))
+    chips_per_host, accelerator, is_3d = _GENERATIONS[gen]
+    if chips < 1:
+        raise ValueError("chip count must be >= 1")
+    if is_3d:
+        topology = _3d_topology(chips)
+    else:
+        if chips not in _2D_TOPOLOGIES:
+            raise ValueError(
+                f"{gen} supports chip counts {sorted(_2D_TOPOLOGIES)}, "
+                f"got {chips}")
+        topology = _2D_TOPOLOGIES[chips]
+    return TpuSpec(
+        generation=gen, chips=chips, chips_per_host=chips_per_host,
+        gke_accelerator=accelerator, topology=topology)

@@ -1,0 +1,142 @@
+"""Layered image spec: base image + launch-time setup steps.
+
+Reference: ``resources/images/image.py:6`` — steps (pip_install / sync /
+env / bash / copy) serialize to a restricted Dockerfile dialect executed **at
+pod startup**, not docker build; ``from_dockerfile:108`` parses one back.
+This no-rebuild model is the core iteration-speed UX and is kept verbatim in
+spirit: steps run inside the pod after code sync.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import shlex
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+DEFAULT_BASE = "python:3.11-slim"
+
+
+@dataclasses.dataclass
+class ImageStep:
+    kind: str                  # pip_install | run_bash | set_env | sync | copy | cmd | entrypoint
+    value: Any
+
+    def to_dockerfile_line(self) -> str:
+        if self.kind == "pip_install":
+            pkgs = " ".join(shlex.quote(p) for p in self.value)
+            return f"RUN pip install {pkgs}"
+        if self.kind == "run_bash":
+            return f"RUN {self.value}"
+        if self.kind == "set_env":
+            key, val = self.value
+            return f"ENV {key}={shlex.quote(str(val))}"
+        if self.kind in ("sync", "copy"):
+            src, dest = self.value
+            return f"COPY {src} {dest}"
+        if self.kind == "cmd":
+            return f"CMD {self.value}"
+        if self.kind == "entrypoint":
+            return f"ENTRYPOINT {self.value}"
+        raise ValueError(f"unknown step kind {self.kind}")
+
+
+class Image:
+    """Fluent, serializable image spec.
+
+    Example::
+
+        kt.Image(image_id="python:3.11").pip_install(["jax[tpu]"]) \\
+            .set_env("JAX_PLATFORMS", "tpu").run_bash("echo ready")
+    """
+
+    def __init__(self, image_id: str = DEFAULT_BASE):
+        self.image_id = image_id
+        self.steps: List[ImageStep] = []
+
+    # ---- fluent builders ----------------------------------------------
+    def pip_install(self, packages: List[str]) -> "Image":
+        self.steps.append(ImageStep("pip_install", list(packages)))
+        return self
+
+    def run_bash(self, command: str) -> "Image":
+        self.steps.append(ImageStep("run_bash", command))
+        return self
+
+    def set_env(self, key: str, value: str) -> "Image":
+        self.steps.append(ImageStep("set_env", (key, value)))
+        return self
+
+    def sync_package(self, local_path: str, remote_path: str = "") -> "Image":
+        remote = remote_path or Path(local_path).name
+        self.steps.append(ImageStep("sync", (local_path, remote)))
+        return self
+
+    def copy(self, src: str, dest: str) -> "Image":
+        self.steps.append(ImageStep("copy", (src, dest)))
+        return self
+
+    # ---- serialization -------------------------------------------------
+    def to_dockerfile(self) -> str:
+        lines = [f"FROM {self.image_id}"]
+        lines += [s.to_dockerfile_line() for s in self.steps]
+        return "\n".join(lines) + "\n"
+
+    @classmethod
+    def from_dockerfile(cls, content_or_path: str) -> "Image":
+        """Parse the restricted dialect (FROM/RUN/ENV/COPY/CMD/ENTRYPOINT)."""
+        if "\n" not in content_or_path and Path(content_or_path).exists():
+            text = Path(content_or_path).read_text()
+        else:
+            text = content_or_path
+        image = cls()
+        for raw in text.splitlines():
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            op, _, rest = line.partition(" ")
+            op = op.upper()
+            rest = rest.strip()
+            if op == "FROM":
+                image.image_id = rest
+            elif op == "RUN":
+                if rest.startswith("pip install "):
+                    image.pip_install(shlex.split(rest[len("pip install "):]))
+                else:
+                    image.run_bash(rest)
+            elif op == "ENV":
+                key, _, val = rest.partition("=")
+                image.set_env(key.strip(), val.strip().strip('"\''))
+            elif op == "COPY":
+                parts = shlex.split(rest)
+                if len(parts) == 2:
+                    image.copy(parts[0], parts[1])
+            elif op == "CMD":
+                image.steps.append(ImageStep("cmd", rest))
+            elif op == "ENTRYPOINT":
+                image.steps.append(ImageStep("entrypoint", rest))
+            else:
+                raise ValueError(
+                    f"unsupported Dockerfile instruction {op!r} "
+                    f"(restricted dialect: FROM/RUN/ENV/COPY/CMD/ENTRYPOINT)")
+        return image
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "image_id": self.image_id,
+            "steps": [{"kind": s.kind, "value": s.value} for s in self.steps],
+        }
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Image":
+        image = cls(data.get("image_id", DEFAULT_BASE))
+        for step in data.get("steps", []):
+            value = step["value"]
+            image.steps.append(ImageStep(step["kind"],
+                                         tuple(value) if isinstance(value, list)
+                                         and step["kind"] in ("set_env", "sync", "copy")
+                                         else value))
+        return image
+
+    def __repr__(self) -> str:
+        return f"Image({self.image_id!r}, steps={len(self.steps)})"

@@ -1,0 +1,35 @@
+"""Prebuilt image factories (reference: resources/images/images.py)."""
+
+from kubetorch_tpu.resources.images.image import Image
+
+
+def python311() -> Image:
+    return Image("python:3.11-slim")
+
+
+def python312() -> Image:
+    return Image("python:3.12-slim")
+
+
+def debian() -> Image:
+    return Image("debian:bookworm-slim").run_bash(
+        "apt-get update && apt-get install -y python3 python3-pip rsync")
+
+
+def ubuntu() -> Image:
+    return Image("ubuntu:24.04").run_bash(
+        "apt-get update && apt-get install -y python3 python3-pip rsync")
+
+
+def jax_tpu() -> Image:
+    """JAX with libtpu — the default for tpus= workloads."""
+    return Image("python:3.11-slim").pip_install(
+        ["jax[tpu]", "-f", "https://storage.googleapis.com/jax-releases/libtpu_releases.html"])
+
+
+def pytorch() -> Image:
+    return Image("pytorch/pytorch:latest")
+
+
+def ray() -> Image:
+    return Image("rayproject/ray:latest")

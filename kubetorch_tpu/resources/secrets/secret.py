@@ -1,0 +1,124 @@
+"""Secrets: K8s Secret CRUD + provider shims.
+
+Reference: ``resources/secrets/`` (~1k LoC, 16 provider shims). Same shape
+here: a ``Secret`` holds key/value pairs or a provider name whose shim knows
+which env vars / files to harvest locally (HF, GCP, AWS, W&B, ...). Local
+backend stores under ``~/.ktpu/secrets`` (0600); k8s backend renders a Secret
+manifest and mounts env vars into the pod template.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import os
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+_LOCAL_ROOT = Path("~/.ktpu/secrets").expanduser()
+
+# provider -> (env vars, credential files)
+PROVIDER_SHIMS: Dict[str, Dict[str, List[str]]] = {
+    "huggingface": {"env": ["HF_TOKEN", "HUGGING_FACE_HUB_TOKEN"],
+                    "files": ["~/.huggingface/token",
+                              "~/.cache/huggingface/token"]},
+    "gcp": {"env": ["GOOGLE_APPLICATION_CREDENTIALS"],
+            "files": ["~/.config/gcloud/application_default_credentials.json"]},
+    "aws": {"env": ["AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY",
+                    "AWS_SESSION_TOKEN"],
+            "files": ["~/.aws/credentials"]},
+    "wandb": {"env": ["WANDB_API_KEY"], "files": ["~/.netrc"]},
+    "openai": {"env": ["OPENAI_API_KEY"], "files": []},
+    "anthropic": {"env": ["ANTHROPIC_API_KEY"], "files": []},
+    "github": {"env": ["GITHUB_TOKEN", "GH_TOKEN"], "files": []},
+    "docker": {"env": [], "files": ["~/.docker/config.json"]},
+    "kubernetes": {"env": ["KUBECONFIG"], "files": ["~/.kube/config"]},
+}
+
+
+@dataclasses.dataclass
+class Secret:
+    name: str
+    values: Dict[str, str] = dataclasses.field(default_factory=dict)
+    provider: Optional[str] = None
+    env_vars: Optional[Dict[str, str]] = None  # secret key -> env var in pod
+
+    @classmethod
+    def from_provider(cls, provider: str,
+                      name: Optional[str] = None) -> "Secret":
+        """Harvest local credentials for a known provider."""
+        shim = PROVIDER_SHIMS.get(provider)
+        if shim is None:
+            raise ValueError(
+                f"unknown provider {provider!r}; options: "
+                f"{sorted(PROVIDER_SHIMS)}")
+        values: Dict[str, str] = {}
+        for env in shim["env"]:
+            if os.environ.get(env):
+                values[env] = os.environ[env]
+        for file in shim["files"]:
+            path = Path(file).expanduser()
+            if path.exists():
+                values[f"file:{path.name}"] = path.read_text()
+        if not values:
+            raise ValueError(
+                f"no local credentials found for provider {provider!r}")
+        return cls(name=name or f"{provider}-secret", values=values,
+                   provider=provider)
+
+    # ---- k8s -----------------------------------------------------------
+    def to_manifest(self, namespace: str = "default") -> Dict[str, Any]:
+        return {
+            "apiVersion": "v1",
+            "kind": "Secret",
+            "metadata": {"name": self.name, "namespace": namespace,
+                         "labels": {"kubetorch.com/managed": "true"}},
+            "type": "Opaque",
+            "data": {k: base64.b64encode(v.encode()).decode()
+                     for k, v in self.values.items()
+                     if not k.startswith("file:")},
+        }
+
+    def pod_env(self) -> List[Dict[str, Any]]:
+        """envFrom-style injection for the pod template."""
+        entries = []
+        for key in self.values:
+            if key.startswith("file:"):
+                continue
+            env_name = (self.env_vars or {}).get(key, key)
+            entries.append({
+                "name": env_name,
+                "valueFrom": {"secretKeyRef": {"name": self.name, "key": key}},
+            })
+        return entries
+
+    # ---- local ---------------------------------------------------------
+    def save_local(self) -> Path:
+        _LOCAL_ROOT.mkdir(parents=True, exist_ok=True)
+        path = _LOCAL_ROOT / f"{self.name}.json"
+        path.write_text(json.dumps(self.values))
+        path.chmod(0o600)
+        return path
+
+    @classmethod
+    def load_local(cls, name: str) -> "Secret":
+        path = _LOCAL_ROOT / f"{name}.json"
+        if not path.exists():
+            raise FileNotFoundError(f"no local secret {name!r}")
+        return cls(name=name, values=json.loads(path.read_text()))
+
+    @classmethod
+    def list_local(cls) -> List[str]:
+        if not _LOCAL_ROOT.exists():
+            return []
+        return sorted(p.stem for p in _LOCAL_ROOT.glob("*.json"))
+
+    def delete_local(self):
+        path = _LOCAL_ROOT / f"{self.name}.json"
+        if path.exists():
+            path.unlink()
+
+    def local_env(self) -> Dict[str, str]:
+        return {(self.env_vars or {}).get(k, k): v
+                for k, v in self.values.items() if not k.startswith("file:")}

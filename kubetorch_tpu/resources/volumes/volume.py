@@ -1,0 +1,67 @@
+"""Persistent volumes (PVC-backed on k8s, directory-backed locally).
+
+Reference: ``resources/volumes/volume.py:17`` — PVC create/reuse with access
+modes and a mount path; the TPU build keeps the same API and adds a local
+backend (a shared directory under ``~/.ktpu/volumes``) so tests and laptop
+runs exercise the same code path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+_LOCAL_ROOT = Path("~/.ktpu/volumes").expanduser()
+
+
+@dataclasses.dataclass
+class Volume:
+    name: str
+    size: str = "10Gi"
+    mount_path: Optional[str] = None
+    access_modes: tuple = ("ReadWriteOnce",)
+    storage_class: Optional[str] = None
+
+    def __post_init__(self):
+        if self.mount_path is None:
+            self.mount_path = f"/ktfs/{self.name}"
+
+    # ---- k8s manifest --------------------------------------------------
+    def to_pvc_manifest(self, namespace: str = "default") -> Dict[str, Any]:
+        spec: Dict[str, Any] = {
+            "accessModes": list(self.access_modes),
+            "resources": {"requests": {"storage": self.size}},
+        }
+        if self.storage_class:
+            spec["storageClassName"] = self.storage_class
+        return {
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": {"name": self.name, "namespace": namespace,
+                         "labels": {"kubetorch.com/managed": "true"}},
+            "spec": spec,
+        }
+
+    def pod_volume(self) -> Dict[str, Any]:
+        return {"name": self.name,
+                "persistentVolumeClaim": {"claimName": self.name}}
+
+    def pod_mount(self) -> Dict[str, Any]:
+        return {"name": self.name, "mountPath": self.mount_path}
+
+    # ---- local backend -------------------------------------------------
+    def local_path(self) -> Path:
+        path = _LOCAL_ROOT / self.name
+        path.mkdir(parents=True, exist_ok=True)
+        return path
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Volume":
+        data = dict(data)
+        if isinstance(data.get("access_modes"), list):
+            data["access_modes"] = tuple(data["access_modes"])
+        return cls(**data)

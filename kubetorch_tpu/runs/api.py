@@ -1,0 +1,114 @@
+"""Batch-run evidence API: run IDs, notes, artifacts.
+
+Reference: ``python_client/kubetorch/runs.py`` (``generate_run_id:48``,
+``sanitize_env:30``) — every ``kt run`` gets a durable record (intent,
+command, env snapshot, logs, notes, artifacts) addressable as
+``runs/{id}/...`` in the data store.
+"""
+
+from __future__ import annotations
+
+import getpass
+import json
+import os
+import re
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from kubetorch_tpu.data_store import commands as store
+
+RUN_ID_ENV = "KT_RUN_ID"
+
+# Env vars that must never be captured into run records.
+_SECRET_PATTERNS = re.compile(
+    r"(TOKEN|SECRET|PASSWORD|PASSWD|CREDENTIAL|API_?KEY|PRIVATE|AUTH)",
+    re.IGNORECASE)
+
+
+def generate_run_id(prefix: str = "run") -> str:
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    return f"{prefix}-{stamp}-{uuid.uuid4().hex[:6]}"
+
+
+def sanitize_env(env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Capture env for the run record, redacting secret-looking vars
+    (reference: runs.py:30)."""
+    env = dict(env if env is not None else os.environ)
+    return {
+        key: ("<redacted>" if _SECRET_PATTERNS.search(key) else value)
+        for key, value in env.items()
+    }
+
+
+def run_id() -> Optional[str]:
+    """The current run's ID when executing inside ``kt run``."""
+    return os.environ.get(RUN_ID_ENV)
+
+
+def _require_run() -> str:
+    rid = run_id()
+    if not rid:
+        raise RuntimeError(
+            "not inside a run (kt run ...); note()/artifact() need one")
+    return rid
+
+
+def note(text: str, **fields: Any) -> str:
+    """Append a note to the current run's evidence."""
+    rid = _require_run()
+    entry = {"ts": time.time(), "text": text, **fields}
+    key = f"runs/{rid}/notes/{int(time.time() * 1000)}.json"
+    store.put(key, entry)
+    return key
+
+
+def artifact(src: str, name: Optional[str] = None) -> str:
+    """Store a file/directory as a run artifact; returns its ``kt://`` ref."""
+    rid = _require_run()
+    name = name or os.path.basename(str(src).rstrip("/"))
+    key = f"runs/{rid}/artifacts/{name}"
+    store.put(key, src)
+    return f"kt://{key}"
+
+
+def record_run(
+    run_id_: str,
+    command: str,
+    workdir_key: Optional[str] = None,
+    env: Optional[Dict[str, str]] = None,
+) -> str:
+    """Write the initial run record (used by `ktpu run`)."""
+    record = {
+        "id": run_id_,
+        "command": command,
+        "workdir_key": workdir_key,
+        "env": sanitize_env(env),
+        "user": os.environ.get("USER") or getpass.getuser(),
+        "status": "created",
+        "created_at": time.time(),
+    }
+    store.put(f"runs/{run_id_}/record.json", record)
+    return run_id_
+
+
+def update_run_status(run_id_: str, status: str, **fields: Any):
+    key = f"runs/{run_id_}/record.json"
+    record = store.get(key)
+    record.update({"status": status, "updated_at": time.time(), **fields})
+    store.put(key, record)
+
+
+def get_run(run_id_: str) -> Dict[str, Any]:
+    return store.get(f"runs/{run_id_}/record.json")
+
+
+def list_runs() -> list:
+    out = []
+    for entry in store.ls("runs"):
+        if entry["key"].endswith("/record.json"):
+            try:
+                out.append(store.get(entry["key"]))
+            except Exception:
+                continue
+    return sorted(out, key=lambda r: r.get("created_at", 0), reverse=True)

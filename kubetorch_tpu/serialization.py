@@ -1,0 +1,89 @@
+"""Wire serialization with an allowlist, mirroring the reference's
+header-based scheme (reference: ``serving/http_client.py:1041`` sends
+``X-Serialization: json|pickle``; ``Compute(allowed_serialization=...)`` gates
+what the server accepts).
+
+``json`` is the default (safe, inspectable); ``pickle`` (cloudpickle) carries
+arbitrary Python objects — including jax/numpy arrays — and must be explicitly
+allowed on the serving side.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable, Optional, Tuple
+
+import cloudpickle
+
+HEADER = "X-Serialization"
+DEFAULT = "json"
+METHODS = ("json", "pickle")
+
+
+class SerializationError(TypeError):
+    pass
+
+
+def _json_default(obj):
+    # numpy / jax scalars and arrays degrade to lists — useful for results;
+    # round-tripping exact types requires pickle.
+    try:
+        import numpy as np
+
+        if isinstance(obj, np.ndarray):
+            return obj.tolist()
+        if isinstance(obj, np.generic):
+            return obj.item()
+    except ImportError:
+        pass
+    if hasattr(obj, "tolist"):  # jax.Array without importing jax here
+        return obj.tolist()
+    if hasattr(obj, "item") and not isinstance(obj, (dict, list)):
+        try:
+            return obj.item()
+        except Exception:
+            pass
+    raise SerializationError(
+        f"{type(obj).__name__} is not JSON-serializable; call with "
+        f"serialization='pickle' (and allow it on the Compute)")
+
+
+def dumps(obj: Any, method: str = DEFAULT) -> bytes:
+    if method == "json":
+        return json.dumps(obj, default=_json_default).encode()
+    if method == "pickle":
+        return cloudpickle.dumps(obj)
+    raise SerializationError(f"unknown serialization method {method!r}")
+
+
+def loads(data: bytes, method: str = DEFAULT) -> Any:
+    if method == "json":
+        return json.loads(data.decode()) if data else None
+    if method == "pickle":
+        return cloudpickle.loads(data)
+    raise SerializationError(f"unknown serialization method {method!r}")
+
+
+def choose(
+    obj: Any, preferred: str, allowed: Iterable[str]
+) -> Tuple[bytes, str]:
+    """Serialize with ``preferred``, falling back json→pickle when the payload
+    isn't JSON-able and pickle is allowed. Returns (body, method_used)."""
+    allowed = tuple(allowed)
+    if preferred not in allowed:
+        raise SerializationError(
+            f"serialization {preferred!r} not in allowed {allowed}")
+    try:
+        return dumps(obj, preferred), preferred
+    except SerializationError:
+        if preferred == "json" and "pickle" in allowed:
+            return dumps(obj, "pickle"), "pickle"
+        raise
+
+
+def check_allowed(method: Optional[str], allowed: Iterable[str]) -> str:
+    method = method or DEFAULT
+    if method not in tuple(allowed):
+        raise SerializationError(
+            f"server does not allow serialization {method!r}")
+    return method

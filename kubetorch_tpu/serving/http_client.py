@@ -1,0 +1,129 @@
+"""Client of the pod server: typed calls with remote-exception rehydration.
+
+Reference: ``serving/http_client.py:1041 call_method`` (+ async variant
+``:1070``), header-based serialization, request IDs, and rehydration of remote
+errors into real exception classes (``CustomResponse.raise_for_status:88``).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Iterable, Optional, Tuple
+
+import httpx
+
+from kubetorch_tpu import serialization
+from kubetorch_tpu.exceptions import rehydrate_exception
+
+_TIMEOUT = httpx.Timeout(connect=10.0, read=None, write=60.0, pool=10.0)
+
+_sync_client: Optional[httpx.Client] = None
+_async_client: Optional[httpx.AsyncClient] = None
+
+
+def sync_client() -> httpx.Client:
+    """Shared pooled client (reference: serving/global_http_clients.py)."""
+    global _sync_client
+    if _sync_client is None or _sync_client.is_closed:
+        _sync_client = httpx.Client(timeout=_TIMEOUT)
+    return _sync_client
+
+
+def async_client() -> httpx.AsyncClient:
+    global _async_client
+    if _async_client is None or _async_client.is_closed:
+        _async_client = httpx.AsyncClient(timeout=_TIMEOUT)
+    return _async_client
+
+
+def _prepare(
+    args: tuple, kwargs: dict, ser: str, allowed: Iterable[str]
+) -> Tuple[bytes, dict]:
+    from kubetorch_tpu.resources.callables.pointers import build_call_body
+
+    body, used = serialization.choose(
+        build_call_body(args, kwargs), ser, allowed)
+    headers = {
+        serialization.HEADER: used,
+        "X-Request-ID": uuid.uuid4().hex[:12],
+        "Content-Type": ("application/json" if used == "json"
+                         else "application/octet-stream"),
+    }
+    return body, headers
+
+
+def _handle(resp: httpx.Response) -> Any:
+    if resp.status_code >= 400:
+        try:
+            payload = resp.json()
+        except Exception:
+            resp.raise_for_status()
+            raise RuntimeError(resp.text)
+        if "error" in payload:
+            raise rehydrate_exception(payload)
+        resp.raise_for_status()
+    used = resp.headers.get(serialization.HEADER, "json")
+    data = serialization.loads(resp.content, used)
+    if isinstance(data, dict) and "result" in data:
+        return data["result"]
+    return data
+
+
+def call_method(
+    base_url: str,
+    callable_name: str,
+    method: Optional[str] = None,
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+    ser: str = serialization.DEFAULT,
+    allowed: Iterable[str] = serialization.METHODS,
+    timeout: Optional[float] = None,
+    query: Optional[dict] = None,
+) -> Any:
+    """POST /{callable}[/{method}] and return the deserialized result
+    (or raise the rehydrated remote exception)."""
+    body, headers = _prepare(args, kwargs or {}, ser, allowed)
+    url = f"{base_url.rstrip('/')}/{callable_name}"
+    if method:
+        url += f"/{method}"
+    resp = sync_client().post(
+        url, content=body, headers=headers, params=query or {},
+        timeout=timeout if timeout is not None else _TIMEOUT)
+    return _handle(resp)
+
+
+async def call_method_async(
+    base_url: str,
+    callable_name: str,
+    method: Optional[str] = None,
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+    ser: str = serialization.DEFAULT,
+    allowed: Iterable[str] = serialization.METHODS,
+    timeout: Optional[float] = None,
+    query: Optional[dict] = None,
+) -> Any:
+    body, headers = _prepare(args, kwargs or {}, ser, allowed)
+    url = f"{base_url.rstrip('/')}/{callable_name}"
+    if method:
+        url += f"/{method}"
+    resp = await async_client().post(
+        url, content=body, headers=headers, params=query or {},
+        timeout=timeout if timeout is not None else _TIMEOUT)
+    return _handle(resp)
+
+
+def get_json(base_url: str, path: str, timeout: float = 10.0) -> Any:
+    resp = sync_client().get(
+        f"{base_url.rstrip('/')}{path}", timeout=timeout)
+    return resp.status_code, (resp.json() if resp.content else None)
+
+
+def is_ready(base_url: str, launch_id: str = "", timeout: float = 5.0) -> bool:
+    try:
+        params = {"launch_id": launch_id} if launch_id else {}
+        resp = sync_client().get(
+            f"{base_url.rstrip('/')}/ready", params=params, timeout=timeout)
+        return resp.status_code == 200 and resp.json().get("ready", False)
+    except (httpx.HTTPError, ValueError):
+        return False

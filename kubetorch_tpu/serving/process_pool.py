@@ -1,0 +1,161 @@
+"""Pool of ProcessWorkers (one per local rank) with a response router.
+
+Reference: ``serving/process_pool.py:12,125,178`` — spawn/stop N workers,
+route responses back to per-request futures, ``call_all`` fans one request to
+every local rank with rank-specific env.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import uuid
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from kubetorch_tpu.exceptions import StartupError
+from kubetorch_tpu.serving.process_worker import (
+    SETUP,
+    ProcessWorker,
+)
+
+
+class ProcessPool:
+    def __init__(self, num_procs: int = 1,
+                 base_env: Optional[Dict[str, str]] = None):
+        self.num_procs = num_procs
+        self.base_env = dict(base_env or {})
+        self.workers: List[ProcessWorker] = []
+        self._futures: Dict[str, Future] = {}
+        self._futures_lock = threading.Lock()
+        self._routers: List[threading.Thread] = []
+        self._round_robin = itertools.count()
+        self._started = False
+
+    # ------------------------------------------------------------------
+    def start(self, per_rank_env: Optional[List[Dict[str, str]]] = None):
+        per_rank_env = per_rank_env or [{} for _ in range(self.num_procs)]
+        for local_rank in range(self.num_procs):
+            env = {**self.base_env, **per_rank_env[local_rank]}
+            worker = ProcessWorker(local_rank, env)
+            worker.start()
+            self.workers.append(worker)
+            router = threading.Thread(
+                target=self._route, args=(worker,), daemon=True,
+                name=f"kt-router-{local_rank}")
+            router.start()
+            self._routers.append(router)
+        self._started = True
+
+    def _route(self, worker: ProcessWorker):
+        while True:
+            try:
+                resp = worker.response_q.get()
+            except (EOFError, OSError):
+                break
+            if resp is None:
+                break
+            with self._futures_lock:
+                fut = self._futures.pop(resp.get("req_id"), None)
+            if fut is not None and not fut.done():
+                fut.set_result(resp)
+
+    def _submit(self, worker: ProcessWorker, req: dict) -> Future:
+        fut: Future = Future()
+        with self._futures_lock:
+            self._futures[req["req_id"]] = fut
+        worker.send(req)
+        return fut
+
+    # ------------------------------------------------------------------
+    def setup_all(
+        self,
+        *,
+        root_path: str,
+        import_path: str,
+        name: str,
+        callable_type: str = "fn",
+        init_args: Optional[dict] = None,
+        env_per_rank: Optional[List[Dict[str, str]]] = None,
+        timeout: float = 300.0,
+    ):
+        """Load (or reload) the callable in every worker."""
+        futures = []
+        for i, worker in enumerate(self.workers):
+            req = {
+                "kind": SETUP, "req_id": f"{SETUP}-{uuid.uuid4().hex}",
+                "root_path": root_path, "import_path": import_path,
+                "name": name, "callable_type": callable_type,
+                "init_args": init_args,
+                "env": (env_per_rank or [{}] * len(self.workers))[i],
+            }
+            futures.append(self._submit(worker, req))
+        for fut in futures:
+            resp = fut.result(timeout)
+            if not resp["ok"]:
+                raise StartupError(
+                    f"callable setup failed: {resp['error']['type']}: "
+                    f"{resp['error']['message']}\n{resp['error']['traceback']}")
+
+    def call(
+        self,
+        body: bytes,
+        serialization_method: str,
+        method: Optional[str] = None,
+        allowed: Optional[tuple] = None,
+        local_rank: Optional[int] = None,
+        timeout: Optional[float] = None,
+    ) -> dict:
+        """Send one call to one worker (round-robin by default)."""
+        if local_rank is None:
+            local_rank = next(self._round_robin) % len(self.workers)
+        worker = self.workers[local_rank]
+        req = {
+            "kind": "call", "req_id": uuid.uuid4().hex, "method": method,
+            "body": body, "serialization": serialization_method,
+            "allowed": list(allowed or ("json", "pickle")),
+        }
+        return self._submit(worker, req).result(timeout)
+
+    def call_all(
+        self,
+        body: bytes,
+        serialization_method: str,
+        method: Optional[str] = None,
+        allowed: Optional[tuple] = None,
+        timeout: Optional[float] = None,
+    ) -> List[dict]:
+        """Fan one request to every local rank; returns per-rank responses."""
+        futures = []
+        for worker in self.workers:
+            req = {
+                "kind": "call", "req_id": uuid.uuid4().hex, "method": method,
+                "body": body, "serialization": serialization_method,
+                "allowed": list(allowed or ("json", "pickle")),
+            }
+            futures.append(self._submit(worker, req))
+        return [f.result(timeout) for f in futures]
+
+    # ------------------------------------------------------------------
+    def stop(self):
+        for worker in self.workers:
+            try:
+                worker.stop()
+            except Exception:
+                pass
+        self.workers = []
+        self._started = False
+
+    def restart(self, per_rank_env: Optional[List[Dict[str, str]]] = None):
+        """Recreate all worker subprocesses (reference: restart_procs=True,
+        spmd_supervisor.py:267)."""
+        self.stop()
+        self._futures.clear()
+        self.start(per_rank_env)
+
+    @property
+    def healthy(self) -> bool:
+        return self._started and all(w.alive for w in self.workers)
+
+    def any_worker_dead(self) -> bool:
+        return self._started and any(not w.alive for w in self.workers)

@@ -1,0 +1,177 @@
+"""Subprocess isolation for user callables.
+
+Reference design: ``serving/process_worker.py:16,109,218`` — a
+multiprocessing.Process per local rank with its own request/response queues;
+async callables are awaited on a persistent event loop, sync callables are
+offloaded to a thread executor; distributed env vars
+(RANK/WORLD_SIZE/LOCAL_RANK/NODE_RANK/POD_IPS, ``:75``) are set *before* user
+imports run so jax/torch bootstrap sees them.
+
+TPU-critical detail: workers use the ``spawn`` start method — a forked child
+inheriting an initialized libtpu/XLA client is undefined behavior, and the
+pod server itself must never import jax (the chips belong to the workers).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import importlib
+import inspect
+import multiprocessing as mp
+import os
+import sys
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+from kubetorch_tpu import serialization
+from kubetorch_tpu.exceptions import package_exception
+
+_CTX = mp.get_context("spawn")
+
+# Sentinel request kinds
+SETUP = "__setup__"
+SHUTDOWN = "__shutdown__"
+
+
+def get_distributed_env_vars(
+    rank: int, world_size: int, local_rank: int, node_rank: int,
+    pod_ips: Optional[list] = None,
+) -> Dict[str, str]:
+    """Base env contract every worker gets (reference: process_worker.py:75)."""
+    env = {
+        "RANK": str(rank),
+        "WORLD_SIZE": str(world_size),
+        "LOCAL_RANK": str(local_rank),
+        "NODE_RANK": str(node_rank),
+    }
+    if pod_ips:
+        env["POD_IPS"] = ",".join(pod_ips)
+    return env
+
+
+def _load_target(root_path: str, import_path: str, name: str,
+                 callable_type: str, init_args: Optional[dict]):
+    """Import the user symbol from synced source inside the worker process."""
+    if root_path and root_path not in sys.path:
+        sys.path.insert(0, root_path)
+    module = importlib.import_module(import_path)
+    module = importlib.reload(module)  # pick up re-synced code on re-setup
+    obj = module
+    for part in name.split("."):
+        obj = getattr(obj, part)
+    if callable_type == "cls":
+        init_args = init_args or {}
+        return obj(*init_args.get("args", []), **init_args.get("kwargs", {}))
+    return obj
+
+
+class _WorkerLoop:
+    """Runs inside the spawned process."""
+
+    def __init__(self, request_q, response_q):
+        self.request_q = request_q
+        self.response_q = response_q
+        self.target = None
+        self.callable_type = "fn"
+        self.executor = ThreadPoolExecutor(
+            max_workers=int(os.environ.get("KT_WORKER_THREADS", "8")))
+
+    def _resolve_method(self, method_name: Optional[str]):
+        if self.callable_type == "cls" and method_name:
+            return getattr(self.target, method_name)
+        if callable(self.target):
+            return self.target
+        raise AttributeError(
+            f"no callable method {method_name!r} on target")
+
+    async def _execute(self, req: dict) -> dict:
+        req_id = req["req_id"]
+        try:
+            if req["kind"] == SETUP:
+                for key, value in (req.get("env") or {}).items():
+                    os.environ[key] = str(value)
+                self.callable_type = req.get("callable_type", "fn")
+                self.target = _load_target(
+                    req.get("root_path", ""), req["import_path"],
+                    req["name"], self.callable_type, req.get("init_args"))
+                return {"req_id": req_id, "ok": True, "payload": None}
+
+            body = serialization.loads(req["body"], req["serialization"])
+            args = body.get("args", [])
+            kwargs = body.get("kwargs", {})
+            fn = self._resolve_method(req.get("method"))
+            if inspect.iscoroutinefunction(fn):
+                result = await fn(*args, **kwargs)
+            else:
+                result = await asyncio.get_running_loop().run_in_executor(
+                    self.executor, lambda: fn(*args, **kwargs))
+            payload, used = serialization.choose(
+                {"result": result}, req["serialization"],
+                req.get("allowed", serialization.METHODS))
+            return {"req_id": req_id, "ok": True, "payload": payload,
+                    "serialization": used}
+        except BaseException as exc:  # noqa: BLE001 — must package everything
+            if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                raise
+            return {"req_id": req_id, "ok": False,
+                    "error": package_exception(exc)["error"]}
+
+    async def run(self):
+        loop = asyncio.get_running_loop()
+        while True:
+            req = await loop.run_in_executor(None, self.request_q.get)
+            if req is None or req.get("kind") == SHUTDOWN:
+                break
+            # Execute concurrently so async user code overlaps.
+            task = asyncio.ensure_future(self._execute(req))
+            task.add_done_callback(
+                lambda t: self.response_q.put(
+                    t.result() if not t.cancelled() else None))
+
+
+def worker_main(request_q, response_q, env: Dict[str, str]):
+    """Entrypoint of the spawned process."""
+    for key, value in env.items():
+        os.environ[key] = str(value)
+    try:
+        asyncio.run(_WorkerLoop(request_q, response_q).run())
+    except KeyboardInterrupt:
+        pass
+
+
+class ProcessWorker:
+    """Parent-side handle for one worker subprocess (one local rank)."""
+
+    def __init__(self, local_rank: int, env: Optional[Dict[str, str]] = None):
+        self.local_rank = local_rank
+        self.request_q = _CTX.Queue()
+        self.response_q = _CTX.Queue()
+        self.env = dict(env or {})
+        self.process = _CTX.Process(
+            target=worker_main,
+            args=(self.request_q, self.response_q, self.env),
+            daemon=True,
+            name=f"kt-worker-{local_rank}",
+        )
+
+    def start(self):
+        self.process.start()
+
+    def send(self, req: dict):
+        self.request_q.put(req)
+
+    def stop(self, timeout: float = 5.0):
+        try:
+            self.request_q.put({"kind": SHUTDOWN, "req_id": SHUTDOWN})
+            self.process.join(timeout)
+        finally:
+            if self.process.is_alive():
+                self.process.terminate()
+                self.process.join(2.0)
+            if self.process.is_alive():
+                self.process.kill()
+
+    @property
+    def alive(self) -> bool:
+        return self.process.is_alive()

@@ -1,0 +1,338 @@
+"""In-pod HTTP server: the workload-side runtime.
+
+aiohttp analogue of the reference's FastAPI pod server
+(``serving/http_server.py``): loads the user callable behind a supervisor,
+serves ``POST /{name}[/{method}]``, health/readiness, metrics, reload, and an
+``/http`` reverse proxy for App workloads. Middleware spine: request-ID
+propagation (``:1237``), request metrics (``:1425``), termination check
+(``:1184`` — SIGTERM'd pods answer with a typed PodTerminatedError).
+
+Metadata arrives via env (KT_*) at start and via ``POST /_reload`` afterwards
+(the controller's push-reload; reference does this over a pod WebSocket,
+``serving/http_server.py:352 _handle_reload`` — we keep an HTTP route so pods
+stay stateless; the controller WS client lives in ``controller_ws.py``).
+
+This module must not import jax/torch: accelerator state belongs to the
+worker subprocesses (see process_worker.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import json
+import os
+import signal
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from aiohttp import ClientSession, web
+
+from kubetorch_tpu import serialization
+from kubetorch_tpu.exceptions import (
+    PodTerminatedError,
+    package_exception,
+)
+from kubetorch_tpu.serving.supervisor import supervisor_factory
+from kubetorch_tpu.version import __version__
+
+request_id_var: contextvars.ContextVar = contextvars.ContextVar(
+    "kt_request_id", default="-")
+
+_RESERVED = {"health", "ready", "metrics", "app", "http", "_reload",
+             "_teardown", "_gpu", "_debug", "_profile"}
+
+
+def metadata_from_env() -> Dict[str, Any]:
+    """Module metadata contract (mirrors reference env application at
+    ``http_server.py:254 _apply_metadata``)."""
+    meta: Dict[str, Any] = {
+        "service_name": os.environ.get("KT_SERVICE_NAME", "unknown"),
+        "callable_name": os.environ.get("KT_CLS_OR_FN_NAME", ""),
+        "callable_type": os.environ.get("KT_CALLABLE_TYPE", "fn"),
+        "root_path": os.environ.get("KT_ROOT_PATH", ""),
+        "import_path": os.environ.get("KT_IMPORT_PATH", ""),
+        "name": os.environ.get("KT_CALLABLE_NAME", ""),
+        "num_procs": int(os.environ.get("KT_NUM_PROCS", "1")),
+        "framework": os.environ.get("KT_FRAMEWORK") or None,
+        "replica_index": int(os.environ.get("KT_REPLICA_INDEX", "0")),
+    }
+    if os.environ.get("KT_INIT_ARGS"):
+        meta["init_args"] = json.loads(os.environ["KT_INIT_ARGS"])
+    if os.environ.get("KT_DISTRIBUTED"):
+        meta["distributed"] = json.loads(os.environ["KT_DISTRIBUTED"])
+    if os.environ.get("KT_ALLOWED_SERIALIZATION"):
+        meta["allowed_serialization"] = tuple(
+            os.environ["KT_ALLOWED_SERIALIZATION"].split(","))
+    if os.environ.get("KT_APP_CMD"):
+        meta["app_cmd"] = os.environ["KT_APP_CMD"]
+        meta["app_port"] = int(os.environ.get("KT_APP_PORT", "0") or 0)
+        meta["app_health_path"] = os.environ.get("KT_APP_HEALTH_PATH", "")
+    return meta
+
+
+class PodServer:
+    def __init__(self, metadata: Optional[Dict[str, Any]] = None):
+        self.metadata = metadata or metadata_from_env()
+        self.supervisor = None
+        self.app_proc: Optional[asyncio.subprocess.Process] = None
+        self.terminating = False
+        self.launch_id = os.environ.get("KT_LAUNCH_ID", "")
+        self.started_at = time.time()
+        self.metrics: Dict[str, Any] = {
+            "http_requests_total": 0,
+            "http_request_errors_total": 0,
+            "http_request_duration_seconds_sum": 0.0,
+            "last_activity_timestamp": time.time(),
+        }
+        self.ready = False
+        self.setup_error: Optional[str] = None
+
+    # ------------------------------------------------------------- app
+    def build_app(self) -> web.Application:
+        app = web.Application(
+            middlewares=[self._mw_request_id, self._mw_termination,
+                         self._mw_metrics],
+            client_max_size=1024**3)
+        app.router.add_get("/health", self.h_health)
+        app.router.add_get("/ready", self.h_ready)
+        app.router.add_get("/metrics", self.h_metrics)
+        app.router.add_get("/app/status", self.h_app_status)
+        app.router.add_post("/_reload", self.h_reload)
+        app.router.add_post("/_teardown", self.h_teardown)
+        app.router.add_route("*", "/http/{tail:.*}", self.h_proxy)
+        app.router.add_post("/{callable}", self.h_call)
+        app.router.add_post("/{callable}/{method}", self.h_call)
+        app.on_startup.append(self._on_startup)
+        app.on_shutdown.append(self._on_shutdown)
+        return app
+
+    async def _on_startup(self, app):
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM,):
+            try:
+                loop.add_signal_handler(sig, self._mark_terminating)
+            except NotImplementedError:
+                pass
+        if self.metadata.get("callable_type") == "app":
+            await self._start_app_cmd()
+            self.ready = True
+            return
+        if self.metadata.get("import_path"):
+            # Setup in a thread: subprocess spawn + user imports are slow.
+            await loop.run_in_executor(None, self._setup_supervisor)
+        else:
+            self.ready = True  # bare pod waiting for controller metadata push
+
+    def _setup_supervisor(self):
+        try:
+            self.supervisor = supervisor_factory(self.metadata)
+            self.supervisor.setup()
+            self.ready = True
+            self.setup_error = None
+        except Exception as exc:  # surfaced via /ready
+            self.setup_error = f"{type(exc).__name__}: {exc}"
+            self.ready = False
+
+    async def _on_shutdown(self, app):
+        if self.supervisor is not None:
+            self.supervisor.cleanup()
+        if self.app_proc and self.app_proc.returncode is None:
+            self.app_proc.terminate()
+
+    def _mark_terminating(self):
+        self.terminating = True
+
+    async def _start_app_cmd(self):
+        cmd = self.metadata.get("app_cmd")
+        if not cmd:
+            return
+        self.app_proc = await asyncio.create_subprocess_shell(
+            cmd, cwd=self.metadata.get("root_path") or None)
+
+    # ----------------------------------------------------- middleware
+    @web.middleware
+    async def _mw_request_id(self, request: web.Request, handler):
+        rid = request.headers.get("X-Request-ID") or uuid.uuid4().hex[:12]
+        token = request_id_var.set(rid)
+        try:
+            resp = await handler(request)
+            resp.headers["X-Request-ID"] = rid
+            return resp
+        finally:
+            request_id_var.reset(token)
+
+    @web.middleware
+    async def _mw_termination(self, request: web.Request, handler):
+        if self.terminating and request.path not in ("/health", "/metrics"):
+            exc = PodTerminatedError("pod received SIGTERM")
+            return web.json_response(package_exception(exc), status=503)
+        return await handler(request)
+
+    @web.middleware
+    async def _mw_metrics(self, request: web.Request, handler):
+        start = time.perf_counter()
+        self.metrics["http_requests_total"] += 1
+        self.metrics["last_activity_timestamp"] = time.time()
+        try:
+            resp = await handler(request)
+            if resp.status >= 500:
+                self.metrics["http_request_errors_total"] += 1
+            return resp
+        except Exception:
+            self.metrics["http_request_errors_total"] += 1
+            raise
+        finally:
+            self.metrics["http_request_duration_seconds_sum"] += (
+                time.perf_counter() - start)
+
+    # ------------------------------------------------------- handlers
+    async def h_health(self, request):
+        return web.json_response({
+            "status": "ok", "version": __version__,
+            "service": self.metadata.get("service_name"),
+            "uptime_s": round(time.time() - self.started_at, 1),
+        })
+
+    async def h_ready(self, request):
+        launch_id = request.query.get("launch_id")
+        if launch_id and self.launch_id and launch_id != self.launch_id:
+            return web.json_response(
+                {"ready": False, "reason": "stale launch_id"}, status=409)
+        if self.setup_error:
+            return web.json_response(
+                {"ready": False, "reason": self.setup_error}, status=500)
+        if not self.ready:
+            return web.json_response(
+                {"ready": False, "reason": "setting up"}, status=503)
+        return web.json_response({"ready": True})
+
+    async def h_metrics(self, request):
+        healthy = (self.supervisor.healthy()
+                   if self.supervisor is not None else True)
+        return web.json_response({**self.metrics, "workers_healthy": healthy})
+
+    async def h_app_status(self, request):
+        if self.app_proc is None:
+            return web.json_response({"running": False, "reason": "no app"})
+        rc = self.app_proc.returncode
+        return web.json_response({"running": rc is None, "returncode": rc})
+
+    async def h_reload(self, request):
+        """Controller push-reload: new metadata (+ freshly synced code)."""
+        try:
+            new_meta = await request.json()
+        except Exception:
+            new_meta = {}
+        loop = asyncio.get_running_loop()
+
+        def do_reload():
+            self.metadata.update(new_meta or {})
+            if self.supervisor is None:
+                self._setup_supervisor()
+            else:
+                self.supervisor.reload(self.metadata)
+                self.ready = True
+
+        try:
+            await loop.run_in_executor(None, do_reload)
+        except Exception as exc:
+            self.setup_error = f"{type(exc).__name__}: {exc}"
+            return web.json_response(package_exception(exc), status=500)
+        return web.json_response({"reloaded": True, "ready": self.ready})
+
+    async def h_teardown(self, request):
+        asyncio.get_event_loop().call_later(0.2, os._exit, 0)
+        return web.json_response({"terminating": True})
+
+    async def h_proxy(self, request: web.Request):
+        """Reverse proxy to an App's own HTTP port (reference:
+        http_server.py:117 /http proxy)."""
+        port = self.metadata.get("app_port")
+        if not port:
+            return web.json_response(
+                {"error": {"type": "KubetorchError",
+                           "message": "no app_port configured"}}, status=404)
+        tail = request.match_info.get("tail", "")
+        url = f"http://127.0.0.1:{port}/{tail}"
+        if request.query_string:
+            url += f"?{request.query_string}"
+        body = await request.read()
+        async with ClientSession() as session:
+            async with session.request(
+                request.method, url, data=body,
+                headers={k: v for k, v in request.headers.items()
+                         if k.lower() not in ("host", "content-length")},
+            ) as upstream:
+                payload = await upstream.read()
+                return web.Response(
+                    body=payload, status=upstream.status,
+                    content_type=upstream.content_type)
+
+    async def h_call(self, request: web.Request):
+        name = request.match_info["callable"]
+        method = request.match_info.get("method")
+        if name in _RESERVED:
+            raise web.HTTPNotFound()
+        if self.supervisor is None or not self.ready:
+            exc = PodTerminatedError if self.terminating else None
+            msg = self.setup_error or "callable not loaded"
+            err = (exc or RuntimeError)(msg)
+            return web.json_response(package_exception(err), status=503)
+        expected = self.metadata.get("name") or self.metadata.get("callable_name")
+        if expected and name not in (expected, self.metadata.get("service_name")):
+            return web.json_response(package_exception(KeyError(
+                f"callable {name!r} not served here (serving {expected!r})")),
+                status=404)
+
+        ser = request.headers.get(serialization.HEADER, serialization.DEFAULT)
+        try:
+            ser = serialization.check_allowed(
+                ser, self.supervisor.allowed)
+        except Exception as exc:
+            return web.json_response(package_exception(exc), status=400)
+        body = await request.read()
+        distributed_subcall = (
+            request.query.get("distributed_subcall") == "true")
+        restart_procs = request.query.get("restart_procs") == "true"
+        workers = request.query.get("workers", "all")
+
+        loop = asyncio.get_running_loop()
+        try:
+            resp = await loop.run_in_executor(
+                None,
+                lambda: self.supervisor.call(
+                    body, ser, method=method,
+                    distributed_subcall=distributed_subcall,
+                    restart_procs=restart_procs, workers=workers))
+        except Exception as exc:
+            return web.json_response(package_exception(exc), status=500)
+        if resp is None:
+            return web.json_response(package_exception(
+                RuntimeError("worker returned no response")), status=500)
+        if not resp.get("ok"):
+            return web.json_response({"error": resp["error"]}, status=500)
+        used = resp.get("serialization", ser)
+        return web.Response(
+            body=resp["payload"],
+            content_type=("application/json" if used == "json"
+                          else "application/octet-stream"),
+            headers={serialization.HEADER: used})
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser(description="kubetorch_tpu pod server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int,
+                        default=int(os.environ.get("KT_SERVER_PORT", "32300")))
+    args = parser.parse_args()
+    server = PodServer()
+    web.run_app(server.build_app(), host=args.host, port=args.port,
+                print=None, access_log=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Execution supervisors: own the worker pool, load callables, route calls.
+
+Reference: ``serving/execution_supervisor.py:23,63,105`` (base: one-subprocess
+pool with setup/cleanup/call) and ``serving/supervisor_factory.py:16``
+(type → class map). The distributed SPMD supervisor lives in
+``spmd_supervisor.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from kubetorch_tpu import serialization
+from kubetorch_tpu.serving.frameworks import framework_class
+from kubetorch_tpu.serving.process_pool import ProcessPool
+
+
+class ExecutionSupervisor:
+    """Single-pod execution: one ProcessPool, no cross-pod anything."""
+
+    def __init__(self, metadata: Dict[str, Any]):
+        """``metadata`` carries pointers + runtime knobs:
+        root_path, import_path, name, callable_type, init_args, num_procs,
+        allowed_serialization, framework, distributed (dict).
+        """
+        self.metadata = metadata
+        self.num_procs = int(metadata.get("num_procs") or 1)
+        self.allowed = tuple(
+            metadata.get("allowed_serialization") or ("json", "pickle"))
+        self.pool: Optional[ProcessPool] = None
+
+    # ------------------------------------------------------------------
+    def setup(self):
+        self.pool = ProcessPool(self.num_procs)
+        self.pool.start(self._per_rank_env())
+        self._setup_callable()
+
+    def _per_rank_env(self):
+        fw = framework_class(self.metadata.get("framework"))(self.num_procs)
+        return [
+            fw.rank_env(node_rank=0, local_rank=i, num_nodes=1,
+                        pod_ips=["127.0.0.1"])
+            for i in range(self.num_procs)
+        ]
+
+    def _setup_callable(self):
+        self.pool.setup_all(
+            root_path=self.metadata.get("root_path", ""),
+            import_path=self.metadata["import_path"],
+            name=self.metadata["name"],
+            callable_type=self.metadata.get("callable_type", "fn"),
+            init_args=self.metadata.get("init_args"),
+        )
+
+    def reload(self, metadata: Optional[Dict[str, Any]] = None):
+        """Re-setup after a code sync / metadata push."""
+        if metadata:
+            self.metadata.update(metadata)
+        if self.pool is None:
+            self.setup()
+        else:
+            self._setup_callable()
+
+    # ------------------------------------------------------------------
+    def call(
+        self,
+        body: bytes,
+        serialization_method: str = serialization.DEFAULT,
+        method: Optional[str] = None,
+        timeout: Optional[float] = None,
+        distributed_subcall: bool = False,
+        restart_procs: bool = False,
+        workers: str = "all",
+    ) -> dict:
+        """Execute one request; returns the worker response dict
+        {ok, payload|error, serialization}."""
+        if restart_procs:
+            self.pool.restart(self._per_rank_env())
+            self._setup_callable()
+        return self.pool.call(
+            body, serialization_method, method=method,
+            allowed=self.allowed, timeout=timeout)
+
+    # ------------------------------------------------------------------
+    def healthy(self) -> bool:
+        return self.pool is not None and self.pool.healthy
+
+    def cleanup(self):
+        if self.pool is not None:
+            self.pool.stop()
+            self.pool = None
+
+
+def supervisor_factory(metadata: Dict[str, Any]) -> ExecutionSupervisor:
+    """type → supervisor (reference: supervisor_factory.py:16).
+
+    distributed.type: None/local → ExecutionSupervisor;
+    jax/pytorch/tensorflow/spmd → SPMDDistributedSupervisor.
+    """
+    dist = metadata.get("distributed") or {}
+    dist_type = dist.get("type")
+    if not dist_type or dist_type == "local":
+        return ExecutionSupervisor(metadata)
+    from kubetorch_tpu.serving.spmd_supervisor import (
+        SPMDDistributedSupervisor,
+    )
+
+    return SPMDDistributedSupervisor(metadata)

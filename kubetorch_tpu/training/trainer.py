@@ -143,18 +143,24 @@ class Trainer:
 
     def benchmark(self, batch: Dict[str, jax.Array], n_steps: int = 10,
                   warmup: int = 2) -> Dict[str, float]:
-        """Steady-state step time + tokens/sec (excludes compile)."""
+        """Steady-state step time + tokens/sec (excludes compile).
+
+        The timed region is closed with a host fetch of the last step's loss
+        (which depends on the whole step chain) — ``block_until_ready`` alone
+        is not trusted because remote/relayed TPU backends have been observed
+        to return from it without forcing execution.
+        """
         for _ in range(warmup):
             metrics = self.step(batch)
-        jax.block_until_ready(metrics["loss"])
+        float(jax.device_get(metrics["loss"]))
         t0 = time.perf_counter()
         for _ in range(n_steps):
             metrics = self.step(batch)
-        jax.block_until_ready(metrics["loss"])
+        loss = float(jax.device_get(metrics["loss"]))
         dt = (time.perf_counter() - t0) / n_steps
         tokens = int(batch["inputs"].shape[0] * batch["inputs"].shape[1])
         return {
             "step_time_s": dt,
             "tokens_per_sec": tokens / dt,
-            "loss": float(metrics["loss"]),
+            "loss": loss,
         }

@@ -1,0 +1,45 @@
+"""Deployable test asset (reference pattern: tests/assets/summer)."""
+
+import asyncio
+import os
+
+
+def summer(a, b):
+    return a + b
+
+
+async def async_summer(a, b):
+    await asyncio.sleep(0.01)
+    return a + b
+
+
+def whoami():
+    return {
+        "rank": os.environ.get("RANK"),
+        "world_size": os.environ.get("WORLD_SIZE"),
+        "pod": os.environ.get("KT_REPLICA_INDEX"),
+        "pid": os.getpid(),
+    }
+
+
+def boom(message="kaboom"):
+    raise ValueError(message)
+
+
+def env_value(key):
+    return os.environ.get(key)
+
+
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def increment(self, by=1):
+        self.value += by
+        return self.value
+
+    def get(self):
+        return self.value
+
+    def pid(self):
+        return os.getpid()
