@@ -105,6 +105,7 @@ class ProcessPool:
         allowed: Optional[tuple] = None,
         local_rank: Optional[int] = None,
         timeout: Optional[float] = None,
+        env: Optional[Dict[str, str]] = None,
     ) -> dict:
         """Send one call to one worker (round-robin by default)."""
         if local_rank is None:
@@ -114,8 +115,30 @@ class ProcessPool:
             "kind": "call", "req_id": uuid.uuid4().hex, "method": method,
             "body": body, "serialization": serialization_method,
             "allowed": list(allowed or ("json", "pickle")),
+            "env": env or {},
         }
         return self._submit(worker, req).result(timeout)
+
+    def call_all_async(
+        self,
+        body: bytes,
+        serialization_method: str,
+        method: Optional[str] = None,
+        allowed: Optional[tuple] = None,
+        env_per_rank: Optional[List[Dict[str, str]]] = None,
+    ) -> List[Future]:
+        """Fan one request to every local rank; returns futures (so callers
+        can race them against membership-change events)."""
+        futures = []
+        for i, worker in enumerate(self.workers):
+            req = {
+                "kind": "call", "req_id": uuid.uuid4().hex, "method": method,
+                "body": body, "serialization": serialization_method,
+                "allowed": list(allowed or ("json", "pickle")),
+                "env": (env_per_rank or [{}] * len(self.workers))[i],
+            }
+            futures.append(self._submit(worker, req))
+        return futures
 
     def call_all(
         self,
@@ -124,16 +147,11 @@ class ProcessPool:
         method: Optional[str] = None,
         allowed: Optional[tuple] = None,
         timeout: Optional[float] = None,
+        env_per_rank: Optional[List[Dict[str, str]]] = None,
     ) -> List[dict]:
-        """Fan one request to every local rank; returns per-rank responses."""
-        futures = []
-        for worker in self.workers:
-            req = {
-                "kind": "call", "req_id": uuid.uuid4().hex, "method": method,
-                "body": body, "serialization": serialization_method,
-                "allowed": list(allowed or ("json", "pickle")),
-            }
-            futures.append(self._submit(worker, req))
+        futures = self.call_all_async(
+            body, serialization_method, method=method, allowed=allowed,
+            env_per_rank=env_per_rank)
         return [f.result(timeout) for f in futures]
 
     # ------------------------------------------------------------------

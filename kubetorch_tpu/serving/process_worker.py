@@ -97,6 +97,10 @@ class _WorkerLoop:
                     req["name"], self.callable_type, req.get("init_args"))
                 return {"req_id": req_id, "ok": True, "payload": None}
 
+            # Per-call env (distributed rank assignment happens at call time,
+            # after quorum — reference: process_pool.call_all per-rank env).
+            for key, value in (req.get("env") or {}).items():
+                os.environ[key] = str(value)
             body = serialization.loads(req["body"], req["serialization"])
             args = body.get("args", [])
             kwargs = body.get("kwargs", {})

@@ -305,7 +305,8 @@ class PodServer:
                 lambda: self.supervisor.call(
                     body, ser, method=method,
                     distributed_subcall=distributed_subcall,
-                    restart_procs=restart_procs, workers=workers))
+                    restart_procs=restart_procs, workers=workers,
+                    query=dict(request.query)))
         except Exception as exc:
             return web.json_response(package_exception(exc), status=500)
         if resp is None:

@@ -71,6 +71,7 @@ class ExecutionSupervisor:
         distributed_subcall: bool = False,
         restart_procs: bool = False,
         workers: str = "all",
+        query: Optional[Dict[str, str]] = None,
     ) -> dict:
         """Execute one request; returns the worker response dict
         {ok, payload|error, serialization}."""
