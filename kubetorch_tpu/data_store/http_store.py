@@ -1,0 +1,146 @@
+"""HTTP store backend: delta-synced tree transfer + blobs against
+``store_server.py``.
+
+Upload: scan local manifest (native xxh64) → POST /tree/{key}/diff → tar only
+the paths the server needs → POST /tree/{key}/upload (with mirror deletes).
+Download: GET /tree/{key}/manifest → diff vs local dest → POST archive of
+missing → extract + delete extraneous. Unchanged files never cross the wire —
+the rsync property that matters for the code-sync loop.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+from pathlib import Path
+from typing import List
+
+import httpx
+
+from kubetorch_tpu.exceptions import DataStoreError, RsyncError
+from kubetorch_tpu.data_store.sync import (
+    DEFAULT_EXCLUDES,
+    diff_manifests,
+    scan_tree,
+)
+
+_TIMEOUT = httpx.Timeout(connect=10.0, read=600.0, write=600.0, pool=10.0)
+
+
+class HttpStoreBackend:
+    def __init__(self, base_url: str):
+        self.base_url = base_url.rstrip("/")
+        self.client = httpx.Client(timeout=_TIMEOUT)
+
+    def _url(self, path: str) -> str:
+        return f"{self.base_url}{path}"
+
+    def _raise_for(self, resp: httpx.Response, action: str):
+        if resp.status_code >= 400:
+            raise DataStoreError(
+                f"store {action} failed ({resp.status_code}): {resp.text}")
+
+    # ---------------------------------------------------------- trees
+    def put_path(self, key: str, src: Path, excludes=DEFAULT_EXCLUDES,
+                 **kw) -> str:
+        src = Path(src)
+        if src.is_file():
+            return self.put_blob(key, src.read_bytes())
+        manifest = scan_tree(src, excludes, with_hash=True)
+        resp = self.client.post(
+            self._url(f"/tree/{key}/diff"),
+            json={k: list(v) for k, v in manifest.items()})
+        self._raise_for(resp, "diff")
+        delta = resp.json()
+        need: List[str] = delta["need"]
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+            for rel in need:
+                tar.add(src / rel, arcname=rel)
+        resp = self.client.post(
+            self._url(f"/tree/{key}/upload"),
+            content=buf.getvalue(),
+            headers={"X-KT-Delete": json.dumps(delta["extraneous"]),
+                     "Content-Type": "application/gzip"})
+        self._raise_for(resp, "upload")
+        return key
+
+    def get_path(self, key: str, dest: Path, excludes=DEFAULT_EXCLUDES,
+                 **kw) -> Path:
+        dest = Path(dest)
+        resp = self.client.get(self._url(f"/tree/{key}/manifest"))
+        if resp.status_code == 404:
+            # single file stored as blob
+            blob = self.get_blob(key)
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            if dest.is_dir():
+                dest = dest / key.rsplit("/", 1)[-1]
+            dest.write_bytes(blob)
+            return dest
+        self._raise_for(resp, "manifest")
+        remote = {k: tuple(v) for k, v in resp.json().items()}
+        dest.mkdir(parents=True, exist_ok=True)
+        local = scan_tree(dest, excludes, with_hash=True)
+        need, extraneous = diff_manifests(remote, local, use_hash=True)
+        if need:
+            resp = self.client.post(
+                self._url(f"/tree/{key}/archive"), json={"paths": need})
+            self._raise_for(resp, "archive")
+            with tarfile.open(fileobj=io.BytesIO(resp.content),
+                              mode="r:*") as tar:
+                _safe_extract(tar, dest)
+        for rel in extraneous:
+            try:
+                (dest / rel).unlink()
+            except OSError:
+                pass
+        return dest
+
+    # ---------------------------------------------------------- blobs
+    def put_blob(self, key: str, blob: bytes, **kw) -> str:
+        resp = self.client.put(self._url(f"/blob/{key}"), content=blob)
+        self._raise_for(resp, "put")
+        return key
+
+    def get_blob(self, key: str, **kw) -> bytes:
+        resp = self.client.get(self._url(f"/blob/{key}"))
+        if resp.status_code == 404:
+            raise DataStoreError(f"no such key {key!r}")
+        self._raise_for(resp, "get")
+        return resp.content
+
+    # ------------------------------------------------------- metadata
+    def list_keys(self, prefix: str = "", **kw) -> List[dict]:
+        resp = self.client.get(self._url("/keys"), params={"prefix": prefix})
+        self._raise_for(resp, "ls")
+        return resp.json()["keys"]
+
+    def delete(self, key: str, recursive: bool = False, **kw) -> int:
+        resp = self.client.delete(
+            self._url(f"/key/{key}"),
+            params={"recursive": "true" if recursive else "false"})
+        self._raise_for(resp, "rm")
+        return resp.json()["deleted"]
+
+    # ------------------------------------------------------- P2P hooks
+    def register_source(self, key: str, url: str):
+        resp = self.client.post(self._url(f"/sources/{key}"),
+                                json={"url": url})
+        self._raise_for(resp, "register_source")
+
+    def get_source(self, key: str) -> dict:
+        resp = self.client.get(self._url(f"/sources/{key}"))
+        if resp.status_code == 404:
+            raise DataStoreError(f"no source for {key!r}")
+        self._raise_for(resp, "get_source")
+        return resp.json()
+
+
+def _safe_extract(tar: tarfile.TarFile, dest: Path):
+    dest = dest.resolve()
+    for member in tar.getmembers():
+        target = (dest / member.name).resolve()
+        if dest not in target.parents and target != dest:
+            raise RsyncError(f"unsafe tar path {member.name!r}")
+    tar.extractall(dest, filter="data")

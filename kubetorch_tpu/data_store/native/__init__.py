@@ -1,0 +1,64 @@
+"""ctypes binding for the native content hasher (kthash.cpp).
+
+Builds on first use with g++ (cached next to the source); callers fall back
+to hashlib when no toolchain exists (see ``sync.file_hash``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+_DIR = Path(__file__).parent
+_SRC = _DIR / "kthash.cpp"
+_LIB = _DIR / "libkthash.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _ensure_lib() -> ctypes.CDLL:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            raise RuntimeError("native hasher build previously failed")
+        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     str(_SRC), "-o", str(_LIB)],
+                    check=True, capture_output=True, timeout=120)
+            except (subprocess.SubprocessError, FileNotFoundError) as exc:
+                _build_failed = True
+                raise RuntimeError(f"native hasher build failed: {exc}")
+        lib = ctypes.CDLL(str(_LIB))
+        lib.kt_hash_file.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                     ctypes.c_int]
+        lib.kt_hash_file.restype = ctypes.c_int
+        lib.kt_hash_buf.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                    ctypes.c_char_p, ctypes.c_int]
+        lib.kt_hash_buf.restype = None
+        _lib = lib
+        return lib
+
+
+def hash_file(path: str) -> str:
+    lib = _ensure_lib()
+    out = ctypes.create_string_buffer(17)
+    rc = lib.kt_hash_file(path.encode(), out, 17)
+    if rc != 0:
+        raise OSError(f"kt_hash_file({path!r}) failed with {rc}")
+    return out.value.decode()
+
+
+def hash_bytes(data: bytes) -> str:
+    lib = _ensure_lib()
+    out = ctypes.create_string_buffer(17)
+    lib.kt_hash_buf(data, len(data), out, 17)
+    return out.value.decode()

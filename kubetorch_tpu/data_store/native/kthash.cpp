@@ -1,0 +1,168 @@
+// Fast content hashing for the delta-sync data plane.
+//
+// The reference delegates file-change detection to the rsync binary
+// (data_store/rsync_client.py); this framework ships its own delta-sync
+// protocol (kubetorch_tpu/data_store/sync.py) and uses this native scanner
+// for the hot path: a streaming XXH64 (implemented from the public xxHash
+// spec) over file contents, plus a buffer variant for wire checksums.
+//
+// Built as a shared library by kubetorch_tpu/data_store/native/__init__.py
+// (g++ -O3); loaded via ctypes. Python falls back to blake2b when the
+// toolchain is unavailable.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t P1 = 11400714785074694791ULL;
+constexpr uint64_t P2 = 14029467366897019727ULL;
+constexpr uint64_t P3 = 1609587929392839161ULL;
+constexpr uint64_t P4 = 9650029242287828579ULL;
+constexpr uint64_t P5 = 2870177450012600261ULL;
+
+inline uint64_t rotl(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+inline uint64_t read64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (x86-64 / arm64)
+}
+
+inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint64_t round_(uint64_t acc, uint64_t lane) {
+  return rotl(acc + lane * P2, 31) * P1;
+}
+
+inline uint64_t merge_round(uint64_t acc, uint64_t lane) {
+  return (acc ^ round_(0, lane)) * P1 + P4;
+}
+
+struct XXH64State {
+  uint64_t acc[4];
+  uint8_t buf[32];
+  size_t buf_len = 0;
+  uint64_t total = 0;
+
+  explicit XXH64State(uint64_t seed = 0) {
+    acc[0] = seed + P1 + P2;
+    acc[1] = seed + P2;
+    acc[2] = seed;
+    acc[3] = seed - P1;
+  }
+
+  void update(const uint8_t* data, size_t len) {
+    total += len;
+    if (buf_len + len < 32) {
+      std::memcpy(buf + buf_len, data, len);
+      buf_len += len;
+      return;
+    }
+    if (buf_len) {
+      size_t fill = 32 - buf_len;
+      std::memcpy(buf + buf_len, data, fill);
+      consume_stripe(buf);
+      data += fill;
+      len -= fill;
+      buf_len = 0;
+    }
+    while (len >= 32) {
+      consume_stripe(data);
+      data += 32;
+      len -= 32;
+    }
+    if (len) {
+      std::memcpy(buf, data, len);
+      buf_len = len;
+    }
+  }
+
+  void consume_stripe(const uint8_t* p) {
+    acc[0] = round_(acc[0], read64(p));
+    acc[1] = round_(acc[1], read64(p + 8));
+    acc[2] = round_(acc[2], read64(p + 16));
+    acc[3] = round_(acc[3], read64(p + 24));
+  }
+
+  uint64_t digest() const {
+    uint64_t h;
+    if (total >= 32) {
+      h = rotl(acc[0], 1) + rotl(acc[1], 7) + rotl(acc[2], 12) +
+          rotl(acc[3], 18);
+      h = merge_round(h, acc[0]);
+      h = merge_round(h, acc[1]);
+      h = merge_round(h, acc[2]);
+      h = merge_round(h, acc[3]);
+    } else {
+      h = acc[2] + P5;  // acc[2] == seed
+    }
+    h += total;
+    const uint8_t* p = buf;
+    size_t len = buf_len;
+    while (len >= 8) {
+      h ^= round_(0, read64(p));
+      h = rotl(h, 27) * P1 + P4;
+      p += 8;
+      len -= 8;
+    }
+    if (len >= 4) {
+      h ^= uint64_t(read32(p)) * P1;
+      h = rotl(h, 23) * P2 + P3;
+      p += 4;
+      len -= 4;
+    }
+    while (len--) {
+      h ^= uint64_t(*p++) * P5;
+      h = rotl(h, 11) * P1;
+    }
+    h ^= h >> 33;
+    h *= P2;
+    h ^= h >> 29;
+    h *= P3;
+    h ^= h >> 32;
+    return h;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Hash a file's contents; returns 0 on success, writes 16 hex chars + NUL.
+int kt_hash_file(const char* path, char* out_hex, int out_len) {
+  if (out_len < 17) return -2;
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  XXH64State state;
+  static thread_local uint8_t chunk[1 << 20];
+  size_t n;
+  while ((n = std::fread(chunk, 1, sizeof(chunk), f)) > 0) {
+    state.update(chunk, n);
+  }
+  int err = std::ferror(f);
+  std::fclose(f);
+  if (err) return -1;
+  std::snprintf(out_hex, 17, "%016llx",
+                static_cast<unsigned long long>(state.digest()));
+  return 0;
+}
+
+// Hash an in-memory buffer.
+void kt_hash_buf(const uint8_t* data, uint64_t len, char* out_hex,
+                 int out_len) {
+  if (out_len < 17) return;
+  XXH64State state;
+  state.update(data, len);
+  std::snprintf(out_hex, 17, "%016llx",
+                static_cast<unsigned long long>(state.digest()));
+}
+
+}  // extern "C"

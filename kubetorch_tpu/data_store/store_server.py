@@ -1,0 +1,262 @@
+"""Data-store service: blob + delta-tree + metadata server in one aiohttp app.
+
+Reference split this across an rsync daemon, a metadata FastAPI server, and a
+WS tunnel (``services/data_store/server.py``, SURVEY.md §2.4). The TPU rebuild
+collapses them into one HTTP service speaking a delta protocol (manifests of
+``(size, mtime, xxh64)`` — see ``sync.py``), so code sync works identically
+from laptops (through any HTTP ingress) and in-cluster, with no rsync binary
+or tunnel in the loop.
+
+Endpoints:
+- ``GET  /health``
+- ``PUT  /blob/{key}``, ``GET /blob/{key}``
+- ``GET  /keys?prefix=``          list
+- ``DELETE /key/{key}?recursive=`` delete
+- ``POST /tree/{key}/diff``       client manifest → paths the server needs
+- ``POST /tree/{key}/upload``     tar of needed paths (+deletes to mirror)
+- ``GET  /tree/{key}/manifest``   server manifest (download direction)
+- ``POST /tree/{key}/archive``    tar of requested paths
+- ``GET  /stats``
+
+P2P source registration (the reference's zero-copy ``locale="local"`` mode)
+is modeled with ``POST /sources/{key}`` + ``GET /sources/{key}`` — peers
+register as alternate sources and getters prefer a peer before falling back
+to the store (reference: metadata_client.py get_source_ip load balancing).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import shutil
+import tarfile
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from aiohttp import web
+
+from kubetorch_tpu.data_store.sync import diff_manifests, scan_tree
+
+_DEFAULT_ROOT = Path(os.environ.get("KT_STORE_ROOT",
+                                    "~/.ktpu/store_server")).expanduser()
+
+
+def _norm_key(key: str) -> str:
+    key = key.strip("/")
+    if not key or ".." in key.split("/"):
+        raise web.HTTPBadRequest(text=f"invalid key {key!r}")
+    return key
+
+
+class StoreServer:
+    def __init__(self, root: Optional[Path] = None):
+        self.root = (root or _DEFAULT_ROOT).resolve()
+        self.root.mkdir(parents=True, exist_ok=True)
+        # key -> [{url, registered_at}] alternate P2P sources
+        self.sources: Dict[str, List[dict]] = {}
+        self._rr: Dict[str, int] = {}
+        self.stats = {"puts": 0, "gets": 0, "bytes_in": 0, "bytes_out": 0,
+                      "started_at": time.time()}
+
+    def _path(self, key: str) -> Path:
+        return self.root / key
+
+    # ------------------------------------------------------------- app
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=8 * 1024**3)
+        r = app.router
+        r.add_get("/health", self.h_health)
+        r.add_get("/stats", self.h_stats)
+        r.add_put("/blob/{key:.+}", self.h_put_blob)
+        r.add_get("/blob/{key:.+}", self.h_get_blob)
+        r.add_get("/keys", self.h_keys)
+        r.add_delete("/key/{key:.+}", self.h_delete)
+        r.add_post("/tree/{key:.+}/diff", self.h_tree_diff)
+        r.add_post("/tree/{key:.+}/upload", self.h_tree_upload)
+        r.add_get("/tree/{key:.+}/manifest", self.h_tree_manifest)
+        r.add_post("/tree/{key:.+}/archive", self.h_tree_archive)
+        r.add_post("/sources/{key:.+}", self.h_register_source)
+        r.add_get("/sources/{key:.+}", self.h_get_source)
+        r.add_delete("/sources/{key:.+}", self.h_delete_source)
+        return app
+
+    # --------------------------------------------------------- handlers
+    async def h_health(self, request):
+        return web.json_response({"status": "ok", "root": str(self.root)})
+
+    async def h_stats(self, request):
+        files = sum(1 for p in self.root.rglob("*") if p.is_file())
+        return web.json_response({**self.stats, "files": files})
+
+    async def h_put_blob(self, request):
+        key = _norm_key(request.match_info["key"])
+        body = await request.read()
+        path = self._path(key)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_bytes(body)
+        self.stats["puts"] += 1
+        self.stats["bytes_in"] += len(body)
+        return web.json_response({"key": key, "size": len(body)})
+
+    async def h_get_blob(self, request):
+        key = _norm_key(request.match_info["key"])
+        path = self._path(key)
+        if not path.is_file():
+            raise web.HTTPNotFound(text=f"no such key {key!r}")
+        data = path.read_bytes()
+        self.stats["gets"] += 1
+        self.stats["bytes_out"] += len(data)
+        return web.Response(body=data,
+                            content_type="application/octet-stream")
+
+    async def h_keys(self, request):
+        prefix = request.query.get("prefix", "").strip("/")
+        base = self.root / prefix if prefix else self.root
+        out = []
+        if base.exists():
+            for path in sorted(base.rglob("*")):
+                if path.is_file():
+                    stat = path.stat()
+                    out.append({"key": str(path.relative_to(self.root)),
+                                "size": stat.st_size,
+                                "mtime": stat.st_mtime})
+        return web.json_response({"keys": out})
+
+    async def h_delete(self, request):
+        key = _norm_key(request.match_info["key"])
+        recursive = request.query.get("recursive") == "true"
+        path = self._path(key)
+        if not path.exists():
+            return web.json_response({"deleted": 0})
+        if path.is_dir():
+            if not recursive:
+                raise web.HTTPBadRequest(
+                    text=f"{key!r} is a prefix; pass recursive=true")
+            count = sum(1 for p in path.rglob("*") if p.is_file())
+            shutil.rmtree(path)
+        else:
+            path.unlink()
+            count = 1
+        self.sources.pop(key, None)
+        return web.json_response({"deleted": count})
+
+    # ------------------------------------------------------ tree sync
+    async def h_tree_diff(self, request):
+        """Client sends its manifest; respond with paths we need + paths we
+        hold that the client doesn't (for mirror deletes on upload)."""
+        key = _norm_key(request.match_info["key"])
+        client_manifest = {
+            k: tuple(v) for k, v in (await request.json()).items()}
+        dest = self._path(key)
+        server_manifest = (scan_tree(dest, with_hash=True)
+                          if dest.is_dir() else {})
+        need, extraneous = diff_manifests(
+            client_manifest, server_manifest, use_hash=True)
+        return web.json_response({"need": need, "extraneous": extraneous})
+
+    async def h_tree_upload(self, request):
+        """Tar of changed files; ``X-KT-Delete`` header lists mirror deletes."""
+        key = _norm_key(request.match_info["key"])
+        dest = self._path(key)
+        dest.mkdir(parents=True, exist_ok=True)
+        deletes = json.loads(request.headers.get("X-KT-Delete", "[]"))
+        body = await request.read()
+        count = 0
+        if body:
+            with tarfile.open(fileobj=io.BytesIO(body), mode="r:*") as tar:
+                _safe_extract(tar, dest)
+                count = len(tar.getnames())
+        for rel in deletes:
+            target = (dest / rel).resolve()
+            if dest.resolve() in target.parents and target.is_file():
+                target.unlink()
+        self.stats["puts"] += 1
+        self.stats["bytes_in"] += len(body)
+        return web.json_response({"applied": count, "deleted": len(deletes)})
+
+    async def h_tree_manifest(self, request):
+        key = _norm_key(request.match_info["key"])
+        path = self._path(key)
+        if not path.is_dir():
+            raise web.HTTPNotFound(text=f"no such tree {key!r}")
+        manifest = scan_tree(path, with_hash=True)
+        return web.json_response({k: list(v) for k, v in manifest.items()})
+
+    async def h_tree_archive(self, request):
+        key = _norm_key(request.match_info["key"])
+        paths = (await request.json()).get("paths", [])
+        base = self._path(key)
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+            for rel in paths:
+                full = (base / rel).resolve()
+                if base.resolve() not in full.parents and full != base.resolve():
+                    continue
+                if full.is_file():
+                    tar.add(full, arcname=rel)
+        data = buf.getvalue()
+        self.stats["gets"] += 1
+        self.stats["bytes_out"] += len(data)
+        return web.Response(body=data, content_type="application/gzip")
+
+    # ------------------------------------------------------ P2P sources
+    async def h_register_source(self, request):
+        key = _norm_key(request.match_info["key"])
+        info = await request.json()
+        entry = {"url": info["url"], "registered_at": time.time()}
+        sources = self.sources.setdefault(key, [])
+        sources[:] = [s for s in sources if s["url"] != entry["url"]]
+        sources.append(entry)
+        return web.json_response({"sources": len(sources)})
+
+    async def h_get_source(self, request):
+        """Load-balanced source lookup: round-robin over registered peers,
+        falling back to the store itself (reference: server.py:474
+        get_source)."""
+        key = _norm_key(request.match_info["key"])
+        sources = [s for s in self.sources.get(key, [])
+                   if time.time() - s["registered_at"] < 3600]
+        if sources:
+            idx = self._rr.get(key, 0) % len(sources)
+            self._rr[key] = idx + 1
+            return web.json_response(
+                {"source": sources[idx]["url"], "peer": True})
+        if self._path(key).exists():
+            return web.json_response({"source": "", "peer": False})
+        raise web.HTTPNotFound(text=f"no source for {key!r}")
+
+    async def h_delete_source(self, request):
+        key = _norm_key(request.match_info["key"])
+        info = await request.json()
+        sources = self.sources.get(key, [])
+        sources[:] = [s for s in sources if s["url"] != info.get("url")]
+        return web.json_response({"sources": len(sources)})
+
+
+def _safe_extract(tar: tarfile.TarFile, dest: Path):
+    dest = dest.resolve()
+    for member in tar.getmembers():
+        target = (dest / member.name).resolve()
+        if dest not in target.parents and target != dest:
+            raise web.HTTPBadRequest(text=f"unsafe tar path {member.name!r}")
+    tar.extractall(dest, filter="data")
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser(description="kubetorch_tpu data store")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int,
+                        default=int(os.environ.get("KT_STORE_PORT", "32310")))
+    parser.add_argument("--root", default=None)
+    args = parser.parse_args()
+    server = StoreServer(Path(args.root) if args.root else None)
+    web.run_app(server.build_app(), host=args.host, port=args.port,
+                print=None, access_log=None)
+
+
+if __name__ == "__main__":
+    main()
