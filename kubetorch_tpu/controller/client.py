@@ -1,0 +1,141 @@
+"""ControllerClient — typed HTTP client of the controller service.
+
+Reference: ``python_client/kubetorch/globals.py:424 ControllerClient`` (all
+typed methods for pools/runs/teardown/apply + version check).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+import httpx
+
+from kubetorch_tpu.config import get_config
+from kubetorch_tpu.exceptions import KubetorchError, VersionMismatchError
+from kubetorch_tpu.version import __version__
+
+_TIMEOUT = httpx.Timeout(connect=10.0, read=300.0, write=60.0, pool=10.0)
+
+
+class ControllerClient:
+    def __init__(self, base_url: Optional[str] = None,
+                 token: Optional[str] = None):
+        self.base_url = (base_url or os.environ.get("KT_CONTROLLER_URL")
+                         or get_config().controller_url)
+        if not self.base_url:
+            raise KubetorchError(
+                "no controller configured (KT_CONTROLLER_URL / "
+                "config.controller_url)")
+        self.base_url = self.base_url.rstrip("/")
+        headers = {}
+        token = token or os.environ.get("KT_CONTROLLER_TOKEN")
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        self.client = httpx.Client(timeout=_TIMEOUT, headers=headers)
+
+    @classmethod
+    def maybe(cls) -> Optional["ControllerClient"]:
+        """A client when a controller is configured, else None (local mode
+        without controller is fully supported)."""
+        try:
+            return cls()
+        except KubetorchError:
+            return None
+
+    # ------------------------------------------------------------------
+    def _check(self, resp: httpx.Response) -> Any:
+        if resp.status_code >= 400:
+            raise KubetorchError(
+                f"controller error {resp.status_code}: {resp.text}")
+        return resp.json() if resp.content else None
+
+    def health(self, check_version: bool = True) -> Dict[str, Any]:
+        resp = self.client.get(f"{self.base_url}/health",
+                               params={"client_version": __version__})
+        data = self._check(resp)
+        if check_version and not data.get("compatible", True):
+            raise VersionMismatchError(
+                f"client {__version__} incompatible with controller "
+                f"{data.get('version')}")
+        return data
+
+    def cluster_config(self) -> Dict[str, Any]:
+        return self._check(self.client.get(f"{self.base_url}/config")) or {}
+
+    # ------------------------------------------------------------ pools
+    def register_pool(
+        self,
+        service_name: str,
+        module_meta: Dict[str, Any],
+        compute: Optional[Dict[str, Any]] = None,
+        launch_id: str = "",
+        broadcast: bool = True,
+        ack_timeout: float = 120.0,
+    ) -> Dict[str, Any]:
+        cfg = get_config()
+        return self._check(self.client.post(f"{self.base_url}/pool", json={
+            "service_name": service_name,
+            "module_meta": module_meta,
+            "compute": compute or {},
+            "namespace": cfg.namespace,
+            "username": cfg.username,
+            "backend": cfg.backend,
+            "launch_id": launch_id,
+            "broadcast": broadcast,
+            "ack_timeout": ack_timeout,
+        }))
+
+    def get_pool(self, service_name: str) -> Optional[Dict[str, Any]]:
+        resp = self.client.get(f"{self.base_url}/pool/{service_name}")
+        if resp.status_code == 404:
+            return None
+        return self._check(resp)
+
+    def list_pools(self) -> List[Dict[str, Any]]:
+        return self._check(
+            self.client.get(f"{self.base_url}/pools"))["pools"]
+
+    def teardown(self, service_name: str) -> bool:
+        return bool(self._check(self.client.delete(
+            f"{self.base_url}/pool/{service_name}"))["deleted"])
+
+    def report_activity(self, service_name: str):
+        self.client.post(f"{self.base_url}/pool/{service_name}/activity")
+
+    # ------------------------------------------------------------- runs
+    def create_run(self, run_id: str, **fields: Any) -> Dict[str, Any]:
+        return self._check(self.client.post(
+            f"{self.base_url}/runs", json={"run_id": run_id, **fields}))
+
+    def update_run(self, run_id: str, **fields: Any) -> Dict[str, Any]:
+        return self._check(self.client.patch(
+            f"{self.base_url}/runs/{run_id}", json=fields))
+
+    def get_run(self, run_id: str) -> Optional[Dict[str, Any]]:
+        resp = self.client.get(f"{self.base_url}/runs/{run_id}")
+        if resp.status_code == 404:
+            return None
+        return self._check(resp)
+
+    def list_runs(self) -> List[Dict[str, Any]]:
+        return self._check(self.client.get(f"{self.base_url}/runs"))["runs"]
+
+    def add_note(self, run_id: str, text: str, **fields: Any):
+        return self._check(self.client.post(
+            f"{self.base_url}/runs/{run_id}/notes",
+            json={"text": text, **fields}))
+
+    def add_artifact(self, run_id: str, ref: str, name: str = ""):
+        return self._check(self.client.post(
+            f"{self.base_url}/runs/{run_id}/artifacts",
+            json={"ref": ref, "name": name}))
+
+    def delete_run(self, run_id: str) -> bool:
+        return bool(self._check(self.client.delete(
+            f"{self.base_url}/runs/{run_id}"))["deleted"])
+
+    # ------------------------------------------------------------ apply
+    def apply(self, manifest: Dict[str, Any]) -> Dict[str, Any]:
+        return self._check(self.client.post(
+            f"{self.base_url}/apply", json={"manifest": manifest}))

@@ -1,0 +1,197 @@
+"""Controller persistence: SQLite (stdlib) for pools and runs.
+
+Reference: ``services/kubetorch_controller/core/{models,database}.py``
+(SQLAlchemy + SQLite). Plain sqlite3 here — two tables, no ORM needed.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS pools (
+    service_name TEXT PRIMARY KEY,
+    namespace TEXT NOT NULL DEFAULT 'default',
+    username TEXT,
+    module_meta TEXT NOT NULL DEFAULT '{}',
+    compute TEXT NOT NULL DEFAULT '{}',
+    backend TEXT NOT NULL DEFAULT 'local',
+    launch_id TEXT,
+    status TEXT NOT NULL DEFAULT 'registered',
+    inactivity_ttl TEXT,
+    last_active REAL,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS runs (
+    run_id TEXT PRIMARY KEY,
+    command TEXT,
+    status TEXT NOT NULL DEFAULT 'created',
+    workdir_key TEXT,
+    env TEXT,
+    log_tail TEXT,
+    notes TEXT NOT NULL DEFAULT '[]',
+    artifacts TEXT NOT NULL DEFAULT '[]',
+    user TEXT,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+"""
+
+
+class Database:
+    def __init__(self, path: str = ":memory:"):
+        if path != ":memory:":
+            Path(path).parent.mkdir(parents=True, exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    # ------------------------------------------------------------ pools
+    def upsert_pool(self, service_name: str, **fields: Any) -> Dict[str, Any]:
+        now = time.time()
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT service_name FROM pools WHERE service_name=?",
+                (service_name,)).fetchone()
+            payload = {
+                "namespace": fields.get("namespace", "default"),
+                "username": fields.get("username"),
+                "module_meta": json.dumps(fields.get("module_meta") or {}),
+                "compute": json.dumps(fields.get("compute") or {}),
+                "backend": fields.get("backend", "local"),
+                "launch_id": fields.get("launch_id"),
+                "status": fields.get("status", "registered"),
+                "inactivity_ttl": fields.get("inactivity_ttl"),
+                "last_active": now,
+                "updated_at": now,
+            }
+            if row is None:
+                self._conn.execute(
+                    f"INSERT INTO pools (service_name, created_at, "
+                    f"{','.join(payload)}) VALUES (?, ?, "
+                    f"{','.join('?' * len(payload))})",
+                    (service_name, now, *payload.values()))
+            else:
+                sets = ",".join(f"{k}=?" for k in payload)
+                self._conn.execute(
+                    f"UPDATE pools SET {sets} WHERE service_name=?",
+                    (*payload.values(), service_name))
+            self._conn.commit()
+        return self.get_pool(service_name)
+
+    def get_pool(self, service_name: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT * FROM pools WHERE service_name=?",
+                (service_name,)).fetchone()
+        return _pool_dict(row) if row else None
+
+    def list_pools(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM pools ORDER BY created_at").fetchall()
+        return [_pool_dict(r) for r in rows]
+
+    def touch_pool(self, service_name: str, ts: Optional[float] = None):
+        with self._lock:
+            self._conn.execute(
+                "UPDATE pools SET last_active=? WHERE service_name=?",
+                (ts or time.time(), service_name))
+            self._conn.commit()
+
+    def delete_pool(self, service_name: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM pools WHERE service_name=?", (service_name,))
+            self._conn.commit()
+            return cur.rowcount > 0
+
+    # ------------------------------------------------------------- runs
+    def create_run(self, run_id: str, **fields: Any) -> Dict[str, Any]:
+        now = time.time()
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO runs (run_id, command, status, "
+                "workdir_key, env, user, created_at, updated_at) "
+                "VALUES (?,?,?,?,?,?,?,?)",
+                (run_id, fields.get("command"),
+                 fields.get("status", "created"),
+                 fields.get("workdir_key"),
+                 json.dumps(fields.get("env") or {}),
+                 fields.get("user"), now, now))
+            self._conn.commit()
+        return self.get_run(run_id)
+
+    def update_run(self, run_id: str, **fields: Any) -> Optional[Dict[str, Any]]:
+        allowed = {"status", "log_tail"}
+        sets, values = ["updated_at=?"], [time.time()]
+        for key in allowed & set(fields):
+            sets.append(f"{key}=?")
+            values.append(fields[key])
+        with self._lock:
+            self._conn.execute(
+                f"UPDATE runs SET {','.join(sets)} WHERE run_id=?",
+                (*values, run_id))
+            self._conn.commit()
+        return self.get_run(run_id)
+
+    def append_run_item(self, run_id: str, column: str, item: Any):
+        if column not in ("notes", "artifacts"):
+            raise ValueError(column)
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT {column} FROM runs WHERE run_id=?",
+                (run_id,)).fetchone()
+            if row is None:
+                return None
+            items = json.loads(row[0] or "[]")
+            items.append(item)
+            self._conn.execute(
+                f"UPDATE runs SET {column}=?, updated_at=? WHERE run_id=?",
+                (json.dumps(items), time.time(), run_id))
+            self._conn.commit()
+        return self.get_run(run_id)
+
+    def get_run(self, run_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT * FROM runs WHERE run_id=?", (run_id,)).fetchone()
+        return _run_dict(row) if row else None
+
+    def list_runs(self, limit: int = 100) -> List[Dict[str, Any]]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM runs ORDER BY created_at DESC LIMIT ?",
+                (limit,)).fetchall()
+        return [_run_dict(r) for r in rows]
+
+    def delete_run(self, run_id: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM runs WHERE run_id=?", (run_id,))
+            self._conn.commit()
+            return cur.rowcount > 0
+
+
+def _pool_dict(row: sqlite3.Row) -> Dict[str, Any]:
+    d = dict(row)
+    d["module_meta"] = json.loads(d.get("module_meta") or "{}")
+    d["compute"] = json.loads(d.get("compute") or "{}")
+    return d
+
+
+def _run_dict(row: sqlite3.Row) -> Dict[str, Any]:
+    d = dict(row)
+    d["env"] = json.loads(d.get("env") or "{}")
+    d["notes"] = json.loads(d.get("notes") or "[]")
+    d["artifacts"] = json.loads(d.get("artifacts") or "[]")
+    return d

@@ -1,0 +1,392 @@
+"""Controller service: pool registry, pod WebSocket hub, runs, TTL reaper.
+
+Reference: ``services/kubetorch_controller/`` — ``routes/pool.py:39``
+(register_pool), ``routes/ws_pods.py`` (PodConnectionManager, metadata push
+with acks, pods-connect-before-pool-exists), ``routes/runs.py``,
+``ttl_controller.py`` (inactivity reaper). This is the most stateful protocol
+in the system (SURVEY.md §7 hard-part 1); the semantics kept exactly:
+
+- pods open a persistent WS and register (service name, pod name, url);
+- a pod whose pool doesn't exist yet parks as "waiting" and is matched when
+  the pool registers (``try_match_pod_to_pool:386``);
+- ``POST /pool`` upserts the pool row and broadcasts the module metadata to
+  every connected pod of that service, then waits for per-pod acks;
+- pods report activity (requests served) which feeds the TTL reaper;
+- the reaper tears down services idle past their ``inactivity-ttl``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import re
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from aiohttp import WSMsgType, web
+
+from kubetorch_tpu.controller.db import Database
+from kubetorch_tpu.version import __version__, compatible
+
+
+def parse_ttl(ttl: Optional[str]) -> Optional[float]:
+    """'30m' / '2h' / '45s' / '1d' → seconds."""
+    if not ttl:
+        return None
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)([smhd]?)", str(ttl).strip())
+    if not m:
+        return None
+    value = float(m.group(1))
+    return value * {"": 1, "s": 1, "m": 60, "h": 3600, "d": 86400}[m.group(2)]
+
+
+class PodConnection:
+    def __init__(self, ws: web.WebSocketResponse, info: Dict[str, Any]):
+        self.ws = ws
+        self.pod_name = info.get("pod_name", "")
+        self.service_name = info.get("service_name", "")
+        self.url = info.get("url", "")
+        self.connected_at = time.time()
+        self.acks: Dict[str, asyncio.Future] = {}
+
+
+class PodHub:
+    """Connection manager (reference: ws_pods.py:47 PodConnectionManager)."""
+
+    def __init__(self):
+        # service -> {pod_name: PodConnection}; "" service = waiting pods
+        self.by_service: Dict[str, Dict[str, PodConnection]] = {}
+        self.waiting: Dict[str, PodConnection] = {}
+
+    def register(self, conn: PodConnection, pool_exists: bool):
+        if conn.service_name and pool_exists:
+            self.by_service.setdefault(conn.service_name, {})[
+                conn.pod_name] = conn
+        else:
+            self.waiting[conn.pod_name] = conn
+
+    def match_waiting(self, service_name: str) -> List[PodConnection]:
+        """Adopt parked pods when their pool appears (try_match_pod_to_pool)."""
+        matched = []
+        for pod_name, conn in list(self.waiting.items()):
+            if conn.service_name == service_name:
+                self.by_service.setdefault(service_name, {})[pod_name] = conn
+                del self.waiting[pod_name]
+                matched.append(conn)
+        return matched
+
+    def remove(self, conn: PodConnection):
+        self.waiting.pop(conn.pod_name, None)
+        pods = self.by_service.get(conn.service_name) or {}
+        pods.pop(conn.pod_name, None)
+
+    def pods_of(self, service_name: str) -> List[PodConnection]:
+        return list((self.by_service.get(service_name) or {}).values())
+
+    async def broadcast_metadata(
+        self, service_name: str, metadata: Dict[str, Any],
+        timeout: float = 120.0,
+    ) -> Dict[str, bool]:
+        """Push metadata/reload to every pod; resolve acks
+        (reference: ws_pods.py:176 broadcast_to_service)."""
+        pods = self.pods_of(service_name)
+        results: Dict[str, bool] = {}
+        loop = asyncio.get_running_loop()
+        futures = []
+        for conn in pods:
+            reload_id = uuid.uuid4().hex[:8]
+            fut = loop.create_future()
+            conn.acks[reload_id] = fut
+            try:
+                await conn.ws.send_json({
+                    "type": "metadata", "reload_id": reload_id,
+                    "metadata": metadata})
+                futures.append((conn, reload_id, fut))
+            except (ConnectionError, RuntimeError):
+                results[conn.pod_name] = False
+        for conn, reload_id, fut in futures:
+            try:
+                ok = await asyncio.wait_for(fut, timeout)
+                results[conn.pod_name] = bool(ok)
+            except asyncio.TimeoutError:
+                results[conn.pod_name] = False
+            finally:
+                conn.acks.pop(reload_id, None)
+        return results
+
+
+class ControllerServer:
+    def __init__(self, db_path: str = ":memory:",
+                 enable_reaper: bool = True,
+                 reaper_interval: float = 15.0):
+        self.db = Database(db_path)
+        self.hub = PodHub()
+        self.enable_reaper = enable_reaper
+        self.reaper_interval = reaper_interval
+        self._reaper_task: Optional[asyncio.Task] = None
+        self.auth_token = os.environ.get("KT_CONTROLLER_TOKEN") or None
+        self.cluster_config: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------- app
+    def build_app(self) -> web.Application:
+        middlewares = []
+        if self.auth_token:
+            middlewares.append(self._mw_auth)
+        app = web.Application(middlewares=middlewares,
+                              client_max_size=256 * 1024**2)
+        r = app.router
+        r.add_get("/health", self.h_health)
+        r.add_get("/config", self.h_config)
+        r.add_post("/pool", self.h_register_pool)
+        r.add_get("/pool/{service}", self.h_get_pool)
+        r.add_get("/pools", self.h_list_pools)
+        r.add_delete("/pool/{service}", self.h_teardown_pool)
+        r.add_post("/pool/{service}/activity", self.h_activity)
+        r.add_get("/ws/pods", self.h_ws_pods)
+        r.add_post("/runs", self.h_create_run)
+        r.add_get("/runs", self.h_list_runs)
+        r.add_get("/runs/{run_id}", self.h_get_run)
+        r.add_patch("/runs/{run_id}", self.h_update_run)
+        r.add_post("/runs/{run_id}/notes", self.h_add_note)
+        r.add_post("/runs/{run_id}/artifacts", self.h_add_artifact)
+        r.add_delete("/runs/{run_id}", self.h_delete_run)
+        r.add_post("/apply", self.h_apply)
+        r.add_post("/teardown/{service}", self.h_teardown_pool)
+        app.on_startup.append(self._on_startup)
+        app.on_shutdown.append(self._on_shutdown)
+        return app
+
+    async def _on_startup(self, app):
+        if self.enable_reaper:
+            self._reaper_task = asyncio.create_task(self._reaper_loop())
+
+    async def _on_shutdown(self, app):
+        if self._reaper_task:
+            self._reaper_task.cancel()
+
+    @web.middleware
+    async def _mw_auth(self, request: web.Request, handler):
+        if request.path == "/health":
+            return await handler(request)
+        token = request.headers.get("Authorization", "")
+        if token != f"Bearer {self.auth_token}":
+            return web.json_response({"error": "unauthorized"}, status=401)
+        return await handler(request)
+
+    # -------------------------------------------------------- handlers
+    async def h_health(self, request):
+        client_version = request.query.get("client_version")
+        ok = (compatible(client_version, __version__)
+              if client_version else True)
+        return web.json_response({
+            "status": "ok", "version": __version__,
+            "compatible": ok,
+            "pools": len(self.db.list_pools()),
+            "connected_pods": sum(
+                len(p) for p in self.hub.by_service.values()),
+            "waiting_pods": len(self.hub.waiting),
+        })
+
+    async def h_config(self, request):
+        """Cluster-level config layer (ConfigMap analog)."""
+        return web.json_response(self.cluster_config)
+
+    async def h_register_pool(self, request):
+        """The core deploy RPC (reference: routes/pool.py:39 register_pool)."""
+        body = await request.json()
+        service = body["service_name"]
+        pool = self.db.upsert_pool(
+            service,
+            namespace=body.get("namespace", "default"),
+            username=body.get("username"),
+            module_meta=body.get("module_meta") or {},
+            compute=body.get("compute") or {},
+            backend=body.get("backend", "local"),
+            launch_id=body.get("launch_id"),
+            inactivity_ttl=(body.get("compute") or {}).get("inactivity_ttl"),
+        )
+        self.hub.match_waiting(service)
+        acks = {}
+        if body.get("broadcast", True):
+            acks = await self.hub.broadcast_metadata(
+                service, body.get("module_meta") or {},
+                timeout=float(body.get("ack_timeout", 120.0)))
+        return web.json_response({"pool": pool, "acks": acks})
+
+    async def h_get_pool(self, request):
+        pool = self.db.get_pool(request.match_info["service"])
+        if pool is None:
+            raise web.HTTPNotFound(text="no such pool")
+        pool["pods"] = [
+            {"pod_name": c.pod_name, "url": c.url,
+             "connected_at": c.connected_at}
+            for c in self.hub.pods_of(pool["service_name"])]
+        return web.json_response(pool)
+
+    async def h_list_pools(self, request):
+        return web.json_response({"pools": self.db.list_pools()})
+
+    async def h_teardown_pool(self, request):
+        service = request.match_info["service"]
+        deleted = self.db.delete_pool(service)
+        # Cascading delete: backend resources (reference:
+        # helpers/delete_helpers.py).
+        try:
+            from kubetorch_tpu.provisioning.backend import get_backend
+
+            get_backend().teardown(service, quiet=True)
+        except Exception:
+            pass
+        for conn in self.hub.pods_of(service):
+            try:
+                await conn.ws.send_json({"type": "teardown"})
+            except (ConnectionError, RuntimeError):
+                pass
+        return web.json_response({"deleted": deleted})
+
+    async def h_activity(self, request):
+        self.db.touch_pool(request.match_info["service"])
+        return web.json_response({"ok": True})
+
+    # ------------------------------------------------------------- WS
+    async def h_ws_pods(self, request):
+        ws = web.WebSocketResponse(heartbeat=30.0)
+        await ws.prepare(request)
+        conn: Optional[PodConnection] = None
+        try:
+            async for msg in ws:
+                if msg.type != WSMsgType.TEXT:
+                    continue
+                data = json.loads(msg.data)
+                mtype = data.get("type")
+                if mtype == "register":
+                    conn = PodConnection(ws, data)
+                    pool = self.db.get_pool(conn.service_name)
+                    self.hub.register(conn, pool is not None)
+                    await ws.send_json({
+                        "type": "registered",
+                        "waiting": pool is None,
+                        "metadata": (pool or {}).get("module_meta"),
+                    })
+                elif mtype == "ack" and conn is not None:
+                    fut = conn.acks.get(data.get("reload_id", ""))
+                    if fut is not None and not fut.done():
+                        fut.set_result(data.get("ok", True))
+                elif mtype == "activity" and conn is not None:
+                    self.db.touch_pool(conn.service_name)
+        finally:
+            if conn is not None:
+                self.hub.remove(conn)
+        return ws
+
+    # ------------------------------------------------------------ runs
+    async def h_create_run(self, request):
+        body = await request.json()
+        run = self.db.create_run(
+            body["run_id"], command=body.get("command"),
+            workdir_key=body.get("workdir_key"), env=body.get("env"),
+            user=body.get("user"), status=body.get("status", "created"))
+        return web.json_response({"run": run})
+
+    async def h_list_runs(self, request):
+        return web.json_response({"runs": self.db.list_runs()})
+
+    async def h_get_run(self, request):
+        run = self.db.get_run(request.match_info["run_id"])
+        if run is None:
+            raise web.HTTPNotFound(text="no such run")
+        return web.json_response(run)
+
+    async def h_update_run(self, request):
+        body = await request.json()
+        run = self.db.update_run(request.match_info["run_id"], **body)
+        if run is None:
+            raise web.HTTPNotFound(text="no such run")
+        return web.json_response(run)
+
+    async def h_add_note(self, request):
+        body = await request.json()
+        run = self.db.append_run_item(
+            request.match_info["run_id"], "notes",
+            {"ts": time.time(), **body})
+        if run is None:
+            raise web.HTTPNotFound(text="no such run")
+        return web.json_response(run)
+
+    async def h_add_artifact(self, request):
+        body = await request.json()
+        run = self.db.append_run_item(
+            request.match_info["run_id"], "artifacts",
+            {"ts": time.time(), **body})
+        if run is None:
+            raise web.HTTPNotFound(text="no such run")
+        return web.json_response(run)
+
+    async def h_delete_run(self, request):
+        return web.json_response(
+            {"deleted": self.db.delete_run(request.match_info["run_id"])})
+
+    async def h_apply(self, request):
+        """Manifest apply passthrough (k8s backend only)."""
+        body = await request.json()
+        try:
+            from kubetorch_tpu.provisioning.k8s_client import K8sClient
+
+            client = K8sClient.from_env()
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: client.apply(body.get("manifest") or {}))
+            return web.json_response({"applied": result})
+        except Exception as exc:
+            return web.json_response(
+                {"error": f"{type(exc).__name__}: {exc}"}, status=501)
+
+    # ------------------------------------------------------------- TTL
+    async def _reaper_loop(self):
+        """Tear down services idle past their TTL (reference:
+        ttl_controller.py:49)."""
+        while True:
+            await asyncio.sleep(self.reaper_interval)
+            try:
+                now = time.time()
+                for pool in self.db.list_pools():
+                    ttl = parse_ttl(pool.get("inactivity_ttl"))
+                    if ttl is None:
+                        continue
+                    last = pool.get("last_active") or pool["created_at"]
+                    if now - last > ttl:
+                        service = pool["service_name"]
+                        self.db.delete_pool(service)
+                        try:
+                            from kubetorch_tpu.provisioning.backend import (
+                                get_backend,
+                            )
+
+                            get_backend().teardown(service, quiet=True)
+                        except Exception:
+                            pass
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                continue
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser(description="kubetorch_tpu controller")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=int(
+        os.environ.get("KT_CONTROLLER_PORT", "32320")))
+    parser.add_argument("--db", default=os.environ.get(
+        "KT_CONTROLLER_DB", str(os.path.expanduser("~/.ktpu/controller.db"))))
+    parser.add_argument("--reaper-interval", type=float, default=15.0)
+    args = parser.parse_args()
+    server = ControllerServer(args.db, reaper_interval=args.reaper_interval)
+    web.run_app(server.build_app(), host=args.host, port=args.port,
+                print=None, access_log=None)
+
+
+if __name__ == "__main__":
+    main()

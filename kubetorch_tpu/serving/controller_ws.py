@@ -1,0 +1,131 @@
+"""Pod-side controller WebSocket client.
+
+Reference: ``serving/http_server.py:206-502 ControllerWebSocket`` —
+registration (pod identity derived without the Downward API), metadata apply,
+push-based reload with acks, reconnect loop. Activated by the pod server when
+``KT_CONTROLLER_URL`` is set; pods that start before their pool exists park
+as "waiting" on the controller and receive metadata when it registers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import socket
+from typing import TYPE_CHECKING, Optional
+
+import aiohttp
+
+if TYPE_CHECKING:
+    from kubetorch_tpu.serving.server import PodServer
+
+
+class ControllerWebSocket:
+    def __init__(self, pod_server: "PodServer", controller_url: str):
+        self.pod_server = pod_server
+        self.controller_url = controller_url.rstrip("/")
+        ws_scheme = "wss" if self.controller_url.startswith("https") else "ws"
+        self.ws_url = (ws_scheme
+                       + self.controller_url[self.controller_url.index("://"):]
+                       + "/ws/pods")
+        self.pod_name = (os.environ.get("KT_POD_NAME")
+                         or f"{socket.gethostname()}-"
+                            f"{os.environ.get('KT_REPLICA_INDEX', '0')}")
+        self._task: Optional[asyncio.Task] = None
+        self._stop = asyncio.Event()
+        self.connected = False
+
+    def start(self):
+        self._task = asyncio.create_task(self._run())
+
+    async def stop(self):
+        self._stop.set()
+        if self._task:
+            self._task.cancel()
+
+    # ------------------------------------------------------------------
+    def _self_url(self) -> str:
+        host = os.environ.get("KT_POD_IP")
+        if not host:
+            try:
+                host = socket.gethostbyname(socket.gethostname())
+            except socket.gaierror:
+                host = "127.0.0.1"
+        port = os.environ.get("KT_SERVER_PORT", "32300")
+        return f"http://{host}:{port}"
+
+    async def _run(self):
+        """Reconnect loop (reference: _run:411)."""
+        backoff = 1.0
+        while not self._stop.is_set():
+            try:
+                async with aiohttp.ClientSession() as session:
+                    async with session.ws_connect(
+                            self.ws_url, heartbeat=30.0) as ws:
+                        self.connected = True
+                        backoff = 1.0
+                        await ws.send_json({
+                            "type": "register",
+                            "pod_name": self.pod_name,
+                            "service_name": self.pod_server.metadata.get(
+                                "service_name", ""),
+                            "url": self._self_url(),
+                        })
+                        await self._listen(ws)
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                pass
+            finally:
+                self.connected = False
+            await asyncio.sleep(min(backoff, 30.0))
+            backoff *= 2
+
+    async def _listen(self, ws: aiohttp.ClientWebSocketResponse):
+        async for msg in ws:
+            if msg.type != aiohttp.WSMsgType.TEXT:
+                break
+            data = json.loads(msg.data)
+            mtype = data.get("type")
+            if mtype == "registered":
+                metadata = data.get("metadata")
+                if metadata and not self.pod_server.ready:
+                    await self._apply_metadata(ws, metadata, reload_id="")
+            elif mtype == "metadata":
+                await self._apply_metadata(
+                    ws, data.get("metadata") or {},
+                    reload_id=data.get("reload_id", ""))
+            elif mtype == "teardown":
+                os._exit(0)
+
+    async def _apply_metadata(self, ws, metadata: dict, reload_id: str):
+        """Apply pushed metadata + reload the supervisor, then ack
+        (reference: _handle_reload:352 / _apply_metadata:254)."""
+        loop = asyncio.get_running_loop()
+        ok = True
+        try:
+            def do_apply():
+                server = self.pod_server
+                server.metadata.update(metadata)
+                if server.supervisor is None:
+                    server._setup_supervisor()
+                else:
+                    server.supervisor.reload(server.metadata)
+                    server.ready = True
+
+            await loop.run_in_executor(None, do_apply)
+        except Exception:
+            ok = False
+        if reload_id:
+            try:
+                await ws.send_json(
+                    {"type": "ack", "reload_id": reload_id, "ok": ok})
+            except (ConnectionError, RuntimeError):
+                pass
+
+    async def report_activity(self, ws):
+        try:
+            await ws.send_json({"type": "activity"})
+        except (ConnectionError, RuntimeError):
+            pass

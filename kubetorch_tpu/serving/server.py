@@ -88,6 +88,8 @@ class PodServer:
         }
         self.ready = False
         self.setup_error: Optional[str] = None
+        self.controller_ws = None
+        self._activity_task = None
 
     # ------------------------------------------------------------- app
     def build_app(self) -> web.Application:
@@ -115,6 +117,14 @@ class PodServer:
                 loop.add_signal_handler(sig, self._mark_terminating)
             except NotImplementedError:
                 pass
+        controller_url = os.environ.get("KT_CONTROLLER_URL")
+        if controller_url:
+            from kubetorch_tpu.serving.controller_ws import ControllerWebSocket
+
+            self.controller_ws = ControllerWebSocket(self, controller_url)
+            self.controller_ws.start()
+            self._activity_task = asyncio.create_task(
+                self._activity_loop(controller_url))
         if self.metadata.get("callable_type") == "app":
             await self._start_app_cmd()
             self.ready = True
@@ -136,13 +146,46 @@ class PodServer:
             self.ready = False
 
     async def _on_shutdown(self, app):
+        if getattr(self, "controller_ws", None) is not None:
+            await self.controller_ws.stop()
+        if getattr(self, "_activity_task", None) is not None:
+            self._activity_task.cancel()
         if self.supervisor is not None:
             self.supervisor.cleanup()
         if self.app_proc and self.app_proc.returncode is None:
             self.app_proc.terminate()
 
+    async def _activity_loop(self, controller_url: str):
+        """Push last-activity to the controller (metrics-push analog,
+        reference: serving/metrics_push.py:20 — feeds the TTL reaper)."""
+        service = self.metadata.get("service_name", "")
+        last_reported = 0.0
+        while True:
+            await asyncio.sleep(15.0)
+            ts = self.metrics["last_activity_timestamp"]
+            if ts <= last_reported:
+                continue
+            try:
+                import aiohttp as _aiohttp
+
+                async with ClientSession(
+                        timeout=_aiohttp.ClientTimeout(total=5.0)) as session:
+                    await session.post(
+                        f"{controller_url.rstrip('/')}/pool/{service}"
+                        f"/activity")
+                last_reported = ts
+            except Exception:
+                pass
+
     def _mark_terminating(self):
+        """SIGTERM: flag so in-flight requests get PodTerminatedError, then
+        exit after a short drain window (K8s will SIGKILL at grace-period end
+        regardless; reference: TerminationCheckMiddleware http_server.py:1184).
+        """
         self.terminating = True
+        loop = asyncio.get_event_loop()
+        loop.call_later(float(os.environ.get("KT_TERM_GRACE", "2.0")),
+                        os._exit, 0)
 
     async def _start_app_cmd(self):
         cmd = self.metadata.get("app_cmd")

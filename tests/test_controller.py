@@ -1,0 +1,193 @@
+"""Controller tests: pool registry, pod WS hub (waiting-pod adoption,
+push-reload acks), runs registry, TTL reaper.
+
+Reference coverage model: services/kubetorch_controller/tests/test_routes.py
+(SQLite + in-process app) — here with aiohttp's TestServer and real pod-server
+subprocesses for the WS protocol.
+"""
+
+import asyncio
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import httpx
+import pytest
+
+from kubetorch_tpu.controller.client import ControllerClient
+from kubetorch_tpu.controller.server import ControllerServer, parse_ttl
+
+ASSETS = Path(__file__).parent / "assets" / "summer"
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def controller(tmp_path_factory):
+    port = _free_port()
+    env = {**os.environ, "KT_CONTROLLER_DB": ":memory:"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.controller.server",
+         "--host", "127.0.0.1", "--port", str(port), "--db", ":memory:",
+         "--reaper-interval", "1.0"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    url = f"http://127.0.0.1:{port}"
+    for _ in range(100):
+        try:
+            if httpx.get(f"{url}/health", timeout=2.0).status_code == 200:
+                break
+        except httpx.HTTPError:
+            time.sleep(0.2)
+    else:
+        proc.kill()
+        raise RuntimeError("controller did not start")
+    yield url
+    proc.terminate()
+    proc.wait(5)
+
+
+@pytest.fixture
+def client(controller):
+    return ControllerClient(controller)
+
+
+def test_parse_ttl():
+    assert parse_ttl("30m") == 1800
+    assert parse_ttl("2h") == 7200
+    assert parse_ttl("45s") == 45
+    assert parse_ttl("90") == 90
+    assert parse_ttl(None) is None
+    assert parse_ttl("bogus") is None
+
+
+@pytest.mark.level("minimal")
+def test_health_and_version(client):
+    health = client.health()
+    assert health["status"] == "ok"
+    assert health["compatible"] is True
+
+
+@pytest.mark.level("minimal")
+def test_pool_register_get_list_teardown(client):
+    meta = {"import_path": "summer", "name": "summer", "callable_type": "fn"}
+    result = client.register_pool("svc-a", meta, compute={"cpus": "0.1"})
+    assert result["pool"]["service_name"] == "svc-a"
+    assert result["acks"] == {}  # no pods connected yet
+    pool = client.get_pool("svc-a")
+    assert pool["module_meta"]["name"] == "summer"
+    names = [p["service_name"] for p in client.list_pools()]
+    assert "svc-a" in names
+    assert client.teardown("svc-a") is True
+    assert client.get_pool("svc-a") is None
+
+
+@pytest.mark.level("minimal")
+def test_runs_registry(client):
+    client.create_run("run-xyz", command="python train.py",
+                      env={"A": "1"}, user="tester")
+    client.update_run("run-xyz", status="running")
+    client.add_note("run-xyz", "epoch 1 done", loss=0.5)
+    client.add_artifact("run-xyz", "kt://runs/run-xyz/artifacts/model")
+    run = client.get_run("run-xyz")
+    assert run["status"] == "running"
+    assert run["notes"][0]["text"] == "epoch 1 done"
+    assert run["artifacts"][0]["ref"].startswith("kt://")
+    assert any(r["run_id"] == "run-xyz" for r in client.list_runs())
+    assert client.delete_run("run-xyz") is True
+
+
+@pytest.mark.level("minimal")
+def test_pod_ws_register_push_reload_and_ack(controller, client, tmp_path):
+    """The hard-part protocol: pod connects BEFORE its pool exists (waits),
+    pool registration pushes metadata, pod loads callable and acks."""
+    port = _free_port()
+    env = {
+        **os.environ,
+        "KT_SERVICE_NAME": "ws-svc",
+        "KT_SERVER_PORT": str(port),
+        "KT_CONTROLLER_URL": controller,
+        "KT_POD_NAME": "ws-svc-pod-0",
+        "PYTHONPATH": str(Path(__file__).resolve().parents[1]),
+        # note: NO callable metadata in env — it must arrive via WS push
+    }
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.serving.server",
+         "--host", "127.0.0.1", "--port", str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        url = f"http://127.0.0.1:{port}"
+        for _ in range(100):
+            try:
+                if httpx.get(f"{url}/health", timeout=2.0).status_code == 200:
+                    break
+            except httpx.HTTPError:
+                time.sleep(0.2)
+        # pod should appear as waiting on the controller
+        for _ in range(150):
+            health = client.health()
+            if health["waiting_pods"] >= 1:
+                break
+            time.sleep(0.2)
+        assert client.health()["waiting_pods"] >= 1
+
+        # register the pool -> metadata pushed -> pod loads callable -> ack
+        meta = {
+            "service_name": "ws-svc",
+            "root_path": str(ASSETS),
+            "import_path": "summer",
+            "name": "summer",
+            "callable_type": "fn",
+            "num_procs": 1,
+            "allowed_serialization": ["json", "pickle"],
+        }
+        result = client.register_pool("ws-svc", meta, ack_timeout=60.0)
+        assert result["acks"] == {"ws-svc-pod-0": True}
+
+        # pod now serves the callable end-to-end
+        from kubetorch_tpu.serving.http_client import call_method
+
+        assert call_method(url, "summer", args=(3, 4)) == 7
+
+        # reload push with changed metadata also acks
+        result = client.register_pool("ws-svc", meta, ack_timeout=60.0)
+        assert result["acks"]["ws-svc-pod-0"] is True
+
+        pool = client.get_pool("ws-svc")
+        assert pool["pods"][0]["pod_name"] == "ws-svc-pod-0"
+    finally:
+        proc.terminate()
+        proc.wait(5)
+        client.teardown("ws-svc")
+
+
+@pytest.mark.level("minimal")
+def test_ttl_reaper_removes_idle_pool(client):
+    client.register_pool("ttl-svc", {"name": "x"},
+                         compute={"inactivity_ttl": "1s"})
+    assert client.get_pool("ttl-svc") is not None
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if client.get_pool("ttl-svc") is None:
+            break
+        time.sleep(0.5)
+    assert client.get_pool("ttl-svc") is None, "reaper did not fire"
+
+
+@pytest.mark.level("minimal")
+def test_activity_defers_ttl(client):
+    client.register_pool("busy-svc", {"name": "x"},
+                         compute={"inactivity_ttl": "3s"})
+    # keep it active past one TTL window
+    for _ in range(4):
+        client.report_activity("busy-svc")
+        time.sleep(1.0)
+    assert client.get_pool("busy-svc") is not None
+    client.teardown("busy-svc")
