@@ -1,0 +1,245 @@
+"""K8s deployment backend: manifests → cluster, with rich failure surfacing.
+
+Same interface as ``LocalBackend`` (provisioning/backend.py). Launch applies
+the manifest set (directly with cluster credentials, or through the
+controller's /apply when configured), registers the pool with the controller,
+and polls readiness extracting typed failures from pod status — the local
+analog of the reference's ``check_service_ready`` event extraction
+(``provisioning/service_manager.py:682``; exceptions
+``resources/compute/utils.py:57-130``).
+
+URL resolution: in-cluster → service DNS; outside → ``KT_INSTALL_URL``
+ingress prefix (laptop path; the reference shells out to kubectl
+port-forward, which this image doesn't have — an ingress/gateway URL is the
+supported remote path).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from kubetorch_tpu.config import get_config
+from kubetorch_tpu.exceptions import (
+    ImagePullError,
+    PodContainerError,
+    ServiceTimeoutError,
+)
+from kubetorch_tpu.provisioning.k8s_client import K8sClient
+from kubetorch_tpu.provisioning.manifests import (
+    SERVER_PORT,
+    build_manifests,
+)
+from kubetorch_tpu.resources.compute.compute import Compute
+from kubetorch_tpu.serving import http_client
+
+
+class K8sBackend:
+    name = "k8s"
+
+    def __init__(self, client: Optional[K8sClient] = None):
+        self._client = client
+
+    @property
+    def client(self) -> K8sClient:
+        if self._client is None:
+            self._client = K8sClient.from_env()
+        return self._client
+
+    def _controller(self):
+        from kubetorch_tpu.controller.client import ControllerClient
+
+        return ControllerClient.maybe()
+
+    # ------------------------------------------------------------------
+    def launch(
+        self,
+        service_name: str,
+        *,
+        module_env: Dict[str, str],
+        compute_dict: Dict[str, Any],
+        module_meta: Dict[str, Any],
+        num_pods: int = 1,
+        launch_timeout: int = 600,
+        launch_id: str = "",
+    ) -> Dict[str, Any]:
+        compute = Compute.from_dict(compute_dict)
+        env = {**module_env, "KT_LAUNCH_ID": launch_id}
+        controller = self._controller()
+        if controller is not None:
+            env["KT_CONTROLLER_URL"] = controller.base_url
+        manifests = build_manifests(service_name, compute, env)
+        for manifest in manifests:
+            if controller is not None:
+                controller.apply(manifest)
+            else:
+                self.client.apply(manifest)
+        if controller is not None:
+            controller.register_pool(
+                service_name, module_meta, compute=compute_dict,
+                launch_id=launch_id, broadcast=False)
+        self._wait_ready(service_name, compute, launch_timeout, launch_id)
+        return {
+            "service_name": service_name,
+            "backend": "k8s",
+            "namespace": compute.namespace,
+            "module_meta": module_meta,
+            "compute": compute_dict,
+        }
+
+    # ------------------------------------------------------------------
+    def _pods(self, service_name: str,
+              namespace: Optional[str] = None) -> List[Dict[str, Any]]:
+        return self.client.list(
+            "Pod", namespace,
+            label_selector=f"kubetorch.com/service={service_name}")
+
+    def _extract_pod_failure(self, pod: Dict[str, Any]):
+        """Typed launch failures from container statuses."""
+        statuses = (pod.get("status", {}).get("containerStatuses") or [])
+        for status in statuses:
+            waiting = (status.get("state") or {}).get("waiting") or {}
+            reason = waiting.get("reason", "")
+            message = waiting.get("message", "")
+            if reason in ("ErrImagePull", "ImagePullBackOff",
+                          "InvalidImageName"):
+                raise ImagePullError(
+                    f"pod {pod['metadata']['name']}: {reason}: {message}")
+            if reason in ("CrashLoopBackOff", "CreateContainerError",
+                          "RunContainerError"):
+                logs = self.client.pod_logs(
+                    pod["metadata"]["name"],
+                    pod["metadata"].get("namespace"))
+                raise PodContainerError(
+                    f"pod {pod['metadata']['name']}: {reason}: {message}\n"
+                    f"--- logs ---\n{logs[-2000:]}")
+
+    def _wait_ready(self, service_name: str, compute: Compute,
+                    timeout: int, launch_id: str):
+        deadline = time.time() + timeout
+        want = compute.num_pods
+        while time.time() < deadline:
+            pods = self._pods(service_name, compute.namespace)
+            ready = 0
+            for pod in pods:
+                self._extract_pod_failure(pod)
+                conditions = pod.get("status", {}).get("conditions") or []
+                if any(c.get("type") == "Ready" and c.get("status") == "True"
+                       for c in conditions):
+                    ready += 1
+            if ready >= want:
+                return
+            time.sleep(2.0)
+        pods = self._pods(service_name, compute.namespace)
+        phases = {p["metadata"]["name"]: p.get("status", {}).get("phase")
+                  for p in pods}
+        raise ServiceTimeoutError(
+            f"{service_name}: {len(phases)} pods, not all Ready after "
+            f"{timeout}s: {json.dumps(phases)}")
+
+    # ------------------------------------------------------------------
+    def lookup(self, service_name: str) -> Optional[Dict[str, Any]]:
+        controller = self._controller()
+        if controller is not None:
+            pool = controller.get_pool(service_name)
+            if pool:
+                return {
+                    "service_name": service_name,
+                    "backend": "k8s",
+                    "namespace": pool.get("namespace", "default"),
+                    "module_meta": pool.get("module_meta", {}),
+                    "compute": pool.get("compute", {}),
+                }
+        svc = self.client.get("Service", service_name)
+        if svc is None:
+            return None
+        return {"service_name": service_name, "backend": "k8s",
+                "namespace": svc["metadata"]["namespace"],
+                "module_meta": {}, "compute": {}}
+
+    def list_services(self) -> List[Dict[str, Any]]:
+        controller = self._controller()
+        if controller is not None:
+            return controller.list_pools()
+        services = self.client.list(
+            "Service", label_selector="kubetorch.com/managed=true")
+        return [{"service_name": s["metadata"]["name"],
+                 "namespace": s["metadata"]["namespace"]} for s in services]
+
+    def service_url(self, service_name: str, namespace: str = "") -> str:
+        namespace = namespace or get_config().namespace
+        install_url = get_config().install_url
+        from kubetorch_tpu.serving.utils_net import in_kubernetes
+
+        if in_kubernetes():
+            return (f"http://{service_name}.{namespace}.svc.cluster.local:"
+                    f"{SERVER_PORT}")
+        if install_url:
+            return f"{install_url.rstrip('/')}/{namespace}/{service_name}"
+        raise RuntimeError(
+            "outside the cluster and no KT_INSTALL_URL ingress configured")
+
+    def pod_urls(self, service_name: str) -> List[str]:
+        pods = self._pods(service_name)
+        urls = []
+        for pod in pods:
+            ip = pod.get("status", {}).get("podIP")
+            if ip:
+                urls.append(f"http://{ip}:{SERVER_PORT}")
+        return urls or [self.service_url(service_name)]
+
+    def reload(self, service_name: str, metadata: Dict[str, Any]):
+        controller = self._controller()
+        if controller is not None:
+            result = controller.register_pool(
+                service_name, metadata, broadcast=True)
+            failed = [p for p, ok in result.get("acks", {}).items() if not ok]
+            if failed:
+                raise PodContainerError(
+                    f"reload not acked by pods: {failed}")
+            return
+        for url in self.pod_urls(service_name):
+            http_client.sync_client().post(
+                f"{url}/_reload", json=metadata, timeout=300.0)
+
+    def teardown(self, service_name: str, quiet: bool = False) -> bool:
+        found = False
+        for kind in ("Deployment", "JobSet"):
+            manifest = {"apiVersion": {"Deployment": "apps/v1",
+                                       "JobSet": "jobset.x-k8s.io/v1alpha2"}[kind],
+                        "kind": kind, "metadata": {"name": service_name}}
+            try:
+                found |= self.client.delete(manifest, service_name)
+            except Exception:
+                pass
+        for svc in (service_name, f"{service_name}-headless"):
+            try:
+                found |= self.client.delete("Service", svc)
+            except Exception:
+                pass
+        controller = self._controller()
+        if controller is not None:
+            try:
+                controller.teardown(service_name)
+            except Exception:
+                pass
+        if not found and not quiet:
+            raise KeyError(f"no k8s service {service_name!r}")
+        return found
+
+    def logs(self, service_name: str, pod_index: Optional[int] = None,
+             tail: int = 200) -> str:
+        chunks = []
+        for i, pod in enumerate(self._pods(service_name)):
+            if pod_index is not None and i != pod_index:
+                continue
+            name = pod["metadata"]["name"]
+            chunks.append(f"=== {name} ===\n" + self.client.pod_logs(
+                name, pod["metadata"].get("namespace"), tail))
+        return "\n".join(chunks)
+
+    def is_up(self, service_name: str) -> bool:
+        pods = self._pods(service_name)
+        return any(p.get("status", {}).get("phase") == "Running"
+                   for p in pods)

@@ -1,0 +1,304 @@
+"""Pure manifest builders: Compute → K8s objects.
+
+Reference: ``provisioning/utils.py`` (``build_deployment_manifest:431``,
+``build_knative_manifest:489``) + the RESOURCE_CONFIGS kind table
+(``:301-384``). TPU-first differences:
+
+- multi-host TPU slices render as a **JobSet** (stable per-host identity +
+  gang semantics — the ``jobset``/``tpu-slice`` kind SURVEY.md §7 hard-part 6
+  calls for) with one pod per TPU VM host, ``TPU_WORKER_HOSTNAMES`` injected,
+  and a headless service for slice DNS;
+- Kueue gang admission sizes the gang to whole slices
+  (``kueue.x-k8s.io/queue-name`` label + ``suspend`` semantics);
+- everything is data-in/data-out: no cluster client here, so all builders are
+  unit-testable without K8s.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from kubetorch_tpu.resources.compute.compute import (
+    KUEUE_QUEUE_LABEL,
+    Compute,
+)
+
+SERVER_PORT = 32300
+DEFAULT_SERVER_CMD = ["python", "-m", "kubetorch_tpu.serving.server"]
+
+
+# --------------------------------------------------------------------------
+# kind table (reference: RESOURCE_CONFIGS, provisioning/utils.py:301)
+# --------------------------------------------------------------------------
+RESOURCE_CONFIGS: Dict[str, Dict[str, Any]] = {
+    "deployment": {
+        "api_version": "apps/v1",
+        "kind": "Deployment",
+        "plural": "deployments",
+        "pod_template_path": ("spec", "template"),
+        "replica_path": ("spec", "replicas"),
+        "routing": "service",
+    },
+    "jobset": {
+        "api_version": "jobset.x-k8s.io/v1alpha2",
+        "kind": "JobSet",
+        "plural": "jobsets",
+        "pod_template_path": (
+            "spec", "replicatedJobs", 0, "template", "spec", "template"),
+        "replica_path": (
+            "spec", "replicatedJobs", 0, "template", "spec", "parallelism"),
+        "routing": "headless",
+    },
+    "knative": {
+        "api_version": "serving.knative.dev/v1",
+        "kind": "Service",
+        "plural": "services",
+        "pod_template_path": ("spec", "template"),
+        "replica_path": None,
+        "routing": "knative",
+    },
+    "raycluster": {
+        "api_version": "ray.io/v1",
+        "kind": "RayCluster",
+        "plural": "rayclusters",
+        "pod_template_path": ("spec", "headGroupSpec", "template"),
+        "replica_path": ("spec", "workerGroupSpecs", 0, "replicas"),
+        "routing": "head",
+    },
+    "selector": {  # BYO pods: route only, create nothing
+        "api_version": None,
+        "kind": None,
+        "plural": None,
+        "pod_template_path": None,
+        "replica_path": None,
+        "routing": "service",
+    },
+}
+
+
+def navigate_path(obj: Any, path: tuple, default: Any = None) -> Any:
+    """Walk a mixed dict/list path (reference: compute/utils.py:18)."""
+    for part in path:
+        try:
+            obj = obj[part]
+        except (KeyError, IndexError, TypeError):
+            return default
+    return obj
+
+
+# --------------------------------------------------------------------------
+# pod template
+# --------------------------------------------------------------------------
+
+def build_pod_template(
+    service_name: str,
+    compute: Compute,
+    env: Optional[Dict[str, str]] = None,
+    command: Optional[List[str]] = None,
+) -> Dict[str, Any]:
+    """The shared pod spec every kind embeds."""
+    env = {**compute.env, **(env or {})}
+    env.setdefault("KT_SERVICE_NAME", service_name)
+    env.setdefault("KT_SERVER_PORT", str(SERVER_PORT))
+    env_list = [{"name": k, "value": str(v)} for k, v in sorted(env.items())]
+    # Downward-API-free pod identity (reference: http_server.py:146-185
+    # derives identity without it; we inject the cheap fields anyway).
+    env_list += [
+        {"name": "KT_POD_NAME", "valueFrom": {
+            "fieldRef": {"fieldPath": "metadata.name"}}},
+        {"name": "KT_POD_IP", "valueFrom": {
+            "fieldRef": {"fieldPath": "status.podIP"}}},
+    ]
+    for secret in compute.secrets:
+        env_list += secret.pod_env()
+
+    container: Dict[str, Any] = {
+        "name": "kubetorch",
+        "image": compute.image.image_id,
+        "command": command or DEFAULT_SERVER_CMD,
+        "ports": [{"containerPort": SERVER_PORT, "name": "kt-server"}],
+        "env": env_list,
+        "resources": compute.pod_resources(),
+        "readinessProbe": {
+            "httpGet": {"path": "/ready", "port": SERVER_PORT},
+            "initialDelaySeconds": 2, "periodSeconds": 3,
+        },
+    }
+    if compute.volumes:
+        container["volumeMounts"] = [v.pod_mount() for v in compute.volumes]
+
+    spec: Dict[str, Any] = {"containers": [container]}
+    selectors = compute.all_node_selectors()
+    if selectors:
+        spec["nodeSelector"] = selectors
+    if compute.tolerations:
+        spec["tolerations"] = compute.tolerations
+    if compute.tpu_spec:
+        spec.setdefault("tolerations", []).append({
+            "key": "google.com/tpu", "operator": "Exists",
+            "effect": "NoSchedule"})
+    if compute.priority_class:
+        spec["priorityClassName"] = compute.priority_class
+    if compute.service_account:
+        spec["serviceAccountName"] = compute.service_account
+    if compute.volumes:
+        spec["volumes"] = [v.pod_volume() for v in compute.volumes]
+
+    return {
+        "metadata": {
+            "labels": compute.workload_labels(service_name),
+            "annotations": compute.workload_annotations(),
+        },
+        "spec": spec,
+    }
+
+
+# --------------------------------------------------------------------------
+# kind builders
+# --------------------------------------------------------------------------
+
+def build_deployment_manifest(
+    service_name: str, compute: Compute,
+    env: Optional[Dict[str, str]] = None,
+) -> Dict[str, Any]:
+    template = build_pod_template(service_name, compute, env)
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": service_name,
+            "namespace": compute.namespace,
+            "labels": compute.workload_labels(service_name),
+            "annotations": compute.workload_annotations(),
+        },
+        "spec": {
+            "replicas": compute.num_pods,
+            "selector": {"matchLabels": {
+                "kubetorch.com/service": service_name}},
+            "template": template,
+        },
+    }
+
+
+def build_jobset_manifest(
+    service_name: str, compute: Compute,
+    env: Optional[Dict[str, str]] = None,
+) -> Dict[str, Any]:
+    """Multi-host TPU slice: one JobSet, ``workers`` replicated jobs (one per
+    slice), each with parallelism = hosts-per-slice and TPU gang env."""
+    tpu = compute.tpu_spec
+    workers = compute.distributed.workers if compute.distributed else 1
+    hosts = tpu.num_hosts if tpu else 1
+    env = dict(env or {})
+    if tpu:
+        env.setdefault(
+            "TPU_WORKER_HOSTNAMES",
+            ",".join(tpu.worker_hostnames(service_name, compute.namespace)))
+    template = build_pod_template(service_name, compute, env)
+    template["spec"]["subdomain"] = f"{service_name}-headless"
+    job_spec: Dict[str, Any] = {
+        "parallelism": hosts,
+        "completions": hosts,
+        "backoffLimit": 0,
+        "template": template,
+    }
+    manifest: Dict[str, Any] = {
+        "apiVersion": "jobset.x-k8s.io/v1alpha2",
+        "kind": "JobSet",
+        "metadata": {
+            "name": service_name,
+            "namespace": compute.namespace,
+            "labels": compute.workload_labels(service_name),
+            "annotations": compute.workload_annotations(),
+        },
+        "spec": {
+            "replicatedJobs": [{
+                "name": "workers",
+                "replicas": workers,
+                "template": {"spec": job_spec},
+            }],
+        },
+    }
+    if compute.queue_name:
+        # Kueue admits the whole JobSet as one gang sized in slices.
+        manifest["metadata"]["labels"][KUEUE_QUEUE_LABEL] = compute.queue_name
+        manifest["spec"]["suspend"] = True
+    return manifest
+
+
+def build_knative_manifest(
+    service_name: str, compute: Compute,
+    env: Optional[Dict[str, str]] = None,
+) -> Dict[str, Any]:
+    template = build_pod_template(service_name, compute, env)
+    annotations = dict(template["metadata"].get("annotations") or {})
+    if compute.autoscaling is not None:
+        annotations.update(compute.autoscaling.to_annotations())
+    template["metadata"]["annotations"] = annotations
+    if (compute.autoscaling is not None
+            and compute.autoscaling.container_concurrency):
+        template["spec"]["containerConcurrency"] = (
+            compute.autoscaling.container_concurrency)
+    return {
+        "apiVersion": "serving.knative.dev/v1",
+        "kind": "Service",
+        "metadata": {
+            "name": service_name,
+            "namespace": compute.namespace,
+            "labels": compute.workload_labels(service_name),
+        },
+        "spec": {"template": template},
+    }
+
+
+def build_service_manifest(
+    service_name: str, compute: Compute, headless: bool = False,
+    selector: Optional[Dict[str, str]] = None,
+) -> Dict[str, Any]:
+    name = f"{service_name}-headless" if headless else service_name
+    spec: Dict[str, Any] = {
+        "selector": selector or {"kubetorch.com/service": service_name},
+        "ports": [{"name": "kt-server", "port": SERVER_PORT,
+                   "targetPort": SERVER_PORT}],
+    }
+    if headless:
+        spec["clusterIP"] = "None"
+        spec["publishNotReadyAddresses"] = True  # quorum sees starting pods
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": name,
+            "namespace": compute.namespace,
+            "labels": compute.workload_labels(service_name),
+        },
+        "spec": spec,
+    }
+
+
+def build_manifests(
+    service_name: str, compute: Compute,
+    env: Optional[Dict[str, str]] = None,
+) -> List[Dict[str, Any]]:
+    """Everything to apply for this Compute, in order."""
+    mode = compute.deployment_mode
+    out: List[Dict[str, Any]] = []
+    for volume in compute.volumes:
+        out.append(volume.to_pvc_manifest(compute.namespace))
+    for secret in compute.secrets:
+        out.append(secret.to_manifest(compute.namespace))
+    if mode == "deployment":
+        out.append(build_deployment_manifest(service_name, compute, env))
+    elif mode == "jobset":
+        out.append(build_jobset_manifest(service_name, compute, env))
+    elif mode == "knative":
+        out.append(build_knative_manifest(service_name, compute, env))
+    else:
+        raise ValueError(f"unknown deployment mode {mode!r}")
+    if mode != "knative":
+        out.append(build_service_manifest(service_name, compute))
+        if compute.distributed is not None or (
+                compute.tpu_spec and compute.tpu_spec.multi_host):
+            out.append(build_service_manifest(
+                service_name, compute, headless=True))
+    return out

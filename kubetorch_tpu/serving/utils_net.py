@@ -1,0 +1,12 @@
+"""Small networking/environment helpers (reference: serving/utils.py
+``is_running_in_kubernetes``)."""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def in_kubernetes() -> bool:
+    return (Path("/var/run/secrets/kubernetes.io/serviceaccount").exists()
+            or bool(os.environ.get("KUBERNETES_SERVICE_HOST")))

@@ -1,0 +1,148 @@
+"""Manifest builder + TPU topology unit tests (pure data, no cluster)."""
+
+import pytest
+
+import kubetorch_tpu as kt
+from kubetorch_tpu.provisioning.manifests import (
+    RESOURCE_CONFIGS,
+    build_deployment_manifest,
+    build_jobset_manifest,
+    build_knative_manifest,
+    build_manifests,
+    build_service_manifest,
+    navigate_path,
+)
+from kubetorch_tpu.resources.compute.topology import parse_tpus
+
+
+# ---------------------------------------------------------------- topology
+def test_parse_tpus_v5e():
+    spec = parse_tpus("v5e-8")
+    assert spec.num_hosts == 2
+    assert spec.chips_per_pod == 4
+    assert spec.topology == "2x4"
+    assert spec.multi_host
+    assert spec.node_selectors() == {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x4",
+    }
+    assert spec.resource_limits() == {"google.com/tpu": "4"}
+
+
+def test_parse_tpus_single_host_and_aliases():
+    assert not parse_tpus("v5e-4").multi_host
+    assert parse_tpus("v5litepod-8").generation == "v5e"
+    assert parse_tpus("v5e-64").num_hosts == 16
+    assert parse_tpus("v6e-16").topology == "4x4"
+    spec = parse_tpus("v4-32")
+    assert spec.topology.count("x") == 2  # 3D
+    with pytest.raises(ValueError):
+        parse_tpus("v5e-7")
+    with pytest.raises(ValueError):
+        parse_tpus("h100-8")
+
+
+def test_worker_hostnames():
+    spec = parse_tpus("v5e-16")
+    hosts = spec.worker_hostnames("train", "ml")
+    assert len(hosts) == 4
+    assert hosts[0] == "train-0.train-headless.ml.svc.cluster.local"
+
+
+# ---------------------------------------------------------------- manifests
+def test_deployment_manifest_shape():
+    compute = kt.Compute(cpus="0.5", memory="512Mi",
+                         env={"FOO": "bar"}, inactivity_ttl="30m")
+    m = build_deployment_manifest("svc", compute)
+    assert m["kind"] == "Deployment"
+    assert m["spec"]["replicas"] == 1
+    container = m["spec"]["template"]["spec"]["containers"][0]
+    assert {"name": "FOO", "value": "bar"} in container["env"]
+    assert container["resources"]["requests"] == {
+        "cpu": "0.5", "memory": "512Mi"}
+    assert m["metadata"]["annotations"][
+        "kubetorch.com/inactivity-ttl"] == "30m"
+    assert container["readinessProbe"]["httpGet"]["path"] == "/ready"
+
+
+def test_tpu_jobset_manifest():
+    compute = kt.Compute(tpus="v5e-16", queue_name="tpu-queue").distribute(
+        "jax", workers=2)
+    assert compute.deployment_mode == "jobset"
+    m = build_jobset_manifest("train", compute)
+    job = m["spec"]["replicatedJobs"][0]
+    assert job["replicas"] == 2                      # 2 slices
+    assert job["template"]["spec"]["parallelism"] == 4   # 4 hosts/slice
+    pod_spec = job["template"]["spec"]["template"]["spec"]
+    container = pod_spec["containers"][0]
+    assert container["resources"]["limits"] == {"google.com/tpu": "4"}
+    assert pod_spec["nodeSelector"][
+        "cloud.google.com/gke-tpu-topology"] == "4x4"
+    env = {e["name"]: e.get("value") for e in container["env"]}
+    assert "train-0.train-headless" in env["TPU_WORKER_HOSTNAMES"]
+    # Kueue gang admission
+    assert m["metadata"]["labels"]["kueue.x-k8s.io/queue-name"] == "tpu-queue"
+    assert m["spec"]["suspend"] is True
+    # TPU toleration present
+    assert any(t.get("key") == "google.com/tpu"
+               for t in pod_spec["tolerations"])
+
+
+def test_knative_manifest_with_autoscaling():
+    compute = kt.Compute(cpus="1").autoscale(
+        target=10, metric="concurrency", min_scale=0, max_scale=8,
+        window="60s")
+    assert compute.deployment_mode == "knative"
+    m = build_knative_manifest("infer", compute)
+    ann = m["spec"]["template"]["metadata"]["annotations"]
+    assert ann["autoscaling.knative.dev/target"] == "10"
+    assert ann["autoscaling.knative.dev/max-scale"] == "8"
+    assert ann["autoscaling.knative.dev/class"] == (
+        "kpa.autoscaling.knative.dev")
+
+
+def test_headless_service_for_distributed():
+    compute = kt.Compute(cpus="0.5").distribute("jax", workers=4)
+    manifests = build_manifests("train", compute)
+    kinds = [(m["kind"], m["metadata"]["name"]) for m in manifests]
+    assert ("Deployment", "train") in kinds
+    assert ("Service", "train") in kinds
+    assert ("Service", "train-headless") in kinds
+    headless = next(m for m in manifests
+                    if m["metadata"]["name"] == "train-headless")
+    assert headless["spec"]["clusterIP"] == "None"
+    assert headless["spec"]["publishNotReadyAddresses"] is True
+
+
+def test_volumes_and_secrets_in_manifest_set():
+    vol = kt.Volume(name="ckpts", size="50Gi")
+    secret = kt.Secret(name="tok", values={"HF_TOKEN": "x"})
+    compute = kt.Compute(cpus="1", volumes=[vol], secrets=[secret])
+    manifests = build_manifests("svc", compute)
+    kinds = [m["kind"] for m in manifests]
+    assert "PersistentVolumeClaim" in kinds
+    assert "Secret" in kinds
+    deploy = next(m for m in manifests if m["kind"] == "Deployment")
+    spec = deploy["spec"]["template"]["spec"]
+    assert spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == "ckpts"
+    container = spec["containers"][0]
+    assert container["volumeMounts"][0]["mountPath"] == "/ktfs/ckpts"
+    assert any(e.get("valueFrom", {}).get("secretKeyRef", {}).get("name")
+               == "tok" for e in container["env"])
+
+
+def test_navigate_path_and_kind_table():
+    compute = kt.Compute(cpus="1")
+    m = build_deployment_manifest("svc", compute)
+    cfg = RESOURCE_CONFIGS["deployment"]
+    template = navigate_path(m, cfg["pod_template_path"])
+    assert template["spec"]["containers"][0]["name"] == "kubetorch"
+    assert navigate_path(m, cfg["replica_path"]) == 1
+    assert RESOURCE_CONFIGS["jobset"]["routing"] == "headless"
+
+
+def test_service_manifest():
+    compute = kt.Compute(cpus="1")
+    svc = build_service_manifest("svc", compute)
+    assert svc["spec"]["selector"] == {"kubetorch.com/service": "svc"}
+    assert svc["spec"]["ports"][0]["port"] == 32300
