@@ -1,0 +1,358 @@
+"""``ktpu`` CLI (reference: ``python_client/kubetorch/cli.py`` — the `kt`
+typer app with check/config/deploy/call/list/logs/run/runs/teardown/
+put/get/ls/rm/secrets/volumes + hidden server commands). Built on click.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+import click
+
+from kubetorch_tpu.version import __version__
+
+
+@click.group()
+@click.version_option(__version__)
+def main():
+    """kubetorch_tpu — TPU-native Kubernetes ML compute orchestrator."""
+
+
+# ---------------------------------------------------------------- check
+@main.command()
+def check():
+    """Doctor: verify config, backend, controller, store, and TPU access."""
+    from kubetorch_tpu.config import get_config
+
+    cfg = get_config()
+    click.echo(f"kubetorch_tpu {__version__}")
+    click.echo(f"  backend: {cfg.backend}")
+    click.echo(f"  username: {cfg.username}  namespace: {cfg.namespace}")
+
+    if cfg.backend == "k8s":
+        from kubetorch_tpu.provisioning.k8s_client import K8sClient
+
+        ok = K8sClient.has_credentials()
+        click.echo(f"  k8s credentials: {'ok' if ok else 'MISSING'}")
+    controller_url = os.environ.get("KT_CONTROLLER_URL") or cfg.controller_url
+    if controller_url:
+        try:
+            from kubetorch_tpu.controller.client import ControllerClient
+
+            health = ControllerClient(controller_url).health()
+            click.echo(f"  controller: ok (v{health['version']}, "
+                       f"{health['pools']} pools)")
+        except Exception as exc:
+            click.echo(f"  controller: ERROR {exc}")
+    store_url = os.environ.get("KT_STORE_URL") or cfg.store_url
+    click.echo(f"  store: {store_url or 'local (~/.ktpu/store)'}")
+    try:
+        import jax
+
+        devices = jax.devices()
+        click.echo(f"  jax devices: {devices}")
+    except Exception as exc:
+        click.echo(f"  jax: unavailable ({type(exc).__name__})")
+
+
+# ---------------------------------------------------------------- config
+@main.command("config")
+@click.argument("assignment", required=False)
+def config_cmd(assignment):
+    """Show config, or set with KEY=VALUE (persisted to ~/.ktpu/config)."""
+    from kubetorch_tpu.config import get_config
+
+    cfg = get_config()
+    if assignment:
+        key, _, value = assignment.partition("=")
+        if not value:
+            click.echo(json.dumps({key: getattr(cfg, key, None)}))
+            return
+        cfg.save(**{key: value})
+        click.echo(f"set {key}={value}")
+    else:
+        click.echo(json.dumps(cfg.as_dict(), indent=2, default=str))
+
+
+# ---------------------------------------------------------------- deploy
+@main.command()
+@click.argument("target")
+def deploy(target):
+    """Deploy decorated modules from FILE.py (``@kt.compute(...)`` etc.)."""
+    import importlib.util
+
+    path = Path(target)
+    if not path.exists():
+        raise click.ClickException(f"{target} not found")
+    spec = importlib.util.spec_from_file_location(path.stem, path)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, str(path.parent.resolve()))
+    spec.loader.exec_module(module)
+
+    from kubetorch_tpu.resources.compute.decorators import PartialModule
+
+    deployed = []
+    for name in dir(module):
+        obj = getattr(module, name)
+        if isinstance(obj, PartialModule):
+            remote = obj.deploy()
+            deployed.append(remote.service_name)
+            click.echo(f"deployed {name} → {remote.service_name}")
+    if not deployed:
+        raise click.ClickException(
+            f"no @kt.compute-decorated callables found in {target}")
+
+
+# ---------------------------------------------------------------- call
+@main.command()
+@click.argument("service")
+@click.argument("method", required=False)
+@click.option("--args", "args_json", default="[]",
+              help="positional args as JSON list")
+@click.option("--kwargs", "kwargs_json", default="{}",
+              help="keyword args as JSON object")
+def call(service, method, args_json, kwargs_json):
+    """Call a deployed service: ktpu call my-fn --args '[1,2]'."""
+    from kubetorch_tpu.resources.callables.module import Module
+
+    module = Module.from_name(service)
+    result = module._call_remote(
+        method=method, args=tuple(json.loads(args_json)),
+        kwargs=json.loads(kwargs_json))
+    click.echo(json.dumps(result, default=str))
+
+
+# ---------------------------------------------------------------- list
+@main.command("list")
+def list_cmd():
+    """List deployed services."""
+    from kubetorch_tpu.provisioning.backend import get_backend
+
+    records = get_backend().list_services()
+    if not records:
+        click.echo("no services")
+        return
+    for record in records:
+        name = record.get("service_name", "?")
+        pods = len(record.get("pods", [])) or record.get("replicas", "")
+        click.echo(f"{name}\tpods={pods}\tbackend="
+                   f"{record.get('backend', '?')}")
+
+
+@main.command()
+@click.argument("service")
+def describe(service):
+    """Describe a deployed service."""
+    from kubetorch_tpu.provisioning.backend import get_backend
+
+    record = get_backend().lookup(service)
+    if record is None:
+        raise click.ClickException(f"no service {service!r}")
+    click.echo(json.dumps(dict(record), indent=2, default=str))
+
+
+@main.command()
+@click.argument("service")
+@click.option("--pod", type=int, default=None)
+@click.option("--tail", type=int, default=200)
+def logs(service, pod, tail):
+    """Show service logs."""
+    from kubetorch_tpu.provisioning.backend import get_backend
+
+    click.echo(get_backend().logs(service, pod, tail))
+
+
+@main.command()
+@click.argument("service")
+def teardown(service):
+    """Tear down a deployed service."""
+    from kubetorch_tpu.provisioning.backend import get_backend
+
+    if get_backend().teardown(service, quiet=True):
+        click.echo(f"tore down {service}")
+    else:
+        click.echo(f"no service {service!r}")
+
+
+# ---------------------------------------------------------------- runs
+@main.command(context_settings={"ignore_unknown_options": True})
+@click.option("--name", default=None, help="run name prefix")
+@click.argument("command", nargs=-1, type=click.UNPROCESSED, required=True)
+def run(name, command):
+    """Durable batch run: ktpu run -- python train.py --epochs 3."""
+    from kubetorch_tpu.runs.wrapper import launch_run
+
+    rid = launch_run(list(command), name_prefix=name or "run")
+    click.echo(rid)
+
+
+@main.group()
+def runs():
+    """Inspect batch runs."""
+
+
+@runs.command("list")
+def runs_list():
+    from kubetorch_tpu.runs.api import list_runs
+
+    for record in list_runs():
+        click.echo(f"{record['id']}\t{record['status']}\t"
+                   f"{record.get('command', '')}")
+
+
+@runs.command("show")
+@click.argument("run_id")
+def runs_show(run_id):
+    from kubetorch_tpu.runs.api import get_run
+
+    click.echo(json.dumps(get_run(run_id), indent=2, default=str))
+
+
+@runs.command("logs")
+@click.argument("run_id")
+def runs_logs(run_id):
+    from kubetorch_tpu.data_store import commands as store
+
+    click.echo(store.get(f"runs/{run_id}/log.txt").decode()
+               if isinstance(store.get(f"runs/{run_id}/log.txt"), bytes)
+               else store.get(f"runs/{run_id}/log.txt"))
+
+
+@runs.command("delete")
+@click.argument("run_id")
+def runs_delete(run_id):
+    from kubetorch_tpu.data_store import commands as store
+
+    count = store.rm(f"runs/{run_id}", recursive=True)
+    click.echo(f"deleted {count} objects")
+
+
+# ---------------------------------------------------------------- store
+@main.command()
+@click.argument("key")
+@click.argument("src")
+def put(key, src):
+    """Upload a file/directory to the data store."""
+    from kubetorch_tpu.data_store import commands as store
+
+    store.put(key, src)
+    click.echo(f"put {src} → {key}")
+
+
+@main.command()
+@click.argument("key")
+@click.argument("dest")
+def get(key, dest):
+    """Download a key from the data store."""
+    from kubetorch_tpu.data_store import commands as store
+
+    store.get(key, dest)
+    click.echo(f"got {key} → {dest}")
+
+
+@main.command()
+@click.argument("prefix", required=False, default="")
+def ls(prefix):
+    """List data-store keys."""
+    from kubetorch_tpu.data_store import commands as store
+
+    for entry in store.ls(prefix):
+        click.echo(f"{entry['size']:>12}  {entry['key']}")
+
+
+@main.command()
+@click.argument("key")
+@click.option("--recursive", is_flag=True)
+def rm(key, recursive):
+    """Delete data-store keys."""
+    from kubetorch_tpu.data_store import commands as store
+
+    click.echo(f"deleted {store.rm(key, recursive=recursive)} objects")
+
+
+# ---------------------------------------------------------------- secrets
+@main.group()
+def secrets():
+    """Manage secrets."""
+
+
+@secrets.command("list")
+def secrets_list():
+    from kubetorch_tpu.resources.secrets.secret import Secret
+
+    for name in Secret.list_local():
+        click.echo(name)
+
+
+@secrets.command("create")
+@click.argument("name")
+@click.option("--provider", default=None)
+@click.option("--from-env", "env_vars", multiple=True)
+def secrets_create(name, provider, env_vars):
+    from kubetorch_tpu.resources.secrets.secret import Secret
+
+    if provider:
+        secret = Secret.from_provider(provider, name)
+    else:
+        values = {v: os.environ[v] for v in env_vars if v in os.environ}
+        if not values:
+            raise click.ClickException("no values (use --provider/--from-env)")
+        secret = Secret(name=name, values=values)
+    secret.save_local()
+    click.echo(f"saved secret {name} ({len(secret.values)} values)")
+
+
+@secrets.command("delete")
+@click.argument("name")
+def secrets_delete(name):
+    from kubetorch_tpu.resources.secrets.secret import Secret
+
+    Secret(name=name).delete_local()
+    click.echo(f"deleted {name}")
+
+
+# ---------------------------------------------------------------- servers
+@main.group(hidden=True)
+def server():
+    """Run framework services (pod server / controller / store)."""
+
+
+@server.command("pod")
+@click.option("--host", default="0.0.0.0")
+@click.option("--port", type=int, default=32300)
+def server_pod(host, port):
+    from kubetorch_tpu.serving.server import PodServer
+    from aiohttp import web
+
+    web.run_app(PodServer().build_app(), host=host, port=port, print=None)
+
+
+@server.command("controller")
+@click.option("--host", default="0.0.0.0")
+@click.option("--port", type=int, default=32320)
+@click.option("--db", default=str(Path.home() / ".ktpu" / "controller.db"))
+def server_controller(host, port, db):
+    from kubetorch_tpu.controller.server import ControllerServer
+    from aiohttp import web
+
+    web.run_app(ControllerServer(db).build_app(), host=host, port=port,
+                print=None)
+
+
+@server.command("store")
+@click.option("--host", default="0.0.0.0")
+@click.option("--port", type=int, default=32310)
+@click.option("--root", default=None)
+def server_store(host, port, root):
+    from kubetorch_tpu.data_store.store_server import StoreServer
+    from aiohttp import web
+
+    store = StoreServer(Path(root) if root else None)
+    web.run_app(store.build_app(), host=host, port=port, print=None)
+
+
+if __name__ == "__main__":
+    main()

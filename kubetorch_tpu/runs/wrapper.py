@@ -1,0 +1,124 @@
+"""Run wrapper: execute a command as a durable, evidence-collecting run.
+
+Reference: ``python_client/kubetorch/run_wrapper.py:74 run_wrapped_command`` —
+pull workdir from the store, exec the command teeing stdout to a local file +
+the store, report status + log tail. ``launch_run`` is the client half
+(reference: ``cli.py:1359 kt_run``): snapshot the workdir, record the run,
+then execute (locally in local mode; as a K8s Job via the controller in k8s
+mode).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from collections import deque
+from pathlib import Path
+from typing import List, Optional
+
+from kubetorch_tpu.data_store import commands as store
+from kubetorch_tpu.runs.api import (
+    RUN_ID_ENV,
+    generate_run_id,
+    record_run,
+    update_run_status,
+)
+
+LOG_TAIL_LINES = 100
+
+
+def launch_run(command: List[str], name_prefix: str = "run",
+               workdir: Optional[str] = None) -> str:
+    """Snapshot workdir → record run → execute wrapped. Returns run id."""
+    run_id = generate_run_id(name_prefix)
+    workdir = workdir or os.getcwd()
+    workdir_key = f"runs/{run_id}/workdir"
+    store.put(workdir_key, workdir)
+    record_run(run_id, command=" ".join(command), workdir_key=workdir_key)
+    from kubetorch_tpu.controller.client import ControllerClient
+
+    controller = ControllerClient.maybe()
+    if controller is not None:
+        try:
+            controller.create_run(run_id, command=" ".join(command),
+                                  workdir_key=workdir_key)
+        except Exception:
+            pass
+    rc = run_wrapped_command(run_id, command, cwd=workdir)
+    if rc != 0:
+        raise SystemExit(rc)
+    return run_id
+
+
+def run_wrapped_command(run_id: str, command: List[str],
+                        cwd: Optional[str] = None,
+                        pull_workdir: bool = False) -> int:
+    """The in-container half: optionally pull workdir, exec, tee, report."""
+    if pull_workdir:
+        cwd = str(Path("/workspace"))
+        store.workdir_sync(f"runs/{run_id}/workdir", cwd)
+
+    update_run_status(run_id, "running", started_at=time.time())
+    _controller_status(run_id, "running")
+
+    log_path = Path(cwd or ".") / f".kt_run_{run_id}.log"
+    tail: deque = deque(maxlen=LOG_TAIL_LINES)
+    # The run's process must see this package (kt.note()/kt.artifact()).
+    pkg_root = str(Path(__file__).resolve().parents[2])
+    python_path = os.environ.get("PYTHONPATH", "")
+    if pkg_root not in python_path.split(os.pathsep):
+        python_path = (f"{pkg_root}{os.pathsep}{python_path}"
+                       if python_path else pkg_root)
+    env = {**os.environ, RUN_ID_ENV: run_id, "PYTHONPATH": python_path}
+    rc = 1
+    try:
+        with open(log_path, "wb") as log_file:
+            proc = subprocess.Popen(
+                command, cwd=cwd, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            for line in iter(proc.stdout.readline, b""):
+                sys.stdout.buffer.write(line)
+                sys.stdout.buffer.flush()
+                log_file.write(line)
+                tail.append(line.decode(errors="replace").rstrip())
+            rc = proc.wait()
+    finally:
+        try:
+            store.put(f"runs/{run_id}/log.txt", log_path.read_bytes())
+            log_path.unlink(missing_ok=True)
+        except OSError:
+            pass
+        status = "succeeded" if rc == 0 else "failed"
+        update_run_status(run_id, status, returncode=rc,
+                          log_tail="\n".join(tail))
+        _controller_status(run_id, status, log_tail="\n".join(tail))
+    return rc
+
+
+def _controller_status(run_id: str, status: str, **fields):
+    from kubetorch_tpu.controller.client import ControllerClient
+
+    controller = ControllerClient.maybe()
+    if controller is not None:
+        try:
+            controller.update_run(run_id, status=status, **fields)
+        except Exception:
+            pass
+
+
+def main():
+    """python -m kubetorch_tpu.runs.wrapper <run_id> -- cmd args..."""
+    argv = sys.argv[1:]
+    if "--" not in argv or not argv:
+        print("usage: run_wrapper <run_id> -- <command...>", file=sys.stderr)
+        return 2
+    sep = argv.index("--")
+    run_id = argv[0] if sep > 0 else generate_run_id()
+    command = argv[sep + 1:]
+    return run_wrapped_command(run_id, command, pull_workdir=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
