@@ -34,6 +34,9 @@ class LlamaConfig:
     remat: bool = True             # rematerialize each block under scan
     moe: Optional[MoEConfig] = None
     max_seq_len: int = 8192
+    # "auto" → pallas flash for long tileable sequences, XLA otherwise;
+    # "ring" is engaged by passing a mesh with sp>1 to forward().
+    attn_impl: str = "auto"        # auto | xla | flash
 
     @property
     def compute_dtype(self):

@@ -146,7 +146,7 @@ def _moe_block(x, layer, cfg: LlamaConfig, rules: ShardingRules):
 
 
 def _block(x, layer, sin, cos, cfg: LlamaConfig, rules: ShardingRules,
-           segment_ids=None):
+           segment_ids=None, mesh=None):
     """One decoder block. ``x``: [B, S, E] in compute dtype."""
     dt = cfg.compute_dtype
     B, S, E = x.shape
@@ -161,12 +161,34 @@ def _block(x, layer, sin, cos, cfg: LlamaConfig, rules: ShardingRules,
                    layer["wv"].reshape(E, Hkv, D).astype(dt))
     q = apply_rope(q, None, cfg.rope_theta, sin=sin, cos=cos)
     k = apply_rope(k, None, cfg.rope_theta, sin=sin, cos=cos)
-    q = shard_constraint(q, rules, "batch", "seq", "heads", None)
-    # kv gathered over seq for attention (sequence parallelism collects here;
-    # ring attention in parallel/ring.py avoids the gather for long context).
-    k = shard_constraint(k, rules, "batch", None, "kv_heads", None)
-    v = shard_constraint(v, rules, "batch", None, "kv_heads", None)
-    attn = dot_product_attention(q, k, v, causal=True, segment_ids=segment_ids)
+
+    ring = (mesh is not None and mesh.shape.get("sp", 1) > 1
+            and segment_ids is None)
+    if ring:
+        # Sequence-parallel exact attention: KV stays seq-sharded and rotates
+        # over the sp ring (parallel/ring.py) — no all-gather of KV.
+        from kubetorch_tpu.parallel.ring import ring_attention
+
+        q = shard_constraint(q, rules, "batch", "seq", "heads", None)
+        k = shard_constraint(k, rules, "batch", "seq", "kv_heads", None)
+        v = shard_constraint(v, rules, "batch", "seq", "kv_heads", None)
+        attn = ring_attention(q, k, v, mesh, causal=True)
+    else:
+        q = shard_constraint(q, rules, "batch", "seq", "heads", None)
+        # kv gathered over seq (XLA inserts the all-gather when sp shards seq)
+        k = shard_constraint(k, rules, "batch", None, "kv_heads", None)
+        v = shard_constraint(v, rules, "batch", None, "kv_heads", None)
+        impl = cfg.attn_impl
+        if impl == "auto":
+            impl = "flash" if (S >= 4096 and S % 512 == 0
+                               and D % 128 == 0) else "xla"
+        if impl == "flash" and segment_ids is None:
+            from kubetorch_tpu.ops.flash_attention import flash_attention
+
+            attn = flash_attention(q, k, v, causal=True)
+        else:
+            attn = dot_product_attention(q, k, v, causal=True,
+                                         segment_ids=segment_ids)
     attn = attn.reshape(B, S, H * D)
     x = x + jnp.einsum("bsf,fe->bse", attn, layer["wo"].astype(dt))
     x = shard_constraint(x, rules, "batch", "seq", None)
@@ -190,8 +212,13 @@ def forward(
     rules: Optional[ShardingRules] = None,
     segment_ids: Optional[jax.Array] = None,
     positions: Optional[jax.Array] = None,
+    mesh=None,
 ) -> jax.Array:
-    """Full-sequence forward pass → logits ``[B, S, vocab]`` (float32)."""
+    """Full-sequence forward pass → logits ``[B, S, vocab]`` (float32).
+
+    Pass ``mesh`` (with an sp axis > 1) to engage ring attention for
+    sequence-parallel long-context training.
+    """
     rules = rules or ShardingRules.default()
     dt = cfg.compute_dtype
     B, S = tokens.shape
@@ -205,10 +232,11 @@ def forward(
     if cfg.remat:
         block = jax.checkpoint(
             _block, policy=jax.checkpoint_policies.nothing_saveable,
-            static_argnums=(4, 5))
+            static_argnums=(4, 5, 7))
 
     def scan_body(carry, layer):
-        return block(carry, layer, sin, cos, cfg, rules, segment_ids), None
+        return block(carry, layer, sin, cos, cfg, rules, segment_ids,
+                     mesh), None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
@@ -217,6 +245,62 @@ def forward(
     logits = jnp.einsum("bse,ev->bsv", x, head)
     logits = shard_constraint(logits, rules, "batch", "seq", "vocab")
     return logits.astype(jnp.float32)
+
+
+def forward_pipeline(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh,
+    n_microbatches: int = 2,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Pipeline-parallel forward: layers grouped into ``pp`` stages, GPipe
+    microbatching via :func:`kubetorch_tpu.parallel.pipeline.pipeline_apply`.
+
+    Embedding/unembedding run outside the pipeline (replicated); the decoder
+    stack streams through stages. Layer count must divide the pp axis size.
+    """
+    from kubetorch_tpu.parallel.pipeline import pipeline_apply
+    from kubetorch_tpu.parallel.sharding import ShardingRules
+
+    pp = mesh.shape["pp"]
+    L = cfg.n_layers
+    if L % pp:
+        raise ValueError(f"n_layers {L} not divisible by pp {pp}")
+    # Inside shard_map the mesh axes are consumed — use unsharded rules.
+    null_rules = ShardingRules(rules=tuple(
+        (name, None) for name, _ in ShardingRules.default().rules))
+
+    dt = cfg.compute_dtype
+    B, S = tokens.shape
+    x = params["embedding"].astype(dt)[tokens]
+    if positions is None:
+        positions = jnp.arange(S)[None, :]
+    sin, cos = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+    # [L, ...] -> [pp, L/pp, ...] stage-major layer grouping.
+    stage_layers = jax.tree.map(
+        lambda a: a.reshape((pp, L // pp) + a.shape[1:]), params["layers"])
+
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(
+            _block, policy=jax.checkpoint_policies.nothing_saveable,
+            static_argnums=(4, 5))
+
+    def stage_fn(stage_params, h):
+        def body(carry, layer):
+            return block(carry, layer, sin, cos, cfg, null_rules, None), None
+
+        h, _ = jax.lax.scan(body, h, stage_params)
+        return h
+
+    x = pipeline_apply(stage_fn, stage_layers, x, mesh, n_microbatches)
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = (params["embedding"].T if cfg.tie_embeddings
+            else params["lm_head"]).astype(dt)
+    return jnp.einsum("bse,ev->bsv", x, head).astype(jnp.float32)
 
 
 def num_params(cfg: LlamaConfig) -> int:

@@ -1,0 +1,132 @@
+"""Ring attention: exact attention over sequence-sharded Q/K/V.
+
+Long-context path (SURVEY.md §5.7 — absent from the reference; first-class
+here). Each ``sp`` shard holds a sequence chunk of Q/K/V; KV chunks rotate
+around the ring via ``jax.lax.ppermute`` while each device folds the incoming
+chunk into its local queries' online softmax state (max, sum, acc). Exact
+(not approximate) attention with O(S_local) memory per device and ICI-only
+communication; XLA overlaps each ppermute with the next chunk's compute.
+
+Composable with the flash kernel: each per-chunk score computation is itself
+block-tiled by XLA; the pallas-RDMA fused version is a planned follow-up.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+_NEG_INF = -1e30
+
+
+def _chunk_scores(q, k, v, q_off, k_off, scale, causal):
+    """One KV chunk vs local Q. q: [B,S,H,D], k/v: [B,T,Hkv,D].
+    Returns (o_unnorm [B,S,H,D], m [B,S,H], l [B,S,H]) in float32."""
+    B, S, H, D = q.shape
+    T, Hkv = k.shape[1], k.shape[2]
+    group = H // Hkv
+    # H splits as (Hkv, group): head index = kv_head * group + g
+    qg = q.reshape(B, S, Hkv, group, D).astype(jnp.float32)
+    s = jnp.einsum("bshgd,bthd->bshgt",
+                   qg * scale, k.astype(jnp.float32))   # [B,S,Hkv,group,T]
+    if causal:
+        q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (S, T), 0)
+        k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (S, T), 1)
+        mask = (q_pos >= k_pos)[None, :, None, None, :]
+        s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)                              # [B,S,Hkv,group]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                              # [B,S,Hkv,group]
+    o = jnp.einsum("bshgt,bthd->bshgd", p, v.astype(jnp.float32))
+    return (o.reshape(B, S, H, D), m.reshape(B, S, H), l.reshape(B, S, H))
+
+
+def _ring_body(q, k, v, *, axis_name: str, scale: float, causal: bool,
+               mesh_axes: tuple = ()):
+    """Runs inside shard_map: q/k/v are local [B, S_local, H(,kv), D]."""
+    sp = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    s_local = S
+
+    acc = jnp.zeros((B, S, H, D), jnp.float32)
+    m = jnp.full((B, S, H), _NEG_INF, jnp.float32)
+    l = jnp.zeros((B, S, H), jnp.float32)
+    if mesh_axes:
+        # shard_map VMA typing: scan carries must enter as 'varying' over the
+        # same axes as the inputs, since the loop body makes them
+        # device-varying (ppermute / axis_index).
+        acc, m, l = jax.lax.pcast((acc, m, l), mesh_axes, to="varying")
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(i, carry):
+        acc, m, l, k_cur, v_cur = carry
+        src = (idx - i) % sp                      # whose chunk we hold now
+        o_c, m_c, l_c = _chunk_scores(
+            q, k_cur, v_cur,
+            q_off=idx * s_local, k_off=src * s_local,
+            scale=scale, causal=causal)
+        m_new = jnp.maximum(m, m_c)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_c - m_new)
+        acc = acc * alpha[..., None] + o_c * beta[..., None]
+        l = l * alpha + l_c * beta
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return acc, m_new, l, k_next, v_next
+
+    acc, m, l, _, _ = jax.lax.fori_loop(
+        0, sp, step, (acc, m, l, k, v))
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,                  # [B, S, Hq, D] sharded on sp along S
+    k: jax.Array,                  # [B, S, Hkv, D]
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    batch_axes=("dp", "fsdp"),
+    head_axis: Optional[str] = "tp",
+) -> jax.Array:
+    """Sequence-parallel exact attention over ``mesh[axis_name]``."""
+    D = q.shape[-1]
+    scale = scale if scale is not None else D ** -0.5
+
+    def fit(size: int, axes) -> Optional[tuple]:
+        """Keep only mesh axes whose product divides ``size``."""
+        used, prod = [], 1
+        for ax in (axes if isinstance(axes, (tuple, list)) else (axes,)):
+            if ax and size % (prod * mesh.shape[ax]) == 0:
+                used.append(ax)
+                prod *= mesh.shape[ax]
+        return tuple(used) or None
+
+    b_axes = fit(q.shape[0], batch_axes)
+    h_axis = fit(k.shape[2], head_axis)
+    h_axis = h_axis[0] if h_axis else None
+    spec_q = P(b_axes, axis_name, h_axis, None)
+    spec_kv = P(b_axes, axis_name, h_axis, None)
+    spec_axes = set()
+    for part in (b_axes or ()), (axis_name,), ((h_axis,) if h_axis else ()):
+        spec_axes.update(a for a in part if a)
+    body = functools.partial(
+        _ring_body, axis_name=axis_name, scale=scale, causal=causal,
+        mesh_axes=tuple(sorted(spec_axes)))
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(spec_q, spec_kv, spec_kv),
+        out_specs=spec_q,
+    )(q, k, v)

@@ -80,15 +80,19 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     rules: Optional[ShardingRules] = None,
     loss_fn: Optional[Callable] = None,
+    mesh: Optional[Mesh] = None,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], tuple]:
     """Build the jitted train step. Call under ``use_mesh(mesh)``
     (the Trainer does this) so PartitionSpec constraints resolve."""
     rules = rules or ShardingRules.default()
+    # Ring attention only engages when sequence parallelism is active.
+    ring_mesh = (mesh if mesh is not None
+                 and mesh.shape.get("sp", 1) > 1 else None)
 
     def default_loss(params, batch):
         logits = llama.forward(
             params, batch["inputs"], cfg, rules,
-            segment_ids=batch.get("segment_ids"))
+            segment_ids=batch.get("segment_ids"), mesh=ring_mesh)
         return cross_entropy_loss(logits, batch["targets"], batch.get("mask"))
 
     compute_loss = loss_fn or default_loss
@@ -134,7 +138,8 @@ class Trainer:
         with use_mesh(self.mesh):
             self.state = init_train_state(
                 jax.random.key(seed), cfg, mesh, self.optimizer, self.rules)
-            self._step = make_train_step(cfg, self.optimizer, self.rules)
+            self._step = make_train_step(cfg, self.optimizer, self.rules,
+                                         mesh=mesh)
 
     def step(self, batch: Dict[str, jax.Array]):
         with use_mesh(self.mesh):
