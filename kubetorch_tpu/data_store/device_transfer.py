@@ -1,0 +1,124 @@
+"""Device-array transfer through the data store — host-staged.
+
+The reference moves GPU tensors between workloads zero-copy via CUDA IPC +
+NCCL broadcast groups (``data_store/gpu_transfer.py:124``,
+``pod_data_server.py``). TPU has no CUDA-IPC analogue (SURVEY.md §7
+hard-part 3), so this path is **host-staged by design**: arrays are fetched
+to host, packed into one contiguous buffer (header = msgpack tree spec +
+shapes/dtypes, mirroring the reference's packed single-buffer mode), moved
+through the store (delta/P2P as for any blob), and placed back onto devices —
+optionally resharded onto a different mesh than they were saved from, which
+the reference cannot do at all.
+
+This is what RL weight-sync uses (trainer publishes, inference workers
+fetch — the async-GRPO pattern); steady-state checkpointing should prefer
+:mod:`kubetorch_tpu.training.checkpoint` (Orbax, per-shard parallel IO).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Optional
+
+import msgpack
+import numpy as np
+
+from kubetorch_tpu.data_store import commands as store
+
+_MAGIC = b"KTARRV1\x00"
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _tree_flatten(tree: Any):
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    return leaves, treedef
+
+
+def pack_arrays(tree: Any) -> bytes:
+    """Pack a pytree of (jax/numpy) arrays into one buffer."""
+    import jax
+
+    leaves, treedef = _tree_flatten(tree)
+    host_leaves = [np.asarray(jax.device_get(leaf)) for leaf in leaves]
+    header = {
+        "treedef": str(treedef),
+        # dtype by name: ml_dtypes types (bfloat16, fp8) stringify as 'V2'
+        # through .str, but round-trip cleanly by name.
+        "leaves": [{"shape": list(a.shape), "dtype": a.dtype.name}
+                   for a in host_leaves],
+    }
+    head = msgpack.packb(header)
+    buf = io.BytesIO()
+    buf.write(_MAGIC)
+    buf.write(len(head).to_bytes(8, "little"))
+    buf.write(head)
+    for array in host_leaves:
+        buf.write(np.ascontiguousarray(array).tobytes())
+    return buf.getvalue()
+
+
+def unpack_arrays(data: bytes, template: Optional[Any] = None) -> Any:
+    """Unpack to numpy leaves; structure comes from ``template`` when given
+    (exact pytree round-trip), else a flat list."""
+    import jax
+
+    if not data.startswith(_MAGIC):
+        raise ValueError("not a packed-array buffer")
+    offset = len(_MAGIC)
+    head_len = int.from_bytes(data[offset:offset + 8], "little")
+    offset += 8
+    header = msgpack.unpackb(data[offset:offset + head_len])
+    offset += head_len
+    leaves = []
+    for spec in header["leaves"]:
+        dtype = _dtype_from_name(spec["dtype"])
+        count = int(np.prod(spec["shape"])) if spec["shape"] else 1
+        nbytes = count * dtype.itemsize
+        array = np.frombuffer(
+            data[offset:offset + nbytes], dtype=dtype).reshape(spec["shape"])
+        leaves.append(array)
+        offset += nbytes
+    if template is not None:
+        treedef = jax.tree.structure(template)
+        return jax.tree.unflatten(treedef, leaves)
+    return leaves
+
+
+def put_arrays(key: str, tree: Any) -> str:
+    """Publish a pytree of arrays (params, state dicts) under ``key``."""
+    from kubetorch_tpu.data_store.client import DataStoreClient
+
+    blob = pack_arrays(tree)
+    return DataStoreClient.default()._backend().put_blob(key, blob)
+
+
+def get_arrays(
+    key: str,
+    template: Optional[Any] = None,
+    shardings: Optional[Any] = None,
+) -> Any:
+    """Fetch arrays; ``shardings`` (pytree of Sharding or a single one)
+    device_puts each leaf — onto a *different* mesh/layout than the publisher
+    used if desired."""
+    import jax
+
+    from kubetorch_tpu.data_store.client import DataStoreClient
+
+    blob = DataStoreClient.default()._backend().get_blob(key)
+    tree = unpack_arrays(blob, template)
+    if shardings is None:
+        return tree
+    if isinstance(shardings, (list, dict, tuple)) or hasattr(
+            shardings, "keys"):
+        return jax.tree.map(jax.device_put, tree, shardings)
+    return jax.tree.map(lambda x: jax.device_put(x, shardings), tree)

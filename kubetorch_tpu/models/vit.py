@@ -1,0 +1,157 @@
+"""ViT-L/16-style image classifier, TPU-first (BASELINE.md config #4).
+
+Same architecture conventions as :mod:`kubetorch_tpu.models.llama`: functional
+init/apply over plain pytrees, stacked+scanned encoder layers, logical-axis
+metadata for mesh-parallel layouts. Patch embedding is an einsum over
+non-overlapping patches (equivalent to the conv, and lands directly on the
+MXU); pooling is mean-over-tokens (no class token) feeding a linear head.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from einops import rearrange
+
+from kubetorch_tpu.models.configs import ViTConfig
+from kubetorch_tpu.ops import dot_product_attention
+from kubetorch_tpu.parallel.sharding import ShardingRules, shard_constraint
+
+Params = Dict[str, Any]
+
+
+def _dense_init(key, shape, dtype, in_axis=-2):
+    fan_in = shape[in_axis]
+    return (jax.random.truncated_normal(key, -2.0, 2.0, shape, jnp.float32)
+            * (fan_in ** -0.5)).astype(dtype)
+
+
+def layer_norm(x, scale, bias, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    normed = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (normed * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def init(key: jax.Array, cfg: ViTConfig) -> Params:
+    pdt = cfg.storage_dtype
+    E, L, H, D, M = (cfg.embed_dim, cfg.n_layers, cfg.n_heads,
+                     cfg.head_dim, cfg.mlp_dim)
+    P = cfg.patch_size
+    keys = jax.random.split(key, 12)
+    patch_dim = 3 * P * P
+    layers = {
+        "ln1_scale": jnp.ones((L, E), pdt),
+        "ln1_bias": jnp.zeros((L, E), pdt),
+        "wq": _dense_init(keys[0], (L, E, H * D), pdt),
+        "wk": _dense_init(keys[1], (L, E, H * D), pdt),
+        "wv": _dense_init(keys[2], (L, E, H * D), pdt),
+        "wo": _dense_init(keys[3], (L, H * D, E), pdt),
+        "ln2_scale": jnp.ones((L, E), pdt),
+        "ln2_bias": jnp.zeros((L, E), pdt),
+        "w_up": _dense_init(keys[4], (L, E, M), pdt),
+        "b_up": jnp.zeros((L, M), pdt),
+        "w_down": _dense_init(keys[5], (L, M, E), pdt),
+        "b_down": jnp.zeros((L, E), pdt),
+    }
+    return {
+        "patch_embed": _dense_init(keys[6], (patch_dim, E), pdt),
+        "patch_bias": jnp.zeros((E,), pdt),
+        "pos_embed": (jax.random.normal(keys[7], (cfg.num_patches, E),
+                                        jnp.float32) * 0.02).astype(pdt),
+        "layers": layers,
+        "final_ln_scale": jnp.ones((E,), pdt),
+        "final_ln_bias": jnp.zeros((E,), pdt),
+        "head": _dense_init(keys[8], (E, cfg.num_classes), pdt),
+        "head_bias": jnp.zeros((cfg.num_classes,), pdt),
+    }
+
+
+def param_logical_axes(cfg: ViTConfig) -> Params:
+    layers = {
+        "ln1_scale": ("layer", "embed"), "ln1_bias": ("layer", "embed"),
+        "wq": ("layer", "embed_fsdp", "heads"),
+        "wk": ("layer", "embed_fsdp", "heads"),
+        "wv": ("layer", "embed_fsdp", "heads"),
+        "wo": ("layer", "heads", "embed_fsdp"),
+        "ln2_scale": ("layer", "embed"), "ln2_bias": ("layer", "embed"),
+        "w_up": ("layer", "embed_fsdp", "mlp"),
+        "b_up": ("layer", "mlp"),
+        "w_down": ("layer", "mlp", "embed_fsdp"),
+        "b_down": ("layer", "embed"),
+    }
+    return {
+        "patch_embed": ("embed_fsdp", None),
+        "patch_bias": ("embed",),
+        "pos_embed": (None, "embed_fsdp"),
+        "layers": layers,
+        "final_ln_scale": ("embed",), "final_ln_bias": ("embed",),
+        "head": ("embed_fsdp", "vocab"),
+        "head_bias": ("vocab",),
+    }
+
+
+def _block(x, layer, cfg: ViTConfig, rules: ShardingRules):
+    dt = cfg.compute_dtype
+    B, N, E = x.shape
+    H, D = cfg.n_heads, cfg.head_dim
+
+    h = layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+    q = jnp.einsum("bne,ehd->bnhd", h,
+                   layer["wq"].reshape(E, H, D).astype(dt))
+    k = jnp.einsum("bne,ehd->bnhd", h,
+                   layer["wk"].reshape(E, H, D).astype(dt))
+    v = jnp.einsum("bne,ehd->bnhd", h,
+                   layer["wv"].reshape(E, H, D).astype(dt))
+    q = shard_constraint(q, rules, "batch", None, "heads", None)
+    attn = dot_product_attention(q, k, v, causal=False)
+    x = x + jnp.einsum("bnf,fe->bne", attn.reshape(B, N, H * D),
+                       layer["wo"].astype(dt))
+
+    h = layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+    up = jnp.einsum("bne,em->bnm", h, layer["w_up"].astype(dt))
+    up = jax.nn.gelu(up + layer["b_up"].astype(dt))
+    up = shard_constraint(up, rules, "batch", None, "mlp")
+    x = x + (jnp.einsum("bnm,me->bne", up, layer["w_down"].astype(dt))
+             + layer["b_down"].astype(dt))
+    return shard_constraint(x, rules, "batch", None, None)
+
+
+def forward(
+    params: Params,
+    images: jax.Array,              # [B, H, W, 3]
+    cfg: ViTConfig,
+    rules: Optional[ShardingRules] = None,
+) -> jax.Array:
+    """Images → class logits ``[B, num_classes]`` (float32)."""
+    rules = rules or ShardingRules.default()
+    dt = cfg.compute_dtype
+    P = cfg.patch_size
+    patches = rearrange(images.astype(dt),
+                        "b (h p1) (w p2) c -> b (h w) (p1 p2 c)",
+                        p1=P, p2=P)
+    x = (jnp.einsum("bnp,pe->bne", patches,
+                    params["patch_embed"].astype(dt))
+         + params["patch_bias"].astype(dt))
+    x = x + params["pos_embed"].astype(dt)[None]
+    x = shard_constraint(x, rules, "batch", None, None)
+
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(
+            _block, policy=jax.checkpoint_policies.nothing_saveable,
+            static_argnums=(2, 3))
+
+    def scan_body(carry, layer):
+        return block(carry, layer, cfg, rules), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
+    pooled = jnp.mean(x, axis=1)
+    logits = (jnp.einsum("be,ec->bc", pooled, params["head"].astype(dt))
+              + params["head_bias"].astype(dt))
+    return logits.astype(jnp.float32)

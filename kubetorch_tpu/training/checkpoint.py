@@ -1,0 +1,100 @@
+"""Sharded checkpoint/resume via Orbax (SURVEY.md §5.4 TPU posture).
+
+The reference has no checkpoint format — users kt.put/get directories. Here
+sharded JAX train states get first-class treatment: Orbax writes each shard
+from its owning host (parallel IO, no host gather), restore maps shards onto
+the *current* mesh (topology changes between save and restore are fine as
+long as shapes match), and checkpoints live either on a mounted Volume or
+round-trip through the data store as a directory tree.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Thin wrapper over Orbax CheckpointManager with store integration."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = Path(directory).expanduser().resolve()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, step: int, state: Any, wait: bool = False) -> bool:
+        saved = self._manager.save(
+            step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._manager.wait_until_finished()
+        return saved
+
+    def restore(self, state_template: Any,
+                step: Optional[int] = None) -> Any:
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoints under {self.directory}")
+        # Abstract template: restores directly sharded like the template.
+        template = jax.tree.map(ocp.utils.to_shape_dtype_struct,
+                                state_template)
+        return self._manager.restore(
+            step, args=ocp.args.StandardRestore(template))
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def all_steps(self):
+        return self._manager.all_steps()
+
+    def wait(self):
+        self._manager.wait_until_finished()
+
+    # ------------------------------------------------- store round-trip
+    def push_to_store(self, key: str, step: Optional[int] = None):
+        """Upload a checkpoint dir to the data store (delta-synced)."""
+        from kubetorch_tpu.data_store import commands as store
+
+        self.wait()
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError("nothing to push")
+        store.put(f"{key}/{step}", self.directory / str(step))
+        return f"{key}/{step}"
+
+    @classmethod
+    def pull_from_store(cls, key: str, directory: str,
+                        step: int) -> "CheckpointManager":
+        from kubetorch_tpu.data_store import commands as store
+
+        manager = cls(directory)
+        store.get(f"{key}/{step}", manager.directory / str(step))
+        # Orbax CheckpointManager scans the dir lazily; recreate to pick up.
+        return cls(directory)
+
+
+def save_for_resume(directory: str, state: Any, step: int):
+    """One-shot save (preemption-recovery pattern,
+    reference: examples/tutorials/fault_tolerance/preemption_recovery.py)."""
+    manager = CheckpointManager(directory)
+    manager.save(step, state, wait=True)
+    return step
+
+
+def resume_or_init(directory: str, init_fn, *init_args) -> tuple:
+    """Return (state, step): restore the newest checkpoint if one exists,
+    else initialize fresh."""
+    manager = CheckpointManager(directory)
+    latest = manager.latest_step()
+    state = init_fn(*init_args)
+    if latest is None:
+        return state, 0
+    return manager.restore(state), latest
