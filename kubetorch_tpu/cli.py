@@ -158,8 +158,40 @@ def describe(service):
 @click.argument("service")
 @click.option("--pod", type=int, default=None)
 @click.option("--tail", type=int, default=200)
-def logs(service, pod, tail):
-    """Show service logs."""
+@click.option("--follow", "-f", is_flag=True,
+              help="live-tail from the controller log sink")
+@click.option("--level", default=None, help="filter by level label")
+@click.option("--request-id", default=None, help="filter by request id")
+def logs(service, pod, tail, follow, level, request_id):
+    """Show service logs (backend logs, or the controller sink with -f)."""
+    from kubetorch_tpu.config import get_config
+
+    controller_url = get_config().controller_url
+    filters = {k: v for k, v in
+               {"level": level, "request_id": request_id}.items() if v}
+    if (follow or filters) and controller_url:
+        from kubetorch_tpu.observability.streaming import (
+            format_entry,
+            iter_logs,
+            query_logs,
+        )
+
+        if follow:
+            try:
+                for entry in iter_logs(controller_url, service=service,
+                                       **filters):
+                    click.echo(format_entry(entry))
+            except KeyboardInterrupt:
+                pass
+        else:
+            for entry in query_logs(controller_url, service=service,
+                                    limit=tail, **filters):
+                click.echo(format_entry(entry))
+        return
+    if follow or filters:
+        raise click.ClickException(
+            "--follow/--level/--request-id need a controller log sink; "
+            "set controller_url (ktpu config controller_url=http://...)")
     from kubetorch_tpu.provisioning.backend import get_backend
 
     click.echo(get_backend().logs(service, pod, tail))

@@ -128,6 +128,12 @@ class ControllerServer:
         self._reaper_task: Optional[asyncio.Task] = None
         self.auth_token = os.environ.get("KT_CONTROLLER_TOKEN") or None
         self.cluster_config: Dict[str, Any] = {}
+        # Controller-hosted observability sinks (SURVEY.md §5.5; reference
+        # deploys Loki + Prometheus as separate components).
+        from kubetorch_tpu.observability.log_sink import LogSink, MetricsStore
+
+        self.log_sink = LogSink()
+        self.metrics_store = MetricsStore()
 
     # ------------------------------------------------------------- app
     def build_app(self) -> web.Application:
@@ -154,6 +160,9 @@ class ControllerServer:
         r.add_delete("/runs/{run_id}", self.h_delete_run)
         r.add_post("/apply", self.h_apply)
         r.add_post("/teardown/{service}", self.h_teardown_pool)
+        from kubetorch_tpu.observability import log_sink as _ls
+
+        _ls.mount(app, self.log_sink, self.metrics_store)
         app.on_startup.append(self._on_startup)
         app.on_shutdown.append(self._on_shutdown)
         return app
@@ -231,6 +240,8 @@ class ControllerServer:
     async def h_teardown_pool(self, request):
         service = request.match_info["service"]
         deleted = self.db.delete_pool(service)
+        self.log_sink.drop_stream(service)
+        self.metrics_store.drop(service)
         # Cascading delete: backend resources (reference:
         # helpers/delete_helpers.py).
         try:
@@ -355,9 +366,15 @@ class ControllerServer:
                     if ttl is None:
                         continue
                     last = pool.get("last_active") or pool["created_at"]
+                    pushed = self.metrics_store.last_activity(
+                        pool["service_name"])
+                    if pushed:
+                        last = max(last, pushed)
                     if now - last > ttl:
                         service = pool["service_name"]
                         self.db.delete_pool(service)
+                        self.log_sink.drop_stream(service)
+                        self.metrics_store.drop(service)
                         try:
                             from kubetorch_tpu.provisioning.backend import (
                                 get_backend,

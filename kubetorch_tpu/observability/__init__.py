@@ -1,0 +1,29 @@
+"""Observability: logs, metrics, and streaming (SURVEY.md §5.5).
+
+The reference ships three pipelines — LogCapture→Loki
+(``serving/log_capture.py:30``), MetricsPusher→Prometheus
+(``serving/metrics_push.py:20``), and a controller event watcher
+(``event_watcher.py``) — all deployed as separate cluster components. The TPU
+rebuild keeps the same shape but hosts the sinks *inside the controller*
+(one fewer moving part; the sink API is Loki-shaped so a real Loki can be
+swapped in behind the same routes).
+"""
+
+from kubetorch_tpu.observability.log_capture import LogCapture
+from kubetorch_tpu.observability.log_sink import LogSink, MetricsStore
+from kubetorch_tpu.observability.streaming import (
+    LogDeduplicator,
+    LogStreamer,
+    iter_logs,
+    query_logs,
+)
+
+__all__ = [
+    "LogCapture",
+    "LogSink",
+    "MetricsStore",
+    "LogDeduplicator",
+    "LogStreamer",
+    "iter_logs",
+    "query_logs",
+]

@@ -1,0 +1,244 @@
+"""In-process log capture: tee stdout/stderr/logging to the log sink.
+
+Reference: ``serving/log_capture.py:30`` — LogCapture replaces
+stdout/stderr and attaches a root-logger handler in every pod, batches 100
+entries / 1s, and pushes to Loki with labels
+service/pod/namespace/level/request_id/source; ``kubectl logs`` keeps working
+because writes tee through to the original streams. Same design here, pushing
+to the controller-hosted sink (``observability/log_sink.py``).
+
+Installed in two places:
+- the pod server process (``serving/server.py`` startup), and
+- every worker subprocess (``serving/process_worker.py:worker_main``) — the
+  reference forwards subprocess logs over a queue; pushing straight from the
+  worker is simpler and labels each line with its rank.
+
+Request-ID spine: the pod server stamps ``KT_REQUEST_ID`` into the worker's
+env for each call (reference threads a contextvar,
+``serving/http_server.py:1237``); labels are resolved per-line so the live
+request id / RANK are picked up.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import logging
+import os
+import queue
+import socket
+import sys
+import threading
+import time
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+_BATCH_SIZE = 100
+_FLUSH_INTERVAL = 1.0
+
+_installed: Optional["LogCapture"] = None
+
+
+class _TeeStream:
+    """File-like wrapper: writes pass through to the original stream and
+    complete lines are emitted to the capture."""
+
+    def __init__(self, original, capture: "LogCapture", source: str):
+        self.original = original
+        self.capture = capture
+        self.source = source
+        self._buf = ""
+
+    def write(self, s: str) -> int:
+        try:
+            n = self.original.write(s)
+        except Exception:
+            n = len(s)
+        self._buf += s
+        while "\n" in self._buf:
+            line, self._buf = self._buf.split("\n", 1)
+            if line.strip():
+                self.capture.emit(line, source=self.source)
+        return n if isinstance(n, int) else len(s)
+
+    def flush(self):
+        try:
+            self.original.flush()
+        except Exception:
+            pass
+
+    def isatty(self) -> bool:
+        return False
+
+    def fileno(self):
+        return self.original.fileno()
+
+    @property
+    def encoding(self):
+        return getattr(self.original, "encoding", "utf-8")
+
+
+class _CaptureHandler(logging.Handler):
+    def __init__(self, capture: "LogCapture"):
+        super().__init__()
+        self.capture = capture
+
+    def emit(self, record: logging.LogRecord):
+        try:
+            self.capture.emit(
+                self.format(record), source="logging",
+                level=record.levelname.lower())
+        except Exception:
+            pass
+
+
+class LogCapture:
+    """Batched push of captured lines to the sink.
+
+    ``labels_fn`` (optional) is called per line and may return dynamic labels
+    (request_id, rank) merged over the static ones.
+    """
+
+    def __init__(
+        self,
+        sink_url: str,
+        labels: Dict[str, str],
+        labels_fn: Optional[Callable[[], Dict[str, str]]] = None,
+    ):
+        self.sink_url = sink_url.rstrip("/")
+        self.labels = dict(labels)
+        self.labels_fn = labels_fn or _default_dynamic_labels
+        self._queue: "queue.Queue[dict]" = queue.Queue(maxsize=100_000)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._orig_stdout = None
+        self._orig_stderr = None
+        self._handler: Optional[_CaptureHandler] = None
+
+    # ------------------------------------------------------------- emit
+    def emit(self, line: str, source: str = "stdout",
+             level: Optional[str] = None):
+        labels = {**self.labels, "source": source}
+        if level:
+            labels["level"] = level
+        try:
+            dynamic = self.labels_fn()
+            if dynamic:
+                labels.update({k: v for k, v in dynamic.items() if v})
+        except Exception:
+            pass
+        entry = {"ts": time.time(), "line": line[:16384], "labels": labels}
+        try:
+            self._queue.put_nowait(entry)
+        except queue.Full:
+            pass
+
+    # ---------------------------------------------------------- install
+    def install(self):
+        global _installed
+        if _installed is not None:
+            return _installed
+        self._orig_stdout, self._orig_stderr = sys.stdout, sys.stderr
+        sys.stdout = _TeeStream(self._orig_stdout, self, "stdout")
+        sys.stderr = _TeeStream(self._orig_stderr, self, "stderr")
+        # Root-logger handler: formatted records with a level label. Existing
+        # StreamHandlers hold references to the *original* stderr object, so
+        # records are not double-captured through the tee.
+        self._handler = _CaptureHandler(self)
+        self._handler.setFormatter(logging.Formatter("%(name)s: %(message)s"))
+        logging.getLogger().addHandler(self._handler)
+        self._thread = threading.Thread(
+            target=self._pusher, daemon=True, name="kt-log-push")
+        self._thread.start()
+        atexit.register(self.flush)
+        _installed = self
+        return self
+
+    def uninstall(self):
+        global _installed
+        if self._orig_stdout is not None:
+            sys.stdout = self._orig_stdout
+        if self._orig_stderr is not None:
+            sys.stderr = self._orig_stderr
+        if self._handler is not None:
+            logging.getLogger().removeHandler(self._handler)
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(2.0)
+        if _installed is self:
+            _installed = None
+
+    # ------------------------------------------------------------ pusher
+    def _pusher(self):
+        while not self._stop.is_set():
+            batch = self._drain(block=True)
+            if batch:
+                self._post(batch)
+
+    def _drain(self, block: bool) -> List[dict]:
+        batch: List[dict] = []
+        deadline = time.time() + _FLUSH_INTERVAL
+        while len(batch) < _BATCH_SIZE:
+            timeout = deadline - time.time()
+            if timeout <= 0:
+                break
+            try:
+                batch.append(self._queue.get(
+                    timeout=timeout if block else 0.001))
+            except queue.Empty:
+                break
+        return batch
+
+    def flush(self, timeout: float = 3.0):
+        """Synchronously drain and push whatever is queued (atexit + tests)."""
+        deadline = time.time() + timeout
+        while not self._queue.empty() and time.time() < deadline:
+            batch = self._drain(block=False)
+            if not batch:
+                break
+            self._post(batch)
+
+    def _post(self, batch: List[dict]):
+        data = json.dumps({"entries": batch}).encode()
+        headers = {"Content-Type": "application/json"}
+        token = os.environ.get("KT_CONTROLLER_TOKEN")
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        req = urllib.request.Request(
+            f"{self.sink_url}/logs/push", data=data, headers=headers)
+        try:
+            urllib.request.urlopen(req, timeout=5.0).read()
+        except Exception:
+            pass  # sink unreachable: lines still reached the real stream
+
+
+def _default_dynamic_labels() -> Dict[str, str]:
+    labels = {}
+    rid = os.environ.get("KT_REQUEST_ID")
+    if rid:
+        labels["request_id"] = rid
+    rank = os.environ.get("RANK")
+    if rank:
+        labels["rank"] = rank
+    return labels
+
+
+def install_from_env(source_hint: str = "pod") -> Optional[LogCapture]:
+    """Install capture if a sink is configured (both pod server and worker
+    subprocesses call this; env is inherited through spawn)."""
+    if os.environ.get("KT_DISABLE_LOG_STREAMING") == "1":
+        return None
+    sink = (os.environ.get("KT_LOG_SINK_URL")
+            or os.environ.get("KT_CONTROLLER_URL"))
+    if not sink:
+        return None
+    labels = {
+        "service": os.environ.get("KT_SERVICE_NAME", "unknown"),
+        "pod": os.environ.get("KT_POD_NAME", socket.gethostname()),
+        "namespace": os.environ.get("KT_NAMESPACE", ""),
+        "level": "info",
+    }
+    if source_hint == "worker":
+        labels["worker"] = "1"
+    capture = LogCapture(sink, labels)
+    return capture.install()

@@ -1,0 +1,220 @@
+"""Loki-shaped log sink + metrics store, mounted into the controller app.
+
+Reference topology: a namespace-local Loki in the data-store pod receives
+batched pushes from every pod's LogCapture (``serving/log_capture.py:30``) and
+serves WS tails to clients (``serving/http_client.py:437``); Prometheus
+receives activity metrics that feed the TTL reaper
+(``services/kubetorch_controller/ttl_controller.py:49``). Here both sinks are
+in-process ring buffers behind HTTP routes with the same label semantics
+(service/pod/level/request_id/source), so the client UX — live tails during
+calls and launches, filtered queries — works with zero extra deployments.
+
+Routes (mounted by ``ControllerServer.build_app``):
+- ``POST /logs/push``                  {"entries": [{ts, line, labels}]}
+- ``GET  /logs/query?service=&pod=&level=&request_id=&source=&since=&limit=``
+- ``WS   /logs/tail?service=&...``     live tail with the same filters
+- ``POST /metrics/push``               {"service", "pod", "metrics"}
+- ``GET  /metrics/query/{service}``    latest snapshot per pod
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from aiohttp import WSMsgType, web
+
+_FILTER_LABELS = ("service", "pod", "level", "request_id", "source", "job")
+
+
+def _matches(entry: Dict[str, Any], filters: Dict[str, str]) -> bool:
+    labels = entry.get("labels", {})
+    for key, want in filters.items():
+        if want and labels.get(key) != want:
+            return False
+    return True
+
+
+class LogSink:
+    """In-memory label-indexed log store with live-tail subscriptions."""
+
+    def __init__(self, max_entries_per_stream: int = 50_000,
+                 max_streams: int = 500):
+        self.max_entries = max_entries_per_stream
+        self.max_streams = max_streams
+        self._streams: Dict[str, deque] = {}
+        self._subscribers: List[tuple] = []  # (asyncio.Queue, filters)
+
+    # ------------------------------------------------------------- core
+    def _stream_key(self, labels: Dict[str, Any]) -> str:
+        return labels.get("service") or labels.get("job") or "_default"
+
+    def push(self, entries: List[Dict[str, Any]]):
+        for entry in entries:
+            key = self._stream_key(entry.get("labels", {}))
+            stream = self._streams.get(key)
+            if stream is None:
+                if len(self._streams) >= self.max_streams:
+                    # evict the stalest stream
+                    oldest = min(
+                        self._streams,
+                        key=lambda k: (self._streams[k][-1]["ts"]
+                                       if self._streams[k] else 0))
+                    del self._streams[oldest]
+                stream = self._streams[key] = deque(maxlen=self.max_entries)
+            stream.append(entry)
+        for queue, filters in list(self._subscribers):
+            for entry in entries:
+                if _matches(entry, filters):
+                    try:
+                        queue.put_nowait(entry)
+                    except asyncio.QueueFull:
+                        pass
+
+    def query(
+        self,
+        filters: Dict[str, str],
+        since: float = 0.0,
+        limit: int = 1000,
+    ) -> List[Dict[str, Any]]:
+        key = filters.get("service") or filters.get("job")
+        streams = ([self._streams[key]] if key and key in self._streams
+                   else ([] if key else list(self._streams.values())))
+        out: List[Dict[str, Any]] = []
+        for stream in streams:
+            for entry in stream:
+                if entry["ts"] >= since and _matches(entry, filters):
+                    out.append(entry)
+        out.sort(key=lambda e: e["ts"])
+        return out[-limit:]
+
+    def subscribe(self, filters: Dict[str, str]) -> asyncio.Queue:
+        queue: asyncio.Queue = asyncio.Queue(maxsize=10_000)
+        self._subscribers.append((queue, filters))
+        return queue
+
+    def unsubscribe(self, queue: asyncio.Queue):
+        self._subscribers = [
+            (q, f) for q, f in self._subscribers if q is not queue]
+
+    def drop_stream(self, service: str):
+        """Teardown hook: forget a service's logs (reference: cascading
+        delete clears Loki streams, ``helpers/delete_helpers.py``)."""
+        self._streams.pop(service, None)
+
+    # ---------------------------------------------------------- handlers
+    def _filters_from(self, request: web.Request) -> Dict[str, str]:
+        return {k: request.query[k] for k in _FILTER_LABELS
+                if request.query.get(k)}
+
+    async def h_push(self, request: web.Request):
+        body = await request.json()
+        entries = body.get("entries", [])
+        now = time.time()
+        for entry in entries:
+            entry.setdefault("ts", now)
+            entry.setdefault("labels", {})
+        self.push(entries)
+        return web.json_response({"accepted": len(entries)})
+
+    async def h_query(self, request: web.Request):
+        entries = self.query(
+            self._filters_from(request),
+            since=float(request.query.get("since", 0) or 0),
+            limit=int(request.query.get("limit", 1000)))
+        return web.json_response({"entries": entries})
+
+    async def h_tail(self, request: web.Request):
+        ws = web.WebSocketResponse(heartbeat=30.0)
+        await ws.prepare(request)
+        filters = self._filters_from(request)
+        since = float(request.query.get("since", 0) or 0)
+        queue = self.subscribe(filters)
+        recv = None
+        try:
+            # Replay history first so tails started mid-launch see the start.
+            for entry in self.query(filters, since=since, limit=1000):
+                await ws.send_json(entry)
+            recv = asyncio.ensure_future(ws.receive())
+            while True:
+                get = asyncio.ensure_future(queue.get())
+                done, _ = await asyncio.wait(
+                    {recv, get}, return_when=asyncio.FIRST_COMPLETED)
+                if recv in done:
+                    msg = recv.result()
+                    get.cancel()
+                    if msg.type in (WSMsgType.CLOSE, WSMsgType.CLOSING,
+                                    WSMsgType.ERROR, WSMsgType.CLOSED):
+                        break
+                    recv = asyncio.ensure_future(ws.receive())
+                    continue
+                await ws.send_json(get.result())
+        finally:
+            self.unsubscribe(queue)
+            if recv is not None and not recv.done():
+                recv.cancel()
+        return ws
+
+
+class MetricsStore:
+    """Latest-snapshot-per-pod metrics store (Prometheus stand-in).
+
+    Feeds the TTL reaper the same signal the reference scrapes:
+    ``kubetorch_last_activity_timestamp`` per service
+    (``serving/metrics_push.py:20``; reaper ``ttl_controller.py:49``).
+    """
+
+    def __init__(self, history: int = 60):
+        self.history = history
+        # service -> pod -> deque[{ts, metrics}]
+        self._data: Dict[str, Dict[str, deque]] = {}
+
+    def push(self, service: str, pod: str, metrics: Dict[str, Any]):
+        pods = self._data.setdefault(service, {})
+        ring = pods.setdefault(pod, deque(maxlen=self.history))
+        ring.append({"ts": time.time(), "metrics": metrics})
+
+    def latest(self, service: str) -> Dict[str, Dict[str, Any]]:
+        return {pod: ring[-1] for pod, ring in
+                self._data.get(service, {}).items() if ring}
+
+    def series(self, service: str, pod: str) -> List[Dict[str, Any]]:
+        return list(self._data.get(service, {}).get(pod, []))
+
+    def last_activity(self, service: str) -> Optional[float]:
+        stamps = [
+            snap["metrics"].get("last_activity_timestamp")
+            for snap in self.latest(service).values()
+            if snap["metrics"].get("last_activity_timestamp")]
+        return max(stamps) if stamps else None
+
+    def drop(self, service: str):
+        self._data.pop(service, None)
+
+    # ---------------------------------------------------------- handlers
+    async def h_push(self, request: web.Request):
+        body = await request.json()
+        self.push(body["service"], body.get("pod", "unknown"),
+                  body.get("metrics", {}))
+        return web.json_response({"ok": True})
+
+    async def h_query(self, request: web.Request):
+        service = request.match_info["service"]
+        return web.json_response({
+            "service": service,
+            "pods": {pod: snap for pod, snap in
+                     self.latest(service).items()},
+            "last_activity": self.last_activity(service),
+        })
+
+
+def mount(app: web.Application, sink: LogSink, metrics: MetricsStore):
+    """Attach sink + metrics routes to an aiohttp app."""
+    app.router.add_post("/logs/push", sink.h_push)
+    app.router.add_get("/logs/query", sink.h_query)
+    app.router.add_get("/logs/tail", sink.h_tail)
+    app.router.add_post("/metrics/push", metrics.h_push)
+    app.router.add_get("/metrics/query/{service}", metrics.h_query)

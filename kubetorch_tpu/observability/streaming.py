@@ -1,0 +1,212 @@
+"""Client-side log/metrics streaming from the controller-hosted sinks.
+
+Reference: ``serving/http_client.py`` — WS log streaming from Loki
+(``_stream_logs_websocket:437``), metrics polling during calls
+(``_collect_metrics_common:797``), and cross-replica log dedup
+(``LogDeduplicator:41``). The launch path streams logs + K8s events live
+while pods come up (``module.py:1028``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional
+
+import httpx
+
+
+def _auth_headers() -> Dict[str, str]:
+    """Bearer token for a token-guarded controller (matches
+    ``ControllerClient``'s auth)."""
+    token = os.environ.get("KT_CONTROLLER_TOKEN")
+    return {"Authorization": f"Bearer {token}"} if token else {}
+
+
+class LogDeduplicator:
+    """Drop identical lines arriving from multiple replicas within a window.
+
+    Reference: ``serving/http_client.py:41`` — replicas of a service often
+    log the same line (e.g. per-epoch progress under data parallelism); the
+    stream shows it once.
+    """
+
+    def __init__(self, window_s: float = 2.0):
+        self.window_s = window_s
+        self._seen: Dict[str, float] = {}
+
+    def admit(self, entry: dict) -> bool:
+        line = entry.get("line", "")
+        digest = hashlib.md5(line.encode()).hexdigest()
+        now = time.time()
+        # opportunistic cleanup
+        if len(self._seen) > 4096:
+            self._seen = {k: v for k, v in self._seen.items()
+                          if now - v < self.window_s}
+        last = self._seen.get(digest)
+        self._seen[digest] = now
+        return last is None or (now - last) >= self.window_s
+
+
+def query_logs(
+    sink_url: str,
+    service: Optional[str] = None,
+    since: float = 0.0,
+    limit: int = 1000,
+    **filters: str,
+) -> List[dict]:
+    """One-shot filtered query against the sink."""
+    params = {k: v for k, v in
+              {"service": service, "since": since or None,
+               "limit": limit, **filters}.items() if v}
+    resp = httpx.get(f"{sink_url.rstrip('/')}/logs/query", params=params,
+                     headers=_auth_headers(), timeout=10.0)
+    resp.raise_for_status()
+    return resp.json()["entries"]
+
+
+def iter_logs(
+    sink_url: str,
+    service: Optional[str] = None,
+    follow: bool = True,
+    since: float = 0.0,
+    stop_event: Optional[threading.Event] = None,
+    **filters: str,
+) -> Iterator[dict]:
+    """Yield log entries; with ``follow`` keeps a live WS tail open.
+
+    Runs an aiohttp WS client on a private loop in this (calling) thread.
+    """
+    if not follow:
+        yield from query_logs(sink_url, service=service, since=since,
+                              **filters)
+        return
+
+    out: "asyncio.Queue" = None  # populated inside the loop
+    entries_q: List[dict] = []
+    lock = threading.Lock()
+    done = threading.Event()
+    stop_event = stop_event or threading.Event()
+
+    async def pump():
+        import aiohttp
+
+        params = {k: str(v) for k, v in
+                  {"service": service, "since": since or None,
+                   **filters}.items() if v}
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.ws_connect(
+                        f"{sink_url.rstrip('/')}/logs/tail",
+                        params=params, headers=_auth_headers(),
+                        heartbeat=30.0) as ws:
+                    while not stop_event.is_set():
+                        try:
+                            msg = await asyncio.wait_for(
+                                ws.receive(), timeout=0.25)
+                        except asyncio.TimeoutError:
+                            continue
+                        if msg.type == aiohttp.WSMsgType.TEXT:
+                            with lock:
+                                entries_q.append(json.loads(msg.data))
+                        else:
+                            break
+        except Exception:
+            pass
+        finally:
+            done.set()
+
+    thread = threading.Thread(target=lambda: asyncio.run(pump()),
+                              daemon=True, name="kt-log-tail")
+    thread.start()
+    try:
+        while not (done.is_set() and not entries_q):
+            with lock:
+                batch, entries_q[:] = entries_q[:], []
+            yield from batch
+            if stop_event.is_set() and not batch:
+                break
+            if not batch:
+                time.sleep(0.1)
+    finally:
+        stop_event.set()
+        thread.join(2.0)
+
+
+def format_entry(entry: dict) -> str:
+    labels = entry.get("labels", {})
+    ts = time.strftime("%H:%M:%S", time.localtime(entry.get("ts", 0)))
+    pod = labels.get("pod", "")
+    rank = labels.get("rank")
+    tag = f"{pod}" + (f"/r{rank}" if rank else "")
+    return f"[{ts} {tag}] {entry.get('line', '')}"
+
+
+class LogStreamer:
+    """Background live tail printing to a callback; used during `.to()`
+    launches and (opt-in) during calls (reference: module.py:1028
+    ``_stream_launch_logs`` and http_client.py:956 ``stream_logs``)."""
+
+    def __init__(
+        self,
+        sink_url: str,
+        service: str,
+        printer: Callable[[str], None] = print,
+        dedup: bool = True,
+        **filters: str,
+    ):
+        self.sink_url = sink_url
+        self.service = service
+        self.printer = printer
+        self.filters = filters
+        self.dedup = LogDeduplicator() if dedup else None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "LogStreamer":
+        def run():
+            for entry in iter_logs(
+                    self.sink_url, service=self.service, follow=True,
+                    since=time.time() - 5.0, stop_event=self._stop,
+                    **self.filters):
+                if self.dedup is None or self.dedup.admit(entry):
+                    try:
+                        self.printer(format_entry(entry))
+                    except Exception:
+                        pass
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="kt-log-stream")
+        self._thread.start()
+        return self
+
+    def stop(self, linger: float = 0.5):
+        time.sleep(linger)  # let in-flight batches land
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(2.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def poll_metrics(
+    controller_url: str, service: str, timeout: float = 5.0
+) -> Optional[dict]:
+    """Latest per-pod metrics snapshot (reference:
+    ``_collect_metrics_common:797``)."""
+    try:
+        resp = httpx.get(
+            f"{controller_url.rstrip('/')}/metrics/query/{service}",
+            headers=_auth_headers(), timeout=timeout)
+        resp.raise_for_status()
+        return resp.json()
+    except httpx.HTTPError:
+        return None

@@ -113,6 +113,7 @@ class LocalBackend:
                 "KT_SERVICE_NAME": service_name,
                 "KT_SERVER_PORT": str(port),
                 "KT_REPLICA_INDEX": str(index),
+                "KT_POD_NAME": f"{service_name}-{index}",
                 "KT_LAUNCH_ID": launch_id,
                 "LOCAL_IPS": local_ips,
                 # workers must not inherit the client's TPU tunnel config

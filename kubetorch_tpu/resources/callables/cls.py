@@ -19,10 +19,12 @@ class _MethodProxy:
         self._method = method
 
     def __call__(self, *args, serialization: Optional[str] = None,
-                 timeout: Optional[float] = None, **kwargs) -> Any:
+                 timeout: Optional[float] = None,
+                 stream_logs: Optional[bool] = None, **kwargs) -> Any:
         return self._owner._call_remote(
             method=self._method, args=args, kwargs=kwargs,
-            serialization=serialization, timeout=timeout)
+            serialization=serialization, timeout=timeout,
+            stream_logs=stream_logs)
 
     async def acall(self, *args, serialization: Optional[str] = None,
                     timeout: Optional[float] = None, **kwargs) -> Any:

@@ -18,10 +18,12 @@ class Fn(Module):
 
     def __call__(self, *args: Any, serialization: Optional[str] = None,
                  timeout: Optional[float] = None, workers: str = "",
-                 restart_procs: bool = False, **kwargs: Any) -> Any:
+                 restart_procs: bool = False,
+                 stream_logs: Optional[bool] = None, **kwargs: Any) -> Any:
         return self._call_remote(
             args=args, kwargs=kwargs, serialization=serialization,
-            timeout=timeout, workers=workers, restart_procs=restart_procs)
+            timeout=timeout, workers=workers, restart_procs=restart_procs,
+            stream_logs=stream_logs)
 
     async def acall(self, *args: Any, serialization: Optional[str] = None,
                     timeout: Optional[float] = None, **kwargs: Any) -> Any:

@@ -127,20 +127,41 @@ class Module:
 
     # ------------------------------------------------------------------
     def to(self, compute: Compute, name: Optional[str] = None) -> "Module":
-        """Deploy this module onto ``compute`` (reference: Module.to:516)."""
+        """Deploy this module onto ``compute`` (reference: Module.to:516).
+
+        While the launch waits for readiness, pod logs stream live from the
+        controller sink (reference: module.py:1028 _stream_launch_logs runs a
+        parallel Loki/event tail thread)."""
         self.compute = compute
         self.service_name = self._compute_service_name(name)
         self._launch_id = uuid.uuid4().hex[:8]
-        self.backend.launch(
-            self.service_name,
-            module_env=self._module_env(),
-            compute_dict=compute.to_dict(),
-            module_meta=self.module_metadata(),
-            num_pods=compute.num_pods,
-            launch_timeout=compute.launch_timeout,
-            launch_id=self._launch_id,
-        )
+        streamer = self._maybe_stream_logs()
+        try:
+            self.backend.launch(
+                self.service_name,
+                module_env=self._module_env(),
+                compute_dict=compute.to_dict(),
+                module_meta=self.module_metadata(),
+                num_pods=compute.num_pods,
+                launch_timeout=compute.launch_timeout,
+                launch_id=self._launch_id,
+            )
+        finally:
+            if streamer is not None:
+                streamer.stop()
         return self
+
+    def _maybe_stream_logs(self):
+        """Start a background sink tail for this service if configured."""
+        cfg = get_config()
+        if not cfg.stream_logs or not cfg.controller_url:
+            return None
+        try:
+            from kubetorch_tpu.observability.streaming import LogStreamer
+
+            return LogStreamer(cfg.controller_url, self.service_name).start()
+        except Exception:
+            return None
 
     async def to_async(self, compute: Compute,
                        name: Optional[str] = None) -> "Module":
@@ -230,17 +251,22 @@ class Module:
         cfg = get_config()
         allowed = (self.compute.allowed_serialization
                    if self.compute else ("json", "pickle"))
-        return http_client.call_method(
-            self.service_url(),
-            self.callable_name or self.service_name,
-            method=method,
-            args=args,
-            kwargs=kwargs or {},
-            ser=serialization or cfg.serialization,
-            allowed=allowed,
-            timeout=timeout,
-            query={k: str(v).lower() for k, v in query.items() if v},
-        )
+        streamer = self._maybe_stream_logs() if stream_logs else None
+        try:
+            return http_client.call_method(
+                self.service_url(),
+                self.callable_name or self.service_name,
+                method=method,
+                args=args,
+                kwargs=kwargs or {},
+                ser=serialization or cfg.serialization,
+                allowed=allowed,
+                timeout=timeout,
+                query={k: str(v).lower() for k, v in query.items() if v},
+            )
+        finally:
+            if streamer is not None:
+                streamer.stop()
 
     async def _call_remote_async(
         self,

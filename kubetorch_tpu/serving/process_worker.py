@@ -138,6 +138,15 @@ def worker_main(request_q, response_q, env: Dict[str, str]):
     """Entrypoint of the spawned process."""
     for key, value in env.items():
         os.environ[key] = str(value)
+    # Stream this worker's stdout/stderr/logging to the log sink, labeled
+    # with rank + request id (reference forwards subprocess logs over a
+    # queue, serving/log_capture.py; direct push is simpler and per-rank).
+    try:
+        from kubetorch_tpu.observability.log_capture import install_from_env
+
+        install_from_env("worker")
+    except Exception:
+        pass
     try:
         asyncio.run(_WorkerLoop(request_q, response_q).run())
     except KeyboardInterrupt:

@@ -111,6 +111,9 @@ class PodServer:
         return app
 
     async def _on_startup(self, app):
+        from kubetorch_tpu.observability.log_capture import install_from_env
+
+        self.log_capture = install_from_env("pod")
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGTERM,):
             try:
@@ -156,24 +159,35 @@ class PodServer:
             self.app_proc.terminate()
 
     async def _activity_loop(self, controller_url: str):
-        """Push last-activity to the controller (metrics-push analog,
-        reference: serving/metrics_push.py:20 — feeds the TTL reaper)."""
-        service = self.metadata.get("service_name", "")
-        last_reported = 0.0
-        while True:
-            await asyncio.sleep(15.0)
-            ts = self.metrics["last_activity_timestamp"]
-            if ts <= last_reported:
-                continue
-            try:
-                import aiohttp as _aiohttp
+        """Push metrics + last-activity to the controller (metrics-push
+        analog, reference: serving/metrics_push.py:20 — the snapshot lands in
+        the controller MetricsStore and feeds the TTL reaper)."""
+        import socket as _socket
 
+        import aiohttp as _aiohttp
+
+        service = self.metadata.get("service_name", "")
+        pod = os.environ.get("KT_POD_NAME", _socket.gethostname())
+        token = os.environ.get("KT_CONTROLLER_TOKEN")
+        headers = {"Authorization": f"Bearer {token}"} if token else {}
+        last_reported = 0.0
+        interval = float(os.environ.get("KT_METRICS_INTERVAL", "15.0"))
+        while True:
+            await asyncio.sleep(interval)
+            ts = self.metrics["last_activity_timestamp"]
+            try:
                 async with ClientSession(
-                        timeout=_aiohttp.ClientTimeout(total=5.0)) as session:
+                        timeout=_aiohttp.ClientTimeout(total=5.0),
+                        headers=headers) as session:
                     await session.post(
-                        f"{controller_url.rstrip('/')}/pool/{service}"
-                        f"/activity")
-                last_reported = ts
+                        f"{controller_url.rstrip('/')}/metrics/push",
+                        json={"service": service, "pod": pod,
+                              "metrics": dict(self.metrics)})
+                    if ts > last_reported:
+                        await session.post(
+                            f"{controller_url.rstrip('/')}/pool/{service}"
+                            f"/activity")
+                        last_reported = ts
             except Exception:
                 pass
 
@@ -349,7 +363,8 @@ class PodServer:
                     body, ser, method=method,
                     distributed_subcall=distributed_subcall,
                     restart_procs=restart_procs, workers=workers,
-                    query=dict(request.query)))
+                    query=dict(request.query),
+                    request_id=request_id_var.get()))
         except Exception as exc:
             return web.json_response(package_exception(exc), status=500)
         if resp is None:

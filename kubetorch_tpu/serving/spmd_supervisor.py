@@ -208,23 +208,31 @@ class SPMDDistributedSupervisor(DistributedSupervisor):
         restart_procs: bool = False,
         workers: str = "all",
         query: Optional[Dict[str, str]] = None,
+        request_id: Optional[str] = None,
     ) -> dict:
         query = query or {}
+        # Request-ID log spine: the coordinator's id wins; subcalls inherit
+        # it via the forwarded query string and stamp it into worker env.
+        # Threaded explicitly (not instance state) so concurrent calls don't
+        # cross-contaminate each other's labels.
+        rid = query.get("_rid") or request_id or ""
         if restart_procs:
             self.pool.restart(self._per_rank_env())
             self._setup_callable()
         if distributed_subcall:
-            return self._subcall(body, serialization_method, method, query)
+            return self._subcall(body, serialization_method, method, query,
+                                 rid)
         return self._coordinate(
-            body, serialization_method, method, workers)
+            body, serialization_method, method, workers, rid)
 
     # ------------------------------------------------------------------
     def _rank_envs(self, node_rank: int, num_nodes: int,
-                   members: List[str]) -> List[Dict[str, str]]:
+                   members: List[str], rid: str = "") -> List[Dict[str, str]]:
         fw = self.framework(self.num_procs)
+        extra = {"KT_REQUEST_ID": rid} if rid else {}
         return [
-            fw.rank_env(node_rank=node_rank, local_rank=i,
-                        num_nodes=num_nodes, pod_ips=members)
+            {**fw.rank_env(node_rank=node_rank, local_rank=i,
+                           num_nodes=num_nodes, pod_ips=members), **extra}
             for i in range(self.num_procs)
         ]
 
@@ -235,7 +243,7 @@ class SPMDDistributedSupervisor(DistributedSupervisor):
         return [by_rank.get(r) for r in range(total_ranks)]
 
     # ------------------------------------------------------------------
-    def _coordinate(self, body, ser, method, workers_mode) -> dict:
+    def _coordinate(self, body, ser, method, workers_mode, rid="") -> dict:
         members = self.discover()
         self_index, _ = self.self_entry(members)
         if self_index != 0:
@@ -257,7 +265,7 @@ class SPMDDistributedSupervisor(DistributedSupervisor):
 
         try:
             pairs, error = self._fan_and_collect(
-                body, ser, method, members, node_rank=0)
+                body, ser, method, members, node_rank=0, rid=rid)
             if error is not None:
                 raise error
             return self._pack_result(
@@ -265,11 +273,11 @@ class SPMDDistributedSupervisor(DistributedSupervisor):
         finally:
             pass  # monitor keeps running between calls (reference behavior)
 
-    def _subcall(self, body, ser, method, query) -> dict:
+    def _subcall(self, body, ser, method, query, rid="") -> dict:
         node_rank = int(query.get("node_rank", "0"))
         members = [m for m in (query.get("members") or "").split(",") if m]
         pairs, error = self._fan_and_collect(
-            body, ser, method, members, node_rank=node_rank)
+            body, ser, method, members, node_rank=node_rank, rid=rid)
         if error is not None:
             raise error
         return self._pack_result(pairs, None, ser, partial=True)
@@ -277,6 +285,7 @@ class SPMDDistributedSupervisor(DistributedSupervisor):
     # ------------------------------------------------------------------
     def _fan_and_collect(
         self, body, ser, method, members: List[str], node_rank: int,
+        rid: str = "",
     ) -> Tuple[List[Tuple[int, Any]], Optional[BaseException]]:
         """Run local ranks + this node's subtree; collect (rank, value)."""
         num_nodes = len(members)
@@ -298,12 +307,13 @@ class SPMDDistributedSupervisor(DistributedSupervisor):
                     "distributed_subcall": "true",
                     "node_rank": str(ci),
                     "members": ",".join(members),
+                    **({"_rid": rid} if rid else {}),
                 })
             child_futures.append((ci, fut))
 
         local_futures = self.pool.call_all_async(
             body, ser, method=method, allowed=self.allowed,
-            env_per_rank=self._rank_envs(my_index, num_nodes, members))
+            env_per_rank=self._rank_envs(my_index, num_nodes, members, rid))
 
         pairs: List[Tuple[int, Any]] = []
         error: Optional[BaseException] = None

@@ -72,15 +72,17 @@ class ExecutionSupervisor:
         restart_procs: bool = False,
         workers: str = "all",
         query: Optional[Dict[str, str]] = None,
+        request_id: Optional[str] = None,
     ) -> dict:
         """Execute one request; returns the worker response dict
         {ok, payload|error, serialization}."""
         if restart_procs:
             self.pool.restart(self._per_rank_env())
             self._setup_callable()
+        env = {"KT_REQUEST_ID": request_id} if request_id else {}
         return self.pool.call(
             body, serialization_method, method=method,
-            allowed=self.allowed, timeout=timeout)
+            allowed=self.allowed, timeout=timeout, env=env)
 
     # ------------------------------------------------------------------
     def healthy(self) -> bool:

@@ -43,3 +43,8 @@ class Counter:
 
     def pid(self):
         return os.getpid()
+
+
+def printer(message):
+    print(f"printed: {message}")
+    return message

@@ -1,0 +1,328 @@
+"""Observability: log sink push/query/tail, LogCapture tee + batching,
+metrics store + TTL signal, client streaming with dedup.
+
+Reference coverage model: ``tests/test_monitoring.py`` (467 LoC) asserts
+end-to-end log/metric streaming against deployed services; here the sink is
+controller-hosted so the loop closes in-process + over HTTP.
+"""
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+import httpx
+import pytest
+
+from kubetorch_tpu.observability.log_capture import LogCapture
+from kubetorch_tpu.observability.log_sink import LogSink, MetricsStore
+from kubetorch_tpu.observability.streaming import (
+    LogDeduplicator,
+    format_entry,
+    iter_logs,
+    query_logs,
+)
+
+pytestmark = pytest.mark.level("unit")
+
+
+def _entry(line, service="svc", **labels):
+    return {"ts": time.time(), "line": line,
+            "labels": {"service": service, **labels}}
+
+
+class TestLogSink:
+    def test_push_query_filters(self):
+        sink = LogSink()
+        sink.push([_entry("hello", pod="p0", level="info"),
+                   _entry("oops", pod="p1", level="error"),
+                   _entry("other", service="svc2")])
+        assert len(sink.query({"service": "svc"})) == 2
+        assert sink.query({"service": "svc", "level": "error"})[0][
+            "line"] == "oops"
+        assert sink.query({"service": "svc", "pod": "p0"})[0][
+            "line"] == "hello"
+        # no service filter → all streams
+        assert len(sink.query({})) == 3
+
+    def test_since_and_limit(self):
+        sink = LogSink()
+        old = {"ts": time.time() - 100, "line": "old",
+               "labels": {"service": "s"}}
+        sink.push([old, _entry("new", service="s")])
+        got = sink.query({"service": "s"}, since=time.time() - 10)
+        assert [e["line"] for e in got] == ["new"]
+        for i in range(10):
+            sink.push([_entry(f"l{i}", service="s")])
+        assert len(sink.query({"service": "s"}, limit=3)) == 3
+
+    def test_ring_cap_and_drop(self):
+        sink = LogSink(max_entries_per_stream=5)
+        for i in range(20):
+            sink.push([_entry(f"l{i}", service="s")])
+        assert len(sink.query({"service": "s"})) == 5
+        sink.drop_stream("s")
+        assert sink.query({"service": "s"}) == []
+
+    def test_request_id_filter(self):
+        sink = LogSink()
+        sink.push([_entry("a", request_id="r1"), _entry("b", request_id="r2")])
+        assert [e["line"] for e in
+                sink.query({"service": "svc", "request_id": "r2"})] == ["b"]
+
+
+class TestMetricsStore:
+    def test_push_latest_activity(self):
+        store = MetricsStore()
+        store.push("svc", "p0", {"last_activity_timestamp": 100.0})
+        store.push("svc", "p1", {"last_activity_timestamp": 200.0})
+        store.push("svc", "p0", {"last_activity_timestamp": 150.0})
+        assert store.last_activity("svc") == 200.0
+        latest = store.latest("svc")
+        assert latest["p0"]["metrics"]["last_activity_timestamp"] == 150.0
+        assert len(store.series("svc", "p0")) == 2
+        store.drop("svc")
+        assert store.last_activity("svc") is None
+
+
+class _FakeSink:
+    """Tiny HTTP sink recording pushes (stdlib server, no controller)."""
+
+    def __init__(self):
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        self.entries = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length))
+                outer.entries.extend(body.get("entries", []))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.server.server_port}"
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    def stop(self):
+        self.server.shutdown()
+
+
+@pytest.fixture
+def fake_sink():
+    sink = _FakeSink()
+    yield sink
+    sink.stop()
+
+
+class TestLogCapture:
+    def test_tee_and_push(self, fake_sink, capsys):
+        cap = LogCapture(fake_sink.url, {"service": "s", "pod": "p"})
+        cap.install()
+        try:
+            print("captured line")
+            logging.getLogger("t").warning("warned")
+        finally:
+            cap.flush()
+            cap.uninstall()
+        # tee-through: the real stdout still saw it
+        assert "captured line" in capsys.readouterr().out
+        lines = {e["line"]: e["labels"] for e in fake_sink.entries}
+        assert "captured line" in lines
+        assert lines["captured line"]["source"] == "stdout"
+        assert lines["captured line"]["service"] == "s"
+        warned = [k for k in lines if "warned" in k]
+        assert warned and lines[warned[0]]["level"] == "warning"
+
+    def test_dynamic_request_id_label(self, fake_sink, monkeypatch):
+        monkeypatch.setenv("KT_REQUEST_ID", "rid-42")
+        monkeypatch.setenv("RANK", "3")
+        cap = LogCapture(fake_sink.url, {"service": "s"})
+        cap.emit("ranked line")
+        cap.flush()
+        entry = fake_sink.entries[-1]
+        assert entry["labels"]["request_id"] == "rid-42"
+        assert entry["labels"]["rank"] == "3"
+
+
+class TestDedup:
+    def test_dedup_window(self):
+        dd = LogDeduplicator(window_s=60.0)
+        assert dd.admit({"line": "same"})
+        assert not dd.admit({"line": "same"})
+        assert dd.admit({"line": "different"})
+
+    def test_format(self):
+        s = format_entry(_entry("x", pod="p0", rank="1"))
+        assert "p0/r1" in s and s.endswith("x")
+
+
+@pytest.mark.level("minimal")
+class TestSinkOverHTTP:
+    """Controller-mounted sink over real HTTP (push → query → WS tail)."""
+
+    @pytest.fixture(scope="class")
+    def controller(self, tmp_path_factory):
+        import os
+        import socket
+        import subprocess
+        import sys
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        port = free_port()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kubetorch_tpu.controller.server",
+             "--host", "127.0.0.1", "--port", str(port), "--db", ":memory:"],
+            env={**os.environ}, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT)
+        url = f"http://127.0.0.1:{port}"
+        for _ in range(100):
+            try:
+                if httpx.get(f"{url}/health", timeout=2.0).status_code == 200:
+                    break
+            except httpx.HTTPError:
+                time.sleep(0.2)
+        else:
+            proc.kill()
+            raise RuntimeError("controller did not start")
+        yield url
+        proc.terminate()
+        proc.wait(5)
+
+    def test_push_then_query(self, controller):
+        httpx.post(f"{controller}/logs/push", json={"entries": [
+            {"line": "over http", "labels": {"service": "websvc"}}]})
+        entries = query_logs(controller, service="websvc")
+        assert entries and entries[0]["line"] == "over http"
+
+    def test_ws_tail_receives_live_pushes(self, controller):
+        got = []
+        stop = threading.Event()
+
+        def consume():
+            for entry in iter_logs(controller, service="tailsvc",
+                                   follow=True, stop_event=stop):
+                got.append(entry)
+                if len(got) >= 2:
+                    stop.set()
+
+        thread = threading.Thread(target=consume, daemon=True)
+        thread.start()
+        time.sleep(0.5)
+        for i in range(2):
+            httpx.post(f"{controller}/logs/push", json={"entries": [
+                {"line": f"live-{i}", "labels": {"service": "tailsvc"}}]})
+            time.sleep(0.2)
+        thread.join(10.0)
+        stop.set()
+        assert [e["line"] for e in got][:2] == ["live-0", "live-1"]
+
+    def test_metrics_push_query(self, controller):
+        httpx.post(f"{controller}/metrics/push", json={
+            "service": "msvc", "pod": "p0",
+            "metrics": {"http_requests_total": 7,
+                        "last_activity_timestamp": time.time()}})
+        resp = httpx.get(f"{controller}/metrics/query/msvc").json()
+        assert resp["pods"]["p0"]["metrics"]["http_requests_total"] == 7
+        assert resp["last_activity"] is not None
+
+    def test_log_capture_into_controller(self, controller):
+        cap = LogCapture(controller, {"service": "capsvc", "pod": "px"})
+        cap.emit("direct emit")
+        cap.flush()
+        entries = query_logs(controller, service="capsvc")
+        assert [e["line"] for e in entries] == ["direct emit"]
+
+
+@pytest.mark.level("release")
+class TestEndToEndPodLogs:
+    """Deploy a real local-backend service wired to a controller sink; prints
+    from the worker subprocess must land in the sink with request-id labels
+    (the full LogCapture → sink → query loop)."""
+
+    def test_worker_print_reaches_sink(self, tmp_path, monkeypatch):
+        import os
+        import socket
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        import kubetorch_tpu as kt
+        import kubetorch_tpu.provisioning.backend as backend_mod
+        from kubetorch_tpu.resources.callables.fn import Fn
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        port = free_port()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kubetorch_tpu.controller.server",
+             "--host", "127.0.0.1", "--port", str(port), "--db", ":memory:"],
+            env={**os.environ}, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT)
+        url = f"http://127.0.0.1:{port}"
+        for _ in range(100):
+            try:
+                if httpx.get(f"{url}/health", timeout=2.0).status_code == 200:
+                    break
+            except httpx.HTTPError:
+                time.sleep(0.2)
+        else:
+            proc.kill()
+            raise RuntimeError("controller did not start")
+
+        state = tmp_path / "state"
+        monkeypatch.setenv("KT_LOCAL_STATE", str(state))
+        monkeypatch.setenv("KT_CONTROLLER_URL", url)
+        monkeypatch.setenv("KT_METRICS_INTERVAL", "1.0")
+        monkeypatch.setattr(backend_mod, "_LOCAL_ROOT", state)
+        assets = Path(__file__).parent / "assets" / "summer"
+        remote = None
+        try:
+            remote = Fn(root_path=str(assets), import_path="summer",
+                        callable_name="printer", name="obs-printer").to(
+                kt.Compute(cpus="0.1"))
+            assert remote("hello-sink") == "hello-sink"
+            deadline = time.time() + 15
+            entries = []
+            while time.time() < deadline:
+                entries = [e for e in query_logs(
+                    url, service=remote.service_name)
+                    if "printed: hello-sink" in e["line"]]
+                if entries:
+                    break
+                time.sleep(0.5)
+            assert entries, "worker print never reached the sink"
+            labels = entries[0]["labels"]
+            assert labels["pod"].startswith(remote.service_name)
+            assert labels.get("request_id"), "request-id label missing"
+            # metrics snapshot arrived too
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                resp = httpx.get(
+                    f"{url}/metrics/query/{remote.service_name}").json()
+                if resp["pods"]:
+                    break
+                time.sleep(0.5)
+            assert resp["pods"], "no metrics snapshot pushed"
+        finally:
+            if remote is not None:
+                remote.teardown()
+            proc.terminate()
+            proc.wait(5)
