@@ -48,6 +48,8 @@ _LAZY = {
     "get": ("kubetorch_tpu.data_store.commands", "get"),
     "ls": ("kubetorch_tpu.data_store.commands", "ls"),
     "rm": ("kubetorch_tpu.data_store.commands", "rm"),
+    # debugging
+    "deep_breakpoint": ("kubetorch_tpu.serving.debugger", "deep_breakpoint"),
     # runs
     "note": ("kubetorch_tpu.runs.api", "note"),
     "artifact": ("kubetorch_tpu.runs.api", "artifact"),

@@ -183,6 +183,8 @@ def logs(service, pod, tail, follow, level, request_id):
                     click.echo(format_entry(entry))
             except KeyboardInterrupt:
                 pass
+            except ConnectionError as exc:
+                raise click.ClickException(str(exc))
         else:
             for entry in query_logs(controller_url, service=service,
                                     limit=tail, **filters):
@@ -207,6 +209,31 @@ def teardown(service):
         click.echo(f"tore down {service}")
     else:
         click.echo(f"no service {service!r}")
+
+
+# ---------------------------------------------------------------- debug
+@main.command()
+@click.argument("service")
+@click.option("--pod", type=int, default=0, help="replica index to attach to")
+@click.option("--port", type=int, default=None,
+              help="in-pod debug port (default 5678 + LOCAL_RANK)")
+def debug(service, pod, port):
+    """Attach to a deep_breakpoint() inside a deployed service."""
+    from kubetorch_tpu.provisioning.backend import get_backend
+    from kubetorch_tpu.serving.debugger import attach
+
+    try:
+        urls = get_backend().pod_urls(service)
+    except KeyError:
+        raise click.ClickException(f"no service {service!r}")
+    if not urls:
+        raise click.ClickException(f"no pods for service {service!r}")
+    if pod >= len(urls):
+        raise click.ClickException(
+            f"pod index {pod} out of range ({len(urls)} pods)")
+    click.echo(f"attaching to {urls[pod]} ... (q to quit pdb, Ctrl-D to "
+               f"detach)")
+    sys.exit(attach(urls[pod], port=port))
 
 
 # ---------------------------------------------------------------- runs

@@ -22,6 +22,7 @@ request id / RANK are picked up.
 from __future__ import annotations
 
 import atexit
+import contextvars
 import json
 import logging
 import os
@@ -32,6 +33,13 @@ import threading
 import time
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional
+
+# Per-call request id inside worker processes. A contextvar (not env): env is
+# process-global, so concurrent calls in one worker would cross-contaminate
+# each other's labels. process_worker sets it around each call and propagates
+# it into the sync-offload executor via copy_context.
+request_id_var: contextvars.ContextVar = contextvars.ContextVar(
+    "kt_worker_request_id", default="")
 
 _BATCH_SIZE = 100
 _FLUSH_INTERVAL = 1.0
@@ -214,7 +222,7 @@ class LogCapture:
 
 def _default_dynamic_labels() -> Dict[str, str]:
     labels = {}
-    rid = os.environ.get("KT_REQUEST_ID")
+    rid = request_id_var.get() or os.environ.get("KT_REQUEST_ID")
     if rid:
         labels["request_id"] = rid
     rank = os.environ.get("RANK")

@@ -86,11 +86,11 @@ def iter_logs(
                               **filters)
         return
 
-    out: "asyncio.Queue" = None  # populated inside the loop
     entries_q: List[dict] = []
     lock = threading.Lock()
     done = threading.Event()
     stop_event = stop_event or threading.Event()
+    state = {"connected": False, "error": None}
 
     async def pump():
         import aiohttp
@@ -104,6 +104,7 @@ def iter_logs(
                         f"{sink_url.rstrip('/')}/logs/tail",
                         params=params, headers=_auth_headers(),
                         heartbeat=30.0) as ws:
+                    state["connected"] = True
                     while not stop_event.is_set():
                         try:
                             msg = await asyncio.wait_for(
@@ -115,8 +116,8 @@ def iter_logs(
                                 entries_q.append(json.loads(msg.data))
                         else:
                             break
-        except Exception:
-            pass
+        except Exception as exc:
+            state["error"] = exc
         finally:
             done.set()
 
@@ -135,6 +136,9 @@ def iter_logs(
     finally:
         stop_event.set()
         thread.join(2.0)
+    if state["error"] is not None and not state["connected"]:
+        raise ConnectionError(
+            f"could not tail logs from {sink_url}: {state['error']}")
 
 
 def format_entry(entry: dict) -> str:
@@ -166,26 +170,43 @@ class LogStreamer:
         self.dedup = LogDeduplicator() if dedup else None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._last_entry = 0.0
 
     def start(self) -> "LogStreamer":
         def run():
-            for entry in iter_logs(
-                    self.sink_url, service=self.service, follow=True,
-                    since=time.time() - 5.0, stop_event=self._stop,
-                    **self.filters):
-                if self.dedup is None or self.dedup.admit(entry):
-                    try:
-                        self.printer(format_entry(entry))
-                    except Exception:
-                        pass
+            try:
+                for entry in iter_logs(
+                        self.sink_url, service=self.service, follow=True,
+                        since=time.time() - 5.0, stop_event=self._stop,
+                        **self.filters):
+                    self._last_entry = time.time()
+                    if self.dedup is None or self.dedup.admit(entry):
+                        try:
+                            self.printer(format_entry(entry))
+                        except Exception:
+                            pass
+            except ConnectionError as exc:
+                try:
+                    self.printer(f"[kt] log streaming unavailable: {exc}")
+                except Exception:
+                    pass
 
         self._thread = threading.Thread(target=run, daemon=True,
                                         name="kt-log-stream")
         self._thread.start()
         return self
 
-    def stop(self, linger: float = 0.5):
-        time.sleep(linger)  # let in-flight batches land
+    def stop(self, linger: float = 1.2):
+        # Drain-aware linger (LogCapture batches flush every ~1s): wait for
+        # the stream to go quiet for 0.3s, capped at ``linger`` — streams
+        # that already drained stop immediately instead of paying a flat tax.
+        started = time.time()
+        deadline = started + linger
+        while time.time() < deadline:
+            last = self._last_entry
+            if last and time.time() - last > 0.3:
+                break
+            time.sleep(0.05)
         self._stop.set()
         if self._thread is not None:
             self._thread.join(2.0)

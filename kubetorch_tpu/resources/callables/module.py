@@ -151,10 +151,14 @@ class Module:
                 streamer.stop()
         return self
 
-    def _maybe_stream_logs(self):
-        """Start a background sink tail for this service if configured."""
+    def _maybe_stream_logs(self, force: bool = False):
+        """Start a background sink tail for this service if configured.
+
+        ``force`` honors an explicit per-call ``stream_logs=True`` even when
+        the config default is off (a controller sink is still required).
+        """
         cfg = get_config()
-        if not cfg.stream_logs or not cfg.controller_url:
+        if (not force and not cfg.stream_logs) or not cfg.controller_url:
             return None
         try:
             from kubetorch_tpu.observability.streaming import LogStreamer
@@ -251,7 +255,8 @@ class Module:
         cfg = get_config()
         allowed = (self.compute.allowed_serialization
                    if self.compute else ("json", "pickle"))
-        streamer = self._maybe_stream_logs() if stream_logs else None
+        streamer = (self._maybe_stream_logs(force=True)
+                    if stream_logs else None)
         try:
             return http_client.call_method(
                 self.service_url(),

@@ -1,0 +1,265 @@
+"""Remote debugging: ``deep_breakpoint()`` + the pod-side WS↔TCP bridge.
+
+Reference: ``serving/pdb_websocket.py:37,217`` — ``deep_breakpoint()``
+(``serving/utils.py:588``) opens a WebSocket-PTY pdb server inside the pod;
+the client attaches with ``kt debug`` through a port-forward (``cli.py:349``).
+
+TPU rebuild keeps the two-hop shape but drops the PTY: pdb is line-based, so
+the in-worker server is a plain TCP socket speaking pdb's stdin/stdout, and
+the pod server exposes ``/_debug/ws`` — a WebSocket↔TCP bridge — so the
+client only ever needs HTTP(S) reach to the pod (works through ingress and
+``kubectl port-forward`` alike). Breakpoints inside worker subprocesses bind
+``port + LOCAL_RANK`` so every rank is attachable.
+
+User code:
+
+    import kubetorch_tpu as kt
+    def train(...):
+        ...
+        kt.deep_breakpoint()   # blocks until `ktpu debug <service>` attaches
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import threading
+from typing import Optional
+
+DEFAULT_DEBUG_PORT = 5678
+_active = threading.local()
+
+
+class _SocketIO:
+    """File-like over a socket for pdb's stdin/stdout."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self._rfile = sock.makefile("r", encoding="utf-8", newline="\n")
+
+    def readline(self):
+        line = self._rfile.readline()
+        return line if line else "c\n"  # client vanished: continue
+
+    def read(self, *a):
+        return self.readline()
+
+    def write(self, data: str) -> int:
+        try:
+            self.sock.sendall(data.encode())
+        except OSError:
+            pass
+        return len(data)
+
+    def flush(self):
+        pass
+
+
+def debug_port(local_rank: Optional[int] = None) -> int:
+    base = int(os.environ.get("KT_DEBUG_PORT", str(DEFAULT_DEBUG_PORT)))
+    rank = (local_rank if local_rank is not None
+            else int(os.environ.get("LOCAL_RANK", "0") or 0))
+    return base + rank
+
+
+class _KtPdb:
+    """Pdb over a socket that owns its connection lifecycle.
+
+    Cleanup cannot live in ``deep_breakpoint`` after ``set_trace`` — the
+    debugger's first step-stop would land inside that cleanup code instead of
+    the user's frame — so the session closes its own sockets when the user
+    resumes (continue/quit), and stepping keeps them open.
+    """
+
+    def __new__(cls, conn, listener, **kwargs):
+        import pdb
+
+        class _Impl(pdb.Pdb):
+            def _kt_close(self):
+                _active.server = None
+                for sock in (conn, listener):
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+
+            def set_continue(self):
+                super().set_continue()
+                self._kt_close()
+
+            def set_quit(self):
+                super().set_quit()
+                self._kt_close()
+
+        impl = _Impl(**kwargs)
+        impl.prompt = "(kt-pdb) "
+        return impl
+
+
+def deep_breakpoint(port: Optional[int] = None, timeout: float = 600.0):
+    """Open a TCP pdb server and block until a debugger client attaches.
+
+    The announcement line below reaches the log sink (LogCapture tees
+    stdout), so `ktpu logs -f` shows exactly where to attach — the
+    reference prints the same hint (serving/utils.py:588).
+    """
+    if getattr(_active, "server", None) is not None:
+        return  # nested breakpoint while a session is live: ignore
+
+    port = port or debug_port()
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind(("0.0.0.0", port))
+    listener.listen(1)
+    listener.settimeout(timeout)
+    service = os.environ.get("KT_SERVICE_NAME", "")
+    print(f"[kt] deep_breakpoint waiting for debugger on port {port} "
+          f"(attach: ktpu debug {service or '<service>'} --port {port})",
+          flush=True)
+    try:
+        conn, _ = listener.accept()
+    except socket.timeout:
+        print(f"[kt] deep_breakpoint timed out after {timeout}s; continuing",
+              flush=True)
+        listener.close()
+        return
+
+    sio = _SocketIO(conn)
+    debugger = _KtPdb(conn, listener, stdin=sio, stdout=sio)
+    _active.server = debugger
+    # Must be the LAST statement: the first step-stop is the caller's next
+    # line; any code here would become the stop site instead.
+    debugger.set_trace(sys._getframe(1))
+
+
+# ---------------------------------------------------------------- pod bridge
+async def ws_tcp_bridge(request):
+    """aiohttp handler: bridge a WebSocket client to the in-pod TCP pdb
+    server (mounted as ``/_debug/ws`` by serving/server.py)."""
+    import asyncio
+
+    from aiohttp import WSMsgType, web
+
+    port = int(request.query.get("port", str(debug_port(0))))
+    ws = web.WebSocketResponse(heartbeat=30.0)
+    await ws.prepare(request)
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    except OSError as exc:
+        await ws.send_json({"error": f"no debugger listening on {port}: "
+                                     f"{exc}"})
+        await ws.close()
+        return ws
+
+    async def tcp_to_ws():
+        try:
+            while True:
+                data = await reader.read(4096)
+                if not data:
+                    break
+                await ws.send_bytes(data)
+        except (ConnectionError, RuntimeError):
+            pass
+        finally:
+            if not ws.closed:
+                await ws.close()
+
+    pump = asyncio.ensure_future(tcp_to_ws())
+    try:
+        async for msg in ws:
+            if msg.type == WSMsgType.BINARY:
+                writer.write(msg.data)
+                await writer.drain()
+            elif msg.type == WSMsgType.TEXT:
+                writer.write(msg.data.encode())
+                await writer.drain()
+            else:
+                break
+    finally:
+        pump.cancel()
+        writer.close()
+    return ws
+
+
+# ---------------------------------------------------------------- client
+def attach(pod_url: str, port: Optional[int] = None,
+           stdin=None, stdout=None) -> int:
+    """Interactive debugger client: bridge this terminal to the pod's pdb
+    over the WS endpoint (reference: ``kt debug``, cli.py:349).
+
+    Returns 0 on clean detach, 1 if the bridge reported an error.
+    """
+    import asyncio
+    import json
+
+    import aiohttp
+
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    params = {"port": str(port)} if port else {}
+
+    async def run() -> int:
+        async with aiohttp.ClientSession() as session:
+            async with session.ws_connect(
+                    f"{pod_url.rstrip('/')}/_debug/ws", params=params,
+                    heartbeat=30.0) as ws:
+                loop = asyncio.get_running_loop()
+
+                # Dedicated daemon thread for stdin: the default executor
+                # would block asyncio.run() shutdown joining a thread stuck
+                # in readline() after the remote side closes the session.
+                stdin_q: asyncio.Queue = asyncio.Queue()
+
+                def read_stdin():
+                    while True:
+                        line = stdin.readline()
+                        try:
+                            loop.call_soon_threadsafe(
+                                stdin_q.put_nowait, line)
+                        except RuntimeError:
+                            return  # loop closed: session over
+                        if not line:
+                            return
+
+                import threading as _threading
+
+                _threading.Thread(target=read_stdin, daemon=True,
+                                  name="kt-debug-stdin").start()
+
+                async def pump_stdin():
+                    while True:
+                        line = await stdin_q.get()
+                        if not line:
+                            # Ctrl-D detach: give in-flight pdb output a
+                            # moment to pump back before closing.
+                            await asyncio.sleep(2.0)
+                            if not ws.closed:
+                                await ws.close()
+                            return
+                        await ws.send_bytes(line.encode())
+
+                feeder = asyncio.ensure_future(pump_stdin())
+                rc = 0
+                try:
+                    async for msg in ws:
+                        if msg.type == aiohttp.WSMsgType.BINARY:
+                            stdout.write(msg.data.decode(errors="replace"))
+                            stdout.flush()
+                        elif msg.type == aiohttp.WSMsgType.TEXT:
+                            try:
+                                payload = json.loads(msg.data)
+                                if "error" in payload:
+                                    stdout.write(payload["error"] + "\n")
+                                    rc = 1
+                                    break
+                            except ValueError:
+                                stdout.write(msg.data)
+                                stdout.flush()
+                        else:
+                            break
+                finally:
+                    feeder.cancel()
+                return rc
+
+    return asyncio.run(run())

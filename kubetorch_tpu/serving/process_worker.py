@@ -99,17 +99,36 @@ class _WorkerLoop:
 
             # Per-call env (distributed rank assignment happens at call time,
             # after quorum — reference: process_pool.call_all per-rank env).
-            for key, value in (req.get("env") or {}).items():
+            # KT_REQUEST_ID goes into a contextvar instead: env is
+            # process-global and concurrent calls would mislabel each
+            # other's log lines.
+            call_env = dict(req.get("env") or {})
+            rid = call_env.pop("KT_REQUEST_ID", "")
+            for key, value in call_env.items():
                 os.environ[key] = str(value)
-            body = serialization.loads(req["body"], req["serialization"])
-            args = body.get("args", [])
-            kwargs = body.get("kwargs", {})
-            fn = self._resolve_method(req.get("method"))
-            if inspect.iscoroutinefunction(fn):
-                result = await fn(*args, **kwargs)
-            else:
-                result = await asyncio.get_running_loop().run_in_executor(
-                    self.executor, lambda: fn(*args, **kwargs))
+            from kubetorch_tpu.observability.log_capture import (
+                request_id_var,
+            )
+
+            rid_token = request_id_var.set(rid)
+            try:
+                body = serialization.loads(req["body"], req["serialization"])
+                args = body.get("args", [])
+                kwargs = body.get("kwargs", {})
+                fn = self._resolve_method(req.get("method"))
+                if inspect.iscoroutinefunction(fn):
+                    result = await fn(*args, **kwargs)
+                else:
+                    # copy_context propagates the request-id contextvar into
+                    # the executor thread running the sync callable.
+                    import contextvars as _cv
+
+                    ctx = _cv.copy_context()
+                    result = await asyncio.get_running_loop().run_in_executor(
+                        self.executor,
+                        lambda: ctx.run(fn, *args, **kwargs))
+            finally:
+                request_id_var.reset(rid_token)
             payload, used = serialization.choose(
                 {"result": result}, req["serialization"],
                 req.get("allowed", serialization.METHODS))

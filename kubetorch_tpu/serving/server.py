@@ -103,6 +103,7 @@ class PodServer:
         app.router.add_get("/app/status", self.h_app_status)
         app.router.add_post("/_reload", self.h_reload)
         app.router.add_post("/_teardown", self.h_teardown)
+        app.router.add_get("/_debug/ws", self.h_debug_ws)
         app.router.add_route("*", "/http/{tail:.*}", self.h_proxy)
         app.router.add_post("/{callable}", self.h_call)
         app.router.add_post("/{callable}/{method}", self.h_call)
@@ -302,6 +303,13 @@ class PodServer:
     async def h_teardown(self, request):
         asyncio.get_event_loop().call_later(0.2, os._exit, 0)
         return web.json_response({"terminating": True})
+
+    async def h_debug_ws(self, request):
+        """WS↔TCP bridge to an in-worker pdb opened by deep_breakpoint()
+        (reference: serving/pdb_websocket.py WebSocket-PTY server)."""
+        from kubetorch_tpu.serving.debugger import ws_tcp_bridge
+
+        return await ws_tcp_bridge(request)
 
     async def h_proxy(self, request: web.Request):
         """Reverse proxy to an App's own HTTP port (reference:

@@ -48,3 +48,11 @@ class Counter:
 def printer(message):
     print(f"printed: {message}")
     return message
+
+
+def debug_me(x):
+    import kubetorch_tpu as kt
+
+    doubled = x * 2
+    kt.deep_breakpoint(timeout=60.0)
+    return doubled

@@ -1,0 +1,136 @@
+"""Remote debugger: socket-pdb, WS bridge, end-to-end attach.
+
+Reference: ``serving/pdb_websocket.py`` (WebSocket pdb server) + ``kt debug``
+attach flow (``cli.py:349,467``).
+"""
+
+import io
+import os
+import socket
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from kubetorch_tpu.serving.debugger import attach, deep_breakpoint
+
+ASSETS = Path(__file__).parent / "assets" / "summer"
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.level("unit")
+class TestSocketPdb:
+    def test_breakpoint_accepts_client_and_evaluates(self):
+        port = _free_port()
+        result = {}
+
+        def target():
+            secret = 41 + 1  # noqa: F841 — inspected through pdb
+            deep_breakpoint(port=port, timeout=10.0)
+            result["after"] = True
+
+        thread = threading.Thread(target=target, daemon=True)
+        thread.start()
+        # wait for the listener
+        deadline = time.time() + 5
+        sock = None
+        while time.time() < deadline:
+            try:
+                sock = socket.create_connection(("127.0.0.1", port),
+                                                timeout=1.0)
+                break
+            except OSError:
+                time.sleep(0.05)
+        assert sock is not None, "breakpoint never listened"
+        sock.settimeout(5.0)
+        buf = b""
+        sock.sendall(b"p secret\n")
+        time.sleep(0.3)
+        sock.sendall(b"c\n")
+        deadline = time.time() + 5
+        while b"42" not in buf and time.time() < deadline:
+            try:
+                data = sock.recv(4096)
+            except socket.timeout:
+                break
+            if not data:
+                break
+            buf += data
+        sock.close()
+        thread.join(5.0)
+        assert b"42" in buf, f"pdb output missing evaluation: {buf!r}"
+        assert result.get("after"), "function never resumed after continue"
+
+    def test_timeout_continues(self):
+        port = _free_port()
+        start = time.time()
+        deep_breakpoint(port=port, timeout=0.3)
+        assert time.time() - start < 5.0
+
+
+@pytest.mark.level("release")
+class TestEndToEndDebug:
+    def test_attach_to_deployed_service(self, tmp_path, monkeypatch):
+        import kubetorch_tpu as kt
+        import kubetorch_tpu.provisioning.backend as backend_mod
+        from kubetorch_tpu.resources.callables.fn import Fn
+
+        state = tmp_path / "state"
+        monkeypatch.setenv("KT_LOCAL_STATE", str(state))
+        monkeypatch.setattr(backend_mod, "_LOCAL_ROOT", state)
+        debug_port = _free_port()
+        remote = None
+        try:
+            remote = Fn(root_path=str(ASSETS), import_path="summer",
+                        callable_name="debug_me", name="dbg-svc").to(
+                kt.Compute(cpus="0.1", env={"KT_DEBUG_PORT": str(debug_port)}))
+
+            call_result = {}
+
+            def do_call():
+                call_result["value"] = remote(21)
+
+            caller = threading.Thread(target=do_call, daemon=True)
+            caller.start()
+            time.sleep(1.5)  # let the call reach the breakpoint
+
+            stdin = io.StringIO("p doubled\nc\n")
+            stdout = io.StringIO()
+            rc = attach(remote.pod_urls()[0], port=debug_port,
+                        stdin=stdin, stdout=stdout)
+            caller.join(15.0)
+            out = stdout.getvalue()
+            assert rc == 0
+            assert "42" in out, f"pdb did not evaluate remote var: {out!r}"
+            assert call_result.get("value") == 42
+        finally:
+            if remote is not None:
+                remote.teardown()
+
+    def test_attach_no_listener_reports_error(self, tmp_path, monkeypatch):
+        import kubetorch_tpu as kt
+        import kubetorch_tpu.provisioning.backend as backend_mod
+        from kubetorch_tpu.resources.callables.fn import Fn
+
+        state = tmp_path / "state2"
+        monkeypatch.setenv("KT_LOCAL_STATE", str(state))
+        monkeypatch.setattr(backend_mod, "_LOCAL_ROOT", state)
+        remote = None
+        try:
+            remote = Fn(root_path=str(ASSETS), import_path="summer",
+                        callable_name="summer", name="dbg-none").to(
+                kt.Compute(cpus="0.1"))
+            stdout = io.StringIO()
+            rc = attach(remote.pod_urls()[0], port=_free_port(),
+                        stdin=io.StringIO(""), stdout=stdout)
+            assert rc == 1
+            assert "no debugger listening" in stdout.getvalue()
+        finally:
+            if remote is not None:
+                remote.teardown()
