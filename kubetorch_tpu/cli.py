@@ -267,7 +267,10 @@ def runs_list():
 def runs_show(run_id):
     from kubetorch_tpu.runs.api import get_run
 
-    click.echo(json.dumps(get_run(run_id), indent=2, default=str))
+    record = get_run(run_id)
+    if record is None:
+        raise click.ClickException(f"no run {run_id!r}")
+    click.echo(json.dumps(record, indent=2, default=str))
 
 
 @runs.command("logs")
@@ -280,6 +283,43 @@ def runs_logs(run_id):
                else store.get(f"runs/{run_id}/log.txt"))
 
 
+@runs.command("note")
+@click.argument("run_id")
+@click.argument("text")
+def runs_note(run_id, text):
+    """Attach a note to a run (reference: `kt runs note`)."""
+    import time
+
+    from kubetorch_tpu.data_store import commands as store
+
+    key = f"runs/{run_id}/notes/{int(time.time() * 1000)}.json"
+    store.put(key, {"ts": time.time(), "text": text})
+    click.echo(f"noted {run_id}")
+
+
+@runs.command("artifact")
+@click.argument("run_id")
+@click.argument("action", type=click.Choice(["list", "get"]))
+@click.argument("name", required=False)
+@click.option("--dest", default=".")
+def runs_artifact(run_id, action, name, dest):
+    """List or fetch run artifacts (reference: `kt runs artifact`)."""
+    from kubetorch_tpu.data_store import commands as store
+    from kubetorch_tpu.runs.api import get_run
+
+    if action == "list":
+        record = get_run(run_id) or {}
+        for art in record.get("artifacts", []):
+            click.echo(f"{art.get('name', '')}\t{art.get('ref', '')}")
+        for entry in store.ls(f"runs/{run_id}/artifacts"):
+            click.echo(f"{entry['size']:>12}  {entry['key']}")
+    else:
+        if not name:
+            raise click.ClickException("artifact NAME required for get")
+        store.get(f"runs/{run_id}/artifacts/{name}", dest)
+        click.echo(f"got {name} → {dest}")
+
+
 @runs.command("delete")
 @click.argument("run_id")
 def runs_delete(run_id):
@@ -287,6 +327,174 @@ def runs_delete(run_id):
 
     count = store.rm(f"runs/{run_id}", recursive=True)
     click.echo(f"deleted {count} objects")
+
+
+# ---------------------------------------------------------------- k8s ops
+@main.command()
+@click.option("-f", "--filename", "filename", required=True,
+              help="manifest YAML/JSON file (- for stdin)")
+def apply(filename):
+    """Apply a raw manifest through the controller (or direct k8s creds)
+    — reference: `kt apply` (cli.py)."""
+    import yaml
+
+    content = (sys.stdin.read() if filename == "-"
+               else Path(filename).read_text())
+    docs = [d for d in yaml.safe_load_all(content) if d]
+    from kubetorch_tpu.controller.client import ControllerClient
+
+    controller = ControllerClient.maybe()
+    if controller is None:
+        from kubetorch_tpu.provisioning.k8s_client import K8sClient
+
+        client = K8sClient.from_env()
+        for doc in docs:
+            client.apply(doc)
+    else:
+        for doc in docs:
+            controller.apply(doc)
+    click.echo(f"applied {len(docs)} manifest(s)")
+
+
+@main.command()
+@click.argument("service")
+@click.option("--pod", default=None, help="pod name (default: first pod)")
+@click.argument("command", required=False)
+def ssh(service, pod, command):
+    """Shell into a pod of a deployed service (k8s backend)."""
+    from kubetorch_tpu.provisioning.backend import get_backend
+
+    backend = get_backend()
+    ssh_fn = getattr(backend, "ssh", None)
+    if ssh_fn is None:
+        raise click.ClickException(
+            "ssh requires the k8s backend (local pods are subprocesses; "
+            "use `ktpu logs` instead)")
+    sys.exit(ssh_fn(service, pod=pod, command=command))
+
+
+@main.command("port-forward")
+@click.argument("service")
+@click.option("--port", type=int, default=32300, help="local port")
+@click.option("--target-port", type=int, default=32300)
+def port_forward(service, port, target_port):
+    """Port-forward a service to localhost via kubectl."""
+    import shutil
+    import subprocess
+
+    if shutil.which("kubectl") is None:
+        raise click.ClickException("kubectl not found on PATH")
+    from kubetorch_tpu.config import get_config
+
+    namespace = get_config().namespace
+    click.echo(f"forwarding localhost:{port} → {service}:{target_port}")
+    sys.exit(subprocess.call(
+        ["kubectl", "port-forward", "-n", namespace, f"svc/{service}",
+         f"{port}:{target_port}"]))
+
+
+@main.command()
+@click.argument("service")
+@click.argument("replicas", type=int)
+def scale(service, replicas):
+    """Scale a deployed service to N replicas."""
+    from kubetorch_tpu.controller.client import ControllerClient
+
+    controller = ControllerClient.maybe()
+    # merge-patch: touch only replicas (a server-side apply under the
+    # deploy path's fieldManager would prune the rest of the spec).
+    patch = {"apiVersion": "apps/v1", "kind": "Deployment",
+             "metadata": {"name": service},
+             "spec": {"replicas": replicas}}
+    if controller is not None:
+        controller.apply(patch, patch="merge")
+    else:
+        from kubetorch_tpu.provisioning.k8s_client import K8sClient
+
+        K8sClient.from_env().patch(patch)
+    click.echo(f"scaled {service} to {replicas}")
+
+
+@main.command()
+@click.option("--name", default=None, help="service name")
+@click.option("--port", type=int, default=8888)
+def notebook(name, port):
+    """Launch a Jupyter notebook server as a kubetorch App (reference:
+    `kt notebook`, cli.py)."""
+    import kubetorch_tpu as kt
+
+    service = name or f"{kt.config.username}-notebook"
+    app = kt.app(
+        f"jupyter lab --ip=0.0.0.0 --port={port} --no-browser "
+        f"--NotebookApp.token=''",
+        port=port, name=service)
+    remote = app.to(kt.Compute(cpus="1", memory="2Gi"))
+    click.echo(f"notebook deployed: {remote.service_name}")
+    click.echo(f"open: {remote.service_url()}/http/")
+
+
+# ---------------------------------------------------------------- volumes
+@main.group()
+def volumes():
+    """Manage persistent volumes."""
+
+
+@volumes.command("list")
+def volumes_list():
+    from kubetorch_tpu.config import get_config
+    from kubetorch_tpu.provisioning.k8s_client import K8sClient
+
+    if not K8sClient.has_credentials():
+        from kubetorch_tpu.resources.volumes.volume import Volume
+
+        for path in sorted(Volume.local_root().glob("*")):
+            click.echo(path.name)
+        return
+    client = K8sClient.from_env()
+    for pvc in client.list("PersistentVolumeClaim",
+                           get_config().namespace):
+        spec = pvc.get("spec", {})
+        size = (spec.get("resources", {}).get("requests", {})
+                .get("storage", "?"))
+        click.echo(f"{pvc['metadata']['name']}\t{size}\t"
+                   f"{pvc.get('status', {}).get('phase', '?')}")
+
+
+@volumes.command("create")
+@click.argument("name")
+@click.option("--size", default="10Gi")
+def volumes_create(name, size):
+    from kubetorch_tpu.config import get_config
+    from kubetorch_tpu.resources.volumes.volume import Volume
+
+    volume = Volume(name=name, size=size)
+    from kubetorch_tpu.provisioning.k8s_client import K8sClient
+
+    if K8sClient.has_credentials():
+        K8sClient.from_env().apply(
+            volume.to_pvc_manifest(get_config().namespace))
+        click.echo(f"created PVC {name} ({size})")
+    else:
+        click.echo(f"created local volume dir {volume.local_path()}")
+
+
+@volumes.command("delete")
+@click.argument("name")
+def volumes_delete(name):
+    from kubetorch_tpu.config import get_config
+    from kubetorch_tpu.provisioning.k8s_client import K8sClient
+
+    if K8sClient.has_credentials():
+        K8sClient.from_env().delete(
+            "PersistentVolumeClaim", name, get_config().namespace)
+        click.echo(f"deleted PVC {name}")
+    else:
+        import shutil as _shutil
+
+        from kubetorch_tpu.resources.volumes.volume import Volume
+
+        _shutil.rmtree(Volume(name=name).local_path(), ignore_errors=True)
+        click.echo(f"deleted local volume {name}")
 
 
 # ---------------------------------------------------------------- store

@@ -136,6 +136,10 @@ class ControllerClient:
             f"{self.base_url}/runs/{run_id}"))["deleted"])
 
     # ------------------------------------------------------------ apply
-    def apply(self, manifest: Dict[str, Any]) -> Dict[str, Any]:
+    def apply(self, manifest: Dict[str, Any],
+              patch: Optional[str] = None) -> Dict[str, Any]:
+        payload: Dict[str, Any] = {"manifest": manifest}
+        if patch:
+            payload["patch"] = patch
         return self._check(self.client.post(
-            f"{self.base_url}/apply", json={"manifest": manifest}))
+            f"{self.base_url}/apply", json=payload))

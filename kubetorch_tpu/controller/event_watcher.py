@@ -1,0 +1,124 @@
+"""K8s event watcher: cluster events → the controller's log sink.
+
+Reference: ``services/kubetorch_controller/event_watcher.py`` streams all K8s
+events into Loki under ``job="kubetorch-events"`` with reason/kind/name
+labels so clients can show scheduling / image-pull / OOM / preemption events
+live while a launch is pending (``module.py:1069``).
+
+This build polls the events API (the minimal REST client has no watch
+streams) and pushes new events into the controller-hosted ``LogSink`` under
+the same ``job="kubetorch-events"`` label scheme, so the existing
+``/logs/tail`` WS gives clients live event streams with zero extra plumbing.
+The ``service`` label is recovered from the involved object's
+``kubetorch.com/service`` naming convention (pods/Deployments/JobSets are
+named ``<service>`` or ``<service>-<suffix>``) so a launch can tail exactly
+its own events.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional, Set
+
+logger = logging.getLogger(__name__)
+
+EVENTS_JOB = "kubetorch-events"
+
+
+def _event_service(event: Dict[str, Any],
+                   known_services: Set[str]) -> str:
+    """Map an event's involved object to a kubetorch service name."""
+    name = (event.get("involvedObject") or {}).get("name", "")
+    if name in known_services:
+        return name
+    # pods are <service>-<hash>-<hash> / jobset pods <service>-workers-...
+    parts = name.split("-")
+    for cut in range(len(parts) - 1, 0, -1):
+        candidate = "-".join(parts[:cut])
+        if candidate in known_services:
+            return candidate
+    return ""
+
+
+def format_event(event: Dict[str, Any], service: str = "") -> Dict[str, Any]:
+    """One LogSink entry per event, Loki-label-shaped."""
+    obj = event.get("involvedObject") or {}
+    ts = (event.get("lastTimestamp") or event.get("eventTime")
+          or event.get("firstTimestamp") or "")
+    line = (f"[{event.get('type', '')}] {obj.get('kind', '')}/"
+            f"{obj.get('name', '')}: {event.get('reason', '')}: "
+            f"{event.get('message', '')}")
+    return {
+        "ts": time.time(),
+        "line": line,
+        "labels": {
+            "job": EVENTS_JOB,
+            "service": service,
+            "namespace": event.get("metadata", {}).get("namespace", ""),
+            "reason": event.get("reason", ""),
+            "kind": obj.get("kind", ""),
+            "name": obj.get("name", ""),
+            "level": ("error" if event.get("type") == "Warning" else "info"),
+            "source": "k8s-event",
+            "event_time": str(ts),
+        },
+    }
+
+
+class EventWatcher:
+    """Background poller: new K8s events → ``log_sink.push``."""
+
+    def __init__(self, log_sink, k8s_client=None, namespace: str = "",
+                 interval: float = 5.0, list_services=None):
+        self.log_sink = log_sink
+        self.k8s_client = k8s_client
+        self.namespace = namespace or None
+        self.interval = interval
+        self.list_services = list_services or (lambda: [])
+        self._seen: Dict[str, str] = {}  # uid -> resourceVersion/count
+        self._task: Optional[asyncio.Task] = None
+        self._started_at = time.time()
+
+    # ------------------------------------------------------------------
+    def start(self):
+        if self.k8s_client is None:
+            return
+        self._task = asyncio.get_event_loop().create_task(self._run())
+
+    def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _run(self):
+        while True:
+            try:
+                await asyncio.get_event_loop().run_in_executor(
+                    None, self.poll_once)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # cluster flake: keep watching
+                logger.debug("event poll failed: %s", exc)
+            await asyncio.sleep(self.interval)
+
+    # ------------------------------------------------------------------
+    def poll_once(self) -> int:
+        """Fetch events, push the unseen ones. Returns the count pushed."""
+        events = self.k8s_client.list("Event", self.namespace)
+        known = {p.get("service_name", "") for p in self.list_services()}
+        entries: List[Dict[str, Any]] = []
+        for event in events:
+            uid = event.get("metadata", {}).get("uid", "")
+            marker = (f"{event.get('count', 0)}:"
+                      f"{event.get('metadata', {}).get('resourceVersion', '')}")
+            if not uid or self._seen.get(uid) == marker:
+                continue
+            self._seen[uid] = marker
+            entries.append(format_event(event, _event_service(event, known)))
+        if len(self._seen) > 100_000:  # bound memory over long uptimes
+            self._seen.clear()
+        if entries:
+            self.log_sink.push(entries)
+        return len(entries)

@@ -134,6 +134,21 @@ class ControllerServer:
 
         self.log_sink = LogSink()
         self.metrics_store = MetricsStore()
+        # cluster events → log sink (reference: event_watcher.py → Loki
+        # under job="kubetorch-events"); only when k8s creds exist.
+        from kubetorch_tpu.controller.event_watcher import EventWatcher
+
+        k8s = None
+        try:
+            from kubetorch_tpu.provisioning.k8s_client import K8sClient
+
+            if K8sClient.has_credentials():
+                k8s = K8sClient.from_env()
+        except Exception:
+            k8s = None
+        self.event_watcher = EventWatcher(
+            self.log_sink, k8s_client=k8s,
+            list_services=self.db.list_pools)
 
     # ------------------------------------------------------------- app
     def build_app(self) -> web.Application:
@@ -170,10 +185,12 @@ class ControllerServer:
     async def _on_startup(self, app):
         if self.enable_reaper:
             self._reaper_task = asyncio.create_task(self._reaper_loop())
+        self.event_watcher.start()
 
     async def _on_shutdown(self, app):
         if self._reaper_task:
             self._reaper_task.cancel()
+        self.event_watcher.stop()
 
     @web.middleware
     async def _mw_auth(self, request: web.Request, handler):
@@ -340,14 +357,21 @@ class ControllerServer:
             {"deleted": self.db.delete_run(request.match_info["run_id"])})
 
     async def h_apply(self, request):
-        """Manifest apply passthrough (k8s backend only)."""
+        """Manifest apply passthrough (k8s backend only). With
+        ``patch="merge"`` performs a JSON merge-patch (partial update, e.g.
+        replica scaling) instead of server-side apply."""
         body = await request.json()
         try:
             from kubetorch_tpu.provisioning.k8s_client import K8sClient
 
             client = K8sClient.from_env()
+            manifest = body.get("manifest") or {}
+            if body.get("patch") == "merge":
+                op = lambda: client.patch(manifest)  # noqa: E731
+            else:
+                op = lambda: client.apply(manifest)  # noqa: E731
             result = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: client.apply(body.get("manifest") or {}))
+                None, op)
             return web.json_response({"applied": result})
         except Exception as exc:
             return web.json_response(

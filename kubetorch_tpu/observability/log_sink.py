@@ -80,7 +80,9 @@ class LogSink:
         since: float = 0.0,
         limit: int = 1000,
     ) -> List[Dict[str, Any]]:
-        key = filters.get("service") or filters.get("job")
+        # service-scoped queries hit one stream; job-only or unscoped
+        # queries (e.g. job=kubetorch-events across services) scan all.
+        key = filters.get("service")
         streams = ([self._streams[key]] if key and key in self._streams
                    else ([] if key else list(self._streams.values())))
         out: List[Dict[str, Any]] = []

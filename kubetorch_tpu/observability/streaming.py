@@ -170,9 +170,16 @@ class LogStreamer:
         self.dedup = LogDeduplicator() if dedup else None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # seeded at start() so quiet streams (no entries at all) take the
+        # fast 0.3s-quiet exit in stop() instead of the full linger.
         self._last_entry = 0.0
 
+    def _mark(self):
+        self._last_entry = time.time()
+
     def start(self) -> "LogStreamer":
+        self._mark()
+
         def run():
             try:
                 for entry in iter_logs(

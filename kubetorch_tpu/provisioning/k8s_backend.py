@@ -205,9 +205,12 @@ class K8sBackend:
 
     def teardown(self, service_name: str, quiet: bool = False) -> bool:
         found = False
-        for kind in ("Deployment", "JobSet"):
-            manifest = {"apiVersion": {"Deployment": "apps/v1",
-                                       "JobSet": "jobset.x-k8s.io/v1alpha2"}[kind],
+        workload_kinds = {"Deployment": "apps/v1",
+                          "JobSet": "jobset.x-k8s.io/v1alpha2",
+                          "Service": "serving.knative.dev/v1",
+                          "RayCluster": "ray.io/v1"}
+        for kind, api_version in workload_kinds.items():
+            manifest = {"apiVersion": api_version,
                         "kind": kind, "metadata": {"name": service_name}}
             try:
                 found |= self.client.delete(manifest, service_name)
@@ -243,3 +246,32 @@ class K8sBackend:
         pods = self._pods(service_name)
         return any(p.get("status", {}).get("phase") == "Running"
                    for p in pods)
+
+    def pods(self, service_name: str) -> List[Dict[str, Any]]:
+        """Compact pod records (reference: compute.py ``pods``)."""
+        return [{
+            "name": p["metadata"]["name"],
+            "namespace": p["metadata"].get("namespace"),
+            "ip": p.get("status", {}).get("podIP"),
+            "phase": p.get("status", {}).get("phase"),
+            "node": p.get("spec", {}).get("nodeName"),
+        } for p in self._pods(service_name)]
+
+    def ssh(self, service_name: str, pod: Optional[str] = None,
+            command: Optional[str] = None) -> int:
+        """Exec into a pod via kubectl (reference: compute.py ``ssh`` — the
+        reference also shells out; K8s exec is SPDY/WS, out of scope for the
+        minimal REST client)."""
+        import shutil
+        import subprocess
+
+        if shutil.which("kubectl") is None:
+            raise RuntimeError("kubectl not found on PATH (required for ssh)")
+        pods = self.pods(service_name)
+        if not pods:
+            raise KeyError(f"no pods for service {service_name!r}")
+        target = pod or pods[0]["name"]
+        namespace = pods[0].get("namespace") or get_config().namespace
+        argv = ["kubectl", "exec", "-n", namespace, "-it", target, "--"]
+        argv += (["/bin/sh", "-c", command] if command else ["/bin/bash"])
+        return subprocess.call(argv)

@@ -168,6 +168,19 @@ class K8sClient:
             content=json.dumps(manifest))
         return self._check(resp)
 
+    def patch(self, kind_or_manifest: Any, name: Optional[str] = None,
+              body: Optional[Dict[str, Any]] = None,
+              namespace: Optional[str] = None) -> Dict[str, Any]:
+        """JSON merge-patch: update only the supplied fields without
+        taking field ownership (unlike server-side ``apply``)."""
+        url = self._resource_url(kind_or_manifest, namespace, name)
+        resp = self.client.patch(
+            url,
+            headers={"Content-Type": "application/merge-patch+json"},
+            content=json.dumps(body if body is not None
+                               else kind_or_manifest))
+        return self._check(resp)
+
     def get(self, kind_or_manifest: Any, name: str,
             namespace: Optional[str] = None) -> Optional[Dict[str, Any]]:
         url = self._resource_url(kind_or_manifest, namespace, name)

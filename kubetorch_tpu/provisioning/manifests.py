@@ -276,6 +276,51 @@ def build_service_manifest(
     }
 
 
+def preprocess_byo_manifest(
+    service_name: str, compute: Compute,
+    env: Optional[Dict[str, str]] = None,
+) -> Dict[str, Any]:
+    """Layer kubetorch identity onto a user-supplied manifest (reference:
+    ``ServiceManager`` manifest preprocessing + ``from_manifest:271``):
+    stamp labels so routing/teardown find it, and merge ``KT_*`` env into
+    the pod template so the pod server can register itself. The user's
+    command/image are left untouched."""
+    import copy as _copy
+
+    manifest = _copy.deepcopy(compute.manifest or {})
+    kind = (manifest.get("kind") or "").lower()
+    config = next(
+        (c for c in RESOURCE_CONFIGS.values()
+         if (c.get("kind") or "").lower() == kind), None)
+    meta = manifest.setdefault("metadata", {})
+    # the workload must be addressable by service_name (teardown/lookup
+    # delete by name), so the manifest's own name is overridden.
+    meta["name"] = service_name
+    meta.setdefault("namespace", compute.namespace)
+    meta.setdefault("labels", {}).update(
+        compute.workload_labels(service_name))
+    meta.setdefault("annotations", {}).update(
+        compute.workload_annotations())
+
+    template = (navigate_path(manifest, config["pod_template_path"])
+                if config and config.get("pod_template_path") else None)
+    if isinstance(template, dict):
+        tmeta = template.setdefault("metadata", {})
+        tmeta.setdefault("labels", {}).update(
+            compute.workload_labels(service_name))
+        merged = {**compute.env, **(env or {})}
+        merged.setdefault("KT_SERVICE_NAME", service_name)
+        merged.setdefault("KT_SERVER_PORT", str(SERVER_PORT))
+        containers = navigate_path(template, ("spec", "containers"),
+                                   default=[])
+        for container in containers:
+            existing = {e.get("name") for e in container.get("env", [])}
+            container.setdefault("env", []).extend(
+                {"name": k, "value": str(v)}
+                for k, v in sorted(merged.items()) if k not in existing)
+    return manifest
+
+
 def build_manifests(
     service_name: str, compute: Compute,
     env: Optional[Dict[str, str]] = None,
@@ -293,12 +338,19 @@ def build_manifests(
         out.append(build_jobset_manifest(service_name, compute, env))
     elif mode == "knative":
         out.append(build_knative_manifest(service_name, compute, env))
+    elif mode == "manifest":
+        out.append(preprocess_byo_manifest(service_name, compute, env))
+    elif mode == "selector":
+        # BYO pods: create nothing but the routing Service below.
+        pass
     else:
         raise ValueError(f"unknown deployment mode {mode!r}")
     if mode != "knative":
-        out.append(build_service_manifest(service_name, compute))
+        out.append(build_service_manifest(
+            service_name, compute, selector=compute.selector))
         if compute.distributed is not None or (
                 compute.tpu_spec and compute.tpu_spec.multi_host):
             out.append(build_service_manifest(
-                service_name, compute, headless=True))
+                service_name, compute, headless=True,
+                selector=compute.selector))
     return out

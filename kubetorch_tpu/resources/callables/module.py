@@ -222,6 +222,32 @@ class Module:
             return False
         return self.backend.is_up(self.service_name)
 
+    def pods(self) -> List[dict]:
+        """Pod records for this service (reference: compute.py ``pods``):
+        name/ip/phase on k8s, pid/port records on the local backend."""
+        self._ensure_deployed()
+        pods_fn = getattr(self.backend, "pods", None)
+        if pods_fn is not None:
+            return pods_fn(self.service_name)
+        record = self.backend.lookup(self.service_name) or {}
+        return list(record.get("pods") or [])
+
+    def pod_names(self) -> List[str]:
+        return [p.get("name") or f"{self.service_name}-{p.get('index', i)}"
+                for i, p in enumerate(self.pods())]
+
+    def ssh(self, pod: Optional[str] = None, command: Optional[str] = None):
+        """Interactive shell (or one-shot command) in a pod (reference:
+        compute.py ``ssh``). Shells out to kubectl on k8s; on the local
+        backend a pod is a subprocess, so this is unsupported."""
+        self._ensure_deployed()
+        ssh_fn = getattr(self.backend, "ssh", None)
+        if ssh_fn is None:
+            raise KubetorchError(
+                "ssh is only available on the k8s backend "
+                "(local 'pods' are plain subprocesses)")
+        return ssh_fn(self.service_name, pod=pod, command=command)
+
     def logs(self, pod: Optional[int] = None, tail: int = 200) -> str:
         self._ensure_deployed()
         return self.backend.logs(self.service_name, pod, tail)

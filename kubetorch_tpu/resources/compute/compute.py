@@ -76,6 +76,7 @@ class Compute:
         labels: Optional[Dict[str, str]] = None,
         annotations: Optional[Dict[str, str]] = None,
         freeze: bool = False,
+        selector: Optional[Dict[str, str]] = None,
     ):
         cfg = get_config()
         self.cpus = str(cpus) if cpus is not None else None
@@ -109,8 +110,38 @@ class Compute:
         self.labels = dict(labels or {})
         self.annotations = dict(annotations or {})
         self.freeze = freeze
+        # BYO pods: route to pods matching this label selector; create no
+        # workload resource (reference: compute.py `selector`).
+        self.selector = dict(selector or {}) or None
+        # BYO manifest: a full workload manifest supplied by the user
+        # (reference: from_manifest:271). Set via Compute.from_manifest.
+        self.manifest: Optional[Dict[str, Any]] = None
         self.distributed: Optional[DistributedConfig] = None
         self.autoscaling = None  # AutoscalingConfig
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_manifest(cls, manifest: Dict[str, Any],
+                      **kwargs: Any) -> "Compute":
+        """Bring-your-own workload manifest (reference: compute.py
+        ``from_manifest:271``). The manifest is applied as-is except that
+        kubetorch labels, the pod-server command/env, and the routing
+        Service are layered on by the provisioning layer."""
+        kind = (manifest.get("kind") or "").lower()
+        from kubetorch_tpu.provisioning import manifests as _m
+
+        if kind and not any(
+                (c.get("kind") or "").lower() == kind
+                for c in _m.RESOURCE_CONFIGS.values() if c.get("kind")):
+            raise ValueError(
+                f"unsupported manifest kind {manifest.get('kind')!r}; "
+                f"supported: "
+                f"{sorted(c['kind'] for c in _m.RESOURCE_CONFIGS.values() if c.get('kind'))}")
+        compute = cls(**kwargs)
+        compute.manifest = _copy.deepcopy(manifest)
+        if manifest.get("metadata", {}).get("namespace"):
+            compute.namespace = manifest["metadata"]["namespace"]
+        return compute
 
     # ------------------------------------------------------------------
     @property
@@ -126,7 +157,12 @@ class Compute:
 
     @property
     def deployment_mode(self) -> str:
-        """deployment | knative | jobset (reference: deployment_mode:1613)."""
+        """deployment | knative | jobset | manifest | selector
+        (reference: deployment_mode:1613)."""
+        if self.manifest is not None:
+            return "manifest"
+        if self.selector is not None:
+            return "selector"
         if self.autoscaling is not None:
             return "knative"
         if self.tpu_spec is not None and self.tpu_spec.multi_host:
@@ -161,6 +197,31 @@ class Compute:
 
     def copy(self) -> "Compute":
         return _copy.deepcopy(self)
+
+    # ------------------------------------------------------------------
+    # image-op passthroughs (reference: compute.py pip_install/sync_package/
+    # run_bash image ops). Value-like: each returns a modified copy.
+    def pip_install(self, packages: Union[str, List[str]]) -> "Compute":
+        new = self.copy()
+        new.image = new.image.pip_install(
+            [packages] if isinstance(packages, str) else list(packages))
+        return new
+
+    def sync_package(self, local_path: str,
+                     remote_path: str = "") -> "Compute":
+        new = self.copy()
+        new.image = new.image.sync_package(local_path, remote_path)
+        return new
+
+    def run_bash(self, command: str) -> "Compute":
+        new = self.copy()
+        new.image = new.image.run_bash(command)
+        return new
+
+    def set_env(self, key: str, value: str) -> "Compute":
+        new = self.copy()
+        new.env[key] = str(value)
+        return new
 
     # ------------------------------------------------------------------
     def pod_resources(self) -> Dict[str, Dict[str, str]]:
@@ -226,6 +287,8 @@ class Compute:
             "allowed_serialization": list(self.allowed_serialization),
             "labels": self.labels, "annotations": self.annotations,
             "freeze": self.freeze,
+            "selector": self.selector,
+            "manifest": self.manifest,
             "distributed": (self.distributed.to_dict()
                             if self.distributed else None),
             "autoscaling": (self.autoscaling.to_dict()
@@ -239,6 +302,7 @@ class Compute:
         autoscaling = data.pop("autoscaling", None)
         image = data.pop("image", None)
         volumes = data.pop("volumes", None) or []
+        manifest = data.pop("manifest", None)
         data.pop("secrets", None)
         compute = cls(
             image=Image.from_dict(image) if image else None,
@@ -246,6 +310,8 @@ class Compute:
             allowed_serialization=tuple(
                 data.pop("allowed_serialization", ("json", "pickle"))),
             **data)
+        if manifest:
+            compute.manifest = manifest
         if distributed:
             compute.distributed = DistributedConfig.from_dict(distributed)
         if autoscaling:

@@ -51,6 +51,11 @@ class Volume:
         return {"name": self.name, "mountPath": self.mount_path}
 
     # ---- local backend -------------------------------------------------
+    @classmethod
+    def local_root(cls) -> Path:
+        _LOCAL_ROOT.mkdir(parents=True, exist_ok=True)
+        return _LOCAL_ROOT
+
     def local_path(self) -> Path:
         path = _LOCAL_ROOT / self.name
         path.mkdir(parents=True, exist_ok=True)

@@ -99,8 +99,13 @@ def update_run_status(run_id_: str, status: str, **fields: Any):
     store.put(key, record)
 
 
-def get_run(run_id_: str) -> Dict[str, Any]:
-    return store.get(f"runs/{run_id_}/record.json")
+def get_run(run_id_: str) -> Optional[Dict[str, Any]]:
+    from kubetorch_tpu.exceptions import DataStoreError
+
+    try:
+        return store.get(f"runs/{run_id_}/record.json")
+    except DataStoreError:
+        return None
 
 
 def list_runs() -> list:

@@ -28,7 +28,11 @@ import threading
 from typing import Optional
 
 DEFAULT_DEBUG_PORT = 5678
-_active = threading.local()
+# Process-wide: sync callables share one worker via a thread pool, so two
+# concurrent requests can reach deep_breakpoint() on the same port — the
+# second must no-op, not crash user code with EADDRINUSE.
+_active_lock = threading.Lock()
+_active_ports: set = set()
 
 
 class _SocketIO:
@@ -72,12 +76,13 @@ class _KtPdb:
     resumes (continue/quit), and stepping keeps them open.
     """
 
-    def __new__(cls, conn, listener, **kwargs):
+    def __new__(cls, conn, listener, port=None, **kwargs):
         import pdb
 
         class _Impl(pdb.Pdb):
             def _kt_close(self):
-                _active.server = None
+                with _active_lock:
+                    _active_ports.discard(port)
                 for sock in (conn, listener):
                     try:
                         sock.close()
@@ -104,13 +109,21 @@ def deep_breakpoint(port: Optional[int] = None, timeout: float = 600.0):
     stdout), so `ktpu logs -f` shows exactly where to attach — the
     reference prints the same hint (serving/utils.py:588).
     """
-    if getattr(_active, "server", None) is not None:
-        return  # nested breakpoint while a session is live: ignore
-
     port = port or debug_port()
+    with _active_lock:
+        if port in _active_ports:
+            return  # concurrent/nested breakpoint on a live port: ignore
+        _active_ports.add(port)
+
     listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    listener.bind(("0.0.0.0", port))
+    try:
+        listener.bind(("0.0.0.0", port))
+    except OSError:
+        with _active_lock:
+            _active_ports.discard(port)
+        listener.close()
+        return  # port taken outside this process: skip, don't crash user code
     listener.listen(1)
     listener.settimeout(timeout)
     service = os.environ.get("KT_SERVICE_NAME", "")
@@ -122,12 +135,13 @@ def deep_breakpoint(port: Optional[int] = None, timeout: float = 600.0):
     except socket.timeout:
         print(f"[kt] deep_breakpoint timed out after {timeout}s; continuing",
               flush=True)
+        with _active_lock:
+            _active_ports.discard(port)
         listener.close()
         return
 
     sio = _SocketIO(conn)
-    debugger = _KtPdb(conn, listener, stdin=sio, stdout=sio)
-    _active.server = debugger
+    debugger = _KtPdb(conn, listener, port=port, stdin=sio, stdout=sio)
     # Must be the LAST statement: the first step-stop is the caller's next
     # line; any code here would become the stop site instead.
     debugger.set_trace(sys._getframe(1))

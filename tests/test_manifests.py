@@ -146,3 +146,61 @@ def test_service_manifest():
     svc = build_service_manifest("svc", compute)
     assert svc["spec"]["selector"] == {"kubetorch.com/service": "svc"}
     assert svc["spec"]["ports"][0]["port"] == 32300
+
+
+def test_from_manifest_byo():
+    """BYO manifest: labels + KT env layered on, user bits untouched
+    (reference: compute.py from_manifest:271)."""
+    manifest = {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "byo", "namespace": "ns1"},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": "c", "image": "custom:latest", "command": ["serve"],
+             "env": [{"name": "FOO", "value": "1"}]}]}}},
+    }
+    compute = kt.Compute.from_manifest(manifest)
+    assert compute.deployment_mode == "manifest"
+    assert compute.namespace == "ns1"
+    out = build_manifests("byo", compute)
+    workload = next(m for m in out if m["kind"] == "Deployment")
+    container = workload["spec"]["template"]["spec"]["containers"][0]
+    assert container["image"] == "custom:latest"  # untouched
+    assert container["command"] == ["serve"]      # untouched
+    env = {e["name"]: e.get("value") for e in container["env"]}
+    assert env["FOO"] == "1"
+    assert env["KT_SERVICE_NAME"] == "byo"
+    assert workload["metadata"]["labels"]["kubetorch.com/service"] == "byo"
+    # routing service still created
+    assert any(m["kind"] == "Service" for m in out)
+    # round-trips through to_dict/from_dict
+    again = kt.Compute.from_dict(compute.to_dict())
+    assert again.deployment_mode == "manifest"
+    assert again.manifest["kind"] == "Deployment"
+
+
+def test_from_manifest_rejects_unknown_kind():
+    with pytest.raises(ValueError):
+        kt.Compute.from_manifest({"kind": "CronJob", "metadata": {}})
+
+
+def test_selector_mode_routes_only():
+    """BYO pods: only a routing Service, targeting the user's selector
+    (reference: compute.py `selector`)."""
+    compute = kt.Compute(selector={"app": "ray-head"})
+    assert compute.deployment_mode == "selector"
+    out = build_manifests("sel", compute)
+    assert [m["kind"] for m in out] == ["Service"]
+    assert out[0]["spec"]["selector"] == {"app": "ray-head"}
+
+
+def test_compute_image_op_passthroughs():
+    compute = (kt.Compute(cpus="1").pip_install("einops")
+               .run_bash("echo hi").set_env("A", "1"))
+    dockerfile = compute.image.to_dockerfile()
+    assert "pip install einops" in dockerfile
+    assert "echo hi" in dockerfile
+    assert compute.env["A"] == "1"
+    # value-like: the original is unchanged
+    base = kt.Compute(cpus="1")
+    base.pip_install("x")
+    assert base.image.steps == []

@@ -326,3 +326,56 @@ class TestEndToEndPodLogs:
                 remote.teardown()
             proc.terminate()
             proc.wait(5)
+
+
+# ---------------------------------------------------------------- events
+class _FakeK8s:
+    def __init__(self):
+        self.events = []
+
+    def list(self, kind, namespace=None, **kw):
+        assert kind == "Event"
+        return self.events
+
+
+def _mk_event(uid, name, reason="Scheduled", etype="Normal", count=1):
+    return {
+        "metadata": {"uid": uid, "namespace": "default",
+                     "resourceVersion": str(count)},
+        "involvedObject": {"kind": "Pod", "name": name},
+        "reason": reason, "type": etype, "count": count,
+        "message": f"{reason} for {name}",
+    }
+
+
+def test_event_watcher_pushes_new_events_only():
+    """Events land in the sink under job=kubetorch-events with a service
+    label recovered from the pod name (reference: event_watcher.py)."""
+    from kubetorch_tpu.controller.event_watcher import EventWatcher
+    from kubetorch_tpu.observability.log_sink import LogSink
+
+    sink = LogSink()
+    k8s = _FakeK8s()
+    watcher = EventWatcher(
+        sink, k8s_client=k8s,
+        list_services=lambda: [{"service_name": "my-fn"}])
+    k8s.events = [_mk_event("u1", "my-fn-abc12-xyz34"),
+                  _mk_event("u2", "other-pod", etype="Warning",
+                            reason="FailedScheduling")]
+    assert watcher.poll_once() == 2
+    assert watcher.poll_once() == 0  # dedup by uid+version
+
+    entries = sink.query({"job": "kubetorch-events"})
+    assert len(entries) == 2
+    by_name = {e["labels"]["name"]: e for e in entries}
+    assert by_name["my-fn-abc12-xyz34"]["labels"]["service"] == "my-fn"
+    assert by_name["other-pod"]["labels"]["level"] == "error"
+    assert "FailedScheduling" in by_name["other-pod"]["line"]
+
+    # a count bump (repeated event) is re-pushed
+    k8s.events = [_mk_event("u1", "my-fn-abc12-xyz34", count=2)]
+    assert watcher.poll_once() == 1
+
+    # service= filter narrows to the launch's own events
+    mine = sink.query({"job": "kubetorch-events", "service": "my-fn"})
+    assert all(e["labels"]["service"] == "my-fn" for e in mine)
