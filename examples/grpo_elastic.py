@@ -1,0 +1,137 @@
+"""BASELINE config #5: GRPO RL loop with elastic workers + weight transfer.
+
+Two cooperating workloads, the async-GRPO topology from the reference's RL
+tutorial (examples/tutorials/reinforcement_learning/async_grpo — trainer
+ships LoRA weights to the inference fleet through the data plane):
+
+- **trainer** — GRPO policy-gradient steps on a Llama policy; after every
+  sync interval it publishes packed weights to the data store
+  (``put_arrays``, the TPU host-staged stand-in for the reference's NCCL
+  broadcast, SURVEY §7 hard-part 3).
+- **sampler** — autoscaled inference workers that pull the freshest weights
+  (``get_arrays``) before each generation round.
+
+Elasticity: the sampler fleet can grow/shrink (autoscale or respawn); the
+trainer never blocks on it — weight handoff is pull-based through the store.
+Smoke mode runs one trainer round + one sampler round in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+WEIGHTS_KEY = "grpo/policy-weights"
+
+
+# ---------------------------------------------------------------- trainer
+def grpo_train(rounds: int = 2, group_size: int = 8, seq_len: int = 32,
+               sync_every: int = 1, model: str = "tiny") -> dict:
+    """GRPO: sample G completions per prompt, normalize rewards within the
+    group (advantage = (r - mean) / std), ascend sum(adv * logp)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from kubetorch_tpu.data_store.device_transfer import put_arrays
+    from kubetorch_tpu.models import LlamaConfig, llama
+    from kubetorch_tpu.parallel import MeshSpec
+    from kubetorch_tpu.training import Trainer
+
+    cfg = (LlamaConfig.llama3_1b() if model == "1b" else LlamaConfig.tiny())
+    mesh = MeshSpec(fsdp=-1).build()
+
+    def grpo_loss(params, batch):
+        """policy-gradient on group-normalized advantages; (loss, aux)."""
+        tokens, advantages = batch["tokens"], batch["advantages"]
+        logits = llama.forward(params, tokens[:, :-1], cfg)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        seq_logp = jnp.take_along_axis(
+            logp, tokens[:, 1:, None], axis=2)[..., 0].sum(-1)
+        loss = -(advantages * seq_logp).mean()
+        return loss, {"mean_seq_logp": seq_logp.mean()}
+
+    trainer = Trainer(cfg, mesh, optimizer=optax.adamw(1e-4),
+                      loss_fn=grpo_loss)
+
+    rng = np.random.default_rng(0)
+    losses, published = [], 0
+    for round_ix in range(rounds):
+        # stand-in rollouts: random token groups + a toy reward
+        tokens = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (group_size, seq_len + 1)),
+            jnp.int32)
+        rewards = jnp.asarray(rng.normal(size=(group_size,)), jnp.float32)
+        advantages = (rewards - rewards.mean()) / (rewards.std() + 1e-6)
+        metrics = trainer.step({"tokens": tokens, "advantages": advantages})
+        losses.append(float(metrics["loss"]))
+        if (round_ix + 1) % sync_every == 0:
+            put_arrays(WEIGHTS_KEY, trainer.state["params"])
+            published += 1
+
+    return {"rounds": rounds, "published": published,
+            "loss_first": round(losses[0], 4),
+            "loss_last": round(losses[-1], 4)}
+
+
+# ---------------------------------------------------------------- sampler
+def grpo_sample(n_prompts: int = 4, seq_len: int = 16,
+                model: str = "tiny") -> dict:
+    """Pull freshest policy weights, run greedy forward passes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubetorch_tpu.data_store.device_transfer import get_arrays
+    from kubetorch_tpu.models import LlamaConfig, llama
+
+    cfg = (LlamaConfig.llama3_1b() if model == "1b" else LlamaConfig.tiny())
+    # abstract init (no FLOPs) recovers the param tree structure the
+    # trainer packed, so the blob unflattens to a real param pytree.
+    template = jax.eval_shape(lambda: llama.init(jax.random.key(0), cfg))
+    params = get_arrays(WEIGHTS_KEY, template=template)
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size,
+                                      (n_prompts, seq_len)), jnp.int32)
+    logits = llama.forward(params, tokens, cfg)
+    next_tokens = jnp.argmax(logits[:, -1, :], axis=-1)
+    return {"sampled": int(next_tokens.shape[0]),
+            "next_tokens": next_tokens.tolist()}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--rounds", type=int, default=4)
+    args = parser.parse_args()
+
+    if args.smoke:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"  # override any TPU tunnel config
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        train_result = grpo_train(rounds=2)
+        sample_result = grpo_sample()
+        print(json.dumps({"example": "grpo_elastic",
+                          "trainer": train_result,
+                          "sampler": sample_result}))
+        return
+
+    import kubetorch_tpu as kt
+
+    # trainer: one slice; sampler: autoscaled fleet pulling weights.
+    trainer = kt.fn(grpo_train).to(
+        kt.Compute(tpus="v5e-8").distribute("jax", workers=1))
+    sampler = kt.fn(grpo_sample).to(
+        kt.Compute(tpus="v5e-4").autoscale(min_scale=1, max_scale=4,
+                                           target=2))
+    train_result = trainer(rounds=args.rounds, model="1b")
+    sample_result = sampler(model="1b")
+    print(json.dumps({"example": "grpo_elastic",
+                      "trainer": train_result, "sampler": sample_result}))
+
+
+if __name__ == "__main__":
+    main()

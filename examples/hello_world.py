@@ -1,0 +1,62 @@
+"""BASELINE config #1: hello_world fn on ``kt.Compute(cpus=.1)``.
+
+Measures the north-star **cold-start dispatch latency**: wall time from
+``kt.fn(...).to(compute)`` on a fresh service to the first successful remote
+call. Reference behavior being reproduced: deploy → rsync-less local code
+ship → pod server up → health gate → HTTP dispatch
+(reference call stack: SURVEY.md §3.1-3.2).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def hello(name: str = "world") -> str:
+    return f"hello {name}"
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true",
+                        help="local backend, then tear down")
+    parser.add_argument("--keep", action="store_true")
+    args = parser.parse_args()
+
+    import kubetorch_tpu as kt
+
+    compute = kt.Compute(cpus="0.1", memory="256Mi")
+
+    t0 = time.perf_counter()
+    remote = kt.fn(hello).to(compute)
+    deploy_s = time.perf_counter() - t0
+
+    t1 = time.perf_counter()
+    result = remote("tpu")
+    first_call_s = time.perf_counter() - t1
+    assert result == "hello tpu", result
+
+    # steady-state dispatch: median of 20 warm calls
+    samples = []
+    for _ in range(20):
+        t = time.perf_counter()
+        remote("tpu")
+        samples.append(time.perf_counter() - t)
+    samples.sort()
+
+    print(json.dumps({
+        "example": "hello_world",
+        "cold_start_s": round(deploy_s + first_call_s, 3),
+        "deploy_s": round(deploy_s, 3),
+        "first_call_s": round(first_call_s, 3),
+        "warm_dispatch_p50_ms": round(samples[len(samples) // 2] * 1e3, 2),
+    }))
+
+    if args.smoke and not args.keep:
+        remote.teardown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""BASELINE config #2: single-host ``kt.Compute(tpus="v5e-8")`` matmul smoke.
+
+Deploys a jax matmul benchmark onto one TPU VM host and reports achieved
+TFLOP/s across the local chips — the "is the slice alive and fast" gate.
+The remote fn shards the matmul over all local devices with a 1-axis mesh so
+the MXU on every chip is exercised, not just chip 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def matmul_bench(size: int = 4096, steps: int = 20) -> dict:
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubetorch_tpu.parallel import MeshSpec
+
+    mesh = MeshSpec(dp=-1).build()
+    n = len(mesh.devices.flatten())
+    key = jax.random.key(0)
+    # batch of per-chip matmuls: (n, size, size) @ (n, size, size)
+    a = jax.random.normal(key, (n, size, size), jnp.bfloat16)
+    b = jax.random.normal(key, (n, size, size), jnp.bfloat16)
+    sharding = NamedSharding(mesh, P("dp", None, None))
+    a, b = jax.device_put(a, sharding), jax.device_put(b, sharding)
+
+    @jax.jit
+    def step(a, b):
+        return jnp.einsum("bij,bjk->bik", a, b)
+
+    step(a, b).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(steps):
+        out = step(a, b)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / steps
+    flops = 2 * n * size**3
+    return {
+        "devices": n,
+        "platform": jax.devices()[0].platform,
+        "matmul_size": size,
+        "step_ms": round(dt * 1e3, 3),
+        "tflops": round(flops / dt / 1e12, 2),
+        "tflops_per_chip": round(flops / dt / 1e12 / n, 2),
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--size", type=int, default=4096)
+    args = parser.parse_args()
+
+    import kubetorch_tpu as kt
+
+    if args.smoke:
+        compute = kt.Compute(cpus="1")
+        size = min(args.size, 256)
+    else:
+        compute = kt.Compute(tpus="v5e-8")
+        size = args.size
+
+    remote = kt.fn(matmul_bench).to(compute)
+    try:
+        result = remote(size=size)
+        print(json.dumps({"example": "tpu_matmul", **result}))
+    finally:
+        if args.smoke:
+            remote.teardown()
+
+
+if __name__ == "__main__":
+    main()

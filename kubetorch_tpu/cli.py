@@ -403,8 +403,11 @@ def scale(service, replicas):
     controller = ControllerClient.maybe()
     # merge-patch: touch only replicas (a server-side apply under the
     # deploy path's fieldManager would prune the rest of the spec).
+    from kubetorch_tpu.config import get_config
+
     patch = {"apiVersion": "apps/v1", "kind": "Deployment",
-             "metadata": {"name": service},
+             "metadata": {"name": service,
+                          "namespace": get_config().namespace},
              "spec": {"replicas": replicas}}
     if controller is not None:
         controller.apply(patch, patch="merge")

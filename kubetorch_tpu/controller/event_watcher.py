@@ -109,16 +109,20 @@ class EventWatcher:
         events = self.k8s_client.list("Event", self.namespace)
         known = {p.get("service_name", "") for p in self.list_services()}
         entries: List[Dict[str, Any]] = []
+        current: Dict[str, str] = {}
         for event in events:
             uid = event.get("metadata", {}).get("uid", "")
             marker = (f"{event.get('count', 0)}:"
                       f"{event.get('metadata', {}).get('resourceVersion', '')}")
-            if not uid or self._seen.get(uid) == marker:
+            if not uid:
                 continue
-            self._seen[uid] = marker
+            current[uid] = marker
+            if self._seen.get(uid) == marker:
+                continue
             entries.append(format_event(event, _event_service(event, known)))
-        if len(self._seen) > 100_000:  # bound memory over long uptimes
-            self._seen.clear()
+        # memory bound: keep markers only for events the API still returns
+        # (expired events can't come back, so dropping them never re-pushes).
+        self._seen = current
         if entries:
             self.log_sink.push(entries)
         return len(entries)

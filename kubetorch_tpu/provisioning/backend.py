@@ -104,10 +104,19 @@ class LocalBackend:
             python_path = (f"{pkg_root}{os.pathsep}{python_path}"
                            if python_path else pkg_root)
 
+        # Workers must not inherit the client's TPU/accelerator platform
+        # config unless the compute asked for TPUs: a remote-TPU tunnel
+        # (JAX_PLATFORMS pointing at a proxy backend) is usually
+        # single-tenancy, so CPU-compute pods pin themselves to cpu.
+        base_env = dict(os.environ)
+        wants_tpu = bool(compute_dict.get("tpus"))
+        if not wants_tpu:
+            base_env["JAX_PLATFORMS"] = "cpu"
+
         pods = []
         for index, port in enumerate(ports):
             env = {
-                **os.environ,
+                **base_env,
                 **module_env,
                 "PYTHONPATH": python_path,
                 "KT_SERVICE_NAME": service_name,
@@ -116,8 +125,6 @@ class LocalBackend:
                 "KT_POD_NAME": f"{service_name}-{index}",
                 "KT_LAUNCH_ID": launch_id,
                 "LOCAL_IPS": local_ips,
-                # workers must not inherit the client's TPU tunnel config
-                # unless the compute asked for TPUs.
             }
             log_path = service_dir / f"pod-{index}.log"
             log_file = open(log_path, "ab")

@@ -345,7 +345,13 @@ def build_manifests(
         pass
     else:
         raise ValueError(f"unknown deployment mode {mode!r}")
-    if mode != "knative":
+    # Knative's reconciler owns the routing Service (both the native knative
+    # mode and a BYO Knative Service manifest) — creating our own would fight
+    # it for the name.
+    byo_is_knative = (
+        mode == "manifest"
+        and "knative" in (compute.manifest or {}).get("apiVersion", ""))
+    if mode != "knative" and not byo_is_knative:
         out.append(build_service_manifest(
             service_name, compute, selector=compute.selector))
         if compute.distributed is not None or (

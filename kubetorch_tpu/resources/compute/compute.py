@@ -130,7 +130,7 @@ class Compute:
         kind = (manifest.get("kind") or "").lower()
         from kubetorch_tpu.provisioning import manifests as _m
 
-        if kind and not any(
+        if not any(
                 (c.get("kind") or "").lower() == kind
                 for c in _m.RESOURCE_CONFIGS.values() if c.get("kind")):
             raise ValueError(

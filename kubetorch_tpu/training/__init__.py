@@ -1,3 +1,7 @@
+from kubetorch_tpu.training.checkpoint import (
+    CheckpointManager,
+    save_for_resume,
+)
 from kubetorch_tpu.training.trainer import (
     Trainer,
     cross_entropy_loss,
@@ -5,4 +9,11 @@ from kubetorch_tpu.training.trainer import (
     make_train_step,
 )
 
-__all__ = ["Trainer", "cross_entropy_loss", "init_train_state", "make_train_step"]
+__all__ = [
+    "CheckpointManager",
+    "save_for_resume",
+    "Trainer",
+    "cross_entropy_loss",
+    "init_train_state",
+    "make_train_step",
+]

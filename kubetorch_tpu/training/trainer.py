@@ -129,7 +129,10 @@ class Trainer:
         optimizer: Optional[optax.GradientTransformation] = None,
         rules: Optional[ShardingRules] = None,
         seed: int = 0,
+        loss_fn=None,
     ):
+        """``loss_fn(params, batch) -> (loss, aux_dict)`` overrides the LM
+        cross-entropy objective (RL losses, distillation, ...)."""
         self.cfg = cfg
         self.mesh = mesh
         self.rules = rules or ShardingRules.default()
@@ -139,7 +142,7 @@ class Trainer:
             self.state = init_train_state(
                 jax.random.key(seed), cfg, mesh, self.optimizer, self.rules)
             self._step = make_train_step(cfg, self.optimizer, self.rules,
-                                         mesh=mesh)
+                                         loss_fn=loss_fn, mesh=mesh)
 
     def step(self, batch: Dict[str, jax.Array]):
         with use_mesh(self.mesh):

@@ -1,0 +1,54 @@
+"""Examples must keep running in smoke mode (BASELINE config harnesses)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+EXAMPLES = Path(__file__).resolve().parents[1] / "examples"
+REPO = EXAMPLES.parent
+
+
+def _run_smoke(name: str, tmp_path, timeout=300):
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(REPO),
+        "KT_LOCAL_STATE": str(tmp_path / "state"),
+        "KT_STORE_ROOT": str(tmp_path / "store"),
+    }
+    proc = subprocess.run(
+        [sys.executable, str(EXAMPLES / name), "--smoke"],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_hello_world_smoke(tmp_path):
+    result = _run_smoke("hello_world.py", tmp_path)
+    assert result["example"] == "hello_world"
+    assert result["cold_start_s"] > 0
+    assert result["warm_dispatch_p50_ms"] < 1000
+
+
+def test_fault_tolerance_smoke(tmp_path):
+    result = _run_smoke("fault_tolerance_dynamic_world.py", tmp_path)
+    assert result["world"] == 2
+    assert result["ranks"] == [0, 1]
+
+
+@pytest.mark.level("release")
+def test_llama_fsdp_smoke(tmp_path):
+    result = _run_smoke("llama_fsdp_pretrain.py", tmp_path)
+    assert result["devices"] == 8
+    assert result["tokens_per_sec"] > 0
+
+
+@pytest.mark.level("release")
+def test_grpo_elastic_smoke(tmp_path):
+    result = _run_smoke("grpo_elastic.py", tmp_path)
+    assert result["trainer"]["published"] == 2
+    assert result["sampler"]["sampled"] == 4
