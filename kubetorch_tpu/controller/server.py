@@ -422,7 +422,8 @@ def main():
         os.environ.get("KT_CONTROLLER_PORT", "32320")))
     parser.add_argument("--db", default=os.environ.get(
         "KT_CONTROLLER_DB", str(os.path.expanduser("~/.ktpu/controller.db"))))
-    parser.add_argument("--reaper-interval", type=float, default=15.0)
+    parser.add_argument("--reaper-interval", type=float, default=float(
+        os.environ.get("KT_REAPER_INTERVAL", "15")))
     args = parser.parse_args()
     server = ControllerServer(args.db, reaper_interval=args.reaper_interval)
     web.run_app(server.build_app(), host=args.host, port=args.port,
