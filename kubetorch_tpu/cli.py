@@ -236,6 +236,46 @@ def debug(service, pod, port):
     sys.exit(attach(urls[pod], port=port))
 
 
+# ---------------------------------------------------------------- profile
+@main.command()
+@click.argument("service")
+@click.option("--seconds", type=float, default=5.0,
+              help="trace capture window")
+@click.option("--pod", type=int, default=0, help="replica index")
+@click.option("--rank", type=int, default=0, help="local process rank")
+@click.option("--out", default="trace.zip", help="output zip path")
+def profile(service, seconds, pod, rank, out):
+    """Capture a jax.profiler trace from a running service (view with
+    TensorBoard's profile plugin or xprof)."""
+    import time as _time
+
+    import httpx
+
+    from kubetorch_tpu.provisioning.backend import get_backend
+
+    try:
+        urls = get_backend().pod_urls(service)
+    except KeyError:
+        raise click.ClickException(f"no service {service!r}")
+    if pod >= len(urls):
+        raise click.ClickException(
+            f"pod index {pod} out of range ({len(urls)} pods)")
+    base = urls[pod]
+    with httpx.Client(timeout=120.0) as client:
+        resp = client.post(f"{base}/_profile/start", params={"rank": rank})
+        if resp.status_code != 200:
+            raise click.ClickException(f"start failed: {resp.text[:300]}")
+        click.echo(f"tracing {service} pod {pod} rank {rank} "
+                   f"for {seconds}s ...")
+        _time.sleep(seconds)
+        resp = client.post(f"{base}/_profile/stop", params={"rank": rank})
+        if resp.status_code != 200:
+            raise click.ClickException(f"stop failed: {resp.text[:300]}")
+        Path(out).write_bytes(resp.content)
+    click.echo(f"trace written to {out} "
+               f"(unzip + `tensorboard --logdir`)")
+
+
 # ---------------------------------------------------------------- runs
 @main.command(context_settings={"ignore_unknown_options": True})
 @click.option("--name", default=None, help="run name prefix")

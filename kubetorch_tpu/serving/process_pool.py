@@ -119,6 +119,24 @@ class ProcessPool:
         }
         return self._submit(worker, req).result(timeout)
 
+    def profile(self, action: str, directory: str = "",
+                local_rank: int = 0, timeout: float = 300.0) -> dict:
+        """Start/stop a jax.profiler trace inside a worker process."""
+        from kubetorch_tpu.serving.process_worker import PROFILE
+
+        if not 0 <= local_rank < len(self.workers):
+            raise ValueError(
+                f"rank {local_rank} out of range ({len(self.workers)} procs)")
+        worker = self.workers[local_rank]
+        req = {"kind": PROFILE, "req_id": uuid.uuid4().hex,
+               "action": action, "dir": directory}
+        resp = self._submit(worker, req).result(timeout)
+        if not resp.get("ok"):
+            from kubetorch_tpu.exceptions import rehydrate_exception
+
+            raise rehydrate_exception(resp)
+        return resp["payload"]
+
     def call_all_async(
         self,
         body: bytes,

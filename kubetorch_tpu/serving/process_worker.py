@@ -32,6 +32,7 @@ _CTX = mp.get_context("spawn")
 # Sentinel request kinds
 SETUP = "__setup__"
 SHUTDOWN = "__shutdown__"
+PROFILE = "__profile__"
 
 
 def get_distributed_env_vars(
@@ -85,6 +86,37 @@ class _WorkerLoop:
         raise AttributeError(
             f"no callable method {method_name!r} on target")
 
+    def _profile(self, req: dict) -> dict:
+        """start/stop a jax.profiler trace; stop returns the zipped
+        TensorBoard trace directory."""
+        import jax
+
+        action = req.get("action")
+        trace_dir = os.path.join(
+            req.get("dir") or "/tmp/kt-profile",
+            f"rank{os.environ.get('LOCAL_RANK', '0')}")
+        if action == "start":
+            os.makedirs(trace_dir, exist_ok=True)
+            jax.profiler.start_trace(trace_dir)
+            self._profile_dir = trace_dir
+            return {"started": True, "dir": trace_dir}
+        if action == "stop":
+            jax.profiler.stop_trace()
+            trace_dir = getattr(self, "_profile_dir", trace_dir)
+            import zipfile
+
+            # zip to a file, not bytes: the server process shares this
+            # filesystem, so multi-GB traces never transit the mp queue.
+            zip_path = trace_dir.rstrip("/") + ".zip"
+            with zipfile.ZipFile(zip_path, "w", zipfile.ZIP_DEFLATED) as zf:
+                for root, _, files in os.walk(trace_dir):
+                    for name in files:
+                        full = os.path.join(root, name)
+                        zf.write(full, os.path.relpath(full, trace_dir))
+            return {"stopped": True, "dir": trace_dir,
+                    "zip_path": zip_path}
+        raise ValueError(f"unknown profile action {action!r}")
+
     async def _execute(self, req: dict) -> dict:
         req_id = req["req_id"]
         try:
@@ -96,6 +128,16 @@ class _WorkerLoop:
                     req.get("root_path", ""), req["import_path"],
                     req["name"], self.callable_type, req.get("init_args"))
                 return {"req_id": req_id, "ok": True, "payload": None}
+
+            if req["kind"] == PROFILE:
+                # jax.profiler runs HERE, in the process that owns the TPU
+                # (the server process never touches devices) — a real
+                # improvement over the reference, which has no tracer
+                # (SURVEY §5.1). Zipping a big trace happens in the thread
+                # executor so in-flight calls keep dispatching.
+                payload = await asyncio.get_running_loop().run_in_executor(
+                    self.executor, self._profile, req)
+                return {"req_id": req_id, "ok": True, "payload": payload}
 
             # Per-call env (distributed rank assignment happens at call time,
             # after quorum — reference: process_pool.call_all per-rank env).

@@ -1,0 +1,144 @@
+"""Ray supervisor: head-only execution with workers joining a Ray cluster.
+
+Reference: ``serving/ray_supervisor.py:33`` — the coordinator pod runs
+``ray start --head`` (GCS), worker pods join it via DNS, and calls execute
+only on the head (user code fans work out through Ray itself). This build
+keeps that topology: rank-0 pod starts the head and runs the callable with
+``RAY_ADDRESS`` set; non-head pods just ``ray start --address`` and serve
+health checks. Membership monitoring is off (Ray handles its own membership
+— same choice as the reference).
+
+Availability-gated: ``ray`` isn't a framework dependency; a clear
+StartupError is raised when the binary is absent.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import time
+from typing import Any, Dict, Optional
+
+from kubetorch_tpu.distributed.utils import pod_ips
+from kubetorch_tpu.exceptions import StartupError
+from kubetorch_tpu.serving.supervisor import ExecutionSupervisor
+
+RAY_PORT = 6379
+_HEAD_WAIT_S = 60.0
+
+
+def _require_ray() -> str:
+    path = shutil.which("ray")
+    if path is None:
+        raise StartupError(
+            "distributed type 'ray' requires the ray package in the image "
+            "(pip_install(['ray']) on the Compute image)")
+    return path
+
+
+class RaySupervisor(ExecutionSupervisor):
+    """Head-only supervisor (reference: ray_supervisor.py head/worker split)."""
+
+    def __init__(self, metadata: Dict[str, Any]):
+        super().__init__(metadata)
+        dist = metadata.get("distributed") or {}
+        self.workers_expected = int(dist.get("workers") or 1)
+        self.quorum_timeout = float(dist.get("quorum_timeout") or 300.0)
+        self._ray_proc: Optional[subprocess.Popen] = None
+        self.is_head = False
+        self.head_ip: Optional[str] = None
+
+    # ------------------------------------------------------------------
+    def setup(self):
+        ray_bin = _require_ray()
+        ips = pod_ips(
+            os.environ.get("KT_SERVICE_NAME", ""),
+            quorum_workers=self.workers_expected,
+            quorum_timeout=self.quorum_timeout)
+        members = sorted(ips)
+        # Same identity rule as SPMDDistributedSupervisor.self_entry: server
+        # port matches in local mode (all pods share 127.0.0.1), pod IP
+        # in-cluster — port-stripped IP comparison would elect every local
+        # pod head at once.
+        self_index = self._self_index(members)
+        self.head_ip = members[0].split(":")[0]
+        self.is_head = self_index == 0 or len(members) == 1
+
+        if self.is_head:
+            cmd = [ray_bin, "start", "--head", "--port", str(RAY_PORT),
+                   "--disable-usage-stats", "--block"]
+        else:
+            cmd = [ray_bin, "start",
+                   "--address", f"{self.head_ip}:{RAY_PORT}",
+                   "--disable-usage-stats", "--block"]
+        self._ray_proc = subprocess.Popen(
+            cmd, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+        self._wait_ray_up(ray_bin)
+
+        # the callable runs only on the head, inside a worker subprocess
+        # with RAY_ADDRESS pointing at the local GCS.
+        if self.is_head:
+            os.environ["RAY_ADDRESS"] = f"{self.head_ip}:{RAY_PORT}"
+            super().setup()
+
+    def _self_index(self, members: list) -> int:
+        import socket as _socket
+
+        my_port = os.environ.get("KT_SERVER_PORT")
+        if my_port:
+            for i, entry in enumerate(members):
+                if entry.endswith(f":{my_port}"):
+                    return i
+        my_ip = os.environ.get("KT_POD_IP")
+        if not my_ip:
+            try:
+                my_ip = _socket.gethostbyname(_socket.gethostname())
+            except _socket.gaierror:
+                my_ip = "127.0.0.1"
+        for i, entry in enumerate(members):
+            if entry.partition(":")[0] == my_ip:
+                return i
+        return 0
+
+    def _wait_ray_up(self, ray_bin: str):
+        deadline = time.time() + _HEAD_WAIT_S
+        while time.time() < deadline:
+            if self._ray_proc.poll() is not None:
+                raise StartupError(
+                    f"ray start exited with {self._ray_proc.returncode}")
+            try:
+                probe = subprocess.run(
+                    [ray_bin, "status",
+                     f"--address={self.head_ip}:{RAY_PORT}"],
+                    capture_output=True, timeout=15)
+            except subprocess.TimeoutExpired:
+                continue  # GCS still bootstrapping; keep probing
+            if probe.returncode == 0:
+                return
+            time.sleep(1.0)
+        raise StartupError(f"ray cluster not up after {_HEAD_WAIT_S}s")
+
+    # ------------------------------------------------------------------
+    def call(self, *args, **kwargs):
+        if not self.is_head:
+            raise StartupError(
+                "ray calls route to the head pod only (Endpoint selector "
+                "targets the head Service)")
+        return super().call(*args, **kwargs)
+
+    def healthy(self) -> bool:
+        ray_ok = (self._ray_proc is not None
+                  and self._ray_proc.poll() is None)
+        return ray_ok and (not self.is_head or super().healthy())
+
+    def cleanup(self):
+        if self.is_head:
+            super().cleanup()
+        if self._ray_proc is not None and self._ray_proc.poll() is None:
+            self._ray_proc.terminate()
+            try:
+                self._ray_proc.wait(10)
+            except subprocess.TimeoutExpired:
+                self._ray_proc.kill()
+            self._ray_proc = None

@@ -104,6 +104,7 @@ class PodServer:
         app.router.add_post("/_reload", self.h_reload)
         app.router.add_post("/_teardown", self.h_teardown)
         app.router.add_get("/_debug/ws", self.h_debug_ws)
+        app.router.add_post("/_profile/{action}", self.h_profile)
         app.router.add_route("*", "/http/{tail:.*}", self.h_proxy)
         app.router.add_post("/{callable}", self.h_call)
         app.router.add_post("/{callable}/{method}", self.h_call)
@@ -310,6 +311,37 @@ class PodServer:
         from kubetorch_tpu.serving.debugger import ws_tcp_bridge
 
         return await ws_tcp_bridge(request)
+
+    async def h_profile(self, request):
+        """jax.profiler trace control: POST /_profile/start |
+        /_profile/stop?rank=N. ``stop`` streams back a zip of the
+        TensorBoard trace directory (additive vs the reference — it ships
+        no tracer, SURVEY §5.1)."""
+        if self.supervisor is None:
+            return web.json_response(
+                {"error": {"type": "StartupError",
+                           "message": "no supervisor loaded"}}, status=409)
+        action = request.match_info["action"]
+        loop = asyncio.get_running_loop()
+        try:
+            rank = int(request.query.get("rank", "0"))
+            if rank < 0:
+                raise ValueError(f"rank must be >= 0, got {rank}")
+            result = await loop.run_in_executor(
+                None, lambda: self.supervisor.profile(action,
+                                                      local_rank=rank))
+        except ValueError as exc:
+            return web.json_response(package_exception(exc), status=400)
+        except Exception as exc:
+            return web.json_response(package_exception(exc), status=500)
+        if action == "stop" and result.get("zip_path"):
+            # worker zipped to the shared filesystem; stream it from there
+            return web.FileResponse(
+                result["zip_path"],
+                headers={"Content-Type": "application/zip",
+                         "X-Trace-Dir": result.get("dir", "")})
+        return web.json_response(
+            {k: v for k, v in result.items() if not isinstance(v, bytes)})
 
     async def h_proxy(self, request: web.Request):
         """Reverse proxy to an App's own HTTP port (reference:

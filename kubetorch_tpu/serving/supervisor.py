@@ -85,6 +85,13 @@ class ExecutionSupervisor:
             allowed=self.allowed, timeout=timeout, env=env)
 
     # ------------------------------------------------------------------
+    def profile(self, action: str, directory: str = "",
+                local_rank: int = 0, timeout: float = 300.0) -> dict:
+        """jax.profiler trace control in the worker that owns the devices
+        (SURVEY §5.1 — the reference has no tracer; this is additive)."""
+        return self.pool.profile(action, directory, local_rank=local_rank,
+                                 timeout=timeout)
+
     def healthy(self) -> bool:
         return self.pool is not None and self.pool.healthy
 
@@ -98,12 +105,17 @@ def supervisor_factory(metadata: Dict[str, Any]) -> ExecutionSupervisor:
     """type → supervisor (reference: supervisor_factory.py:16).
 
     distributed.type: None/local → ExecutionSupervisor;
+    ray → RaySupervisor (head-only);
     jax/pytorch/tensorflow/spmd → SPMDDistributedSupervisor.
     """
     dist = metadata.get("distributed") or {}
     dist_type = dist.get("type")
     if not dist_type or dist_type == "local":
         return ExecutionSupervisor(metadata)
+    if dist_type == "ray":
+        from kubetorch_tpu.serving.ray_supervisor import RaySupervisor
+
+        return RaySupervisor(metadata)
     from kubetorch_tpu.serving.spmd_supervisor import (
         SPMDDistributedSupervisor,
     )

@@ -94,3 +94,22 @@ def test_distributed_error_fast_fails():
             remote()
     finally:
         remote.teardown()
+
+
+def test_ray_supervisor_factory_and_gating():
+    """'ray' maps to RaySupervisor; absent ray binary -> clear StartupError
+    (reference: ray_supervisor.py:33 head-only supervisor)."""
+    from kubetorch_tpu.exceptions import StartupError
+    from kubetorch_tpu.serving.ray_supervisor import RaySupervisor
+    from kubetorch_tpu.serving.supervisor import supervisor_factory
+
+    meta = {"import_path": "x", "callable_name": "y",
+            "distributed": {"type": "ray", "workers": 2}}
+    sup = supervisor_factory(meta)
+    assert isinstance(sup, RaySupervisor)
+
+    import shutil
+
+    if shutil.which("ray") is None:
+        with pytest.raises(StartupError, match="ray"):
+            sup.setup()

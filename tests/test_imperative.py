@@ -142,3 +142,24 @@ def test_env_and_secrets_injection():
         assert remote("MY_TOKEN_X") == "abc123"
     finally:
         remote.teardown()
+
+
+@pytest.mark.level("minimal")
+def test_profile_trace_roundtrip(summer_service):
+    """jax.profiler trace control on a live service (additive vs the
+    reference — SURVEY §5.1 flags profiling as a TPU-build improvement)."""
+    import io
+    import zipfile
+
+    import httpx
+
+    base = summer_service.pod_urls()[0]
+    resp = httpx.post(f"{base}/_profile/start", timeout=60.0)
+    assert resp.status_code == 200, resp.text
+    assert resp.json()["started"]
+    summer_service(1, 2)  # traced work
+    resp = httpx.post(f"{base}/_profile/stop", timeout=120.0)
+    assert resp.status_code == 200, resp.text
+    assert resp.headers["Content-Type"] == "application/zip"
+    names = zipfile.ZipFile(io.BytesIO(resp.content)).namelist()
+    assert any("xplane" in n or "trace" in n for n in names), names
