@@ -35,7 +35,8 @@ def _bench_tpu():
         cfg = LlamaConfig(
             vocab_size=32768, embed_dim=2048, n_layers=12, n_heads=16,
             n_kv_heads=8, head_dim=128, mlp_dim=8192, tie_embeddings=True,
-            remat=True, dtype="bfloat16", param_dtype="bfloat16")
+            remat=True, remat_policy="dots", dtype="bfloat16",
+            param_dtype="bfloat16")
         batch, seq, steps = 4, 2048, 10
         metric = "llama_0.8b_train_tokens_per_sec_per_chip"
     else:

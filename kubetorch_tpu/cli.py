@@ -267,11 +267,17 @@ def profile(service, seconds, pod, rank, out):
             raise click.ClickException(f"start failed: {resp.text[:300]}")
         click.echo(f"tracing {service} pod {pod} rank {rank} "
                    f"for {seconds}s ...")
-        _time.sleep(seconds)
-        resp = client.post(f"{base}/_profile/stop", params={"rank": rank})
+        try:
+            _time.sleep(seconds)
+        finally:
+            # Always stop the trace — an interrupt mid-window must not leave
+            # jax.profiler running — and keep whatever was captured.
+            resp = client.post(f"{base}/_profile/stop",
+                               params={"rank": rank}, timeout=300.0)
+            if resp.status_code == 200:
+                Path(out).write_bytes(resp.content)
         if resp.status_code != 200:
             raise click.ClickException(f"stop failed: {resp.text[:300]}")
-        Path(out).write_bytes(resp.content)
     click.echo(f"trace written to {out} "
                f"(unzip + `tensorboard --logdir`)")
 

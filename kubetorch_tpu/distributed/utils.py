@@ -57,6 +57,33 @@ def _resolve_dns(service: str) -> List[str]:
         return []
 
 
+def self_entry(members: List[str]) -> tuple:
+    """Find this pod in a member list → ``(index, entry)``.
+
+    Identity rules shared by every distributed supervisor: server-port match
+    first (local mode — all pods share 127.0.0.1, ports differ), then pod
+    IP / hostname match (in-cluster; members may be hostnames via the
+    ``TPU_WORKER_HOSTNAMES`` path). Falls back to index 0 (a pod not in the
+    list, e.g. an Endpoint-routed coordinator, acts as rank 0).
+    """
+    my_port = os.environ.get("KT_SERVER_PORT")
+    if my_port:
+        for i, entry in enumerate(members):
+            if entry.endswith(f":{my_port}"):
+                return i, entry
+    hostname = socket.gethostname()
+    my_ip = os.environ.get("KT_POD_IP")
+    if not my_ip:
+        try:
+            my_ip = socket.gethostbyname(hostname)
+        except socket.gaierror:
+            my_ip = "127.0.0.1"
+    for i, entry in enumerate(members):
+        if entry.partition(":")[0] in (my_ip, hostname):
+            return i, entry
+    return 0, members[0] if members else "127.0.0.1"
+
+
 def pod_ips(
     service_name: Optional[str] = None,
     quorum_workers: Optional[int] = None,

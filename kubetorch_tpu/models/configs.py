@@ -32,6 +32,10 @@ class LlamaConfig:
     dtype: str = "bfloat16"        # compute dtype
     param_dtype: str = "bfloat16"  # storage dtype
     remat: bool = True             # rematerialize each block under scan
+    # Which intermediates survive remat: "nothing" recomputes the whole block
+    # in backward (min memory); "dots" saves matmul outputs (no-batch-dim
+    # contractions), skipping the recompute FLOPs at ~2x activation memory.
+    remat_policy: str = "nothing"  # nothing | dots
     moe: Optional[MoEConfig] = None
     max_seq_len: int = 8192
     # "auto" → pallas flash for long tileable sequences, XLA otherwise;

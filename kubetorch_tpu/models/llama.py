@@ -145,6 +145,16 @@ def _moe_block(x, layer, cfg: LlamaConfig, rules: ShardingRules):
     return out
 
 
+def _remat_policy(cfg: LlamaConfig):
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy != "nothing":
+        raise ValueError(
+            f"unknown remat_policy {cfg.remat_policy!r}; options: "
+            "'nothing', 'dots'")
+    return jax.checkpoint_policies.nothing_saveable
+
+
 def _block(x, layer, sin, cos, cfg: LlamaConfig, rules: ShardingRules,
            segment_ids=None, mesh=None):
     """One decoder block. ``x``: [B, S, E] in compute dtype."""
@@ -231,8 +241,7 @@ def forward(
     block = _block
     if cfg.remat:
         block = jax.checkpoint(
-            _block, policy=jax.checkpoint_policies.nothing_saveable,
-            static_argnums=(4, 5, 7))
+            _block, policy=_remat_policy(cfg), static_argnums=(4, 5, 7))
 
     def scan_body(carry, layer):
         return block(carry, layer, sin, cos, cfg, rules, segment_ids,
@@ -286,8 +295,7 @@ def forward_pipeline(
     block = _block
     if cfg.remat:
         block = jax.checkpoint(
-            _block, policy=jax.checkpoint_policies.nothing_saveable,
-            static_argnums=(4, 5))
+            _block, policy=_remat_policy(cfg), static_argnums=(4, 5))
 
     def stage_fn(stage_params, h):
         def body(carry, layer):

@@ -96,6 +96,15 @@ class _WorkerLoop:
             req.get("dir") or "/tmp/kt-profile",
             f"rank{os.environ.get('LOCAL_RANK', '0')}")
         if action == "start":
+            # Fresh dir per capture: stale traces from a previous session
+            # would otherwise ride along in the next stop's zip.
+            if os.path.isdir(trace_dir):
+                import shutil
+
+                shutil.rmtree(trace_dir, ignore_errors=True)
+            stale_zip = trace_dir.rstrip("/") + ".zip"
+            if os.path.exists(stale_zip):
+                os.unlink(stale_zip)
             os.makedirs(trace_dir, exist_ok=True)
             jax.profiler.start_trace(trace_dir)
             self._profile_dir = trace_dir

@@ -20,7 +20,7 @@ import subprocess
 import time
 from typing import Any, Dict, Optional
 
-from kubetorch_tpu.distributed.utils import pod_ips
+from kubetorch_tpu.distributed.utils import pod_ips, self_entry
 from kubetorch_tpu.exceptions import StartupError
 from kubetorch_tpu.serving.supervisor import ExecutionSupervisor
 
@@ -48,6 +48,7 @@ class RaySupervisor(ExecutionSupervisor):
         self._ray_proc: Optional[subprocess.Popen] = None
         self.is_head = False
         self.head_ip: Optional[str] = None
+        self.head_entry: str = "127.0.0.1"
 
     # ------------------------------------------------------------------
     def setup(self):
@@ -57,11 +58,8 @@ class RaySupervisor(ExecutionSupervisor):
             quorum_workers=self.workers_expected,
             quorum_timeout=self.quorum_timeout)
         members = sorted(ips)
-        # Same identity rule as SPMDDistributedSupervisor.self_entry: server
-        # port matches in local mode (all pods share 127.0.0.1), pod IP
-        # in-cluster — port-stripped IP comparison would elect every local
-        # pod head at once.
-        self_index = self._self_index(members)
+        self_index, _ = self_entry(members)
+        self.head_entry = members[0]
         self.head_ip = members[0].split(":")[0]
         self.is_head = self_index == 0 or len(members) == 1
 
@@ -82,25 +80,6 @@ class RaySupervisor(ExecutionSupervisor):
             os.environ["RAY_ADDRESS"] = f"{self.head_ip}:{RAY_PORT}"
             super().setup()
 
-    def _self_index(self, members: list) -> int:
-        import socket as _socket
-
-        my_port = os.environ.get("KT_SERVER_PORT")
-        if my_port:
-            for i, entry in enumerate(members):
-                if entry.endswith(f":{my_port}"):
-                    return i
-        my_ip = os.environ.get("KT_POD_IP")
-        if not my_ip:
-            try:
-                my_ip = _socket.gethostbyname(_socket.gethostname())
-            except _socket.gaierror:
-                my_ip = "127.0.0.1"
-        for i, entry in enumerate(members):
-            if entry.partition(":")[0] == my_ip:
-                return i
-        return 0
-
     def _wait_ray_up(self, ray_bin: str):
         deadline = time.time() + _HEAD_WAIT_S
         while time.time() < deadline:
@@ -120,12 +99,57 @@ class RaySupervisor(ExecutionSupervisor):
         raise StartupError(f"ray cluster not up after {_HEAD_WAIT_S}s")
 
     # ------------------------------------------------------------------
-    def call(self, *args, **kwargs):
+    def reload(self, metadata: Optional[Dict[str, Any]] = None):
+        """Code-sync reload: never restart the ray daemon (a second
+        ``ray start`` against a live GCS exits nonzero)."""
+        if metadata:
+            self.metadata.update(metadata)
+        if self._ray_proc is None or self._ray_proc.poll() is not None:
+            self.setup()           # ray never started (or died): full setup
+            return
+        if self.is_head:
+            if self.pool is None:
+                ExecutionSupervisor.setup(self)
+            else:
+                self._setup_callable()
+        # non-head: the ray daemon keeps serving; nothing to reload.
+
+    # ------------------------------------------------------------------
+    def call(self, body, serialization_method="json", method=None,
+             query=None, **kwargs):
         if not self.is_head:
-            raise StartupError(
-                "ray calls route to the head pod only (Endpoint selector "
-                "targets the head Service)")
-        return super().call(*args, **kwargs)
+            # The routing Service round-robins over all pods, but the head
+            # is elected at runtime — proxy to its pod server.
+            if (query or {}).get("ray_head_call"):
+                raise StartupError(
+                    "ray head election inconsistent: proxied call landed on "
+                    "a non-head pod")
+            return self._proxy_to_head(body, serialization_method, method)
+        return super().call(body, serialization_method, method=method,
+                            query=query, **kwargs)
+
+    def _proxy_to_head(self, body, ser, method) -> dict:
+        from kubetorch_tpu import serialization
+        from kubetorch_tpu.serving.http_client import sync_client
+        from kubetorch_tpu.serving.spmd_supervisor import _entry_url
+
+        target = f"{_entry_url(self.head_entry)}/{self.metadata.get('name')}"
+        if method:
+            target += f"/{method}"
+        resp = sync_client().post(
+            target, content=body, params={"ray_head_call": "true"},
+            headers={serialization.HEADER: ser,
+                     "Content-Type": "application/octet-stream"},
+            timeout=None)
+        if resp.status_code != 200:
+            try:
+                error = resp.json().get("error")
+            except Exception:
+                error = {"type": "RuntimeError",
+                         "message": resp.text[:500]}
+            return {"ok": False, "error": error}
+        return {"ok": True, "payload": resp.content,
+                "serialization": resp.headers.get(serialization.HEADER, ser)}
 
     def healthy(self) -> bool:
         ray_ok = (self._ray_proc is not None

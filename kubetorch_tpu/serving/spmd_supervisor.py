@@ -131,24 +131,11 @@ class DistributedSupervisor(ExecutionSupervisor):
         return sorted(ips)
 
     def self_entry(self, members: List[str]) -> Tuple[int, str]:
-        """Find this pod in the member list (port match in local mode, IP
-        match in-cluster)."""
-        my_port = os.environ.get("KT_SERVER_PORT")
-        if my_port:
-            for i, entry in enumerate(members):
-                if entry.endswith(f":{my_port}"):
-                    return i, entry
-        hostname = socket.gethostname()
-        try:
-            my_ip = socket.gethostbyname(hostname)
-        except socket.gaierror:
-            my_ip = "127.0.0.1"
-        for i, entry in enumerate(members):
-            host = entry.partition(":")[0]
-            if host in (my_ip, hostname):
-                return i, entry
-        # Not in the list (e.g. Endpoint-routed coordinator): act as rank 0.
-        return 0, members[0] if members else "127.0.0.1"
+        """Find this pod in the member list (shared identity rules —
+        :func:`kubetorch_tpu.distributed.utils.self_entry`)."""
+        from kubetorch_tpu.distributed.utils import self_entry
+
+        return self_entry(members)
 
     # ---------------------------------------------------- membership
     def start_monitoring(self, baseline: List[str]):

@@ -89,6 +89,10 @@ class ExecutionSupervisor:
                 local_rank: int = 0, timeout: float = 300.0) -> dict:
         """jax.profiler trace control in the worker that owns the devices
         (SURVEY §5.1 — the reference has no tracer; this is additive)."""
+        if self.pool is None:
+            raise RuntimeError(
+                "profiling is only available on pods running workers "
+                "(e.g. the head pod of a ray service)")
         return self.pool.profile(action, directory, local_rank=local_rank,
                                  timeout=timeout)
 

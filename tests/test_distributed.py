@@ -113,3 +113,53 @@ def test_ray_supervisor_factory_and_gating():
     if shutil.which("ray") is None:
         with pytest.raises(StartupError, match="ray"):
             sup.setup()
+
+
+def test_ray_nonhead_proxies_to_head():
+    """Calls landing on a non-head ray pod proxy to the elected head's pod
+    server (the routing Service round-robins; the head is runtime-elected)."""
+    import json
+
+    from kubetorch_tpu import serialization
+    from kubetorch_tpu.serving.ray_supervisor import RaySupervisor
+
+    # A head "pod server": echoes a serialized result like h_call does.
+    from aiohttp import web
+    import threading, asyncio
+
+    async def fake_head(request):
+        assert request.query.get("ray_head_call") == "true"
+        payload, used = serialization.choose({"result": "from-head"}, "json",
+                                             ("json", "pickle"))
+        return web.Response(body=payload,
+                            headers={serialization.HEADER: used})
+
+    app = web.Application()
+    app.router.add_post("/summer", fake_head)
+    runner = web.AppRunner(app)
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    try:
+        asyncio.run_coroutine_threadsafe(runner.setup(), loop).result(10)
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        asyncio.run_coroutine_threadsafe(site.start(), loop).result(10)
+        port = runner.addresses[0][1]
+
+        sup = RaySupervisor({"import_path": "x", "name": "summer",
+                             "distributed": {"type": "ray", "workers": 2}})
+        sup.is_head = False
+        sup.head_entry = f"127.0.0.1:{port}"
+        resp = sup.call(b"{}", "json")
+        assert resp["ok"]
+        result = serialization.loads(resp["payload"], resp["serialization"])
+        assert result == {"result": "from-head"}
+
+        # a proxied call arriving at a non-head pod must not loop
+        from kubetorch_tpu.exceptions import StartupError
+
+        with pytest.raises(StartupError, match="head election"):
+            sup.call(b"{}", "json", query={"ray_head_call": "true"})
+    finally:
+        asyncio.run_coroutine_threadsafe(runner.cleanup(), loop).result(10)
+        loop.call_soon_threadsafe(loop.stop)

@@ -59,6 +59,31 @@ def test_causality(tiny_cfg):
                            np.asarray(logits_b[:, -1]))
 
 
+def test_remat_policies_identical_grads(tiny_cfg):
+    """remat on/off and both policies must give the same loss and grads."""
+    import dataclasses
+
+    batch = _batch(tiny_cfg)
+
+    def loss_for(cfg, params):
+        def loss_fn(p):
+            logits = llama.forward(p, batch["inputs"], cfg)
+            return cross_entropy_loss(logits, batch["targets"])[0]
+        return jax.value_and_grad(loss_fn)(params)
+
+    base = dataclasses.replace(tiny_cfg, remat=False)
+    params = llama.init(jax.random.key(0), base)
+    ref_loss, ref_grads = loss_for(base, params)
+    for policy in ("nothing", "dots"):
+        cfg = dataclasses.replace(tiny_cfg, remat=True, remat_policy=policy)
+        loss, grads = loss_for(cfg, params)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            grads, ref_grads)
+
+
 def test_sharded_forward_matches_single_device(tiny_cfg, mesh):
     """The same params must produce identical logits under dp/fsdp/tp
     sharding — the collectives XLA inserts must be numerically transparent."""
