@@ -148,10 +148,16 @@ def _moe_block(x, layer, cfg: LlamaConfig, rules: ShardingRules):
 def _remat_policy(cfg: LlamaConfig):
     if cfg.remat_policy == "dots":
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy == "dots_and_attn":
+        # Additionally save the attention output so the backward never
+        # re-runs the flash forward kernel (costs B*S*E bf16 per layer).
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names("attn_out"))
     if cfg.remat_policy != "nothing":
         raise ValueError(
             f"unknown remat_policy {cfg.remat_policy!r}; options: "
-            "'nothing', 'dots'")
+            "'nothing', 'dots', 'dots_and_attn'")
     return jax.checkpoint_policies.nothing_saveable
 
 
@@ -190,7 +196,10 @@ def _block(x, layer, sin, cos, cfg: LlamaConfig, rules: ShardingRules,
         v = shard_constraint(v, rules, "batch", None, "kv_heads", None)
         impl = cfg.attn_impl
         if impl == "auto":
-            impl = "flash" if (S >= 4096 and S % 512 == 0
+            # Flash wins decisively once XLA's materialized S×S scores
+            # dominate HBM traffic (measured +46% train throughput at
+            # S=2048 on v5e — fwd + both Pallas backward kernels).
+            impl = "flash" if (S >= 2048 and S % 512 == 0
                                and D % 128 == 0) else "xla"
         if impl == "flash" and segment_ids is None:
             from kubetorch_tpu.ops.flash_attention import flash_attention
@@ -199,7 +208,9 @@ def _block(x, layer, sin, cos, cfg: LlamaConfig, rules: ShardingRules,
         else:
             attn = dot_product_attention(q, k, v, causal=True,
                                          segment_ids=segment_ids)
-    attn = attn.reshape(B, S, H * D)
+    from jax.ad_checkpoint import checkpoint_name
+
+    attn = checkpoint_name(attn.reshape(B, S, H * D), "attn_out")
     x = x + jnp.einsum("bsf,fe->bse", attn, layer["wo"].astype(dt))
     x = shard_constraint(x, rules, "batch", "seq", None)
 
@@ -215,7 +226,7 @@ def _block(x, layer, sin, cos, cfg: LlamaConfig, rules: ShardingRules,
     return shard_constraint(x, rules, "batch", "seq", None)
 
 
-def forward(
+def hidden_states(
     params: Params,
     tokens: jax.Array,                      # [B, S] int32
     cfg: LlamaConfig,
@@ -224,7 +235,7 @@ def forward(
     positions: Optional[jax.Array] = None,
     mesh=None,
 ) -> jax.Array:
-    """Full-sequence forward pass → logits ``[B, S, vocab]`` (float32).
+    """Decoder stack → final-norm hidden states ``[B, S, E]`` (compute dtype).
 
     Pass ``mesh`` (with an sp axis > 1) to engage ring attention for
     sequence-parallel long-context training.
@@ -248,10 +259,30 @@ def forward(
                      mesh), None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    return rms_norm(x, params["final_norm"], cfg.rms_eps)
+
+
+def unembedding(params: Params, cfg: LlamaConfig) -> jax.Array:
+    """The [E, V] output projection (tied → embedding transpose)."""
     head = (params["embedding"].T if cfg.tie_embeddings
-            else params["lm_head"]).astype(dt)
-    logits = jnp.einsum("bse,ev->bsv", x, head)
+            else params["lm_head"])
+    return head.astype(cfg.compute_dtype)
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,                      # [B, S] int32
+    cfg: LlamaConfig,
+    rules: Optional[ShardingRules] = None,
+    segment_ids: Optional[jax.Array] = None,
+    positions: Optional[jax.Array] = None,
+    mesh=None,
+) -> jax.Array:
+    """Full-sequence forward pass → logits ``[B, S, vocab]`` (float32)."""
+    rules = rules or ShardingRules.default()
+    x = hidden_states(params, tokens, cfg, rules, segment_ids, positions,
+                      mesh)
+    logits = jnp.einsum("bse,ev->bsv", x, unembedding(params, cfg))
     logits = shard_constraint(logits, rules, "batch", "seq", "vocab")
     return logits.astype(jnp.float32)
 

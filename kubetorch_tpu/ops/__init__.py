@@ -9,5 +9,7 @@ matters), and XLA-fusable control flow.
 from kubetorch_tpu.ops.norms import rms_norm
 from kubetorch_tpu.ops.rope import apply_rope, rope_angles
 from kubetorch_tpu.ops.attention import dot_product_attention
+from kubetorch_tpu.ops.xent import fused_cross_entropy
 
-__all__ = ["rms_norm", "apply_rope", "rope_angles", "dot_product_attention"]
+__all__ = ["rms_norm", "apply_rope", "rope_angles", "dot_product_attention",
+           "fused_cross_entropy"]

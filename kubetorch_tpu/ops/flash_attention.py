@@ -4,16 +4,23 @@ Forward kernel keeps running (max, sum, acc) in VMEM scratch across the KV
 grid dimension (innermost), so the S×S score matrix never materializes in
 HBM — the standard flash pattern mapped to TPU tiling constraints
 ((8,128)/f32 tiles, MXU matmuls with float32 accumulation, grid ordered so
-KV is the contraction dim).
+KV is the contraction dim). The forward also emits per-row logsumexp stats
+(lane-replicated, [B,H,S,128]) as the residual for the backward.
+
+Backward is two flash kernels (FlashAttention-2 decomposition):
+``dq`` iterates KV blocks per Q block; ``dk/dv`` iterates (q-head × Q-block)
+per KV block, folding the GQA group into the innermost accumulation axis so
+grouped query heads sum into their KV head without a second pass. Neither
+materializes scores in HBM.
 
 GQA costs no data movement: the K/V BlockSpec index maps fold the
 query-head → kv-head mapping (``h // group``) so kv blocks are simply fetched
 per query head.
 
-Backward currently recomputes through the XLA reference implementation via
-``jax.custom_vjp`` (correct, flash-memory in forward; a flash backward kernel
-is the planned follow-up). Use ``interpret=True`` (automatic on CPU) for
-tests.
+Causal: blocks strictly above the diagonal are skipped in all three kernels
+(~2x fewer effective blocks).
+
+Use ``interpret=True`` (automatic on CPU) for tests.
 """
 
 from __future__ import annotations
@@ -29,11 +36,14 @@ from jax.experimental.pallas import tpu as pltpu
 from kubetorch_tpu.ops.attention import dot_product_attention
 
 _NEG_INF = -1e30
+_LANES = 128  # stats tensors replicate row stats across the TPU lane dim
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
                 acc_scratch, *, scale: float, causal: bool,
                 block_q: int, block_k: int):
+    """Forward kernel. ``lse_ref`` is None in the forward-only (primal)
+    variant — no residual stats are written then."""
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -85,25 +95,46 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
         denom = l_scratch[:][:, :1]
         o_ref[0, 0] = (acc_scratch[:] / jnp.maximum(denom, 1e-30)).astype(
             o_ref.dtype)
+        if lse_ref is not None:
+            # lse = m + log(l), lane-replicated; rows with no live block
+            # (fully masked) keep lse=-inf so exp(s - lse) in backward stays
+            # 0 via the causal mask there.
+            lse_ref[0, 0] = m_scratch[:] + jnp.log(
+                jnp.maximum(l_scratch[:], 1e-30))
 
 
 def _flash_forward(
     q: jax.Array, k: jax.Array, v: jax.Array, *,
     scale: float, causal: bool, block_q: int, block_k: int,
-    interpret: bool,
-) -> jax.Array:
+    interpret: bool, with_lse: bool = True,
+):
+    """[B,H,S,D] layout. Returns (out, lse[B,H,S,128] f32) — lse is None
+    when ``with_lse=False`` (forward-only: skips the residual writes)."""
     B, Hq, S, D = q.shape
     _, Hkv, T, _ = k.shape
     group = Hq // Hkv
     nq = S // block_q
     nk = T // block_k
 
+    out_shape = [jax.ShapeDtypeStruct((B, Hq, S, D), q.dtype)]
+    out_specs = [pl.BlockSpec((1, 1, block_q, D),
+                              lambda b, h, qi, ki: (b, h, qi, 0))]
+    if with_lse:
+        out_shape.append(
+            jax.ShapeDtypeStruct((B, Hq, S, _LANES), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, 1, block_q, _LANES),
+                                      lambda b, h, qi, ki: (b, h, qi, 0)))
+        kernel = _fwd_kernel
+    else:
+        def kernel(q_ref, k_ref, v_ref, o_ref, *scratch, **kw):
+            _fwd_kernel(q_ref, k_ref, v_ref, o_ref, None, *scratch, **kw)
+
     grid = (B, Hq, nq, nk)
-    out = pl.pallas_call(
+    res = pl.pallas_call(
         functools.partial(
-            _fwd_kernel, scale=scale, causal=causal,
+            kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k),
-        out_shape=jax.ShapeDtypeStruct((B, Hq, S, D), q.dtype),
+        out_shape=out_shape,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D),
@@ -113,44 +144,215 @@ def _flash_forward(
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, qi, ki: (b, h // group, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D),
-                               lambda b, h, qi, ki: (b, h, qi, 0)),
+        out_specs=out_specs,
         scratch_shapes=[
             # row stats live replicated across the 128-lane dim (TPU tile)
-            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
-            pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
-            pltpu.VMEM((block_q, D), jnp.float32),     # output accumulator
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # running sum
+            pltpu.VMEM((block_q, D), jnp.float32),        # output accumulator
         ],
         interpret=interpret,
     )(q, k, v)
-    return out
+    return (res[0], res[1]) if with_lse else (res[0], None)
 
 
-def _reference(q, k, v, scale, causal):
-    """XLA reference in [B,S,H,D] layout for vjp recompute."""
-    return dot_product_attention(
-        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-        v.transpose(0, 2, 1, 3), causal=causal, scale=scale,
-    ).transpose(0, 2, 1, 3)
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scratch, *, scale: float, causal: bool,
+               block_q: int, block_k: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scratch[:] = jnp.zeros_like(dq_scratch)
+
+    block_live = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(block_live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)           # [bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)           # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)           # [bk, D]
+        do = do_ref[0, 0].astype(jnp.float32)         # [bq, D]
+        lse = lse_ref[0, 0][:, :1]                    # [bq, 1]
+        delta = delta_ref[0, 0][:, :1]                # [bq, 1]
+
+        s = jax.lax.dot_general(
+            q * scale, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                          # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        ds = p * (dp - delta) * scale
+        dq_scratch[:] = dq_scratch[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, D]
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scratch[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scratch, dv_scratch, *,
+                scale: float, causal: bool, block_q: int, block_k: int,
+                group: int):
+    ki = pl.program_id(2)
+    j = pl.program_id(3)                 # j = qi * group + g (qi-major)
+    nj = pl.num_programs(3)
+    qi = j // group
+
+    @pl.when(j == 0)
+    def _init():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    block_live = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(block_live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)           # [bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)           # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)           # [bk, D]
+        do = do_ref[0, 0].astype(jnp.float32)         # [bq, D]
+        lse = lse_ref[0, 0][:, :1]                    # [bq, 1]
+        delta = delta_ref[0, 0][:, :1]                # [bq, 1]
+
+        s = jax.lax.dot_general(
+            q * scale, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                          # [bq, bk]
+        dv_scratch[:] = dv_scratch[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bk, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        ds = p * (dp - delta) * scale                 # [bq, bk]
+        dk_scratch[:] = dk_scratch[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bk, D]
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scratch[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, *, scale, causal, block_q, block_k,
+                    interpret):
+    B, Hq, S, D = q.shape
+    _, Hkv, T, _ = k.shape
+    group = Hq // Hkv
+    nq = S // block_q
+    nk = T // block_k
+
+    # delta_i = rowsum(dO_i · O_i), lane-replicated like lse.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (B, Hq, S, _LANES))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, S, D), q.dtype),
+        grid=(B, Hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, qi, ki: (b, h // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, qi, ki: (b, h // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, qi, ki: (b, h, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    # dk/dv: grid folds the GQA group into the innermost axis (qi-major) so
+    # all query heads of a KV head accumulate into one scratch pass.
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, group=group),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hkv, T, D), k.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, T, D), v.dtype),
+        ],
+        grid=(B, Hkv, nk, nq * group),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, ki, j: (b, h * group + j % group,
+                                              j // group, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, ki, j: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, ki, j: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, ki, j: (b, h * group + j % group,
+                                              j // group, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES),
+                         lambda b, h, ki, j: (b, h * group + j % group,
+                                              j // group, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES),
+                         lambda b, h, ki, j: (b, h * group + j % group,
+                                              j // group, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, ki, j: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, ki, j: (b, h, ki, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k,
-                          interpret=interpret)
+    out, _ = _flash_forward(q, k, v, scale=scale, causal=causal,
+                            block_q=block_q, block_k=block_k,
+                            interpret=interpret, with_lse=False)
+    return out
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out = _flash(q, k, v, scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k,
+                              interpret=interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, scale, causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    return _flash_backward(q, k, v, out, lse, g, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           interpret=interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

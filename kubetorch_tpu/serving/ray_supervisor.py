@@ -124,11 +124,17 @@ class RaySupervisor(ExecutionSupervisor):
                 raise StartupError(
                     "ray head election inconsistent: proxied call landed on "
                     "a non-head pod")
-            return self._proxy_to_head(body, serialization_method, method)
+            return self._proxy_to_head(body, serialization_method, method,
+                                       query=query, **kwargs)
         return super().call(body, serialization_method, method=method,
                             query=query, **kwargs)
 
-    def _proxy_to_head(self, body, ser, method) -> dict:
+    def _proxy_to_head(self, body, ser, method, query=None,
+                       request_id=None, **_ignored) -> dict:
+        """Forward the call verbatim: the original query string (carrying
+        restart_procs / workers / timeout and any user params) and the
+        request id must survive the hop, or call semantics would depend on
+        which pod the round-robin Service happened to hit."""
         from kubetorch_tpu import serialization
         from kubetorch_tpu.serving.http_client import sync_client
         from kubetorch_tpu.serving.spmd_supervisor import _entry_url
@@ -136,10 +142,14 @@ class RaySupervisor(ExecutionSupervisor):
         target = f"{_entry_url(self.head_entry)}/{self.metadata.get('name')}"
         if method:
             target += f"/{method}"
+        params = dict(query or {})
+        params["ray_head_call"] = "true"
+        headers = {serialization.HEADER: ser,
+                   "Content-Type": "application/octet-stream"}
+        if request_id:
+            headers["X-Request-ID"] = request_id
         resp = sync_client().post(
-            target, content=body, params={"ray_head_call": "true"},
-            headers={serialization.HEADER: ser,
-                     "Content-Type": "application/octet-stream"},
+            target, content=body, params=params, headers=headers,
             timeout=None)
         if resp.status_code != 200:
             try:

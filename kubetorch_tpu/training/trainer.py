@@ -90,10 +90,17 @@ def make_train_step(
                  and mesh.shape.get("sp", 1) > 1 else None)
 
     def default_loss(params, batch):
-        logits = llama.forward(
+        # Fused path: never materializes [B, S, V] float32 logits — the
+        # unembedding matmul + xent run chunkwise (ops/xent.py). Cuts ~1 GB
+        # of HBM traffic at Llama scale vs. forward()+cross_entropy_loss.
+        from kubetorch_tpu.ops.xent import fused_cross_entropy
+
+        x = llama.hidden_states(
             params, batch["inputs"], cfg, rules,
             segment_ids=batch.get("segment_ids"), mesh=ring_mesh)
-        return cross_entropy_loss(logits, batch["targets"], batch.get("mask"))
+        return fused_cross_entropy(
+            x, llama.unembedding(params, cfg), batch["targets"],
+            batch.get("mask"))
 
     compute_loss = loss_fn or default_loss
 
