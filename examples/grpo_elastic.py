@@ -76,15 +76,14 @@ def grpo_train(rounds: int = 2, group_size: int = 8, seq_len: int = 32,
 
 
 # ---------------------------------------------------------------- sampler
-def grpo_sample(n_prompts: int = 4, seq_len: int = 16,
-                model: str = "tiny") -> dict:
-    """Pull freshest policy weights, run greedy forward passes."""
+def grpo_sample(n_prompts: int = 4, seq_len: int = 8,
+                max_new_tokens: int = 8, model: str = "tiny") -> dict:
+    """Pull freshest policy weights, run real KV-cache rollouts."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     from kubetorch_tpu.data_store.device_transfer import get_arrays
-    from kubetorch_tpu.models import LlamaConfig, llama
+    from kubetorch_tpu.models import Generator, LlamaConfig, llama
 
     cfg = (LlamaConfig.llama3_1b() if model == "1b" else LlamaConfig.tiny())
     # abstract init (no FLOPs) recovers the param tree structure the
@@ -92,12 +91,12 @@ def grpo_sample(n_prompts: int = 4, seq_len: int = 16,
     template = jax.eval_shape(lambda: llama.init(jax.random.key(0), cfg))
     params = get_arrays(WEIGHTS_KEY, template=template)
     rng = np.random.default_rng(1)
-    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size,
-                                      (n_prompts, seq_len)), jnp.int32)
-    logits = llama.forward(params, tokens, cfg)
-    next_tokens = jnp.argmax(logits[:, -1, :], axis=-1)
-    return {"sampled": int(next_tokens.shape[0]),
-            "next_tokens": next_tokens.tolist()}
+    prompts = rng.integers(
+        0, cfg.vocab_size, (n_prompts, seq_len)).tolist()
+    rollouts = Generator(params, cfg).generate(
+        prompts, max_new_tokens=max_new_tokens, temperature=0.8,
+        top_p=0.95, seed=1)
+    return {"sampled": len(rollouts), "rollouts": rollouts}
 
 
 def main():

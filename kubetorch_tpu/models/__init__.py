@@ -11,4 +11,19 @@ O(1) in depth.
 from kubetorch_tpu.models.configs import LlamaConfig, MoEConfig, ViTConfig
 from kubetorch_tpu.models import llama
 
-__all__ = ["LlamaConfig", "MoEConfig", "ViTConfig", "llama"]
+
+def __getattr__(name):
+    # generate pulls in the sampling stack; keep the train-only import light.
+    if name == "Generator":
+        from kubetorch_tpu.models.generate import Generator
+
+        return Generator
+    if name == "generate":
+        from kubetorch_tpu.models import generate
+
+        return generate
+    raise AttributeError(name)
+
+
+__all__ = ["LlamaConfig", "MoEConfig", "ViTConfig", "llama", "Generator",
+           "generate"]

@@ -1,0 +1,188 @@
+"""Autoregressive generation: jitted prefill + ``lax.scan`` decode loop.
+
+TPU-first shape discipline: prompts are right-padded to a common length, the
+KV cache is a preallocated static buffer (``llama.init_cache``), and the whole
+``max_new_tokens`` loop is ONE jitted ``lax.scan`` with the cache donated —
+no per-token Python dispatch, no dynamic shapes, one compile per
+(batch, prompt_len, max_new_tokens) bucket.
+
+Positions and masking with ragged prompts: sequence ``b`` has
+``prompt_len[b]`` real tokens at slots ``[0, prompt_len[b])``; generated
+tokens go at uniform slots ``Pmax + step`` with RoPE position
+``prompt_len[b] + step``. Attention masks out each sequence's pad gap
+``[prompt_len[b], Pmax)``.
+
+The reference framework has no inference engine (it deploys e.g. vLLM as an
+``App`` — reference ``examples/tutorials/vllm_inference/``); the TPU build
+owns the compute path, so rollout generation (BASELINE #5 GRPO) is framework
+code.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubetorch_tpu.models import llama
+from kubetorch_tpu.models.configs import LlamaConfig
+from kubetorch_tpu.parallel.mesh import use_mesh
+from kubetorch_tpu.parallel.sharding import ShardingRules
+
+
+def filter_logits(logits: jax.Array, top_k: Optional[int] = None,
+                  top_p: Optional[float] = None) -> jax.Array:
+    """Apply top-k and/or nucleus (top-p) filtering to [B, V] logits."""
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p (always
+        # keep the argmax); threshold = logit of the last kept token.
+        keep = cum - probs < top_p
+        kth = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
+                      axis=-1, keepdims=True)
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return logits
+
+
+def sample_tokens(rng: jax.Array, logits: jax.Array, temperature: float,
+                  top_k: Optional[int], top_p: Optional[float]) -> jax.Array:
+    """Sample [B] token ids from [B, V] logits (greedy iff temperature==0)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = filter_logits(logits / temperature, top_k, top_p)
+    return jax.random.categorical(rng, logits)
+
+
+class Generator:
+    """Batched KV-cache text generation for the flagship Llama.
+
+    >>> gen = Generator(params, cfg)
+    >>> out = gen.generate([[1, 5, 9], [1, 7]], max_new_tokens=16,
+    ...                    temperature=0.8, top_p=0.9, eos_id=2, seed=0)
+
+    Works under a device mesh: pass ``mesh`` (and optionally ``rules``) and
+    call inside or outside ``use_mesh`` — params keep their shardings and XLA
+    propagates them into the cache.
+    """
+
+    def __init__(self, params: Dict[str, Any], cfg: LlamaConfig,
+                 mesh=None, rules: Optional[ShardingRules] = None,
+                 pad_id: int = 0):
+        self.params = params
+        self.cfg = cfg
+        self.mesh = mesh
+        self.rules = rules or ShardingRules.default()
+        self.pad_id = pad_id
+        self._prefill = jax.jit(
+            partial(self._prefill_impl, cfg=cfg, rules=self.rules),
+            static_argnames=("max_len",))
+        self._decode = jax.jit(
+            partial(self._decode_impl, cfg=cfg, rules=self.rules),
+            static_argnames=("n_steps", "temperature", "top_k", "top_p",
+                             "eos_id", "pad_id"),
+            donate_argnames=("cache",))
+
+    # -------------------------------------------------------------- impl
+    @staticmethod
+    def _prefill_impl(params, tokens, prompt_lens, *, max_len, cfg, rules):
+        B, P = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(P)[None, :], (B, P))
+        # causal over the prompt region; pad queries produce unused rows.
+        m = jnp.arange(max_len)[None, None, :]
+        t = jnp.arange(P)[None, :, None]
+        mask = (m <= t) & (m < prompt_lens[:, None, None])
+        cache = llama.init_cache(cfg, B, max_len)
+        logits, cache = llama.forward_cached(
+            params, tokens, positions, cache, 0, mask, cfg, rules)
+        # next-token logits at each sequence's last real token
+        last = jnp.take_along_axis(
+            logits, (prompt_lens - 1)[:, None, None], axis=1)[:, 0]
+        return last, cache
+
+    @staticmethod
+    def _decode_impl(params, cache, first_logits, prompt_lens, rng, *,
+                     n_steps, temperature, top_k, top_p, eos_id, pad_id,
+                     cfg, rules):
+        B = first_logits.shape[0]
+        M = cache["k"].shape[2]
+        Pmax = M - n_steps
+        slot_idx = jnp.arange(M)[None, :]
+
+        def step(carry, i):
+            cache, logits, done, rng = carry
+            rng, key = jax.random.split(rng)
+            tok = sample_tokens(key, logits, temperature, top_k, top_p)
+            tok = jnp.where(done, pad_id, tok)
+            if eos_id is not None:
+                done = done | (tok == eos_id)
+            write_at = Pmax + i
+            positions = (prompt_lens + i)[:, None]
+            # attend: real prompt slots + generated slots up to write_at
+            mask = ((slot_idx < prompt_lens[:, None])
+                    | ((slot_idx >= Pmax) & (slot_idx <= write_at)))[:, None, :]
+            logits, cache = llama.forward_cached(
+                params, tok[:, None], positions, cache, write_at, mask,
+                cfg, rules)
+            return (cache, logits[:, 0], done, rng), tok
+
+        done0 = jnp.zeros((B,), bool)
+        (_, _, done, _), toks = jax.lax.scan(
+            step, (cache, first_logits, done0, rng), jnp.arange(n_steps))
+        return toks.T, done  # [B, n_steps]
+
+    # -------------------------------------------------------------- api
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: int = 128,
+        temperature: float = 0.7,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> List[List[int]]:
+        """Generate continuations; returns per-prompt token lists
+        (truncated at ``eos_id`` if given, which is included)."""
+        B = len(prompts)
+        lens = np.array([len(p) for p in prompts], np.int32)
+        if (lens <= 0).any():
+            raise ValueError("empty prompt")
+        Pmax = int(lens.max())
+        toks = np.full((B, Pmax), self.pad_id, np.int32)
+        for i, p in enumerate(prompts):
+            toks[i, :len(p)] = p
+        max_len = Pmax + max_new_tokens
+        if max_len > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt+generation {max_len} exceeds max_seq_len "
+                f"{self.cfg.max_seq_len}")
+
+        import contextlib
+
+        ctx = (use_mesh(self.mesh) if self.mesh is not None
+               else contextlib.nullcontext())
+        with ctx:
+            first_logits, cache = self._prefill(
+                self.params, jnp.asarray(toks), jnp.asarray(lens),
+                max_len=max_len)
+            out, done = self._decode(
+                self.params, cache, first_logits, jnp.asarray(lens),
+                jax.random.key(seed), n_steps=max_new_tokens,
+                temperature=float(temperature), top_k=top_k, top_p=top_p,
+                eos_id=eos_id, pad_id=self.pad_id)
+        out = np.asarray(jax.device_get(out))
+        results: List[List[int]] = []
+        for row in out:
+            seq = row.tolist()
+            if eos_id is not None and eos_id in seq:
+                seq = seq[:seq.index(eos_id) + 1]
+            results.append(seq)
+        return results

@@ -214,16 +214,21 @@ def _block(x, layer, sin, cos, cfg: LlamaConfig, rules: ShardingRules,
     x = x + jnp.einsum("bsf,fe->bse", attn, layer["wo"].astype(dt))
     x = shard_constraint(x, rules, "batch", "seq", None)
 
+    x = x + _mlp(x, layer, cfg, rules)
+    return shard_constraint(x, rules, "batch", "seq", None)
+
+
+def _mlp(x, layer, cfg: LlamaConfig, rules: ShardingRules):
+    """SwiGLU (or MoE) sublayer incl. its pre-norm; returns the residual."""
+    dt = cfg.compute_dtype
     h = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
     if cfg.moe is None:
         gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"].astype(dt))
         up = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(dt))
         ff = shard_constraint(jax.nn.silu(gate) * up, rules,
                               "batch", "seq", "mlp")
-        x = x + jnp.einsum("bsm,me->bse", ff, layer["w_down"].astype(dt))
-    else:
-        x = x + _moe_block(h, layer, cfg, rules).astype(dt)
-    return shard_constraint(x, rules, "batch", "seq", None)
+        return jnp.einsum("bsm,me->bse", ff, layer["w_down"].astype(dt))
+    return _moe_block(h, layer, cfg, rules).astype(dt)
 
 
 def hidden_states(
@@ -340,6 +345,104 @@ def forward_pipeline(
     head = (params["embedding"].T if cfg.tie_embeddings
             else params["lm_head"]).astype(dt)
     return jnp.einsum("bse,ev->bsv", x, head).astype(jnp.float32)
+
+
+# --------------------------------------------------------------------------
+# KV-cache inference path (prefill + single-token decode)
+# --------------------------------------------------------------------------
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
+               dtype=None) -> Dict[str, jax.Array]:
+    """Preallocated KV cache: ``{"k","v"}`` of [L, B, max_len, Hkv, D].
+
+    Static shapes — the decode step compiles once and runs for any sequence
+    shorter than ``max_len``. The reference has no inference path at all
+    (orchestration only); on TPU the framework owns it (BASELINE #5 rollouts).
+    """
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    dt = jnp.dtype(dtype) if dtype is not None else cfg.compute_dtype
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _cached_attn(q, ck, cv, mask, cfg: LlamaConfig):
+    """q: [B,T,H,D]; ck/cv: [B,M,Hkv,D]; mask: [B,T,M] bool → [B,T,H,D].
+
+    Grouped-query einsum form — no materialized [B,M,H,D] repeat of KV.
+    T is small (prefill ≤ M, decode 1), so scores [B,Hkv,G,T,M] stay modest
+    and XLA fuses the softmax chain.
+    """
+    B, T, H, D = q.shape
+    Hkv = ck.shape[2]
+    G = H // Hkv
+    qg = q.reshape(B, T, Hkv, G, D)
+    s = jnp.einsum("btkgd,bmkd->bkgtm", qg.astype(jnp.float32),
+                   ck.astype(jnp.float32)) * (D ** -0.5)
+    s = jnp.where(mask[:, None, None, :, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgtm,bmkd->btkgd", p, cv.astype(jnp.float32))
+    return out.reshape(B, T, H, D).astype(q.dtype)
+
+
+def _block_cached(x, layer, sin, cos, ck, cv, write_at, mask,
+                  cfg: LlamaConfig, rules: ShardingRules):
+    """One decoder block in cache mode.
+
+    Writes this step's K/V into the cache at slot ``write_at`` (scalar,
+    uniform across the batch — prompts are right-padded to a common length),
+    then attends the full cache under ``mask``.
+    Returns (x, updated ck, updated cv).
+    """
+    dt = cfg.compute_dtype
+    B, T, E = x.shape
+    H, Hkv, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+    q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].reshape(E, H, D).astype(dt))
+    k = jnp.einsum("bse,ehd->bshd", h,
+                   layer["wk"].reshape(E, Hkv, D).astype(dt))
+    v = jnp.einsum("bse,ehd->bshd", h,
+                   layer["wv"].reshape(E, Hkv, D).astype(dt))
+    q = apply_rope(q, None, cfg.rope_theta, sin=sin, cos=cos)
+    k = apply_rope(k, None, cfg.rope_theta, sin=sin, cos=cos)
+
+    ck = jax.lax.dynamic_update_slice(
+        ck, k.astype(ck.dtype), (0, write_at, 0, 0))
+    cv = jax.lax.dynamic_update_slice(
+        cv, v.astype(cv.dtype), (0, write_at, 0, 0))
+
+    attn = _cached_attn(q, ck, cv, mask, cfg).reshape(B, T, H * D)
+    x = x + jnp.einsum("bsf,fe->bse", attn, layer["wo"].astype(dt))
+    x = x + _mlp(x, layer, cfg, rules)
+    return x, ck, cv
+
+
+def forward_cached(
+    params: Params,
+    tokens: jax.Array,        # [B, T] int32 (prefill: padded prompt; decode: 1)
+    positions: jax.Array,     # [B, T] int32 RoPE positions per token
+    cache: Dict[str, jax.Array],
+    write_at,                 # scalar int: cache slot for tokens[:, 0]
+    mask: jax.Array,          # [B, T, max_len] bool attention mask
+    cfg: LlamaConfig,
+    rules: Optional[ShardingRules] = None,
+):
+    """Forward with KV cache → (logits [B, T, V] float32, new cache)."""
+    rules = rules or ShardingRules.default()
+    dt = cfg.compute_dtype
+    x = params["embedding"].astype(dt)[tokens]
+    sin, cos = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+    def scan_body(carry, inp):
+        layer, ck, cv = inp
+        x, ck, cv = _block_cached(carry, layer, sin, cos, ck, cv,
+                                  write_at, mask, cfg, rules)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        scan_body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = jnp.einsum("bse,ev->bsv", x, unembedding(params, cfg))
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 
 
 def num_params(cfg: LlamaConfig) -> int:

@@ -23,12 +23,9 @@ import jax
 import jax.numpy as jnp
 
 
-def _pick_chunks(n_tokens: int, target: int) -> int:
-    """Largest divisor of ``n_tokens`` that is <= target (>=1)."""
-    c = min(target, n_tokens)
-    while n_tokens % c:
-        c -= 1
-    return c
+def _pad_to_multiple(n: int, chunk: int) -> int:
+    """Padded token count: smallest multiple of ``chunk`` >= n."""
+    return ((n + chunk - 1) // chunk) * chunk
 
 
 def fused_cross_entropy(
@@ -47,15 +44,22 @@ def fused_cross_entropy(
     B, S, E = hidden.shape
     V = head.shape[1]
     n = B * S
-    chunk = _pick_chunks(n, chunk_size)
-    n_chunks = n // chunk
+    chunk = min(chunk_size, n)
+    n_pad = _pad_to_multiple(n, chunk)
+    n_chunks = n_pad // chunk
 
-    x = hidden.reshape(n_chunks, chunk, E)
-    t = targets.reshape(n_chunks, chunk)
-    if mask is None:
-        m = jnp.ones((n_chunks, chunk), dtype=jnp.float32)
-    else:
-        m = mask.reshape(n_chunks, chunk).astype(jnp.float32)
+    x = hidden.reshape(n, E)
+    t = targets.reshape(n)
+    m = (jnp.ones((n,), jnp.float32) if mask is None
+         else mask.reshape(n).astype(jnp.float32))
+    if n_pad != n:
+        # pad with masked-out tokens — any batch shape chunks cleanly.
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+        t = jnp.pad(t, (0, n_pad - n))
+        m = jnp.pad(m, (0, n_pad - n))
+    x = x.reshape(n_chunks, chunk, E)
+    t = t.reshape(n_chunks, chunk)
+    m = m.reshape(n_chunks, chunk)
 
     def body(carry, inp):
         xc, tc, mc = inp

@@ -1,0 +1,126 @@
+"""KV-cache inference: cached forward parity with the training forward,
+ragged-prompt masking, sampling filters, and mesh-sharded generation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubetorch_tpu.models import LlamaConfig, llama
+from kubetorch_tpu.models.generate import Generator, filter_logits
+from kubetorch_tpu.parallel import MeshSpec
+
+pytestmark = pytest.mark.level("unit")
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return LlamaConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return llama.init(jax.random.key(0), cfg)
+
+
+def test_prefill_matches_full_forward(cfg, params):
+    """Cached prefill logits must equal the training forward's logits."""
+    B, P, M = 2, 12, 20
+    toks = jax.random.randint(jax.random.key(1), (B, P), 0, cfg.vocab_size)
+    full = llama.forward(params, toks, cfg)
+
+    positions = jnp.broadcast_to(jnp.arange(P)[None], (B, P))
+    mask = (jnp.arange(M)[None, None, :] <= jnp.arange(P)[None, :, None])
+    mask = jnp.broadcast_to(mask, (B, P, M))
+    cache = llama.init_cache(cfg, B, M)
+    cached, _ = llama.forward_cached(
+        params, toks, positions, cache, 0, mask, cfg)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(cached),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_decode_steps_match_full_forward(cfg, params):
+    """Feeding tokens one at a time through the cache must reproduce the
+    full-sequence logits at every position."""
+    B, S, M = 1, 10, 12
+    toks = jax.random.randint(jax.random.key(2), (B, S), 0, cfg.vocab_size)
+    full = llama.forward(params, toks, cfg)
+
+    cache = llama.init_cache(cfg, B, M)
+    slot = jnp.arange(M)[None, None, :]
+    step_logits = []
+    for t in range(S):
+        mask = slot <= t
+        logits, cache = llama.forward_cached(
+            params, toks[:, t:t + 1], jnp.array([[t]]), cache, t,
+            jnp.broadcast_to(mask, (B, 1, M)), cfg)
+        step_logits.append(logits[:, 0])
+    stepped = jnp.stack(step_logits, axis=1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(stepped),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_generate_greedy_matches_argmax_rollout(cfg, params):
+    """Greedy generation must equal manually argmax-ing the full forward."""
+    prompt = [3, 7, 11, 2, 9]
+    gen = Generator(params, cfg)
+    out = gen.generate([prompt], max_new_tokens=6, temperature=0.0)[0]
+
+    seq = list(prompt)
+    for _ in range(6):
+        logits = llama.forward(params, jnp.array([seq]), cfg)
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    assert out == seq[len(prompt):]
+
+
+def test_generate_ragged_prompts_match_individual(cfg, params):
+    """Batched ragged prompts (right-padded) must produce exactly what each
+    prompt produces alone — the pad-gap masking must be airtight."""
+    p1, p2 = [5, 9, 1, 13, 4, 8, 2], [17, 3]
+    gen = Generator(params, cfg)
+    batched = gen.generate([p1, p2], max_new_tokens=5, temperature=0.0)
+    solo1 = gen.generate([p1], max_new_tokens=5, temperature=0.0)[0]
+    solo2 = gen.generate([p2], max_new_tokens=5, temperature=0.0)[0]
+    assert batched[0] == solo1
+    assert batched[1] == solo2
+
+
+def test_generate_eos_truncation_and_padding(cfg, params):
+    gen = Generator(params, cfg)
+    # force eos: pick the greedy first token as "eos" so it truncates at 1
+    first = gen.generate([[4, 4, 4]], max_new_tokens=4, temperature=0.0)[0]
+    out = gen.generate([[4, 4, 4]], max_new_tokens=4, temperature=0.0,
+                       eos_id=first[0])[0]
+    assert out == [first[0]]
+
+
+def test_sampling_respects_temperature_and_seed(cfg, params):
+    gen = Generator(params, cfg)
+    a = gen.generate([[1, 2, 3]], max_new_tokens=8, temperature=1.0, seed=1)
+    b = gen.generate([[1, 2, 3]], max_new_tokens=8, temperature=1.0, seed=1)
+    c = gen.generate([[1, 2, 3]], max_new_tokens=8, temperature=1.0, seed=2)
+    assert a == b          # deterministic for a seed
+    assert a != c          # 8 tokens over a 512 vocab: collision ~impossible
+
+
+def test_filter_logits_topk_topp():
+    logits = jnp.log(jnp.array([[0.5, 0.25, 0.15, 0.1]]))
+    k2 = filter_logits(logits, top_k=2)
+    assert np.isfinite(np.asarray(k2[0, :2])).all()
+    assert np.isneginf(np.asarray(k2[0, 2:])).all()
+    p6 = filter_logits(logits, top_p=0.6)       # 0.5 alone < 0.6 → keep 2
+    assert np.isfinite(np.asarray(p6[0, :2])).all()
+    assert np.isneginf(np.asarray(p6[0, 2:])).all()
+    p4 = filter_logits(logits, top_p=0.4)       # argmax always kept
+    assert np.isfinite(np.asarray(p4[0, 0]))
+    assert np.isneginf(np.asarray(p4[0, 1:])).all()
+
+
+def test_generate_sharded_matches_unsharded(cfg, params):
+    """Generation under a dp×tp mesh must equal single-device generation."""
+    mesh = MeshSpec(dp=2, tp=4).build()
+    gen1 = Generator(params, cfg)
+    gen8 = Generator(params, cfg, mesh=mesh)
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6]]
+    assert (gen1.generate(prompts, max_new_tokens=4, temperature=0.0)
+            == gen8.generate(prompts, max_new_tokens=4, temperature=0.0))

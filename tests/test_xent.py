@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from kubetorch_tpu.models import LlamaConfig, llama
-from kubetorch_tpu.ops.xent import fused_cross_entropy, _pick_chunks
+from kubetorch_tpu.ops.xent import fused_cross_entropy, _pad_to_multiple
 from kubetorch_tpu.training import cross_entropy_loss
 
 pytestmark = pytest.mark.level("unit")
@@ -24,11 +24,21 @@ def _setup(vocab=97, batch=2, seq=12, embed=16):
     return hidden, head, targets
 
 
-def test_pick_chunks_divides():
+def test_pad_to_multiple():
     for n in (1, 7, 24, 4096, 6144):
-        for target in (1, 5, 1024):
-            c = _pick_chunks(n, target)
-            assert n % c == 0 and 1 <= c <= max(1, min(target, n))
+        for chunk in (1, 5, 1024):
+            p = _pad_to_multiple(n, chunk)
+            assert p >= n and p % chunk == 0 and p - n < chunk
+
+
+def test_prime_token_count_matches_naive():
+    # B*S with no friendly divisor must still chunk (padding, not chunk=1)
+    hidden, head, targets = _setup(batch=1, seq=13)
+    naive, _ = cross_entropy_loss(
+        jnp.einsum("bse,ev->bsv", hidden, head), targets)
+    fused, faux = fused_cross_entropy(hidden, head, targets, chunk_size=4)
+    np.testing.assert_allclose(naive, fused, rtol=1e-5)
+    assert int(faux["tokens"]) == 13
 
 
 @pytest.mark.parametrize("chunk_size", [3, 8, 1024])
