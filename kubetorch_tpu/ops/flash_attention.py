@@ -160,6 +160,16 @@ def _flash_forward(
     return (res[0], res[1]) if with_lse else (res[0], None)
 
 
+def flash_bwd_delta(g, out):
+    """delta_i = rowsum(dO_i · O_i) in the narrow-lane stats layout.
+
+    Loop-invariant wrt the KV chunk — ring attention computes it once and
+    reuses it across all ring steps of the backward pass."""
+    B, Hq, S, _ = g.shape
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    return jnp.broadcast_to(delta[..., None], (B, Hq, S, _STATS))
+
+
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                dq_scratch, *, scale: float, causal: bool,
                block_q: int, block_k: int):
@@ -258,16 +268,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, *, scale, causal, block_q, block_k,
-                    interpret):
+                    interpret, delta=None):
     B, Hq, S, D = q.shape
     _, Hkv, T, _ = k.shape
     group = Hq // Hkv
     nq = S // block_q
     nk = T // block_k
 
-    # delta_i = rowsum(dO_i · O_i), narrow-lane like lse.
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], (B, Hq, S, _STATS))
+    if delta is None:
+        delta = flash_bwd_delta(g, out)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -362,6 +371,47 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def flash_tileable(q_shape, k_shape, block_q: int = 512,
+                   block_k: int = 512) -> bool:
+    """True when [B,S,H,D] / [B,T,Hkv,D] shapes fit the kernel tiling."""
+    B, S, Hq, D = q_shape
+    T, Hkv = k_shape[1], k_shape[2]
+    bq, bk = min(block_q, S), min(block_k, T)
+    return (S % bq == 0 and T % bk == 0 and D % 128 == 0
+            and Hq % Hkv == 0 and bq % 8 == 0 and bk % 8 == 0)
+
+
+def flash_attention_with_lse(
+    q: jax.Array,                 # [B, S, Hq, D] — must be tileable
+    k: jax.Array,                 # [B, T, Hkv, D]
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+):
+    """Forward-only flash returning (out [B,S,H,D], lse [B,H,S] f32).
+
+    The lse output makes results mergeable across KV chunks (online-softmax
+    combine) — ring attention folds per-chunk flash results this way.
+    Differentiation goes through the plain :func:`flash_attention` path;
+    this variant is for inference/manual-combine callers.
+    """
+    B, S, Hq, D = q.shape
+    scale = scale if scale is not None else D ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    block_q = min(block_q, S)
+    block_k = min(block_k, k.shape[1])
+    out, lse = _flash_forward(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret)
+    return out.transpose(0, 2, 1, 3), lse[..., 0]
+
+
 def flash_attention(
     q: jax.Array,                 # [B, S, Hq, D]
     k: jax.Array,                 # [B, T, Hkv, D]
@@ -380,16 +430,13 @@ def flash_attention(
     """
     B, S, Hq, D = q.shape
     T = k.shape[1]
-    Hkv = k.shape[2]
     scale = scale if scale is not None else D ** -0.5
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
+    if not flash_tileable(q.shape, k.shape, block_q, block_k):
+        return dot_product_attention(q, k, v, causal=causal, scale=scale)
     block_q = min(block_q, S)
     block_k = min(block_k, T)
-    tileable = (S % block_q == 0 and T % block_k == 0 and D % 128 == 0
-                and Hq % Hkv == 0)
-    if not tileable:
-        return dot_product_attention(q, k, v, causal=causal, scale=scale)
     out = _flash(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
         v.transpose(0, 2, 1, 3), scale, causal, block_q, block_k, interpret)

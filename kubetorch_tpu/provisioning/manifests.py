@@ -65,6 +65,37 @@ RESOURCE_CONFIGS: Dict[str, Dict[str, Any]] = {
         "replica_path": ("spec", "workerGroupSpecs", 0, "replicas"),
         "routing": "head",
     },
+    # Kubeflow training-operator CRDs (reference SUPPORTED_TRAINING_JOBS,
+    # provisioning/utils.py:423). Kinds are data, not code: the TPU-first
+    # path is jobset, but BYO Kubeflow workloads route the same way.
+    "pytorchjob": {
+        "api_version": "kubeflow.org/v1",
+        "kind": "PyTorchJob",
+        "plural": "pytorchjobs",
+        "pod_template_path": (
+            "spec", "pytorchReplicaSpecs", "Worker", "template"),
+        "replica_path": (
+            "spec", "pytorchReplicaSpecs", "Worker", "replicas"),
+        "routing": "headless",
+    },
+    "tfjob": {
+        "api_version": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "plural": "tfjobs",
+        "pod_template_path": (
+            "spec", "tfReplicaSpecs", "Worker", "template"),
+        "replica_path": ("spec", "tfReplicaSpecs", "Worker", "replicas"),
+        "routing": "headless",
+    },
+    "xgboostjob": {
+        "api_version": "kubeflow.org/v1",
+        "kind": "XGBoostJob",
+        "plural": "xgboostjobs",
+        "pod_template_path": (
+            "spec", "xgbReplicaSpecs", "Worker", "template"),
+        "replica_path": ("spec", "xgbReplicaSpecs", "Worker", "replicas"),
+        "routing": "headless",
+    },
     "selector": {  # BYO pods: route only, create nothing
         "api_version": None,
         "kind": None,

@@ -34,6 +34,18 @@ PROVIDER_SHIMS: Dict[str, Dict[str, List[str]]] = {
     "github": {"env": ["GITHUB_TOKEN", "GH_TOKEN"], "files": []},
     "docker": {"env": [], "files": ["~/.docker/config.json"]},
     "kubernetes": {"env": ["KUBECONFIG"], "files": ["~/.kube/config"]},
+    "azure": {"env": ["AZURE_SUBSCRIPTION_ID", "AZURE_CLIENT_ID",
+                      "AZURE_CLIENT_SECRET", "AZURE_TENANT_ID"],
+              "files": ["~/.azure/clouds.config"]},
+    "cohere": {"env": ["COHERE_API_KEY", "CO_API_KEY"], "files": []},
+    "lambda": {"env": ["LAMBDA_API_KEY"],
+               "files": ["~/.lambda_cloud/lambda_keys"]},
+    "langchain": {"env": ["LANGCHAIN_API_KEY", "LANGSMITH_API_KEY"],
+                  "files": []},
+    "pinecone": {"env": ["PINECONE_API_KEY"], "files": []},
+    "ssh": {"env": [], "files": ["~/.ssh/id_rsa", "~/.ssh/id_rsa.pub",
+                                 "~/.ssh/id_ed25519",
+                                 "~/.ssh/id_ed25519.pub"]},
 }
 
 

@@ -144,6 +144,42 @@ def test_env_and_secrets_injection():
         remote.teardown()
 
 
+def test_secret_provider_shims_cover_reference_set(monkeypatch, tmp_path):
+    """Every provider the reference ships a shim for must harvest here
+    (reference: resources/secrets/provider_secrets/ — 14 provider modules)."""
+    from kubetorch_tpu.resources.secrets.secret import PROVIDER_SHIMS, Secret
+
+    reference_providers = {
+        "anthropic", "aws", "azure", "cohere", "gcp", "github",
+        "huggingface", "kubernetes", "lambda", "langchain", "openai",
+        "pinecone", "ssh", "wandb"}
+    assert reference_providers <= set(PROVIDER_SHIMS)
+
+    # env-var harvest: one representative var per env-bearing provider
+    for provider, shim in PROVIDER_SHIMS.items():
+        if not shim["env"]:
+            continue
+        var = shim["env"][0]
+        monkeypatch.setenv(var, "tok-" + provider)
+        s = Secret.from_provider(provider)
+        assert s.values[var] == "tok-" + provider
+        assert s.local_env()[var] == "tok-" + provider
+        monkeypatch.delenv(var)
+
+    # file harvest (ssh has no env vars at all)
+    key = tmp_path / "id_ed25519"
+    key.write_text("PRIVATE")
+    monkeypatch.setitem(PROVIDER_SHIMS, "ssh", {"env": [], "files": [str(key)]})
+    s = Secret.from_provider("ssh")
+    assert s.values[f"file:{key.name}"] == "PRIVATE"
+    # file values never leak into env-var injection or k8s manifest data
+    assert s.local_env() == {}
+    assert s.to_manifest()["data"] == {}
+
+    with pytest.raises(ValueError, match="unknown provider"):
+        Secret.from_provider("nope")
+
+
 @pytest.mark.level("minimal")
 def test_profile_trace_roundtrip(summer_service):
     """jax.profiler trace control on a live service (additive vs the

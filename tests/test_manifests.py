@@ -139,6 +139,19 @@ def test_navigate_path_and_kind_table():
     assert template["spec"]["containers"][0]["name"] == "kubetorch"
     assert navigate_path(m, cfg["replica_path"]) == 1
     assert RESOURCE_CONFIGS["jobset"]["routing"] == "headless"
+    # the full reference kind table (RESOURCE_CONFIGS, provisioning/
+    # utils.py:301-384) must be representable
+    for kind in ("deployment", "knative", "raycluster", "pytorchjob",
+                 "tfjob", "xgboostjob", "selector", "jobset"):
+        assert kind in RESOURCE_CONFIGS
+    # BYO kubeflow manifests: pod template path must resolve
+    pt = {"spec": {"pytorchReplicaSpecs": {"Worker": {
+        "replicas": 2, "template": {"spec": {"containers": []}}}}}}
+    assert navigate_path(
+        pt, RESOURCE_CONFIGS["pytorchjob"]["pod_template_path"]) \
+        == {"spec": {"containers": []}}
+    assert navigate_path(
+        pt, RESOURCE_CONFIGS["pytorchjob"]["replica_path"]) == 2
 
 
 def test_service_manifest():

@@ -45,6 +45,42 @@ def test_ring_attention_noncausal_and_grads():
                                rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_flash_engine_matches_global(causal):
+    """D=128 engages the flash chunk engine inside the ring — results and
+    gradients must match global attention."""
+    mesh = MeshSpec(sp=4, fsdp=2).build()
+    q, k, v = _qkv(B=2, S=64, Hq=4, Hkv=2, D=128)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh, causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-3, atol=2e-3)
+
+    def ring_loss(q, k, v):
+        o = ring_attention(q, k, v, mesh, causal=causal)
+        return (o * jnp.sin(o)).sum()
+
+    def ref_loss(q, k, v):
+        o = dot_product_attention(q, k, v, causal=causal)
+        return (o * jnp.sin(o)).sum()
+
+    gr = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gr, gf, "q k v".split()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-3, err_msg=name)
+
+
+def test_ring_attention_flash_engine_with_tp_heads():
+    mesh = MeshSpec(sp=2, tp=2, dp=2).build()
+    q, k, v = _qkv(B=2, S=32, Hq=4, Hkv=4, D=128)
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-3, atol=2e-3)
+
+
 # ------------------------------------------------------------- flash
 def test_flash_attention_interpret_matches_reference():
     q, k, v = _qkv(S=256, D=128)
