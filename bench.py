@@ -56,7 +56,27 @@ def _bench_tpu():
     }
     result = trainer.benchmark(data, n_steps=steps, warmup=2)
     per_chip = result["tokens_per_sec"] / n_dev
+
+    if on_tpu:
+        result["generate_tok_s"] = _bench_decode(trainer.state["params"], cfg)
     return metric, per_chip, result
+
+
+def _bench_decode(params, cfg, B=8, P=128, N=64):
+    """KV-cache generation throughput incl. prefill (stderr detail)."""
+    import time
+
+    import numpy as np
+
+    from kubetorch_tpu.models import Generator
+
+    gen = Generator(params, cfg)
+    prompts = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (B, P)).tolist()
+    gen.generate(prompts, max_new_tokens=N, temperature=0.8)   # compile
+    t0 = time.perf_counter()
+    gen.generate(prompts, max_new_tokens=N, temperature=0.8)
+    return B * N / (time.perf_counter() - t0)
 
 
 def main():
@@ -81,8 +101,10 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs, 4),
     }))
+    extra = (f" generate={detail['generate_tok_s']:.0f}tok/s"
+             if "generate_tok_s" in detail else "")
     print(f"# detail: step_time={detail['step_time_s'] * 1e3:.1f}ms "
-          f"loss={detail['loss']:.3f}", file=sys.stderr)
+          f"loss={detail['loss']:.3f}{extra}", file=sys.stderr)
 
 
 if __name__ == "__main__":

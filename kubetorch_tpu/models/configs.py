@@ -13,6 +13,14 @@ class MoEConfig:
     num_experts: int = 8
     top_k: int = 2
     expert_mlp_dim: int = 2048
+    # "dense": evaluate every expert on every token (exact, full FLOPs —
+    #   fine for few experts / small models).
+    # "capacity": GShard-style fixed-capacity dispatch — each expert
+    #   processes at most ceil(tokens * top_k / num_experts) *
+    #   capacity_factor tokens (overflow dropped), cutting expert FLOPs by
+    #   num_experts/top_k at static shapes XLA can tile.
+    dispatch: str = "dense"
+    capacity_factor: float = 1.25
 
 
 @dataclasses.dataclass(frozen=True)

@@ -33,6 +33,11 @@ from kubetorch_tpu.parallel.mesh import use_mesh
 from kubetorch_tpu.parallel.sharding import ShardingRules
 
 
+_TOP_P_CANDIDATES = 2048  # nucleus threshold search space (full sort is
+                          # ~0.7 ms/step at V=32k on v5e; top_k of 2048 is
+                          # cheaper and exact unless the nucleus is wider)
+
+
 def filter_logits(logits: jax.Array, top_k: Optional[int] = None,
                   top_p: Optional[float] = None) -> jax.Array:
     """Apply top-k and/or nucleus (top-p) filtering to [B, V] logits."""
@@ -40,14 +45,23 @@ def filter_logits(logits: jax.Array, top_k: Optional[int] = None,
         kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None:
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        # threshold search over the top candidates only (lax.top_k returns
+        # them sorted); probabilities still normalize over the FULL vocab,
+        # so the cutoff matches full-sort semantics exactly whenever the
+        # nucleus fits in the candidate set. If the true nucleus is wider
+        # than _TOP_P_CANDIDATES (near-flat distribution at top_p→1), the
+        # sample is truncated to the top candidates — narrower than exact
+        # nucleus sampling. Accepted trade-off for the ~0.7 ms/step the
+        # full 32k-vocab sort costs on v5e.
+        c = min(_TOP_P_CANDIDATES, logits.shape[-1])
+        cand = jax.lax.top_k(logits, c)[0]            # [B, c] descending
+        logz = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+        probs = jnp.exp(cand - logz)
         cum = jnp.cumsum(probs, axis=-1)
         # keep the smallest prefix with cumulative prob >= top_p (always
         # keep the argmax); threshold = logit of the last kept token.
         keep = cum - probs < top_p
-        kth = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
-                      axis=-1, keepdims=True)
+        kth = jnp.min(jnp.where(keep, cand, jnp.inf), axis=-1, keepdims=True)
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     return logits
 
@@ -84,11 +98,12 @@ class Generator:
         self._prefill = jax.jit(
             partial(self._prefill_impl, cfg=cfg, rules=self.rules),
             static_argnames=("max_len",))
+        # note: no cache donation — the decode returns only tokens, so XLA
+        # has no same-shaped output to alias the donated buffer to.
         self._decode = jax.jit(
             partial(self._decode_impl, cfg=cfg, rules=self.rules),
             static_argnames=("n_steps", "temperature", "top_k", "top_p",
-                             "eos_id", "pad_id"),
-            donate_argnames=("cache",))
+                             "eos_id", "pad_id"))
 
     # -------------------------------------------------------------- impl
     @staticmethod

@@ -26,6 +26,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from kubetorch_tpu.models.configs import LlamaConfig
 from kubetorch_tpu.ops import apply_rope, dot_product_attention, rms_norm, rope_angles
@@ -124,13 +125,33 @@ def param_logical_axes(cfg: LlamaConfig) -> Params:
 # --------------------------------------------------------------------------
 
 def _moe_block(x, layer, cfg: LlamaConfig, rules: ShardingRules):
-    """Top-k MoE with renormalized gates; experts sharded over ``ep``."""
+    if cfg.moe.dispatch == "capacity":
+        return _moe_block_capacity(x, layer, cfg, rules)
+    if cfg.moe.dispatch != "dense":
+        raise ValueError(f"unknown moe dispatch {cfg.moe.dispatch!r}")
+    return _moe_block_dense(x, layer, cfg, rules)
+
+
+def _moe_router(x, layer, moe):
+    """Softmax router → renormalized top-k (values [.., k], indices [.., k])."""
+    gates = jax.nn.softmax(
+        jnp.einsum("...e,en->...n", x.astype(jnp.float32),
+                   layer["router"].astype(jnp.float32)), axis=-1)
+    top_vals, top_idx = jax.lax.top_k(gates, moe.top_k)
+    top_vals = top_vals / (jnp.sum(top_vals, axis=-1, keepdims=True) + 1e-9)
+    return gates, top_vals, top_idx
+
+
+def _moe_block_dense(x, layer, cfg: LlamaConfig, rules: ShardingRules):
+    """Top-k MoE, every expert evaluated densely; sharded over ``ep``.
+
+    Weighting is equivalent to the capacity path's renormalized top-k
+    (``_moe_router``) expressed as a dense [.., n_exp] mask."""
     moe = cfg.moe
     gates = jax.nn.softmax(
         jnp.einsum("bse,en->bsn", x.astype(jnp.float32),
                    layer["router"].astype(jnp.float32)), axis=-1)
-    top_vals, _ = jax.lax.top_k(gates, moe.top_k)
-    thresh = top_vals[..., -1:]
+    thresh = jax.lax.top_k(gates, moe.top_k)[0][..., -1:]
     masked = jnp.where(gates >= thresh, gates, 0.0)
     weights = masked / (jnp.sum(masked, axis=-1, keepdims=True) + 1e-9)
 
@@ -143,6 +164,53 @@ def _moe_block(x, layer, cfg: LlamaConfig, rules: ShardingRules):
     out = jnp.einsum("bsxm,xme,bsx->bse", h, layer["we_down"],
                      weights.astype(x.dtype))
     return out
+
+
+def _moe_block_capacity(x, layer, cfg: LlamaConfig, rules: ShardingRules):
+    """Fixed-capacity token dispatch (GShard-style), static shapes.
+
+    Tokens scatter into a per-expert buffer [X, C, E] (slot position =
+    running count of that expert's assignments; overflow beyond capacity C
+    is dropped via OOB scatter mode). Experts run ordinary [C, E] matmuls —
+    num_experts/top_k fewer FLOPs than dense — and kept slots gather back
+    weighted by their renormalized gates. No [tokens, X, C] one-hot is ever
+    materialized (GShard's einsum formulation costs O(n·X·C) memory; the
+    scatter form is O(n·K + X·C·E)).
+    """
+    moe = cfg.moe
+    B, S, E = x.shape
+    n = B * S
+    K, X = moe.top_k, moe.num_experts
+    x2d = x.reshape(n, E)
+
+    _, top_vals, top_idx = _moe_router(x2d, layer, moe)
+
+    cap = int(np.ceil(n * K / X * moe.capacity_factor))
+    e_flat = top_idx.reshape(-1)                        # [n*K] token-major
+    # slot position within its expert = how many earlier slots chose it
+    onehot = (e_flat[:, None] == jnp.arange(X)[None, :]).astype(jnp.int32)
+    pos = (jnp.cumsum(onehot, axis=0) - 1)              # [n*K, X]
+    pos_flat = jnp.take_along_axis(pos, e_flat[:, None], axis=1)[:, 0]
+    keep = pos_flat < cap
+    # OOB position → mode="drop" discards overflow tokens
+    pos_safe = jnp.where(keep, pos_flat, cap)
+
+    tok = jnp.repeat(jnp.arange(n), K)
+    buf = jnp.zeros((X, cap, E), x.dtype)
+    buf = buf.at[e_flat, pos_safe].set(x2d[tok], mode="drop")
+    buf = shard_constraint(buf, rules, "expert", None, None)
+
+    h = jax.nn.silu(jnp.einsum("xce,xem->xcm", buf, layer["we_gate"])) \
+        * jnp.einsum("xce,xem->xcm", buf, layer["we_up"])
+    h = shard_constraint(h, rules, "expert", None, "mlp")
+    y = jnp.einsum("xcm,xme->xce", h, layer["we_down"])  # [X, C, E]
+
+    gathered = y.at[e_flat, pos_safe].get(
+        mode="drop", fill_value=0.0)                     # [n*K, E]
+    gathered = gathered * (keep[:, None]
+                           * top_vals.reshape(-1)[:, None]).astype(x.dtype)
+    out = gathered.reshape(n, K, E).sum(axis=1)
+    return out.reshape(B, S, E)
 
 
 def _remat_policy(cfg: LlamaConfig):

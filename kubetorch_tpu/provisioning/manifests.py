@@ -155,8 +155,10 @@ def build_pod_template(
             "initialDelaySeconds": 2, "periodSeconds": 3,
         },
     }
-    if compute.volumes:
-        container["volumeMounts"] = [v.pod_mount() for v in compute.volumes]
+    mounts = [v.pod_mount() for v in compute.volumes]
+    mounts += [m for m in (s.pod_mount() for s in compute.secrets) if m]
+    if mounts:
+        container["volumeMounts"] = mounts
 
     spec: Dict[str, Any] = {"containers": [container]}
     selectors = compute.all_node_selectors()
@@ -172,8 +174,10 @@ def build_pod_template(
         spec["priorityClassName"] = compute.priority_class
     if compute.service_account:
         spec["serviceAccountName"] = compute.service_account
-    if compute.volumes:
-        spec["volumes"] = [v.pod_volume() for v in compute.volumes]
+    pod_volumes = [v.pod_volume() for v in compute.volumes]
+    pod_volumes += [v for v in (s.pod_volume() for s in compute.secrets) if v]
+    if pod_volumes:
+        spec["volumes"] = pod_volumes
 
     return {
         "metadata": {

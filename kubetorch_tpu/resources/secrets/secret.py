@@ -79,8 +79,24 @@ class Secret:
         return cls(name=name or f"{provider}-secret", values=values,
                    provider=provider)
 
+    @staticmethod
+    def _file_key(key: str) -> str:
+        """`file:id_rsa` → a k8s-legal data key (`file.id_rsa`)."""
+        return "file." + key.split(":", 1)[1].replace("/", "_")
+
+    def file_items(self) -> Dict[str, str]:
+        """Harvested credential files: sanitized data key → contents."""
+        return {self._file_key(k): v for k, v in self.values.items()
+                if k.startswith("file:")}
+
     # ---- k8s -----------------------------------------------------------
     def to_manifest(self, namespace: str = "default") -> Dict[str, Any]:
+        """Env values AND file credentials land in the Secret data (file
+        entries under sanitized ``file.<name>`` keys, delivered by
+        ``pod_volume``/``pod_mount``)."""
+        data = {k: v for k, v in self.values.items()
+                if not k.startswith("file:")}
+        data.update(self.file_items())
         return {
             "apiVersion": "v1",
             "kind": "Secret",
@@ -88,9 +104,26 @@ class Secret:
                          "labels": {"kubetorch.com/managed": "true"}},
             "type": "Opaque",
             "data": {k: base64.b64encode(v.encode()).decode()
-                     for k, v in self.values.items()
-                     if not k.startswith("file:")},
+                     for k, v in data.items()},
         }
+
+    def pod_volume(self) -> Optional[Dict[str, Any]]:
+        """Secret volume for file credentials (None when there are none)."""
+        if not self.file_items():
+            return None
+        return {"name": f"secret-{self.name}",
+                "secret": {"secretName": self.name,
+                           "items": [{"key": k, "path": k[len("file."):]}
+                                     for k in self.file_items()]}}
+
+    def pod_mount(self, mount_path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        """volumeMount delivering harvested files at
+        ``/etc/kt-secrets/<name>/<filename>`` (0400)."""
+        if not self.file_items():
+            return None
+        return {"name": f"secret-{self.name}",
+                "mountPath": mount_path or f"/etc/kt-secrets/{self.name}",
+                "readOnly": True}
 
     def pod_env(self) -> List[Dict[str, Any]]:
         """envFrom-style injection for the pod template."""

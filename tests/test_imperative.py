@@ -172,9 +172,19 @@ def test_secret_provider_shims_cover_reference_set(monkeypatch, tmp_path):
     monkeypatch.setitem(PROVIDER_SHIMS, "ssh", {"env": [], "files": [str(key)]})
     s = Secret.from_provider("ssh")
     assert s.values[f"file:{key.name}"] == "PRIVATE"
-    # file values never leak into env-var injection or k8s manifest data
+    # file values are delivered as mounted secret files, never as env vars
     assert s.local_env() == {}
-    assert s.to_manifest()["data"] == {}
+    import base64
+
+    data = s.to_manifest()["data"]
+    assert base64.b64decode(data["file.id_ed25519"]).decode() == "PRIVATE"
+    vol, mount = s.pod_volume(), s.pod_mount()
+    assert vol["secret"]["secretName"] == s.name
+    assert vol["secret"]["items"] == [
+        {"key": "file.id_ed25519", "path": "id_ed25519"}]
+    assert mount["mountPath"].endswith(s.name) and mount["readOnly"]
+    # env-only secrets need no volume plumbing
+    assert Secret(name="x", values={"A": "1"}).pod_volume() is None
 
     with pytest.raises(ValueError, match="unknown provider"):
         Secret.from_provider("nope")

@@ -129,6 +129,67 @@ def test_moe_forward_and_grads():
     assert float(jnp.abs(grads["layers"]["router"]).sum()) > 0
 
 
+def test_moe_capacity_dispatch_matches_dense_when_ample():
+    """With capacity >= tokens*top_k no token drops, so the scatter
+    dispatch must reproduce the dense evaluation exactly."""
+    import dataclasses
+
+    cfg = LlamaConfig.tiny_moe()
+    ample = dataclasses.replace(
+        cfg, moe=dataclasses.replace(
+            cfg.moe, dispatch="capacity",
+            capacity_factor=float(cfg.moe.num_experts)))
+    params = llama.init(jax.random.key(0), cfg)
+    batch = _batch(cfg)
+    dense = llama.forward(params, batch["inputs"], cfg)
+    capacity = llama.forward(params, batch["inputs"], ample)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(capacity),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_moe_capacity_dispatch_drops_and_trains():
+    """Tight capacity drops overflow tokens but must stay finite and give
+    finite grads (incl. router)."""
+    import dataclasses
+
+    cfg = LlamaConfig.tiny_moe()
+    tight = dataclasses.replace(
+        cfg, moe=dataclasses.replace(
+            cfg.moe, dispatch="capacity", capacity_factor=0.5))
+    params = llama.init(jax.random.key(0), tight)
+    batch = _batch(tight)
+
+    def loss_fn(p):
+        logits = llama.forward(p, batch["inputs"], tight)
+        return cross_entropy_loss(logits, batch["targets"])[0]
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    assert bool(jnp.isfinite(loss))
+    assert all(bool(jnp.isfinite(g).all()) for g in jax.tree.leaves(grads))
+    assert float(jnp.abs(grads["layers"]["router"]).sum()) > 0
+
+
+def test_moe_capacity_sharded_matches_unsharded():
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny_moe(),
+        moe=dataclasses.replace(LlamaConfig.tiny_moe().moe,
+                                dispatch="capacity", capacity_factor=8.0))
+    mesh = MeshSpec(fsdp=2, ep=2, tp=2).build()
+    params = llama.init(jax.random.key(1), cfg)
+    batch = _batch(cfg)
+    ref = llama.forward(params, batch["inputs"], cfg)
+    rules = ShardingRules.default()
+    from kubetorch_tpu.training.trainer import param_shardings
+    sharded = jax.device_put(params, param_shardings(cfg, mesh, rules))
+    with use_mesh(mesh):
+        out = jax.jit(lambda p, t: llama.forward(p, t, cfg, rules))(
+            sharded, batch["inputs"])
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_moe_sharded_matches_unsharded():
     cfg = LlamaConfig.tiny_moe()
     mesh = MeshSpec(fsdp=2, ep=2, tp=2).build()

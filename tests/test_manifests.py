@@ -131,6 +131,20 @@ def test_volumes_and_secrets_in_manifest_set():
                == "tok" for e in container["env"])
 
 
+def test_file_secret_mounted_in_pod_template():
+    secret = kt.Secret(name="sshkeys",
+                       values={"file:id_rsa": "PRIVATE", "TOKEN": "t"})
+    compute = kt.Compute(cpus="1", secrets=[secret])
+    manifests = build_manifests("svc", compute)
+    deploy = next(m for m in manifests if m["kind"] == "Deployment")
+    spec = deploy["spec"]["template"]["spec"]
+    assert spec["volumes"] == [secret.pod_volume()]
+    container = spec["containers"][0]
+    assert secret.pod_mount() in container["volumeMounts"]
+    secret_manifest = next(m for m in manifests if m["kind"] == "Secret")
+    assert "file.id_rsa" in secret_manifest["data"]
+
+
 def test_navigate_path_and_kind_table():
     compute = kt.Compute(cpus="1")
     m = build_deployment_manifest("svc", compute)
