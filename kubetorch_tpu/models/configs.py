@@ -43,7 +43,7 @@ class LlamaConfig:
     # Which intermediates survive remat: "nothing" recomputes the whole block
     # in backward (min memory); "dots" saves matmul outputs (no-batch-dim
     # contractions), skipping the recompute FLOPs at ~2x activation memory.
-    remat_policy: str = "nothing"  # nothing | dots | dots_and_attn
+    remat_policy: str = "nothing"  # nothing | dots | dots_and_attn | dots_no_mlp
     moe: Optional[MoEConfig] = None
     max_seq_len: int = 8192
     # "auto" → pallas flash for long tileable sequences, XLA otherwise;

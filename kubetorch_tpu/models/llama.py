@@ -27,6 +27,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 
 from kubetorch_tpu.models.configs import LlamaConfig
 from kubetorch_tpu.ops import apply_rope, dot_product_attention, rms_norm, rope_angles
@@ -222,10 +223,18 @@ def _remat_policy(cfg: LlamaConfig):
         return jax.checkpoint_policies.save_from_both_policies(
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             jax.checkpoint_policies.save_only_these_names("attn_out"))
+    if cfg.remat_policy == "dots_no_mlp":
+        # Save the narrow per-layer intermediates (qkv projections, attn
+        # output, mlp output) but NOT the wide gate/up MLP activations
+        # (B*S*mlp_dim each — the bulk of "dots" memory); those recompute
+        # in backward. ~4x less activation memory for ~2 extra MLP matmuls
+        # — the policy that unlocks larger per-chip batches.
+        return jax.checkpoint_policies.save_only_these_names(
+            "qkv_q", "qkv_k", "qkv_v", "attn_out", "mlp_out")
     if cfg.remat_policy != "nothing":
         raise ValueError(
             f"unknown remat_policy {cfg.remat_policy!r}; options: "
-            "'nothing', 'dots', 'dots_and_attn'")
+            "'nothing', 'dots', 'dots_and_attn', 'dots_no_mlp'")
     return jax.checkpoint_policies.nothing_saveable
 
 
@@ -237,12 +246,15 @@ def _block(x, layer, sin, cos, cfg: LlamaConfig, rules: ShardingRules,
     H, Hkv, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-    q = jnp.einsum("bse,ehd->bshd", h,
-                   layer["wq"].reshape(E, H, D).astype(dt))
-    k = jnp.einsum("bse,ehd->bshd", h,
-                   layer["wk"].reshape(E, Hkv, D).astype(dt))
-    v = jnp.einsum("bse,ehd->bshd", h,
-                   layer["wv"].reshape(E, Hkv, D).astype(dt))
+    q = checkpoint_name(jnp.einsum(
+        "bse,ehd->bshd", h, layer["wq"].reshape(E, H, D).astype(dt)),
+        "qkv_q")
+    k = checkpoint_name(jnp.einsum(
+        "bse,ehd->bshd", h, layer["wk"].reshape(E, Hkv, D).astype(dt)),
+        "qkv_k")
+    v = checkpoint_name(jnp.einsum(
+        "bse,ehd->bshd", h, layer["wv"].reshape(E, Hkv, D).astype(dt)),
+        "qkv_v")
     q = apply_rope(q, None, cfg.rope_theta, sin=sin, cos=cos)
     k = apply_rope(k, None, cfg.rope_theta, sin=sin, cos=cos)
 
@@ -276,8 +288,6 @@ def _block(x, layer, sin, cos, cfg: LlamaConfig, rules: ShardingRules,
         else:
             attn = dot_product_attention(q, k, v, causal=True,
                                          segment_ids=segment_ids)
-    from jax.ad_checkpoint import checkpoint_name
-
     attn = checkpoint_name(attn.reshape(B, S, H * D), "attn_out")
     x = x + jnp.einsum("bsf,fe->bse", attn, layer["wo"].astype(dt))
     x = shard_constraint(x, rules, "batch", "seq", None)
@@ -295,8 +305,10 @@ def _mlp(x, layer, cfg: LlamaConfig, rules: ShardingRules):
         up = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(dt))
         ff = shard_constraint(jax.nn.silu(gate) * up, rules,
                               "batch", "seq", "mlp")
-        return jnp.einsum("bsm,me->bse", ff, layer["w_down"].astype(dt))
-    return _moe_block(h, layer, cfg, rules).astype(dt)
+        out = jnp.einsum("bsm,me->bse", ff, layer["w_down"].astype(dt))
+    else:
+        out = _moe_block(h, layer, cfg, rules).astype(dt)
+    return checkpoint_name(out, "mlp_out")
 
 
 def hidden_states(

@@ -51,6 +51,35 @@ def get_distributed_env_vars(
     return env
 
 
+def _maybe_device_stats() -> Optional[Dict[str, int]]:
+    """Accelerator memory stats from THIS process (the one owning the TPU).
+
+    DCGM-analogue for the metrics pipeline (SURVEY §5.5 "replace DCGM with
+    TPU metrics"): summed over local devices, attached to call responses so
+    the pod server can report them without ever touching the devices
+    itself. Only reports when user code already imported jax — never
+    initializes a backend for the sake of metrics.
+    """
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        agg: Dict[str, int] = {}
+        devices = jax.local_devices()
+        for dev in devices:
+            stats = dev.memory_stats() or {}
+            for key in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use"):
+                value = stats.get(key)
+                if value is not None:
+                    agg[f"device_{key}"] = agg.get(f"device_{key}", 0) + value
+        agg["device_count"] = len(devices)
+        return agg
+    except Exception:
+        return None
+
+
 def _load_target(root_path: str, import_path: str, name: str,
                  callable_type: str, init_args: Optional[dict]):
     """Import the user symbol from synced source inside the worker process."""
@@ -184,7 +213,8 @@ class _WorkerLoop:
                 {"result": result}, req["serialization"],
                 req.get("allowed", serialization.METHODS))
             return {"req_id": req_id, "ok": True, "payload": payload,
-                    "serialization": used}
+                    "serialization": used,
+                    "device_stats": _maybe_device_stats()}
         except BaseException as exc:  # noqa: BLE001 — must package everything
             if isinstance(exc, (KeyboardInterrupt, SystemExit)):
                 raise

@@ -412,6 +412,11 @@ class PodServer:
                 RuntimeError("worker returned no response")), status=500)
         if not resp.get("ok"):
             return web.json_response({"error": resp["error"]}, status=500)
+        stats = resp.pop("device_stats", None)
+        if stats:
+            # workers attach accelerator memory stats to responses; the
+            # freshest snapshot rides the next metrics push (DCGM analogue)
+            self.metrics.update(stats)
         used = resp.get("serialization", ser)
         return web.Response(
             body=resp["payload"],

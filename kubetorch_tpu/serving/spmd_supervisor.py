@@ -325,6 +325,10 @@ class SPMDDistributedSupervisor(DistributedSupervisor):
                 try:
                     if kind == "local":
                         resp = fut.result()
+                        if resp.get("device_stats"):
+                            # keep the freshest local accelerator stats so
+                            # the packed response carries them (pod /metrics)
+                            self._device_stats = resp["device_stats"]
                         if not resp.get("ok"):
                             error = rehydrate_exception(
                                 {"error": resp["error"]})
@@ -358,4 +362,7 @@ class SPMDDistributedSupervisor(DistributedSupervisor):
             result_obj = {"result": self._merge_rank_results(
                 pairs, total_ranks)}
         payload, used = serialization.choose(result_obj, ser, self.allowed)
-        return {"ok": True, "payload": payload, "serialization": used}
+        out = {"ok": True, "payload": payload, "serialization": used}
+        if getattr(self, "_device_stats", None):
+            out["device_stats"] = self._device_stats
+        return out

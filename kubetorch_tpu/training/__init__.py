@@ -2,6 +2,11 @@ from kubetorch_tpu.training.checkpoint import (
     CheckpointManager,
     save_for_resume,
 )
+from kubetorch_tpu.training.data import (
+    host_shard,
+    lm_batches,
+    prefetch_to_device,
+)
 from kubetorch_tpu.training.trainer import (
     Trainer,
     cross_entropy_loss,
@@ -16,4 +21,7 @@ __all__ = [
     "cross_entropy_loss",
     "init_train_state",
     "make_train_step",
+    "host_shard",
+    "lm_batches",
+    "prefetch_to_device",
 ]

@@ -56,3 +56,13 @@ def debug_me(x):
     doubled = x * 2
     kt.deep_breakpoint(timeout=60.0)
     return doubled
+
+
+def jax_touch():
+    """Imports jax and runs a tiny op — used by device-metrics tests."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    return float(jax.numpy.zeros(2).sum())

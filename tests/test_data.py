@@ -1,0 +1,106 @@
+"""Input pipeline: per-host sharded LM batching + device prefetch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubetorch_tpu.training.data import (
+    host_shard,
+    lm_batches,
+    prefetch_to_device,
+)
+
+pytestmark = pytest.mark.level("unit")
+
+
+def test_host_shard_partition():
+    slices = [host_shard(32, pi, 4) for pi in range(4)]
+    assert slices == [(0, 8), (8, 8), (16, 8), (24, 8)]
+    with pytest.raises(ValueError, match="not divisible"):
+        host_shard(10, 0, 4)
+
+
+def test_lm_batches_shapes_and_shift():
+    tokens = np.arange(10_000, dtype=np.int32)
+    it = lm_batches(tokens, global_batch=4, seq_len=16, seed=0,
+                    process_index=0, process_count=1)
+    batch = next(it)
+    assert batch["inputs"].shape == (4, 16)
+    assert batch["targets"].shape == (4, 16)
+    # targets are inputs shifted by one (contiguous windows of arange)
+    np.testing.assert_array_equal(batch["targets"], batch["inputs"] + 1)
+    # deterministic per seed
+    again = next(lm_batches(tokens, 4, 16, seed=0,
+                            process_index=0, process_count=1))
+    np.testing.assert_array_equal(batch["inputs"], again["inputs"])
+
+
+def test_lm_batches_hosts_tile_the_global_batch():
+    tokens = np.arange(5_000, dtype=np.int32)
+    full = next(lm_batches(tokens, 8, 4, seed=3,
+                           process_index=0, process_count=1))
+    parts = [next(lm_batches(tokens, 8, 4, seed=3,
+                             process_index=pi, process_count=2))
+             for pi in range(2)]
+    np.testing.assert_array_equal(
+        full["inputs"], np.concatenate([p["inputs"] for p in parts]))
+
+
+def test_lm_batches_works_off_memmap(tmp_path):
+    path = tmp_path / "toks.bin"
+    np.arange(4_096, dtype=np.uint16).tofile(path)
+    mm = np.memmap(path, dtype=np.uint16, mode="r")
+    batch = next(lm_batches(mm, 2, 8, seed=1,
+                            process_index=0, process_count=1))
+    np.testing.assert_array_equal(batch["targets"], batch["inputs"] + 1)
+
+
+def test_prefetch_to_device_preserves_order_and_device():
+    batches = [{"x": np.full((2,), i)} for i in range(5)]
+    out = list(prefetch_to_device(iter(batches), size=2))
+    assert len(out) == 5
+    for i, b in enumerate(out):
+        assert isinstance(b["x"], jax.Array)
+        assert int(b["x"][0]) == i
+
+
+def test_prefetch_with_sharding_lands_in_layout():
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from kubetorch_tpu.parallel import MeshSpec
+
+    mesh = MeshSpec(dp=8).build()
+    sharding = NamedSharding(mesh, PartitionSpec("dp"))
+    batches = ({"x": np.arange(8, dtype=np.float32)} for _ in range(3))
+    out = list(prefetch_to_device(batches, size=2, sharding=sharding))
+    assert all(b["x"].sharding == sharding for b in out)
+
+
+def test_prefetch_shorter_than_lookahead():
+    out = list(prefetch_to_device(iter([{"x": np.ones(1)}]), size=4))
+    assert len(out) == 1
+
+
+def test_pipeline_feeds_trainer():
+    import optax
+
+    from kubetorch_tpu.models import LlamaConfig
+    from kubetorch_tpu.parallel import MeshSpec
+    from kubetorch_tpu.training import Trainer
+
+    cfg = LlamaConfig.tiny()
+    trainer = Trainer(cfg, MeshSpec(fsdp=-1).build(),
+                      optimizer=optax.sgd(0.1))
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, 4_000).astype(np.int32)
+    it = prefetch_to_device(
+        lm_batches(tokens, 2, 32, seed=0,
+                   process_index=0, process_count=1),
+        transform=lambda b: {k: jnp.asarray(v) for k, v in b.items()})
+    # fresh random windows each step — assert the pipeline drives training
+    # (finite losses, roughly at/below the uniform-vocab ceiling), not
+    # memorization of a repeated batch.
+    losses = [float(trainer.step(next(it))["loss"]) for _ in range(5)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < np.log(cfg.vocab_size) * 1.5

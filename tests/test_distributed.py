@@ -82,6 +82,26 @@ def test_jax_framework_env():
         remote.teardown()
 
 
+def test_spmd_path_carries_device_stats():
+    """Worker device stats must survive SPMD aggregation to /metrics
+    (the DCGM-analogue pipeline on multi-worker TPU pods)."""
+    import httpx
+
+    remote = Fn(root_path=str(ASSETS), import_path="summer",
+                callable_name="jax_touch", name="jax-stats")
+    compute = kt.Compute(cpus="0.1").distribute(
+        "jax", workers=2, num_procs=1, monitor_members=False)
+    remote.to(compute)
+    try:
+        results = remote()
+        assert results == [0.0, 0.0]
+        metrics = httpx.get(f"{remote.pod_urls()[0]}/metrics",
+                            timeout=10.0).json()
+        assert metrics.get("device_count", 0) >= 1
+    finally:
+        remote.teardown()
+
+
 @pytest.mark.level("minimal")
 def test_distributed_error_fast_fails():
     remote = Fn(root_path=str(ASSETS), import_path="summer",

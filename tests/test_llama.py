@@ -74,7 +74,7 @@ def test_remat_policies_identical_grads(tiny_cfg):
     base = dataclasses.replace(tiny_cfg, remat=False)
     params = llama.init(jax.random.key(0), base)
     ref_loss, ref_grads = loss_for(base, params)
-    for policy in ("nothing", "dots", "dots_and_attn"):
+    for policy in ("nothing", "dots", "dots_and_attn", "dots_no_mlp"):
         cfg = dataclasses.replace(tiny_cfg, remat=True, remat_policy=policy)
         loss, grads = loss_for(cfg, params)
         np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)

@@ -379,3 +379,52 @@ def test_event_watcher_pushes_new_events_only():
     # service= filter narrows to the launch's own events
     mine = sink.query({"job": "kubetorch-events", "service": "my-fn"})
     assert all(e["labels"]["service"] == "my-fn" for e in mine)
+
+
+# ---------------------------------------------------------------- device
+class TestDeviceStats:
+    def test_maybe_device_stats_without_jax(self, monkeypatch):
+        import sys
+
+        from kubetorch_tpu.serving import process_worker
+
+        monkeypatch.setitem(sys.modules, "jax", None)
+        assert process_worker._maybe_device_stats() is None
+
+    def test_maybe_device_stats_with_jax(self):
+        import jax  # noqa: F401  (already forced to CPU by conftest)
+
+        from kubetorch_tpu.serving.process_worker import _maybe_device_stats
+
+        stats = _maybe_device_stats()
+        assert stats is not None and stats["device_count"] >= 1
+
+    def test_maybe_device_stats_swallow_errors(self, monkeypatch):
+        import sys
+        import types
+
+        from kubetorch_tpu.serving import process_worker
+
+        broken = types.SimpleNamespace(
+            local_devices=lambda: (_ for _ in ()).throw(RuntimeError("x")))
+        monkeypatch.setitem(sys.modules, "jax", broken)
+        assert process_worker._maybe_device_stats() is None
+
+    @pytest.mark.level("minimal")
+    def test_stats_reach_pod_metrics_endpoint(self):
+        """A call whose worker imported jax must surface device stats on the
+        pod /metrics endpoint (the DCGM-analogue pipeline)."""
+        import httpx
+
+        from tests.test_imperative import _make_fn
+
+        import kubetorch_tpu as kt
+
+        remote = _make_fn("jax_touch").to(kt.Compute(cpus="0.1"))
+        try:
+            assert remote() == 0.0
+            url = remote.pod_urls()[0]
+            metrics = httpx.get(f"{url}/metrics", timeout=10.0).json()
+            assert metrics.get("device_count", 0) >= 1
+        finally:
+            remote.teardown()
