@@ -43,7 +43,7 @@ def train(model: str = "tiny", batch_per_chip: int = 1, seq_len: int = 2048,
     # synthetic corpus through the real input pipeline: per-host sharded
     # windows + device prefetch (training/data.py). Swap `corpus` for an
     # np.memmap over a tokenized dataset for real pretraining.
-    from kubetorch_tpu.training import lm_batches, prefetch_to_device
+    from kubetorch_tpu.training import lm_batches
 
     corpus = np.random.default_rng(0).integers(
         0, cfg.vocab_size, max(batch * (seq + 1) * 4, 1 << 16),
@@ -51,12 +51,10 @@ def train(model: str = "tiny", batch_per_chip: int = 1, seq_len: int = 2048,
     # process_count=1: benchmark() feeds full global batches from every
     # host (jit assembles them); per-host sharded feeding pairs with
     # make_array_from_process_local_data in a real multi-host input loop.
-    # size=1: benchmark() reuses one batch, so a deeper lookahead would
-    # device_put batches nothing consumes.
-    feed = prefetch_to_device(
-        lm_batches(corpus, batch, seq, seed=0,
-                   process_index=0, process_count=1), size=1)
-    data = next(feed)
+    # benchmark() reuses ONE batch, so no prefetch lookahead here — a real
+    # training loop would wrap this iterator in prefetch_to_device.
+    data = jax.device_put(next(lm_batches(
+        corpus, batch, seq, seed=0, process_index=0, process_count=1)))
 
     result = trainer.benchmark(data, n_steps=steps, warmup=2)
 

@@ -1,0 +1,86 @@
+"""Long-context training: ring attention over a sequence-parallel mesh.
+
+Sequence parallelism (`sp`) shards Q/K/V along the sequence axis; ring
+attention (parallel/ring.py) rotates KV chunks over ICI with the Pallas
+flash kernels as the per-chunk engine — exact attention, O(S/sp) memory per
+device, no all-gather of KV. Single-chip long context instead relies on the
+flash kernel + the ``dots_no_mlp`` remat policy (measured on one v5e chip:
+S=8192 at ~15.4k tok/s with "dots"; S=16384 fits only with "dots_no_mlp",
+~10.9k tok/s).
+
+Smoke mode: sp=4 × fsdp=2 on the 8-device virtual CPU mesh.
+Cluster mode: ``.distribute("jax", workers=N)`` on a TPU slice, sp spanning
+the slice's ICI ring.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def train_long(seq_len: int = 2048, sp: int = 4, steps: int = 4,
+               model: str = "tiny") -> dict:
+    import jax
+    import numpy as np
+    import optax
+
+    from kubetorch_tpu.models import LlamaConfig
+    from kubetorch_tpu.parallel import MeshSpec
+    from kubetorch_tpu.training import Trainer
+
+    n_dev = len(jax.devices())
+    if model == "tiny":
+        cfg = LlamaConfig.tiny(max_seq_len=max(seq_len, 128),
+                               head_dim=16)
+    else:
+        cfg = LlamaConfig.llama3_1b(max_seq_len=seq_len, remat=True,
+                                    remat_policy="dots_no_mlp")
+    mesh = MeshSpec(sp=sp, fsdp=-1).build()
+    trainer = Trainer(cfg, mesh, optimizer=optax.adamw(3e-4))
+
+    batch = max(1, mesh.shape.get("fsdp", 1))
+    toks = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, seq_len + 1))
+    data = {"inputs": jax.numpy.asarray(toks[:, :-1], jax.numpy.int32),
+            "targets": jax.numpy.asarray(toks[:, 1:], jax.numpy.int32)}
+    result = trainer.benchmark(data, n_steps=steps, warmup=1)
+    return {
+        "devices": n_dev,
+        "mesh": dict(mesh.shape),
+        "seq_len": seq_len,
+        "ring_attention": mesh.shape.get("sp", 1) > 1,
+        "loss": round(result["loss"], 4),
+        "tokens_per_sec": round(result["tokens_per_sec"], 1),
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--seq-len", type=int, default=32768)
+    parser.add_argument("--workers", type=int, default=4)
+    args = parser.parse_args()
+
+    if args.smoke:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        result = train_long(seq_len=256, sp=4, steps=2)
+        print(json.dumps({"example": "long_context_ring", **result}))
+        return
+
+    import kubetorch_tpu as kt
+
+    compute = kt.Compute(tpus="v5e-32").distribute("jax",
+                                                   workers=args.workers)
+    remote = kt.fn(train_long).to(compute)
+    results = remote(seq_len=args.seq_len, sp=8, steps=10, model="1b")
+    first = results[0] if isinstance(results, list) else results
+    print(json.dumps({"example": "long_context_ring", **first}))
+
+
+if __name__ == "__main__":
+    main()

@@ -226,14 +226,41 @@ def build_jobset_manifest(
     hosts = tpu.num_hosts if tpu else 1
     env = dict(env or {})
     if tpu:
-        env.setdefault(
-            "TPU_WORKER_HOSTNAMES",
-            ",".join(tpu.worker_hostnames(service_name, compute.namespace)))
+        slice0 = tpu.worker_hostnames(service_name, compute.namespace,
+                                      slice_index=0)
+        if workers > 1:
+            # Multi-slice (megascale): each replicated job is one slice;
+            # libtpu's DCN mesh spans slices via the MEGASCALE contract.
+            # TPU_WORKER_HOSTNAMES must list THIS slice's hosts, which vary
+            # per job — the pod server expands the pattern with its
+            # MEGASCALE_SLICE_ID at startup (serving/frameworks.py).
+            env.setdefault(
+                "KT_TPU_HOSTNAME_PATTERN",
+                tpu.worker_hostnames(service_name, compute.namespace,
+                                     slice_index=0)[0].replace(
+                    f"-0-0.", "-{slice}-{host}.", 1))
+            env.setdefault("KT_TPU_HOSTS_PER_SLICE", str(hosts))
+            env.setdefault("MEGASCALE_NUM_SLICES", str(workers))
+            env.setdefault("MEGASCALE_COORDINATOR_ADDRESS",
+                           f"{slice0[0]}:8081")
+        else:
+            env.setdefault("TPU_WORKER_HOSTNAMES", ",".join(slice0))
     template = build_pod_template(service_name, compute, env)
     template["spec"]["subdomain"] = f"{service_name}-headless"
+    if tpu and workers > 1:
+        # slice id comes from the JobSet job index, resolved per pod via
+        # the downward API (annotation set by the JobSet controller).
+        template["spec"]["containers"][0]["env"].append({
+            "name": "MEGASCALE_SLICE_ID",
+            "valueFrom": {"fieldRef": {"fieldPath":
+                "metadata.annotations['jobset.sigs.k8s.io/job-index']"}},
+        })
     job_spec: Dict[str, Any] = {
+        # Indexed completion + JobSet DNS (below) give each pod the stable
+        # hostname the TPU_WORKER_HOSTNAMES contract resolves.
         "parallelism": hosts,
         "completions": hosts,
+        "completionMode": "Indexed",
         "backoffLimit": 0,
         "template": template,
     }
@@ -247,6 +274,10 @@ def build_jobset_manifest(
             "annotations": compute.workload_annotations(),
         },
         "spec": {
+            "network": {
+                "enableDNSHostnames": True,
+                "subdomain": f"{service_name}-headless",
+            },
             "replicatedJobs": [{
                 "name": "workers",
                 "replicas": workers,

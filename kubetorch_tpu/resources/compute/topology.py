@@ -80,11 +80,19 @@ class TpuSpec:
     def resource_limits(self) -> Dict[str, str]:
         return {"google.com/tpu": str(self.chips_per_pod)}
 
-    def worker_hostnames(self, service_name: str, namespace: str) -> List[str]:
-        """Stable per-host DNS names for TPU_WORKER_HOSTNAMES injection."""
+    def worker_hostnames(self, service_name: str, namespace: str,
+                         slice_index: int = 0,
+                         job_name: str = "workers") -> List[str]:
+        """Stable per-host DNS names for TPU_WORKER_HOSTNAMES injection.
+
+        Matches the JobSet pod-DNS contract: with ``completionMode:
+        Indexed`` + ``network.enableDNSHostnames``, pod ``i`` of replicated
+        job ``j`` resolves as
+        ``{jobset}-{job}-{j}-{i}.{subdomain}.{ns}.svc.cluster.local``.
+        """
         return [
-            f"{service_name}-{i}.{service_name}-headless."
-            f"{namespace}.svc.cluster.local"
+            f"{service_name}-{job_name}-{slice_index}-{i}."
+            f"{service_name}-headless.{namespace}.svc.cluster.local"
             for i in range(self.num_hosts)
         ]
 

@@ -75,22 +75,59 @@ class JaxProcess(FrameworkProcess):
                       pod_ips) -> Dict[str, str]:
         coordinator = pod_ips[0].split(":")[0] if pod_ips else "127.0.0.1"
         process_id = node_rank * self.num_procs + local_rank
+        env: Dict[str, str] = {}
         tpu_worker_id = os.environ.get("TPU_WORKER_ID")
         if tpu_worker_id is not None and self.num_procs == 1:
             process_id = int(tpu_worker_id)
-        env = {
+            slice_id = os.environ.get("MEGASCALE_SLICE_ID")
+            num_slices = int(os.environ.get("MEGASCALE_NUM_SLICES") or 1)
+            if slice_id is not None and num_slices > 1:
+                # TPU_WORKER_ID restarts at 0 per slice; globalize it so
+                # jax process ids are unique across the DCN mesh.
+                hosts_per_slice = world_size // num_slices
+                process_id = (int(slice_id) * hosts_per_slice
+                              + int(tpu_worker_id))
+                hostnames = self._slice_hostnames(slice_id, hosts_per_slice)
+                if hostnames:
+                    env["TPU_WORKER_HOSTNAMES"] = ",".join(hostnames)
+            # With slice-derived process ids, pod_ips[0] (the HTTP-routed
+            # pod, rotated to node_rank 0) is NOT necessarily process 0 —
+            # jax.distributed requires the coordinator to BE process 0, so
+            # point it at slice-0/worker-0's stable DNS name.
+            if slice_id is not None and num_slices > 1:
+                coord = os.environ.get("MEGASCALE_COORDINATOR_ADDRESS", "")
+            else:
+                coord = (os.environ.get("TPU_WORKER_HOSTNAMES", "")
+                         or env.get("TPU_WORKER_HOSTNAMES", ""))
+            if coord:
+                coordinator = coord.split(",")[0].split(":")[0]
+        env.update({
             "JAX_COORDINATOR_ADDRESS": f"{coordinator}:{self.port}",
             "JAX_NUM_PROCESSES": str(world_size),
             "JAX_PROCESS_ID": str(process_id),
-        }
+        })
         # Multi-slice (megascale) pass-through.
         for key, value in os.environ.items():
             if key.startswith("MEGASCALE_"):
-                env[key] = value
+                env.setdefault(key, value)
         if self.num_procs > 1:
             # Multiple jax processes on one host must split local chips.
             env["JAX_LOCAL_DEVICE_IDS"] = str(local_rank)
         return env
+
+    @staticmethod
+    def _slice_hostnames(slice_id: str,
+                         hosts_per_slice: int) -> Optional[List[str]]:
+        """Expand this slice's TPU_WORKER_HOSTNAMES from the provisioning
+        pattern (multi-slice: each slice's list differs, so it cannot be a
+        static env var — manifests.py sets the pattern instead)."""
+        pattern = os.environ.get("KT_TPU_HOSTNAME_PATTERN")
+        if not pattern:
+            return None
+        hosts = int(os.environ.get("KT_TPU_HOSTS_PER_SLICE",
+                                   str(hosts_per_slice)) or hosts_per_slice)
+        return [pattern.format(slice=int(slice_id), host=i)
+                for i in range(hosts)]
 
     def cleanup_env(self) -> List[str]:
         return ["JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",

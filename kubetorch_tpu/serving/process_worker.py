@@ -57,8 +57,10 @@ def _maybe_device_stats() -> Optional[Dict[str, int]]:
     DCGM-analogue for the metrics pipeline (SURVEY §5.5 "replace DCGM with
     TPU metrics"): summed over local devices, attached to call responses so
     the pod server can report them without ever touching the devices
-    itself. Only reports when user code already imported jax — never
-    initializes a backend for the sake of metrics.
+    itself. Only reports when user code already *initialized* a backend —
+    a bare ``import jax`` (e.g. for tree utils, or before a deliberate
+    ``jax.distributed.initialize``) must not trigger device acquisition
+    from the metrics hook.
     """
     import sys
 
@@ -66,6 +68,9 @@ def _maybe_device_stats() -> Optional[Dict[str, int]]:
     if jax is None:
         return None
     try:
+        xla_bridge = sys.modules.get("jax._src.xla_bridge")
+        if xla_bridge is None or not getattr(xla_bridge, "_backends", None):
+            return None  # backend not live; stay hands-off
         agg: Dict[str, int] = {}
         devices = jax.local_devices()
         for dev in devices:

@@ -57,8 +57,10 @@ def lm_batches(
     rng = np.random.default_rng(seed)
     while True:
         # every host draws the same global offsets, then takes its slice —
-        # deterministic global batches with zero coordination.
-        offsets = rng.integers(0, n - seq_len - 1, (global_batch,))
+        # deterministic global batches with zero coordination. Valid window
+        # starts are [0, n - seq_len - 1] inclusive (window spans
+        # seq_len + 1 tokens).
+        offsets = rng.integers(0, n - seq_len, (global_batch,))
         mine = offsets[start:start + size]
         window = np.stack([np.asarray(tokens[o:o + seq_len + 1])
                            for o in mine])

@@ -47,6 +47,15 @@ def test_lm_batches_hosts_tile_the_global_batch():
         full["inputs"], np.concatenate([p["inputs"] for p in parts]))
 
 
+def test_lm_batches_minimal_corpus():
+    # corpus of exactly seq_len+1 tokens: one valid window, must not crash
+    tokens = np.arange(17, dtype=np.int32)
+    batch = next(lm_batches(tokens, 2, 16, seed=0,
+                            process_index=0, process_count=1))
+    np.testing.assert_array_equal(batch["inputs"][0], np.arange(16))
+    np.testing.assert_array_equal(batch["targets"][0], np.arange(1, 17))
+
+
 def test_lm_batches_works_off_memmap(tmp_path):
     path = tmp_path / "toks.bin"
     np.arange(4_096, dtype=np.uint16).tofile(path)

@@ -48,6 +48,13 @@ def test_llama_fsdp_smoke(tmp_path):
 
 
 @pytest.mark.level("release")
+def test_long_context_ring_smoke(tmp_path):
+    result = _run_smoke("long_context_ring.py", tmp_path)
+    assert result["ring_attention"] is True
+    assert result["mesh"]["sp"] == 4
+
+
+@pytest.mark.level("release")
 def test_grpo_elastic_smoke(tmp_path):
     result = _run_smoke("grpo_elastic.py", tmp_path)
     assert result["trainer"]["published"] == 2

@@ -46,7 +46,11 @@ def test_worker_hostnames():
     spec = parse_tpus("v5e-16")
     hosts = spec.worker_hostnames("train", "ml")
     assert len(hosts) == 4
-    assert hosts[0] == "train-0.train-headless.ml.svc.cluster.local"
+    # JobSet pod-DNS contract: {jobset}-{job}-{jobIdx}-{podIdx}.{subdomain}
+    assert hosts[0] == ("train-workers-0-0.train-headless"
+                       ".ml.svc.cluster.local")
+    assert spec.worker_hostnames("train", "ml", slice_index=2)[1] == (
+        "train-workers-2-1.train-headless.ml.svc.cluster.local")
 
 
 # ---------------------------------------------------------------- manifests
@@ -66,8 +70,8 @@ def test_deployment_manifest_shape():
 
 
 def test_tpu_jobset_manifest():
-    compute = kt.Compute(tpus="v5e-16", queue_name="tpu-queue").distribute(
-        "jax", workers=2)
+    compute = kt.Compute(tpus="v5e-16", queue_name="tpu-queue",
+                         namespace="default").distribute("jax", workers=2)
     assert compute.deployment_mode == "jobset"
     m = build_jobset_manifest("train", compute)
     job = m["spec"]["replicatedJobs"][0]
@@ -79,13 +83,82 @@ def test_tpu_jobset_manifest():
     assert pod_spec["nodeSelector"][
         "cloud.google.com/gke-tpu-topology"] == "4x4"
     env = {e["name"]: e.get("value") for e in container["env"]}
-    assert "train-0.train-headless" in env["TPU_WORKER_HOSTNAMES"]
+    # multi-slice: per-slice hostname lists expand in-pod from the pattern
+    assert env["KT_TPU_HOSTNAME_PATTERN"] == (
+        "train-workers-{slice}-{host}.train-headless."
+        "default.svc.cluster.local")
+    assert env["KT_TPU_HOSTS_PER_SLICE"] == "4"
     # Kueue gang admission
     assert m["metadata"]["labels"]["kueue.x-k8s.io/queue-name"] == "tpu-queue"
     assert m["spec"]["suspend"] is True
     # TPU toleration present
     assert any(t.get("key") == "google.com/tpu"
                for t in pod_spec["tolerations"])
+    # multi-slice (megascale) contract: workers>1 slices get the DCN env
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"].startswith(
+        "train-workers-0-0.train-headless")
+    # stable pod DNS: Indexed jobs + JobSet DNS hostnames
+    assert m["spec"]["network"] == {
+        "enableDNSHostnames": True, "subdomain": "train-headless"}
+    assert job["template"]["spec"]["completionMode"] == "Indexed"
+    slice_env = next(e for e in container["env"]
+                     if e["name"] == "MEGASCALE_SLICE_ID")
+    assert "jobset.sigs.k8s.io/job-index" in (
+        slice_env["valueFrom"]["fieldRef"]["fieldPath"])
+
+
+def test_single_slice_jobset_has_no_megascale_env():
+    compute = kt.Compute(tpus="v5e-16").distribute("jax", workers=1)
+    m = build_jobset_manifest("train", compute)
+    container = (m["spec"]["replicatedJobs"][0]["template"]["spec"]
+                 ["template"]["spec"]["containers"][0])
+    env = {e["name"]: e.get("value") for e in container["env"]}
+    assert not any(n.startswith("MEGASCALE") for n in env)
+    # single slice: static hostnames, JobSet pod-DNS naming
+    assert env["TPU_WORKER_HOSTNAMES"].startswith(
+        "train-workers-0-0.train-headless")
+    assert len(env["TPU_WORKER_HOSTNAMES"].split(",")) == 4
+
+
+def test_jax_process_multislice_global_ids(monkeypatch):
+    """TPU_WORKER_ID restarts per slice; jax process ids must globalize."""
+    from kubetorch_tpu.serving.frameworks import JaxProcess
+
+    proc = JaxProcess(num_procs=1)
+    monkeypatch.setenv("TPU_WORKER_ID", "3")
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "1")
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+    monkeypatch.setenv("MEGASCALE_COORDINATOR_ADDRESS",
+                       "svc-workers-0-0.svc-headless:8081")
+    monkeypatch.setenv(
+        "KT_TPU_HOSTNAME_PATTERN",
+        "svc-workers-{slice}-{host}.svc-headless")
+    monkeypatch.setenv("KT_TPU_HOSTS_PER_SLICE", "4")
+    env = proc.rank_env(node_rank=0, local_rank=0, num_nodes=8,
+                        pod_ips=["10.0.0.1"] * 8)
+    # slice 1 of 2, 4 hosts/slice, worker 3 -> global process id 7
+    assert env["JAX_PROCESS_ID"] == "7"
+    assert env["JAX_NUM_PROCESSES"] == "8"
+    assert env["MEGASCALE_SLICE_ID"] == "1"   # passed through
+    # the jax coordinator must be process 0 (slice 0 / worker 0), not the
+    # HTTP-routed pod
+    assert env["JAX_COORDINATOR_ADDRESS"] == (
+        "svc-workers-0-0.svc-headless:8476")
+    # this slice's hostnames expand from the pattern
+    assert env["TPU_WORKER_HOSTNAMES"] == ",".join(
+        f"svc-workers-1-{i}.svc-headless" for i in range(4))
+    # single-slice: worker id used directly
+    monkeypatch.delenv("MEGASCALE_SLICE_ID")
+    monkeypatch.delenv("MEGASCALE_NUM_SLICES")
+    monkeypatch.delenv("MEGASCALE_COORDINATOR_ADDRESS")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES",
+                       "h0.svc,h1.svc,h2.svc,h3.svc")
+    env = proc.rank_env(node_rank=2, local_rank=0, num_nodes=4,
+                        pod_ips=["10.0.0.1"] * 4)
+    assert env["JAX_PROCESS_ID"] == "3"
+    # coordinator = worker 0's hostname (process 0), not pod_ips[0]
+    assert env["JAX_COORDINATOR_ADDRESS"] == "h0.svc:8476"
 
 
 def test_knative_manifest_with_autoscaling():
