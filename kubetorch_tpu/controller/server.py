@@ -25,7 +25,8 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
-from aiohttp import WSMsgType, web
+import aiohttp
+from aiohttp import ClientSession, WSMsgType, web
 
 from kubetorch_tpu.controller.db import Database
 from kubetorch_tpu.version import __version__, compatible
@@ -127,6 +128,13 @@ class ControllerServer:
         self.reaper_interval = reaper_interval
         self._reaper_task: Optional[asyncio.Task] = None
         self.auth_token = os.environ.get("KT_CONTROLLER_TOKEN") or None
+        # External token validation (reference: auth/middleware.py — bearer
+        # validated against an endpoint, with namespace access checks).
+        self.auth_validate_url = os.environ.get("KT_AUTH_VALIDATE_URL") or None
+        self._auth_cache: Dict[str, Any] = {}   # token -> (exp_ts, info|None)
+        self._auth_session = None
+        self.auth_cache_ttl = float(
+            os.environ.get("KT_AUTH_CACHE_TTL", "60"))
         self.cluster_config: Dict[str, Any] = {}
         # Controller-hosted observability sinks (SURVEY.md §5.5; reference
         # deploys Loki + Prometheus as separate components).
@@ -153,7 +161,7 @@ class ControllerServer:
     # ------------------------------------------------------------- app
     def build_app(self) -> web.Application:
         middlewares = []
-        if self.auth_token:
+        if self.auth_token or self.auth_validate_url:
             middlewares.append(self._mw_auth)
         app = web.Application(middlewares=middlewares,
                               client_max_size=256 * 1024**2)
@@ -191,15 +199,80 @@ class ControllerServer:
         if self._reaper_task:
             self._reaper_task.cancel()
         self.event_watcher.stop()
+        if self._auth_session is not None and not self._auth_session.closed:
+            await self._auth_session.close()
 
     @web.middleware
     async def _mw_auth(self, request: web.Request, handler):
         if request.path == "/health":
             return await handler(request)
-        token = request.headers.get("Authorization", "")
-        if token != f"Bearer {self.auth_token}":
+        header = request.headers.get("Authorization", "")
+        if not header.startswith("Bearer "):
             return web.json_response({"error": "unauthorized"}, status=401)
-        return await handler(request)
+        token = header[len("Bearer "):]
+        if self.auth_token and token == self.auth_token:
+            request["auth"] = {"username": "static", "namespaces": None}
+            return await handler(request)
+        if self.auth_validate_url:
+            info = await self._validate_token(token)
+            if info is not None:
+                request["auth"] = info
+                return await handler(request)
+        return web.json_response({"error": "unauthorized"}, status=401)
+
+    @staticmethod
+    def _ns_denied(request, namespace) -> Optional[web.Response]:
+        """403 when the authenticated token is namespace-scoped and the
+        request targets a namespace outside its set. Handlers that consume
+        a namespace (register/apply/teardown) call this with the value they
+        actually act on — the enforcement point is the action, not a
+        client-supplied query string."""
+        allowed = (request.get("auth") or {}).get("namespaces")
+        if allowed is not None and namespace and namespace not in allowed:
+            return web.json_response(
+                {"error": f"namespace {namespace!r} not allowed"},
+                status=403)
+        return None
+
+    _AUTH_CACHE_MAX = 4096   # junk-token flood must not grow memory unbounded
+
+    async def _validate_token(self, token: str) -> Optional[Dict[str, Any]]:
+        """Validate a bearer against the external endpoint, with caching.
+
+        The endpoint receives the token as its own bearer and returns 200
+        with optional ``{"username", "namespaces"}`` JSON on success.
+        Failures (non-200 or unreachable) deny access; denials are cached
+        too so a bad token cannot hammer the validator.
+        """
+        now = time.time()
+        cached = self._auth_cache.get(token)
+        if cached and cached[0] > now:
+            return cached[1]
+        info: Optional[Dict[str, Any]] = None
+        try:
+            if self._auth_session is None or self._auth_session.closed:
+                self._auth_session = ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=5.0))
+            resp = await self._auth_session.get(
+                self.auth_validate_url,
+                headers={"Authorization": f"Bearer {token}"})
+            if resp.status == 200:
+                try:
+                    body = await resp.json()
+                except Exception:
+                    body = {}
+                info = {"username": (body or {}).get("username", ""),
+                        "namespaces": (body or {}).get("namespaces")}
+        except Exception:
+            info = None
+        if len(self._auth_cache) >= self._AUTH_CACHE_MAX:
+            # evict expired first; if still full, drop the oldest-expiring
+            self._auth_cache = {
+                k: v for k, v in self._auth_cache.items() if v[0] > now}
+            while len(self._auth_cache) >= self._AUTH_CACHE_MAX:
+                self._auth_cache.pop(next(iter(self._auth_cache)))
+        self._auth_cache[token] = (now + self.auth_cache_ttl, info)
+        return info
 
     # -------------------------------------------------------- handlers
     async def h_health(self, request):
@@ -223,6 +296,9 @@ class ControllerServer:
         """The core deploy RPC (reference: routes/pool.py:39 register_pool)."""
         body = await request.json()
         service = body["service_name"]
+        denied = self._ns_denied(request, body.get("namespace", "default"))
+        if denied is not None:
+            return denied
         pool = self.db.upsert_pool(
             service,
             namespace=body.get("namespace", "default"),
@@ -256,6 +332,11 @@ class ControllerServer:
 
     async def h_teardown_pool(self, request):
         service = request.match_info["service"]
+        pool = self.db.get_pool(service)
+        denied = self._ns_denied(
+            request, (pool or {}).get("namespace"))
+        if denied is not None:
+            return denied
         deleted = self.db.delete_pool(service)
         self.log_sink.drop_stream(service)
         self.metrics_store.drop(service)
@@ -366,6 +447,11 @@ class ControllerServer:
 
             client = K8sClient.from_env()
             manifest = body.get("manifest") or {}
+            denied = self._ns_denied(
+                request,
+                (manifest.get("metadata") or {}).get("namespace"))
+            if denied is not None:
+                return denied
             if body.get("patch") == "merge":
                 op = lambda: client.patch(manifest)  # noqa: E731
             else:

@@ -58,9 +58,11 @@ class ControllerWebSocket:
     async def _run(self):
         """Reconnect loop (reference: _run:411)."""
         backoff = 1.0
+        token = os.environ.get("KT_CONTROLLER_TOKEN")
+        headers = {"Authorization": f"Bearer {token}"} if token else {}
         while not self._stop.is_set():
             try:
-                async with aiohttp.ClientSession() as session:
+                async with aiohttp.ClientSession(headers=headers) as session:
                     async with session.ws_connect(
                             self.ws_url, heartbeat=30.0) as ws:
                         self.connected = True

@@ -113,6 +113,14 @@ class JaxProcess(FrameworkProcess):
         if self.num_procs > 1:
             # Multiple jax processes on one host must split local chips.
             env["JAX_LOCAL_DEVICE_IDS"] = str(local_rank)
+        # Persistent compilation cache: reload-heavy iteration (the
+        # kubetorch UX) recompiles identical programs on every worker
+        # restart; caching cuts warm-deploy first-call latency from tens of
+        # seconds to ~none. Point KT_JAX_CACHE_DIR at a mounted volume to
+        # survive pod reschedules.
+        if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+            env["JAX_COMPILATION_CACHE_DIR"] = os.environ.get(
+                "KT_JAX_CACHE_DIR", "/tmp/kt-jax-cache")
         return env
 
     @staticmethod

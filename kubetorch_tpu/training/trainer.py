@@ -81,9 +81,15 @@ def make_train_step(
     rules: Optional[ShardingRules] = None,
     loss_fn: Optional[Callable] = None,
     mesh: Optional[Mesh] = None,
+    accum_steps: int = 1,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], tuple]:
     """Build the jitted train step. Call under ``use_mesh(mesh)``
-    (the Trainer does this) so PartitionSpec constraints resolve."""
+    (the Trainer does this) so PartitionSpec constraints resolve.
+
+    ``accum_steps > 1`` splits the batch's leading dim into that many
+    microbatches and accumulates grads under ``lax.scan`` — activation
+    memory of one microbatch, optimizer math of the full batch.
+    """
     rules = rules or ShardingRules.default()
     # Ring attention only engages when sequence parallelism is active.
     ring_mesh = (mesh if mesh is not None
@@ -103,10 +109,54 @@ def make_train_step(
             batch.get("mask"))
 
     compute_loss = loss_fn or default_loss
+    grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+
+    def compute_grads(params, batch):
+        if accum_steps <= 1:
+            return grad_fn(params, batch)
+        B = jax.tree.leaves(batch)[0].shape[0]
+        if B % accum_steps:
+            raise ValueError(
+                f"batch dim {B} not divisible by accum_steps={accum_steps}")
+        micro = jax.tree.map(
+            lambda x: x.reshape((accum_steps, B // accum_steps)
+                                + x.shape[1:]), batch)
+
+        def weighted(loss, aux, g):
+            # Per-microbatch losses are means over that microbatch's
+            # unmasked tokens; weight by the token count (when the loss
+            # reports one) so accumulation matches the full-batch mean
+            # exactly even with ragged masks. Without a count, microbatches
+            # weight uniformly (exact for unmasked LM batches).
+            w = aux.get("tokens", jnp.float32(1.0))
+            return (loss * w, jax.tree.map(lambda a: a * w, aux),
+                    jax.tree.map(lambda x: x * w, g), w)
+
+        def body(carry, mb):
+            loss_sum, aux_sum, grads, w_sum = carry
+            (loss, aux), g = grad_fn(params, mb)
+            loss_w, aux_w, g_w, w = weighted(loss, aux, g)
+            return (loss_sum + loss_w,
+                    jax.tree.map(jnp.add, aux_sum, aux_w),
+                    jax.tree.map(jnp.add, grads, g_w),
+                    w_sum + w), None
+
+        (loss0, aux0), g0 = grad_fn(
+            params, jax.tree.map(lambda x: x[0], micro))
+        loss0, aux0, g0, w0 = weighted(loss0, aux0, g0)
+        g0 = jax.tree.map(jnp.add, jax.tree.map(jnp.zeros_like, params), g0)
+        rest = jax.tree.map(lambda x: x[1:], micro)
+        (loss_sum, aux_sum, grads, w_sum), _ = jax.lax.scan(
+            body, (loss0, aux0, g0, w0), rest)
+        inv = 1.0 / w_sum
+        aux = jax.tree.map(lambda a: a * inv, aux_sum)
+        if "tokens" in aux:
+            aux["tokens"] = w_sum  # a count, not an average
+        return ((loss_sum * inv, aux),
+                jax.tree.map(lambda g: g * inv, grads))
 
     def train_step(state: TrainState, batch: Dict[str, jax.Array]):
-        (loss, aux), grads = jax.value_and_grad(compute_loss, has_aux=True)(
-            state["params"], batch)
+        (loss, aux), grads = compute_grads(state["params"], batch)
         updates, new_opt = optimizer.update(
             grads, state["opt_state"], state["params"])
         new_params = optax.apply_updates(state["params"], updates)
@@ -137,9 +187,11 @@ class Trainer:
         rules: Optional[ShardingRules] = None,
         seed: int = 0,
         loss_fn=None,
+        accum_steps: int = 1,
     ):
         """``loss_fn(params, batch) -> (loss, aux_dict)`` overrides the LM
-        cross-entropy objective (RL losses, distillation, ...)."""
+        cross-entropy objective (RL losses, distillation, ...).
+        ``accum_steps`` enables gradient accumulation over microbatches."""
         self.cfg = cfg
         self.mesh = mesh
         self.rules = rules or ShardingRules.default()
@@ -149,7 +201,8 @@ class Trainer:
             self.state = init_train_state(
                 jax.random.key(seed), cfg, mesh, self.optimizer, self.rules)
             self._step = make_train_step(cfg, self.optimizer, self.rules,
-                                         loss_fn=loss_fn, mesh=mesh)
+                                         loss_fn=loss_fn, mesh=mesh,
+                                         accum_steps=accum_steps)
 
     def step(self, batch: Dict[str, jax.Array]):
         with use_mesh(self.mesh):

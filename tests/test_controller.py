@@ -191,3 +191,117 @@ def test_activity_defers_ttl(client):
         time.sleep(1.0)
     assert client.get_pool("busy-svc") is not None
     client.teardown("busy-svc")
+
+
+# ---------------------------------------------------------------- auth
+class TestAuth:
+    def _spawn(self, tmp_path, env_extra, port=None):
+        port = port or _free_port()
+        env = {**os.environ, **env_extra}
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kubetorch_tpu.controller.server",
+             "--host", "127.0.0.1", "--port", str(port), "--db", ":memory:"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        base = f"http://127.0.0.1:{port}"
+        for _ in range(50):
+            try:
+                httpx.get(f"{base}/health", timeout=1.0)
+                break
+            except Exception:
+                time.sleep(0.2)
+        return proc, base
+
+    def test_static_token(self, tmp_path):
+        proc, base = self._spawn(tmp_path, {"KT_CONTROLLER_TOKEN": "s3cret"})
+        try:
+            # /health open; everything else needs the bearer
+            assert httpx.get(f"{base}/health").status_code == 200
+            assert httpx.get(f"{base}/pools").status_code == 401
+            assert httpx.get(
+                f"{base}/pools",
+                headers={"Authorization": "Bearer wrong"}).status_code == 401
+            assert httpx.get(
+                f"{base}/pools",
+                headers={"Authorization": "Bearer s3cret"}).status_code == 200
+        finally:
+            proc.terminate()
+
+    def test_pod_ws_connects_with_bearer(self, tmp_path):
+        """With auth on, the pod's controller WebSocket must present the
+        bearer (regression: WS connects were silently rejected)."""
+        proc, base = self._spawn(tmp_path, {"KT_CONTROLLER_TOKEN": "wstok"})
+        port = _free_port()
+        pod = subprocess.Popen(
+            [sys.executable, "-m", "kubetorch_tpu.serving.server",
+             "--host", "127.0.0.1", "--port", str(port)],
+            env={**os.environ,
+                 "KT_SERVICE_NAME": "authed-svc",
+                 "KT_SERVER_PORT": str(port),
+                 "KT_CONTROLLER_URL": base,
+                 "KT_CONTROLLER_TOKEN": "wstok",
+                 "KT_POD_NAME": "authed-svc-0",
+                 "PYTHONPATH": str(Path(__file__).resolve().parents[1])},
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            ok = False
+            for _ in range(100):
+                health = httpx.get(f"{base}/health", timeout=2.0).json()
+                if health["waiting_pods"] + health["connected_pods"] >= 1:
+                    ok = True
+                    break
+                time.sleep(0.2)
+            assert ok, "authed pod never registered over WS"
+        finally:
+            pod.terminate()
+            proc.terminate()
+
+    def test_external_validation_and_namespace_check(self, tmp_path):
+        # stand up a tiny validator: accepts token "tok-ml", scoped to ns ml
+        from aiohttp import web as _web
+
+        vport = _free_port()
+
+        async def validate(request):
+            tok = request.headers.get("Authorization", "")
+            if tok == "Bearer tok-ml":
+                return _web.json_response(
+                    {"username": "ml-user", "namespaces": ["ml"]})
+            return _web.json_response({}, status=401)
+
+        import threading
+
+        def run_validator():
+            app = _web.Application()
+            app.router.add_get("/validate", validate)
+            _web.run_app(app, host="127.0.0.1", port=vport,
+                         print=None, handle_signals=False)
+
+        t = threading.Thread(target=run_validator, daemon=True)
+        t.start()
+        time.sleep(0.7)
+
+        proc, base = self._spawn(tmp_path, {
+            "KT_AUTH_VALIDATE_URL": f"http://127.0.0.1:{vport}/validate"})
+        try:
+            hdr = {"Authorization": "Bearer tok-ml"}
+            assert httpx.get(f"{base}/pools").status_code == 401
+            assert httpx.get(
+                f"{base}/pools",
+                headers={"Authorization": "Bearer bad"}).status_code == 401
+            assert httpx.get(f"{base}/pools", headers=hdr).status_code == 200
+            # namespace scoping is enforced on the ACTION's namespace (the
+            # pool body), not a client-supplied query param
+            ok = httpx.post(f"{base}/pool", headers=hdr, json={
+                "service_name": "svc-ml", "namespace": "ml",
+                "broadcast": False})
+            assert ok.status_code == 200
+            denied = httpx.post(f"{base}/pool", headers=hdr, json={
+                "service_name": "svc-prod", "namespace": "prod",
+                "broadcast": False})
+            assert denied.status_code == 403
+            # teardown of the ml pool allowed; a static-token admin would
+            # bypass scoping entirely (namespaces=None)
+            assert httpx.delete(f"{base}/pool/svc-ml",
+                                headers=hdr).status_code == 200
+        finally:
+            proc.terminate()

@@ -159,6 +159,12 @@ def test_jax_process_multislice_global_ids(monkeypatch):
     assert env["JAX_PROCESS_ID"] == "3"
     # coordinator = worker 0's hostname (process 0), not pod_ips[0]
     assert env["JAX_COORDINATOR_ADDRESS"] == "h0.svc:8476"
+    # persistent compile cache on by default (overridable via env)
+    assert env["JAX_COMPILATION_CACHE_DIR"] == "/tmp/kt-jax-cache"
+    monkeypatch.setenv("KT_JAX_CACHE_DIR", "/ktfs/cache/jax")
+    env = proc.rank_env(node_rank=0, local_rank=0, num_nodes=1,
+                        pod_ips=["10.0.0.1"])
+    assert env["JAX_COMPILATION_CACHE_DIR"] == "/ktfs/cache/jax"
 
 
 def test_knative_manifest_with_autoscaling():
