@@ -183,6 +183,12 @@ class ControllerServer:
         r.add_delete("/runs/{run_id}", self.h_delete_run)
         r.add_post("/apply", self.h_apply)
         r.add_post("/teardown/{service}", self.h_teardown_pool)
+        # proxied K8s CRUD for clients without cluster credentials
+        # (reference: routes/{pods,services,deployments,...}.py — here one
+        # generic passthrough over the dynamic client)
+        r.add_get("/k8s/{kind}", self.h_k8s_list)
+        r.add_get("/k8s/{kind}/{name}", self.h_k8s_get)
+        r.add_delete("/k8s/{kind}/{name}", self.h_k8s_delete)
         from kubetorch_tpu.observability import log_sink as _ls
 
         _ls.mount(app, self.log_sink, self.metrics_store)
@@ -210,7 +216,9 @@ class ControllerServer:
         if not header.startswith("Bearer "):
             return web.json_response({"error": "unauthorized"}, status=401)
         token = header[len("Bearer "):]
-        if self.auth_token and token == self.auth_token:
+        import hmac
+
+        if self.auth_token and hmac.compare_digest(token, self.auth_token):
             request["auth"] = {"username": "static", "namespaces": None}
             return await handler(request)
         if self.auth_validate_url:
@@ -224,11 +232,13 @@ class ControllerServer:
     def _ns_denied(request, namespace) -> Optional[web.Response]:
         """403 when the authenticated token is namespace-scoped and the
         request targets a namespace outside its set. Handlers that consume
-        a namespace (register/apply/teardown) call this with the value they
-        actually act on — the enforcement point is the action, not a
-        client-supplied query string."""
+        a namespace call this with the value they actually act on — the
+        enforcement point is the action, not a client-supplied query
+        string. A scoped token MUST name an allowed namespace: a missing
+        namespace would otherwise fall through to the cluster default,
+        silently escaping the scope."""
         allowed = (request.get("auth") or {}).get("namespaces")
-        if allowed is not None and namespace and namespace not in allowed:
+        if allowed is not None and namespace not in allowed:
             return web.json_response(
                 {"error": f"namespace {namespace!r} not allowed"},
                 status=403)
@@ -253,16 +263,16 @@ class ControllerServer:
             if self._auth_session is None or self._auth_session.closed:
                 self._auth_session = ClientSession(
                     timeout=aiohttp.ClientTimeout(total=5.0))
-            resp = await self._auth_session.get(
-                self.auth_validate_url,
-                headers={"Authorization": f"Bearer {token}"})
-            if resp.status == 200:
-                try:
-                    body = await resp.json()
-                except Exception:
-                    body = {}
-                info = {"username": (body or {}).get("username", ""),
-                        "namespaces": (body or {}).get("namespaces")}
+            async with self._auth_session.get(
+                    self.auth_validate_url,
+                    headers={"Authorization": f"Bearer {token}"}) as resp:
+                if resp.status == 200:
+                    try:
+                        body = await resp.json()
+                    except Exception:
+                        body = {}
+                    info = {"username": (body or {}).get("username", ""),
+                            "namespaces": (body or {}).get("namespaces")}
         except Exception:
             info = None
         if len(self._auth_cache) >= self._AUTH_CACHE_MAX:
@@ -334,7 +344,7 @@ class ControllerServer:
         service = request.match_info["service"]
         pool = self.db.get_pool(service)
         denied = self._ns_denied(
-            request, (pool or {}).get("namespace"))
+            request, (pool or {}).get("namespace") or "default")
         if denied is not None:
             return denied
         deleted = self.db.delete_pool(service)
@@ -449,7 +459,8 @@ class ControllerServer:
             manifest = body.get("manifest") or {}
             denied = self._ns_denied(
                 request,
-                (manifest.get("metadata") or {}).get("namespace"))
+                (manifest.get("metadata") or {}).get("namespace")
+                or os.environ.get("KT_NAMESPACE", "default"))
             if denied is not None:
                 return denied
             if body.get("patch") == "merge":
@@ -462,6 +473,69 @@ class ControllerServer:
         except Exception as exc:
             return web.json_response(
                 {"error": f"{type(exc).__name__}: {exc}"}, status=501)
+
+    async def _k8s_op(self, request, op):
+        """Run a dynamic-client operation in a worker thread. 501 when the
+        controller has no cluster credentials (local/dev mode); real K8s
+        errors surface as 502 so clients can tell them apart."""
+        try:
+            from kubetorch_tpu.provisioning.k8s_client import K8sClient
+
+            client = K8sClient.from_env()
+        except Exception as exc:
+            return web.json_response(
+                {"error": f"no cluster credentials: {exc}"}, status=501)
+        try:
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: op(client))
+            return web.json_response({"result": result})
+        except Exception as exc:
+            return web.json_response(
+                {"error": f"{type(exc).__name__}: {exc}"}, status=502)
+
+    @staticmethod
+    def _k8s_kind(request) -> str:
+        """Accept Kind, lowercase kind, or plural resource names."""
+        from kubetorch_tpu.provisioning.k8s_client import kind_for
+
+        return kind_for(request.match_info["kind"])
+
+    def _k8s_ns(self, request):
+        """Effective namespace for proxy ops (query param or the
+        controller's default), for both the op and the scope check."""
+        return request.query.get("namespace") or os.environ.get(
+            "KT_NAMESPACE", "default")
+
+    async def h_k8s_list(self, request):
+        kind = self._k8s_kind(request)
+        ns = self._k8s_ns(request)
+        denied = self._ns_denied(request, ns)
+        if denied is not None:
+            return denied
+        selector = request.query.get("selector")
+        return await self._k8s_op(
+            request, lambda c: c.list(kind, namespace=ns,
+                                      label_selector=selector or ""))
+
+    async def h_k8s_get(self, request):
+        kind = self._k8s_kind(request)
+        name = request.match_info["name"]
+        ns = self._k8s_ns(request)
+        denied = self._ns_denied(request, ns)
+        if denied is not None:
+            return denied
+        return await self._k8s_op(
+            request, lambda c: c.get(kind, name, namespace=ns))
+
+    async def h_k8s_delete(self, request):
+        kind = self._k8s_kind(request)
+        name = request.match_info["name"]
+        ns = self._k8s_ns(request)
+        denied = self._ns_denied(request, ns)
+        if denied is not None:
+            return denied
+        return await self._k8s_op(
+            request, lambda c: c.delete(kind, name, namespace=ns))
 
     # ------------------------------------------------------------- TTL
     async def _reaper_loop(self):

@@ -30,6 +30,7 @@ from kubetorch_tpu.provisioning.k8s_client import K8sClient
 from kubetorch_tpu.provisioning.manifests import (
     SERVER_PORT,
     build_manifests,
+    build_workload_record,
 )
 from kubetorch_tpu.resources.compute.compute import Compute
 from kubetorch_tpu.serving import http_client
@@ -70,11 +71,19 @@ class K8sBackend:
         if controller is not None:
             env["KT_CONTROLLER_URL"] = controller.base_url
         manifests = build_manifests(service_name, compute, env)
+        manifests.append(build_workload_record(
+            service_name, compute, module_meta))
         for manifest in manifests:
-            if controller is not None:
-                controller.apply(manifest)
-            else:
-                self.client.apply(manifest)
+            try:
+                if controller is not None:
+                    controller.apply(manifest)
+                else:
+                    self.client.apply(manifest)
+            except Exception:
+                if manifest.get("kind") != "KubetorchWorkload":
+                    raise
+                # the CRD is optional (chart-installed); the declarative
+                # record is best-effort and never blocks a deploy.
         if controller is not None:
             controller.register_pool(
                 service_name, module_meta, compute=compute_dict,
@@ -208,7 +217,8 @@ class K8sBackend:
         workload_kinds = {"Deployment": "apps/v1",
                           "JobSet": "jobset.x-k8s.io/v1alpha2",
                           "Service": "serving.knative.dev/v1",
-                          "RayCluster": "ray.io/v1"}
+                          "RayCluster": "ray.io/v1",
+                          "KubetorchWorkload": "kubetorch.com/v1alpha1"}
         for kind, api_version in workload_kinds.items():
             manifest = {"apiVersion": api_version,
                         "kind": kind, "metadata": {"name": service_name}}

@@ -44,6 +44,18 @@ def plural_for(kind: str) -> str:
     return _PLURALS.get(kind, kind.lower() + "s")
 
 
+def kind_for(name: str) -> str:
+    """Inverse-ish of :func:`plural_for`: accept a Kind, a lowercase kind,
+    or a plural resource name ("pods", "ingresses") and return the Kind."""
+    if name in _PLURALS:
+        return name
+    lowered = name.lower()
+    for kind, plural in _PLURALS.items():
+        if lowered in (plural, kind.lower()):
+            return kind
+    return name[:1].upper() + name[1:]
+
+
 class K8sClient:
     def __init__(self, base_url: str, token: Optional[str] = None,
                  verify: Any = True, namespace: str = "default"):

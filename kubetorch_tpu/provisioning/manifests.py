@@ -387,6 +387,43 @@ def preprocess_byo_manifest(
     return manifest
 
 
+def build_workload_record(
+    service_name: str, compute: "Compute",
+    module_meta: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """The declarative KubetorchWorkload record (reference CRD:
+    kubetorchworkloads.kubetorch.com/v1alpha1 — selector + serviceConfig +
+    module). Applied best-effort alongside the workload so ``kubectl get
+    ktw`` shows what kubetorch deployed."""
+    meta = module_meta or {}
+    return {
+        "apiVersion": "kubetorch.com/v1alpha1",
+        "kind": "KubetorchWorkload",
+        "metadata": {
+            "name": service_name,
+            "namespace": compute.namespace,
+            "labels": compute.workload_labels(service_name),
+        },
+        "spec": {
+            "selector": {"kubetorch.com/service": service_name},
+            "serviceConfig": {
+                "port": SERVER_PORT,
+                "deploymentMode": compute.deployment_mode,
+                "replicas": compute.num_pods,
+            },
+            "module": {
+                "type": meta.get("callable_type", "fn"),
+                "dispatch": (compute.distributed.type
+                             if compute.distributed else "local"),
+                "pointers": {
+                    "import_path": meta.get("import_path", ""),
+                    "name": meta.get("name", ""),
+                },
+            },
+        },
+    }
+
+
 def build_manifests(
     service_name: str, compute: Compute,
     env: Optional[Dict[str, str]] = None,

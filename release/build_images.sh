@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Build + optionally push the kubetorch_tpu server image.
+# (reference: release/build_images.sh — here one image covers server,
+# controller, and store: the entrypoint picks the role.)
+set -euo pipefail
+
+REGISTRY="${REGISTRY:-ghcr.io/kubetorch-tpu}"
+VERSION="$(python -c 'from kubetorch_tpu.version import __version__; print(__version__)')"
+PUSH="${PUSH:-0}"
+
+cd "$(dirname "$0")/.."
+docker build -f release/Dockerfile -t "${REGISTRY}/kubetorch-tpu:${VERSION}" \
+  -t "${REGISTRY}/kubetorch-tpu:latest" .
+echo "built ${REGISTRY}/kubetorch-tpu:${VERSION}"
+if [[ "${PUSH}" == "1" ]]; then
+  docker push "${REGISTRY}/kubetorch-tpu:${VERSION}"
+  docker push "${REGISTRY}/kubetorch-tpu:latest"
+fi

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Package + optionally publish the Helm chart (reference: publish_chart.sh).
+set -euo pipefail
+
+REPO_URL="${CHART_REPO:-}"   # e.g. oci://ghcr.io/kubetorch-tpu/charts
+cd "$(dirname "$0")/.."
+python release/sync_version.py   # chart version follows the package
+helm package charts/kubetorch-tpu -d dist/
+if [[ -n "${REPO_URL}" ]]; then
+  helm push dist/kubetorch-tpu-*.tgz "${REPO_URL}"
+fi

@@ -1,0 +1,34 @@
+"""Keep chart + pyproject versions in lockstep with kubetorch_tpu.version
+(reference: release/sync_version.py)."""
+
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+from kubetorch_tpu.version import __version__  # noqa: E402
+
+
+def sync_chart():
+    chart = ROOT / "charts" / "kubetorch-tpu" / "Chart.yaml"
+    text = chart.read_text()
+    text = re.sub(r"(?m)^version: .*$", f"version: {__version__}", text)
+    text = re.sub(r"(?m)^appVersion: .*$",
+                  f'appVersion: "{__version__}"', text)
+    chart.write_text(text)
+
+
+def sync_pyproject():
+    py = ROOT / "pyproject.toml"
+    text = py.read_text()
+    text = re.sub(r'(?m)^version = ".*"$', f'version = "{__version__}"',
+                  text)
+    py.write_text(text)
+
+
+if __name__ == "__main__":
+    sync_chart()
+    sync_pyproject()
+    print(f"synced chart + pyproject to {__version__}")

@@ -305,3 +305,13 @@ class TestAuth:
                                 headers=hdr).status_code == 200
         finally:
             proc.terminate()
+
+
+def test_k8s_proxy_routes_501_without_creds(controller):
+    """Proxied K8s CRUD exists (reference: routes/{pods,...}.py); without
+    cluster credentials it answers 501, not 404."""
+    assert httpx.get(f"{controller}/k8s/pods").status_code == 501
+    assert httpx.get(f"{controller}/k8s/nodes/n1").status_code == 501
+    assert httpx.delete(f"{controller}/k8s/pods/p1").status_code == 501
+    # unknown route still 404s
+    assert httpx.patch(f"{controller}/k8s/pods").status_code in (404, 405)

@@ -310,3 +310,19 @@ def test_compute_image_op_passthroughs():
     base = kt.Compute(cpus="1")
     base.pip_install("x")
     assert base.image.steps == []
+
+
+def test_workload_record():
+    from kubetorch_tpu.provisioning.manifests import build_workload_record
+
+    compute = kt.Compute(cpus="1", namespace="default").distribute(
+        "jax", workers=2)
+    rec = build_workload_record("svc", compute, {
+        "callable_type": "fn", "import_path": "m", "name": "f"})
+    assert rec["apiVersion"] == "kubetorch.com/v1alpha1"
+    assert rec["kind"] == "KubetorchWorkload"
+    assert rec["spec"]["module"] == {
+        "type": "fn", "dispatch": "jax",
+        "pointers": {"import_path": "m", "name": "f"}}
+    assert rec["spec"]["selector"] == {"kubetorch.com/service": "svc"}
+    assert rec["spec"]["serviceConfig"]["deploymentMode"] == "deployment"
