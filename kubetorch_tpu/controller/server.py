@@ -218,7 +218,8 @@ class ControllerServer:
         token = header[len("Bearer "):]
         import hmac
 
-        if self.auth_token and hmac.compare_digest(token, self.auth_token):
+        if self.auth_token and hmac.compare_digest(
+                token.encode(), self.auth_token.encode()):
             request["auth"] = {"username": "static", "namespaces": None}
             return await handler(request)
         if self.auth_validate_url:
@@ -479,9 +480,7 @@ class ControllerServer:
         controller has no cluster credentials (local/dev mode); real K8s
         errors surface as 502 so clients can tell them apart."""
         try:
-            from kubetorch_tpu.provisioning.k8s_client import K8sClient
-
-            client = K8sClient.from_env()
+            client = self._k8s_client()
         except Exception as exc:
             return web.json_response(
                 {"error": f"no cluster credentials: {exc}"}, status=501)
@@ -493,12 +492,21 @@ class ControllerServer:
             return web.json_response(
                 {"error": f"{type(exc).__name__}: {exc}"}, status=502)
 
-    @staticmethod
-    def _k8s_kind(request) -> str:
-        """Accept Kind, lowercase kind, or plural resource names."""
-        from kubetorch_tpu.provisioning.k8s_client import kind_for
+    def _k8s_client(self):
+        """One cached dynamic client per controller (kubeconfig parsing and
+        its CA temp file happen once, not per proxy request)."""
+        if getattr(self, "_k8s", None) is None:
+            from kubetorch_tpu.provisioning.k8s_client import K8sClient
 
-        return kind_for(request.match_info["kind"])
+            self._k8s = K8sClient.from_env()
+        return self._k8s
+
+    @staticmethod
+    def _k8s_kind(request) -> dict:
+        """Kind reference (with API group) from Kind/lowercase/plural."""
+        from kubetorch_tpu.provisioning.k8s_client import kind_ref
+
+        return kind_ref(request.match_info["kind"])
 
     def _k8s_ns(self, request):
         """Effective namespace for proxy ops (query param or the

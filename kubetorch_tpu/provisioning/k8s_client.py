@@ -36,7 +36,16 @@ _PLURALS = {
     "PersistentVolumeClaim": "persistentvolumeclaims",
     "JobSet": "jobsets", "Job": "jobs", "Namespace": "namespaces",
     "RayCluster": "rayclusters", "Node": "nodes", "Event": "events",
-    "Ingress": "ingresses",
+    "Ingress": "ingresses", "KubetorchWorkload": "kubetorchworkloads",
+}
+
+# non-core-v1 groups for kinds addressed by bare name (manifest dicts carry
+# their own apiVersion; this map serves the /k8s proxy + string-kind calls)
+API_VERSIONS = {
+    "Deployment": "apps/v1", "Job": "batch/v1",
+    "JobSet": "jobset.x-k8s.io/v1alpha2", "RayCluster": "ray.io/v1",
+    "Ingress": "networking.k8s.io/v1",
+    "KubetorchWorkload": "kubetorch.com/v1alpha1",
 }
 
 
@@ -53,7 +62,19 @@ def kind_for(name: str) -> str:
     for kind, plural in _PLURALS.items():
         if lowered in (plural, kind.lower()):
             return kind
+    # unknown: assume a plural was given; singularize so plural_for
+    # round-trips ("foos" -> "Foo" -> "foos", not "fooss")
+    if name == lowered and name.endswith("s"):
+        name = name[:-1]
     return name[:1].upper() + name[1:]
+
+
+def kind_ref(name: str) -> dict:
+    """A minimal manifest-shaped reference {apiVersion, kind} for a kind
+    addressed by name — routes non-core kinds to their API group."""
+    kind = kind_for(name)
+    return {"apiVersion": API_VERSIONS.get(kind, "v1"), "kind": kind,
+            "metadata": {}}
 
 
 class K8sClient:

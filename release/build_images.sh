@@ -5,10 +5,10 @@
 set -euo pipefail
 
 REGISTRY="${REGISTRY:-ghcr.io/kubetorch-tpu}"
-VERSION="$(python -c 'from kubetorch_tpu.version import __version__; print(__version__)')"
 PUSH="${PUSH:-0}"
 
 cd "$(dirname "$0")/.."
+VERSION="$(python -c 'from kubetorch_tpu.version import __version__; print(__version__)')"
 docker build -f release/Dockerfile -t "${REGISTRY}/kubetorch-tpu:${VERSION}" \
   -t "${REGISTRY}/kubetorch-tpu:latest" .
 echo "built ${REGISTRY}/kubetorch-tpu:${VERSION}"

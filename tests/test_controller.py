@@ -307,11 +307,44 @@ class TestAuth:
             proc.terminate()
 
 
-def test_k8s_proxy_routes_501_without_creds(controller):
+def test_k8s_proxy_routes_501_without_creds(tmp_path):
     """Proxied K8s CRUD exists (reference: routes/{pods,...}.py); without
-    cluster credentials it answers 501, not 404."""
-    assert httpx.get(f"{controller}/k8s/pods").status_code == 501
-    assert httpx.get(f"{controller}/k8s/nodes/n1").status_code == 501
-    assert httpx.delete(f"{controller}/k8s/pods/p1").status_code == 501
-    # unknown route still 404s
-    assert httpx.patch(f"{controller}/k8s/pods").status_code in (404, 405)
+    cluster credentials it answers 501, not 404. The controller gets an
+    empty HOME so a developer's ~/.kube/config can never leak in (which
+    would otherwise make this test hit a live cluster)."""
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.controller.server",
+         "--host", "127.0.0.1", "--port", str(port), "--db", ":memory:"],
+        env={**os.environ, "HOME": str(tmp_path),
+             "KUBECONFIG": str(tmp_path / "nonexistent")},
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        for _ in range(50):
+            try:
+                httpx.get(f"{base}/health", timeout=1.0)
+                break
+            except Exception:
+                time.sleep(0.2)
+        assert httpx.get(f"{base}/k8s/pods").status_code == 501
+        assert httpx.get(f"{base}/k8s/nodes/n1").status_code == 501
+        assert httpx.delete(f"{base}/k8s/pods/p1").status_code == 501
+        # unknown route still 404s
+        assert httpx.patch(f"{base}/k8s/pods").status_code in (404, 405)
+    finally:
+        proc.terminate()
+
+
+def test_kind_resolution_for_proxy():
+    from kubetorch_tpu.provisioning.k8s_client import kind_for, kind_ref
+
+    assert kind_for("pods") == "Pod"
+    assert kind_for("Deployment") == "Deployment"
+    assert kind_for("ingresses") == "Ingress"
+    assert kind_for("kubetorchworkloads") == "KubetorchWorkload"
+    assert kind_for("widgets") == "Widget"          # unknown plural
+    assert kind_ref("deployments")["apiVersion"] == "apps/v1"
+    assert kind_ref("pods")["apiVersion"] == "v1"
+    assert kind_ref("kubetorchworkloads")["apiVersion"] == (
+        "kubetorch.com/v1alpha1")

@@ -41,6 +41,19 @@ def test_fault_tolerance_smoke(tmp_path):
 
 
 @pytest.mark.level("release")
+def test_vit_dp_kueue_smoke(tmp_path):
+    result = _run_smoke("vit_dp_kueue.py", tmp_path)
+    assert result["devices"] == 8
+    assert result["images_per_sec"] > 0
+
+
+@pytest.mark.level("release")
+def test_tpu_matmul_smoke(tmp_path):
+    result = _run_smoke("tpu_matmul.py", tmp_path)
+    assert result["tflops"] > 0
+
+
+@pytest.mark.level("release")
 def test_llama_fsdp_smoke(tmp_path):
     result = _run_smoke("llama_fsdp_pretrain.py", tmp_path)
     assert result["devices"] == 8
