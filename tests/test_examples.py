@@ -41,6 +41,15 @@ def test_fault_tolerance_smoke(tmp_path):
 
 
 @pytest.mark.level("release")
+def test_llama_serve_smoke(tmp_path):
+    result = _run_smoke("llama_serve.py", tmp_path)
+    assert len(result["rollouts"]) == 2
+    assert all(len(r) == 6 for r in result["rollouts"])
+    assert result["scores"][0] < 0          # a log-likelihood
+    assert result["model_params"] > 0
+
+
+@pytest.mark.level("release")
 def test_vit_dp_kueue_smoke(tmp_path):
     result = _run_smoke("vit_dp_kueue.py", tmp_path)
     assert result["devices"] == 8
