@@ -21,6 +21,7 @@ class LlamaServer:
     """Stateful model replica: params live across requests."""
 
     def __init__(self, model: str = "tiny", max_len: int = 512):
+        import dataclasses
         import os
 
         if os.environ.get("KT_SMOKE"):
@@ -31,6 +32,10 @@ class LlamaServer:
 
         cfg = (LlamaConfig.llama3_1b(remat=False) if model == "1b"
                else LlamaConfig.tiny())
+        # max_len bounds prompt+generation (Generator enforces it via
+        # cfg.max_seq_len) and caps the KV cache per request
+        cfg = dataclasses.replace(
+            cfg, max_seq_len=min(max_len, cfg.max_seq_len))
         self.cfg = cfg
         params = jax.jit(lambda k: llama.init(k, cfg))(jax.random.key(0))
         self.generator = Generator(params, cfg)
@@ -66,8 +71,9 @@ class LlamaServer:
                 return (gold * m).sum(-1) / jnp.maximum(m.sum(-1), 1.0)
 
             self._score_fn = _score
-        lens = [len(t) for t in tokens]
-        width = max(lens)
+        # bucket the pad width so the jit cache actually caches (a new
+        # exact max-length per request would recompile every call)
+        width = -(-max(len(t) for t in tokens) // 64) * 64
         toks = np.zeros((len(tokens), width), np.int32)
         mask = np.zeros((len(tokens), width), np.float32)
         for i, t in enumerate(tokens):

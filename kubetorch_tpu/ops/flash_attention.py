@@ -371,12 +371,24 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def auto_block_k(T: int, requested: Optional[int] = None) -> int:
+    """KV block size: 1024 when it divides T (measured ~+1.2% train
+    throughput over 512 at S=2048 on v5e), else 512 — never silently
+    shrink coverage for shapes only 512 divides."""
+    if requested is not None:
+        return min(requested, T)
+    for cand in (1024, 512):
+        if T % min(cand, T) == 0:
+            return min(cand, T)
+    return min(512, T)
+
+
 def flash_tileable(q_shape, k_shape, block_q: int = 512,
-                   block_k: int = 512) -> bool:
+                   block_k: Optional[int] = None) -> bool:
     """True when [B,S,H,D] / [B,T,Hkv,D] shapes fit the kernel tiling."""
     B, S, Hq, D = q_shape
     T, Hkv = k_shape[1], k_shape[2]
-    bq, bk = min(block_q, S), min(block_k, T)
+    bq, bk = min(block_q, S), auto_block_k(T, block_k)
     return (S % bq == 0 and T % bk == 0 and D % 128 == 0
             and Hq % Hkv == 0 and bq % 8 == 0 and bk % 8 == 0)
 
@@ -389,7 +401,7 @@ def flash_attention_with_lse(
     causal: bool = True,
     scale: Optional[float] = None,
     block_q: int = 512,
-    block_k: int = 512,
+    block_k: Optional[int] = None,   # None = auto (1024 when it divides T)
     interpret: Optional[bool] = None,
 ):
     """Forward-only flash returning (out [B,S,H,D], lse [B,H,S] f32).
@@ -404,7 +416,7 @@ def flash_attention_with_lse(
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     block_q = min(block_q, S)
-    block_k = min(block_k, k.shape[1])
+    block_k = auto_block_k(k.shape[1], block_k)
     out, lse = _flash_forward(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
         v.transpose(0, 2, 1, 3), scale=scale, causal=causal,
@@ -420,7 +432,7 @@ def flash_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     block_q: int = 512,
-    block_k: int = 512,
+    block_k: Optional[int] = None,   # None = auto (1024 when it divides T)
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention in the model's [B, S, H, D] layout.
@@ -436,7 +448,7 @@ def flash_attention(
     if not flash_tileable(q.shape, k.shape, block_q, block_k):
         return dot_product_attention(q, k, v, causal=causal, scale=scale)
     block_q = min(block_q, S)
-    block_k = min(block_k, T)
+    block_k = auto_block_k(T, block_k)
     out = _flash(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
         v.transpose(0, 2, 1, 3), scale, causal, block_q, block_k, interpret)

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from kubetorch_tpu.ops.flash_attention import (
     _STATS,
     _flash_backward,
+    auto_block_k,
     flash_attention_with_lse,
     flash_bwd_delta,
     flash_tileable,
@@ -204,8 +205,8 @@ def _ring_bwd_flash(q, k, v, out, lse, g, *, axis_name, scale, interpret,
                     qT, k_c, v_c, outT, lseT, gT, scale=scale,
                     causal=causal_chunk,
                     block_q=min(512, qT.shape[2]),
-                    block_k=min(512, k_c.shape[2]), interpret=interpret,
-                    delta=deltaT)
+                    block_k=auto_block_k(k_c.shape[2]),
+                    interpret=interpret, delta=deltaT)
             return f
 
         if not causal:
