@@ -48,6 +48,9 @@ _LAZY = {
     "get": ("kubetorch_tpu.data_store.commands", "get"),
     "ls": ("kubetorch_tpu.data_store.commands", "ls"),
     "rm": ("kubetorch_tpu.data_store.commands", "rm"),
+    "BroadcastWindow": ("kubetorch_tpu.data_store.types", "BroadcastWindow"),
+    "Locale": ("kubetorch_tpu.data_store.types", "Locale"),
+    "Lifespan": ("kubetorch_tpu.data_store.types", "Lifespan"),
     # debugging
     "deep_breakpoint": ("kubetorch_tpu.serving.debugger", "deep_breakpoint"),
     # runs

@@ -1,0 +1,213 @@
+"""Client half of broadcast groups: join → fetch from assigned parent →
+serve → complete.
+
+Reference: the getter side of ``data_store/pod_data_server.py`` fs-broadcast
+(``_handle_fs_broadcast_get_path:2182`` — children block on parent
+completion, then pull from the parent, then serve their own copy to later
+joiners). Our peers speak the exact store HTTP protocol — a completed member
+runs a read-only :class:`~kubetorch_tpu.data_store.store_server.StoreServer`
+rooted at its local cache, so the fetch path is identical whether the parent
+is the central store or a peer pod.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import socket
+import threading
+import time
+import uuid
+from pathlib import Path
+from typing import Optional, Tuple
+
+from kubetorch_tpu.exceptions import DataStoreError
+from kubetorch_tpu.data_store.types import BroadcastWindow
+
+_CACHE_ROOT = Path(os.environ.get(
+    "KT_PEER_CACHE", "~/.ktpu/peer_cache")).expanduser()
+
+
+def _advertise_ip() -> str:
+    """IP peers can reach us on: pod IP in-cluster, else a local route."""
+    ip = os.environ.get("KT_POD_IP")
+    if ip:
+        return ip
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+class PeerServer:
+    """Per-process read-only store server over the peer cache dir.
+
+    Mirrors the reference's per-node ``PodDataServer`` singleton
+    (``pod_data_server.py:581`` file-lock daemon); process-local is enough
+    here because the serve payload lives in a shared cache dir keyed the
+    same way for every process on the node.
+    """
+
+    _instance: Optional["PeerServer"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, root: Path):
+        from aiohttp import web
+
+        from kubetorch_tpu.data_store.store_server import StoreServer
+
+        self.root = root
+        self._server = StoreServer(root)
+        self._loop = None
+        self.port = None
+        self._web = web
+        self._started = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="kt-peer-server", daemon=True)
+
+    def _run(self):
+        import asyncio
+
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def _start():
+            runner = self._web.AppRunner(self._server.build_readonly_app())
+            await runner.setup()
+            site = self._web.TCPSite(runner, "0.0.0.0", 0)
+            await site.start()
+            self.port = site._server.sockets[0].getsockname()[1]
+            self._started.set()
+
+        self._loop.run_until_complete(_start())
+        self._loop.run_forever()
+
+    @classmethod
+    def ensure(cls, root: Optional[Path] = None) -> Optional["PeerServer"]:
+        with cls._lock:
+            if cls._instance is None:
+                inst = cls(root or _CACHE_ROOT)
+                try:
+                    inst._thread.start()
+                    if not inst._started.wait(10):
+                        return None
+                except (OSError, RuntimeError):
+                    return None
+                cls._instance = inst
+            return cls._instance
+
+    @property
+    def url(self) -> str:
+        return f"http://{_advertise_ip()}:{self.port}"
+
+
+def _member_id() -> str:
+    return f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+
+
+def _fetch_into_cache(backend, key: str, cache_root: Path,
+                      excludes=None) -> Tuple[Path, bool]:
+    """Pull ``key`` from ``backend`` into the peer cache, preserving the
+    blob-vs-tree distinction so we can re-serve it unchanged. Returns
+    (local path, is_tree).
+
+    Publishes atomically: siblings assigned the same source write this same
+    cache path concurrently while we may already be serving it. Blobs go
+    through tmp-file + ``os.replace``; trees are staged into a private dir
+    and swapped in via symlink replace (the serving side realpath-pins a
+    version per request, so readers never see a half-synced tree)."""
+    from kubetorch_tpu.data_store.sync import DEFAULT_EXCLUDES
+
+    excludes = DEFAULT_EXCLUDES if excludes is None else excludes
+    local = cache_root / key
+    manifest_resp = backend.client.get(
+        backend._url(f"/tree/{key}/manifest"))
+    if manifest_resp.status_code == 404:
+        blob = backend.get_blob(key)
+        local.parent.mkdir(parents=True, exist_ok=True)
+        tmp = local.with_name(
+            f".{local.name}.{os.getpid()}-{uuid.uuid4().hex[:6]}.tmp")
+        tmp.write_bytes(blob)
+        os.replace(tmp, local)
+        return local, False
+    backend._raise_for(manifest_resp, "manifest")
+    stage = cache_root / ".trees" / uuid.uuid4().hex
+    stage.mkdir(parents=True, exist_ok=True)
+    backend.get_path(key, stage, excludes=excludes)
+    local.parent.mkdir(parents=True, exist_ok=True)
+    link_tmp = local.with_name(
+        f".{local.name}.{os.getpid()}-{uuid.uuid4().hex[:6]}.lnk")
+    os.symlink(stage, link_tmp)
+    if local.exists() and not local.is_symlink():
+        shutil.rmtree(local)  # pre-symlink-era cache entry
+    os.replace(link_tmp, local)
+    return local, True
+
+
+def broadcast_get(store_backend, key: str, window: BroadcastWindow,
+                  dest: Optional[Path] = None, excludes=None):
+    """Coordinated fetch. Returns blob bytes, or the dest/cache Path for
+    trees. Falls back to a direct store fetch if the parent peer dies."""
+    from kubetorch_tpu.data_store.http_store import HttpStoreBackend
+
+    group = window.resolved_group(key)
+    mid = _member_id()
+    deadline = time.time() + window.timeout
+    state = store_backend.bcast_join(
+        group, key=key, member_id=mid, world_size=window.world_size,
+        fanout=window.fanout, lease=window.lease)
+    while state["status"] == "joined":
+        if time.time() > deadline:
+            raise DataStoreError(
+                f"broadcast {group!r}: no source within "
+                f"{window.timeout:.0f}s (rank {state['rank']})")
+        time.sleep(0.1)
+        state = store_backend.bcast_member(group, mid)
+
+    parent_url = state["parent"]
+    parent = (store_backend if parent_url == ""
+              else HttpStoreBackend(parent_url))
+    import httpx
+
+    try:
+        local, is_tree = _fetch_into_cache(parent, key, _CACHE_ROOT,
+                                           excludes=excludes)
+    except (DataStoreError, OSError, httpx.HTTPError):
+        if parent is store_backend:
+            raise
+        # Parent peer died mid-serve: the store always has the bytes.
+        local, is_tree = _fetch_into_cache(store_backend, key, _CACHE_ROOT,
+                                           excludes=excludes)
+
+    serve_url = None
+    if window.serve:
+        peer = PeerServer.ensure()
+        if peer is not None:
+            serve_url = peer.url
+    try:
+        store_backend.bcast_complete(group, mid, serve_url=serve_url)
+    except (DataStoreError, httpx.HTTPError):
+        # Best-effort: the bytes are already here; a pruned group or store
+        # restart must not fail a finished fetch.
+        pass
+
+    if is_tree:
+        if dest is not None:
+            from kubetorch_tpu.data_store.sync import (
+                DEFAULT_EXCLUDES,
+                sync_tree,
+            )
+
+            sync_tree(local, Path(dest),
+                      DEFAULT_EXCLUDES if excludes is None else excludes)
+            return Path(dest)
+        return local
+    data = local.read_bytes()
+    if dest is not None:
+        dest = Path(dest)
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        dest.write_bytes(data)
+        return dest
+    return data

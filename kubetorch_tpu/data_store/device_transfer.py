@@ -106,15 +106,20 @@ def get_arrays(
     key: str,
     template: Optional[Any] = None,
     shardings: Optional[Any] = None,
+    broadcast=None,
 ) -> Any:
     """Fetch arrays; ``shardings`` (pytree of Sharding or a single one)
     device_puts each leaf — onto a *different* mesh/layout than the publisher
-    used if desired."""
+    used if desired. ``broadcast`` (a :class:`BroadcastWindow`) coordinates
+    many simultaneous getters through the store's rolling fan-out tree — the
+    RL weight-sync path at scale (reference: GPU broadcast groups,
+    SURVEY.md §3.5)."""
     import jax
 
     from kubetorch_tpu.data_store.client import DataStoreClient
 
-    blob = DataStoreClient.default()._backend().get_blob(key)
+    blob = DataStoreClient.default()._backend().get_blob(
+        key, broadcast=broadcast)
     tree = unpack_arrays(blob, template)
     if shardings is None:
         return tree

@@ -67,8 +67,13 @@ class HttpStoreBackend:
         return key
 
     def get_path(self, key: str, dest: Path, excludes=DEFAULT_EXCLUDES,
-                 **kw) -> Path:
+                 broadcast=None, **kw) -> Path:
         dest = Path(dest)
+        if broadcast is not None:
+            from kubetorch_tpu.data_store.broadcast import broadcast_get
+
+            return broadcast_get(self, key, broadcast, dest=dest,
+                                 excludes=excludes)
         resp = self.client.get(self._url(f"/tree/{key}/manifest"))
         if resp.status_code == 404:
             # single file stored as blob
@@ -103,7 +108,11 @@ class HttpStoreBackend:
         self._raise_for(resp, "put")
         return key
 
-    def get_blob(self, key: str, **kw) -> bytes:
+    def get_blob(self, key: str, broadcast=None, **kw) -> bytes:
+        if broadcast is not None:
+            from kubetorch_tpu.data_store.broadcast import broadcast_get
+
+            return broadcast_get(self, key, broadcast)
         resp = self.client.get(self._url(f"/blob/{key}"))
         if resp.status_code == 404:
             raise DataStoreError(f"no such key {key!r}")
@@ -122,6 +131,32 @@ class HttpStoreBackend:
             params={"recursive": "true" if recursive else "false"})
         self._raise_for(resp, "rm")
         return resp.json()["deleted"]
+
+    # ------------------------------------------------- broadcast groups
+    def bcast_join(self, group: str, **info) -> dict:
+        resp = self.client.post(self._url(f"/broadcast/{group}/join"),
+                                json=info)
+        self._raise_for(resp, "broadcast join")
+        return resp.json()
+
+    def bcast_member(self, group: str, member_id: str) -> dict:
+        resp = self.client.get(self._url(f"/broadcast/{group}/member"),
+                               params={"member_id": member_id})
+        self._raise_for(resp, "broadcast poll")
+        return resp.json()
+
+    def bcast_complete(self, group: str, member_id: str,
+                       serve_url=None) -> dict:
+        resp = self.client.post(
+            self._url(f"/broadcast/{group}/complete"),
+            json={"member_id": member_id, "serve_url": serve_url})
+        self._raise_for(resp, "broadcast complete")
+        return resp.json()
+
+    def bcast_status(self, group: str) -> dict:
+        resp = self.client.get(self._url(f"/broadcast/{group}/status"))
+        self._raise_for(resp, "broadcast status")
+        return resp.json()
 
     # ------------------------------------------------------- P2P hooks
     def register_source(self, key: str, url: str):

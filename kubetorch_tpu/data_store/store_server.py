@@ -22,6 +22,15 @@ P2P source registration (the reference's zero-copy ``locale="local"`` mode)
 is modeled with ``POST /sources/{key}`` + ``GET /sources/{key}`` — peers
 register as alternate sources and getters prefer a peer before falling back
 to the store (reference: metadata_client.py get_source_ip load balancing).
+
+Broadcast groups (the reference's MDS quorum/manifest protocol,
+``services/data_store/server.py`` ``/ws/broadcast/{group}`` +
+``/ws/fs-broadcast/{group}``) are a rolling-join tree over plain HTTP
+polling: ``POST /broadcast/{group}/join`` assigns ranks, ``GET
+/broadcast/{group}/member`` polls for a parent assignment (the store itself
+or a completed peer, at most ``fanout`` concurrent children each), ``POST
+/broadcast/{group}/complete`` promotes the member to a source for later
+joiners. See ``data_store/broadcast.py`` for the client half.
 """
 
 from __future__ import annotations
@@ -57,6 +66,8 @@ class StoreServer:
         # key -> [{url, registered_at}] alternate P2P sources
         self.sources: Dict[str, List[dict]] = {}
         self._rr: Dict[str, int] = {}
+        # group -> rolling-join broadcast state (see h_bcast_join)
+        self.broadcasts: Dict[str, dict] = {}
         self.stats = {"puts": 0, "gets": 0, "bytes_in": 0, "bytes_out": 0,
                       "started_at": time.time()}
 
@@ -80,6 +91,23 @@ class StoreServer:
         r.add_post("/sources/{key:.+}", self.h_register_source)
         r.add_get("/sources/{key:.+}", self.h_get_source)
         r.add_delete("/sources/{key:.+}", self.h_delete_source)
+        r.add_post("/broadcast/{group}/join", self.h_bcast_join)
+        r.add_get("/broadcast/{group}/member", self.h_bcast_member)
+        r.add_post("/broadcast/{group}/complete", self.h_bcast_complete)
+        r.add_get("/broadcast/{group}/status", self.h_bcast_status)
+        return app
+
+    def build_readonly_app(self) -> web.Application:
+        """Serving-only surface for broadcast peers: no writes, no deletes,
+        no coordination — a worker pod advertising its cache must not let
+        neighbours mutate it."""
+        app = web.Application(client_max_size=64 * 1024**2)
+        r = app.router
+        r.add_get("/health", self.h_health)
+        r.add_get("/blob/{key:.+}", self.h_get_blob)
+        r.add_get("/keys", self.h_keys)
+        r.add_get("/tree/{key:.+}/manifest", self.h_tree_manifest)
+        r.add_post("/tree/{key:.+}/archive", self.h_tree_archive)
         return app
 
     # --------------------------------------------------------- handlers
@@ -178,7 +206,9 @@ class StoreServer:
 
     async def h_tree_manifest(self, request):
         key = _norm_key(request.match_info["key"])
-        path = self._path(key)
+        # realpath: broadcast peer caches swap tree versions by symlink;
+        # pinning here keeps one request on one version.
+        path = Path(os.path.realpath(self._path(key)))
         if not path.is_dir():
             raise web.HTTPNotFound(text=f"no such tree {key!r}")
         manifest = scan_tree(path, with_hash=True)
@@ -187,7 +217,7 @@ class StoreServer:
     async def h_tree_archive(self, request):
         key = _norm_key(request.match_info["key"])
         paths = (await request.json()).get("paths", [])
-        base = self._path(key)
+        base = Path(os.path.realpath(self._path(key)))
         buf = io.BytesIO()
         with tarfile.open(fileobj=buf, mode="w:gz") as tar:
             for rel in paths:
@@ -226,6 +256,168 @@ class StoreServer:
         if self._path(key).exists():
             return web.json_response({"source": "", "peer": False})
         raise web.HTTPNotFound(text=f"no source for {key!r}")
+
+    # ------------------------------------------------- broadcast groups
+    def _key_fingerprint(self, key: str):
+        """Cheap content version for a key: a re-put invalidates any group
+        built on the previous bytes (the RL weight-sync loop re-broadcasts
+        the same key every iteration)."""
+        path = self._path(key)
+        if path.is_file():
+            st = path.stat()
+            return [st.st_size, st.st_mtime_ns]
+        if path.is_dir():
+            total, latest, count = 0, 0, 0
+            for p in path.rglob("*"):
+                if p.is_file():
+                    st = p.stat()
+                    total += st.st_size
+                    latest = max(latest, st.st_mtime_ns)
+                    count += 1
+            return [count, total, latest]
+        return None
+
+    def _bcast_group(self, group: str, info: Optional[dict] = None) -> dict:
+        # Prune abandoned groups (all-complete groups stay for late status
+        # reads until the age cutoff).
+        cutoff = time.time() - 3600
+        for name in [n for n, g in self.broadcasts.items()
+                     if g["created_at"] < cutoff]:
+            del self.broadcasts[name]
+        g = self.broadcasts.get(group)
+        if g is not None and info is not None:
+            # New joiner against changed bytes → fresh group; stale members
+            # must not be handed out as sources for the new content.
+            if g["fingerprint"] != self._key_fingerprint(g["key"]):
+                del self.broadcasts[group]
+                g = None
+        if g is None:
+            if info is None:
+                raise web.HTTPNotFound(text=f"no broadcast group {group!r}")
+            g = self.broadcasts[group] = {
+                "key": info["key"],
+                "world_size": int(info.get("world_size") or 0),
+                "fanout": max(1, int(info.get("fanout") or 3)),
+                # Fetch lease: a slot held by a member that neither
+                # completes nor reports within this window is reclaimed so
+                # crashed children can't wedge the group.
+                "lease": max(10.0, float(info.get("lease") or 120.0)),
+                "created_at": time.time(),
+                "fingerprint": self._key_fingerprint(info["key"]),
+                # member_id -> {rank, status: joined|fetching|complete,
+                #               parent: None|""(store)|serve_url, serve_url}
+                "members": {},
+                # source id ("" = store, else member_id) -> active children
+                "active": {},
+            }
+        return g
+
+    def _bcast_assign(self, g: dict):
+        """Rolling-join tree: hand every waiting member a source that has
+        the bytes and spare fanout. The store is source "" and participates
+        with the same fanout bound, so it ships the key O(fanout) times
+        regardless of world size."""
+        fanout = g["fanout"]
+        # Reclaim slots from members that took a source and went silent
+        # past the lease — a crashed child must not hold fanout capacity
+        # for the group's lifetime.
+        now = time.time()
+        for m in g["members"].values():
+            if (m["status"] == "fetching" and m.get("counted")
+                    and now - m.get("assigned_at", now) > g["lease"]):
+                m["counted"] = False
+                pid = m.get("parent_id")
+                if pid is not None:
+                    g["active"][pid] = max(0, g["active"].get(pid, 1) - 1)
+        peers: List[tuple] = [  # (member_id, url)
+            (mid, m["serve_url"]) for mid, m in g["members"].items()
+            if m["status"] == "complete" and m["serve_url"]]
+        for m in sorted(g["members"].values(), key=lambda m: m["rank"]):
+            if m["status"] != "joined":
+                continue
+            # Peers first, store ("") as last resort: once the tree has any
+            # completed peer, new joiners ride ICI-local copies and the
+            # store's egress stays O(fanout) for the whole group.
+            open_sources = [(sid, url) for sid, url in peers
+                            if g["active"].get(sid, 0) < fanout]
+            if not open_sources and g["active"].get("", 0) < fanout:
+                open_sources = [("", "")]
+            if not open_sources:
+                return  # all sources saturated; member keeps polling
+            sid, url = min(open_sources,
+                           key=lambda s: g["active"].get(s[0], 0))
+            g["active"][sid] = g["active"].get(sid, 0) + 1
+            m["status"] = "fetching"
+            m["parent"] = url
+            m["parent_id"] = sid
+            m["assigned_at"] = now
+            m["counted"] = True
+
+    async def h_bcast_join(self, request):
+        group = request.match_info["group"]
+        info = await request.json()
+        g = self._bcast_group(group, info)
+        mid = info["member_id"]
+        member = g["members"].get(mid)
+        if member is None:
+            member = g["members"][mid] = {
+                "rank": len(g["members"]), "status": "joined",
+                "parent": None, "parent_id": None,
+                "serve_url": info.get("serve_url"),
+            }
+        self._bcast_assign(g)
+        return web.json_response({
+            "rank": member["rank"], "status": member["status"],
+            "parent": member["parent"], "key": g["key"]})
+
+    async def h_bcast_member(self, request):
+        g = self._bcast_group(request.match_info["group"])
+        mid = request.query.get("member_id", "")
+        member = g["members"].get(mid)
+        if member is None:
+            raise web.HTTPNotFound(text=f"not a member: {mid!r}")
+        self._bcast_assign(g)
+        return web.json_response({
+            "rank": member["rank"], "status": member["status"],
+            "parent": member["parent"], "key": g["key"]})
+
+    async def h_bcast_complete(self, request):
+        g = self._bcast_group(request.match_info["group"])
+        info = await request.json()
+        mid = info["member_id"]
+        member = g["members"].get(mid)
+        if member is None:
+            raise web.HTTPNotFound(text=f"not a member: {mid!r}")
+        if member["status"] != "complete":
+            pid = member.get("parent_id")
+            if pid is not None and member.get("counted"):
+                g["active"][pid] = max(0, g["active"].get(pid, 1) - 1)
+            member["counted"] = False
+            member["status"] = "complete"
+            if info.get("serve_url"):
+                member["serve_url"] = info["serve_url"]
+                entry = {"url": info["serve_url"],
+                         "registered_at": time.time()}
+                sources = self.sources.setdefault(g["key"], [])
+                sources[:] = [s for s in sources
+                              if s["url"] != entry["url"]]
+                sources.append(entry)
+        self._bcast_assign(g)
+        return web.json_response({"status": "complete"})
+
+    async def h_bcast_status(self, request):
+        g = self._bcast_group(request.match_info["group"])
+        counts: Dict[str, int] = {}
+        for m in g["members"].values():
+            counts[m["status"]] = counts.get(m["status"], 0) + 1
+        store_children = sum(
+            1 for m in g["members"].values() if m.get("parent_id") == "")
+        return web.json_response({
+            "key": g["key"], "world_size": g["world_size"],
+            "fanout": g["fanout"], "members": len(g["members"]),
+            "counts": counts, "store_children": store_children,
+            "complete": (g["world_size"] > 0
+                         and counts.get("complete", 0) >= g["world_size"])})
 
     async def h_delete_source(self, request):
         key = _norm_key(request.match_info["key"])

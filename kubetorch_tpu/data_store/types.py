@@ -1,0 +1,63 @@
+"""Data-store wire/control types.
+
+Reference: ``data_store/types.py`` (``Locale``, ``Lifespan``,
+``BroadcastWindow(timeout, world_size, ips, group_id, fanout, pack)``).
+
+On TPU there is no CUDA-IPC/NCCL side channel for cross-workload tensor
+movement (SURVEY.md §7 hard-part 3), so a broadcast window coordinates the
+**host-staged** fan-out instead: N getters of the same key join a group on
+the store server, which assigns each one a parent — the store itself for the
+first ``fanout`` joiners, then already-completed peers for the rest — so the
+store ships the bytes O(fanout) times and the peers multiply them out in a
+rolling tree (the reference's fs-broadcast rolling-join design,
+``services/data_store/server.py`` ``/ws/fs-broadcast/{group}``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+class Locale:
+    """Where ``put`` stages data: the central store, or served P2P from the
+    publishing node (reference: ``data_store/types.py`` Locale)."""
+
+    STORE = "store"
+    LOCAL = "local"
+
+
+class Lifespan:
+    """Key lifetime: pinned to the cluster, or garbage-collected with the
+    owning workload (reference: ``data_store/types.py`` Lifespan)."""
+
+    CLUSTER = "cluster"
+    RESOURCE = "resource"
+
+
+@dataclasses.dataclass
+class BroadcastWindow:
+    """Coordinated many-getter fetch of one key.
+
+    Attributes mirror the reference's ``BroadcastWindow``: ``world_size``
+    getters expected within ``timeout`` seconds; ``group_id`` defaults to a
+    key-derived id so all getters of the same key land in the same group
+    without out-of-band agreement; ``fanout`` bounds concurrent children
+    per source. (The reference's ``pack`` flag has no analogue here: the
+    host-staged array path always packs — ``device_transfer.pack_arrays``.)
+    """
+
+    world_size: int
+    timeout: float = 300.0
+    group_id: Optional[str] = None
+    fanout: int = 3
+    # Serve our fetched copy to later joiners. Disabled automatically when
+    # no listening port can be bound.
+    serve: bool = True
+    # A source slot held this long with no completion is reclaimed by the
+    # coordinator (crashed-child protection). Raise for very large payloads
+    # on slow links.
+    lease: float = 120.0
+
+    def resolved_group(self, key: str) -> str:
+        return self.group_id or f"bcast-{key.replace('/', '-')}"

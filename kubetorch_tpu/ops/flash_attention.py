@@ -377,9 +377,12 @@ def auto_block_k(T: int, requested: Optional[int] = None) -> int:
     shrink coverage for shapes only 512 divides."""
     if requested is not None:
         return min(requested, T)
-    for cand in (1024, 512):
-        if T % min(cand, T) == 0:
-            return min(cand, T)
+    if T >= 1024 and T % 1024 == 0:
+        return 1024
+    if T >= 512 and T % 512 == 0:
+        return 512
+    # Small or non-dividing T: cap at 512; flash_tileable rejects shapes
+    # this doesn't divide (they take the XLA attention path).
     return min(512, T)
 
 
