@@ -1,5 +1,6 @@
 """User-facing distributed helpers (``kt.distributed``)."""
 
+from kubetorch_tpu.distributed.cluster_env import initialize
 from kubetorch_tpu.distributed.utils import pod_ips, slice_info
 
-__all__ = ["pod_ips", "slice_info"]
+__all__ = ["initialize", "pod_ips", "slice_info"]

@@ -1,0 +1,93 @@
+"""Make bare ``jax.distributed.initialize()`` work off the kubetorch env
+contract.
+
+The launcher injects ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+``JAX_PROCESS_ID`` (+ optional ``JAX_LOCAL_DEVICE_IDS``) per worker
+(``serving/frameworks.py`` JaxProcess — the TPU-first analogue of the
+reference's ``serving/spmd/jax_process.py:8``). Current JAX only reads the
+coordinator address and local-device ids from env; process count/id must
+come from a registered ``ClusterEnv``. This module registers one keyed on
+exactly those variables, so user code inside a ``.distribute("jax")``
+workload needs no arguments — the same UX torch users get from
+``MASTER_ADDR``/``RANK`` env in ``dist.init_process_group``.
+
+Importing the module performs the registration (JAX auto-detects
+``ClusterEnv`` subclasses on definition). ``initialize()`` is the
+explicit-args fallback that works even if the private registration API
+drifts.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["initialize", "register"]
+
+_REGISTERED = False
+
+
+def register() -> bool:
+    """Define + auto-register the ClusterEnv subclass. Returns success."""
+    global _REGISTERED
+    if _REGISTERED:
+        return True
+    try:
+        from jax._src import clusters
+    except ImportError:  # private API moved; explicit initialize() still works
+        return False
+
+    class KubetorchCluster(clusters.ClusterEnv):
+        """Bootstraps from the env the kubetorch launcher injects."""
+
+        name = "kubetorch"
+
+        @classmethod
+        def is_env_present(cls) -> bool:
+            return all(v in os.environ for v in (
+                "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID"))
+
+        @classmethod
+        def get_coordinator_address(cls, timeout_secs=None,
+                                    override_coordinator_port=None) -> str:
+            addr = os.environ["JAX_COORDINATOR_ADDRESS"]
+            if override_coordinator_port:
+                addr = f"{addr.rsplit(':', 1)[0]}:{override_coordinator_port}"
+            return addr
+
+        @classmethod
+        def get_process_count(cls) -> int:
+            return int(os.environ["JAX_NUM_PROCESSES"])
+
+        @classmethod
+        def get_process_id(cls) -> int:
+            return int(os.environ["JAX_PROCESS_ID"])
+
+    _REGISTERED = True
+    return True
+
+
+def initialize(**kwargs) -> None:
+    """Explicit ``jax.distributed.initialize`` from the kubetorch env
+    contract; idempotent. Use when you want initialization independent of
+    JAX's cluster auto-detection (any JAX version)."""
+    import jax
+
+    state = jax.distributed.global_state
+    if getattr(state, "client", None) is not None:  # already initialized
+        return
+    args = dict(
+        coordinator_address=os.environ.get("JAX_COORDINATOR_ADDRESS"),
+        num_processes=_int_env("JAX_NUM_PROCESSES"),
+        process_id=_int_env("JAX_PROCESS_ID"),
+    )
+    ids = os.environ.get("JAX_LOCAL_DEVICE_IDS")
+    if ids:
+        args["local_device_ids"] = [int(i) for i in ids.split(",")]
+    args.update(kwargs)
+    jax.distributed.initialize(**args)
+
+
+def _int_env(name: str):
+    value = os.environ.get(name)
+    return int(value) if value is not None else None

@@ -96,6 +96,15 @@ RESOURCE_CONFIGS: Dict[str, Dict[str, Any]] = {
         "replica_path": ("spec", "xgbReplicaSpecs", "Worker", "replicas"),
         "routing": "headless",
     },
+    "mxjob": {
+        "api_version": "kubeflow.org/v1",
+        "kind": "MXJob",
+        "plural": "mxjobs",
+        "pod_template_path": (
+            "spec", "mxReplicaSpecs", "Worker", "template"),
+        "replica_path": ("spec", "mxReplicaSpecs", "Worker", "replicas"),
+        "routing": "headless",
+    },
     "selector": {  # BYO pods: route only, create nothing
         "api_version": None,
         "kind": None,

@@ -69,7 +69,13 @@ class JaxProcess(FrameworkProcess):
     """
 
     name = "jax"
-    port = 8476  # jax.distributed default coordinator port
+
+    @property
+    def port(self) -> int:
+        # jax.distributed default coordinator port; override when several
+        # independent quorums share a network namespace (local backend,
+        # tests, sidecar jobs on one host).
+        return int(os.environ.get("KT_JAX_COORD_PORT", "8476"))
 
     def framework_env(self, *, rank, world_size, local_rank, node_rank,
                       pod_ips) -> Dict[str, str]:

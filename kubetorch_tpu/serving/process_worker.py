@@ -166,6 +166,15 @@ class _WorkerLoop:
             if req["kind"] == SETUP:
                 for key, value in (req.get("env") or {}).items():
                     os.environ[key] = str(value)
+                if ("JAX_NUM_PROCESSES" in os.environ
+                        and "JAX_COORDINATOR_ADDRESS" in os.environ):
+                    # jax-framework workload: register the ClusterEnv so a
+                    # bare jax.distributed.initialize() in user code picks
+                    # up the injected contract (current JAX doesn't read
+                    # process count/id from env by itself).
+                    from kubetorch_tpu.distributed import cluster_env
+
+                    cluster_env.register()
                 self.callable_type = req.get("callable_type", "fn")
                 self.target = _load_target(
                     req.get("root_path", ""), req["import_path"],

@@ -66,3 +66,29 @@ def jax_touch():
     import jax
 
     return float(jax.numpy.zeros(2).sum())
+
+
+def jax_allgather():
+    """Real multi-process jax.distributed collective: each worker
+    initializes from the env contract JaxProcess injects, then allgathers
+    its (process_index + 1). Proves the bootstrap works end-to-end, not
+    just that env vars are set."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize()
+    from jax.experimental import multihost_utils
+
+    import numpy as np
+
+    local = np.array([jax.process_index() + 1], dtype=np.int32)
+    gathered = multihost_utils.process_allgather(local)
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "device_count": jax.device_count(),
+        "gathered": [int(v) for v in np.asarray(gathered).ravel()],
+    }

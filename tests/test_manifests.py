@@ -235,7 +235,7 @@ def test_navigate_path_and_kind_table():
     # the full reference kind table (RESOURCE_CONFIGS, provisioning/
     # utils.py:301-384) must be representable
     for kind in ("deployment", "knative", "raycluster", "pytorchjob",
-                 "tfjob", "xgboostjob", "selector", "jobset"):
+                 "tfjob", "xgboostjob", "mxjob", "selector", "jobset"):
         assert kind in RESOURCE_CONFIGS
     # BYO kubeflow manifests: pod template path must resolve
     pt = {"spec": {"pytorchReplicaSpecs": {"Worker": {
