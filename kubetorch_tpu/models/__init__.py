@@ -22,8 +22,16 @@ def __getattr__(name):
         from kubetorch_tpu.models import generate
 
         return generate
+    if name == "quant":
+        from kubetorch_tpu.models import quant
+
+        return quant
+    if name == "quantize_params":
+        from kubetorch_tpu.models.quant import quantize_params
+
+        return quantize_params
     raise AttributeError(name)
 
 
 __all__ = ["LlamaConfig", "MoEConfig", "ViTConfig", "llama", "Generator",
-           "generate"]
+           "generate", "quant", "quantize_params"]

@@ -133,6 +133,21 @@ def _moe_block(x, layer, cfg: LlamaConfig, rules: ShardingRules):
     return _moe_block_dense(x, layer, cfg, rules)
 
 
+def _wload(layer, name: str, dt):
+    """Load a matmul weight in compute dtype.
+
+    When the params tree came through ``models.quant.quantize_params`` the
+    entry is int8 with a ``<name>_scale`` sibling; the convert × scale here
+    fuses into the consuming einsum's operand read, so decode streams half
+    the HBM bytes and never materializes the dequantized matrix.
+    """
+    w = layer[name].astype(dt)
+    scale = layer.get(name + "_scale")
+    if scale is not None:
+        w = w * scale.astype(dt)
+    return w
+
+
 def _moe_router(x, layer, moe):
     """Softmax router → renormalized top-k (values [.., k], indices [.., k])."""
     gates = jax.nn.softmax(
@@ -158,11 +173,11 @@ def _moe_block_dense(x, layer, cfg: LlamaConfig, rules: ShardingRules):
 
     # Dense expert evaluation: [B,S,n_exp,em]; expert dim rides the ep axis,
     # the contraction over n_exp below becomes a psum over ep under jit.
-    h_gate = jnp.einsum("bse,xem->bsxm", x, layer["we_gate"])
-    h_up = jnp.einsum("bse,xem->bsxm", x, layer["we_up"])
+    h_gate = jnp.einsum("bse,xem->bsxm", x, _wload(layer, "we_gate", x.dtype))
+    h_up = jnp.einsum("bse,xem->bsxm", x, _wload(layer, "we_up", x.dtype))
     h = jax.nn.silu(h_gate) * h_up
     h = shard_constraint(h, rules, "batch", "seq", "expert", "mlp")
-    out = jnp.einsum("bsxm,xme,bsx->bse", h, layer["we_down"],
+    out = jnp.einsum("bsxm,xme,bsx->bse", h, _wload(layer, "we_down", x.dtype),
                      weights.astype(x.dtype))
     return out
 
@@ -201,10 +216,10 @@ def _moe_block_capacity(x, layer, cfg: LlamaConfig, rules: ShardingRules):
     buf = buf.at[e_flat, pos_safe].set(x2d[tok], mode="drop")
     buf = shard_constraint(buf, rules, "expert", None, None)
 
-    h = jax.nn.silu(jnp.einsum("xce,xem->xcm", buf, layer["we_gate"])) \
-        * jnp.einsum("xce,xem->xcm", buf, layer["we_up"])
+    h = jax.nn.silu(jnp.einsum("xce,xem->xcm", buf, _wload(layer, "we_gate", x.dtype))) \
+        * jnp.einsum("xce,xem->xcm", buf, _wload(layer, "we_up", x.dtype))
     h = shard_constraint(h, rules, "expert", None, "mlp")
-    y = jnp.einsum("xcm,xme->xce", h, layer["we_down"])  # [X, C, E]
+    y = jnp.einsum("xcm,xme->xce", h, _wload(layer, "we_down", x.dtype))  # [X, C, E]
 
     gathered = y.at[e_flat, pos_safe].get(
         mode="drop", fill_value=0.0)                     # [n*K, E]
@@ -247,13 +262,13 @@ def _block(x, layer, sin, cos, cfg: LlamaConfig, rules: ShardingRules,
 
     h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
     q = checkpoint_name(jnp.einsum(
-        "bse,ehd->bshd", h, layer["wq"].reshape(E, H, D).astype(dt)),
+        "bse,ehd->bshd", h, _wload(layer, "wq", dt).reshape(E, H, D)),
         "qkv_q")
     k = checkpoint_name(jnp.einsum(
-        "bse,ehd->bshd", h, layer["wk"].reshape(E, Hkv, D).astype(dt)),
+        "bse,ehd->bshd", h, _wload(layer, "wk", dt).reshape(E, Hkv, D)),
         "qkv_k")
     v = checkpoint_name(jnp.einsum(
-        "bse,ehd->bshd", h, layer["wv"].reshape(E, Hkv, D).astype(dt)),
+        "bse,ehd->bshd", h, _wload(layer, "wv", dt).reshape(E, Hkv, D)),
         "qkv_v")
     q = apply_rope(q, None, cfg.rope_theta, sin=sin, cos=cos)
     k = apply_rope(k, None, cfg.rope_theta, sin=sin, cos=cos)
@@ -289,7 +304,7 @@ def _block(x, layer, sin, cos, cfg: LlamaConfig, rules: ShardingRules,
             attn = dot_product_attention(q, k, v, causal=True,
                                          segment_ids=segment_ids)
     attn = checkpoint_name(attn.reshape(B, S, H * D), "attn_out")
-    x = x + jnp.einsum("bsf,fe->bse", attn, layer["wo"].astype(dt))
+    x = x + jnp.einsum("bsf,fe->bse", attn, _wload(layer, "wo", dt))
     x = shard_constraint(x, rules, "batch", "seq", None)
 
     x = x + _mlp(x, layer, cfg, rules)
@@ -301,11 +316,11 @@ def _mlp(x, layer, cfg: LlamaConfig, rules: ShardingRules):
     dt = cfg.compute_dtype
     h = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
     if cfg.moe is None:
-        gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"].astype(dt))
-        up = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(dt))
+        gate = jnp.einsum("bse,em->bsm", h, _wload(layer, "w_gate", dt))
+        up = jnp.einsum("bse,em->bsm", h, _wload(layer, "w_up", dt))
         ff = shard_constraint(jax.nn.silu(gate) * up, rules,
                               "batch", "seq", "mlp")
-        out = jnp.einsum("bsm,me->bse", ff, layer["w_down"].astype(dt))
+        out = jnp.einsum("bsm,me->bse", ff, _wload(layer, "w_down", dt))
     else:
         out = _moe_block(h, layer, cfg, rules).astype(dt)
     return checkpoint_name(out, "mlp_out")
@@ -348,10 +363,20 @@ def hidden_states(
 
 
 def unembedding(params: Params, cfg: LlamaConfig) -> jax.Array:
-    """The [E, V] output projection (tied → embedding transpose)."""
-    head = (params["embedding"].T if cfg.tie_embeddings
-            else params["lm_head"])
-    return head.astype(cfg.compute_dtype)
+    """The [E, V] output projection (tied → embedding transpose).
+
+    Prefers the int8 forms ``models.quant.quantize_params`` installs:
+    ``unembed_q`` (tied — keeps the bf16 embedding table for lookups) or an
+    in-place quantized ``lm_head``."""
+    dt = cfg.compute_dtype
+    if "unembed_q" in params:
+        return (params["unembed_q"].astype(dt)
+                * params["unembed_scale"].astype(dt))
+    if not cfg.tie_embeddings:
+        head = params["lm_head"].astype(dt)
+        scale = params.get("lm_head_scale")
+        return head * scale.astype(dt) if scale is not None else head
+    return params["embedding"].T.astype(dt)
 
 
 def forward(
@@ -477,11 +502,11 @@ def _block_cached(x, layer, sin, cos, ck, cv, write_at, mask,
     H, Hkv, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-    q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].reshape(E, H, D).astype(dt))
+    q = jnp.einsum("bse,ehd->bshd", h, _wload(layer, "wq", dt).reshape(E, H, D))
     k = jnp.einsum("bse,ehd->bshd", h,
-                   layer["wk"].reshape(E, Hkv, D).astype(dt))
+                   _wload(layer, "wk", dt).reshape(E, Hkv, D))
     v = jnp.einsum("bse,ehd->bshd", h,
-                   layer["wv"].reshape(E, Hkv, D).astype(dt))
+                   _wload(layer, "wv", dt).reshape(E, Hkv, D))
     q = apply_rope(q, None, cfg.rope_theta, sin=sin, cos=cos)
     k = apply_rope(k, None, cfg.rope_theta, sin=sin, cos=cos)
 
@@ -491,7 +516,7 @@ def _block_cached(x, layer, sin, cos, ck, cv, write_at, mask,
         cv, v.astype(cv.dtype), (0, write_at, 0, 0))
 
     attn = _cached_attn(q, ck, cv, mask, cfg).reshape(B, T, H * D)
-    x = x + jnp.einsum("bsf,fe->bse", attn, layer["wo"].astype(dt))
+    x = x + jnp.einsum("bsf,fe->bse", attn, _wload(layer, "wo", dt))
     x = x + _mlp(x, layer, cfg, rules)
     return x, ck, cv
 

@@ -1,0 +1,129 @@
+"""Weight-only int8 quantization for serving.
+
+Decode on TPU is HBM-bandwidth-bound: every generated token streams the full
+parameter set through the MXU once, so byte-halving the weights is worth up
+to ~2× decode throughput (v5e: 819 GB/s HBM — see BASELINE.md decode rows).
+This module quantizes the transformer matmul weights per output channel to
+int8 with a bf16 scale; the model's weight loads (``llama._wload``) fuse the
+``int8 → compute-dtype convert × scale`` into the einsum operand read, so
+the dequantized matrix is never materialized in HBM.
+
+No reference analogue (the reference ships no model/serving compute at all,
+SURVEY.md §2.7); this is part of the owned compute stack.
+
+Usage::
+
+    qparams = quantize_params(params)
+    gen = Generator(qparams, cfg, mesh=mesh)   # everything else unchanged
+
+Norms, embeddings, and the router stay in the original dtype: they are a
+tiny fraction of the bytes and the quality-sensitive parts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# Stacked-layer matmul weights: [L, ..., in_axis, out_axis]. Scales reduce
+# over the input axis (second-to-last), one scale per output channel.
+QUANT_KEYS: Sequence[str] = (
+    "wq", "wk", "wv", "wo",
+    "w_gate", "w_up", "w_down",
+    "we_gate", "we_up", "we_down",
+)
+
+
+def _quantize_leaf(w: jax.Array):
+    """→ (int8 weights, per-output-channel scale in w.dtype)."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale.astype(w.dtype)
+
+
+def quantize_params(params: Dict[str, Any],
+                    keys: Sequence[str] = QUANT_KEYS,
+                    quantize_unembed: bool = False) -> Dict[str, Any]:
+    """Return a params tree with matmul weights int8-quantized.
+
+    Quantized entries are replaced in place and a ``<name>_scale`` sibling
+    is added; all other leaves (embedding, norms, router) pass through
+    untouched. The result feeds any cached-forward / Generator path — the
+    training step must keep full-precision params.
+
+    ``quantize_unembed``: also quantize the [E, V] output projection
+    (untied ``lm_head`` in place; tied embeddings get a dedicated int8
+    ``unembed_q`` copy so token-embedding *lookups* keep full precision).
+    Off by default: measured **slower** on v5e (2,540 vs 2,708 tok/s
+    decode on the 0.8B bench) — XLA materializes the dequantized [E, V]
+    matrix for this einsum instead of fusing the convert into the operand
+    read, unlike the per-layer weights where the fusion holds.
+    """
+    layers = dict(params["layers"])
+    for name in keys:
+        if name not in layers:
+            continue
+        q, scale = _quantize_leaf(layers[name])
+        layers[name] = q
+        layers[name + "_scale"] = scale
+    out = dict(params)
+    out["layers"] = layers
+    if quantize_unembed:
+        if "lm_head" in out:
+            q, scale = _quantize_leaf(out["lm_head"])
+            out["lm_head"] = q
+            out["lm_head_scale"] = scale
+        else:
+            q, scale = _quantize_leaf(out["embedding"].T)
+            out["unembed_q"] = q
+            out["unembed_scale"] = scale
+    return out
+
+
+def quantized_logical_axes(cfg, base: Optional[Dict[str, Any]] = None):
+    """Logical-axis tree matching :func:`quantize_params` output.
+
+    Scales keep the layer axis and replicate the rest (they are ~1/in_dim
+    the weight's size — sharding them buys nothing).
+    """
+    from kubetorch_tpu.models import llama
+
+    axes = base or llama.param_logical_axes(cfg)
+    layers = dict(axes["layers"])
+    for name in QUANT_KEYS:
+        if name not in layers:
+            continue
+        w_axes = layers[name]
+        layers[name + "_scale"] = ("layer",) + (None,) * (len(w_axes) - 1)
+    out = dict(axes)
+    out["layers"] = layers
+    if "lm_head" in out:
+        out["lm_head_scale"] = (None, None)
+    else:
+        out["unembed_q"] = ("embed_fsdp", "vocab")
+        out["unembed_scale"] = (None, None)
+    return out
+
+
+def dequantize_params(params: Dict[str, Any],
+                      dtype=jnp.bfloat16) -> Dict[str, Any]:
+    """Materialize full-precision weights back (debug / quality checks)."""
+    layers = dict(params["layers"])
+    for name in list(layers):
+        if name.endswith("_scale"):
+            base = name[: -len("_scale")]
+            layers[base] = (layers[base].astype(dtype)
+                            * layers[name].astype(dtype))
+            del layers[name]
+    out = dict(params)
+    out["layers"] = layers
+    # tied-unembed int8 copy is derived data; the bf16 embedding is the truth
+    out.pop("unembed_q", None)
+    out.pop("unembed_scale", None)
+    if "lm_head_scale" in out:
+        out["lm_head"] = (out["lm_head"].astype(dtype)
+                          * out.pop("lm_head_scale").astype(dtype))
+    return out

@@ -386,12 +386,25 @@ def auto_block_k(T: int, requested: Optional[int] = None) -> int:
     return min(512, T)
 
 
-def flash_tileable(q_shape, k_shape, block_q: int = 512,
+def auto_block_q(S: int, requested: Optional[int] = None) -> int:
+    """Query block size: 1024 when it divides S (measured +1.6% train
+    throughput over 512 at S=2048 on v5e — bigger MXU tiles amortize the
+    online-softmax bookkeeping), else the 512 ladder as for KV."""
+    if requested is not None:
+        return min(requested, S)
+    if S >= 1024 and S % 1024 == 0:
+        return 1024
+    if S >= 512 and S % 512 == 0:
+        return 512
+    return min(512, S)
+
+
+def flash_tileable(q_shape, k_shape, block_q: Optional[int] = None,
                    block_k: Optional[int] = None) -> bool:
     """True when [B,S,H,D] / [B,T,Hkv,D] shapes fit the kernel tiling."""
     B, S, Hq, D = q_shape
     T, Hkv = k_shape[1], k_shape[2]
-    bq, bk = min(block_q, S), auto_block_k(T, block_k)
+    bq, bk = auto_block_q(S, block_q), auto_block_k(T, block_k)
     return (S % bq == 0 and T % bk == 0 and D % 128 == 0
             and Hq % Hkv == 0 and bq % 8 == 0 and bk % 8 == 0)
 
@@ -403,7 +416,7 @@ def flash_attention_with_lse(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = 512,
+    block_q: Optional[int] = None,   # None = auto (1024 when it divides S)
     block_k: Optional[int] = None,   # None = auto (1024 when it divides T)
     interpret: Optional[bool] = None,
 ):
@@ -418,7 +431,7 @@ def flash_attention_with_lse(
     scale = scale if scale is not None else D ** -0.5
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    block_q = min(block_q, S)
+    block_q = auto_block_q(S, block_q)
     block_k = auto_block_k(k.shape[1], block_k)
     out, lse = _flash_forward(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
@@ -434,7 +447,7 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = 512,
+    block_q: Optional[int] = None,   # None = auto (1024 when it divides S)
     block_k: Optional[int] = None,   # None = auto (1024 when it divides T)
     interpret: Optional[bool] = None,
 ) -> jax.Array:
@@ -450,7 +463,7 @@ def flash_attention(
         interpret = jax.default_backend() == "cpu"
     if not flash_tileable(q.shape, k.shape, block_q, block_k):
         return dot_product_attention(q, k, v, causal=causal, scale=scale)
-    block_q = min(block_q, S)
+    block_q = auto_block_q(S, block_q)
     block_k = auto_block_k(T, block_k)
     out = _flash(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),

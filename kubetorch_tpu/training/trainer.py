@@ -220,7 +220,8 @@ class Trainer:
         """
         for _ in range(warmup):
             metrics = self.step(batch)
-        float(jax.device_get(metrics["loss"]))
+        if warmup:
+            float(jax.device_get(metrics["loss"]))
         t0 = time.perf_counter()
         for _ in range(n_steps):
             metrics = self.step(batch)
