@@ -20,7 +20,8 @@ import json
 class LlamaServer:
     """Stateful model replica: params live across requests."""
 
-    def __init__(self, model: str = "tiny", max_len: int = 512):
+    def __init__(self, model: str = "tiny", max_len: int = 512,
+                 quantize: bool = True):
         import dataclasses
         import os
 
@@ -38,8 +39,15 @@ class LlamaServer:
             cfg, max_seq_len=min(max_len, cfg.max_seq_len))
         self.cfg = cfg
         params = jax.jit(lambda k: llama.init(k, cfg))(jax.random.key(0))
-        self.generator = Generator(params, cfg)
+        # full-precision params serve score(); decode runs int8 weight-only
+        # (+32% tok/s on v5e — models/quant.py) unless disabled
         self.params = params
+        gen_params = params
+        if quantize:
+            from kubetorch_tpu.models.quant import quantize_params
+
+            gen_params = jax.jit(quantize_params)(params)
+        self.generator = Generator(gen_params, cfg)
 
     def generate(self, prompts, max_new_tokens: int = 32,
                  temperature: float = 0.8, top_p: float = 0.95,
