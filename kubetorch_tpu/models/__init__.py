@@ -30,8 +30,12 @@ def __getattr__(name):
         from kubetorch_tpu.models.quant import quantize_params
 
         return quantize_params
+    if name == "RollingGenerator":
+        from kubetorch_tpu.models.rolling import RollingGenerator
+
+        return RollingGenerator
     raise AttributeError(name)
 
 
 __all__ = ["LlamaConfig", "MoEConfig", "ViTConfig", "llama", "Generator",
-           "generate", "quant", "quantize_params"]
+           "generate", "quant", "quantize_params", "RollingGenerator"]

@@ -510,10 +510,27 @@ def _block_cached(x, layer, sin, cos, ck, cv, write_at, mask,
     q = apply_rope(q, None, cfg.rope_theta, sin=sin, cos=cos)
     k = apply_rope(k, None, cfg.rope_theta, sin=sin, cos=cos)
 
-    ck = jax.lax.dynamic_update_slice(
-        ck, k.astype(ck.dtype), (0, write_at, 0, 0))
-    cv = jax.lax.dynamic_update_slice(
-        cv, v.astype(cv.dtype), (0, write_at, 0, 0))
+    if jnp.ndim(write_at) == 0:
+        # uniform slot across the batch (Generator: right-padded prompts)
+        ck = jax.lax.dynamic_update_slice(
+            ck, k.astype(ck.dtype), (0, write_at, 0, 0))
+        cv = jax.lax.dynamic_update_slice(
+            cv, v.astype(cv.dtype), (0, write_at, 0, 0))
+    elif T == 1:
+        # per-sequence slots (rolling decode: every slot at its own depth).
+        # One-hot masked write, not a scatter — generic 2D-index scatters
+        # lower poorly on TPU (measured 15 ms vs ~2 ms per decode step on
+        # the 0.8B bench); this streams the cache once at HBM speed.
+        hit = (jnp.arange(ck.shape[1])[None, :]
+               == write_at[:, None])[:, :, None, None]        # [B, M, 1, 1]
+        ck = jnp.where(hit, k.astype(ck.dtype), ck)
+        cv = jnp.where(hit, v.astype(cv.dtype), cv)
+    else:
+        # per-sequence multi-token write (rare): scatter rows
+        pos = write_at[:, None] + jnp.arange(T)[None, :]      # [B, T]
+        bidx = jnp.arange(B)[:, None]
+        ck = ck.at[bidx, pos].set(k.astype(ck.dtype), mode="drop")
+        cv = cv.at[bidx, pos].set(v.astype(cv.dtype), mode="drop")
 
     attn = _cached_attn(q, ck, cv, mask, cfg).reshape(B, T, H * D)
     x = x + jnp.einsum("bsf,fe->bse", attn, _wload(layer, "wo", dt))
@@ -526,7 +543,8 @@ def forward_cached(
     tokens: jax.Array,        # [B, T] int32 (prefill: padded prompt; decode: 1)
     positions: jax.Array,     # [B, T] int32 RoPE positions per token
     cache: Dict[str, jax.Array],
-    write_at,                 # scalar int: cache slot for tokens[:, 0]
+    write_at,                 # cache slot for tokens[:, 0]: scalar, or [B]
+                              # per-sequence slots (rolling batches)
     mask: jax.Array,          # [B, T, max_len] bool attention mask
     cfg: LlamaConfig,
     rules: Optional[ShardingRules] = None,

@@ -1,0 +1,297 @@
+"""Continuous (rolling) batching for KV-cache generation.
+
+The reference serves LLMs by deploying vLLM as an ``App`` workload
+(reference: ``examples/tutorials/vllm_inference/``); the TPU build owns the
+serving compute, so it needs vLLM's core scheduling idea natively: requests
+join and leave a shared decode batch at any time, instead of the whole
+batch blocking until its slowest member finishes (the static
+:class:`~kubetorch_tpu.models.generate.Generator` contract).
+
+TPU shape discipline + dispatch discipline:
+
+- Everything is static-shaped. The engine owns a ``[L, max_slots, max_len,
+  Hkv, D]`` cache; a *slot* is a batch row. New requests prefill into a
+  free slot (jitted per padded-length bucket), and decode advances **all**
+  active slots — each at its own depth via the per-sequence ``write_at``
+  scatter in ``llama.forward_cached``.
+- All decode state (cache, pending logits, depths, active mask) lives on
+  device between calls; the host holds only bookkeeping. Each
+  :meth:`step` is ONE jit call running ``steps_per_call`` tokens through a
+  ``lax.scan`` and ONE host sync for the emitted block — per-token Python
+  dispatch is what made naive rolling 8× slower than a static scan on a
+  remote-attached TPU, and chunking amortizes it away. Requests finish
+  mid-chunk: their surplus tokens are trimmed on the host and their slot
+  frees at the chunk boundary (≤ ``steps_per_call − 1`` wasted
+  slot-tokens), which is the latency/throughput knob.
+
+Greedy rolling decode is token-identical to isolated ``Generator`` runs
+(pinned in ``tests/test_rolling.py``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubetorch_tpu.models import llama
+from kubetorch_tpu.models.configs import LlamaConfig
+from kubetorch_tpu.models.generate import filter_logits
+from kubetorch_tpu.parallel.sharding import ShardingRules
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    """Pad length → power-of-two bucket (few compiles cover all prompts)."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class Request:
+    __slots__ = ("rid", "prompt", "max_new_tokens", "temperature",
+                 "tokens", "done", "slot")
+
+    def __init__(self, rid, prompt, max_new_tokens, temperature):
+        self.rid = rid
+        self.prompt = list(prompt)
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.tokens: List[int] = []
+        self.done = False
+        self.slot: Optional[int] = None
+
+
+class RollingGenerator:
+    """Continuous-batching engine over a fixed slot grid.
+
+    >>> eng = RollingGenerator(params, cfg, max_slots=8)
+    >>> rid = eng.submit([1, 2, 3], max_new_tokens=64)
+    >>> while eng.pending:
+    ...     for rid, toks, done in eng.step():
+    ...         ...
+    """
+
+    def __init__(self, params: Dict[str, Any], cfg: LlamaConfig,
+                 max_slots: int = 8, max_len: Optional[int] = None,
+                 rules: Optional[ShardingRules] = None,
+                 eos_id: Optional[int] = None, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, seed: int = 0,
+                 steps_per_call: int = 8):
+        self.params = params
+        self.cfg = cfg
+        self.rules = rules or ShardingRules.default()
+        self.max_slots = max_slots
+        self.max_len = max_len or cfg.max_seq_len
+        self.eos_id = eos_id
+        self.top_k = top_k
+        self.top_p = top_p
+        self.steps_per_call = max(1, steps_per_call)
+        self._rng = jax.random.key(seed)
+
+        # device-resident decode state
+        self.cache = llama.init_cache(cfg, max_slots, self.max_len)
+        self._logits = jnp.zeros((max_slots, cfg.vocab_size), jnp.float32)
+        self._dpos = jnp.zeros((max_slots,), jnp.int32)
+        self._dactive = jnp.zeros((max_slots,), bool)
+
+        # host bookkeeping
+        self._free = list(range(max_slots))
+        self._slots: Dict[int, Request] = {}
+        self._queue: List[Request] = []
+        self._next_rid = 0
+        self._temps = np.zeros(max_slots, np.float32)
+
+        # Donation matters doubly here: the cache grid is the largest
+        # buffer in the server and every call rewrites it — aliasing
+        # in/out keeps updates in place (and off any remote-dispatch wire).
+        self._prefill = jax.jit(
+            partial(self._prefill_impl, cfg=cfg, rules=self.rules),
+            static_argnames=("p_pad",), donate_argnums=(1, 2, 3, 4))
+        self._decode = jax.jit(
+            partial(self._decode_impl, cfg=cfg, rules=self.rules),
+            static_argnames=("top_k", "top_p", "n_steps"),
+            donate_argnums=(1, 2, 3))
+
+    # ------------------------------------------------------------ public
+    @property
+    def pending(self) -> int:
+        return len(self._queue) + len(self._slots)
+
+    def submit(self, prompt, max_new_tokens: int = 128,
+               temperature: float = 0.0) -> int:
+        if (len(prompt) + max_new_tokens + self.steps_per_call
+                > self.max_len):
+            raise ValueError(
+                f"prompt+max_new_tokens+steps_per_call "
+                f"{len(prompt)}+{max_new_tokens}+{self.steps_per_call} "
+                f"exceeds max_len {self.max_len}")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(Request(rid, prompt, max_new_tokens, temperature))
+        return rid
+
+    def step(self) -> List[Tuple[int, List[int], bool]]:
+        """Admit queued requests into free slots, run one decode chunk
+        (``steps_per_call`` tokens). Returns ``(rid, new_tokens,
+        finished)`` per active request."""
+        # Batched admission: all same-bucket arrivals prefill in ONE call
+        # (a per-call dispatch costs more than the prefill compute for
+        # short prompts; grouping cuts admission dispatches ~max_slots×).
+        by_bucket: Dict[int, List[Request]] = {}
+        while self._free and self._queue:
+            req = self._queue.pop(0)
+            req.slot = self._free.pop(0)
+            by_bucket.setdefault(_bucket(len(req.prompt)), []).append(req)
+        for p_pad, group in by_bucket.items():
+            self._admit_group(group, p_pad)
+        if not self._slots:
+            return []
+        return self._decode_chunk()
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drain everything; → {rid: generated tokens}."""
+        out: Dict[int, List[int]] = {}
+        while self.pending:
+            for rid, toks, done in self.step():
+                out.setdefault(rid, []).extend(toks)
+        return out
+
+    def warmup(self, prompt_buckets=(16, 64, 128)) -> None:
+        """Compile the serving shapes up front: the decode chunk plus both
+        admission widths for each prompt bucket. Call before taking
+        traffic — a cold (bucket, width) pair compiles mid-request
+        otherwise (tens of seconds on a cold compile cache)."""
+        for p_pad in sorted(set(_bucket(b) for b in prompt_buckets)):
+            for width in sorted({1, self.max_slots}):
+                for _ in range(width):
+                    self.submit([1] * min(p_pad, self.max_len // 2),
+                                max_new_tokens=1)
+                self.run()
+
+    # ----------------------------------------------------------- interns
+    def _admit_group(self, group: List[Request], p_pad: int):
+        """Prefill N same-bucket requests in one call. N pads to a power
+        of two (dummy rows target slot ``max_slots`` and drop in the
+        scatter) so compile count stays O(buckets × log slots)."""
+        n = len(group)
+        # two admission shapes only (single vs full-width) — prefill FLOPs
+        # on dummy rows are cheap; compiles are not
+        n_pad = 1 if n == 1 else self.max_slots
+        toks = np.zeros((n_pad, p_pad), np.int32)
+        lens = np.ones(n_pad, np.int32)
+        slots = np.full(n_pad, self.max_slots, np.int32)  # OOB → dropped
+        for i, req in enumerate(group):
+            toks[i, :len(req.prompt)] = req.prompt
+            lens[i] = len(req.prompt)
+            slots[i] = req.slot
+            self._temps[req.slot] = req.temperature
+            self._slots[req.slot] = req
+        (self.cache, self._logits, self._dpos,
+         self._dactive) = self._prefill(
+            self.params, self.cache, self._logits, self._dpos, self._dactive,
+            jnp.asarray(toks), jnp.asarray(lens), jnp.asarray(slots),
+            p_pad=p_pad)
+
+    def _decode_chunk(self) -> List[Tuple[int, List[int], bool]]:
+        self._rng, key = jax.random.split(self._rng)
+        (self.cache, self._logits, self._dpos, toks) = self._decode(
+            self.params, self.cache, self._logits, self._dpos, self._dactive,
+            jnp.asarray(self._temps), key,
+            top_k=self.top_k, top_p=self.top_p,
+            n_steps=self.steps_per_call)
+        toks = np.asarray(toks)                       # [K, B] — the one sync
+
+        events: List[Tuple[int, List[int], bool]] = []
+        freed: List[int] = []
+        for slot in list(self._slots):
+            req = self._slots[slot]
+            new = [int(t) for t in toks[:, slot]]
+            # trim to budget; cut at eos
+            room = req.max_new_tokens - len(req.tokens)
+            new = new[:room]
+            if self.eos_id is not None and self.eos_id in new:
+                new = new[: new.index(self.eos_id) + 1]
+            req.tokens.extend(new)
+            done = (len(req.tokens) >= req.max_new_tokens
+                    or (self.eos_id is not None
+                        and bool(new) and new[-1] == self.eos_id))
+            events.append((req.rid, new, done))
+            if done:
+                req.done = True
+                del self._slots[slot]
+                freed.append(slot)
+        if freed:
+            idx = jnp.asarray(freed, jnp.int32)
+            self._dactive = self._dactive.at[idx].set(False)
+            self._dpos = self._dpos.at[idx].set(0)
+            self._free.extend(freed)
+        return events
+
+    # ------------------------------------------------------------- jitted
+    @staticmethod
+    def _prefill_impl(params, cache, logits, dpos, dactive, tokens,
+                      prompt_lens, slots, *, p_pad, cfg, rules):
+        """Prefill N slots at once: one forward over a private N-row
+        cache, then scatter the rows into the shared grid at ``slots``
+        (out-of-range dummy rows drop)."""
+        M = cache["k"].shape[2]
+        N = tokens.shape[0]
+        positions = jnp.broadcast_to(jnp.arange(p_pad)[None, :], (N, p_pad))
+        m = jnp.arange(M)[None, None, :]
+        t = positions[:, :, None]
+        mask = (m <= t) & (m < prompt_lens[:, None, None])
+        own = llama.init_cache(cfg, N, M, dtype=cache["k"].dtype)
+        out, own = llama.forward_cached(
+            params, tokens, positions, own, 0, mask, cfg, rules)
+        # Splice own rows into the grid as gather + masked select, NOT a
+        # scatter: batched-axis scatters on the [L,B,M,Hkv,D] grid lower to
+        # a serialized generic scatter on TPU (measured ~7 s per admission
+        # on the 0.8B bench vs ~60 ms this way).
+        B = cache["k"].shape[1]
+        onehot = slots[None, :] == jnp.arange(B)[:, None]       # [B, N]
+        valid = onehot.any(axis=1)[None, :, None, None, None]
+        sel = jnp.argmax(onehot, axis=1)                        # [B]
+        cache = {
+            "k": jnp.where(valid, own["k"][:, sel], cache["k"]),
+            "v": jnp.where(valid, own["v"][:, sel], cache["v"]),
+        }
+        last = jnp.take_along_axis(
+            out, (prompt_lens - 1)[:, None, None], axis=1)[:, 0]  # [N, V]
+        logits = logits.at[slots].set(last, mode="drop")
+        dpos = dpos.at[slots].set(prompt_lens, mode="drop")
+        dactive = dactive.at[slots].set(True, mode="drop")
+        return cache, logits, dpos, dactive
+
+    @staticmethod
+    def _decode_impl(params, cache, last_logits, pos, active, temps, key, *,
+                     top_k, top_p, n_steps, cfg, rules):
+        """``n_steps`` tokens for every slot, each at its own depth, in one
+        ``lax.scan`` — one dispatch, one emitted [K, B] block."""
+        M = cache["k"].shape[2]
+
+        def one(carry, step_key):
+            cache, logits, pos = carry
+            logits_f = filter_logits(logits, top_k=top_k, top_p=top_p)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            keys = jax.random.split(step_key, logits.shape[0])
+            sampled = jax.vmap(
+                lambda k, l, t: jax.random.categorical(
+                    k, l / jnp.maximum(t, 1e-6))
+            )(keys, logits_f, temps).astype(jnp.int32)
+            tok = jnp.where(temps > 0, sampled, greedy)
+
+            positions = pos[:, None]
+            m = jnp.arange(M)[None, None, :]
+            mask = (m <= pos[:, None, None]) & active[:, None, None]
+            out, cache = llama.forward_cached(
+                params, tok[:, None], positions, cache, pos, mask, cfg,
+                rules)
+            return (cache, out[:, 0], pos + 1), tok
+
+        (cache, logits, pos), toks = jax.lax.scan(
+            one, (cache, last_logits, pos), jax.random.split(key, n_steps))
+        return cache, logits, pos, toks
