@@ -21,7 +21,8 @@ class LlamaServer:
     """Stateful model replica: params live across requests."""
 
     def __init__(self, model: str = "tiny", max_len: int = 512,
-                 quantize: bool = True):
+                 quantize: bool = True, rolling: bool = True,
+                 max_slots: int = 8):
         import dataclasses
         import os
 
@@ -48,11 +49,29 @@ class LlamaServer:
 
             gen_params = jax.jit(quantize_params)(params)
         self.generator = Generator(gen_params, cfg)
+        # Continuous batching: concurrent HTTP callers (the pod server's
+        # thread pool) share one decode batch instead of serializing
+        # whole-batch generations (models/rolling.py).
+        self.service = None
+        if rolling:
+            from kubetorch_tpu.models.rolling import (
+                RollingGenerator,
+                RollingService,
+            )
+
+            self.service = RollingService(RollingGenerator(
+                gen_params, cfg, max_slots=max_slots, top_p=0.95))
 
     def generate(self, prompts, max_new_tokens: int = 32,
                  temperature: float = 0.8, top_p: float = 0.95,
                  eos_id=None, seed: int = 0):
-        """Batched sampling → per-prompt token lists."""
+        """Batched sampling → per-prompt token lists. Single-prompt calls
+        ride the shared rolling batch; multi-prompt calls use the static
+        batch generator."""
+        if self.service is not None and len(prompts) == 1:
+            return [self.service.generate(
+                prompts[0], max_new_tokens=max_new_tokens,
+                temperature=temperature, timeout=600)]
         return self.generator.generate(
             prompts, max_new_tokens=max_new_tokens, temperature=temperature,
             top_p=top_p, eos_id=eos_id, seed=seed)

@@ -295,3 +295,61 @@ class RollingGenerator:
         (cache, logits, pos), toks = jax.lax.scan(
             one, (cache, last_logits, pos), jax.random.split(key, n_steps))
         return cache, logits, pos, toks
+
+
+class RollingService:
+    """Thread-safe facade: concurrent callers share one rolling batch.
+
+    This is what a ``kt.cls`` model server wants — the pod server runs
+    requests on a thread pool, and every concurrent ``generate()`` call
+    lands in the same continuous batch instead of serializing whole-batch
+    generations. A single driver thread advances the engine while any
+    request is pending.
+    """
+
+    def __init__(self, engine: "RollingGenerator"):
+        import threading
+
+        self.engine = engine
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._results: Dict[int, List[int]] = {}
+        self._done: Dict[int, bool] = {}
+        self._driver = threading.Thread(
+            target=self._drive, name="kt-rolling-driver", daemon=True)
+        self._driver.start()
+
+    def generate(self, prompt, max_new_tokens: int = 128,
+                 temperature: float = 0.0,
+                 timeout: Optional[float] = None) -> List[int]:
+        """Submit and block until this request finishes; other callers'
+        requests decode in the same chunks meanwhile."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.time() + timeout
+        with self._wake:
+            rid = self.engine.submit(prompt, max_new_tokens=max_new_tokens,
+                                     temperature=temperature)
+            self._results[rid] = []
+            self._done[rid] = False
+            self._wake.notify_all()
+            while not self._done[rid]:
+                rem = None if deadline is None else deadline - _time.time()
+                if rem is not None and rem <= 0:
+                    raise TimeoutError(f"request {rid} timed out")
+                self._wake.wait(timeout=rem if rem is not None else 1.0)
+            self._done.pop(rid)
+            return self._results.pop(rid)
+
+    def _drive(self):
+        while True:
+            with self._wake:
+                while not self.engine.pending:
+                    self._wake.wait()
+                events = self.engine.step()
+                for rid, toks, done in events:
+                    self._results.setdefault(rid, []).extend(toks)
+                    if done:
+                        self._done[rid] = True
+                if any(done for _, _, done in events):
+                    self._wake.notify_all()

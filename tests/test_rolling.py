@@ -99,6 +99,41 @@ def test_eos_frees_slot(model):
 
 
 @pytest.mark.level("minimal")
+def test_rolling_service_concurrent_callers(model):
+    """Threaded callers (the kt.cls pod-server execution model) share one
+    batch and each gets its own isolated-generation-equivalent result."""
+    import threading
+
+    from kubetorch_tpu.models.rolling import RollingService
+
+    params, cfg = model
+    gen = Generator(params, cfg)
+    prompts = [[1, 2, 3], [4, 5, 6, 7], [10, 20], [8, 9]]
+    iso = [gen.generate([p], max_new_tokens=6, temperature=0.0)[0]
+           for p in prompts]
+
+    svc = RollingService(RollingGenerator(params, cfg, max_slots=2))
+    results = [None] * len(prompts)
+    errors = []
+
+    def call(i):
+        try:
+            results[i] = svc.generate(prompts[i], max_new_tokens=6,
+                                      timeout=120)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=call, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(150)
+    assert not errors, errors
+    assert results == iso
+
+
+@pytest.mark.level("minimal")
 def test_prefill_bucket_compile_stability(model):
     """Prompts in the same bucket reuse one prefill compile."""
     params, cfg = model
