@@ -77,12 +77,13 @@ class RollingGenerator:
 
     def __init__(self, params: Dict[str, Any], cfg: LlamaConfig,
                  max_slots: int = 8, max_len: Optional[int] = None,
-                 rules: Optional[ShardingRules] = None,
+                 mesh=None, rules: Optional[ShardingRules] = None,
                  eos_id: Optional[int] = None, top_k: Optional[int] = None,
                  top_p: Optional[float] = None, seed: int = 0,
                  steps_per_call: int = 8):
         self.params = params
         self.cfg = cfg
+        self.mesh = mesh
         self.rules = rules or ShardingRules.default()
         self.max_slots = max_slots
         self.max_len = max_len or cfg.max_seq_len
@@ -190,19 +191,29 @@ class RollingGenerator:
             slots[i] = req.slot
             self._temps[req.slot] = req.temperature
             self._slots[req.slot] = req
-        (self.cache, self._logits, self._dpos,
-         self._dactive) = self._prefill(
-            self.params, self.cache, self._logits, self._dpos, self._dactive,
-            jnp.asarray(toks), jnp.asarray(lens), jnp.asarray(slots),
-            p_pad=p_pad)
+        with self._mesh_ctx():
+            (self.cache, self._logits, self._dpos,
+             self._dactive) = self._prefill(
+                self.params, self.cache, self._logits, self._dpos,
+                self._dactive, jnp.asarray(toks), jnp.asarray(lens),
+                jnp.asarray(slots), p_pad=p_pad)
+
+    def _mesh_ctx(self):
+        import contextlib
+
+        from kubetorch_tpu.parallel.mesh import use_mesh
+
+        return (use_mesh(self.mesh) if self.mesh is not None
+                else contextlib.nullcontext())
 
     def _decode_chunk(self) -> List[Tuple[int, List[int], bool]]:
         self._rng, key = jax.random.split(self._rng)
-        (self.cache, self._logits, self._dpos, toks) = self._decode(
-            self.params, self.cache, self._logits, self._dpos, self._dactive,
-            jnp.asarray(self._temps), key,
-            top_k=self.top_k, top_p=self.top_p,
-            n_steps=self.steps_per_call)
+        with self._mesh_ctx():
+            (self.cache, self._logits, self._dpos, toks) = self._decode(
+                self.params, self.cache, self._logits, self._dpos,
+                self._dactive, jnp.asarray(self._temps), key,
+                top_k=self.top_k, top_p=self.top_p,
+                n_steps=self.steps_per_call)
         toks = np.asarray(toks)                       # [K, B] — the one sync
 
         events: List[Tuple[int, List[int], bool]] = []

@@ -134,6 +134,39 @@ def test_rolling_service_concurrent_callers(model):
 
 
 @pytest.mark.level("minimal")
+def test_rolling_under_tp_mesh(model):
+    """Continuous batching on a sharded model: tp=2 mesh over the virtual
+    8-device farm, params placed by logical axes, same greedy tokens."""
+    import jax as _jax
+
+    from kubetorch_tpu.models import llama as _llama
+    from kubetorch_tpu.parallel import MeshSpec, use_mesh
+    from kubetorch_tpu.parallel.sharding import (
+        ShardingRules,
+        named_sharding,
+    )
+
+    params, cfg = model
+    mesh = MeshSpec(tp=2).build(_jax.devices()[:2])
+    rules = ShardingRules.default()
+    axes = _llama.param_logical_axes(cfg)
+    shardings = _jax.tree.map(
+        lambda ax: named_sharding(mesh, rules, *ax), axes,
+        is_leaf=lambda x: isinstance(x, tuple))
+    sharded = _jax.tree.map(_jax.device_put, params, shardings)
+    prompts = [[1, 2, 3, 4], [7, 8]]
+    gen = Generator(params, cfg)
+    iso = [gen.generate([p], max_new_tokens=6, temperature=0.0)[0]
+           for p in prompts]
+    eng = RollingGenerator(sharded, cfg, max_slots=2, mesh=mesh,
+                           rules=rules)
+    rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    out = eng.run()
+    for rid, expect in zip(rids, iso):
+        assert out[rid] == expect
+
+
+@pytest.mark.level("minimal")
 def test_prefill_bucket_compile_stability(model):
     """Prompts in the same bucket reuse one prefill compile."""
     params, cfg = model
