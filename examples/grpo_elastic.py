@@ -77,25 +77,42 @@ def grpo_train(rounds: int = 2, group_size: int = 8, seq_len: int = 32,
 
 # ---------------------------------------------------------------- sampler
 def grpo_sample(n_prompts: int = 4, seq_len: int = 8,
-                max_new_tokens: int = 8, model: str = "tiny") -> dict:
-    """Pull freshest policy weights, run real KV-cache rollouts."""
+                max_new_tokens: int = 8, model: str = "tiny",
+                fleet_size: int = 1) -> dict:
+    """Pull freshest policy weights, run real KV-cache rollouts.
+
+    ``fleet_size`` > 1 tells the store how many samplers are fetching the
+    same weights this round: the fetch joins a ``BroadcastWindow`` group
+    and rides the rolling fan-out tree (completed peers serve later
+    joiners) instead of every worker streaming from the store — the
+    reference's NCCL broadcast-group role (SURVEY §3.5), host-staged.
+    Rollouts run on the continuous-batching engine so staggered prompt
+    lengths don't serialize."""
     import jax
     import numpy as np
 
     from kubetorch_tpu.data_store.device_transfer import get_arrays
-    from kubetorch_tpu.models import Generator, LlamaConfig, llama
+    from kubetorch_tpu.data_store.types import BroadcastWindow
+    from kubetorch_tpu.models import LlamaConfig, llama
+    from kubetorch_tpu.models.rolling import RollingGenerator
 
     cfg = (LlamaConfig.llama3_1b() if model == "1b" else LlamaConfig.tiny())
     # abstract init (no FLOPs) recovers the param tree structure the
     # trainer packed, so the blob unflattens to a real param pytree.
     template = jax.eval_shape(lambda: llama.init(jax.random.key(0), cfg))
-    params = get_arrays(WEIGHTS_KEY, template=template)
+    window = (BroadcastWindow(world_size=fleet_size, fanout=3)
+              if fleet_size > 1 else None)
+    params = get_arrays(WEIGHTS_KEY, template=template, broadcast=window)
     rng = np.random.default_rng(1)
-    prompts = rng.integers(
-        0, cfg.vocab_size, (n_prompts, seq_len)).tolist()
-    rollouts = Generator(params, cfg).generate(
-        prompts, max_new_tokens=max_new_tokens, temperature=0.8,
-        top_p=0.95, seed=1)
+    eng = RollingGenerator(params, cfg, max_slots=min(8, n_prompts),
+                           steps_per_call=4)
+    rids = [eng.submit(rng.integers(0, cfg.vocab_size,
+                                    int(rng.integers(2, seq_len + 1)))
+                       .tolist(),
+                       max_new_tokens=max_new_tokens, temperature=0.8)
+            for _ in range(n_prompts)]
+    out = eng.run()
+    rollouts = [out[rid] for rid in rids]
     return {"sampled": len(rollouts), "rollouts": rollouts}
 
 
