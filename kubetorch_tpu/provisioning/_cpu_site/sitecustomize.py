@@ -1,0 +1,9 @@
+"""Shadowing sitecustomize for CPU-only local-backend pods.
+
+Python imports exactly one ``sitecustomize`` — the first on ``sys.path``.
+Some dev images install one that eagerly imports jax plus an accelerator
+plugin (~2 s) into EVERY interpreter; local-backend pods and their worker
+subprocesses are CPU-only by definition, so the backend prepends this
+directory to ``PYTHONPATH`` and the heavy registration never runs. The
+k8s backend does not use this — real TPU pods need their plugin.
+"""

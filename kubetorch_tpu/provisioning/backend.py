@@ -112,6 +112,12 @@ class LocalBackend:
         wants_tpu = bool(compute_dict.get("tpus"))
         if not wants_tpu:
             base_env["JAX_PLATFORMS"] = "cpu"
+            # Shadow any site-level accelerator-plugin import (costs ~2 s
+            # per interpreter — pod server AND each spawned worker): cold
+            # dispatch is a headline metric and these pods are CPU-only.
+            stub = str(Path(__file__).resolve().parent / "_cpu_site")
+            if stub not in python_path.split(os.pathsep):
+                python_path = f"{stub}{os.pathsep}{python_path}"
 
         pods = []
         for index, port in enumerate(ports):
@@ -160,7 +166,8 @@ class LocalBackend:
         ``service_manager.py:682``)."""
         deadline = time.time() + timeout
         pending = {p["port"]: p for p in record["pods"]}
-        while pending and time.time() < deadline:
+        delay = 0.05  # tight at first — cold dispatch latency is a
+        while pending and time.time() < deadline:  # headline metric
             for port, pod in list(pending.items()):
                 if not _pid_alive(pod["pid"]):
                     raise ServiceTimeoutError(
@@ -170,7 +177,8 @@ class LocalBackend:
                         f"http://127.0.0.1:{port}", launch_id):
                     del pending[port]
             if pending:
-                time.sleep(0.3)
+                time.sleep(delay)
+                delay = min(delay * 1.5, 0.3)
         if pending:
             pod = next(iter(pending.values()))
             raise ServiceTimeoutError(
