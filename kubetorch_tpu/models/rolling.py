@@ -53,7 +53,7 @@ def _bucket(n: int, lo: int = 16) -> int:
 
 class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature",
-                 "tokens", "done", "slot")
+                 "tokens", "done", "slot", "prefix_id")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature):
         self.rid = rid
@@ -63,6 +63,7 @@ class Request:
         self.tokens: List[int] = []
         self.done = False
         self.slot: Optional[int] = None
+        self.prefix_id: Optional[int] = None
 
 
 class RollingGenerator:
@@ -105,6 +106,9 @@ class RollingGenerator:
         self._queue: List[Request] = []
         self._next_rid = 0
         self._temps = np.zeros(max_slots, np.float32)
+        # prefix_id -> {k, v, len, logits} (device KV blocks, see
+        # register_prefix)
+        self._prefixes: Dict[int, dict] = {}
 
         # Donation matters doubly here: the cache grid is the largest
         # buffer in the server and every call rewrites it — aliasing
@@ -116,6 +120,12 @@ class RollingGenerator:
             partial(self._decode_impl, cfg=cfg, rules=self.rules),
             static_argnames=("top_k", "top_p", "n_steps"),
             donate_argnums=(1, 2, 3))
+        self._prefix_fill = jax.jit(
+            partial(self._prefix_fill_impl, cfg=cfg, rules=self.rules),
+            static_argnames=("p_pad",))
+        self._prefill_px = jax.jit(
+            partial(self._prefill_px_impl, cfg=cfg, rules=self.rules),
+            static_argnames=("p_pad",), donate_argnums=(1, 2, 3, 4))
 
     # ------------------------------------------------------------ public
     @property
@@ -123,32 +133,44 @@ class RollingGenerator:
         return len(self._queue) + len(self._slots)
 
     def submit(self, prompt, max_new_tokens: int = 128,
-               temperature: float = 0.0) -> int:
-        if (len(prompt) + max_new_tokens + self.steps_per_call
-                > self.max_len):
+               temperature: float = 0.0,
+               prefix_id: Optional[int] = None) -> int:
+        prefix_len = 0
+        if prefix_id is not None:
+            if prefix_id not in self._prefixes:
+                raise KeyError(f"unknown prefix_id {prefix_id}")
+            if not prompt:
+                raise ValueError("prefixed submit needs >= 1 suffix token")
+            prefix_len = self._prefixes[prefix_id]["len"]
+        total = prefix_len + len(prompt) + max_new_tokens
+        if total + self.steps_per_call > self.max_len:
             raise ValueError(
-                f"prompt+max_new_tokens+steps_per_call "
-                f"{len(prompt)}+{max_new_tokens}+{self.steps_per_call} "
-                f"exceeds max_len {self.max_len}")
+                f"prefix+prompt+max_new_tokens+steps_per_call "
+                f"{prefix_len}+{len(prompt)}+{max_new_tokens}"
+                f"+{self.steps_per_call} exceeds max_len {self.max_len}")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(Request(rid, prompt, max_new_tokens, temperature))
+        req = Request(rid, prompt, max_new_tokens, temperature)
+        req.prefix_id = prefix_id
+        self._queue.append(req)
         return rid
 
     def step(self) -> List[Tuple[int, List[int], bool]]:
         """Admit queued requests into free slots, run one decode chunk
         (``steps_per_call`` tokens). Returns ``(rid, new_tokens,
         finished)`` per active request."""
-        # Batched admission: all same-bucket arrivals prefill in ONE call
-        # (a per-call dispatch costs more than the prefill compute for
-        # short prompts; grouping cuts admission dispatches ~max_slots×).
-        by_bucket: Dict[int, List[Request]] = {}
+        # Batched admission: all same-(bucket, prefix) arrivals prefill in
+        # ONE call (a per-call dispatch costs more than the prefill compute
+        # for short prompts; grouping cuts admission dispatches
+        # ~max_slots×).
+        by_key: Dict[tuple, List[Request]] = {}
         while self._free and self._queue:
             req = self._queue.pop(0)
             req.slot = self._free.pop(0)
-            by_bucket.setdefault(_bucket(len(req.prompt)), []).append(req)
-        for p_pad, group in by_bucket.items():
-            self._admit_group(group, p_pad)
+            key = (_bucket(len(req.prompt)), req.prefix_id)
+            by_key.setdefault(key, []).append(req)
+        for (p_pad, prefix_id), group in by_key.items():
+            self._admit_group(group, p_pad, prefix_id)
         if not self._slots:
             return []
         return self._decode_chunk()
@@ -160,6 +182,26 @@ class RollingGenerator:
             for rid, toks, done in self.step():
                 out.setdefault(rid, []).extend(toks)
         return out
+
+    def register_prefix(self, tokens) -> int:
+        """Prefill a shared prefix (system prompt) ONCE; later submissions
+        pass ``prefix_id`` and only their suffix is prefetched — the
+        prefix's KV rows are copied into the slot at admission. vLLM's
+        prefix-caching idea at slot granularity (static shapes: the prefix
+        KV block is [L, 1, p_pad, Hkv, D])."""
+        tokens = list(tokens)
+        p_pad = _bucket(len(tokens))
+        toks = np.zeros((1, p_pad), np.int32)
+        toks[0, :len(tokens)] = tokens
+        with self._mesh_ctx():
+            k, v, logits = self._prefix_fill(
+                self.params, jnp.asarray(toks),
+                jnp.int32(len(tokens)), p_pad=p_pad)
+        pid = len(self._prefixes)
+        self._prefixes[pid] = {
+            "k": k, "v": v, "len": len(tokens), "logits": logits,
+        }
+        return pid
 
     def warmup(self, prompt_buckets=(16, 64, 128)) -> None:
         """Compile the serving shapes up front: the decode chunk plus both
@@ -174,10 +216,11 @@ class RollingGenerator:
                 self.run()
 
     # ----------------------------------------------------------- interns
-    def _admit_group(self, group: List[Request], p_pad: int):
-        """Prefill N same-bucket requests in one call. N pads to a power
-        of two (dummy rows target slot ``max_slots`` and drop in the
-        scatter) so compile count stays O(buckets × log slots)."""
+    def _admit_group(self, group: List[Request], p_pad: int,
+                     prefix_id: Optional[int] = None):
+        """Prefill N same-(bucket, prefix) requests in one call. N pads
+        to one of two widths (dummy rows target slot ``max_slots`` and
+        drop in the splice) so compile count stays O(buckets)."""
         n = len(group)
         # two admission shapes only (single vs full-width) — prefill FLOPs
         # on dummy rows are cheap; compiles are not
@@ -192,11 +235,20 @@ class RollingGenerator:
             self._temps[req.slot] = req.temperature
             self._slots[req.slot] = req
         with self._mesh_ctx():
-            (self.cache, self._logits, self._dpos,
-             self._dactive) = self._prefill(
-                self.params, self.cache, self._logits, self._dpos,
-                self._dactive, jnp.asarray(toks), jnp.asarray(lens),
-                jnp.asarray(slots), p_pad=p_pad)
+            if prefix_id is None:
+                (self.cache, self._logits, self._dpos,
+                 self._dactive) = self._prefill(
+                    self.params, self.cache, self._logits, self._dpos,
+                    self._dactive, jnp.asarray(toks), jnp.asarray(lens),
+                    jnp.asarray(slots), p_pad=p_pad)
+            else:
+                pfx = self._prefixes[prefix_id]
+                (self.cache, self._logits, self._dpos,
+                 self._dactive) = self._prefill_px(
+                    self.params, self.cache, self._logits, self._dpos,
+                    self._dactive, pfx["k"], pfx["v"],
+                    jnp.int32(pfx["len"]), jnp.asarray(toks),
+                    jnp.asarray(lens), jnp.asarray(slots), p_pad=p_pad)
 
     def _mesh_ctx(self):
         import contextlib
@@ -258,10 +310,19 @@ class RollingGenerator:
         own = llama.init_cache(cfg, N, M, dtype=cache["k"].dtype)
         out, own = llama.forward_cached(
             params, tokens, positions, own, 0, mask, cfg, rules)
-        # Splice own rows into the grid as gather + masked select, NOT a
-        # scatter: batched-axis scatters on the [L,B,M,Hkv,D] grid lower to
-        # a serialized generic scatter on TPU (measured ~7 s per admission
-        # on the 0.8B bench vs ~60 ms this way).
+        return RollingGenerator._finish_admit(
+            cache, own, out, logits, dpos, dactive, slots, prompt_lens,
+            prompt_lens - 1)
+
+    @staticmethod
+    def _finish_admit(cache, own, out, logits, dpos, dactive, slots,
+                      new_pos, last_t):
+        """Splice own-cache rows into the grid and update per-slot state.
+
+        Gather + masked select, NOT a scatter: batched-axis scatters on the
+        [L,B,M,Hkv,D] grid lower to a serialized generic scatter on TPU
+        (measured ~7 s per admission on the 0.8B bench vs ~60 ms this way).
+        """
         B = cache["k"].shape[1]
         onehot = slots[None, :] == jnp.arange(B)[:, None]       # [B, N]
         valid = onehot.any(axis=1)[None, :, None, None, None]
@@ -271,11 +332,54 @@ class RollingGenerator:
             "v": jnp.where(valid, own["v"][:, sel], cache["v"]),
         }
         last = jnp.take_along_axis(
-            out, (prompt_lens - 1)[:, None, None], axis=1)[:, 0]  # [N, V]
+            out, last_t[:, None, None], axis=1)[:, 0]           # [N, V]
         logits = logits.at[slots].set(last, mode="drop")
-        dpos = dpos.at[slots].set(prompt_lens, mode="drop")
+        dpos = dpos.at[slots].set(new_pos, mode="drop")
         dactive = dactive.at[slots].set(True, mode="drop")
         return cache, logits, dpos, dactive
+
+    @staticmethod
+    def _prefix_fill_impl(params, tokens, prefix_len, *, p_pad, cfg, rules):
+        """Forward a shared prefix once → its KV block + last logits."""
+        positions = jnp.arange(p_pad)[None, :]
+        m = jnp.arange(p_pad)[None, None, :]
+        mask = (m <= positions[:, :, None]) & (m < prefix_len)
+        own = llama.init_cache(cfg, 1, p_pad)
+        out, own = llama.forward_cached(
+            params, tokens, positions, own, 0, mask, cfg, rules)
+        return own["k"], own["v"], out[0, prefix_len - 1]
+
+    @staticmethod
+    def _prefill_px_impl(params, cache, logits, dpos, dactive, pk, pv,
+                         prefix_len, tokens, prompt_lens, slots, *,
+                         p_pad, cfg, rules):
+        """Prefill N suffixes on top of a shared, already-computed prefix:
+        the prefix KV block is broadcast into each slot's rows [0, Ppad)
+        and only the suffix runs through the model (vLLM prefix caching at
+        slot granularity). Suffix rows write at ``prefix_len``, so the
+        layout stays contiguous and any prefix-pad garbage lives beyond
+        every future ``pos`` — never attended."""
+        M = cache["k"].shape[2]
+        N = tokens.shape[0]
+        L, _, Ppad, Hkv, D = pk.shape
+        own = llama.init_cache(cfg, N, M, dtype=cache["k"].dtype)
+        own = {
+            "k": jax.lax.dynamic_update_slice(
+                own["k"], jnp.broadcast_to(pk, (L, N, Ppad, Hkv, D))
+                .astype(own["k"].dtype), (0, 0, 0, 0, 0)),
+            "v": jax.lax.dynamic_update_slice(
+                own["v"], jnp.broadcast_to(pv, (L, N, Ppad, Hkv, D))
+                .astype(own["v"].dtype), (0, 0, 0, 0, 0)),
+        }
+        positions = prefix_len + jnp.broadcast_to(
+            jnp.arange(p_pad)[None, :], (N, p_pad))
+        m = jnp.arange(M)[None, None, :]
+        mask = m <= positions[:, :, None]
+        out, own = llama.forward_cached(
+            params, tokens, positions, own, prefix_len, mask, cfg, rules)
+        return RollingGenerator._finish_admit(
+            cache, own, out, logits, dpos, dactive, slots,
+            prefix_len + prompt_lens, prompt_lens - 1)
 
     @staticmethod
     def _decode_impl(params, cache, last_logits, pos, active, temps, key, *,
@@ -331,7 +435,7 @@ class RollingService:
         self._driver.start()
 
     def generate(self, prompt, max_new_tokens: int = 128,
-                 temperature: float = 0.0,
+                 temperature: float = 0.0, prefix_id: Optional[int] = None,
                  timeout: Optional[float] = None) -> List[int]:
         """Submit and block until this request finishes; other callers'
         requests decode in the same chunks meanwhile."""
@@ -340,7 +444,8 @@ class RollingService:
         deadline = None if timeout is None else _time.time() + timeout
         with self._wake:
             rid = self.engine.submit(prompt, max_new_tokens=max_new_tokens,
-                                     temperature=temperature)
+                                     temperature=temperature,
+                                     prefix_id=prefix_id)
             self._results[rid] = []
             self._done[rid] = False
             self._wake.notify_all()

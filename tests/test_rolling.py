@@ -167,6 +167,34 @@ def test_rolling_under_tp_mesh(model):
 
 
 @pytest.mark.level("minimal")
+def test_prefix_caching_matches_full_prompt(model):
+    """register_prefix + suffix submits must produce the same greedy
+    tokens as isolated generation over the concatenated prompt — including
+    the prefix-pad garbage edge (prefix 5 pads to 16; 1-token suffix)."""
+    params, cfg = model
+    gen = Generator(params, cfg)
+    prefix = [11, 12, 13, 14, 15]           # pads to bucket 16 → garbage gap
+    suffixes = [[21, 22, 23], [31], [41, 42, 43, 44, 45, 46, 47]]
+    iso = [gen.generate([prefix + s], max_new_tokens=8,
+                        temperature=0.0)[0] for s in suffixes]
+
+    eng = RollingGenerator(params, cfg, max_slots=4)
+    pid = eng.register_prefix(prefix)
+    rids = [eng.submit(s, max_new_tokens=8, prefix_id=pid)
+            for s in suffixes]
+    out = eng.run()
+    for rid, expect in zip(rids, iso):
+        assert out[rid] == expect, (rid, out[rid], expect)
+    # mixed traffic: un-prefixed requests still work alongside
+    plain = eng.submit([1, 2, 3], max_new_tokens=4)
+    mixed = eng.submit(suffixes[0], max_new_tokens=4, prefix_id=pid)
+    out2 = eng.run()
+    assert out2[plain] == gen.generate([[1, 2, 3]], max_new_tokens=4,
+                                       temperature=0.0)[0]
+    assert out2[mixed] == iso[0][:4]
+
+
+@pytest.mark.level("minimal")
 def test_prefill_bucket_compile_stability(model):
     """Prompts in the same bucket reuse one prefill compile."""
     params, cfg = model
