@@ -1,0 +1,23 @@
+import json, sys
+import jax, optax, numpy as np
+from kubetorch_tpu.models import LlamaConfig
+from kubetorch_tpu.parallel import MeshSpec
+from kubetorch_tpu.training import Trainer
+
+policy = sys.argv[1]
+cfg = LlamaConfig(vocab_size=32768, embed_dim=2048, n_layers=12, n_heads=16,
+                  n_kv_heads=8, head_dim=128, mlp_dim=8192, tie_embeddings=True,
+                  remat=True, remat_policy=policy, dtype="bfloat16",
+                  param_dtype="bfloat16")
+mesh = MeshSpec(fsdp=-1).build()
+trainer = Trainer(cfg, mesh, optimizer=optax.adamw(1e-4))
+rng = np.random.default_rng(0)
+toks = rng.integers(0, cfg.vocab_size, (4, 2049))
+data = {"inputs": jax.numpy.asarray(toks[:, :-1], jax.numpy.int32),
+        "targets": jax.numpy.asarray(toks[:, 1:], jax.numpy.int32)}
+try:
+    r = trainer.benchmark(data, n_steps=10, warmup=2)
+    print(json.dumps({"policy": policy,
+                      "tok_s": round(r["tokens_per_sec"], 1)}))
+except Exception as e:
+    print(json.dumps({"policy": policy, "error": str(e)[:120]}))

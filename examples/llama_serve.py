@@ -76,6 +76,16 @@ class LlamaServer:
             prompts, max_new_tokens=max_new_tokens, temperature=temperature,
             top_p=top_p, eos_id=eos_id, seed=seed)
 
+    def generate_tokens(self, prompt, max_new_tokens: int = 32,
+                        temperature: float = 0.8):
+        """Token-streaming generation: a generator result streams to the
+        client chunk by chunk (`server.generate_tokens.stream(...)`) while
+        riding the shared rolling batch."""
+        if self.service is None:
+            raise RuntimeError("rolling service disabled (rolling=False)")
+        yield from self.service.generate_iter(
+            prompt, max_new_tokens=max_new_tokens, temperature=temperature)
+
     def score(self, tokens):
         """Per-sequence mean log-likelihood of the given token lists.
 
@@ -136,11 +146,15 @@ def main():
         try:
             rollouts = remote.generate([[3, 1, 4], [1, 5]],
                                        max_new_tokens=6, temperature=0.0)
+            # token streaming: the generator method arrives chunk by chunk
+            streamed = list(remote.generate_tokens.stream(
+                [3, 1, 4], max_new_tokens=6, temperature=0.0))
             scores = remote.score([[3, 1, 4, 1, 5]])
             health = remote.healthz()
             print(json.dumps({
                 "example": "llama_serve",
                 "rollouts": rollouts,
+                "streamed": streamed,
                 "scores": [round(s, 4) for s in scores],
                 "model_params": health["model_params"],
             }))

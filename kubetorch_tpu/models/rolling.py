@@ -430,6 +430,7 @@ class RollingService:
         self._wake = threading.Condition(self._lock)
         self._results: Dict[int, List[int]] = {}
         self._done: Dict[int, bool] = {}
+        self._live: Dict[int, Any] = {}  # rid -> token queue (generate_iter)
         self._driver = threading.Thread(
             target=self._drive, name="kt-rolling-driver", daemon=True)
         self._driver.start()
@@ -457,6 +458,26 @@ class RollingService:
             self._done.pop(rid)
             return self._results.pop(rid)
 
+    def generate_iter(self, prompt, max_new_tokens: int = 128,
+                      temperature: float = 0.0,
+                      prefix_id: Optional[int] = None):
+        """Yield tokens as decode chunks land — compose with the call
+        path's result streaming for end-to-end token streaming."""
+        import queue as _queue
+
+        live: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        with self._wake:
+            rid = self.engine.submit(prompt, max_new_tokens=max_new_tokens,
+                                     temperature=temperature,
+                                     prefix_id=prefix_id)
+            self._live[rid] = live
+            self._wake.notify_all()
+        while True:
+            item = live.get()
+            if item is None:
+                return
+            yield item
+
     def _drive(self):
         while True:
             with self._wake:
@@ -464,6 +485,14 @@ class RollingService:
                     self._wake.wait()
                 events = self.engine.step()
                 for rid, toks, done in events:
+                    live = self._live.get(rid)
+                    if live is not None:
+                        for tok in toks:
+                            live.put(tok)
+                        if done:
+                            live.put(None)
+                            del self._live[rid]
+                        continue
                     self._results.setdefault(rid, []).extend(toks)
                     if done:
                         self._done[rid] = True

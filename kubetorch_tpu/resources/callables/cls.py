@@ -26,6 +26,13 @@ class _MethodProxy:
             serialization=serialization, timeout=timeout,
             stream_logs=stream_logs)
 
+    def stream(self, *args, serialization: Optional[str] = None,
+               timeout: Optional[float] = None, **kwargs):
+        """Iterate a generator-returning remote method as items arrive."""
+        return self._owner._call_remote(
+            method=self._method, args=args, kwargs=kwargs,
+            serialization=serialization, timeout=timeout, stream=True)
+
     async def acall(self, *args, serialization: Optional[str] = None,
                     timeout: Optional[float] = None, **kwargs) -> Any:
         return await self._owner._call_remote_async(

@@ -25,6 +25,15 @@ class Fn(Module):
             timeout=timeout, workers=workers, restart_procs=restart_procs,
             stream_logs=stream_logs)
 
+    def stream(self, *args: Any, serialization: Optional[str] = None,
+               timeout: Optional[float] = None, **kwargs: Any):
+        """Iterate a generator-returning remote fn as items are produced
+        (framed chunked response). A plain ``__call__`` on the same fn
+        returns the collected list instead."""
+        return self._call_remote(
+            args=args, kwargs=kwargs, serialization=serialization,
+            timeout=timeout, stream=True)
+
     async def acall(self, *args: Any, serialization: Optional[str] = None,
                     timeout: Optional[float] = None, **kwargs: Any) -> Any:
         return await self._call_remote_async(

@@ -276,6 +276,7 @@ class Module:
         serialization: Optional[str] = None,
         timeout: Optional[float] = None,
         stream_logs: Optional[bool] = None,
+        stream: bool = False,
         **query: Any,
     ) -> Any:
         cfg = get_config()
@@ -294,6 +295,7 @@ class Module:
                 allowed=allowed,
                 timeout=timeout,
                 query={k: str(v).lower() for k, v in query.items() if v},
+                stream=stream,
             )
         finally:
             if streamer is not None:

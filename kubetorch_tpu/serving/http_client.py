@@ -79,17 +79,69 @@ def call_method(
     allowed: Iterable[str] = serialization.METHODS,
     timeout: Optional[float] = None,
     query: Optional[dict] = None,
+    stream: bool = False,
 ) -> Any:
     """POST /{callable}[/{method}] and return the deserialized result
-    (or raise the rehydrated remote exception)."""
+    (or raise the rehydrated remote exception).
+
+    ``stream=True``: ask the server to frame a generator result as it is
+    produced; returns an iterator of items. (A non-generator result still
+    arrives as a single item.) Without it, generator results arrive as one
+    list."""
     body, headers = _prepare(args, kwargs or {}, ser, allowed)
     url = f"{base_url.rstrip('/')}/{callable_name}"
     if method:
         url += f"/{method}"
+    if stream:
+        headers = {**headers, "X-KT-Stream": "request"}
+        return _stream_call(url, body, headers, query, timeout)
     resp = sync_client().post(
         url, content=body, headers=headers, params=query or {},
         timeout=timeout if timeout is not None else _TIMEOUT)
     return _handle(resp)
+
+
+def _stream_call(url, body, headers, query, timeout):
+    """Generator over framed stream items (see server _respond_stream)."""
+    import json as _json
+
+    with sync_client().stream(
+            "POST", url, content=body, headers=headers, params=query or {},
+            timeout=timeout if timeout is not None else _TIMEOUT) as resp:
+        if (resp.status_code >= 400
+                or resp.headers.get("X-KT-Stream") != "1"):
+            # server answered plainly (non-generator result, or an error):
+            # surface it as a one-item stream / raised exception
+            resp.read()
+            yield _handle(resp)
+            return
+        used = resp.headers.get(serialization.HEADER,
+                                serialization.DEFAULT)
+        buf = b""
+        itr = resp.iter_bytes()
+
+        def take(n: int) -> bytes:
+            nonlocal buf
+            while len(buf) < n:
+                try:
+                    buf += next(itr)
+                except StopIteration:
+                    raise RuntimeError(
+                        "result stream truncated mid-frame") from None
+            out, rest = buf[:n], buf[n:]
+            buf = rest
+            return out
+
+        while True:
+            kind = take(1)
+            size = int.from_bytes(take(8), "little")
+            payload = take(size) if size else b""
+            if kind == b"D":
+                yield serialization.loads(payload, used)["result"]
+            elif kind == b"E":
+                raise rehydrate_exception(_json.loads(payload))
+            else:  # b"Z"
+                return
 
 
 async def call_method_async(

@@ -8,6 +8,7 @@ every local rank with rank-specific env.
 from __future__ import annotations
 
 import itertools
+import queue
 import threading
 import uuid
 from concurrent.futures import Future
@@ -20,6 +21,31 @@ from kubetorch_tpu.serving.process_worker import (
 )
 
 
+class StreamResult:
+    """Ordered mid-stream items of one request.
+
+    Iterating yields chunk dicts ({payload, serialization, seq}) as the
+    worker produces them; iteration ends at the terminal response, which is
+    then available as ``.terminal`` (ok/stream_end, or a packaged error —
+    callers must check it)."""
+
+    def __init__(self, chan: "queue.SimpleQueue", first: dict,
+                 timeout: Optional[float]):
+        self._chan = chan
+        self._first = first
+        self._timeout = timeout
+        self.terminal: Optional[dict] = None
+
+    def __iter__(self):
+        item = self._first
+        while True:
+            if not item.get("stream"):
+                self.terminal = item
+                return
+            yield item
+            item = self._chan.get(timeout=self._timeout)
+
+
 class ProcessPool:
     def __init__(self, num_procs: int = 1,
                  base_env: Optional[Dict[str, str]] = None):
@@ -27,6 +53,8 @@ class ProcessPool:
         self.base_env = dict(base_env or {})
         self.workers: List[ProcessWorker] = []
         self._futures: Dict[str, Future] = {}
+        self._streams: Dict[str, "queue.SimpleQueue"] = {}
+        self._collect: Dict[str, list] = {}
         self._futures_lock = threading.Lock()
         self._routers: List[threading.Thread] = []
         self._round_robin = itertools.count()
@@ -48,6 +76,8 @@ class ProcessPool:
         self._started = True
 
     def _route(self, worker: ProcessWorker):
+        from kubetorch_tpu import serialization
+
         while True:
             try:
                 resp = worker.response_q.get()
@@ -55,17 +85,57 @@ class ProcessPool:
                 break
             if resp is None:
                 break
+            req_id = resp.get("req_id")
+            if resp.get("stream"):
+                # mid-stream item: live consumers get it on their channel;
+                # collect-mode requests (distributed fan-out — per-rank
+                # results must land in one future) buffer it for the merge.
+                with self._futures_lock:
+                    buf = self._collect.get(req_id)
+                    chan = None if buf is not None else \
+                        self._streams.get(req_id)
+                if buf is not None:
+                    buf.append(resp)
+                elif chan is not None:
+                    chan.put(resp)
+                continue
             with self._futures_lock:
-                fut = self._futures.pop(resp.get("req_id"), None)
+                fut = self._futures.pop(req_id, None)
+                chan = self._streams.pop(req_id, None)
+                buf = self._collect.pop(req_id, None)
+            if buf is not None and resp.get("stream_end"):
+                # merge buffered chunks into one list-valued payload
+                try:
+                    items = [serialization.loads(
+                        c["payload"], c["serialization"])["result"]
+                        for c in buf]
+                    ser = (buf[0]["serialization"] if buf
+                           else serialization.DEFAULT)
+                    payload, used = serialization.choose(
+                        {"result": items}, ser, serialization.METHODS)
+                    resp = {**resp, "payload": payload,
+                            "serialization": used}
+                except Exception as exc:  # noqa: BLE001
+                    from kubetorch_tpu.exceptions import package_exception
+
+                    resp = {"req_id": req_id, "ok": False,
+                            "error": package_exception(exc)["error"]}
+            if chan is not None:
+                chan.put(resp)  # terminal also closes the stream channel
             if fut is not None and not fut.done():
                 fut.set_result(resp)
 
-    def _submit(self, worker: ProcessWorker, req: dict) -> Future:
+    def _submit(self, worker: ProcessWorker, req: dict, collect: bool = False):
         fut: Future = Future()
+        chan: "queue.SimpleQueue" = queue.SimpleQueue()
         with self._futures_lock:
             self._futures[req["req_id"]] = fut
+            if collect:
+                self._collect[req["req_id"]] = []
+            else:
+                self._streams[req["req_id"]] = chan
         worker.send(req)
-        return fut
+        return fut, chan
 
     # ------------------------------------------------------------------
     def setup_all(
@@ -89,7 +159,7 @@ class ProcessPool:
                 "init_args": init_args,
                 "env": (env_per_rank or [{}] * len(self.workers))[i],
             }
-            futures.append(self._submit(worker, req))
+            futures.append(self._submit(worker, req)[0])
         for fut in futures:
             resp = fut.result(timeout)
             if not resp["ok"]:
@@ -117,7 +187,11 @@ class ProcessPool:
             "allowed": list(allowed or ("json", "pickle")),
             "env": env or {},
         }
-        return self._submit(worker, req).result(timeout)
+        fut, chan = self._submit(worker, req)
+        first = chan.get(timeout=timeout)
+        if not first.get("stream"):
+            return first
+        return {"ok": True, "stream": StreamResult(chan, first, timeout)}
 
     def profile(self, action: str, directory: str = "",
                 local_rank: int = 0, timeout: float = 300.0) -> dict:
@@ -130,7 +204,7 @@ class ProcessPool:
         worker = self.workers[local_rank]
         req = {"kind": PROFILE, "req_id": uuid.uuid4().hex,
                "action": action, "dir": directory}
-        resp = self._submit(worker, req).result(timeout)
+        resp = self._submit(worker, req)[0].result(timeout)
         if not resp.get("ok"):
             from kubetorch_tpu.exceptions import rehydrate_exception
 
@@ -155,7 +229,10 @@ class ProcessPool:
                 "allowed": list(allowed or ("json", "pickle")),
                 "env": (env_per_rank or [{}] * len(self.workers))[i],
             }
-            futures.append(self._submit(worker, req))
+            # collect: a streamed (generator) result merges into one
+            # list-valued payload so the distributed fan-out's per-rank
+            # futures stay single-response.
+            futures.append(self._submit(worker, req, collect=True)[0])
         return futures
 
     def call_all(

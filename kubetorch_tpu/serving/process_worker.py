@@ -221,6 +221,15 @@ class _WorkerLoop:
                     result = await asyncio.get_running_loop().run_in_executor(
                         self.executor,
                         lambda: ctx.run(fn, *args, **kwargs))
+                if inspect.isgenerator(result) or inspect.isasyncgen(result):
+                    # Stream: push one response per yielded item (the pool
+                    # routes them to the caller as they land), then a
+                    # terminal marker. The generator body runs here, still
+                    # under this request's id/env.
+                    await self._stream_result(req, result)
+                    return {"req_id": req_id, "ok": True,
+                            "stream_end": True,
+                            "device_stats": _maybe_device_stats()}
             finally:
                 request_id_var.reset(rid_token)
             payload, used = serialization.choose(
@@ -234,6 +243,32 @@ class _WorkerLoop:
                 raise
             return {"req_id": req_id, "ok": False,
                     "error": package_exception(exc)["error"]}
+
+    async def _stream_result(self, req: dict, gen):
+        """Drain a (sync or async) generator result, pushing each item as
+        its own response message (``stream: True``, ordered ``seq``)."""
+        req_id = req["req_id"]
+        ser = req["serialization"]
+        allowed = req.get("allowed", serialization.METHODS)
+
+        def _chunk(item, seq):
+            payload, used = serialization.choose(
+                {"result": item}, ser, allowed)
+            return {"req_id": req_id, "ok": True, "stream": True,
+                    "seq": seq, "payload": payload, "serialization": used}
+
+        if inspect.isasyncgen(gen):
+            seq = 0
+            async for item in gen:
+                self.response_q.put(_chunk(item, seq))
+                seq += 1
+        else:
+            def _pump():
+                for seq, item in enumerate(gen):
+                    self.response_q.put(_chunk(item, seq))
+
+            await asyncio.get_running_loop().run_in_executor(
+                self.executor, _pump)
 
     async def run(self):
         loop = asyncio.get_running_loop()

@@ -412,6 +412,28 @@ class PodServer:
                 RuntimeError("worker returned no response")), status=500)
         if not resp.get("ok"):
             return web.json_response({"error": resp["error"]}, status=500)
+        if "stream" in resp:
+            if request.headers.get("X-KT-Stream") == "request":
+                return await self._respond_stream(request, resp["stream"],
+                                                  ser)
+            # plain caller: drain the generator into one list result
+            items, used = [], ser
+            it = iter(resp["stream"])
+            while True:
+                chunk = await asyncio.get_running_loop().run_in_executor(
+                    None, next, it, None)
+                if chunk is None:
+                    break
+                items.append(serialization.loads(
+                    chunk["payload"], chunk["serialization"])["result"])
+                used = chunk["serialization"]
+            terminal = resp["stream"].terminal or {}
+            if not terminal.get("ok"):
+                return web.json_response({"error": terminal["error"]},
+                                         status=500)
+            payload, used = serialization.choose(
+                {"result": items}, used, self.supervisor.allowed)
+            resp = {**terminal, "payload": payload, "serialization": used}
         stats = resp.pop("device_stats", None)
         if stats:
             # workers attach accelerator memory stats to responses; the
@@ -423,6 +445,41 @@ class PodServer:
             content_type=("application/json" if used == "json"
                           else "application/octet-stream"),
             headers={serialization.HEADER: used})
+
+    async def _respond_stream(self, request, stream, default_ser):
+        """Chunked frame response for generator results: each frame is
+        1-byte type ('D' data / 'E' error / 'Z' end) + 8-byte LE length +
+        body. One frame per yielded item, written as produced — the remote
+        analogue of iterating the generator locally."""
+        loop = asyncio.get_running_loop()
+        it = iter(stream)
+        first = await loop.run_in_executor(None, next, it, None)
+        used = (first or {}).get("serialization", default_ser)
+        response = web.StreamResponse(headers={
+            "X-KT-Stream": "1",
+            serialization.HEADER: used,
+            "Content-Type": "application/octet-stream",
+        })
+        await response.prepare(request)
+
+        def frame(kind: bytes, body: bytes = b"") -> bytes:
+            return kind + len(body).to_bytes(8, "little") + body
+
+        chunk = first
+        while chunk is not None:
+            await response.write(frame(b"D", chunk["payload"]))
+            chunk = await loop.run_in_executor(None, next, it, None)
+        terminal = stream.terminal or {}
+        if not terminal.get("ok"):
+            await response.write(frame(
+                b"E", json.dumps({"error": terminal["error"]}).encode()))
+        else:
+            stats = terminal.get("device_stats")
+            if stats:
+                self.metrics.update(stats)
+            await response.write(frame(b"Z"))
+        await response.write_eof()
+        return response
 
 
 def main():

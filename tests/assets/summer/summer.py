@@ -68,6 +68,30 @@ def jax_touch():
     return float(jax.numpy.zeros(2).sum())
 
 
+def count_stream(n, delay=0.0):
+    """Generator result — streamed to the caller item by item."""
+    import time
+
+    for i in range(n):
+        if delay:
+            time.sleep(delay)
+        yield {"i": i, "sq": i * i}
+
+
+async def count_stream_async(n):
+    import asyncio
+
+    for i in range(n):
+        await asyncio.sleep(0.01)
+        yield i * 10
+
+
+def broken_stream(n):
+    for i in range(n):
+        yield i
+    raise ValueError("stream blew up")
+
+
 def jax_allgather():
     """Real multi-process jax.distributed collective: each worker
     initializes from the env contract JaxProcess injects, then allgathers

@@ -45,6 +45,8 @@ def test_llama_serve_smoke(tmp_path):
     result = _run_smoke("llama_serve.py", tmp_path)
     assert len(result["rollouts"]) == 2
     assert all(len(r) == 6 for r in result["rollouts"])
+    # token streaming rode the rolling batch; greedy == batch result
+    assert result["streamed"] == result["rollouts"][0]
     assert result["scores"][0] < 0          # a log-likelihood
     assert result["model_params"] > 0
 
