@@ -20,6 +20,16 @@ DEFAULT = "json"
 METHODS = ("json", "pickle")
 
 
+def method_code(method: str) -> bytes:
+    """1-byte wire code for a method (stream frames carry serialization
+    per item — the worker may fall back to pickle mid-stream)."""
+    return bytes([METHODS.index(method)])
+
+
+def method_from_code(code: int) -> str:
+    return METHODS[code]
+
+
 class SerializationError(TypeError):
     pass
 

@@ -115,8 +115,6 @@ def _stream_call(url, body, headers, query, timeout):
             resp.read()
             yield _handle(resp)
             return
-        used = resp.headers.get(serialization.HEADER,
-                                serialization.DEFAULT)
         buf = b""
         itr = resp.iter_bytes()
 
@@ -137,7 +135,9 @@ def _stream_call(url, body, headers, query, timeout):
             size = int.from_bytes(take(8), "little")
             payload = take(size) if size else b""
             if kind == b"D":
-                yield serialization.loads(payload, used)["result"]
+                # first body byte: per-item serialization method
+                used = serialization.method_from_code(payload[0])
+                yield serialization.loads(payload[1:], used)["result"]
             elif kind == b"E":
                 raise rehydrate_exception(_json.loads(payload))
             else:  # b"Z"

@@ -30,10 +30,11 @@ class StreamResult:
     callers must check it)."""
 
     def __init__(self, chan: "queue.SimpleQueue", first: dict,
-                 timeout: Optional[float]):
+                 timeout: Optional[float], canceller=None):
         self._chan = chan
         self._first = first
         self._timeout = timeout
+        self._canceller = canceller
         self.terminal: Optional[dict] = None
 
     def __iter__(self):
@@ -44,6 +45,13 @@ class StreamResult:
                 return
             yield item
             item = self._chan.get(timeout=self._timeout)
+
+    def cancel(self):
+        """Abandon the stream: tell the worker to close the generator
+        (client disconnected). The terminal response still arrives and
+        cleans up the channel."""
+        if self._canceller is not None:
+            self._canceller()
 
 
 class ProcessPool:
@@ -191,7 +199,15 @@ class ProcessPool:
         first = chan.get(timeout=timeout)
         if not first.get("stream"):
             return first
-        return {"ok": True, "stream": StreamResult(chan, first, timeout)}
+
+        from kubetorch_tpu.serving.process_worker import CANCEL
+
+        def _cancel(w=worker, rid=req["req_id"]):
+            w.send({"kind": CANCEL, "req_id": f"{CANCEL}-{rid}",
+                    "target": rid})
+
+        return {"ok": True,
+                "stream": StreamResult(chan, first, timeout, _cancel)}
 
     def profile(self, action: str, directory: str = "",
                 local_rank: int = 0, timeout: float = 300.0) -> dict:
@@ -264,6 +280,8 @@ class ProcessPool:
         spmd_supervisor.py:267)."""
         self.stop()
         self._futures.clear()
+        self._streams.clear()
+        self._collect.clear()
         self.start(per_rank_env)
 
     @property

@@ -33,6 +33,7 @@ _CTX = mp.get_context("spawn")
 SETUP = "__setup__"
 SHUTDOWN = "__shutdown__"
 PROFILE = "__profile__"
+CANCEL = "__cancel__"
 
 
 def get_distributed_env_vars(
@@ -111,6 +112,8 @@ class _WorkerLoop:
         self.callable_type = "fn"
         self.executor = ThreadPoolExecutor(
             max_workers=int(os.environ.get("KT_WORKER_THREADS", "8")))
+        # req_ids whose streams the client abandoned (see _stream_result)
+        self._cancelled: set = set()
 
     def _resolve_method(self, method_name: Optional[str]):
         if self.callable_type == "cls" and method_name:
@@ -246,7 +249,9 @@ class _WorkerLoop:
 
     async def _stream_result(self, req: dict, gen):
         """Drain a (sync or async) generator result, pushing each item as
-        its own response message (``stream: True``, ordered ``seq``)."""
+        its own response message (``stream: True``, ordered ``seq``). A
+        ``cancel`` control message (client disconnected) closes the
+        generator between items so it doesn't hold an executor thread."""
         req_id = req["req_id"]
         ser = req["serialization"]
         allowed = req.get("allowed", serialization.METHODS)
@@ -260,15 +265,28 @@ class _WorkerLoop:
         if inspect.isasyncgen(gen):
             seq = 0
             async for item in gen:
+                if req_id in self._cancelled:
+                    await gen.aclose()
+                    break
                 self.response_q.put(_chunk(item, seq))
                 seq += 1
         else:
             def _pump():
-                for seq, item in enumerate(gen):
-                    self.response_q.put(_chunk(item, seq))
+                try:
+                    for seq, item in enumerate(gen):
+                        if req_id in self._cancelled:
+                            break
+                        self.response_q.put(_chunk(item, seq))
+                finally:
+                    gen.close()
 
+            # copy_context: the generator body logs under this request's id
+            import contextvars as _cv
+
+            ctx = _cv.copy_context()
             await asyncio.get_running_loop().run_in_executor(
-                self.executor, _pump)
+                self.executor, lambda: ctx.run(_pump))
+        self._cancelled.discard(req_id)
 
     async def run(self):
         loop = asyncio.get_running_loop()
@@ -276,6 +294,9 @@ class _WorkerLoop:
             req = await loop.run_in_executor(None, self.request_q.get)
             if req is None or req.get("kind") == SHUTDOWN:
                 break
+            if req.get("kind") == CANCEL:
+                self._cancelled.add(req.get("target"))
+                continue
             # Execute concurrently so async user code overlaps.
             task = asyncio.ensure_future(self._execute(req))
             task.add_done_callback(

@@ -416,14 +416,13 @@ class PodServer:
             if request.headers.get("X-KT-Stream") == "request":
                 return await self._respond_stream(request, resp["stream"],
                                                   ser)
-            # plain caller: drain the generator into one list result
+            # plain caller: drain the generator into one list result (one
+            # executor handoff for the whole drain — no progressive
+            # delivery is needed here)
+            chunks = await asyncio.get_running_loop().run_in_executor(
+                None, list, iter(resp["stream"]))
             items, used = [], ser
-            it = iter(resp["stream"])
-            while True:
-                chunk = await asyncio.get_running_loop().run_in_executor(
-                    None, next, it, None)
-                if chunk is None:
-                    break
+            for chunk in chunks:
                 items.append(serialization.loads(
                     chunk["payload"], chunk["serialization"])["result"])
                 used = chunk["serialization"]
@@ -449,15 +448,16 @@ class PodServer:
     async def _respond_stream(self, request, stream, default_ser):
         """Chunked frame response for generator results: each frame is
         1-byte type ('D' data / 'E' error / 'Z' end) + 8-byte LE length +
-        body. One frame per yielded item, written as produced — the remote
-        analogue of iterating the generator locally."""
+        body; a 'D' body leads with one serialization-method byte (the
+        worker may pick json or pickle per item). One frame per yielded
+        item, written as produced — the remote analogue of iterating the
+        generator locally. A client disconnect cancels the worker-side
+        generator so it doesn't hold an executor thread forever."""
         loop = asyncio.get_running_loop()
         it = iter(stream)
-        first = await loop.run_in_executor(None, next, it, None)
-        used = (first or {}).get("serialization", default_ser)
         response = web.StreamResponse(headers={
             "X-KT-Stream": "1",
-            serialization.HEADER: used,
+            serialization.HEADER: default_ser,
             "Content-Type": "application/octet-stream",
         })
         await response.prepare(request)
@@ -465,10 +465,19 @@ class PodServer:
         def frame(kind: bytes, body: bytes = b"") -> bytes:
             return kind + len(body).to_bytes(8, "little") + body
 
-        chunk = first
-        while chunk is not None:
-            await response.write(frame(b"D", chunk["payload"]))
-            chunk = await loop.run_in_executor(None, next, it, None)
+        try:
+            while True:
+                chunk = await loop.run_in_executor(None, next, it, None)
+                if chunk is None:
+                    break
+                ser_code = serialization.method_code(chunk["serialization"])
+                await response.write(frame(b"D",
+                                           ser_code + chunk["payload"]))
+        except (ConnectionResetError, asyncio.CancelledError):
+            cancel = getattr(stream, "cancel", None)
+            if cancel is not None:
+                cancel()
+            raise
         terminal = stream.terminal or {}
         if not terminal.get("ok"):
             await response.write(frame(

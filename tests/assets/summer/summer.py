@@ -92,6 +92,13 @@ def broken_stream(n):
     raise ValueError("stream blew up")
 
 
+def mixed_stream():
+    """First item is JSON-able, second needs pickle — exercises per-frame
+    serialization."""
+    yield {"plain": 1}
+    yield {1, 2, 3}  # a set: not JSON-able, triggers per-item pickle
+
+
 def jax_allgather():
     """Real multi-process jax.distributed collective: each worker
     initializes from the env contract JaxProcess injects, then allgathers

@@ -93,6 +93,58 @@ def test_midstream_error_rehydrates(streamer):
 
 
 @pytest.mark.level("minimal")
+def test_abandoned_stream_frees_worker():
+    """Cancel mid-stream (the client-disconnect path): the worker closes
+    the generator, the terminal arrives, and the worker keeps serving."""
+    from kubetorch_tpu import serialization
+    from kubetorch_tpu.serving.process_pool import ProcessPool
+
+    pool = ProcessPool(num_procs=1)
+    pool.start()
+    try:
+        pool.setup_all(root_path=str(ASSETS), import_path="summer",
+                       name="count_stream")
+        body = serialization.dumps(
+            {"args": [10_000], "kwargs": {"delay": 0.01}}, "json")
+        resp = pool.call(body, "json", timeout=30)
+        stream = resp["stream"]
+        it = iter(stream)
+        assert next(it)["ok"]
+        assert next(it)["ok"]
+        stream.cancel()
+        # drain to the terminal — must arrive promptly, not after 10k items
+        t0 = time.perf_counter()
+        remaining = sum(1 for _ in it)
+        assert time.perf_counter() - t0 < 10
+        assert remaining < 1000
+        assert stream.terminal.get("ok")
+        # worker still serves
+        body2 = serialization.dumps({"args": [2], "kwargs": {}}, "json")
+        resp2 = pool.call(body2, "json", timeout=30)
+        items = [serialization.loads(c["payload"], c["serialization"])
+                 for c in resp2["stream"]]
+        assert len(items) == 2
+    finally:
+        pool.stop()
+
+
+@pytest.mark.level("minimal")
+def test_mixed_serialization_stream():
+    """A stream that flips json→pickle mid-way decodes per frame."""
+    remote = Fn(root_path=str(ASSETS), import_path="summer",
+                callable_name="mixed_stream", name="mixedstream")
+    remote.to(kt.Compute(cpus="0.1"))
+    try:
+        # request json: item 1 stays json, item 2 falls back to pickle —
+        # the per-frame serialization byte is what keeps this decodable
+        items = list(remote.stream(serialization="json"))
+        assert items[0] == {"plain": 1}
+        assert items[1] == {1, 2, 3} and isinstance(items[1], set)
+    finally:
+        remote.teardown()
+
+
+@pytest.mark.level("minimal")
 def test_distributed_generator_collects_per_rank():
     """SPMD fan-out: each rank's generator collects into a list, results
     aggregate per rank as usual."""
