@@ -53,7 +53,7 @@ def _bucket(n: int, lo: int = 16) -> int:
 
 class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature",
-                 "tokens", "done", "slot", "prefix_id")
+                 "tokens", "done", "slot", "prefix_id", "stop")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature):
         self.rid = rid
@@ -64,6 +64,20 @@ class Request:
         self.done = False
         self.slot: Optional[int] = None
         self.prefix_id: Optional[int] = None
+        self.stop: List[List[int]] = []
+
+    def match_stop(self) -> Optional[int]:
+        """Earliest index (exclusive) at which a stop sequence completes in
+        ``tokens``; None if no stop sequence has appeared."""
+        best = None
+        for seq in self.stop:
+            n = len(seq)
+            for end in range(n, len(self.tokens) + 1):
+                if self.tokens[end - n:end] == seq:
+                    if best is None or end < best:
+                        best = end
+                    break
+        return best
 
 
 class RollingGenerator:
@@ -134,7 +148,11 @@ class RollingGenerator:
 
     def submit(self, prompt, max_new_tokens: int = 128,
                temperature: float = 0.0,
-               prefix_id: Optional[int] = None) -> int:
+               prefix_id: Optional[int] = None,
+               stop: Optional[List[List[int]]] = None) -> int:
+        """``stop``: token sequences that terminate generation when they
+        appear (included in the output, like ``eos_id``). Checked host-side
+        per chunk — multi-token stop strings cost nothing on device."""
         prefix_len = 0
         if prefix_id is not None:
             if prefix_id not in self._prefixes:
@@ -152,6 +170,7 @@ class RollingGenerator:
         self._next_rid += 1
         req = Request(rid, prompt, max_new_tokens, temperature)
         req.prefix_id = prefix_id
+        req.stop = [list(s) for s in (stop or []) if s]
         self._queue.append(req)
         return rid
 
@@ -278,8 +297,17 @@ class RollingGenerator:
             new = new[:room]
             if self.eos_id is not None and self.eos_id in new:
                 new = new[: new.index(self.eos_id) + 1]
+            prev_len = len(req.tokens)
             req.tokens.extend(new)
-            done = (len(req.tokens) >= req.max_new_tokens
+            stopped = False
+            if req.stop:
+                cut = req.match_stop()
+                if cut is not None:
+                    req.tokens = req.tokens[:cut]
+                    new = req.tokens[prev_len:]
+                    stopped = True
+            done = (stopped
+                    or len(req.tokens) >= req.max_new_tokens
                     or (self.eos_id is not None
                         and bool(new) and new[-1] == self.eos_id))
             events.append((req.rid, new, done))
@@ -437,6 +465,7 @@ class RollingService:
 
     def generate(self, prompt, max_new_tokens: int = 128,
                  temperature: float = 0.0, prefix_id: Optional[int] = None,
+                 stop: Optional[List[List[int]]] = None,
                  timeout: Optional[float] = None) -> List[int]:
         """Submit and block until this request finishes; other callers'
         requests decode in the same chunks meanwhile."""
@@ -446,7 +475,7 @@ class RollingService:
         with self._wake:
             rid = self.engine.submit(prompt, max_new_tokens=max_new_tokens,
                                      temperature=temperature,
-                                     prefix_id=prefix_id)
+                                     prefix_id=prefix_id, stop=stop)
             self._results[rid] = []
             self._done[rid] = False
             self._wake.notify_all()
@@ -460,7 +489,8 @@ class RollingService:
 
     def generate_iter(self, prompt, max_new_tokens: int = 128,
                       temperature: float = 0.0,
-                      prefix_id: Optional[int] = None):
+                      prefix_id: Optional[int] = None,
+                      stop: Optional[List[List[int]]] = None):
         """Yield tokens as decode chunks land — compose with the call
         path's result streaming for end-to-end token streaming."""
         import queue as _queue
@@ -469,7 +499,7 @@ class RollingService:
         with self._wake:
             rid = self.engine.submit(prompt, max_new_tokens=max_new_tokens,
                                      temperature=temperature,
-                                     prefix_id=prefix_id)
+                                     prefix_id=prefix_id, stop=stop)
             self._live[rid] = live
             self._wake.notify_all()
         while True:

@@ -195,6 +195,37 @@ def test_prefix_caching_matches_full_prompt(model):
 
 
 @pytest.mark.level("minimal")
+def test_stop_sequences(model):
+    """Generation halts when a stop sequence appears, including stop
+    sequences that span a chunk boundary."""
+    params, cfg = model
+    gen = Generator(params, cfg)
+    prompt = [1, 2, 3]
+    free = gen.generate([prompt], max_new_tokens=20, temperature=0.0)[0]
+    assert len(free) == 20
+    # choose a stop seq from the greedy continuation spanning positions
+    # 5..7 — i.e. crossing the steps_per_call=6 chunk boundary
+    stop_seq = free[5:8]
+
+    def earliest_end(tokens, seq):
+        for end in range(len(seq), len(tokens) + 1):
+            if tokens[end - len(seq):end] == seq:
+                return end
+        return None
+
+    eng = RollingGenerator(params, cfg, max_slots=2, steps_per_call=6)
+    rid = eng.submit(prompt, max_new_tokens=20, stop=[stop_seq])
+    out = eng.run()[rid]
+    # cut right after the EARLIEST completion of the stop sequence (greedy
+    # continuations repeat tokens, so it may complete before position 8)
+    assert out == free[:earliest_end(free, stop_seq)]
+    # un-matched stop sequences don't interfere
+    rid2 = eng.submit(prompt, max_new_tokens=10, stop=[[99999 % cfg.vocab_size,
+                                                        1234 % cfg.vocab_size]])
+    assert eng.run()[rid2] == free[:10]
+
+
+@pytest.mark.level("minimal")
 def test_prefill_bucket_compile_stability(model):
     """Prompts in the same bucket reuse one prefill compile."""
     params, cfg = model
