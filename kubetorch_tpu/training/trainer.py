@@ -103,7 +103,9 @@ def make_train_step(
 
         x = llama.hidden_states(
             params, batch["inputs"], cfg, rules,
-            segment_ids=batch.get("segment_ids"), mesh=ring_mesh)
+            segment_ids=batch.get("segment_ids"),
+            positions=batch.get("positions"),  # packed rows: RoPE restarts
+            mesh=ring_mesh)
         return fused_cross_entropy(
             x, llama.unembedding(params, cfg), batch["targets"],
             batch.get("mask"))
