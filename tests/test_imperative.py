@@ -83,6 +83,25 @@ def test_boom_typed_exception():
 
 
 @pytest.mark.level("minimal")
+def test_xla_runtime_error_surfaces_typed():
+    """libtpu/XLA failures rewrap as XlaRuntimeSurfacedError with the
+    origin recorded (SURVEY §5.3 TPU mapping)."""
+    import kubetorch_tpu as kt
+    from kubetorch_tpu.exceptions import (
+        package_exception,
+        rehydrate_exception,
+    )
+
+    fake = type("XlaRuntimeError", (RuntimeError,),
+                {"__module__": "jax._src.lib.xla_client"})
+    payload = package_exception(fake("RESOURCE_EXHAUSTED: hbm oom"))
+    assert payload["error"]["type"] == "XlaRuntimeSurfacedError"
+    assert payload["error"]["extra"]["origin"].endswith("XlaRuntimeError")
+    exc = rehydrate_exception(payload)
+    assert isinstance(exc, kt.XlaRuntimeSurfacedError)
+    assert "RESOURCE_EXHAUSTED" in str(exc)
+
+
 def test_async_fn_and_acall():
     import asyncio
 
