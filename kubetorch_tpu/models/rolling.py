@@ -53,7 +53,8 @@ def _bucket(n: int, lo: int = 16) -> int:
 
 class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature",
-                 "tokens", "done", "slot", "prefix_id", "stop")
+                 "tokens", "done", "slot", "prefix_id", "stop",
+                 "repetition_penalty")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature):
         self.rid = rid
@@ -65,6 +66,7 @@ class Request:
         self.slot: Optional[int] = None
         self.prefix_id: Optional[int] = None
         self.stop: List[List[int]] = []
+        self.repetition_penalty: float = 1.0
 
     def match_stop(self) -> Optional[int]:
         """Earliest index (exclusive) at which a stop sequence completes in
@@ -120,6 +122,9 @@ class RollingGenerator:
         self._queue: List[Request] = []
         self._next_rid = 0
         self._temps = np.zeros(max_slots, np.float32)
+        self._penalties = np.ones(max_slots, np.float32)
+        # recent-token window per slot for repetition penalty (−1 = empty)
+        self._win = np.full((max_slots, 64), -1, np.int32)
         # prefix_id -> {k, v, len, logits} (device KV blocks, see
         # register_prefix)
         self._prefixes: Dict[int, dict] = {}
@@ -149,10 +154,13 @@ class RollingGenerator:
     def submit(self, prompt, max_new_tokens: int = 128,
                temperature: float = 0.0,
                prefix_id: Optional[int] = None,
-               stop: Optional[List[List[int]]] = None) -> int:
+               stop: Optional[List[List[int]]] = None,
+               repetition_penalty: float = 1.0) -> int:
         """``stop``: token sequences that terminate generation when they
         appear (included in the output, like ``eos_id``). Checked host-side
-        per chunk — multi-token stop strings cost nothing on device."""
+        per chunk — multi-token stop strings cost nothing on device.
+        ``repetition_penalty`` > 1 discounts tokens seen in the last 64
+        positions (HF semantics), applied on device inside the scan."""
         prefix_len = 0
         if prefix_id is not None:
             if prefix_id not in self._prefixes:
@@ -171,6 +179,7 @@ class RollingGenerator:
         req = Request(rid, prompt, max_new_tokens, temperature)
         req.prefix_id = prefix_id
         req.stop = [list(s) for s in (stop or []) if s]
+        req.repetition_penalty = float(repetition_penalty)
         self._queue.append(req)
         return rid
 
@@ -252,6 +261,12 @@ class RollingGenerator:
             lens[i] = len(req.prompt)
             slots[i] = req.slot
             self._temps[req.slot] = req.temperature
+            self._penalties[req.slot] = req.repetition_penalty
+            W = self._win.shape[1]
+            tail = req.prompt[-W:]
+            self._win[req.slot] = -1
+            if req.repetition_penalty != 1.0 and tail:
+                self._win[req.slot, -len(tail):] = tail
             self._slots[req.slot] = req
         with self._mesh_ctx():
             if prefix_id is None:
@@ -282,10 +297,19 @@ class RollingGenerator:
         with self._mesh_ctx():
             (self.cache, self._logits, self._dpos, toks) = self._decode(
                 self.params, self.cache, self._logits, self._dpos,
-                self._dactive, jnp.asarray(self._temps), key,
+                self._dactive, jnp.asarray(self._temps),
+                jnp.asarray(self._penalties), jnp.asarray(self._win), key,
                 top_k=self.top_k, top_p=self.top_p,
                 n_steps=self.steps_per_call)
         toks = np.asarray(toks)                       # [K, B] — the one sync
+        # roll the host-side penalty windows by this chunk's tokens
+        K = toks.shape[0]
+        W = self._win.shape[1]
+        if K >= W:
+            self._win[:] = toks[-W:].T
+        else:
+            self._win[:, :-K] = self._win[:, K:]
+            self._win[:, -K:] = toks.T
 
         events: List[Tuple[int, List[int], bool]] = []
         freed: List[int] = []
@@ -319,6 +343,9 @@ class RollingGenerator:
             idx = jnp.asarray(freed, jnp.int32)
             self._dactive = self._dactive.at[idx].set(False)
             self._dpos = self._dpos.at[idx].set(0)
+            for slot in freed:
+                self._win[slot] = -1
+                self._penalties[slot] = 1.0
             self._free.extend(freed)
         return events
 
@@ -410,22 +437,40 @@ class RollingGenerator:
             prefix_len + prompt_lens, prompt_lens - 1)
 
     @staticmethod
-    def _decode_impl(params, cache, last_logits, pos, active, temps, key, *,
+    def _decode_impl(params, cache, last_logits, pos, active, temps,
+                     penalties, window, key, *,
                      top_k, top_p, n_steps, cfg, rules):
         """``n_steps`` tokens for every slot, each at its own depth, in one
-        ``lax.scan`` — one dispatch, one emitted [K, B] block."""
+        ``lax.scan`` — one dispatch, one emitted [K, B] block.
+
+        ``window`` [B, W] holds each slot's recent token ids (−1 = empty);
+        ``penalties`` [B] apply HF-style repetition penalty to those ids
+        (positive logits divided, negative multiplied). The window rolls
+        inside the scan so a token sampled at step k is already penalized
+        at step k+1."""
         M = cache["k"].shape[2]
+        B = last_logits.shape[0]
 
         def one(carry, step_key):
-            cache, logits, pos = carry
+            cache, logits, pos, win = carry
+            pen = penalties[:, None]                       # [B, 1]
+            idx = jnp.maximum(win, 0)
+            gathered = jnp.take_along_axis(logits, idx, axis=1)  # [B, W]
+            adjusted = jnp.where(gathered > 0, gathered / pen,
+                                 gathered * pen)
+            # empty window slots (−1) write their original value back
+            adjusted = jnp.where(win >= 0, adjusted, gathered)
+            logits = logits.at[jnp.arange(B)[:, None], idx].set(adjusted)
+
             logits_f = filter_logits(logits, top_k=top_k, top_p=top_p)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            keys = jax.random.split(step_key, logits.shape[0])
+            keys = jax.random.split(step_key, B)
             sampled = jax.vmap(
                 lambda k, l, t: jax.random.categorical(
                     k, l / jnp.maximum(t, 1e-6))
             )(keys, logits_f, temps).astype(jnp.int32)
             tok = jnp.where(temps > 0, sampled, greedy)
+            win = jnp.concatenate([win[:, 1:], tok[:, None]], axis=1)
 
             positions = pos[:, None]
             m = jnp.arange(M)[None, None, :]
@@ -433,10 +478,11 @@ class RollingGenerator:
             out, cache = llama.forward_cached(
                 params, tok[:, None], positions, cache, pos, mask, cfg,
                 rules)
-            return (cache, out[:, 0], pos + 1), tok
+            return (cache, out[:, 0], pos + 1, win), tok
 
-        (cache, logits, pos), toks = jax.lax.scan(
-            one, (cache, last_logits, pos), jax.random.split(key, n_steps))
+        (cache, logits, pos, _), toks = jax.lax.scan(
+            one, (cache, last_logits, pos, window),
+            jax.random.split(key, n_steps))
         return cache, logits, pos, toks
 
 
