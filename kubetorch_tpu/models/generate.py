@@ -103,7 +103,7 @@ class Generator:
         self._decode = jax.jit(
             partial(self._decode_impl, cfg=cfg, rules=self.rules),
             static_argnames=("n_steps", "temperature", "top_k", "top_p",
-                             "eos_id", "pad_id"))
+                             "eos_id", "pad_id", "repetition_penalty"))
 
     # -------------------------------------------------------------- impl
     @staticmethod
@@ -123,21 +123,31 @@ class Generator:
         return last, cache
 
     @staticmethod
-    def _decode_impl(params, cache, first_logits, prompt_lens, rng, *,
+    def _decode_impl(params, cache, first_logits, prompt_lens, rng, win0, *,
                      n_steps, temperature, top_k, top_p, eos_id, pad_id,
-                     cfg, rules):
+                     repetition_penalty, cfg, rules):
         B = first_logits.shape[0]
         M = cache["k"].shape[2]
         Pmax = M - n_steps
         slot_idx = jnp.arange(M)[None, :]
 
         def step(carry, i):
-            cache, logits, done, rng = carry
+            cache, logits, done, rng, win = carry
+            if repetition_penalty != 1.0:
+                # HF semantics over the rolling last-W window (−1 = empty)
+                idx = jnp.maximum(win, 0)
+                gathered = jnp.take_along_axis(logits, idx, axis=1)
+                adjusted = jnp.where(gathered > 0,
+                                     gathered / repetition_penalty,
+                                     gathered * repetition_penalty)
+                adjusted = jnp.where(win >= 0, adjusted, gathered)
+                logits = logits.at[jnp.arange(B)[:, None], idx].set(adjusted)
             rng, key = jax.random.split(rng)
             tok = sample_tokens(key, logits, temperature, top_k, top_p)
             tok = jnp.where(done, pad_id, tok)
             if eos_id is not None:
                 done = done | (tok == eos_id)
+            win = jnp.concatenate([win[:, 1:], tok[:, None]], axis=1)
             write_at = Pmax + i
             positions = (prompt_lens + i)[:, None]
             # attend: real prompt slots + generated slots up to write_at
@@ -146,11 +156,12 @@ class Generator:
             logits, cache = llama.forward_cached(
                 params, tok[:, None], positions, cache, write_at, mask,
                 cfg, rules)
-            return (cache, logits[:, 0], done, rng), tok
+            return (cache, logits[:, 0], done, rng, win), tok
 
         done0 = jnp.zeros((B,), bool)
-        (_, _, done, _), toks = jax.lax.scan(
-            step, (cache, first_logits, done0, rng), jnp.arange(n_steps))
+        (_, _, done, _, _), toks = jax.lax.scan(
+            step, (cache, first_logits, done0, rng, win0),
+            jnp.arange(n_steps))
         return toks.T, done  # [B, n_steps]
 
     # -------------------------------------------------------------- api
@@ -163,9 +174,17 @@ class Generator:
         top_p: Optional[float] = None,
         eos_id: Optional[int] = None,
         seed: int = 0,
+        repetition_penalty: float = 1.0,
+        stop: Optional[Sequence[Sequence[int]]] = None,
     ) -> List[List[int]]:
         """Generate continuations; returns per-prompt token lists
-        (truncated at ``eos_id`` if given, which is included)."""
+        (truncated at ``eos_id`` if given, which is included).
+
+        ``repetition_penalty`` (HF semantics, last-64-token window; seeded
+        from the prompt tail) runs inside the scan. ``stop`` sequences trim
+        post-hoc — the static scan still runs ``max_new_tokens`` steps, so
+        prefer :class:`~kubetorch_tpu.models.rolling.RollingGenerator` when
+        stop sequences usually fire early."""
         B = len(prompts)
         lens = np.array([len(p) for p in prompts], np.int32)
         if (lens <= 0).any():
@@ -184,20 +203,35 @@ class Generator:
 
         ctx = (use_mesh(self.mesh) if self.mesh is not None
                else contextlib.nullcontext())
+        W = 64
+        win0 = np.full((B, W), -1, np.int32)
+        if repetition_penalty != 1.0:
+            for i, p in enumerate(prompts):
+                tail = list(p)[-W:]
+                win0[i, -len(tail):] = tail
         with ctx:
             first_logits, cache = self._prefill(
                 self.params, jnp.asarray(toks), jnp.asarray(lens),
                 max_len=max_len)
             out, done = self._decode(
                 self.params, cache, first_logits, jnp.asarray(lens),
-                jax.random.key(seed), n_steps=max_new_tokens,
+                jax.random.key(seed), jnp.asarray(win0),
+                n_steps=max_new_tokens,
                 temperature=float(temperature), top_k=top_k, top_p=top_p,
-                eos_id=eos_id, pad_id=self.pad_id)
+                eos_id=eos_id, pad_id=self.pad_id,
+                repetition_penalty=float(repetition_penalty))
         out = np.asarray(jax.device_get(out))
+        stop_seqs = [list(s) for s in (stop or []) if s]
         results: List[List[int]] = []
         for row in out:
             seq = row.tolist()
             if eos_id is not None and eos_id in seq:
                 seq = seq[:seq.index(eos_id) + 1]
+            for sseq in stop_seqs:
+                n = len(sseq)
+                for end in range(n, len(seq) + 1):
+                    if seq[end - n:end] == sseq:
+                        seq = seq[:end]
+                        break
             results.append(seq)
         return results

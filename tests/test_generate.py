@@ -124,3 +124,28 @@ def test_generate_sharded_matches_unsharded(cfg, params):
     prompts = [[3, 1, 4, 1, 5], [9, 2, 6]]
     assert (gen1.generate(prompts, max_new_tokens=4, temperature=0.0)
             == gen8.generate(prompts, max_new_tokens=4, temperature=0.0))
+
+
+@pytest.mark.level("minimal")
+def test_generate_repetition_penalty_and_stop(cfg, params):
+    """Static-engine parity with the rolling engine's sampling knobs."""
+    gen = Generator(params, cfg)
+    prompt = [[1, 2, 3]]
+    base = gen.generate(prompt, max_new_tokens=24, temperature=0.0)[0]
+    pen = gen.generate(prompt, max_new_tokens=24, temperature=0.0,
+                       repetition_penalty=1.5)[0]
+
+    def repeats(seq):
+        return sum(1 for a, b in zip(seq, seq[1:]) if a == b)
+
+    assert pen != base
+    assert repeats(pen) < repeats(base)
+
+    # stop sequences trim post-hoc (earliest completion, inclusive)
+    stop_seq = base[5:8]
+    stopped = gen.generate(prompt, max_new_tokens=24, temperature=0.0,
+                           stop=[stop_seq])[0]
+    n = len(stop_seq)
+    first_end = next(end for end in range(n, len(base) + 1)
+                     if base[end - n:end] == stop_seq)
+    assert stopped == base[:first_end]
