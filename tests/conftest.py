@@ -11,12 +11,17 @@ every sharding/collective path is exercised in CI without hardware
 
 import os
 
-# Must run before any jax import anywhere in the test session.
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: session env may point at a TPU
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+# The tpu tier (KT_TPU_TESTS=1 pytest --level tpu) runs on live TPU
+# hardware — everything else pins to the virtual 8-device CPU mesh.
+_TPU_TIER = os.environ.get("KT_TPU_TESTS") == "1"
+
+if not _TPU_TIER:
+    # Must run before any jax import anywhere in the test session.
+    os.environ["JAX_PLATFORMS"] = "cpu"  # session env may point at a TPU
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 # Keep test pods/processes off any real TPU tunnel.
 os.environ.setdefault("KT_BACKEND", "local")
 
@@ -24,8 +29,9 @@ os.environ.setdefault("KT_BACKEND", "local")
 # plugin before this conftest runs; override via the live config too.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+if not _TPU_TIER:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 
