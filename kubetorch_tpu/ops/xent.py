@@ -33,7 +33,9 @@ def fused_cross_entropy(
     head: jax.Array,              # [E, V] unembedding (compute dtype)
     targets: jax.Array,           # [B, S] int32
     mask: Optional[jax.Array] = None,   # [B, S] {0,1}
-    chunk_size: int = 1024,
+    chunk_size: int = 512,  # interleaved A/B at 0.8B/V=32k on v5e:
+                            # 512 ≈ +1% train throughput over 1024
+                            # (smaller live [chunk, V] logits tile)
 ) -> Tuple[jax.Array, dict]:
     """Masked mean LM cross-entropy without materializing [B,S,V] logits.
 
