@@ -114,14 +114,20 @@ def deploy(target):
               help="positional args as JSON list")
 @click.option("--kwargs", "kwargs_json", default="{}",
               help="keyword args as JSON object")
-def call(service, method, args_json, kwargs_json):
+@click.option("--stream", is_flag=True,
+              help="stream a generator result item by item (JSONL)")
+def call(service, method, args_json, kwargs_json, stream):
     """Call a deployed service: ktpu call my-fn --args '[1,2]'."""
     from kubetorch_tpu.resources.callables.module import Module
 
     module = Module.from_name(service)
     result = module._call_remote(
         method=method, args=tuple(json.loads(args_json)),
-        kwargs=json.loads(kwargs_json))
+        kwargs=json.loads(kwargs_json), stream=stream)
+    if stream:
+        for item in result:
+            click.echo(json.dumps(item, default=str))
+        return
     click.echo(json.dumps(result, default=str))
 
 

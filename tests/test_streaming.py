@@ -145,6 +145,24 @@ def test_mixed_serialization_stream():
 
 
 @pytest.mark.level("minimal")
+def test_cli_call_stream(streamer):
+    """`ktpu call --stream` prints one JSON line per streamed item."""
+    from click.testing import CliRunner
+
+    from kubetorch_tpu.cli import main as cli_main
+
+    result = CliRunner().invoke(
+        cli_main, ["call", streamer.service_name, "--args", "[3]",
+                   "--stream"])
+    assert result.exit_code == 0, result.output
+    import json as _json
+
+    lines = [_json.loads(line) for line in
+             result.output.strip().splitlines()]
+    assert lines == [{"i": i, "sq": i * i} for i in range(3)]
+
+
+@pytest.mark.level("minimal")
 def test_distributed_generator_collects_per_rank():
     """SPMD fan-out: each rank's generator collects into a list, results
     aggregate per rank as usual."""
