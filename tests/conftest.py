@@ -44,13 +44,35 @@ def pytest_addoption(parser):
         help="run tests at or below this level")
 
 
+def pytest_configure(config):
+    # The env var (pins/unpins the CPU mesh at import time) and the level
+    # option must agree — KT_TPU_TESTS=1 without --level tpu would run the
+    # ordinary suite on live hardware with no 8-device mesh.
+    if _TPU_TIER and config.getoption("--level") != "tpu":
+        raise pytest.UsageError(
+            "KT_TPU_TESTS=1 requires --level tpu (the tpu tier runs ONLY "
+            "the hardware tests)")
+    if config.getoption("--level") == "tpu" and not _TPU_TIER:
+        raise pytest.UsageError(
+            "--level tpu requires KT_TPU_TESTS=1 (set before pytest starts "
+            "so the CPU-mesh pin is skipped)")
+
+
 def pytest_collection_modifyitems(config, items):
     max_level = LEVELS.index(config.getoption("--level"))
-    skip_tpu = pytest.mark.skip(reason="needs --level tpu + real TPU")
+    tpu_ix = LEVELS.index("tpu")
     for item in items:
         marker = item.get_closest_marker("level")
         level = LEVELS.index(marker.args[0]) if marker else 0
-        if level > max_level:
+        if max_level == tpu_ix:
+            # The tpu tier runs ONLY hardware tests: lower tiers assume the
+            # virtual 8-device CPU mesh, and their subprocess pods would
+            # contend for the single libtpu device lock.
+            if level != tpu_ix:
+                item.add_marker(pytest.mark.skip(
+                    reason="tpu tier runs only tpu-level tests"))
+        elif level > max_level:
             item.add_marker(
-                skip_tpu if level == LEVELS.index("tpu") else
+                pytest.mark.skip(reason="needs --level tpu + real TPU")
+                if level == tpu_ix else
                 pytest.mark.skip(reason=f"needs --level {LEVELS[level]}"))
