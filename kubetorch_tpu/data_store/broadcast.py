@@ -133,17 +133,80 @@ def _fetch_into_cache(backend, key: str, cache_root: Path,
         os.replace(tmp, local)
         return local, False
     backend._raise_for(manifest_resp, "manifest")
-    stage = cache_root / ".trees" / uuid.uuid4().hex
+    # "tmp-" prefix marks an in-progress stage: the sweeper must never
+    # tombstone a tree that is still being populated.
+    stage = cache_root / ".trees" / f"tmp-{uuid.uuid4().hex}"
     stage.mkdir(parents=True, exist_ok=True)
-    backend.get_path(key, stage, excludes=excludes)
+    try:
+        backend.get_path(key, stage, excludes=excludes)
+    except BaseException:
+        shutil.rmtree(stage, ignore_errors=True)
+        raise
+    final = stage.with_name(stage.name[len("tmp-"):])
+    os.rename(stage, final)  # no readers yet: nothing references the stage
     local.parent.mkdir(parents=True, exist_ok=True)
     link_tmp = local.with_name(
         f".{local.name}.{os.getpid()}-{uuid.uuid4().hex[:6]}.lnk")
-    os.symlink(stage, link_tmp)
+    os.symlink(final, link_tmp)
     if local.exists() and not local.is_symlink():
         shutil.rmtree(local)  # pre-symlink-era cache entry
     os.replace(link_tmp, local)
+    # Superseded versions are NOT deleted inline: a peer may be mid-serve
+    # of the old version (h_tree_archive realpath-pins per request and
+    # silently skips vanished files — deleting under it would truncate a
+    # sibling's fetch). The sweep gives every unreferenced version a grace
+    # window before reclaiming it, which also catches stages orphaned by
+    # concurrent-writer races.
+    _sweep_stale_trees(cache_root)
     return local, True
+
+
+def _sweep_stale_trees(cache_root: Path, grace: float = 120.0,
+                       tmp_grace: float = 3600.0):
+    """Reap superseded/orphaned tree versions under ``cache_root/.trees``.
+
+    A version directory is deleted only after sitting unreferenced (no
+    cache symlink points at it) for ``grace`` seconds — a ``.tombstone``
+    marker records when it was first seen unreferenced, so in-flight
+    requests against the old version can drain before the bytes go away.
+    ``tmp-``-prefixed stages (fetch in progress) are exempt unless older
+    than ``tmp_grace`` (an orphan from a crashed fetcher)."""
+    trees = cache_root / ".trees"
+    if not trees.is_dir():
+        return
+    referenced = set()
+    for dirpath, dirnames, filenames in os.walk(cache_root,
+                                                followlinks=False):
+        if Path(dirpath) == cache_root and ".trees" in dirnames:
+            dirnames.remove(".trees")
+        for name in dirnames + filenames:
+            p = Path(dirpath) / name
+            if p.is_symlink():
+                referenced.add(os.path.realpath(p))
+    now = time.time()
+    for d in list(trees.iterdir()):
+        try:
+            if d.name.endswith(".tombstone"):
+                if not (trees / d.name[:-len(".tombstone")]).exists():
+                    d.unlink()
+                continue
+            if not d.is_dir():
+                continue
+            if d.name.startswith("tmp-"):
+                if now - d.stat().st_mtime > tmp_grace:
+                    shutil.rmtree(d, ignore_errors=True)
+                continue
+            ts = trees / (d.name + ".tombstone")
+            if str(d) in referenced or os.path.realpath(d) in referenced:
+                ts.unlink(missing_ok=True)
+                continue
+            if not ts.exists():
+                ts.touch()
+            elif now - ts.stat().st_mtime > grace:
+                shutil.rmtree(d, ignore_errors=True)
+                ts.unlink(missing_ok=True)
+        except OSError:
+            continue  # concurrent sweeper won the race; nothing to do
 
 
 def broadcast_get(store_backend, key: str, window: BroadcastWindow,
@@ -164,7 +227,19 @@ def broadcast_get(store_backend, key: str, window: BroadcastWindow,
                 f"broadcast {group!r}: no source within "
                 f"{window.timeout:.0f}s (rank {state['rank']})")
         time.sleep(0.1)
-        state = store_backend.bcast_member(group, mid)
+        try:
+            state = store_backend.bcast_member(group, mid)
+        except DataStoreError as e:
+            # 404 only: group vanished server-side (fingerprint
+            # invalidation after a re-put, or the 1h age prune) — the
+            # store still has the bytes, degrade to a direct fetch. A 5xx
+            # must NOT take this path: converting every waiting member
+            # into a direct fetch on a transient store overload is the
+            # thundering herd the broadcast window exists to prevent.
+            if getattr(e, "status", None) != 404:
+                raise
+            state = {"status": "fetching", "parent": "",
+                     "rank": state["rank"]}
 
     parent_url = state["parent"]
     parent = (store_backend if parent_url == ""

@@ -39,7 +39,8 @@ class HttpStoreBackend:
     def _raise_for(self, resp: httpx.Response, action: str):
         if resp.status_code >= 400:
             raise DataStoreError(
-                f"store {action} failed ({resp.status_code}): {resp.text}")
+                f"store {action} failed ({resp.status_code}): {resp.text}",
+                status=resp.status_code)
 
     # ---------------------------------------------------------- trees
     def put_path(self, key: str, src: Path, excludes=DEFAULT_EXCLUDES,
@@ -115,7 +116,7 @@ class HttpStoreBackend:
             return broadcast_get(self, key, broadcast)
         resp = self.client.get(self._url(f"/blob/{key}"))
         if resp.status_code == 404:
-            raise DataStoreError(f"no such key {key!r}")
+            raise DataStoreError(f"no such key {key!r}", status=404)
         self._raise_for(resp, "get")
         return resp.content
 
@@ -167,7 +168,7 @@ class HttpStoreBackend:
     def get_source(self, key: str) -> dict:
         resp = self.client.get(self._url(f"/sources/{key}"))
         if resp.status_code == 404:
-            raise DataStoreError(f"no source for {key!r}")
+            raise DataStoreError(f"no source for {key!r}", status=404)
         self._raise_for(resp, "get_source")
         return resp.json()
 

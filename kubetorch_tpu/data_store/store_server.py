@@ -124,6 +124,11 @@ class StoreServer:
         path = self._path(key)
         path.parent.mkdir(parents=True, exist_ok=True)
         path.write_bytes(body)
+        # New bytes under an old key: peers registered for the previous
+        # version must not be handed out (RL weight-sync re-puts every
+        # round; a stale peer would serve last round's weights for up to
+        # the 1h source TTL).
+        self.sources.pop(key, None)
         self.stats["puts"] += 1
         self.stats["bytes_in"] += len(body)
         return web.json_response({"key": key, "size": len(body)})
@@ -200,6 +205,7 @@ class StoreServer:
             target = (dest / rel).resolve()
             if dest.resolve() in target.parents and target.is_file():
                 target.unlink()
+        self.sources.pop(key, None)  # peers hold the pre-upload tree
         self.stats["puts"] += 1
         self.stats["bytes_in"] += len(body)
         return web.json_response({"applied": count, "deleted": len(deletes)})
@@ -394,7 +400,11 @@ class StoreServer:
                 g["active"][pid] = max(0, g["active"].get(pid, 1) - 1)
             member["counted"] = False
             member["status"] = "complete"
-            if info.get("serve_url"):
+            # A straggler that fetched old bytes before a re-put must not
+            # re-register as a source: the group's fingerprint predates the
+            # new content, so its copy is last round's weights.
+            stale = g["fingerprint"] != self._key_fingerprint(g["key"])
+            if not stale and info.get("serve_url"):
                 member["serve_url"] = info["serve_url"]
                 entry = {"url": info["serve_url"],
                          "registered_at": time.time()}

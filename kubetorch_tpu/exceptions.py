@@ -92,7 +92,16 @@ class RsyncError(KubetorchError):
 
 
 class DataStoreError(KubetorchError):
-    """Data store operation failed (missing key, no source, etc.)."""
+    """Data store operation failed (missing key, no source, etc.).
+
+    ``status`` carries the HTTP status when the failure came off the wire;
+    callers discriminate recoverable 404s (key/group gone) from transient
+    5xxs (e.g. ``broadcast_get``'s direct-fetch fallback fires only on 404
+    so a store brown-out doesn't become a thundering herd)."""
+
+    def __init__(self, message: str, status: "int | None" = None):
+        super().__init__(message)
+        self.status = status
 
 
 class RemoteException(KubetorchError):

@@ -140,8 +140,11 @@ class Generator:
                 adjusted = jnp.where(gathered > 0,
                                      gathered / repetition_penalty,
                                      gathered * repetition_penalty)
-                adjusted = jnp.where(win >= 0, adjusted, gathered)
-                logits = logits.at[jnp.arange(B)[:, None], idx].set(adjusted)
+                # empty slots (−1) scatter out of range and drop — see
+                # rolling.py _decode_impl for the duplicate-index hazard
+                sidx = jnp.where(win >= 0, win, logits.shape[-1])
+                logits = logits.at[jnp.arange(B)[:, None], sidx].set(
+                    adjusted, mode="drop")
             rng, key = jax.random.split(rng)
             tok = sample_tokens(key, logits, temperature, top_k, top_p)
             tok = jnp.where(done, pad_id, tok)

@@ -458,9 +458,12 @@ class RollingGenerator:
             gathered = jnp.take_along_axis(logits, idx, axis=1)  # [B, W]
             adjusted = jnp.where(gathered > 0, gathered / pen,
                                  gathered * pen)
-            # empty window slots (−1) write their original value back
-            adjusted = jnp.where(win >= 0, adjusted, gathered)
-            logits = logits.at[jnp.arange(B)[:, None], idx].set(adjusted)
+            # Empty window slots (−1) scatter out of range and drop: a
+            # duplicate-index .set is nondeterministic, so routing them to
+            # index 0 could silently erase token 0's penalty.
+            sidx = jnp.where(win >= 0, win, logits.shape[-1])
+            logits = logits.at[jnp.arange(B)[:, None], sidx].set(
+                adjusted, mode="drop")
 
             logits_f = filter_logits(logits, top_k=top_k, top_p=top_p)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)

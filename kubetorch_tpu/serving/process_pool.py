@@ -44,7 +44,16 @@ class StreamResult:
                 self.terminal = item
                 return
             yield item
-            item = self._chan.get(timeout=self._timeout)
+            try:
+                item = self._chan.get(timeout=self._timeout)
+            except queue.Empty:
+                # Tell the worker to close the generator before bailing —
+                # otherwise it keeps pushing frames into the unbounded
+                # channel for the rest of its run.
+                self.cancel()
+                raise TimeoutError(
+                    f"stream stalled: no frame within {self._timeout}s"
+                ) from None
 
     def cancel(self):
         """Abandon the stream: tell the worker to close the generator
@@ -196,7 +205,14 @@ class ProcessPool:
             "env": env or {},
         }
         fut, chan = self._submit(worker, req)
-        first = chan.get(timeout=timeout)
+        try:
+            first = chan.get(timeout=timeout)
+        except queue.Empty:
+            # A bare queue.Empty would reach the pod server's blanket
+            # handler as an empty-message 500; keep the timeout signal.
+            raise TimeoutError(
+                f"call {req['req_id']} ({method or 'call'}) timed out after "
+                f"{timeout}s waiting on worker rank {local_rank}") from None
         if not first.get("stream"):
             return first
 

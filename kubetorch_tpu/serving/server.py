@@ -419,8 +419,11 @@ class PodServer:
             # plain caller: drain the generator into one list result (one
             # executor handoff for the whole drain — no progressive
             # delivery is needed here)
-            chunks = await asyncio.get_running_loop().run_in_executor(
-                None, list, iter(resp["stream"]))
+            try:
+                chunks = await asyncio.get_running_loop().run_in_executor(
+                    None, list, iter(resp["stream"]))
+            except TimeoutError as exc:
+                return web.json_response(package_exception(exc), status=500)
             items, used = [], ser
             for chunk in chunks:
                 items.append(serialization.loads(
@@ -478,6 +481,15 @@ class PodServer:
             if cancel is not None:
                 cancel()
             raise
+        except TimeoutError as exc:
+            # Stream stalled past the call timeout (StreamResult already
+            # cancelled the worker generator): tell the client with an 'E'
+            # frame instead of silently truncating the stream.
+            await response.write(frame(
+                b"E", json.dumps({"error": package_exception(exc)["error"]}
+                                 ).encode()))
+            await response.write_eof()
+            return response
         terminal = stream.terminal or {}
         if not terminal.get("ok"):
             await response.write(frame(

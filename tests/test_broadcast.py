@@ -214,3 +214,38 @@ def test_get_arrays_broadcast(store, monkeypatch):
     out = dt.get_arrays("bcast/params", template=tree, broadcast=window)
     np.testing.assert_array_equal(np.asarray(out["w"]), tree["w"])
     DataStoreClient._default = None
+
+
+@pytest.mark.level("unit")
+def test_sweep_stale_trees(tmp_path):
+    """Superseded versions get a tombstone, then a grace window, then the
+    disk back; referenced versions and fresh tmp- stages are untouched."""
+    from kubetorch_tpu.data_store.broadcast import _sweep_stale_trees
+
+    cache = tmp_path / "cache"
+    trees = cache / ".trees"
+    trees.mkdir(parents=True)
+    live = trees / "aaaa"
+    old = trees / "bbbb"
+    tmp = trees / "tmp-cccc"
+    for d in (live, old, tmp):
+        d.mkdir()
+        (d / "f.bin").write_bytes(b"x")
+    (cache / "key").symlink_to(live)
+
+    _sweep_stale_trees(cache, grace=60.0)
+    assert live.is_dir() and tmp.is_dir()
+    assert old.is_dir()  # grace window: still serving in-flight requests
+    tomb = trees / "bbbb.tombstone"
+    assert tomb.exists() and not (trees / "aaaa.tombstone").exists()
+
+    # age the tombstone past grace → reclaimed; live + fresh tmp survive
+    os.utime(tomb, (time.time() - 120, time.time() - 120))
+    _sweep_stale_trees(cache, grace=60.0)
+    assert not old.exists() and not tomb.exists()
+    assert live.is_dir() and tmp.is_dir()
+
+    # orphaned crashed-fetcher stage goes once past tmp_grace
+    os.utime(tmp, (time.time() - 7200, time.time() - 7200))
+    _sweep_stale_trees(cache, grace=60.0, tmp_grace=3600.0)
+    assert not tmp.exists()

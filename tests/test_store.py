@@ -163,6 +163,11 @@ def test_http_store_p2p_source_registry(http_store):
     second = backend.get_source("shared/data")["source"]
     assert {first, second} == {"http://10.0.0.5:32310",
                                "http://10.0.0.6:32310"}
+    # Re-putting the key invalidates peer sources: they hold the old bytes
+    # (RL weight-sync re-puts every round).
+    backend.put_blob("shared/data", b"y")
+    resp = backend.get_source("shared/data")
+    assert resp["peer"] is False and resp["source"] == ""
 
 
 def test_store_via_env_uses_http(tmp_path, monkeypatch, http_store):
