@@ -1,0 +1,29 @@
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+from pathlib import Path
+from kubetorch_tpu.data_store.http_store import HttpStoreBackend
+
+be = HttpStoreBackend("http://127.0.0.1:42311")
+
+# straggler staleness: join a group, re-put the key, then complete with a
+# serve_url — the stale copy must NOT be registered as a source
+be.put_blob("w/x", b"v1" * 100)
+be.bcast_join("g1", key="w/x", member_id="m1", world_size=2, fanout=2)
+be.put_blob("w/x", b"v2" * 100)   # re-put while m1 is "fetching"
+be.bcast_complete("g1", "m1", serve_url="http://10.1.1.1:1")
+s = be.get_source("w/x")
+assert s["peer"] is False, f"stale straggler registered as source: {s}"
+print("PASS straggler does not re-register stale source")
+
+# fresh group on current bytes still registers fine
+be.bcast_join("g2", key="w/x", member_id="m2", world_size=1, fanout=2)
+be.bcast_complete("g2", "m2", serve_url="http://10.1.1.2:1")
+s = be.get_source("w/x")
+assert s["peer"] is True and s["source"] == "http://10.1.1.2:1", s
+print("PASS fresh completion registers source")
+
+# re-put invalidation still holds with the version counter
+be.put_blob("w/x", b"v3" * 100)
+s = be.get_source("w/x")
+assert s["peer"] is False and s["source"] == "", s
+print("PASS version-counter re-put invalidation")

@@ -149,7 +149,11 @@ def _fetch_into_cache(backend, key: str, cache_root: Path,
         f".{local.name}.{os.getpid()}-{uuid.uuid4().hex[:6]}.lnk")
     os.symlink(final, link_tmp)
     if local.exists() and not local.is_symlink():
-        shutil.rmtree(local)  # pre-symlink-era cache entry
+        # pre-symlink-era tree, or the key changed kind from blob to tree
+        if local.is_dir():
+            shutil.rmtree(local)
+        else:
+            local.unlink()
     os.replace(link_tmp, local)
     # Superseded versions are NOT deleted inline: a peer may be mid-serve
     # of the old version (h_tree_archive realpath-pins per request and

@@ -68,6 +68,12 @@ class StoreServer:
         self._rr: Dict[str, int] = {}
         # group -> rolling-join broadcast state (see h_bcast_join)
         self.broadcasts: Dict[str, dict] = {}
+        # key -> monotonic content version, bumped on every mutation. The
+        # broadcast fingerprint compares these integers — O(1) per join/
+        # complete instead of rglob+stat of the whole tree on the event
+        # loop (all store mutations flow through this process's handlers,
+        # so the counter can't miss a change).
+        self.versions: Dict[str, int] = {}
         self.stats = {"puts": 0, "gets": 0, "bytes_in": 0, "bytes_out": 0,
                       "started_at": time.time()}
 
@@ -129,6 +135,7 @@ class StoreServer:
         # round; a stale peer would serve last round's weights for up to
         # the 1h source TTL).
         self.sources.pop(key, None)
+        self.versions[key] = self.versions.get(key, 0) + 1
         self.stats["puts"] += 1
         self.stats["bytes_in"] += len(body)
         return web.json_response({"key": key, "size": len(body)})
@@ -173,6 +180,7 @@ class StoreServer:
             path.unlink()
             count = 1
         self.sources.pop(key, None)
+        self.versions[key] = self.versions.get(key, 0) + 1
         return web.json_response({"deleted": count})
 
     # ------------------------------------------------------ tree sync
@@ -206,6 +214,7 @@ class StoreServer:
             if dest.resolve() in target.parents and target.is_file():
                 target.unlink()
         self.sources.pop(key, None)  # peers hold the pre-upload tree
+        self.versions[key] = self.versions.get(key, 0) + 1
         self.stats["puts"] += 1
         self.stats["bytes_in"] += len(body)
         return web.json_response({"applied": count, "deleted": len(deletes)})
@@ -264,24 +273,12 @@ class StoreServer:
         raise web.HTTPNotFound(text=f"no source for {key!r}")
 
     # ------------------------------------------------- broadcast groups
-    def _key_fingerprint(self, key: str):
-        """Cheap content version for a key: a re-put invalidates any group
-        built on the previous bytes (the RL weight-sync loop re-broadcasts
-        the same key every iteration)."""
-        path = self._path(key)
-        if path.is_file():
-            st = path.stat()
-            return [st.st_size, st.st_mtime_ns]
-        if path.is_dir():
-            total, latest, count = 0, 0, 0
-            for p in path.rglob("*"):
-                if p.is_file():
-                    st = p.stat()
-                    total += st.st_size
-                    latest = max(latest, st.st_mtime_ns)
-                    count += 1
-            return [count, total, latest]
-        return None
+    def _key_fingerprint(self, key: str) -> int:
+        """Content version for a key: a re-put invalidates any group built
+        on the previous bytes (the RL weight-sync loop re-broadcasts the
+        same key every iteration). An integer counter, not a filesystem
+        scan — this runs on the event loop once per join/complete."""
+        return self.versions.get(key, 0)
 
     def _bcast_group(self, group: str, info: Optional[dict] = None) -> dict:
         # Prune abandoned groups (all-complete groups stay for late status

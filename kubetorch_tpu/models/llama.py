@@ -343,7 +343,15 @@ def hidden_states(
     rules = rules or ShardingRules.default()
     dt = cfg.compute_dtype
     B, S = tokens.shape
-    x = params["embedding"].astype(dt)[tokens]
+    # Gather from a table whose embed dim is unsharded at use: looking up
+    # straight from the ("vocab","embed_fsdp") at-rest layout makes the
+    # output embed-sharded, and XLA can only reach the batch-sharded
+    # constraint below via involuntary full rematerialization. Dropping
+    # the fsdp embed sharding first costs one all-gather of the local
+    # vocab shard; the vocab(tp) sharding stays (masked gather + psum).
+    emb = shard_constraint(params["embedding"].astype(dt), rules,
+                           "vocab", None)
+    x = emb[tokens]
     x = shard_constraint(x, rules, "batch", "seq", None)
     if positions is None:
         positions = jnp.arange(S)[None, :]
@@ -404,34 +412,58 @@ def forward_pipeline(
     mesh,
     n_microbatches: int = 2,
     positions: Optional[jax.Array] = None,
+    rules=None,
 ) -> jax.Array:
     """Pipeline-parallel forward: layers grouped into ``pp`` stages, GPipe
     microbatching via :func:`kubetorch_tpu.parallel.pipeline.pipeline_apply`.
 
     Embedding/unembedding run outside the pipeline (replicated); the decoder
     stack streams through stages. Layer count must divide the pp axis size.
+
+    ``rules`` should be the stage-consistent
+    :meth:`~kubetorch_tpu.parallel.sharding.ShardingRules.pipeline` variant
+    (the default here) **and** the same rules the train state was
+    initialized with — then the stacked layer params enter the pipeline's
+    shard_map in their at-rest sharding (stage dim on pp, weight dims on
+    fsdp, gathered ZeRO-style inside the body) and XLA inserts no
+    resharding at the boundary. Batch rows shard over (dp, fsdp): each
+    data-parallel group pipelines its own rows, so fsdp is simultaneously
+    data-parallel and param-sharded.
     """
     from kubetorch_tpu.parallel.pipeline import pipeline_apply
     from kubetorch_tpu.parallel.sharding import ShardingRules
 
+    rules = rules or ShardingRules.pipeline()
     pp = mesh.shape["pp"]
     L = cfg.n_layers
     if L % pp:
         raise ValueError(f"n_layers {L} not divisible by pp {pp}")
     # Inside shard_map the mesh axes are consumed — use unsharded rules.
     null_rules = ShardingRules(rules=tuple(
-        (name, None) for name, _ in ShardingRules.default().rules))
+        (name, None) for name, _ in rules.rules))
 
     dt = cfg.compute_dtype
     B, S = tokens.shape
-    x = params["embedding"].astype(dt)[tokens]
+    emb = shard_constraint(params["embedding"].astype(dt), rules,
+                           "vocab", None)  # see hidden_states
+    x = emb[tokens]
+    x = shard_constraint(x, rules, "batch", "seq", None)
     if positions is None:
         positions = jnp.arange(S)[None, :]
     sin, cos = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
 
-    # [L, ...] -> [pp, L/pp, ...] stage-major layer grouping.
+    # [L, ...] -> [pp, L/pp, ...] stage-major layer grouping. When the
+    # layer dim is pp-sharded at rest (pipeline rules), this reshape is a
+    # local split — no cross-device movement.
     stage_layers = jax.tree.map(
         lambda a: a.reshape((pp, L // pp) + a.shape[1:]), params["layers"])
+    # Per-leaf at-rest specs for the stacked layout: logical
+    # ("stage", "layer", *weight_axes) — "stage"→pp, "layer" drops (pp
+    # already consumed), weight axes keep their fsdp placement.
+    layer_axes = param_logical_axes(cfg)["layers"]
+    stage_specs = jax.tree.map(
+        lambda ax: rules.pspec("stage", *ax), layer_axes,
+        is_leaf=lambda x: isinstance(x, tuple))
 
     block = _block
     if cfg.remat:
@@ -445,7 +477,11 @@ def forward_pipeline(
         h, _ = jax.lax.scan(body, h, stage_params)
         return h
 
-    x = pipeline_apply(stage_fn, stage_layers, x, mesh, n_microbatches)
+    batch_axes = rules.mesh_axes("batch")
+    if isinstance(batch_axes, str):
+        batch_axes = (batch_axes,)
+    x = pipeline_apply(stage_fn, stage_layers, x, mesh, n_microbatches,
+                       param_specs=stage_specs, batch_axes=batch_axes)
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     head = (params["embedding"].T if cfg.tie_embeddings
             else params["lm_head"]).astype(dt)

@@ -49,6 +49,23 @@ class ShardingRules:
         merged.update(overrides)
         return cls(rules=tuple(merged.items()))
 
+    @classmethod
+    def pipeline(cls, **overrides: MeshAxes) -> "ShardingRules":
+        """Stage-consistent rules for pipeline parallelism.
+
+        The stacked layer dim lives on pp **at rest**, so
+        ``forward_pipeline``'s shard_map consumes params exactly as the
+        train state holds them — no XLA replicate-then-repartition on entry
+        (round 1's involuntary-full-rematerialization defect). Weight dims
+        keep fsdp (gathered ZeRO-style inside the stage body); tp-bound
+        axes go unsharded — tensor parallelism inside pipeline stages is
+        not supported (put tp devices on fsdp instead)."""
+        merged = dict(LOGICAL_AXIS_RULES)
+        merged.update(layer="pp", heads=None, kv_heads=None, mlp=None,
+                      vocab=None)
+        merged.update(overrides)
+        return cls(rules=tuple(merged.items()))
+
     def mesh_axes(self, logical: Optional[str]) -> MeshAxes:
         if logical is None:
             return None

