@@ -1,12 +1,16 @@
-"""Headline bench: Llama training throughput, tokens/sec/chip.
+"""Headline bench: Llama training + Llama-3-8B serving on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu",
+"extra"}. The headline metric stays the 0.8B train number (comparable to
+BENCH_BASELINE.json across rounds); "extra" carries the north-star rows
+(BASELINE.md targets #3/#5): Llama-3-8B int8 weight-only decode throughput
+on the real chip, and the largest-fitting train config (~1.5B) with MFU.
 
 The reference publishes no framework benchmarks (BASELINE.md — verified
 absence), so ``vs_baseline`` is measured against the target this repo
-establishes in BENCH_BASELINE.json (first run writes it; later runs compare).
-Runs on whatever jax.devices() offers: the real TPU chip under the driver, or
-CPU as a tiny-smoke fallback.
+establishes in BENCH_BASELINE.json (first run writes it; later runs
+compare). Runs on whatever jax.devices() offers: the real TPU chip under
+the driver, or CPU as a tiny-smoke fallback.
 """
 
 from __future__ import annotations
@@ -18,36 +22,37 @@ from pathlib import Path
 
 _BASELINE_PATH = Path(__file__).parent / "BENCH_BASELINE.json"
 
+# v5e bf16 peak and HBM bandwidth (public spec: 197 TFLOP/s, 819 GB/s).
+PEAK_FLOPS = 197e12
+HBM_BW = 819e9
 
-def _bench_tpu():
+
+def _train_flops_per_token(cfg, seq: int) -> float:
+    """Matmul model-flops per token, fwd+bwd.
+
+    6·N_matmul for the dense/attention/unembed matmuls (untied embedding
+    *lookups* are excluded — counting the [V,E] table twice would flatter
+    MFU by ~7% at 128k vocab) plus causal attention's 6·L·S·H·D.
+    """
+    from kubetorch_tpu.models import llama
+
+    n = llama.num_params(cfg)
+    if not cfg.tie_embeddings:
+        n -= cfg.vocab_size * cfg.embed_dim
+    attn = 6 * cfg.n_layers * seq * cfg.n_heads * cfg.head_dim
+    return 6 * n + attn
+
+
+def _bench_train(cfg, batch, seq, steps, n_dev):
     import jax
+    import numpy as np
     import optax
 
-    from kubetorch_tpu.models import LlamaConfig
     from kubetorch_tpu.parallel import MeshSpec
     from kubetorch_tpu.training import Trainer
 
-    n_dev = len(jax.devices())
-    on_tpu = jax.devices()[0].platform != "cpu"
-
-    if on_tpu:
-        # ~0.8B-param Llama (tied embeddings) fits one v5e chip with fp32 Adam.
-        cfg = LlamaConfig(
-            vocab_size=32768, embed_dim=2048, n_layers=12, n_heads=16,
-            n_kv_heads=8, head_dim=128, mlp_dim=8192, tie_embeddings=True,
-            remat=True, remat_policy="dots", dtype="bfloat16",
-            param_dtype="bfloat16")
-        batch, seq, steps = 4, 2048, 10
-        metric = "llama_0.8b_train_tokens_per_sec_per_chip"
-    else:
-        cfg = LlamaConfig.tiny()
-        batch, seq, steps = 4, 128, 4
-        metric = "llama_tiny_cpu_train_tokens_per_sec_per_chip"
-
     mesh = MeshSpec(fsdp=-1).build()
     trainer = Trainer(cfg, mesh, optimizer=optax.adamw(1e-4))
-    import numpy as np
-
     rng = np.random.default_rng(0)
     toks = rng.integers(0, cfg.vocab_size, (batch, seq + 1))
     data = {
@@ -55,11 +60,13 @@ def _bench_tpu():
         "targets": jax.numpy.asarray(toks[:, 1:], jax.numpy.int32),
     }
     result = trainer.benchmark(data, n_steps=steps, warmup=2)
-    per_chip = result["tokens_per_sec"] / n_dev
-
-    if on_tpu:
-        result["generate_tok_s"] = _bench_decode(trainer.state["params"], cfg)
-    return metric, per_chip, result
+    result["tokens_per_sec_per_chip"] = result["tokens_per_sec"] / n_dev
+    if jax.devices()[0].platform != "cpu":
+        # MFU is against the v5e peak — meaningless on the CPU smoke path
+        result["mfu"] = (result["tokens_per_sec_per_chip"]
+                         * _train_flops_per_token(cfg, seq) / PEAK_FLOPS)
+    result["params"] = trainer.state["params"]
+    return result
 
 
 def _bench_decode(params, cfg, B=8, P=128, N=64):
@@ -79,8 +86,137 @@ def _bench_decode(params, cfg, B=8, P=128, N=64):
     return B * N / (time.perf_counter() - t0)
 
 
+def _bench_8b_decode(B=64, P=128, N=128):
+    """Llama-3-8B int8 weight-only decode, steady-state (north star #5).
+
+    Weights are random int8 initialized directly on device (a bf16 8B tree
+    is 16 GB and cannot be staged on the chip; values don't affect
+    throughput). Timed region: the second call of the compiled decode scan
+    — same executable back-to-back, so the axon tunnel's program-swap cost
+    (~7.5 s, absent on real PJRT TPU) stays out of the measurement. A
+    host fetch closes the timing (block_until_ready is not trusted on the
+    tunnel backend).
+    """
+    import time
+
+    import jax
+    import numpy as np
+
+    from kubetorch_tpu.models import Generator, LlamaConfig, quant
+
+    cfg = LlamaConfig.llama3_8b(max_seq_len=1024)
+    params = quant.init_quantized(jax.random.key(0), cfg)
+    jax.block_until_ready(params)
+    nbytes = sum(x.nbytes for x in jax.tree.leaves(params))
+
+    gen = Generator(params, cfg)
+    out = None
+    for b in (B, B // 2):
+        try:
+            prompts = np.random.default_rng(0).integers(
+                1, cfg.vocab_size, (b, P))
+            lens = np.full((b,), P, np.int32)
+            first_logits, cache = gen._prefill(
+                params, jax.numpy.asarray(prompts), jax.numpy.asarray(lens),
+                max_len=P + N)
+            win0 = jax.numpy.asarray(np.full((b, 64), -1, np.int32))
+            kw = dict(n_steps=N, temperature=0.8, top_k=None, top_p=None,
+                      eos_id=None, pad_id=0, repetition_penalty=1.0)
+            args = (params, cache, first_logits, jax.numpy.asarray(lens))
+            out, _ = gen._decode(*args, jax.random.key(0), win0, **kw)
+            np.asarray(jax.device_get(out))
+            t0 = time.perf_counter()
+            out, _ = gen._decode(*args, jax.random.key(1), win0, **kw)
+            np.asarray(jax.device_get(out))
+            dt = time.perf_counter() - t0
+            B = b
+            break
+        except Exception as e:  # OOM headroom shrank: halve the batch
+            print(f"# 8b decode B={b} failed ({type(e).__name__}); retrying",
+                  file=sys.stderr)
+            # Drop the failed attempt's device buffers (multi-GB KV cache)
+            # before retrying on a chip that just ran out of memory.
+            out = cache = first_logits = None
+    if out is None:
+        return None
+    step_s = dt / N
+    # HBM bytes per decode step: every matmul weight streams once (total
+    # params minus the embedding table, which is row-looked-up), plus the
+    # KV cache at its average fill over the run.
+    emb_bytes = params["embedding"].nbytes
+    kv_bytes = sum(x.nbytes for x in jax.tree.leaves(
+        {"k": cache["k"], "v": cache["v"]}))
+    avg_fill = (P + N / 2) / (P + N)
+    bytes_per_step = (nbytes - emb_bytes) + kv_bytes * avg_fill
+    return {
+        "tok_s": B * N / dt,
+        "batch": B,
+        "ms_per_step": step_s * 1e3,
+        "param_gb": nbytes / 1e9,
+        "mbu": bytes_per_step / step_s / HBM_BW,
+    }
+
+
+def _bench_tpu():
+    import jax
+
+    from kubetorch_tpu.models import LlamaConfig
+
+    n_dev = len(jax.devices())
+    on_tpu = jax.devices()[0].platform != "cpu"
+
+    if not on_tpu:
+        cfg = LlamaConfig.tiny()
+        result = _bench_train(cfg, batch=4, seq=128, steps=4, n_dev=n_dev)
+        result.pop("params")
+        return ("llama_tiny_cpu_train_tokens_per_sec_per_chip",
+                result["tokens_per_sec_per_chip"], result, {})
+
+    # Headline: ~0.8B-param Llama (tied embeddings), fp32-master-free Adam.
+    cfg = LlamaConfig(
+        vocab_size=32768, embed_dim=2048, n_layers=12, n_heads=16,
+        n_kv_heads=8, head_dim=128, mlp_dim=8192, tie_embeddings=True,
+        remat=True, remat_policy="dots", dtype="bfloat16",
+        param_dtype="bfloat16")
+    result = _bench_train(cfg, batch=4, seq=2048, steps=10, n_dev=n_dev)
+    params = result.pop("params")
+    result["generate_tok_s"] = _bench_decode(params, cfg)
+    del params
+
+    extra = {}
+    # Largest-fitting single-chip train config (north star #3 proxy at
+    # 1 chip): ~1.5B incl. 128k-vocab untied embeddings, B=2 S=2048.
+    try:
+        big = LlamaConfig.llama3_1b(remat=True, remat_policy="dots")
+        r = _bench_train(big, batch=2, seq=2048, steps=8, n_dev=n_dev)
+        r.pop("params")
+        extra["llama_1.5b_train_tok_s_per_chip"] = round(
+            r["tokens_per_sec_per_chip"], 1)
+        extra["llama_1.5b_train_mfu"] = round(r["mfu"], 4)
+    except Exception as e:
+        print(f"# 1.5b train failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+    # North star #5: Llama-3-8B int8 decode on the real chip.
+    try:
+        dec = _bench_8b_decode()
+        if dec:
+            extra["llama3_8b_int8_decode_tok_s"] = round(dec["tok_s"], 1)
+            extra["llama3_8b_decode_batch"] = dec["batch"]
+            extra["llama3_8b_decode_ms_per_step"] = round(
+                dec["ms_per_step"], 2)
+            extra["llama3_8b_decode_mbu"] = round(dec["mbu"], 4)
+            extra["llama3_8b_param_gb"] = round(dec["param_gb"], 2)
+    except Exception as e:
+        print(f"# 8b decode failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+    return ("llama_0.8b_train_tokens_per_sec_per_chip",
+            result["tokens_per_sec_per_chip"], result, extra)
+
+
 def main():
-    metric, value, detail = _bench_tpu()
+    metric, value, detail, extra = _bench_tpu()
 
     baseline = None
     if _BASELINE_PATH.exists():
@@ -95,16 +231,21 @@ def main():
             json.dumps({"metric": metric, "value": value}))
 
     vs = (value / baseline) if baseline else 1.0
-    print(json.dumps({
+    out = {
         "metric": metric,
         "value": round(value, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs, 4),
-    }))
-    extra = (f" generate={detail['generate_tok_s']:.0f}tok/s"
-             if "generate_tok_s" in detail else "")
+    }
+    if "mfu" in detail:
+        out["mfu"] = round(detail["mfu"], 4)
+    if extra:
+        out["extra"] = extra
+    print(json.dumps(out))
+    gen = (f" generate={detail['generate_tok_s']:.0f}tok/s"
+           if "generate_tok_s" in detail else "")
     print(f"# detail: step_time={detail['step_time_s'] * 1e3:.1f}ms "
-          f"loss={detail['loss']:.3f}{extra}", file=sys.stderr)
+          f"loss={detail['loss']:.3f}{gen}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -13,27 +13,22 @@ from kubetorch_tpu.models import llama
 
 
 def __getattr__(name):
-    # generate pulls in the sampling stack; keep the train-only import light.
+    # generate pulls in the sampling stack; keep the train-only import
+    # light. importlib, not `from … import`: the latter consults this very
+    # __getattr__ before importing, recursing forever on module names.
+    import importlib
+
+    if name in ("generate", "quant", "rolling"):
+        return importlib.import_module(f"kubetorch_tpu.models.{name}")
     if name == "Generator":
-        from kubetorch_tpu.models.generate import Generator
-
-        return Generator
-    if name == "generate":
-        from kubetorch_tpu.models import generate
-
-        return generate
-    if name == "quant":
-        from kubetorch_tpu.models import quant
-
-        return quant
+        return importlib.import_module(
+            "kubetorch_tpu.models.generate").Generator
     if name == "quantize_params":
-        from kubetorch_tpu.models.quant import quantize_params
-
-        return quantize_params
+        return importlib.import_module(
+            "kubetorch_tpu.models.quant").quantize_params
     if name == "RollingGenerator":
-        from kubetorch_tpu.models.rolling import RollingGenerator
-
-        return RollingGenerator
+        return importlib.import_module(
+            "kubetorch_tpu.models.rolling").RollingGenerator
     raise AttributeError(name)
 
 

@@ -115,12 +115,12 @@ class Generator:
         t = jnp.arange(P)[None, :, None]
         mask = (m <= t) & (m < prompt_lens[:, None, None])
         cache = llama.init_cache(cfg, B, max_len)
+        # next-token logits at each sequence's last real token only — the
+        # full [B, P, V] logits would be GBs of HBM at 128k vocab.
         logits, cache = llama.forward_cached(
-            params, tokens, positions, cache, 0, mask, cfg, rules)
-        # next-token logits at each sequence's last real token
-        last = jnp.take_along_axis(
-            logits, (prompt_lens - 1)[:, None, None], axis=1)[:, 0]
-        return last, cache
+            params, tokens, positions, cache, 0, mask, cfg, rules,
+            unembed_positions=prompt_lens - 1)
+        return logits[:, 0], cache
 
     @staticmethod
     def _decode_impl(params, cache, first_logits, prompt_lens, rng, win0, *,

@@ -584,8 +584,16 @@ def forward_cached(
     mask: jax.Array,          # [B, T, max_len] bool attention mask
     cfg: LlamaConfig,
     rules: Optional[ShardingRules] = None,
+    unembed_positions: Optional[jax.Array] = None,  # [B] — logits only there
 ):
-    """Forward with KV cache → (logits [B, T, V] float32, new cache)."""
+    """Forward with KV cache → (logits [B, T, V] float32, new cache).
+
+    ``unembed_positions`` restricts the unembedding matmul to one position
+    per sequence (logits come back [B, 1, V]). Prefill only needs the last
+    real token's logits; materializing [B, P, V] float32 there is pure HBM
+    waste (4.2 GB at B=64, P=128, V=128k — an OOM on a 16 GB chip that
+    never needed to happen).
+    """
     rules = rules or ShardingRules.default()
     dt = cfg.compute_dtype
     x = params["embedding"].astype(dt)[tokens]
@@ -600,6 +608,8 @@ def forward_cached(
     x, (new_k, new_v) = jax.lax.scan(
         scan_body, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    if unembed_positions is not None:
+        x = jnp.take_along_axis(x, unembed_positions[:, None, None], axis=1)
     logits = jnp.einsum("bse,ev->bsv", x, unembedding(params, cfg))
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 

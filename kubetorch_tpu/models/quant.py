@@ -127,3 +127,69 @@ def dequantize_params(params: Dict[str, Any],
         out["lm_head"] = (out["lm_head"].astype(dtype)
                           * out.pop("lm_head_scale").astype(dtype))
     return out
+
+
+def init_quantized(key: jax.Array, cfg,
+                   keys: Sequence[str] = QUANT_KEYS) -> Dict[str, Any]:
+    """Random params initialized *directly* in int8-quantized form.
+
+    For serving-scale benchmarks and smoke tests of models whose bf16 tree
+    exceeds HBM: a Llama-3-8B bf16 tree is ~16 GB — it cannot be
+    materialized on a 16 GB v5e chip to be quantized after the fact, but
+    the int8 form (~7 GB matmul weights + bf16 embeddings/norms/head)
+    fits. Weight *values* are random (throughput doesn't depend on them);
+    scales mimic a trained model's magnitude (absmax ≈ 4σ of a 1/√in_dim
+    dense init) so logits land in a realistic range for the sampling path.
+    The unembedding stays bf16 — int8 there is measured slower (see
+    :func:`quantize_params`).
+    """
+    pdt = cfg.storage_dtype
+    L, E, H, Hkv, D, M, V = (cfg.n_layers, cfg.embed_dim, cfg.n_heads,
+                             cfg.n_kv_heads, cfg.head_dim, cfg.mlp_dim,
+                             cfg.vocab_size)
+    shapes = {
+        "wq": (L, E, H * D), "wk": (L, E, Hkv * D), "wv": (L, E, Hkv * D),
+        "wo": (L, H * D, E),
+    }
+    if cfg.moe is None:
+        shapes.update({"w_gate": (L, E, M), "w_up": (L, E, M),
+                       "w_down": (L, M, E)})
+    else:
+        X, Me = cfg.moe.num_experts, cfg.moe.expert_mlp_dim
+        shapes.update({"we_gate": (L, X, E, Me), "we_up": (L, X, E, Me),
+                       "we_down": (L, X, Me, E)})
+
+    def build(key):
+        ks = iter(jax.random.split(key, len(shapes) + 4))
+        layers: Dict[str, Any] = {
+            "attn_norm": jnp.ones((L, E), pdt),
+            "mlp_norm": jnp.ones((L, E), pdt),
+        }
+        for name, shape in shapes.items():
+            in_dim = shape[-2]
+            if name in keys:
+                layers[name] = jax.random.randint(
+                    next(ks), shape, -127, 128, jnp.int8)
+                layers[name + "_scale"] = jnp.full(
+                    shape[:-2] + (1, shape[-1]),
+                    4.0 / (in_dim ** 0.5) / 127.0, pdt)
+            else:
+                # not selected for quantization: full-precision, matching
+                # quantize_params' behavior on a keys subset
+                layers[name] = jax.random.normal(
+                    next(ks), shape, pdt) * (in_dim ** -0.5)
+        if cfg.moe is not None:
+            layers["router"] = jax.random.normal(
+                next(ks), (L, E, cfg.moe.num_experts), pdt) * 0.02
+        out: Dict[str, Any] = {
+            "embedding": jax.random.normal(next(ks), (V, E), pdt)
+            * (E ** -0.5),
+            "layers": layers,
+            "final_norm": jnp.ones((E,), pdt),
+        }
+        if not cfg.tie_embeddings:
+            out["lm_head"] = jax.random.normal(
+                next(ks), (E, V), pdt) * (E ** -0.5)
+        return out
+
+    return jax.jit(build)(key)

@@ -114,6 +114,7 @@ class _WorkerLoop:
             max_workers=int(os.environ.get("KT_WORKER_THREADS", "8")))
         # req_ids whose streams the client abandoned (see _stream_result)
         self._cancelled: set = set()
+        self._inflight: set = set()
 
     def _resolve_method(self, method_name: Optional[str]):
         if self.callable_type == "cls" and method_name:
@@ -295,13 +296,24 @@ class _WorkerLoop:
             if req is None or req.get("kind") == SHUTDOWN:
                 break
             if req.get("kind") == CANCEL:
-                self._cancelled.add(req.get("target"))
+                # Only mark live requests: a CANCEL racing a completed (or
+                # plain, already-answered) call must not grow the set
+                # forever on a long-lived pod.
+                if req.get("target") in self._inflight:
+                    self._cancelled.add(req.get("target"))
                 continue
             # Execute concurrently so async user code overlaps.
+            rid = req.get("req_id")
+            self._inflight.add(rid)
             task = asyncio.ensure_future(self._execute(req))
-            task.add_done_callback(
-                lambda t: self.response_q.put(
-                    t.result() if not t.cancelled() else None))
+
+            def _finish(t, rid=rid):
+                self._inflight.discard(rid)
+                self._cancelled.discard(rid)
+                self.response_q.put(
+                    t.result() if not t.cancelled() else None)
+
+            task.add_done_callback(_finish)
 
 
 def worker_main(request_q, response_q, env: Dict[str, str]):

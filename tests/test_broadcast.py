@@ -249,3 +249,25 @@ def test_sweep_stale_trees(tmp_path):
     os.utime(tmp, (time.time() - 7200, time.time() - 7200))
     _sweep_stale_trees(cache, grace=60.0, tmp_grace=3600.0)
     assert not tmp.exists()
+
+
+@pytest.mark.level("minimal")
+def test_straggler_completion_does_not_register_stale_source(store):
+    """A member that finishes fetching OLD bytes after a re-put must not
+    re-register its copy as a P2P source — get_source consumers would be
+    routed to last round's weights for up to the 1h TTL."""
+    backend = HttpStoreBackend(store)
+    backend.put_blob("w/x", b"v1" * 100)
+    backend.bcast_join("g1", key="w/x", member_id="m1", world_size=2,
+                       fanout=2)
+    backend.put_blob("w/x", b"v2" * 100)   # re-put while m1 is fetching
+    backend.bcast_complete("g1", "m1", serve_url="http://10.1.1.1:1")
+    s = backend.get_source("w/x")
+    assert s["peer"] is False, f"stale straggler registered: {s}"
+
+    # a fresh group against the current bytes still registers fine
+    backend.bcast_join("g2", key="w/x", member_id="m2", world_size=1,
+                       fanout=2)
+    backend.bcast_complete("g2", "m2", serve_url="http://10.1.1.2:1")
+    s = backend.get_source("w/x")
+    assert s["peer"] is True and s["source"] == "http://10.1.1.2:1"

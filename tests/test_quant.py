@@ -103,3 +103,47 @@ def test_quantized_logical_axes_cover_tree():
                                         isinstance(x, tuple))}
     for key, _ in flat_p:
         assert jax.tree_util.keystr(key) in flat_a, key
+
+
+@pytest.mark.level("unit")
+def test_init_quantized_matches_quantize_params_structure():
+    """Direct-int8 init (for models whose bf16 tree exceeds HBM) must
+    produce exactly the tree quantize_params(init()) would: same leaves,
+    shapes, dtypes — so every cached-forward/Generator path is identical."""
+    from kubetorch_tpu.models import quant
+
+    for cfg in (LlamaConfig.tiny(n_layers=2),
+                LlamaConfig.tiny_moe(n_layers=2)):
+        ref = quant.quantize_params(llama.init(jax.random.key(0), cfg))
+        new = quant.init_quantized(jax.random.key(1), cfg)
+        ref_map = {
+            jax.tree_util.keystr(k): (v.shape, v.dtype)
+            for k, v in jax.tree.flatten_with_path(ref)[0]}
+        new_map = {
+            jax.tree_util.keystr(k): (v.shape, v.dtype)
+            for k, v in jax.tree.flatten_with_path(new)[0]}
+        assert ref_map == new_map, cfg
+
+
+@pytest.mark.level("unit")
+def test_prefill_last_position_unembed_matches_full():
+    """unembed_positions must select exactly the last real token's logits
+    (ragged prompts), identical to slicing the full [B, P, V] logits."""
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = llama.init(jax.random.key(0), cfg)
+    B, P, max_len = 2, 6, 16
+    toks = jnp.asarray([[5, 3, 9, 0, 0, 0], [7, 2, 4, 8, 1, 6]], jnp.int32)
+    lens = jnp.asarray([3, 6], jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(P)[None, :], (B, P))
+    m = jnp.arange(max_len)[None, None, :]
+    t = jnp.arange(P)[None, :, None]
+    mask = (m <= t) & (m < lens[:, None, None])
+    cache = llama.init_cache(cfg, B, max_len)
+    full, _ = llama.forward_cached(
+        params, toks, positions, cache, 0, mask, cfg)
+    last, _ = llama.forward_cached(
+        params, toks, positions, cache, 0, mask, cfg,
+        unembed_positions=lens - 1)
+    expect = jnp.take_along_axis(full, (lens - 1)[:, None, None], axis=1)
+    np.testing.assert_allclose(np.asarray(last), np.asarray(expect),
+                               rtol=1e-5, atol=1e-5)
