@@ -175,7 +175,10 @@ def logs(service, pod, tail, follow, level, request_id):
     controller_url = get_config().controller_url
     filters = {k: v for k, v in
                {"level": level, "request_id": request_id}.items() if v}
-    if (follow or filters) and controller_url:
+    # Sink-first whenever a controller is configured: it holds the full
+    # durable history (and labels), while backend logs are whatever the
+    # pod runtime still has. Backend is the no-controller fallback only.
+    if controller_url:
         from kubetorch_tpu.observability.streaming import (
             format_entry,
             iter_logs,

@@ -42,8 +42,18 @@ def _event_service(event: Dict[str, Any],
     return ""
 
 
+def _event_marker(event: Dict[str, Any]) -> str:
+    return (f"{event.get('count', 0)}:"
+            f"{event.get('metadata', {}).get('resourceVersion', '')}")
+
+
 def format_event(event: Dict[str, Any], service: str = "") -> Dict[str, Any]:
-    """One LogSink entry per event, Loki-label-shaped."""
+    """One LogSink entry per event, Loki-label-shaped.
+
+    ``event_uid``/``event_marker`` labels let a restarted watcher rebuild
+    its dedup state from the (now durable) sink instead of re-pushing
+    every still-live event after each controller restart.
+    """
     obj = event.get("involvedObject") or {}
     ts = (event.get("lastTimestamp") or event.get("eventTime")
           or event.get("firstTimestamp") or "")
@@ -63,6 +73,8 @@ def format_event(event: Dict[str, Any], service: str = "") -> Dict[str, Any]:
             "level": ("error" if event.get("type") == "Warning" else "info"),
             "source": "k8s-event",
             "event_time": str(ts),
+            "event_uid": event.get("metadata", {}).get("uid", ""),
+            "event_marker": _event_marker(event),
         },
     }
 
@@ -78,6 +90,14 @@ class EventWatcher:
         self.interval = interval
         self.list_services = list_services or (lambda: [])
         self._seen: Dict[str, str] = {}  # uid -> resourceVersion/count
+        # Rebuild dedup state from the sink (durable across restarts):
+        # K8s events live ~1h, so without this every restart re-pushes —
+        # and re-persists — every still-live event.
+        for entry in log_sink.query({"job": EVENTS_JOB}, limit=10_000):
+            labels = entry.get("labels", {})
+            uid = labels.get("event_uid")
+            if uid:
+                self._seen[uid] = labels.get("event_marker", "")
         self._task: Optional[asyncio.Task] = None
         self._started_at = time.time()
 
@@ -112,8 +132,7 @@ class EventWatcher:
         current: Dict[str, str] = {}
         for event in events:
             uid = event.get("metadata", {}).get("uid", "")
-            marker = (f"{event.get('count', 0)}:"
-                      f"{event.get('metadata', {}).get('resourceVersion', '')}")
+            marker = _event_marker(event)
             if not uid:
                 continue
             current[uid] = marker

@@ -137,11 +137,33 @@ class ControllerServer:
             os.environ.get("KT_AUTH_CACHE_TTL", "60"))
         self.cluster_config: Dict[str, Any] = {}
         # Controller-hosted observability sinks (SURVEY.md §5.5; reference
-        # deploys Loki + Prometheus as separate components).
+        # deploys Loki + Prometheus as separate components, both durable —
+        # values.yaml logStreaming/metrics). Durability here: JSONL log
+        # segments + metrics snapshot under KT_OBS_DIR (defaults to
+        # <db>.obs/ next to a file-backed SQLite; in-memory DB ⇒ in-memory
+        # sinks, e.g. tests).
         from kubetorch_tpu.observability.log_sink import LogSink, MetricsStore
 
-        self.log_sink = LogSink()
-        self.metrics_store = MetricsStore()
+        obs_dir = os.environ.get("KT_OBS_DIR") or (
+            f"{db_path}.obs" if db_path != ":memory:" else None)
+        persist = snapshot = None
+        if obs_dir:
+            from pathlib import Path
+
+            from kubetorch_tpu.observability.persist import (
+                LogPersistence,
+                MetricsSnapshot,
+            )
+
+            retain_mb = float(os.environ.get("KT_LOG_RETAIN_MB", "256"))
+            retain_h = float(os.environ.get("KT_LOG_RETAIN_HOURS", "72"))
+            persist = LogPersistence(
+                Path(obs_dir) / "logs",
+                retain_bytes=int(retain_mb * 1024 * 1024),
+                retain_secs=retain_h * 3600.0)
+            snapshot = MetricsSnapshot(Path(obs_dir) / "metrics.json")
+        self.log_sink = LogSink(persist=persist)
+        self.metrics_store = MetricsStore(snapshot=snapshot)
         # cluster events → log sink (reference: event_watcher.py → Loki
         # under job="kubetorch-events"); only when k8s creds exist.
         from kubetorch_tpu.controller.event_watcher import EventWatcher
@@ -205,6 +227,9 @@ class ControllerServer:
         if self._reaper_task:
             self._reaper_task.cancel()
         self.event_watcher.stop()
+        if self.log_sink.persist is not None:
+            self.log_sink.persist.close()
+        self.metrics_store.flush()
         if self._auth_session is not None and not self._auth_session.closed:
             await self._auth_session.close()
 

@@ -39,20 +39,35 @@ def _matches(entry: Dict[str, Any], filters: Dict[str, str]) -> bool:
 
 
 class LogSink:
-    """In-memory label-indexed log store with live-tail subscriptions."""
+    """Label-indexed log store with live-tail subscriptions.
+
+    Hot path is in-memory rings; pass ``persist`` (a
+    :class:`~kubetorch_tpu.observability.persist.LogPersistence`) to spill
+    every push to JSONL segments and survive controller restarts — the
+    constructor replays persisted entries (and stream drops) back into the
+    rings.
+    """
 
     def __init__(self, max_entries_per_stream: int = 50_000,
-                 max_streams: int = 500):
+                 max_streams: int = 500, persist=None):
         self.max_entries = max_entries_per_stream
         self.max_streams = max_streams
         self._streams: Dict[str, deque] = {}
         self._subscribers: List[tuple] = []  # (asyncio.Queue, filters)
+        self.persist = persist
+        if persist is not None:
+            persist.replay(self._push_mem, self._drop_mem)
 
     # ------------------------------------------------------------- core
     def _stream_key(self, labels: Dict[str, Any]) -> str:
         return labels.get("service") or labels.get("job") or "_default"
 
     def push(self, entries: List[Dict[str, Any]]):
+        if self.persist is not None:
+            self.persist.append(entries)
+        self._push_mem(entries)
+
+    def _push_mem(self, entries: List[Dict[str, Any]]):
         for entry in entries:
             key = self._stream_key(entry.get("labels", {}))
             stream = self._streams.get(key)
@@ -105,6 +120,11 @@ class LogSink:
     def drop_stream(self, service: str):
         """Teardown hook: forget a service's logs (reference: cascading
         delete clears Loki streams, ``helpers/delete_helpers.py``)."""
+        if self.persist is not None:
+            self.persist.append_drop(service)
+        self._drop_mem(service)
+
+    def _drop_mem(self, service: str):
         self._streams.pop(service, None)
 
     # ---------------------------------------------------------- handlers
@@ -169,15 +189,31 @@ class MetricsStore:
     (``serving/metrics_push.py:20``; reaper ``ttl_controller.py:49``).
     """
 
-    def __init__(self, history: int = 60):
+    def __init__(self, history: int = 60, snapshot=None):
         self.history = history
         # service -> pod -> deque[{ts, metrics}]
         self._data: Dict[str, Dict[str, deque]] = {}
+        self.snapshot = snapshot
+        if snapshot is not None:
+            # Rehydrate the latest sample per pod so TTL-reaper activity
+            # state survives a controller restart.
+            for service, pods in snapshot.load().items():
+                for pod, snap in pods.items():
+                    ring = self._data.setdefault(service, {}).setdefault(
+                        pod, deque(maxlen=self.history))
+                    ring.append(snap)
+
+    def _snapshot_data(self) -> Dict[str, Dict[str, Any]]:
+        return {service: {pod: ring[-1] for pod, ring in pods.items()
+                          if ring}
+                for service, pods in self._data.items()}
 
     def push(self, service: str, pod: str, metrics: Dict[str, Any]):
         pods = self._data.setdefault(service, {})
         ring = pods.setdefault(pod, deque(maxlen=self.history))
         ring.append({"ts": time.time(), "metrics": metrics})
+        if self.snapshot is not None:
+            self.snapshot.maybe_write(self._snapshot_data())
 
     def latest(self, service: str) -> Dict[str, Dict[str, Any]]:
         return {pod: ring[-1] for pod, ring in
@@ -195,6 +231,14 @@ class MetricsStore:
 
     def drop(self, service: str):
         self._data.pop(service, None)
+        if self.snapshot is not None:
+            self.snapshot.maybe_write(self._snapshot_data(), force=True)
+
+    def flush(self):
+        """Final snapshot write + drain (controller shutdown hook)."""
+        if self.snapshot is not None:
+            self.snapshot.maybe_write(self._snapshot_data(), force=True)
+            self.snapshot.close()
 
     # ---------------------------------------------------------- handlers
     async def h_push(self, request: web.Request):

@@ -1,0 +1,170 @@
+"""Durable backing for the controller-hosted observability sinks.
+
+The reference deploys Loki + Prometheus, whose stores survive pod restarts
+(`/root/reference/charts/kubetorch/values.yaml` logStreaming/metrics). The
+TPU build hosts both sinks inside the controller process (SURVEY.md §5.5),
+so durability is this module's job:
+
+- **Logs**: append-only JSONL segment files, rotated by size, replayed into
+  the in-memory rings on startup. Stream drops (service teardown) are
+  control records in the same ordered stream, so a replay converges to the
+  exact pre-restart state. Retention = total-bytes cap + age cap, enforced
+  at rotation (oldest segments deleted first) — the Loki chunk/retention
+  model without the extra deployment.
+- **Metrics**: a periodic atomic JSON snapshot of the latest sample per
+  (service, pod). Metrics arrive once per second per pod; persisting every
+  push would be pure write amplification when the only restart-critical
+  datum is ``last_activity_timestamp`` for the TTL reaper — snapshot
+  granularity (default 10 s) is far below any real TTL.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional
+
+
+class LogPersistence:
+    """Ordered JSONL segment store for log entries + drop records.
+
+    Writes are queued onto a single-thread executor: ``append`` is called
+    from aiohttp handlers, and open/write/flush/rotate on the event loop
+    would stall every concurrent request (tails, health checks) behind the
+    disk. One thread keeps the record order exact.
+    """
+
+    def __init__(self, root: Path,
+                 segment_bytes: int = 16 * 1024 * 1024,
+                 retain_bytes: int = 256 * 1024 * 1024,
+                 retain_secs: float = 72 * 3600.0):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.segment_bytes = segment_bytes
+        self.retain_bytes = retain_bytes
+        self.retain_secs = retain_secs
+        self._fh = None
+        self._current: Optional[Path] = None
+        self._current_size = 0
+        self._io = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="kt-obs-log")
+        # Rotation-only enforcement never fires for low-volume or
+        # frequently-restarted controllers (each lifetime starts a fresh
+        # segment) — prune once at startup too.
+        self._enforce_retention()
+
+    # ------------------------------------------------------------ write
+    def _segment_paths(self) -> List[Path]:
+        return sorted(self.root.glob("*.jsonl"))
+
+    def _open_segment(self):
+        if self._fh is not None and self._current_size < self.segment_bytes:
+            return
+        if self._fh is not None:
+            self._fh.close()
+            self._enforce_retention()
+        self._current = self.root / f"{time.time_ns():020d}.jsonl"
+        self._fh = open(self._current, "a", encoding="utf-8")
+        self._current_size = 0
+
+    def _append_sync(self, entries: List[Dict[str, Any]]):
+        self._open_segment()
+        chunk = "".join(
+            json.dumps(e, separators=(",", ":")) + "\n" for e in entries)
+        self._fh.write(chunk)
+        self._fh.flush()
+        self._current_size += len(chunk)
+
+    def append(self, entries: List[Dict[str, Any]]):
+        self._io.submit(self._append_sync, list(entries))
+
+    def append_drop(self, service: str):
+        self.append([{"_drop": service, "ts": time.time()}])
+
+    def _enforce_retention(self):
+        segments = self._segment_paths()
+        sizes = {p: p.stat().st_size for p in segments if p.exists()}
+        total = sum(sizes.values())
+        cutoff = time.time() - self.retain_secs
+        for p in segments:
+            if p == self._current:
+                continue
+            too_big = total > self.retain_bytes
+            try:
+                too_old = p.stat().st_mtime < cutoff
+            except OSError:
+                continue
+            if too_big or too_old:
+                total -= sizes.get(p, 0)
+                p.unlink(missing_ok=True)
+
+    def close(self):
+        """Drain queued writes and release the segment handle."""
+        self._io.shutdown(wait=True)
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    # ------------------------------------------------------------- read
+    def replay(self, on_entries: Callable[[List[Dict[str, Any]]], None],
+               on_drop: Callable[[str], None], batch: int = 1000):
+        """Feed persisted records, oldest first, into the in-memory sink."""
+        for path in self._segment_paths():
+            pending: List[Dict[str, Any]] = []
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    for line in fh:
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue  # torn tail write from a crash
+                        if "_drop" in rec:
+                            if pending:
+                                on_entries(pending)
+                                pending = []
+                            on_drop(rec["_drop"])
+                            continue
+                        pending.append(rec)
+                        if len(pending) >= batch:
+                            on_entries(pending)
+                            pending = []
+            except OSError:
+                continue
+            if pending:
+                on_entries(pending)
+
+
+class MetricsSnapshot:
+    """Atomic latest-per-pod snapshot for the metrics store."""
+
+    def __init__(self, path: Path, interval: float = 10.0):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.interval = interval
+        self._last_write = 0.0
+        self._io = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="kt-obs-metrics")
+
+    def _write_sync(self, data: Dict[str, Dict[str, Any]]):
+        tmp = self.path.with_suffix(f".tmp{os.getpid()}")
+        tmp.write_text(json.dumps(data, separators=(",", ":")))
+        os.replace(tmp, self.path)
+
+    def maybe_write(self, data: Dict[str, Dict[str, Any]], force=False):
+        now = time.time()
+        if not force and now - self._last_write < self.interval:
+            return
+        self._last_write = now
+        self._io.submit(self._write_sync, data)
+
+    def close(self):
+        self._io.shutdown(wait=True)
+
+    def load(self) -> Dict[str, Dict[str, Any]]:
+        try:
+            return json.loads(self.path.read_text())
+        except (OSError, json.JSONDecodeError):
+            return {}
