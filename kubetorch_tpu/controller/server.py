@@ -51,6 +51,12 @@ class PodConnection:
         self.url = info.get("url", "")
         self.connected_at = time.time()
         self.acks: Dict[str, asyncio.Future] = {}
+        # setup status pushed by the pod ("status" messages): lets launch
+        # waiters fail fast on terminal setup errors even on backends that
+        # can't reach pod IPs directly (k8s readinessProbe only sees a
+        # failing probe, not the reason).
+        self.ready = bool(info.get("ready", False))
+        self.setup_error = info.get("setup_error")
 
 
 class PodHub:
@@ -359,7 +365,8 @@ class ControllerServer:
             raise web.HTTPNotFound(text="no such pool")
         pool["pods"] = [
             {"pod_name": c.pod_name, "url": c.url,
-             "connected_at": c.connected_at}
+             "connected_at": c.connected_at, "ready": c.ready,
+             "setup_error": c.setup_error}
             for c in self.hub.pods_of(pool["service_name"])]
         return web.json_response(pool)
 
@@ -419,6 +426,9 @@ class ControllerServer:
                     fut = conn.acks.get(data.get("reload_id", ""))
                     if fut is not None and not fut.done():
                         fut.set_result(data.get("ok", True))
+                elif mtype == "status" and conn is not None:
+                    conn.ready = bool(data.get("ready", False))
+                    conn.setup_error = data.get("setup_error")
                 elif mtype == "activity" and conn is not None:
                     self.db.touch_pool(conn.service_name)
         finally:

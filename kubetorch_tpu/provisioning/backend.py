@@ -25,7 +25,7 @@ from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 from kubetorch_tpu.config import get_config
-from kubetorch_tpu.exceptions import ServiceTimeoutError
+from kubetorch_tpu.exceptions import ServiceTimeoutError, StartupError
 from kubetorch_tpu.serving import http_client
 
 _LOCAL_ROOT = Path(os.environ.get("KT_LOCAL_STATE",
@@ -155,6 +155,21 @@ class LocalBackend:
             "username": get_config().username,
         })
         self._record_path(service_name).write_text(json.dumps(record, indent=2))
+        # Parity with the k8s backend: when a controller is configured,
+        # the pool exists there too — pods register into it (instead of
+        # parking as "waiting") and push their setup status, and
+        # controller features (push-reload, TTL, pod views) see local
+        # services. Best-effort: a missing controller never blocks local.
+        try:
+            from kubetorch_tpu.controller.client import ControllerClient
+
+            controller = ControllerClient.maybe()
+            if controller is not None:
+                controller.register_pool(
+                    service_name, module_meta, compute=compute_dict,
+                    launch_id=launch_id, broadcast=False)
+        except Exception:
+            pass
         self._wait_ready(record, launch_timeout, launch_id)
         return record
 
@@ -173,9 +188,16 @@ class LocalBackend:
                     raise ServiceTimeoutError(
                         f"pod {pod['index']} of {record['service_name']} "
                         f"exited during launch\n{_log_tail(pod['log'])}")
-                if http_client.is_ready(
-                        f"http://127.0.0.1:{port}", launch_id):
+                ok, fatal = http_client.ready_state(
+                    f"http://127.0.0.1:{port}", launch_id)
+                if ok:
                     del pending[port]
+                elif fatal:
+                    # terminal setup failure (bad import, dead App
+                    # subprocess): fail the launch now, not at timeout
+                    raise StartupError(
+                        f"pod {pod['index']} of {record['service_name']} "
+                        f"failed setup: {fatal}\n{_log_tail(pod['log'])}")
             if pending:
                 time.sleep(delay)
                 delay = min(delay * 1.5, 0.3)

@@ -128,6 +128,7 @@ class K8sBackend:
                     timeout: int, launch_id: str):
         deadline = time.time() + timeout
         want = compute.num_pods
+        controller = self._controller()
         while time.time() < deadline:
             pods = self._pods(service_name, compute.namespace)
             ready = 0
@@ -139,6 +140,23 @@ class K8sBackend:
                     ready += 1
             if ready >= want:
                 return
+            if controller is not None:
+                # Pods push setup status over their controller WS; a
+                # terminal setup error (bad import, dead App subprocess)
+                # only shows up here as a readinessProbe that never goes
+                # green — fail the launch now instead of at timeout.
+                try:
+                    pool = controller.get_pool(service_name) or {}
+                except Exception:
+                    pool = {}
+                for pod_info in pool.get("pods", []):
+                    if pod_info.get("setup_error"):
+                        from kubetorch_tpu.exceptions import StartupError
+
+                        raise StartupError(
+                            f"pod {pod_info.get('pod_name')} of "
+                            f"{service_name} failed setup: "
+                            f"{pod_info['setup_error']}")
             time.sleep(2.0)
         pods = self._pods(service_name, compute.namespace)
         phases = {p["metadata"]["name"]: p.get("status", {}).get("phase")

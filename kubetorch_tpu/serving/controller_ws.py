@@ -35,8 +35,10 @@ class ControllerWebSocket:
         self._task: Optional[asyncio.Task] = None
         self._stop = asyncio.Event()
         self.connected = False
+        self._ws: Optional[aiohttp.ClientWebSocketResponse] = None
 
     def start(self):
+        self._loop = asyncio.get_running_loop()
         self._task = asyncio.create_task(self._run())
 
     async def stop(self):
@@ -66,6 +68,7 @@ class ControllerWebSocket:
                     async with session.ws_connect(
                             self.ws_url, heartbeat=30.0) as ws:
                         self.connected = True
+                        self._ws = ws
                         backoff = 1.0
                         await ws.send_json({
                             "type": "register",
@@ -73,6 +76,10 @@ class ControllerWebSocket:
                             "service_name": self.pod_server.metadata.get(
                                 "service_name", ""),
                             "url": self._self_url(),
+                            # reconnects must carry current state — the
+                            # controller's view resets with the connection
+                            "ready": self.pod_server.ready,
+                            "setup_error": self.pod_server.setup_error,
                         })
                         await self._listen(ws)
             except asyncio.CancelledError:
@@ -81,6 +88,7 @@ class ControllerWebSocket:
                 pass
             finally:
                 self.connected = False
+                self._ws = None
             await asyncio.sleep(min(backoff, 30.0))
             backoff *= 2
 
@@ -92,7 +100,12 @@ class ControllerWebSocket:
             mtype = data.get("type")
             if mtype == "registered":
                 metadata = data.get("metadata")
-                if metadata and not self.pod_server.ready:
+                # App pods run their command from env and gate readiness on
+                # the app's health check — adopting pool metadata must not
+                # spin up a callable supervisor they don't have.
+                is_app = (self.pod_server.metadata.get("callable_type")
+                          == "app")
+                if metadata and not self.pod_server.ready and not is_app:
                     await self._apply_metadata(ws, metadata, reload_id="")
             elif mtype == "metadata":
                 await self._apply_metadata(
@@ -110,6 +123,8 @@ class ControllerWebSocket:
             def do_apply():
                 server = self.pod_server
                 server.metadata.update(metadata)
+                if not server.metadata.get("import_path"):
+                    return  # app/bare pod: nothing to import
                 if server.supervisor is None:
                     server._setup_supervisor()
                 else:
@@ -131,3 +146,25 @@ class ControllerWebSocket:
             await ws.send_json({"type": "activity"})
         except (ConnectionError, RuntimeError):
             pass
+
+    def notify_status(self):
+        """Push the pod's current ready/setup_error to the controller
+        (fire-and-forget; the register message covers reconnects)."""
+        ws = self._ws
+        if ws is None or ws.closed:
+            return
+
+        async def _send():
+            try:
+                await ws.send_json({
+                    "type": "status",
+                    "ready": self.pod_server.ready,
+                    "setup_error": self.pod_server.setup_error,
+                })
+            except Exception:
+                pass
+
+        try:
+            asyncio.get_running_loop().create_task(_send())
+        except RuntimeError:  # called from a worker thread
+            asyncio.run_coroutine_threadsafe(_send(), self._loop)

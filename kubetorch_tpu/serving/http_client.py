@@ -171,11 +171,24 @@ def get_json(base_url: str, path: str, timeout: float = 10.0) -> Any:
     return resp.status_code, (resp.json() if resp.content else None)
 
 
-def is_ready(base_url: str, launch_id: str = "", timeout: float = 5.0) -> bool:
+def ready_state(base_url: str, launch_id: str = "",
+                timeout: float = 5.0):
+    """→ (ready, fatal_reason). A 500 from /ready is a terminal setup
+    failure (bad import, crashed App subprocess) — callers should stop
+    polling and surface it instead of burning the launch timeout."""
     try:
         params = {"launch_id": launch_id} if launch_id else {}
         resp = sync_client().get(
             f"{base_url.rstrip('/')}/ready", params=params, timeout=timeout)
-        return resp.status_code == 200 and resp.json().get("ready", False)
+        data = resp.json()
+        if resp.status_code == 200 and data.get("ready", False):
+            return True, None
+        if resp.status_code == 500:
+            return False, data.get("reason") or "setup failed"
+        return False, None
     except (httpx.HTTPError, ValueError):
-        return False
+        return False, None
+
+
+def is_ready(base_url: str, launch_id: str = "", timeout: float = 5.0) -> bool:
+    return ready_state(base_url, launch_id, timeout)[0]

@@ -132,7 +132,17 @@ class PodServer:
                 self._activity_loop(controller_url))
         if self.metadata.get("callable_type") == "app":
             await self._start_app_cmd()
-            self.ready = True
+            if (self.metadata.get("app_health_path")
+                    and self.metadata.get("app_port")):
+                # Readiness gates on the app's own health endpoint
+                # (reference: resources/compute/app.py:20 health_path +
+                # app status handling in serving/http_server.py:1700) —
+                # an App pod must not be "ready" the instant the
+                # subprocess spawns.
+                self._app_ready_task = asyncio.create_task(
+                    self._app_readiness_loop())
+            else:
+                self.ready = True
             return
         if self.metadata.get("import_path"):
             # Setup in a thread: subprocess spawn + user imports are slow.
@@ -149,12 +159,22 @@ class PodServer:
         except Exception as exc:  # surfaced via /ready
             self.setup_error = f"{type(exc).__name__}: {exc}"
             self.ready = False
+        self._notify_status()
+
+    def _notify_status(self):
+        """Tell the controller about a ready/setup_error transition so
+        launch waiters on probe-only backends (k8s) fail fast too."""
+        ws = getattr(self, "controller_ws", None)
+        if ws is not None:
+            ws.notify_status()
 
     async def _on_shutdown(self, app):
         if getattr(self, "controller_ws", None) is not None:
             await self.controller_ws.stop()
         if getattr(self, "_activity_task", None) is not None:
             self._activity_task.cancel()
+        if getattr(self, "_app_ready_task", None) is not None:
+            self._app_ready_task.cancel()
         if self.supervisor is not None:
             self.supervisor.cleanup()
         if self.app_proc and self.app_proc.returncode is None:
@@ -210,6 +230,38 @@ class PodServer:
         self.app_proc = await asyncio.create_subprocess_shell(
             cmd, cwd=self.metadata.get("root_path") or None)
 
+    async def _app_readiness_loop(self):
+        """Poll the app's health path until it answers 200, then flip
+        ready. A dead subprocess fails fast (setup_error carries the exit
+        code) instead of polling a corpse until the client times out."""
+        import aiohttp as _aiohttp
+
+        port = self.metadata["app_port"]
+        path = "/" + self.metadata["app_health_path"].lstrip("/")
+        url = f"http://127.0.0.1:{port}{path}"
+        interval = float(os.environ.get("KT_APP_HEALTH_INTERVAL", "0.5"))
+        async with ClientSession(
+                timeout=_aiohttp.ClientTimeout(total=5.0)) as s:
+            while True:
+                if self.app_proc is not None and \
+                        self.app_proc.returncode is not None:
+                    # any pre-health exit — 0 included — means the server
+                    # the health path belongs to will never answer
+                    self.setup_error = (
+                        f"app exited with code {self.app_proc.returncode} "
+                        f"before passing health check {path}")
+                    self._notify_status()
+                    return
+                try:
+                    async with s.get(url) as resp:
+                        if resp.status == 200:
+                            self.ready = True
+                            self._notify_status()
+                            return
+                except Exception:
+                    pass
+                await asyncio.sleep(interval)
+
     # ----------------------------------------------------- middleware
     @web.middleware
     async def _mw_request_id(self, request: web.Request, handler):
@@ -262,6 +314,16 @@ class PodServer:
         if self.setup_error:
             return web.json_response(
                 {"ready": False, "reason": self.setup_error}, status=500)
+        # A crashed App is never ready, even after it once was: autoscalers
+        # and clients must see the failure, not a stale ready=True. Exit 0
+        # is NOT a crash — kt.app also runs short-lived CLI commands that
+        # complete normally (h_app_status models that as a regular state).
+        if self.app_proc is not None and \
+                self.app_proc.returncode not in (None, 0):
+            return web.json_response(
+                {"ready": False,
+                 "reason": ("app exited with code "
+                            f"{self.app_proc.returncode}")}, status=500)
         if not self.ready:
             return web.json_response(
                 {"ready": False, "reason": "setting up"}, status=503)

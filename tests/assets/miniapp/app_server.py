@@ -1,0 +1,40 @@
+"""Tiny HTTP app for kt.app e2e tests: binds late to prove readiness gating.
+
+Sleeps KT_TEST_APP_DELAY seconds BEFORE binding its port, then serves
+/healthz (200) and /greet (JSON). A pod server that marks itself ready the
+instant the subprocess spawns would hand clients connection errors for the
+whole delay window.
+"""
+
+import json
+import os
+import sys
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+
+class Handler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        if self.path == "/healthz":
+            body = b'{"ok": true}'
+        elif self.path.startswith("/greet"):
+            body = json.dumps({"hello": "from-miniapp",
+                               "pid": os.getpid()}).encode()
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+if __name__ == "__main__":
+    time.sleep(float(os.environ.get("KT_TEST_APP_DELAY", "0")))
+    port = int(sys.argv[1])
+    HTTPServer(("127.0.0.1", port), Handler).serve_forever()

@@ -1,0 +1,79 @@
+"""kt.app end-to-end: health-gated readiness, /http proxy, crash surfacing.
+
+Reference: resources/compute/app.py:20 (health_path) + app status handling
+in serving/http_server.py:1700 — an App pod is ready only when its own
+health endpoint answers, and an exited app surfaces through /ready.
+"""
+
+import os
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+import kubetorch_tpu as kt
+from kubetorch_tpu.exceptions import StartupError
+
+ASSETS = Path(__file__).parent / "assets" / "miniapp"
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _local_state(tmp_path_factory):
+    state = tmp_path_factory.mktemp("ktlocal-app")
+    os.environ["KT_LOCAL_STATE"] = str(state)
+    import kubetorch_tpu.provisioning.backend as backend
+
+    backend._LOCAL_ROOT = state
+    yield
+    for record in backend.LocalBackend().list_services():
+        backend.LocalBackend().teardown(record["service_name"], quiet=True)
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.level("minimal")
+def test_app_health_gated_readiness_and_proxy(monkeypatch):
+    """Deploy a real HTTP server that binds its port only after a delay:
+    .to() must block until the app's own /healthz answers, so the very
+    first /http proxy call succeeds — no ready-before-alive race."""
+    monkeypatch.setenv("KT_TEST_APP_DELAY", "1.5")
+    port = _free_port()
+    app = kt.app(
+        command=f"{sys.executable} {ASSETS / 'app_server.py'} {port}",
+        name="miniapp", port=port, health_path="/healthz",
+        root_path=str(ASSETS))
+    t0 = time.monotonic()
+    app.to(kt.Compute(cpus="0.1"))
+    launch_s = time.monotonic() - t0
+    try:
+        # readiness waited out the bind delay
+        assert launch_s >= 1.5, f"ready before the app bound ({launch_s}s)"
+        # first proxied request works immediately — that's the point
+        out = app.request("/greet")
+        assert out["hello"] == "from-miniapp"
+        status = app.status()
+        assert status["running"] is True
+    finally:
+        app.teardown()
+
+
+@pytest.mark.level("minimal")
+def test_app_crash_fails_launch_fast():
+    """An app that exits before passing its health check must fail .to()
+    quickly with the exit code — not burn the whole launch timeout."""
+    port = _free_port()
+    app = kt.app(
+        command=f"{sys.executable} -c 'import sys; sys.exit(3)'",
+        name="miniapp-crash", port=port, health_path="/healthz",
+        root_path=str(ASSETS))
+    t0 = time.monotonic()
+    with pytest.raises(StartupError, match="exited with code 3"):
+        app.to(kt.Compute(cpus="0.1", launch_timeout=60))
+    assert time.monotonic() - t0 < 30, "burned the launch timeout"
