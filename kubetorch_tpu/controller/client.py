@@ -32,7 +32,14 @@ class ControllerClient:
         token = token or os.environ.get("KT_CONTROLLER_TOKEN")
         if token:
             headers["Authorization"] = f"Bearer {token}"
-        self.client = httpx.Client(timeout=_TIMEOUT, headers=headers)
+        from kubetorch_tpu.retry import attempts
+
+        # Connect-level retries (reference: the controller wraps K8s calls
+        # in a retry decorator, server.py:82): a controller mid-restart
+        # refuses connections for a moment; re-dialing is always safe.
+        self.client = httpx.Client(
+            timeout=_TIMEOUT, headers=headers,
+            transport=httpx.HTTPTransport(retries=max(0, attempts() - 1)))
 
     @classmethod
     def maybe(cls) -> Optional["ControllerClient"]:

@@ -122,8 +122,8 @@ def _fetch_into_cache(backend, key: str, cache_root: Path,
 
     excludes = DEFAULT_EXCLUDES if excludes is None else excludes
     local = cache_root / key
-    manifest_resp = backend.client.get(
-        backend._url(f"/tree/{key}/manifest"))
+    manifest_resp = backend._request(
+        "GET", backend._url(f"/tree/{key}/manifest"))
     if manifest_resp.status_code == 404:
         blob = backend.get_blob(key)
         local.parent.mkdir(parents=True, exist_ok=True)

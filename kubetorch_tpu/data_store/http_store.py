@@ -19,6 +19,11 @@ from typing import List
 import httpx
 
 from kubetorch_tpu.exceptions import DataStoreError, RsyncError
+from kubetorch_tpu.retry import (
+    RetryableStatus,
+    raise_if_retryable,
+    with_retries,
+)
 from kubetorch_tpu.data_store.sync import (
     DEFAULT_EXCLUDES,
     diff_manifests,
@@ -36,6 +41,26 @@ class HttpStoreBackend:
     def _url(self, path: str) -> str:
         return f"{self.base_url}{path}"
 
+    def _request(self, method: str, url: str, **kw) -> httpx.Response:
+        """One store request with bounded retries (reference: the rsync
+        client retries every transfer, rsync_client.py:41). Every store
+        operation is idempotent, so transport errors AND 502/503/504 are
+        safely re-run."""
+
+        def attempt():
+            resp = self.client.request(method, url, **kw)
+            raise_if_retryable(resp)
+            return resp
+
+        try:
+            return with_retries(attempt)
+        except RetryableStatus as exc:
+            # exhaustion surfaces in the store's own error contract so
+            # callers' except DataStoreError fallbacks still fire
+            raise DataStoreError(
+                f"store {method} {url} failed after retries: {exc}",
+                status=exc.status) from None
+
     def _raise_for(self, resp: httpx.Response, action: str):
         if resp.status_code >= 400:
             raise DataStoreError(
@@ -49,8 +74,8 @@ class HttpStoreBackend:
         if src.is_file():
             return self.put_blob(key, src.read_bytes())
         manifest = scan_tree(src, excludes, with_hash=True)
-        resp = self.client.post(
-            self._url(f"/tree/{key}/diff"),
+        resp = self._request(
+            "POST", self._url(f"/tree/{key}/diff"),
             json={k: list(v) for k, v in manifest.items()})
         self._raise_for(resp, "diff")
         delta = resp.json()
@@ -59,8 +84,8 @@ class HttpStoreBackend:
         with tarfile.open(fileobj=buf, mode="w:gz") as tar:
             for rel in need:
                 tar.add(src / rel, arcname=rel)
-        resp = self.client.post(
-            self._url(f"/tree/{key}/upload"),
+        resp = self._request(
+            "POST", self._url(f"/tree/{key}/upload"),
             content=buf.getvalue(),
             headers={"X-KT-Delete": json.dumps(delta["extraneous"]),
                      "Content-Type": "application/gzip"})
@@ -75,7 +100,7 @@ class HttpStoreBackend:
 
             return broadcast_get(self, key, broadcast, dest=dest,
                                  excludes=excludes)
-        resp = self.client.get(self._url(f"/tree/{key}/manifest"))
+        resp = self._request("GET", self._url(f"/tree/{key}/manifest"))
         if resp.status_code == 404:
             # single file stored as blob
             blob = self.get_blob(key)
@@ -90,8 +115,9 @@ class HttpStoreBackend:
         local = scan_tree(dest, excludes, with_hash=True)
         need, extraneous = diff_manifests(remote, local, use_hash=True)
         if need:
-            resp = self.client.post(
-                self._url(f"/tree/{key}/archive"), json={"paths": need})
+            resp = self._request(
+                "POST", self._url(f"/tree/{key}/archive"),
+                json={"paths": need})
             self._raise_for(resp, "archive")
             with tarfile.open(fileobj=io.BytesIO(resp.content),
                               mode="r:*") as tar:
@@ -105,7 +131,8 @@ class HttpStoreBackend:
 
     # ---------------------------------------------------------- blobs
     def put_blob(self, key: str, blob: bytes, **kw) -> str:
-        resp = self.client.put(self._url(f"/blob/{key}"), content=blob)
+        resp = self._request("PUT", self._url(f"/blob/{key}"),
+                             content=blob)
         self._raise_for(resp, "put")
         return key
 
@@ -114,7 +141,7 @@ class HttpStoreBackend:
             from kubetorch_tpu.data_store.broadcast import broadcast_get
 
             return broadcast_get(self, key, broadcast)
-        resp = self.client.get(self._url(f"/blob/{key}"))
+        resp = self._request("GET", self._url(f"/blob/{key}"))
         if resp.status_code == 404:
             raise DataStoreError(f"no such key {key!r}", status=404)
         self._raise_for(resp, "get")
@@ -122,51 +149,52 @@ class HttpStoreBackend:
 
     # ------------------------------------------------------- metadata
     def list_keys(self, prefix: str = "", **kw) -> List[dict]:
-        resp = self.client.get(self._url("/keys"), params={"prefix": prefix})
+        resp = self._request("GET", self._url("/keys"),
+                             params={"prefix": prefix})
         self._raise_for(resp, "ls")
         return resp.json()["keys"]
 
     def delete(self, key: str, recursive: bool = False, **kw) -> int:
-        resp = self.client.delete(
-            self._url(f"/key/{key}"),
+        resp = self._request(
+            "DELETE", self._url(f"/key/{key}"),
             params={"recursive": "true" if recursive else "false"})
         self._raise_for(resp, "rm")
         return resp.json()["deleted"]
 
     # ------------------------------------------------- broadcast groups
     def bcast_join(self, group: str, **info) -> dict:
-        resp = self.client.post(self._url(f"/broadcast/{group}/join"),
-                                json=info)
+        resp = self._request("POST", self._url(f"/broadcast/{group}/join"),
+                             json=info)
         self._raise_for(resp, "broadcast join")
         return resp.json()
 
     def bcast_member(self, group: str, member_id: str) -> dict:
-        resp = self.client.get(self._url(f"/broadcast/{group}/member"),
-                               params={"member_id": member_id})
+        resp = self._request("GET", self._url(f"/broadcast/{group}/member"),
+                             params={"member_id": member_id})
         self._raise_for(resp, "broadcast poll")
         return resp.json()
 
     def bcast_complete(self, group: str, member_id: str,
                        serve_url=None) -> dict:
-        resp = self.client.post(
-            self._url(f"/broadcast/{group}/complete"),
+        resp = self._request(
+            "POST", self._url(f"/broadcast/{group}/complete"),
             json={"member_id": member_id, "serve_url": serve_url})
         self._raise_for(resp, "broadcast complete")
         return resp.json()
 
     def bcast_status(self, group: str) -> dict:
-        resp = self.client.get(self._url(f"/broadcast/{group}/status"))
+        resp = self._request("GET", self._url(f"/broadcast/{group}/status"))
         self._raise_for(resp, "broadcast status")
         return resp.json()
 
     # ------------------------------------------------------- P2P hooks
     def register_source(self, key: str, url: str):
-        resp = self.client.post(self._url(f"/sources/{key}"),
-                                json={"url": url})
+        resp = self._request("POST", self._url(f"/sources/{key}"),
+                             json={"url": url})
         self._raise_for(resp, "register_source")
 
     def get_source(self, key: str) -> dict:
-        resp = self.client.get(self._url(f"/sources/{key}"))
+        resp = self._request("GET", self._url(f"/sources/{key}"))
         if resp.status_code == 404:
             raise DataStoreError(f"no source for {key!r}", status=404)
         self._raise_for(resp, "get_source")

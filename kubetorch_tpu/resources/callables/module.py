@@ -12,8 +12,10 @@ with the username so shared clusters don't collide.
 from __future__ import annotations
 
 import json
+import os
 import re
 import uuid
+from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 from kubetorch_tpu.config import get_config
@@ -50,6 +52,7 @@ class Module:
         self.service_name: Optional[str] = None
         self._backend = None
         self._launch_id: Optional[str] = None
+        self._code_key: Optional[str] = None  # store key of synced code
 
     # ------------------------------------------------------------------
     @property
@@ -99,7 +102,33 @@ class Module:
             "framework": framework,
             "distributed": distributed,
             "allowed_serialization": list(compute.allowed_serialization),
+            "code_key": self._code_key,
         }
+
+    # ------------------------------------------------------------------
+    def _sync_code(self, compute: Compute) -> Optional[str]:
+        """Delta-sync ``root_path`` into the data store so pods can pull it
+        (reference: deploy-time rsync, ``data_store/rsync_client.py``).
+
+        ``compute.freeze=True`` skips the sync entirely — the user
+        guarantees the image already carries the code (reference: freeze
+        skips code-sync on deploy). Mode via ``KT_CODE_SYNC``:
+        ``auto`` (default) syncs on cluster backends only — local pods
+        share the client's filesystem; ``always``/``never`` force it.
+        """
+        mode = os.environ.get("KT_CODE_SYNC", "auto")
+        if compute.freeze or not self.root_path or mode == "never":
+            return None
+        if mode == "auto":
+            from kubetorch_tpu.provisioning.k8s_backend import K8sBackend
+
+            if not isinstance(self.backend, K8sBackend):
+                return None
+        from kubetorch_tpu.data_store.client import DataStoreClient
+
+        key = f"code/{self.service_name}"
+        DataStoreClient.default().put_path(key, Path(self.root_path))
+        return key
 
     def _module_env(self) -> Dict[str, str]:
         meta = self.module_metadata()
@@ -113,6 +142,8 @@ class Module:
             "KT_ALLOWED_SERIALIZATION": ",".join(
                 meta["allowed_serialization"]),
         }
+        if meta.get("code_key"):
+            env["KT_CODE_KEY"] = meta["code_key"]
         if meta.get("framework"):
             env["KT_FRAMEWORK"] = meta["framework"]
         if meta.get("init_args") is not None:
@@ -135,6 +166,7 @@ class Module:
         self.compute = compute
         self.service_name = self._compute_service_name(name)
         self._launch_id = uuid.uuid4().hex[:8]
+        self._code_key = self._sync_code(compute)
         streamer = self._maybe_stream_logs()
         try:
             self.backend.launch(
@@ -255,6 +287,11 @@ class Module:
     def reload_code(self):
         """Re-sync code + hot-reload the callable on every pod."""
         self._ensure_deployed()
+        if self.compute is not None and self.compute.freeze:
+            raise KubetorchError(
+                f"{self.service_name} was deployed with freeze=True: code "
+                "is pinned to the image; redeploy without freeze to sync")
+        self._code_key = self._sync_code(self.compute or Compute())
         self.backend.reload(self.service_name, self.module_metadata())
 
     def teardown(self):

@@ -1,0 +1,103 @@
+"""Bounded exponential retry for transient transport failures.
+
+Reference: the rsync client wraps every transfer in a retry loop
+(``data_store/rsync_client.py:41``) and the controller wraps K8s calls in a
+retry decorator (``services/kubetorch_controller/server.py:82``). Here one
+policy object serves all clients, with two safety tiers:
+
+- ``transport``: retries any ``httpx.TransportError`` plus HTTP
+  502/503/504 via ``RetryableStatus``. Only for idempotent operations
+  (data-plane transfers, controller reads/upserts) — a re-run must be
+  harmless.
+- ``connect``: retries only errors raised **before the request reached the
+  server** (``httpx.ConnectError``/``ConnectTimeout``). Safe for anything,
+  including non-idempotent user-function calls: the server never saw the
+  attempt, so nothing can double-execute.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Tuple, Type
+
+import httpx
+
+DEFAULT_ATTEMPTS = 3  # override via KT_RETRY_ATTEMPTS
+
+
+class RetryableStatus(Exception):
+    """Internal marker: an idempotent call got a 5xx worth re-trying."""
+
+    def __init__(self, status: int, text: str = ""):
+        super().__init__(f"HTTP {status}: {text[:200]}")
+        self.status = status
+
+
+CONNECT_ERRORS: Tuple[Type[BaseException], ...] = (
+    httpx.ConnectError, httpx.ConnectTimeout)
+TRANSPORT_ERRORS: Tuple[Type[BaseException], ...] = (
+    httpx.TransportError, RetryableStatus)
+
+
+def attempts() -> int:
+    import os
+
+    try:
+        return max(1, int(os.environ.get("KT_RETRY_ATTEMPTS",
+                                         DEFAULT_ATTEMPTS)))
+    except ValueError:
+        return DEFAULT_ATTEMPTS
+
+
+def with_retries(
+    fn: Callable,
+    *,
+    retry_on: Tuple[Type[BaseException], ...] = TRANSPORT_ERRORS,
+    max_attempts: int = 0,
+    base_delay: float = 0.25,
+    max_delay: float = 4.0,
+):
+    """Run ``fn()``; on a retryable error, back off exponentially (with
+    jitter) and re-run, raising the last error after ``max_attempts``."""
+    n = max_attempts or attempts()
+    delay = base_delay
+    for attempt in range(1, n + 1):
+        try:
+            return fn()
+        except retry_on:
+            if attempt == n:
+                raise
+            time.sleep(delay * (0.7 + 0.6 * random.random()))
+            delay = min(delay * 2, max_delay)
+
+
+def raise_if_retryable(resp: "httpx.Response"):
+    """Map gateway-transient responses (502/503/504) to
+    :class:`RetryableStatus`. Plain 500s and all 4xx are the caller's
+    problem — a 500 usually means a server bug, not a transient."""
+    if resp.status_code in (502, 503, 504):
+        raise RetryableStatus(resp.status_code, resp.text)
+
+
+async def with_retries_async(
+    fn,
+    *,
+    retry_on: Tuple[Type[BaseException], ...] = TRANSPORT_ERRORS,
+    max_attempts: int = 0,
+    base_delay: float = 0.25,
+    max_delay: float = 4.0,
+):
+    """Async twin of :func:`with_retries` (same policy, one place)."""
+    import asyncio
+
+    n = max_attempts or attempts()
+    delay = base_delay
+    for attempt in range(1, n + 1):
+        try:
+            return await fn()
+        except retry_on:
+            if attempt == n:
+                raise
+            await asyncio.sleep(delay * (0.7 + 0.6 * random.random()))
+            delay = min(delay * 2, max_delay)

@@ -128,6 +128,7 @@ class ControllerWebSocket:
                 if server.supervisor is None:
                     server._setup_supervisor()
                 else:
+                    server._pull_code()
                     server.supervisor.reload(server.metadata)
                     server.ready = True
 

@@ -14,6 +14,11 @@ import httpx
 
 from kubetorch_tpu import serialization
 from kubetorch_tpu.exceptions import rehydrate_exception
+from kubetorch_tpu.retry import (
+    CONNECT_ERRORS,
+    with_retries,
+    with_retries_async,
+)
 
 _TIMEOUT = httpx.Timeout(connect=10.0, read=None, write=60.0, pool=10.0)
 
@@ -95,9 +100,18 @@ def call_method(
     if stream:
         headers = {**headers, "X-KT-Stream": "request"}
         return _stream_call(url, body, headers, query, timeout)
-    resp = sync_client().post(
-        url, content=body, headers=headers, params=query or {},
-        timeout=timeout if timeout is not None else _TIMEOUT)
+
+    # Connect-tier retries only: a connection that never reached the pod
+    # (reset mid-deploy, pod restarting) is always safe to re-dial, while
+    # re-POSTing after a read failure could double-execute a
+    # non-idempotent user function. Reference: rsync_client.py:41 retry
+    # discipline, applied to the call path with the narrower error set.
+    def attempt():
+        return sync_client().post(
+            url, content=body, headers=headers, params=query or {},
+            timeout=timeout if timeout is not None else _TIMEOUT)
+
+    resp = with_retries(attempt, retry_on=CONNECT_ERRORS)
     return _handle(resp)
 
 
@@ -159,9 +173,14 @@ async def call_method_async(
     url = f"{base_url.rstrip('/')}/{callable_name}"
     if method:
         url += f"/{method}"
-    resp = await async_client().post(
-        url, content=body, headers=headers, params=query or {},
-        timeout=timeout if timeout is not None else _TIMEOUT)
+
+    # same connect-tier-only retry discipline as call_method
+    async def attempt():
+        return await async_client().post(
+            url, content=body, headers=headers, params=query or {},
+            timeout=timeout if timeout is not None else _TIMEOUT)
+
+    resp = await with_retries_async(attempt, retry_on=CONNECT_ERRORS)
     return _handle(resp)
 
 

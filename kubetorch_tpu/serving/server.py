@@ -69,6 +69,8 @@ def metadata_from_env() -> Dict[str, Any]:
         meta["app_cmd"] = os.environ["KT_APP_CMD"]
         meta["app_port"] = int(os.environ.get("KT_APP_PORT", "0") or 0)
         meta["app_health_path"] = os.environ.get("KT_APP_HEALTH_PATH", "")
+    if os.environ.get("KT_CODE_KEY"):
+        meta["code_key"] = os.environ["KT_CODE_KEY"]
     return meta
 
 
@@ -150,8 +152,27 @@ class PodServer:
         else:
             self.ready = True  # bare pod waiting for controller metadata push
 
+    def _pull_code(self):
+        """Fetch synced user code from the data store and point root_path
+        at the local copy (reference: deploy rsync → pod-side pull). Runs
+        before every supervisor (re)setup so push-reloads pick up deltas
+        — the store's tree diff makes unchanged re-pulls near-free."""
+        key = self.metadata.get("code_key")
+        if not key:
+            return
+        from pathlib import Path
+
+        from kubetorch_tpu.data_store import commands
+
+        dest = (Path(os.environ.get("KT_CODE_DEST",
+                                    "~/.ktpu/code")).expanduser()
+                / self.metadata.get("service_name", "svc"))
+        commands.workdir_sync(key, dest)
+        self.metadata["root_path"] = str(dest)
+
     def _setup_supervisor(self):
         try:
+            self._pull_code()
             self.supervisor = supervisor_factory(self.metadata)
             self.supervisor.setup()
             self.ready = True
@@ -353,6 +374,7 @@ class PodServer:
             if self.supervisor is None:
                 self._setup_supervisor()
             else:
+                self._pull_code()
                 self.supervisor.reload(self.metadata)
                 self.ready = True
 

