@@ -247,7 +247,7 @@ def broadcast_get(store_backend, key: str, window: BroadcastWindow,
 
     parent_url = state["parent"]
     parent = (store_backend if parent_url == ""
-              else HttpStoreBackend(parent_url))
+              else HttpStoreBackend(parent_url, retry_attempts=1))
     import httpx
 
     try:

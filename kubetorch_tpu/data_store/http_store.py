@@ -34,8 +34,13 @@ _TIMEOUT = httpx.Timeout(connect=10.0, read=600.0, write=600.0, pool=10.0)
 
 
 class HttpStoreBackend:
-    def __init__(self, base_url: str):
+    def __init__(self, base_url: str, retry_attempts: int = 0):
+        """``retry_attempts``: 0 = policy default (KT_RETRY_ATTEMPTS);
+        1 = fail fast — used for broadcast *peer* fetches, where a dead
+        parent should trigger the store fallback immediately instead of
+        backing off against a corpse."""
         self.base_url = base_url.rstrip("/")
+        self.retry_attempts = retry_attempts
         self.client = httpx.Client(timeout=_TIMEOUT)
 
     def _url(self, path: str) -> str:
@@ -53,7 +58,7 @@ class HttpStoreBackend:
             return resp
 
         try:
-            return with_retries(attempt)
+            return with_retries(attempt, max_attempts=self.retry_attempts)
         except RetryableStatus as exc:
             # exhaustion surfaces in the store's own error contract so
             # callers' except DataStoreError fallbacks still fire

@@ -16,6 +16,14 @@ and materializes a ``jax.sharding.Mesh``. Axis order is chosen so that the
 highest-bandwidth-demand axis (tp) maps to the fastest-varying physical ICI
 dimension, and pp (lowest demand, point-to-point only) is outermost — the
 layout recipe from the public scaling-book guidance.
+
+Multi-slice: the ``dcn`` axis (outermost of all) spans TPU slices over the
+data-center network. Only gradient all-reduces ride it (pure data
+parallelism — the lowest-bandwidth collective in the step), matching the
+megascale deployment contract in ``provisioning/manifests.py`` (one JobSet
+replicated job per slice). On real hardware ``build()`` uses
+``mesh_utils.create_hybrid_device_mesh`` so ICI axes never straddle a
+slice boundary.
 """
 
 from __future__ import annotations
@@ -29,8 +37,9 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-# Outermost → innermost. tp last so it lands on the fastest ICI ring.
-AXIS_ORDER: tuple = ("pp", "dp", "fsdp", "sp", "ep", "tp")
+# Outermost → innermost. dcn crosses slices (DCN, lowest bandwidth);
+# tp last so it lands on the fastest ICI ring.
+AXIS_ORDER: tuple = ("dcn", "pp", "dp", "fsdp", "sp", "ep", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,6 +51,7 @@ class MeshSpec:
         MeshSpec(fsdp=-1, tp=4).build()   # v5e-64: fsdp=16, tp=4
     """
 
+    dcn: int = 1
     pp: int = 1
     dp: int = 1
     fsdp: int = 1
@@ -76,7 +86,19 @@ class MeshSpec:
         sizes = self.sizes(len(devices))
         shape = tuple(sizes[ax] for ax in AXIS_ORDER)
         try:
-            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+            if sizes["dcn"] > 1:
+                # Hybrid mesh: ICI axes laid out within each slice, the
+                # dcn axis across slices (requires device slice_index —
+                # real multi-slice TPU; virtual farms take the fallback).
+                ici = tuple(1 if ax == "dcn" else sizes[ax]
+                            for ax in AXIS_ORDER)
+                dcn = tuple(sizes["dcn"] if ax == "dcn" else 1
+                            for ax in AXIS_ORDER)
+                dev_array = mesh_utils.create_hybrid_device_mesh(
+                    ici, dcn, devices=devices)
+            else:
+                dev_array = mesh_utils.create_device_mesh(
+                    shape, devices=devices)
         except Exception:
             dev_array = np.asarray(devices).reshape(shape)
         return Mesh(dev_array, AXIS_ORDER)

@@ -272,7 +272,7 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = True,
     scale: Optional[float] = None,
-    batch_axes=("dp", "fsdp"),
+    batch_axes=("dcn", "dp", "fsdp"),  # match LOGICAL_AXIS_RULES "batch"
     head_axis: Optional[str] = "tp",
 ) -> jax.Array:
     """Sequence-parallel exact attention over ``mesh[axis_name]``."""

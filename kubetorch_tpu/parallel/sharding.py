@@ -24,7 +24,7 @@ MeshAxes = Union[None, str, Tuple[str, ...]]
 # "long" dim and tp on the head/mlp dim; sequence shards over sp; experts over
 # ep; the scanned layer dim over pp (pipeline stages own contiguous layers).
 LOGICAL_AXIS_RULES: Dict[str, MeshAxes] = {
-    "batch": ("dp", "fsdp"),
+    "batch": ("dcn", "dp", "fsdp"),  # dcn: cross-slice pure DP
     "seq": "sp",
     "embed": None,
     "embed_fsdp": "fsdp",      # param dim sharded ZeRO-3 style

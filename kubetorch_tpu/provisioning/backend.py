@@ -119,6 +119,24 @@ class LocalBackend:
             if stub not in python_path.split(os.pathsep):
                 python_path = f"{stub}{os.pathsep}{python_path}"
 
+        # TPU-slice env emulation: a GKE TPU pod gets TPU_WORKER_ID from
+        # the device plugin and MEGASCALE_SLICE_ID from its JobSet job
+        # index (manifests.py:262). Local "pods" mirror that contract so
+        # the slice-aware rank derivation in serving/frameworks.py —
+        # including multi-slice TPU_WORKER_ID globalization — is testable
+        # end-to-end without a cluster.
+        from kubetorch_tpu.resources.compute.compute import Compute
+
+        compute_obj = Compute.from_dict(compute_dict)
+        # Only a distributed gang is a slice group (num_pods = workers ×
+        # hosts, divisible by construction); independent serving replicas
+        # must NOT get MEGASCALE identities — libtpu would try to join
+        # them into one multi-slice job.
+        tpu_spec = (compute_obj.tpu_spec
+                    if compute_obj.distributed is not None else None)
+        hosts_per_slice = tpu_spec.num_hosts if tpu_spec else 1
+        n_slices = max(1, num_pods // hosts_per_slice) if tpu_spec else 1
+
         pods = []
         for index, port in enumerate(ports):
             env = {
@@ -132,6 +150,15 @@ class LocalBackend:
                 "KT_LAUNCH_ID": launch_id,
                 "LOCAL_IPS": local_ips,
             }
+            if tpu_spec is not None:
+                env.setdefault("TPU_WORKER_ID",
+                               str(index % hosts_per_slice))
+                if n_slices > 1:
+                    env.setdefault("MEGASCALE_SLICE_ID",
+                                   str(index // hosts_per_slice))
+                    env.setdefault("MEGASCALE_NUM_SLICES", str(n_slices))
+                    env.setdefault("MEGASCALE_COORDINATOR_ADDRESS",
+                                   "127.0.0.1")
             log_path = service_dir / f"pod-{index}.log"
             log_file = open(log_path, "ab")
             proc = subprocess.Popen(

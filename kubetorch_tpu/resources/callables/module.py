@@ -103,6 +103,7 @@ class Module:
             "distributed": distributed,
             "allowed_serialization": list(compute.allowed_serialization),
             "code_key": self._code_key,
+            "code_store_url": getattr(self, "_code_store_url", None),
         }
 
     # ------------------------------------------------------------------
@@ -116,18 +117,28 @@ class Module:
         ``auto`` (default) syncs on cluster backends only — local pods
         share the client's filesystem; ``always``/``never`` force it.
         """
+        self._code_store_url = None  # never report a previous deploy's URL
         mode = os.environ.get("KT_CODE_SYNC", "auto")
         if compute.freeze or not self.root_path or mode == "never":
             return None
-        if mode == "auto":
-            from kubetorch_tpu.provisioning.k8s_backend import K8sBackend
+        from kubetorch_tpu.provisioning.k8s_backend import K8sBackend
 
-            if not isinstance(self.backend, K8sBackend):
-                return None
+        is_k8s = isinstance(self.backend, K8sBackend)
+        if mode == "auto" and not is_k8s:
+            return None
         from kubetorch_tpu.data_store.client import DataStoreClient
 
+        client = DataStoreClient.default()
+        if is_k8s and not client.store_url:
+            # No HTTP store configured: syncing would land in the CLIENT's
+            # local filesystem store, which cluster pods cannot reach —
+            # fall back to image-baked code rather than wedging the deploy.
+            return None
         key = f"code/{self.service_name}"
-        DataStoreClient.default().put_path(key, Path(self.root_path))
+        client.put_path(key, Path(self.root_path))
+        # Pods must reach the SAME store the client synced to — their env
+        # has no KT_STORE_URL of its own on a fresh cluster.
+        self._code_store_url = client.store_url
         return key
 
     def _module_env(self) -> Dict[str, str]:
@@ -144,6 +155,8 @@ class Module:
         }
         if meta.get("code_key"):
             env["KT_CODE_KEY"] = meta["code_key"]
+            if getattr(self, "_code_store_url", None):
+                env["KT_STORE_URL"] = self._code_store_url
         if meta.get("framework"):
             env["KT_FRAMEWORK"] = meta["framework"]
         if meta.get("init_args") is not None:

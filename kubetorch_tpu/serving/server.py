@@ -71,6 +71,7 @@ def metadata_from_env() -> Dict[str, Any]:
         meta["app_health_path"] = os.environ.get("KT_APP_HEALTH_PATH", "")
     if os.environ.get("KT_CODE_KEY"):
         meta["code_key"] = os.environ["KT_CODE_KEY"]
+        meta["code_store_url"] = os.environ.get("KT_STORE_URL")
     return meta
 
 
@@ -162,12 +163,25 @@ class PodServer:
             return
         from pathlib import Path
 
-        from kubetorch_tpu.data_store import commands
+        from kubetorch_tpu.data_store.client import DataStoreClient
 
+        # Per-pod dir: local-backend pods (and k8s pods on a shared
+        # volume) would otherwise extract into one directory concurrently
+        # and import half-written modules.
+        pod = os.environ.get("KT_POD_NAME") or os.environ.get(
+            "KT_REPLICA_INDEX", "0")
         dest = (Path(os.environ.get("KT_CODE_DEST",
                                     "~/.ktpu/code")).expanduser()
-                / self.metadata.get("service_name", "svc"))
-        commands.workdir_sync(key, dest)
+                / f"{self.metadata.get('service_name', 'svc')}-{pod}")
+        dest.mkdir(parents=True, exist_ok=True)
+        # Prefer the store the CLIENT synced to (rides in the metadata and
+        # push-reloads); env KT_STORE_URL is the fallback for pods whose
+        # metadata predates the field.
+        store_url = (self.metadata.get("code_store_url")
+                     or os.environ.get("KT_STORE_URL"))
+        client = (DataStoreClient(store_url) if store_url
+                  else DataStoreClient.default())
+        client.get_path(key, dest)
         self.metadata["root_path"] = str(dest)
 
     def _setup_supervisor(self):

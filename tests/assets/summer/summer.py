@@ -123,3 +123,7 @@ def jax_allgather():
         "device_count": jax.device_count(),
         "gathered": [int(v) for v in np.asarray(gathered).ravel()],
     }
+
+
+def env_values(keys):
+    return {k: os.environ.get(k) for k in keys}

@@ -214,3 +214,39 @@ def test_ray_nonhead_proxies_to_head():
     finally:
         asyncio.run_coroutine_threadsafe(runner.cleanup(), loop).result(10)
         loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.mark.level("minimal")
+def test_multislice_megascale_env_end_to_end():
+    """2 virtual slices × 2 hosts on the local backend: pods receive the
+    GKE TPU env contract (TPU_WORKER_ID per host, MEGASCALE_SLICE_ID per
+    slice — emulated by LocalBackend exactly as the device plugin/JobSet
+    set them, manifests.py:262) and the jax bootstrap globalizes the
+    per-slice worker ids into unique process ids across the DCN mesh
+    (serving/frameworks.py TPU_WORKER_ID globalization)."""
+    remote = Fn(root_path=str(ASSETS), import_path="summer",
+                callable_name="env_values", name="megascale-env")
+    compute = kt.Compute(
+        tpus="v5e-8",   # 8 chips -> 2 hosts per slice
+        env={"JAX_PLATFORMS": "cpu"},  # emulated slice: stay off real TPU
+    ).distribute("jax", workers=2, num_procs=1, monitor_members=False)
+    assert compute.num_pods == 4
+    remote.to(compute)
+    try:
+        rows = remote(["TPU_WORKER_ID", "MEGASCALE_SLICE_ID",
+                       "MEGASCALE_NUM_SLICES", "JAX_PROCESS_ID",
+                       "JAX_NUM_PROCESSES", "JAX_COORDINATOR_ADDRESS"])
+        assert len(rows) == 4
+        by_pid = sorted(rows, key=lambda r: int(r["JAX_PROCESS_ID"]))
+        # per-slice worker ids globalize to unique process ids 0..3
+        assert [r["JAX_PROCESS_ID"] for r in by_pid] == ["0", "1", "2", "3"]
+        assert [(r["MEGASCALE_SLICE_ID"], r["TPU_WORKER_ID"])
+                for r in by_pid] == [("0", "0"), ("0", "1"),
+                                     ("1", "0"), ("1", "1")]
+        assert all(r["MEGASCALE_NUM_SLICES"] == "2" for r in rows)
+        assert all(r["JAX_NUM_PROCESSES"] == "4" for r in rows)
+        # one coordinator for the whole DCN mesh, from MEGASCALE_*
+        coords = {r["JAX_COORDINATOR_ADDRESS"] for r in rows}
+        assert len(coords) == 1 and coords.pop().startswith("127.0.0.1:")
+    finally:
+        remote.teardown()

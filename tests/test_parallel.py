@@ -23,7 +23,7 @@ def test_mesh_spec_fill():
     mesh = spec.build()
     assert mesh.shape["fsdp"] == 4
     assert mesh.shape["tp"] == 2
-    assert mesh.axis_names == ("pp", "dp", "fsdp", "sp", "ep", "tp")
+    assert mesh.axis_names == ("dcn", "pp", "dp", "fsdp", "sp", "ep", "tp")
 
 
 def test_mesh_spec_validation():
@@ -46,9 +46,9 @@ def test_best_spec_for():
 
 def test_logical_to_pspec_dedup():
     rules = ShardingRules.default()
-    # batch uses (dp, fsdp); a later fsdp-sharded dim must drop fsdp.
+    # batch uses (dcn, dp, fsdp); a later fsdp-sharded dim must drop fsdp.
     spec = logical_to_pspec(("batch", "embed_fsdp"), rules)
-    assert spec == PartitionSpec(("dp", "fsdp"), None)
+    assert spec == PartitionSpec(("dcn", "dp", "fsdp"), None)
     spec = logical_to_pspec(("embed_fsdp", "heads"), rules)
     assert spec == PartitionSpec("fsdp", "tp")
 

@@ -148,8 +148,42 @@ def test_freeze_skips_code_sync_and_unfrozen_syncs(tmp_path, monkeypatch):
         assert live(2, 3) == 5
         synced = store_root / "code" / live.service_name
         assert synced.is_dir() and (synced / "summer.py").exists()
-        # the pod imported from its pulled copy, not the client path
-        pod_copy = tmp_path / "pod-code" / live.service_name / "summer.py"
-        assert pod_copy.exists()
+        # the pod imported from its pulled (per-pod) copy
+        pod_copies = list((tmp_path / "pod-code").glob(
+            f"{live.service_name}-*/summer.py"))
+        assert pod_copies, list((tmp_path / "pod-code").iterdir())
     finally:
         live.teardown()
+
+
+@pytest.mark.level("unit")
+def test_module_env_carries_store_url_for_pods(monkeypatch, tmp_path):
+    """K8s pods have no KT_STORE_URL of their own — the deploy env must
+    carry the URL of the store the client synced code to, else _pull_code
+    falls back to an (empty) pod-local store and every deploy fails."""
+    import kubetorch_tpu as kt
+    from kubetorch_tpu.data_store.client import DataStoreClient
+    from kubetorch_tpu.resources.callables.fn import Fn
+
+    synced = {}
+
+    class StubClient:
+        store_url = "http://store.example:32310"
+
+        def put_path(self, key, src, **kw):
+            synced["key"] = key
+            return key
+
+    monkeypatch.setenv("KT_CODE_SYNC", "always")
+    monkeypatch.setattr(DataStoreClient, "default",
+                        classmethod(lambda cls: StubClient()))
+    fn = Fn(root_path=str(tmp_path), import_path="m", callable_name="f",
+            name="envcheck")
+    fn.compute = kt.Compute(cpus="0.1")
+    fn.service_name = "envcheck"
+    fn._code_key = fn._sync_code(fn.compute)
+    env = fn._module_env()
+    assert env["KT_CODE_KEY"] == "code/envcheck" == synced["key"]
+    assert env["KT_STORE_URL"] == "http://store.example:32310"
+    meta = fn.module_metadata()
+    assert meta["code_store_url"] == "http://store.example:32310"
