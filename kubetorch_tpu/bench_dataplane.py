@@ -158,6 +158,10 @@ def bench_broadcast(store: "_Store", world: int = 8,
             t.start()
         for t in threads:
             t.join(120)
+        if any(t.is_alive() for t in threads):
+            raise TimeoutError(
+                "broadcast fan-out worker hung past 120s — refusing to "
+                "report a fabricated wall time")
         if errors:
             raise errors[0]
         return (time.perf_counter() - t0) * 1e3

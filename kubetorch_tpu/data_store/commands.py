@@ -53,10 +53,15 @@ def rm(key: str, recursive: bool = False, **kwargs) -> int:
     return _client().delete(key, recursive=recursive, **kwargs)
 
 
-def workdir_sync(key: str, dest: Union[str, Path]) -> Path:
+def workdir_sync(key: str, dest: Union[str, Path],
+                 store_url: Optional[str] = None) -> Path:
     """Pull a synced workdir at pod startup (reference: run_wrapper +
-    cached_image_setup rsync pulls)."""
+    cached_image_setup rsync pulls). ``store_url`` pins the store the
+    CLIENT synced to (pod code pulls); default resolves from env/config."""
+    from kubetorch_tpu.data_store.client import DataStoreClient
+
     dest = Path(dest)
     dest.mkdir(parents=True, exist_ok=True)
-    _client().get_path(key, dest)
+    client = DataStoreClient(store_url) if store_url else _client()
+    client.get_path(key, dest)
     return dest

@@ -151,14 +151,22 @@ class LocalBackend:
                 "LOCAL_IPS": local_ips,
             }
             if tpu_spec is not None:
-                env.setdefault("TPU_WORKER_ID",
-                               str(index % hosts_per_slice))
+                # Assign the computed identity EXPLICITLY: setdefault
+                # would let a TPU_WORKER_ID inherited from the client's
+                # own environment give every pod the same identity. An
+                # explicit module_env (user override) still wins.
+                slice_env = {
+                    "TPU_WORKER_ID": str(index % hosts_per_slice),
+                }
                 if n_slices > 1:
-                    env.setdefault("MEGASCALE_SLICE_ID",
-                                   str(index // hosts_per_slice))
-                    env.setdefault("MEGASCALE_NUM_SLICES", str(n_slices))
-                    env.setdefault("MEGASCALE_COORDINATOR_ADDRESS",
-                                   "127.0.0.1")
+                    slice_env.update({
+                        "MEGASCALE_SLICE_ID": str(index // hosts_per_slice),
+                        "MEGASCALE_NUM_SLICES": str(n_slices),
+                        "MEGASCALE_COORDINATOR_ADDRESS": "127.0.0.1",
+                    })
+                for k, v in slice_env.items():
+                    if k not in module_env:
+                        env[k] = v
             log_path = service_dir / f"pod-{index}.log"
             log_file = open(log_path, "ab")
             proc = subprocess.Popen(

@@ -91,8 +91,21 @@ def _load_target(root_path: str, import_path: str, name: str,
     """Import the user symbol from synced source inside the worker process."""
     if root_path and root_path not in sys.path:
         sys.path.insert(0, root_path)
+    if root_path:
+        # Re-synced code must reload the WHOLE project tree: reloading
+        # only the entry module would keep every already-imported
+        # submodule (e.g. an edited helper inside a package) at its old
+        # bytes. Drop them from sys.modules so the import below
+        # re-executes everything under root_path fresh.
+        rp = os.path.realpath(root_path) + os.sep
+        for mod_name, mod in list(sys.modules.items()):
+            f = getattr(mod, "__file__", None)
+            if f and os.path.realpath(f).startswith(rp):
+                del sys.modules[mod_name]
+        # A re-sync may have ADDED files; finder directory caches keyed on
+        # coarse mtimes can miss same-second creations without this.
+        importlib.invalidate_caches()
     module = importlib.import_module(import_path)
-    module = importlib.reload(module)  # pick up re-synced code on re-setup
     obj = module
     for part in name.split("."):
         obj = getattr(obj, part)

@@ -163,7 +163,7 @@ class PodServer:
             return
         from pathlib import Path
 
-        from kubetorch_tpu.data_store.client import DataStoreClient
+        from kubetorch_tpu.data_store.commands import workdir_sync
 
         # Per-pod dir: local-backend pods (and k8s pods on a shared
         # volume) would otherwise extract into one directory concurrently
@@ -173,15 +173,12 @@ class PodServer:
         dest = (Path(os.environ.get("KT_CODE_DEST",
                                     "~/.ktpu/code")).expanduser()
                 / f"{self.metadata.get('service_name', 'svc')}-{pod}")
-        dest.mkdir(parents=True, exist_ok=True)
         # Prefer the store the CLIENT synced to (rides in the metadata and
         # push-reloads); env KT_STORE_URL is the fallback for pods whose
         # metadata predates the field.
-        store_url = (self.metadata.get("code_store_url")
+        workdir_sync(key, dest,
+                     store_url=self.metadata.get("code_store_url")
                      or os.environ.get("KT_STORE_URL"))
-        client = (DataStoreClient(store_url) if store_url
-                  else DataStoreClient.default())
-        client.get_path(key, dest)
         self.metadata["root_path"] = str(dest)
 
     def _setup_supervisor(self):

@@ -1,0 +1,7 @@
+"""Fails at import time — the deploy must surface a fast, typed error."""
+
+import a_module_that_does_not_exist  # noqa: F401
+
+
+def unreachable():
+    return 0

@@ -1,0 +1,8 @@
+"""Entry module importing a sibling package — the deployment must carry the
+whole tree, not just the entry file."""
+
+from mathkit import scale
+
+
+def tenfold(x):
+    return scale(x)

@@ -1,0 +1,4 @@
+"""Multi-file package asset (reference pattern: tests/assets/ multi-module
+projects) — exercises cross-module imports through deploy + code-sync."""
+
+from mathkit.core import scale  # noqa: F401

@@ -1,0 +1,5 @@
+from mathkit.util import FACTOR
+
+
+def scale(x):
+    return x * FACTOR

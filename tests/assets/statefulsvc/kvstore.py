@@ -1,0 +1,27 @@
+"""Stateful + async class asset (reference pattern: kv_store, async actors)."""
+
+import asyncio
+
+
+class KVStore:
+    def __init__(self, namespace="default"):
+        self.namespace = namespace
+        self._data = {}
+
+    def put(self, key, value):
+        self._data[key] = value
+        return len(self._data)
+
+    def get(self, key, default=None):
+        return self._data.get(key, default)
+
+    def delete(self, key):
+        return self._data.pop(key, None) is not None
+
+    def keys(self):
+        return sorted(self._data)
+
+    async def slow_sum(self, values):
+        """Async method: must run on the worker's event loop."""
+        await asyncio.sleep(0.01)
+        return {"namespace": self.namespace, "sum": sum(values)}

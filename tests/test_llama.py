@@ -69,7 +69,7 @@ def test_remat_policies_identical_grads(tiny_cfg):
         def loss_fn(p):
             logits = llama.forward(p, batch["inputs"], cfg)
             return cross_entropy_loss(logits, batch["targets"])[0]
-        return jax.value_and_grad(loss_fn)(params)
+        return jax.jit(jax.value_and_grad(loss_fn))(params)
 
     base = dataclasses.replace(tiny_cfg, remat=False)
     params = llama.init(jax.random.key(0), base)

@@ -38,9 +38,10 @@ def test_ring_attention_noncausal_and_grads():
         lambda q, k, v: ring_attention(q, k, v, mesh, causal=False))(q, k, v)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
                                rtol=1e-4, atol=1e-4)
-    g = jax.grad(lambda q: ring_attention(q, k, v, mesh).sum())(q)
-    gref = jax.grad(
-        lambda q: dot_product_attention(q, k, v, causal=True).sum())(q)
+    g = jax.jit(jax.grad(
+        lambda q: ring_attention(q, k, v, mesh).sum()))(q)
+    gref = jax.jit(jax.grad(
+        lambda q: dot_product_attention(q, k, v, causal=True).sum()))(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gref),
                                rtol=1e-3, atol=1e-3)
 
@@ -65,8 +66,8 @@ def test_ring_attention_flash_engine_matches_global(causal):
         o = dot_product_attention(q, k, v, causal=causal)
         return (o * jnp.sin(o)).sum()
 
-    gr = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
-    gf = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    gf = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))(q, k, v)
     for a, b, name in zip(gr, gf, "q k v".split()):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-3, atol=5e-3, err_msg=name)
@@ -105,8 +106,8 @@ def test_flash_attention_backward_matches_reference(causal, Hq, Hkv):
         out = dot_product_attention(q, k, v, causal=causal)
         return (out * jnp.cos(out)).sum()
 
-    gf = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
-    gr = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))(q, k, v)
     for a, b, name in zip(gf, gr, "q k v".split()):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-3, err_msg=name)
@@ -120,8 +121,8 @@ def test_flash_attention_grads_under_jit_and_mixed_blocks():
         return jax.grad(lambda q: flash_attention(
             q, k, v, causal=True, block_q=128, block_k=64).sum())(q)
 
-    gref = jax.grad(lambda q: dot_product_attention(
-        q, k, v, causal=True).sum())(q)
+    gref = jax.jit(jax.grad(lambda q: dot_product_attention(
+        q, k, v, causal=True).sum()))(q)
     np.testing.assert_allclose(np.asarray(g(q, k, v)), np.asarray(gref),
                                rtol=2e-3, atol=2e-3)
 
@@ -180,7 +181,7 @@ def test_llama_pipeline_grads_flow():
                                         n_microbatches=2)
         return jnp.mean(logits ** 2)
 
-    grads = jax.grad(loss)(params)
+    grads = jax.jit(jax.grad(loss))(params)
     flat = jax.tree.leaves(grads)
     assert all(bool(jnp.isfinite(g).all()) for g in flat)
     # every layer's weights received gradient (all stages trained)
@@ -224,18 +225,24 @@ def test_no_involuntary_remat_in_sharded_train_steps(capfd):
     toks = rng.integers(0, cfg.vocab_size, (8, 17))
     batch = {"inputs": jnp.asarray(toks[:, :-1], jnp.int32),
              "targets": jnp.asarray(toks[:, 1:], jnp.int32)}
-    capfd.readouterr()
-    for mesh, rules, loss_fn, label in layouts:
-        optimizer = optax.adamw(1e-3)
-        with use_mesh(mesh):
-            state = init_train_state(
-                jax.random.key(0), cfg, mesh, optimizer, rules)
-            step = make_train_step(cfg, optimizer, rules, loss_fn=loss_fn,
-                                   mesh=mesh)
-            state, metrics = step(state, batch)
-            assert np.isfinite(float(jax.device_get(metrics["loss"])))
-        err = capfd.readouterr().err
-        assert "Involuntary full rematerialization" not in err, (
-            f"{label}: XLA degraded a sharding transition:\n" +
-            "\n".join(l for l in err.splitlines()
-                      if "rematerialization" in l)[:2000])
+    # The warnings under test are emitted at COMPILE time — a persistent-
+    # cache hit would skip compilation and vacuously pass.
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        capfd.readouterr()
+        for mesh, rules, loss_fn, label in layouts:
+            optimizer = optax.adamw(1e-3)
+            with use_mesh(mesh):
+                state = init_train_state(
+                    jax.random.key(0), cfg, mesh, optimizer, rules)
+                step = make_train_step(cfg, optimizer, rules,
+                                       loss_fn=loss_fn, mesh=mesh)
+                state, metrics = step(state, batch)
+                assert np.isfinite(float(jax.device_get(metrics["loss"])))
+            err = capfd.readouterr().err
+            assert "Involuntary full rematerialization" not in err, (
+                f"{label}: XLA degraded a sharding transition:\n" +
+                "\n".join(l for l in err.splitlines()
+                          if "rematerialization" in l)[:2000])
+    finally:
+        jax.config.update("jax_enable_compilation_cache", True)
