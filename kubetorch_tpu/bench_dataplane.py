@@ -151,8 +151,9 @@ def bench_broadcast(store: "_Store", world: int = 8,
             except Exception as exc:  # pragma: no cover
                 errors.append(exc)
 
-        threads = [threading.Thread(target=worker, args=(i,))
-                   for i in range(world)]
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(world)]  # daemon: a hung fetch must not
+        #                                    block interpreter shutdown
         t0 = time.perf_counter()
         for t in threads:
             t.start()

@@ -142,6 +142,35 @@ class ControllerClient:
         return bool(self._check(self.client.delete(
             f"{self.base_url}/runs/{run_id}"))["deleted"])
 
+    # ------------------------------------------------------------- k8s
+    # Generic passthrough over the controller's dynamic-client proxy
+    # (server.py h_k8s_*; responses wrap the op result as {"result": ...}).
+    def k8s_list(self, kind: str, namespace: Optional[str] = None,
+                 selector: Optional[str] = None) -> list:
+        params = {k: v for k, v in (("namespace", namespace),
+                                    ("selector", selector)) if v}
+        return (self._check(self.client.get(
+            f"{self.base_url}/k8s/{kind}", params=params)) or {}).get(
+                "result") or []
+
+    def k8s_get(self, kind: str, name: str,
+                namespace: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        resp = self.client.get(
+            f"{self.base_url}/k8s/{kind}/{name}",
+            params={"namespace": namespace} if namespace else {})
+        if resp.status_code == 404:
+            return None
+        return (self._check(resp) or {}).get("result")
+
+    def k8s_delete(self, kind: str, name: str,
+                   namespace: Optional[str] = None) -> bool:
+        resp = self.client.delete(
+            f"{self.base_url}/k8s/{kind}/{name}",
+            params={"namespace": namespace} if namespace else {})
+        if resp.status_code == 404:
+            return False
+        return bool((self._check(resp) or {}).get("result"))
+
     # ------------------------------------------------------------ apply
     def apply(self, manifest: Dict[str, Any],
               patch: Optional[str] = None) -> Dict[str, Any]:

@@ -1,10 +1,24 @@
-"""Secrets: K8s Secret CRUD + provider shims.
+"""Secrets: K8s Secret CRUD + provider shims with real file layouts.
 
-Reference: ``resources/secrets/`` (~1k LoC, 16 provider shims). Same shape
-here: a ``Secret`` holds key/value pairs or a provider name whose shim knows
-which env vars / files to harvest locally (HF, GCP, AWS, W&B, ...). Local
-backend stores under ``~/.ktpu/secrets`` (0600); k8s backend renders a Secret
-manifest and mounts env vars into the pod template.
+Reference: ``resources/secrets/`` (16 provider classes, ~1k LoC — each
+knows its provider's credential *directory*, the filenames inside it, and
+the env vars that must point at them, e.g.
+``provider_secrets/aws_secret.py`` ``_DEFAULT_PATH=~/.aws`` +
+``_DEFAULT_FILENAMES=[config, credentials]``;
+``kubeconfig_secret.py`` ``~/.kube/config``). Same contract here as one
+table instead of 16 classes:
+
+- **harvest**: ``Secret.from_provider`` reads the provider's env vars and
+  credential files from the local machine (following KUBECONFIG-style
+  pointer vars to custom paths).
+- **deliver (k8s)**: files mount read-only at a neutral per-secret dir and
+  ``path_env`` vars (``KUBECONFIG``, ``GOOGLE_APPLICATION_CREDENTIALS``,
+  ``AWS_*_FILE``, ...) point tools at the copies — mounting over the
+  provider's home directory would shadow writable state (HF cache, kubectl
+  cache). ssh, which has no pointer var, mounts at ``~/.ssh``.
+- **deliver (local)**: files are written under the secret's private dir
+  and the same ``path_env`` vars point there — the user's real dotfiles
+  are never touched.
 """
 
 from __future__ import annotations
@@ -18,34 +32,64 @@ from typing import Any, Dict, List, Optional
 
 _LOCAL_ROOT = Path("~/.ktpu/secrets").expanduser()
 
-# provider -> (env vars, credential files)
-PROVIDER_SHIMS: Dict[str, Dict[str, List[str]]] = {
+# provider -> {env: harvested env vars,
+#              dir: credential directory (harvest source),
+#              files: filenames inside dir (subpaths allowed),
+#              env_file: env var -> canonical filename; when the var points
+#                        at an existing file (custom credential paths), its
+#                        CONTENT is harvested under the canonical name,
+#              path_env: env var -> filename ("" = the dir itself) exported
+#                        pointing at the DELIVERED location,
+#              mount_home_dir: True = deliver at the provider's own dir in
+#                        the pod (only ssh: no env override exists). All
+#                        others deliver at a neutral per-secret dir — a
+#                        readOnly mount over ~/.kube or ~/.cache would
+#                        shadow writable state the pod needs.}
+PROVIDER_SHIMS: Dict[str, Dict[str, Any]] = {
     "huggingface": {"env": ["HF_TOKEN", "HUGGING_FACE_HUB_TOKEN"],
-                    "files": ["~/.huggingface/token",
-                              "~/.cache/huggingface/token"]},
-    "gcp": {"env": ["GOOGLE_APPLICATION_CREDENTIALS"],
-            "files": ["~/.config/gcloud/application_default_credentials.json"]},
+                    "dir": "~/.cache/huggingface", "files": ["token"],
+                    "path_env": {}},
+    "gcp": {"env": [],
+            "dir": "~/.config/gcloud",
+            "files": ["application_default_credentials.json"],
+            "env_file": {"GOOGLE_APPLICATION_CREDENTIALS":
+                         "application_default_credentials.json"},
+            "path_env": {"GOOGLE_APPLICATION_CREDENTIALS":
+                         "application_default_credentials.json"}},
     "aws": {"env": ["AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY",
                     "AWS_SESSION_TOKEN"],
-            "files": ["~/.aws/credentials"]},
-    "wandb": {"env": ["WANDB_API_KEY"], "files": ["~/.netrc"]},
-    "openai": {"env": ["OPENAI_API_KEY"], "files": []},
-    "anthropic": {"env": ["ANTHROPIC_API_KEY"], "files": []},
-    "github": {"env": ["GITHUB_TOKEN", "GH_TOKEN"], "files": []},
-    "docker": {"env": [], "files": ["~/.docker/config.json"]},
-    "kubernetes": {"env": ["KUBECONFIG"], "files": ["~/.kube/config"]},
+            "dir": "~/.aws", "files": ["config", "credentials"],
+            "path_env": {"AWS_SHARED_CREDENTIALS_FILE": "credentials",
+                         "AWS_CONFIG_FILE": "config"}},
+    "wandb": {"env": ["WANDB_API_KEY"], "dir": "~", "files": [".netrc"],
+              "path_env": {"NETRC": ".netrc"}},
+    "openai": {"env": ["OPENAI_API_KEY"], "dir": None, "files": [],
+               "path_env": {}},
+    "anthropic": {"env": ["ANTHROPIC_API_KEY"], "dir": None, "files": [],
+                  "path_env": {}},
+    "github": {"env": ["GITHUB_TOKEN", "GH_TOKEN"], "dir": None,
+               "files": [], "path_env": {}},
+    "docker": {"env": [], "dir": "~/.docker", "files": ["config.json"],
+               "path_env": {"DOCKER_CONFIG": ""}},
+    "kubernetes": {"env": [], "dir": "~/.kube", "files": ["config"],
+                   "env_file": {"KUBECONFIG": "config"},
+                   "path_env": {"KUBECONFIG": "config"}},
     "azure": {"env": ["AZURE_SUBSCRIPTION_ID", "AZURE_CLIENT_ID",
                       "AZURE_CLIENT_SECRET", "AZURE_TENANT_ID"],
-              "files": ["~/.azure/clouds.config"]},
-    "cohere": {"env": ["COHERE_API_KEY", "CO_API_KEY"], "files": []},
-    "lambda": {"env": ["LAMBDA_API_KEY"],
-               "files": ["~/.lambda_cloud/lambda_keys"]},
+              "dir": "~/.azure", "files": ["clouds.config"],
+              "path_env": {"AZURE_CONFIG_DIR": ""}},
+    "cohere": {"env": ["COHERE_API_KEY", "CO_API_KEY"], "dir": None,
+               "files": [], "path_env": {}},
+    "lambda": {"env": ["LAMBDA_API_KEY"], "dir": "~/.lambda_cloud",
+               "files": ["lambda_keys"], "path_env": {}},
     "langchain": {"env": ["LANGCHAIN_API_KEY", "LANGSMITH_API_KEY"],
-                  "files": []},
-    "pinecone": {"env": ["PINECONE_API_KEY"], "files": []},
-    "ssh": {"env": [], "files": ["~/.ssh/id_rsa", "~/.ssh/id_rsa.pub",
-                                 "~/.ssh/id_ed25519",
-                                 "~/.ssh/id_ed25519.pub"]},
+                  "dir": None, "files": [], "path_env": {}},
+    "pinecone": {"env": ["PINECONE_API_KEY"], "dir": None, "files": [],
+                 "path_env": {}},
+    "ssh": {"env": [], "dir": "~/.ssh",
+            "files": ["id_rsa", "id_rsa.pub", "id_ed25519",
+                      "id_ed25519.pub", "known_hosts", "config"],
+            "path_env": {}, "mount_home_dir": True},
 }
 
 
@@ -55,11 +99,18 @@ class Secret:
     values: Dict[str, str] = dataclasses.field(default_factory=dict)
     provider: Optional[str] = None
     env_vars: Optional[Dict[str, str]] = None  # secret key -> env var in pod
+    # Pod-side directory the file credentials mount at (defaults to the
+    # provider's expected dir with ~ resolved to the pod user's home).
+    mount_dir: Optional[str] = None
 
     @classmethod
-    def from_provider(cls, provider: str,
-                      name: Optional[str] = None) -> "Secret":
-        """Harvest local credentials for a known provider."""
+    def from_provider(cls, provider: str, name: Optional[str] = None,
+                      path: Optional[str] = None) -> "Secret":
+        """Harvest local credentials for a known provider.
+
+        ``path`` overrides the provider's default credential directory
+        (reference: per-provider ``_DEFAULT_PATH`` override).
+        """
         shim = PROVIDER_SHIMS.get(provider)
         if shim is None:
             raise ValueError(
@@ -69,25 +120,81 @@ class Secret:
         for env in shim["env"]:
             if os.environ.get(env):
                 values[env] = os.environ[env]
-        for file in shim["files"]:
-            path = Path(file).expanduser()
-            if path.exists():
-                values[f"file:{path.name}"] = path.read_text()
+        cred_dir = path or shim.get("dir")
+        if cred_dir:
+            base = Path(cred_dir).expanduser()
+            for rel in shim["files"]:
+                file_path = base / rel
+                if file_path.exists():
+                    values[f"file:{rel}"] = file_path.read_text()
+        # Custom credential paths: when KUBECONFIG /
+        # GOOGLE_APPLICATION_CREDENTIALS point at a file, harvest its
+        # CONTENT under the canonical name (delivery re-points the var).
+        for var, rel in shim.get("env_file", {}).items():
+            pointer = os.environ.get(var)
+            if pointer and f"file:{rel}" not in values:
+                pfile = Path(pointer).expanduser()
+                if pfile.is_file():
+                    values[f"file:{rel}"] = pfile.read_text()
         if not values:
             raise ValueError(
                 f"no local credentials found for provider {provider!r}")
         return cls(name=name or f"{provider}-secret", values=values,
                    provider=provider)
 
+    # ------------------------------------------------------------ files
     @staticmethod
     def _file_key(key: str) -> str:
-        """`file:id_rsa` → a k8s-legal data key (`file.id_rsa`)."""
+        """`file:sub/name` → a k8s-legal data key (`file.sub_name`)."""
         return "file." + key.split(":", 1)[1].replace("/", "_")
 
     def file_items(self) -> Dict[str, str]:
         """Harvested credential files: sanitized data key → contents."""
-        return {self._file_key(k): v for k, v in self.values.items()
-                if k.startswith("file:")}
+        out: Dict[str, str] = {}
+        for k, v in self.values.items():
+            if not k.startswith("file:"):
+                continue
+            key = self._file_key(k)
+            if key in out:
+                raise ValueError(
+                    f"secret {self.name!r}: file paths collide after "
+                    f"sanitization on data key {key!r} — rename one")
+            out[key] = v
+        return out
+
+    def _file_relpaths(self) -> Dict[str, str]:
+        """sanitized data key → original relative path inside the dir."""
+        return {self._file_key(k): k.split(":", 1)[1]
+                for k in self.values if k.startswith("file:")}
+
+    def _delivery_dir(self, home: str = "/root") -> str:
+        """Where the pod should see the files. Neutral per-secret dir by
+        default — a readOnly secret mount over ``~/.kube`` or ``~/.cache``
+        would shadow writable state the pod needs; ``path_env`` vars make
+        tools find the neutral copies. Only providers with no env override
+        at all (ssh) mount at their home directory."""
+        if self.mount_dir:
+            return self.mount_dir
+        shim = PROVIDER_SHIMS.get(self.provider or "")
+        if shim and shim.get("mount_home_dir") and shim.get("dir"):
+            raw = shim["dir"]
+            return raw.replace("~", home, 1) if raw.startswith("~") else raw
+        return f"/etc/kt-secrets/{self.name}"
+
+    def _path_env_for(self, base: str) -> Dict[str, str]:
+        """path_env vars resolved against a delivery base dir (shared by
+        the k8s and local delivery paths — one export rule)."""
+        shim = PROVIDER_SHIMS.get(self.provider or "")
+        out: Dict[str, str] = {}
+        for env, rel in (shim or {}).get("path_env", {}).items():
+            # only export when the file was actually harvested
+            if not rel or f"file:{rel}" in self.values:
+                out[env] = f"{base}/{rel}" if rel else base
+        return out
+
+    def path_env(self, home: str = "/root") -> Dict[str, str]:
+        """Env vars pointing at the delivered files (KUBECONFIG, ...)."""
+        return self._path_env_for(self._delivery_dir(home))
 
     # ---- k8s -----------------------------------------------------------
     def to_manifest(self, namespace: str = "default") -> Dict[str, Any]:
@@ -108,25 +215,32 @@ class Secret:
         }
 
     def pod_volume(self) -> Optional[Dict[str, Any]]:
-        """Secret volume for file credentials (None when there are none)."""
+        """Secret volume for file credentials (None when there are none).
+
+        Items restore the original relative paths (``config``,
+        ``sub/dir/file``) inside the delivery directory."""
         if not self.file_items():
             return None
+        rel = self._file_relpaths()
         return {"name": f"secret-{self.name}",
                 "secret": {"secretName": self.name,
-                           "items": [{"key": k, "path": k[len("file."):]}
+                           "defaultMode": 0o400,
+                           "items": [{"key": k, "path": rel[k]}
                                      for k in self.file_items()]}}
 
-    def pod_mount(self, mount_path: Optional[str] = None) -> Optional[Dict[str, Any]]:
-        """volumeMount delivering harvested files at
-        ``/etc/kt-secrets/<name>/<filename>`` (0400)."""
+    def pod_mount(self, mount_path: Optional[str] = None,
+                  home: str = "/root") -> Optional[Dict[str, Any]]:
+        """volumeMount delivering harvested files at the provider's
+        expected directory (``~/.aws`` → ``/root/.aws``), 0400."""
         if not self.file_items():
             return None
         return {"name": f"secret-{self.name}",
-                "mountPath": mount_path or f"/etc/kt-secrets/{self.name}",
+                "mountPath": mount_path or self._delivery_dir(home),
                 "readOnly": True}
 
-    def pod_env(self) -> List[Dict[str, Any]]:
-        """envFrom-style injection for the pod template."""
+    def pod_env(self, home: str = "/root") -> List[Dict[str, Any]]:
+        """envFrom-style injection for the pod template, plus literal
+        path_env vars pointing at the mounted credential files."""
         entries = []
         for key in self.values:
             if key.startswith("file:"):
@@ -136,6 +250,8 @@ class Secret:
                 "name": env_name,
                 "valueFrom": {"secretKeyRef": {"name": self.name, "key": key}},
             })
+        for env, target in self.path_env(home).items():
+            entries.append({"name": env, "value": target})
         return entries
 
     # ---- local ---------------------------------------------------------
@@ -163,7 +279,30 @@ class Secret:
         path = _LOCAL_ROOT / f"{self.name}.json"
         if path.exists():
             path.unlink()
+        deliver = _LOCAL_ROOT / self.name
+        if deliver.is_dir():
+            import shutil
+
+            shutil.rmtree(deliver, ignore_errors=True)
+
+    def deliver_local(self) -> Path:
+        """Write file credentials under the secret's private dir (0600) —
+        the local analogue of the k8s mount; never touches the user's real
+        dotfiles. Returns the delivery dir."""
+        deliver = _LOCAL_ROOT / self.name
+        for key, rel in self._file_relpaths().items():
+            target = deliver / rel
+            target.parent.mkdir(parents=True, exist_ok=True)
+            content = self.values["file:" + rel]
+            target.write_text(content)
+            target.chmod(0o600)
+        return deliver
 
     def local_env(self) -> Dict[str, str]:
-        return {(self.env_vars or {}).get(k, k): v
-                for k, v in self.values.items() if not k.startswith("file:")}
+        """Env contract for local-backend pods: harvested env values plus
+        path_env vars pointing at locally delivered files."""
+        env = {(self.env_vars or {}).get(k, k): v
+               for k, v in self.values.items() if not k.startswith("file:")}
+        if self.file_items():
+            env.update(self._path_env_for(str(self.deliver_local())))
+        return env

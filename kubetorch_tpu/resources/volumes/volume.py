@@ -1,31 +1,103 @@
 """Persistent volumes (PVC-backed on k8s, directory-backed locally).
 
-Reference: ``resources/volumes/volume.py:17`` — PVC create/reuse with access
-modes and a mount path; the TPU build keeps the same API and adds a local
-backend (a shared directory under ``~/.ktpu/volumes``) so tests and laptop
-runs exercise the same code path.
+Reference: ``resources/volumes/volume.py:17`` — PVC lifecycle with access
+modes, RWX-aware storage-class resolution, binding to an existing PV
+(``volume_name``), mount-path annotations, ``from_name`` reuse, and a
+debug-shell helper. The TPU build keeps the same API, routes cluster
+operations through the controller's K8s proxy (clients need no cluster
+credentials), and adds a local backend (a shared directory under
+``~/.ktpu/volumes``) so tests and laptop runs exercise the same code path.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import time
 from pathlib import Path
 from typing import Any, Dict, Optional
 
+from kubetorch_tpu.exceptions import KubetorchError
+
 _LOCAL_ROOT = Path("~/.ktpu/volumes").expanduser()
+
+DEFAULT_ACCESS_MODE = "ReadWriteOnce"
+# Provisioners known to support ReadWriteMany (reference:
+# volume.py:120 RWX storage-class preference).
+RWX_PROVISIONERS = ("nfs.csi.k8s.io", "cephfs.csi.ceph.com",
+                    "filestore.csi.storage.gke.io")
+MOUNT_PATH_ANNOTATION = "kubetorch.com/mount-path"
 
 
 @dataclasses.dataclass
 class Volume:
+    """``kt.Volume(name="ckpts", size="50Gi", mount_path="/data")``.
+
+    ``volume_name`` binds the PVC to a specific existing PersistentVolume
+    instead of dynamic provisioning (reference: volume.py volume_name).
+    """
+
     name: str
     size: str = "10Gi"
     mount_path: Optional[str] = None
-    access_modes: tuple = ("ReadWriteOnce",)
+    access_modes: tuple = (DEFAULT_ACCESS_MODE,)
     storage_class: Optional[str] = None
+    volume_name: Optional[str] = None
+    namespace: Optional[str] = None
 
     def __post_init__(self):
         if self.mount_path is None:
+            # ktfs convention: volumes surface under /ktfs/<name>
             self.mount_path = f"/ktfs/{self.name}"
+        if not str(self.mount_path).startswith("/"):
+            raise ValueError(
+                f"mount_path must be absolute, got {self.mount_path!r}")
+        if isinstance(self.access_modes, str):
+            self.access_modes = (self.access_modes,)
+
+    @property
+    def access_mode(self) -> str:
+        return self.access_modes[0]
+
+    @property
+    def pvc_name(self) -> str:
+        return self.name
+
+    # ---- cluster plumbing ---------------------------------------------
+    @staticmethod
+    def _controller():
+        from kubetorch_tpu.controller.client import ControllerClient
+
+        return ControllerClient.maybe()
+
+    def resolve_storage_class(self) -> Optional[str]:
+        """Storage class to provision with: the explicit one; an
+        RWX-capable one when ReadWriteMany is requested; else the cluster
+        default (None → let the cluster pick)."""
+        if self.volume_name:
+            return ""  # binding to an existing PV: no dynamic provisioning
+        if self.storage_class:
+            return self.storage_class
+        controller = self._controller()
+        if controller is None:
+            return None
+        try:
+            classes = controller.k8s_list("StorageClass")
+        except Exception:
+            return None
+        if self.access_mode == "ReadWriteMany":
+            for sc in classes:
+                if sc.get("provisioner") in RWX_PROVISIONERS:
+                    return sc["metadata"]["name"]
+            raise KubetorchError(
+                "ReadWriteMany requested but no RWX-capable storage class "
+                f"found (looked for provisioners {RWX_PROVISIONERS})")
+        for sc in classes:
+            annotations = (sc.get("metadata", {}).get("annotations")
+                           or {})
+            if annotations.get(
+                    "storageclass.kubernetes.io/is-default-class") == "true":
+                return sc["metadata"]["name"]
+        return None
 
     # ---- k8s manifest --------------------------------------------------
     def to_pvc_manifest(self, namespace: str = "default") -> Dict[str, Any]:
@@ -33,22 +105,134 @@ class Volume:
             "accessModes": list(self.access_modes),
             "resources": {"requests": {"storage": self.size}},
         }
-        if self.storage_class:
-            spec["storageClassName"] = self.storage_class
+        sc = (self.storage_class if self.storage_class is not None
+              else self.resolve_storage_class())
+        if self.volume_name:
+            spec["storageClassName"] = ""
+            spec["volumeName"] = self.volume_name
+        elif sc is not None:
+            spec["storageClassName"] = sc
         return {
             "apiVersion": "v1",
             "kind": "PersistentVolumeClaim",
-            "metadata": {"name": self.name, "namespace": namespace,
-                         "labels": {"kubetorch.com/managed": "true"}},
+            "metadata": {
+                "name": self.pvc_name,
+                "namespace": self.namespace or namespace,
+                "labels": {"kubetorch.com/managed": "true",
+                           "kubetorch.com/volume": self.name},
+                "annotations": {MOUNT_PATH_ANNOTATION: self.mount_path},
+            },
             "spec": spec,
         }
 
     def pod_volume(self) -> Dict[str, Any]:
         return {"name": self.name,
-                "persistentVolumeClaim": {"claimName": self.name}}
+                "persistentVolumeClaim": {"claimName": self.pvc_name}}
 
     def pod_mount(self) -> Dict[str, Any]:
         return {"name": self.name, "mountPath": self.mount_path}
+
+    # ---- lifecycle -----------------------------------------------------
+    @classmethod
+    def from_name(cls, name: str, namespace: Optional[str] = None,
+                  mount_path: Optional[str] = None) -> "Volume":
+        """Reuse an existing PVC: size/modes/class/PV-binding come from the
+        cluster, mount path from the PVC's annotation unless overridden
+        (reference: volume.py:156 from_name). Falls back to the local
+        volume dir when no controller is configured."""
+        controller = cls._controller()
+        if controller is None:
+            if not (_LOCAL_ROOT / name).is_dir():
+                raise KubetorchError(f"no local volume {name!r}")
+            return cls(name=name, mount_path=mount_path,
+                       namespace=namespace)
+        pvc = controller.k8s_get("PersistentVolumeClaim", name,
+                                 namespace=namespace)
+        if pvc is None:
+            raise KubetorchError(
+                f"volume {name!r} (PVC) does not exist"
+                + (f" in namespace {namespace!r}" if namespace else ""))
+        spec = pvc.get("spec", {})
+        annotations = pvc.get("metadata", {}).get("annotations") or {}
+        return cls(
+            name=name,
+            size=spec.get("resources", {}).get("requests", {}).get(
+                "storage", "10Gi"),
+            mount_path=(mount_path
+                        or annotations.get(MOUNT_PATH_ANNOTATION)),
+            access_modes=tuple(spec.get("accessModes")
+                               or (DEFAULT_ACCESS_MODE,)),
+            storage_class=spec.get("storageClassName"),
+            volume_name=spec.get("volumeName"),
+            namespace=pvc.get("metadata", {}).get("namespace"),
+        )
+
+    def exists(self) -> bool:
+        controller = self._controller()
+        if controller is None:
+            return (_LOCAL_ROOT / self.name).is_dir()
+        return controller.k8s_get("PersistentVolumeClaim", self.pvc_name,
+                                  namespace=self.namespace) is not None
+
+    def create(self) -> Dict[str, Any]:
+        """Create the PVC if absent (reuse semantics: an existing PVC of
+        the same name is returned untouched)."""
+        controller = self._controller()
+        if controller is None:
+            return {"local_path": str(self.local_path())}
+        existing = controller.k8s_get("PersistentVolumeClaim",
+                                      self.pvc_name,
+                                      namespace=self.namespace)
+        if existing is not None:
+            return existing
+        return controller.apply(self.to_pvc_manifest(
+            self.namespace or "default"))
+
+    def delete(self, wait: bool = True, timeout: float = 60.0):
+        controller = self._controller()
+        if controller is None:
+            import shutil
+
+            shutil.rmtree(_LOCAL_ROOT / self.name, ignore_errors=True)
+            return
+        controller.k8s_delete("PersistentVolumeClaim", self.pvc_name,
+                              namespace=self.namespace)
+        if wait:
+            deadline = time.time() + timeout
+            while time.time() < deadline and self.exists():
+                time.sleep(0.5)
+            if self.exists():
+                raise KubetorchError(
+                    f"PVC {self.pvc_name!r} still exists after {timeout}s "
+                    "(stuck Terminating? a pod may still mount it)")
+
+    def debug_pod_manifest(self, image: str = "alpine:latest"
+                           ) -> Dict[str, Any]:
+        """A throwaway pod mounting this volume at its mount path — apply
+        it (``controller.apply``) and exec in to inspect the contents
+        (reference: volume.py:336 ssh() shells out to kubectl run; here the
+        manifest is first-class so it also works through the proxy)."""
+        import uuid
+
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": f"debug-{self.name}-{uuid.uuid4().hex[:6]}",
+                "namespace": self.namespace or "default",
+                "labels": {"kubetorch.com/managed": "true"},
+            },
+            "spec": {
+                "restartPolicy": "Never",
+                "containers": [{
+                    "name": "debug",
+                    "image": image,
+                    "command": ["sh", "-c", "sleep 3600"],
+                    "volumeMounts": [self.pod_mount()],
+                }],
+                "volumes": [self.pod_volume()],
+            },
+        }
 
     # ---- local backend -------------------------------------------------
     @classmethod
