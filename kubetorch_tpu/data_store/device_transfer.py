@@ -44,12 +44,7 @@ def _tree_flatten(tree: Any):
     return leaves, treedef
 
 
-def pack_arrays(tree: Any) -> bytes:
-    """Pack a pytree of (jax/numpy) arrays into one buffer."""
-    import jax
-
-    leaves, treedef = _tree_flatten(tree)
-    host_leaves = [np.asarray(jax.device_get(leaf)) for leaf in leaves]
+def _pack_header(host_leaves, treedef) -> bytes:
     header = {
         "treedef": str(treedef),
         # dtype by name: ml_dtypes types (bfloat16, fp8) stringify as 'V2'
@@ -58,13 +53,38 @@ def pack_arrays(tree: Any) -> bytes:
                    for a in host_leaves],
     }
     head = msgpack.packb(header)
+    return _MAGIC + len(head).to_bytes(8, "little") + head
+
+
+def _host_leaves(tree: Any):
+    import jax
+
+    leaves, treedef = _tree_flatten(tree)
+    return [np.asarray(jax.device_get(leaf)) for leaf in leaves], treedef
+
+
+def pack_arrays(tree: Any) -> bytes:
+    """Pack a pytree of (jax/numpy) arrays into one buffer."""
+    host_leaves, treedef = _host_leaves(tree)
     buf = io.BytesIO()
-    buf.write(_MAGIC)
-    buf.write(len(head).to_bytes(8, "little"))
-    buf.write(head)
+    buf.write(_pack_header(host_leaves, treedef))
     for array in host_leaves:
         buf.write(np.ascontiguousarray(array).tobytes())
     return buf.getvalue()
+
+
+def iter_packed(tree: Any, chunk: int = 8 << 20):
+    """Yield the packed form in chunks without materializing one giant
+    buffer — a multi-GB param tree streams straight onto the wire."""
+    host_leaves, treedef = _host_leaves(tree)
+    yield _pack_header(host_leaves, treedef)
+    for array in host_leaves:
+        # uint8 view: ml_dtypes dtypes (bfloat16/fp8) have no buffer
+        # protocol of their own, but any contiguous array views as bytes
+        flat = np.ascontiguousarray(array).reshape(-1).view(np.uint8)
+        mv = memoryview(flat)
+        for i in range(0, len(mv), chunk):
+            yield bytes(mv[i:i + chunk])
 
 
 def unpack_arrays(data: bytes, template: Optional[Any] = None) -> Any:
@@ -74,10 +94,12 @@ def unpack_arrays(data: bytes, template: Optional[Any] = None) -> Any:
 
     if not data.startswith(_MAGIC):
         raise ValueError("not a packed-array buffer")
+    # memoryview slices: bytes slicing would COPY each multi-GB leaf
+    mv = memoryview(data)
     offset = len(_MAGIC)
-    head_len = int.from_bytes(data[offset:offset + 8], "little")
+    head_len = int.from_bytes(mv[offset:offset + 8], "little")
     offset += 8
-    header = msgpack.unpackb(data[offset:offset + head_len])
+    header = msgpack.unpackb(mv[offset:offset + head_len])
     offset += head_len
     leaves = []
     for spec in header["leaves"]:
@@ -85,7 +107,7 @@ def unpack_arrays(data: bytes, template: Optional[Any] = None) -> Any:
         count = int(np.prod(spec["shape"])) if spec["shape"] else 1
         nbytes = count * dtype.itemsize
         array = np.frombuffer(
-            data[offset:offset + nbytes], dtype=dtype).reshape(spec["shape"])
+            mv[offset:offset + nbytes], dtype=dtype).reshape(spec["shape"])
         leaves.append(array)
         offset += nbytes
     if template is not None:
@@ -98,8 +120,10 @@ def put_arrays(key: str, tree: Any) -> str:
     """Publish a pytree of arrays (params, state dicts) under ``key``."""
     from kubetorch_tpu.data_store.client import DataStoreClient
 
-    blob = pack_arrays(tree)
-    return DataStoreClient.default()._backend().put_blob(key, blob)
+    backend = DataStoreClient.default()._backend()
+    if hasattr(backend, "put_blob_stream"):
+        return backend.put_blob_stream(key, lambda: iter_packed(tree))
+    return backend.put_blob(key, pack_arrays(tree))
 
 
 def get_arrays(

@@ -125,11 +125,33 @@ class StoreServer:
         return web.json_response({**self.stats, "files": files})
 
     async def h_put_blob(self, request):
+        """Streamed to disk: weight blobs run to GBs — accumulating the
+        body in memory is both a 2× RSS spike and superlinear slowdown
+        (measured 0.16 → 0.03 GB/s from 32 MB to 512 MB bodies)."""
+        import uuid
+
         key = _norm_key(request.match_info["key"])
-        body = await request.read()
         path = self._path(key)
         path.parent.mkdir(parents=True, exist_ok=True)
-        path.write_bytes(body)
+        # unique per REQUEST: two concurrent PUTs of one key must not
+        # interleave into a shared tmp file (last os.replace wins whole)
+        tmp = path.with_name(
+            f".{path.name}.{os.getpid()}-{uuid.uuid4().hex[:8]}.tmp")
+        # streaming bypasses aiohttp's client_max_size — enforce it here
+        limit = 8 * 1024 ** 3
+        size = 0
+        try:
+            with open(tmp, "wb") as fh:
+                async for chunk in request.content.iter_chunked(4 << 20):
+                    size += len(chunk)
+                    if size > limit:
+                        raise web.HTTPRequestEntityTooLarge(
+                            max_size=limit, actual_size=size)
+                    fh.write(chunk)
+            os.replace(tmp, path)
+        except BaseException:
+            tmp.unlink(missing_ok=True)
+            raise
         # New bytes under an old key: peers registered for the previous
         # version must not be handed out (RL weight-sync re-puts every
         # round; a stale peer would serve last round's weights for up to
@@ -137,19 +159,19 @@ class StoreServer:
         self.sources.pop(key, None)
         self.versions[key] = self.versions.get(key, 0) + 1
         self.stats["puts"] += 1
-        self.stats["bytes_in"] += len(body)
-        return web.json_response({"key": key, "size": len(body)})
+        self.stats["bytes_in"] += size
+        return web.json_response({"key": key, "size": size})
 
     async def h_get_blob(self, request):
         key = _norm_key(request.match_info["key"])
         path = self._path(key)
         if not path.is_file():
             raise web.HTTPNotFound(text=f"no such key {key!r}")
-        data = path.read_bytes()
         self.stats["gets"] += 1
-        self.stats["bytes_out"] += len(data)
-        return web.Response(body=data,
-                            content_type="application/octet-stream")
+        self.stats["bytes_out"] += path.stat().st_size
+        # FileResponse: sendfile-backed, no whole-blob buffering
+        return web.FileResponse(
+            path, headers={"Content-Type": "application/octet-stream"})
 
     async def h_keys(self, request):
         prefix = request.query.get("prefix", "").strip("/")
