@@ -5,11 +5,14 @@ events into Loki under ``job="kubetorch-events"`` with reason/kind/name
 labels so clients can show scheduling / image-pull / OOM / preemption events
 live while a launch is pending (``module.py:1069``).
 
-This build polls the events API (the minimal REST client has no watch
-streams) and pushes new events into the controller-hosted ``LogSink`` under
+Streams the events API with a real ``?watch=1`` chunked watch
+(``K8sClient.watch``): list-with-resourceVersion seeds the stream so
+nothing is lost between list and watch, and events arrive with API-push
+latency instead of a poll interval. A failed/unsupported watch degrades to
+the polling loop. Events land in the controller-hosted ``LogSink`` under
 the same ``job="kubetorch-events"`` label scheme, so the existing
-``/logs/tail`` WS gives clients live event streams with zero extra plumbing.
-The ``service`` label is recovered from the involved object's
+``/logs/tail`` WS gives clients live event streams with zero extra
+plumbing. The ``service`` label is recovered from the involved object's
 ``kubetorch.com/service`` naming convention (pods/Deployments/JobSets are
 named ``<service>`` or ``<service>-<suffix>``) so a launch can tail exactly
 its own events.
@@ -17,7 +20,6 @@ its own events.
 
 from __future__ import annotations
 
-import asyncio
 import logging
 import time
 from typing import Any, Dict, List, Optional, Set
@@ -98,50 +100,125 @@ class EventWatcher:
             uid = labels.get("event_uid")
             if uid:
                 self._seen[uid] = labels.get("event_marker", "")
-        self._task: Optional[asyncio.Task] = None
+        self._thread = None
         self._started_at = time.time()
+        self._watch_ok = hasattr(k8s_client, "watch")
+        self._watch_failures = 0
+        self._stopping = False
+        self._known_cache: tuple = (0.0, set())
 
     # ------------------------------------------------------------------
     def start(self):
+        """Runs on a daemon thread, not the event loop's executor: a watch
+        stream blocks in a socket read between events, and a non-daemon
+        executor thread would hold controller shutdown hostage for the
+        remaining server-side watch timeout."""
         if self.k8s_client is None:
             return
-        self._task = asyncio.get_event_loop().create_task(self._run())
+        import threading
+
+        self._stopping = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="kt-event-watch")
+        self._thread.start()
 
     def stop(self):
-        if self._task is not None:
-            self._task.cancel()
-            self._task = None
+        self._stopping = True  # daemon thread drains on its own
 
-    async def _run(self):
-        while True:
+    def _loop(self):
+        while not self._stopping:
+            t0 = time.time()
             try:
-                await asyncio.get_event_loop().run_in_executor(
-                    None, self.poll_once)
-            except asyncio.CancelledError:
-                raise
+                if self._watch_ok:
+                    # One watch cycle = list (seed + catch-up) + stream
+                    # until the server-side timeout — event latency is
+                    # API-push, not a poll interval.
+                    self.watch_once(timeout_seconds=60)
+                else:
+                    self.poll_once()
             except Exception as exc:  # cluster flake: keep watching
-                logger.debug("event poll failed: %s", exc)
-            await asyncio.sleep(self.interval)
+                logger.debug("event watch/poll failed: %s", exc)
+                self._note_watch_failure(exc)
+                time.sleep(self.interval)
+                continue
+            if self._watch_ok and time.time() - t0 >= 1.0:
+                self._watch_failures = 0
+                continue  # healthy stream ended at its timeout: reconnect
+            if self._watch_ok:
+                # Instant no-error return = server ignored watch=1 (plain
+                # list body) or drops watches: without this guard the loop
+                # would re-LIST events hot forever.
+                self._note_watch_failure("watch stream returned instantly")
+            time.sleep(self.interval)
+
+    def _note_watch_failure(self, exc):
+        if not self._watch_ok:
+            return
+        self._watch_failures += 1
+        if self._watch_failures >= 3:
+            logger.info("event watch unavailable (%s); "
+                        "falling back to polling", exc)
+            self._watch_ok = False
+
+    def _known_services(self) -> set:
+        """Service names with a short TTL cache: an event storm must not
+        turn into one list_pools DB query per streamed event."""
+        ts, cached = self._known_cache
+        if time.time() - ts > 5.0:
+            cached = {p.get("service_name", "")
+                      for p in self.list_services()}
+            self._known_cache = (time.time(), cached)
+        return cached
 
     # ------------------------------------------------------------------
-    def poll_once(self) -> int:
-        """Fetch events, push the unseen ones. Returns the count pushed."""
-        events = self.k8s_client.list("Event", self.namespace)
-        known = {p.get("service_name", "") for p in self.list_services()}
+    def _push_unseen(self, events: List[Dict[str, Any]],
+                     known: set) -> int:
         entries: List[Dict[str, Any]] = []
-        current: Dict[str, str] = {}
         for event in events:
             uid = event.get("metadata", {}).get("uid", "")
             marker = _event_marker(event)
-            if not uid:
+            if not uid or self._seen.get(uid) == marker:
                 continue
-            current[uid] = marker
-            if self._seen.get(uid) == marker:
-                continue
+            self._seen[uid] = marker
             entries.append(format_event(event, _event_service(event, known)))
-        # memory bound: keep markers only for events the API still returns
-        # (expired events can't come back, so dropping them never re-pushes).
-        self._seen = current
         if entries:
             self.log_sink.push(entries)
         return len(entries)
+
+    def poll_once(self) -> int:
+        """Fetch events, push the unseen ones. Returns the count pushed."""
+        events = self.k8s_client.list("Event", self.namespace)
+        current = {e.get("metadata", {}).get("uid", ""): _event_marker(e)
+                   for e in events}
+        pushed = self._push_unseen(events, self._known_services())
+        # memory bound: keep markers only for events the API still returns
+        # (expired events can't come back, so dropping them never re-pushes).
+        self._seen = {u: m for u, m in self._seen.items() if u in current}
+        return pushed
+
+    def watch_once(self, timeout_seconds: int = 240) -> int:
+        """List (seed + catch-up) then stream ``?watch=1`` until the
+        server-side timeout — one cycle of the watch loop. Reference:
+        event_watcher.py consumes the official client's watch stream; this
+        is the same API over the dependency-free client."""
+        events, version = self.k8s_client.list_with_version(
+            "Event", self.namespace)
+        # memory bound: a DELETED missed across a dropped stream would
+        # otherwise pin its marker forever (expired events can't return,
+        # so pruning against the live list never re-pushes)
+        current = {e.get("metadata", {}).get("uid", "") for e in events}
+        self._seen = {u: m for u, m in self._seen.items() if u in current}
+        pushed = self._push_unseen(events, self._known_services())
+        for etype, obj in self.k8s_client.watch(
+                "Event", self.namespace, resource_version=version,
+                timeout_seconds=timeout_seconds):
+            if self._stopping:
+                break
+            if etype in ("ADDED", "MODIFIED"):
+                pushed += self._push_unseen([obj], self._known_services())
+            elif etype == "DELETED":
+                self._seen.pop(obj.get("metadata", {}).get("uid", ""),
+                               None)
+            elif etype == "ERROR":
+                break  # stale resourceVersion: next cycle re-lists
+        return pushed

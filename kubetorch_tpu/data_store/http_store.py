@@ -172,19 +172,22 @@ class HttpStoreBackend:
         # stdlib http.client for the raw download: ~0.9 GB/s vs httpx's
         # ~0.12 (h11 receive overhead dominates multi-GB weight fetches).
         import http.client as _hc
-        from urllib.parse import urlsplit
+        from urllib.parse import quote, urlsplit
 
         parts = urlsplit(self._url(f"/blob/{key}"))
         conn_cls = (_hc.HTTPSConnection if parts.scheme == "https"
                     else _hc.HTTPConnection)
         port = parts.port or (443 if parts.scheme == "https" else 80)
+        # httpx percent-encodes on PUT; the raw request line must match
+        # or keys with spaces/non-ASCII write fine and fail to read back
+        quoted_path = quote(parts.path, safe="/%")
 
         def attempt():
             # socket timeout applies per recv(), so a 30 s cap bounds an
             # unresponsive host without limiting multi-GB transfers
             conn = conn_cls(parts.hostname, port, timeout=30.0)
             try:
-                conn.request("GET", parts.path)
+                conn.request("GET", quoted_path)
                 resp = conn.getresponse()
                 if resp.status in (502, 503, 504):
                     raise RetryableStatus(resp.status,
@@ -202,6 +205,13 @@ class HttpStoreBackend:
             raise DataStoreError(
                 f"store get {key!r} failed after retries: {exc}",
                 status=exc.status) from None
+        except _hc.HTTPException as exc:
+            # normalize to the store error contract: callers' fallbacks
+            # (broadcast dead-parent → direct store fetch) catch
+            # DataStoreError/OSError, not http.client internals
+            raise DataStoreError(
+                f"store get {key!r} failed: {type(exc).__name__}: {exc}"
+            ) from exc
         if status == 404:
             raise DataStoreError(f"no such key {key!r}", status=404)
         if status >= 400:

