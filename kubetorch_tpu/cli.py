@@ -500,17 +500,15 @@ def volumes():
 @volumes.command("list")
 def volumes_list():
     from kubetorch_tpu.config import get_config
-    from kubetorch_tpu.provisioning.k8s_client import K8sClient
+    from kubetorch_tpu.resources.volumes.volume import Volume
 
-    if not K8sClient.has_credentials():
-        from kubetorch_tpu.resources.volumes.volume import Volume
-
+    cluster = Volume._controller()  # same backend chain as create/delete
+    if cluster is None:
         for path in sorted(Volume.local_root().glob("*")):
             click.echo(path.name)
         return
-    client = K8sClient.from_env()
-    for pvc in client.list("PersistentVolumeClaim",
-                           get_config().namespace):
+    for pvc in cluster.k8s_list("PersistentVolumeClaim",
+                                namespace=get_config().namespace):
         spec = pvc.get("spec", {})
         size = (spec.get("resources", {}).get("requests", {})
                 .get("storage", "?"))
@@ -521,38 +519,66 @@ def volumes_list():
 @volumes.command("create")
 @click.argument("name")
 @click.option("--size", default="10Gi")
-def volumes_create(name, size):
+@click.option("--mount-path", default=None,
+              help="absolute mount path (default /ktfs/<name>)")
+@click.option("--access-mode", default="ReadWriteOnce",
+              type=click.Choice(["ReadWriteOnce", "ReadWriteMany",
+                                 "ReadOnlyMany"]),
+              help="RWX picks an RWX-capable storage class automatically")
+@click.option("--storage-class", default=None)
+@click.option("--volume-name", default=None,
+              help="bind to an existing PersistentVolume instead of "
+                   "dynamic provisioning")
+def volumes_create(name, size, mount_path, access_mode, storage_class,
+                   volume_name):
     from kubetorch_tpu.config import get_config
     from kubetorch_tpu.resources.volumes.volume import Volume
 
-    volume = Volume(name=name, size=size)
-    from kubetorch_tpu.provisioning.k8s_client import K8sClient
-
-    if K8sClient.has_credentials():
-        K8sClient.from_env().apply(
-            volume.to_pvc_manifest(get_config().namespace))
-        click.echo(f"created PVC {name} ({size})")
+    volume = Volume(name=name, size=size, mount_path=mount_path,
+                    access_modes=(access_mode,),
+                    storage_class=storage_class, volume_name=volume_name,
+                    namespace=get_config().namespace)
+    existed = volume.exists()
+    result = volume.create()
+    if "local_path" in result:
+        click.echo(f"created local volume dir {result['local_path']}")
+    elif existed:
+        click.echo(f"PVC {name} already exists — left unchanged "
+                   "(reuse semantics; delete it to change spec)")
     else:
-        click.echo(f"created local volume dir {volume.local_path()}")
+        click.echo(f"created PVC {name} ({size}, {access_mode})"
+                   + (f" bound to PV {volume_name}" if volume_name else ""))
+
+
+@volumes.command("describe")
+@click.argument("name")
+def volumes_describe(name):
+    """Show a volume's live spec (size, modes, class, PV binding, mount)."""
+    from kubetorch_tpu.config import get_config
+    from kubetorch_tpu.exceptions import KubetorchError
+    from kubetorch_tpu.resources.volumes.volume import Volume
+
+    try:
+        vol = Volume.from_name(name, namespace=get_config().namespace)
+    except KubetorchError as exc:
+        raise click.ClickException(str(exc))
+    click.echo(json.dumps(vol.to_dict(), indent=2))
 
 
 @volumes.command("delete")
 @click.argument("name")
-def volumes_delete(name):
+@click.option("--wait/--no-wait", default=True)
+def volumes_delete(name, wait):
     from kubetorch_tpu.config import get_config
-    from kubetorch_tpu.provisioning.k8s_client import K8sClient
+    from kubetorch_tpu.exceptions import KubetorchError
+    from kubetorch_tpu.resources.volumes.volume import Volume
 
-    if K8sClient.has_credentials():
-        K8sClient.from_env().delete(
-            "PersistentVolumeClaim", name, get_config().namespace)
-        click.echo(f"deleted PVC {name}")
-    else:
-        import shutil as _shutil
-
-        from kubetorch_tpu.resources.volumes.volume import Volume
-
-        _shutil.rmtree(Volume(name=name).local_path(), ignore_errors=True)
-        click.echo(f"deleted local volume {name}")
+    try:
+        Volume(name=name,
+               namespace=get_config().namespace).delete(wait=wait)
+    except KubetorchError as exc:
+        raise click.ClickException(str(exc))
+    click.echo(f"deleted volume {name}")
 
 
 # ---------------------------------------------------------------- store
@@ -614,14 +640,22 @@ def secrets_list():
 
 @secrets.command("create")
 @click.argument("name")
-@click.option("--provider", default=None)
+@click.option("--provider", default=None,
+              help="harvest a known provider's env vars + credential "
+                   "files (aws, gcp, kubernetes, huggingface, ssh, ...)")
+@click.option("--path", default=None,
+              help="override the provider's credential directory")
 @click.option("--from-env", "env_vars", multiple=True)
-def secrets_create(name, provider, env_vars):
+def secrets_create(name, provider, path, env_vars):
     from kubetorch_tpu.resources.secrets.secret import Secret
 
     if provider:
-        secret = Secret.from_provider(provider, name)
+        secret = Secret.from_provider(provider, name, path=path)
     else:
+        if path:
+            raise click.ClickException(
+                "--path only applies with --provider (it overrides the "
+                "provider's credential directory)")
         values = {v: os.environ[v] for v in env_vars if v in os.environ}
         if not values:
             raise click.ClickException("no values (use --provider/--from-env)")

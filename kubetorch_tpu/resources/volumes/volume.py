@@ -28,6 +28,27 @@ RWX_PROVISIONERS = ("nfs.csi.k8s.io", "cephfs.csi.ceph.com",
 MOUNT_PATH_ANNOTATION = "kubetorch.com/mount-path"
 
 
+class _DirectK8s:
+    """ControllerClient's k8s_* surface over a raw K8sClient (clients
+    with cluster credentials but no controller configured)."""
+
+    def __init__(self, client):
+        self._client = client
+
+    def k8s_get(self, kind, name, namespace=None):
+        return self._client.get(kind, name, namespace=namespace)
+
+    def k8s_list(self, kind, namespace=None, selector=None):
+        return self._client.list(kind, namespace=namespace,
+                                 label_selector=selector or "")
+
+    def k8s_delete(self, kind, name, namespace=None):
+        return self._client.delete(kind, name, namespace=namespace)
+
+    def apply(self, manifest, patch=None):
+        return self._client.apply(manifest)
+
+
 @dataclasses.dataclass
 class Volume:
     """``kt.Volume(name="ckpts", size="50Gi", mount_path="/data")``.
@@ -65,9 +86,23 @@ class Volume:
     # ---- cluster plumbing ---------------------------------------------
     @staticmethod
     def _controller():
+        """Cluster access: the controller's K8s proxy when configured,
+        else direct cluster credentials (kubeconfig/in-cluster) through
+        the same 4-method surface, else None (local volume dirs). One
+        decision chain for every Volume operation AND the CLI."""
         from kubetorch_tpu.controller.client import ControllerClient
 
-        return ControllerClient.maybe()
+        controller = ControllerClient.maybe()
+        if controller is not None:
+            return controller
+        from kubetorch_tpu.provisioning.k8s_client import K8sClient
+
+        if K8sClient.has_credentials():
+            try:
+                return _DirectK8s(K8sClient.from_env())
+            except Exception:
+                return None
+        return None
 
     def resolve_storage_class(self) -> Optional[str]:
         """Storage class to provision with: the explicit one; an
