@@ -100,6 +100,7 @@ def _bench_weight_sync(cfg):
     import tempfile
     from pathlib import Path
 
+    _free_device_memory()
     params = jax.jit(lambda k: llama.init(k, cfg))(jax.random.key(1))
     jax.block_until_ready(params)
     nbytes = sum(x.nbytes for x in jax.tree.leaves(params))
@@ -142,6 +143,18 @@ def _bench_weight_sync(cfg):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _free_device_memory():
+    """Drop refs from earlier bench stages and force the deferred device
+    frees through before a large allocation (the axon tunnel processes
+    deletions lazily; a 9 GB init can otherwise race them and OOM)."""
+    import gc
+
+    import jax
+
+    gc.collect()
+    jax.block_until_ready(jax.device_put(0))
+
+
 def _bench_8b_decode(B=64, P=128, N=128):
     """Llama-3-8B int8 weight-only decode, steady-state (north star #5).
 
@@ -161,6 +174,7 @@ def _bench_8b_decode(B=64, P=128, N=128):
     from kubetorch_tpu.models import Generator, LlamaConfig, quant
 
     cfg = LlamaConfig.llama3_8b(max_seq_len=1024)
+    _free_device_memory()
     params = quant.init_quantized(jax.random.key(0), cfg)
     jax.block_until_ready(params)
     nbytes = sum(x.nbytes for x in jax.tree.leaves(params))
@@ -191,8 +205,10 @@ def _bench_8b_decode(B=64, P=128, N=128):
             print(f"# 8b decode B={b} failed ({type(e).__name__}); retrying",
                   file=sys.stderr)
             # Drop the failed attempt's device buffers (multi-GB KV cache)
-            # before retrying on a chip that just ran out of memory.
-            out = cache = first_logits = None
+            # before retrying on a chip that just ran out of memory —
+            # including the args tuple, which also references them.
+            out = cache = first_logits = args = None  # noqa: F841
+            _free_device_memory()
     if out is None:
         return None
     step_s = dt / N
@@ -255,6 +271,7 @@ def _bench_tpu():
     # 1 chip): ~1.5B incl. 128k-vocab untied embeddings, B=2 S=2048.
     try:
         big = LlamaConfig.llama3_1b(remat=True, remat_policy="dots")
+        _free_device_memory()
         r = _bench_train(big, batch=2, seq=2048, steps=8, n_dev=n_dev)
         r.pop("params")
         extra["llama_1.5b_train_tok_s_per_chip"] = round(
