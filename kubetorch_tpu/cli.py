@@ -195,9 +195,42 @@ def logs(service, pod, tail, follow, level, request_id):
             except ConnectionError as exc:
                 raise click.ClickException(str(exc))
         else:
-            for entry in query_logs(controller_url, service=service,
-                                    limit=tail, **filters):
+            sink_error = None
+            # --pod filters client-side by name suffix; over-query so the
+            # post-filter result can still fill `tail` lines.
+            limit = tail if pod is None else max(tail * 20, 2000)
+            try:
+                entries = query_logs(controller_url, service=service,
+                                     limit=limit, **filters)
+            except Exception as exc:  # unreachable controller included
+                entries, sink_error = [], exc
+            if pod is not None:
+                # sink entries carry pod *names*; match the index against
+                # the replica suffix (local backend / jobset naming).
+                entries = [e for e in entries
+                           if e.get("labels", {}).get("pod", "")
+                           .endswith(f"-{pod}")][-tail:]
+            for entry in entries:
                 click.echo(format_entry(entry))
+            if sink_error is not None and filters:
+                # filtered queries have no backend fallback — don't let a
+                # dead controller masquerade as "no matching logs"
+                raise click.ClickException(f"sink query failed: {sink_error}")
+            if not entries and not filters:
+                # services whose logs never reached the sink (deployed
+                # before the controller, log streaming disabled, sink
+                # unreachable): show backend pod logs instead of silently
+                # printing nothing.
+                from kubetorch_tpu.provisioning.backend import get_backend
+
+                try:
+                    click.echo(get_backend().logs(service, pod, tail))
+                except Exception as exc:
+                    detail = (f"; sink query failed: {sink_error}"
+                              if sink_error else "")
+                    raise click.ClickException(
+                        f"no logs in the controller sink and the backend "
+                        f"fallback failed: {exc}{detail}")
         return
     if follow or filters:
         raise click.ClickException(

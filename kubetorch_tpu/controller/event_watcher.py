@@ -104,7 +104,7 @@ class EventWatcher:
         self._started_at = time.time()
         self._watch_ok = hasattr(k8s_client, "watch")
         self._watch_failures = 0
-        self._stopping = False
+        self._stop_event = None  # owned by the currently-started thread
         self._known_cache: tuple = (0.0, set())
 
     # ------------------------------------------------------------------
@@ -117,29 +117,39 @@ class EventWatcher:
             return
         import threading
 
-        self._stopping = False
+        # Each started thread owns its own stop flag: a stopped thread can
+        # stay blocked in a watch read past a subsequent start(), and a
+        # shared boolean reset by start() would resurrect it — two loops
+        # then race on _seen and double-push events. A re-start() also
+        # stops the previous thread, or its Event would become unreachable.
+        if self._stop_event is not None:
+            self._stop_event.set()
+        stop = threading.Event()
+        self._stop_event = stop
         self._thread = threading.Thread(
-            target=self._loop, daemon=True, name="kt-event-watch")
+            target=self._loop, args=(stop,), daemon=True,
+            name="kt-event-watch")
         self._thread.start()
 
     def stop(self):
-        self._stopping = True  # daemon thread drains on its own
+        if self._stop_event is not None:
+            self._stop_event.set()  # daemon thread drains on its own
 
-    def _loop(self):
-        while not self._stopping:
+    def _loop(self, stop):
+        while not stop.is_set():
             t0 = time.time()
             try:
                 if self._watch_ok:
                     # One watch cycle = list (seed + catch-up) + stream
                     # until the server-side timeout — event latency is
                     # API-push, not a poll interval.
-                    self.watch_once(timeout_seconds=60)
+                    self.watch_once(timeout_seconds=60, stop=stop)
                 else:
                     self.poll_once()
             except Exception as exc:  # cluster flake: keep watching
                 logger.debug("event watch/poll failed: %s", exc)
                 self._note_watch_failure(exc)
-                time.sleep(self.interval)
+                stop.wait(self.interval)
                 continue
             if self._watch_ok and time.time() - t0 >= 1.0:
                 self._watch_failures = 0
@@ -149,7 +159,7 @@ class EventWatcher:
                 # list body) or drops watches: without this guard the loop
                 # would re-LIST events hot forever.
                 self._note_watch_failure("watch stream returned instantly")
-            time.sleep(self.interval)
+            stop.wait(self.interval)
 
     def _note_watch_failure(self, exc):
         if not self._watch_ok:
@@ -196,13 +206,18 @@ class EventWatcher:
         self._seen = {u: m for u, m in self._seen.items() if u in current}
         return pushed
 
-    def watch_once(self, timeout_seconds: int = 240) -> int:
+    def watch_once(self, timeout_seconds: int = 240, stop=None) -> int:
         """List (seed + catch-up) then stream ``?watch=1`` until the
         server-side timeout — one cycle of the watch loop. Reference:
         event_watcher.py consumes the official client's watch stream; this
         is the same API over the dependency-free client."""
+        if stop is not None and stop.is_set():
+            return 0  # superseded thread: don't race the replacement's
+            # list+push on _seen
         events, version = self.k8s_client.list_with_version(
             "Event", self.namespace)
+        if stop is not None and stop.is_set():
+            return 0
         # memory bound: a DELETED missed across a dropped stream would
         # otherwise pin its marker forever (expired events can't return,
         # so pruning against the live list never re-pushes)
@@ -212,7 +227,7 @@ class EventWatcher:
         for etype, obj in self.k8s_client.watch(
                 "Event", self.namespace, resource_version=version,
                 timeout_seconds=timeout_seconds):
-            if self._stopping:
+            if stop is not None and stop.is_set():
                 break
             if etype in ("ADDED", "MODIFIED"):
                 pushed += self._push_unseen([obj], self._known_services())

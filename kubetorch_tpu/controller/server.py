@@ -57,6 +57,11 @@ class PodConnection:
         # failing probe, not the reason).
         self.ready = bool(info.get("ready", False))
         self.setup_error = info.get("setup_error")
+        # the deploy generation this pod belongs to (KT_LAUNCH_ID): lets
+        # launch waiters ignore a terminating pod from a previous deploy of
+        # the same service name whose stale setup_error would otherwise
+        # abort a healthy relaunch.
+        self.launch_id = info.get("launch_id", "")
 
 
 class PodHub:
@@ -225,6 +230,9 @@ class ControllerServer:
         return app
 
     async def _on_startup(self, app):
+        # event-watcher pushes arrive from a plain thread; the sink marshals
+        # them onto this loop for subscriber fan-out.
+        self.log_sink.bind_loop()
         if self.enable_reaper:
             self._reaper_task = asyncio.create_task(self._reaper_loop())
         self.event_watcher.start()
@@ -366,7 +374,7 @@ class ControllerServer:
         pool["pods"] = [
             {"pod_name": c.pod_name, "url": c.url,
              "connected_at": c.connected_at, "ready": c.ready,
-             "setup_error": c.setup_error}
+             "setup_error": c.setup_error, "launch_id": c.launch_id}
             for c in self.hub.pods_of(pool["service_name"])]
         return web.json_response(pool)
 
@@ -429,6 +437,8 @@ class ControllerServer:
                 elif mtype == "status" and conn is not None:
                     conn.ready = bool(data.get("ready", False))
                     conn.setup_error = data.get("setup_error")
+                    if data.get("launch_id"):
+                        conn.launch_id = data["launch_id"]
                 elif mtype == "activity" and conn is not None:
                     self.db.touch_pool(conn.service_name)
         finally:

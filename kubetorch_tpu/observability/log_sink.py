@@ -54,9 +54,17 @@ class LogSink:
         self.max_streams = max_streams
         self._streams: Dict[str, deque] = {}
         self._subscribers: List[tuple] = []  # (asyncio.Queue, filters)
+        # controller event loop, bound on first loop-side use: pushes from
+        # plain threads (the k8s event watcher) must marshal onto it —
+        # asyncio.Queue is not thread-safe and /logs/tail waiters would
+        # miss (or corrupt) wakeups otherwise.
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self.persist = persist
         if persist is not None:
             persist.replay(self._push_mem, self._drop_mem)
+
+    def bind_loop(self, loop: Optional[asyncio.AbstractEventLoop] = None):
+        self._loop = loop or asyncio.get_running_loop()
 
     # ------------------------------------------------------------- core
     def _stream_key(self, labels: Dict[str, Any]) -> str:
@@ -65,7 +73,20 @@ class LogSink:
     def push(self, entries: List[Dict[str, Any]]):
         if self.persist is not None:
             self.persist.append(entries)
-        self._push_mem(entries)
+        loop = self._loop
+        on_loop = True
+        if loop is not None:
+            try:
+                on_loop = asyncio.get_running_loop() is loop
+            except RuntimeError:
+                on_loop = False
+        if on_loop:
+            self._push_mem(entries)
+        else:
+            # off-loop producer (event-watcher thread): hand the whole
+            # update to the loop so ring mutation and subscriber wakeups
+            # stay single-threaded.
+            loop.call_soon_threadsafe(self._push_mem, entries)
 
     def _push_mem(self, entries: List[Dict[str, Any]]):
         for entry in entries:

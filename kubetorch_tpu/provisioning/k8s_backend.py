@@ -150,6 +150,16 @@ class K8sBackend:
                 except Exception:
                     pool = {}
                 for pod_info in pool.get("pods", []):
+                    # only pods of THIS deploy generation are terminal: a
+                    # still-connected pod from a previous failed deploy of
+                    # the same service name must not abort a healthy
+                    # relaunch with its stale setup_error. Pods that don't
+                    # report a launch_id (pre-launch_id image) still
+                    # fast-fail — better a rare stale abort than a silent
+                    # 600 s timeout on every real setup error.
+                    pod_launch = pod_info.get("launch_id")
+                    if launch_id and pod_launch and pod_launch != launch_id:
+                        continue
                     if pod_info.get("setup_error"):
                         from kubetorch_tpu.exceptions import StartupError
 

@@ -80,6 +80,7 @@ class ControllerWebSocket:
                             # controller's view resets with the connection
                             "ready": self.pod_server.ready,
                             "setup_error": self.pod_server.setup_error,
+                            "launch_id": self.pod_server.launch_id,
                         })
                         await self._listen(ws)
             except asyncio.CancelledError:
@@ -161,6 +162,7 @@ class ControllerWebSocket:
                     "type": "status",
                     "ready": self.pod_server.ready,
                     "setup_error": self.pod_server.setup_error,
+                    "launch_id": self.pod_server.launch_id,
                 })
             except Exception:
                 pass
