@@ -175,7 +175,7 @@ def _bench_8b_decode(B=64, P=128, N=128):
 
     cfg = LlamaConfig.llama3_8b(max_seq_len=1024)
     _free_device_memory()
-    params = quant.init_quantized(jax.random.key(0), cfg)
+    params = quant.init_quantized(jax.random.key(0), cfg, fuse=True)
     jax.block_until_ready(params)
     nbytes = sum(x.nbytes for x in jax.tree.leaves(params))
 

@@ -366,9 +366,9 @@ def runs_show(run_id):
 def runs_logs(run_id):
     from kubetorch_tpu.data_store import commands as store
 
-    click.echo(store.get(f"runs/{run_id}/log.txt").decode()
-               if isinstance(store.get(f"runs/{run_id}/log.txt"), bytes)
-               else store.get(f"runs/{run_id}/log.txt"))
+    log = store.get(f"runs/{run_id}/log.txt")
+    click.echo(log.decode() if isinstance(log, (bytes, bytearray))
+               else log)
 
 
 @runs.command("note")

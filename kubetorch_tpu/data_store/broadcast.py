@@ -50,7 +50,7 @@ class PeerServer:
     same way for every process on the node.
     """
 
-    _instance: Optional["PeerServer"] = None
+    _instances: dict = {}  # root -> PeerServer
     _lock = threading.Lock()
 
     def __init__(self, root: Path):
@@ -86,17 +86,19 @@ class PeerServer:
 
     @classmethod
     def ensure(cls, root: Optional[Path] = None) -> Optional["PeerServer"]:
+        root = Path(root or _CACHE_ROOT)
         with cls._lock:
-            if cls._instance is None:
-                inst = cls(root or _CACHE_ROOT)
+            inst = cls._instances.get(root)
+            if inst is None:
+                inst = cls(root)
                 try:
                     inst._thread.start()
                     if not inst._started.wait(10):
                         return None
                 except (OSError, RuntimeError):
                     return None
-                cls._instance = inst
-            return cls._instance
+                cls._instances[root] = inst
+            return inst
 
     @property
     def url(self) -> str:
@@ -107,17 +109,242 @@ def _member_id() -> str:
     return f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
 
 
+def _stream_blob_into_cache(backend, key: str, cache_root: Path,
+                            wait_parent: bool = False,
+                            cache_name: Optional[str] = None,
+                            remote_name: Optional[str] = None) -> Path:
+    """Streaming blob download into the peer cache.
+
+    Bytes land in a fetcher-private ``.part-<pid>-<uuid>`` file as they
+    arrive (its ``.size`` sidecar written first, from the Content-Length /
+    X-KT-Blob-Size header), with a ``<name>.part`` symlink claiming it, so
+    this member's :class:`PeerServer` serves children while the download
+    is still running — the chunk-pipelined relay that makes tree
+    wall-clock ≈ one transfer instead of depth × transfer. The symlink
+    doubles as the local dedup claim: concurrent fetchers of the same key
+    wait for the claimant's final file, and a steal after a stall just
+    re-points the symlink at the stealer's own private part — two live
+    fetchers can never interleave writes into one file.
+
+    ``cache_name``: store the blob under this name instead of the key
+    (broadcast_get passes a content-version-scoped name so a peer's cache
+    from a previous put of the same key can never satisfy this round's
+    children).
+    ``remote_name``: the name to request from the source — the versioned
+    cache name when the source is a peer (its cache uses the same
+    scheme), the plain key when it is the central store.
+    ``wait_parent``: ask the source to hold the request briefly if its own
+    fetch hasn't started yet (``?wait=1``; peers only).
+    """
+    import http.client as _hc
+    from urllib.parse import quote, urlsplit
+
+    from kubetorch_tpu.retry import RetryableStatus, with_retries
+
+    local = cache_root / (cache_name or key)
+    local.parent.mkdir(parents=True, exist_ok=True)
+    part = local.with_name(
+        f"{local.name}.part-{os.getpid()}-{uuid.uuid4().hex[:6]}")
+    size_f = part.with_name(part.name + ".size")
+    claim = local.with_name(local.name + ".part")
+
+    def take_claim() -> bool:
+        try:
+            os.symlink(part.name, claim)
+            return True
+        except FileExistsError:
+            return False
+
+    if not take_claim():
+        winner = _await_local_fetch(local, claim)
+        if winner is not None:
+            return winner
+        # stale claim (fetcher crashed or wedged): re-point it at our own
+        # private part file and fetch ourselves — the previous claimant,
+        # if still alive, keeps writing ITS part; no shared fd, no
+        # interleaving, and both finals hold identical bytes.
+        steal = claim.with_name(
+            f".{claim.name}.{os.getpid()}-{uuid.uuid4().hex[:6]}.steal")
+        try:
+            os.symlink(part.name, steal)
+            os.replace(steal, claim)
+        except OSError:
+            winner = _await_local_fetch(local, claim)
+            if winner is not None:
+                return winner
+            raise DataStoreError(f"local fetch of {key!r} wedged")
+
+    query = "?wait=1" if wait_parent else ""
+    parts = urlsplit(f"{backend.base_url}/blob/"
+                     f"{quote(remote_name or key, safe='/')}{query}")
+    conn_cls = (_hc.HTTPSConnection if parts.scheme == "https"
+                else _hc.HTTPConnection)
+    port = parts.port or (443 if parts.scheme == "https" else 80)
+
+    def attempt():
+        import json as _json
+
+        conn = conn_cls(parts.hostname, port, timeout=30.0)
+        buf = bytearray(4 << 20)
+        view = memoryview(buf)
+        try:
+            conn.request("GET", parts.path + (f"?{parts.query}"
+                                              if parts.query else ""))
+            resp = conn.getresponse()
+            if resp.status in (502, 503, 504):
+                raise RetryableStatus(resp.status,
+                                      resp.read(200).decode("latin1"))
+            if resp.status == 404:
+                raise DataStoreError(f"no such key {key!r}", status=404)
+            if resp.status >= 400:
+                raise DataStoreError(
+                    f"peer get failed ({resp.status}): "
+                    f"{resp.read(200)!r}", status=resp.status)
+            if resp.status == 202:
+                # source is itself mid-fetch: window our reads over its
+                # growing .part (ranged GETs land on sendfile, so relayed
+                # bytes never pass through the parent's Python)
+                info = _json.loads(resp.read())
+                total = int(info["size"])
+                size_f.write_text(str(total))
+                return _windowed_fetch(conn, parts.path, part, total, view)
+            # complete source: one streamed body
+            total = (resp.getheader("X-KT-Blob-Size")
+                     or resp.getheader("Content-Length"))
+            if total is not None:
+                size_f.write_text(str(int(total)))
+            got = 0
+            with open(part, "wb") as fh:
+                while True:
+                    n = resp.readinto(view)
+                    if n <= 0:
+                        break
+                    fh.write(view[:n])
+                    fh.flush()  # children tail this file
+                    got += n
+            if total is not None and got != int(total):
+                raise OSError(f"short blob stream {got}/{total}")
+            return got
+        finally:
+            conn.close()
+
+    try:
+        with_retries(attempt,
+                     retry_on=(OSError, _hc.HTTPException, RetryableStatus),
+                     max_attempts=getattr(backend, "retry_attempts", 0))
+        os.replace(part, local)
+    except RetryableStatus as exc:
+        raise DataStoreError(
+            f"blob stream {key!r} failed after retries: {exc}",
+            status=exc.status) from None
+    except _hc.HTTPException as exc:
+        raise DataStoreError(
+            f"blob stream {key!r} failed: {type(exc).__name__}: {exc}"
+        ) from exc
+    finally:
+        size_f.unlink(missing_ok=True)
+        part.unlink(missing_ok=True)
+        try:  # release the claim only if it still points at OUR part
+            if os.readlink(claim) == part.name:
+                claim.unlink(missing_ok=True)
+        except OSError:
+            pass
+    if cache_name is not None:
+        # version-scoped cache files accumulate across re-puts of the same
+        # key: drop superseded versions (best-effort; readers mid-serve
+        # hold open fds and are unaffected)
+        base = (cache_root / key).name
+        for old in local.parent.glob(f"{base}.bv*"):
+            if old.name != local.name and ".part" not in old.name:
+                old.unlink(missing_ok=True)
+    return local
+
+
+def _windowed_fetch(conn, url_path: str, part: Path, total: int,
+                    view) -> int:
+    """Drain a mid-fetch source: probe ``?progress=1`` for available
+    bytes, pull each new span with a ranged GET (one keep-alive
+    connection), append to our own ``.part`` so our children can chain."""
+    import json as _json
+
+    off = 0
+    last_progress = time.time()
+    with open(part, "wb") as fh:
+        while off < total:
+            conn.request("GET", url_path + "?progress=1")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise OSError(f"progress probe failed ({resp.status}): "
+                              f"{resp.read(200)!r}")
+            info = _json.loads(resp.read())
+            avail = int(info["size"] if info["complete"] else info["have"])
+            if avail > off:
+                conn.request("GET", url_path,
+                             headers={"Range": f"bytes={off}-{avail - 1}"})
+                span = conn.getresponse()
+                if span.status not in (200, 206):
+                    raise OSError(f"ranged get failed ({span.status}): "
+                                  f"{span.read(200)!r}")
+                while True:
+                    n = span.readinto(view)
+                    if n <= 0:
+                        break
+                    fh.write(view[:n])
+                    fh.flush()  # our children tail this file
+                    off += n
+                last_progress = time.time()
+            elif time.time() - last_progress > 60.0:
+                raise OSError(f"relay parent stalled at {off}/{total}")
+            else:
+                time.sleep(0.005)
+    return off
+
+
+def _await_local_fetch(local: Path, claim: Path,
+                       stall: float = 60.0) -> Optional[Path]:
+    """Wait for another local process's in-flight fetch of the same key
+    (the ``.part`` symlink claim). Returns the final path, or None if the
+    claimant looks dead (no growth of its part file within ``stall``
+    seconds)."""
+    last_size, last_change = -1, time.time()
+    while True:
+        if local.is_file():
+            return local
+        if not claim.is_symlink():
+            # claimant finished (file may appear a beat later) or crashed
+            if local.is_file():
+                return local
+            if time.time() - last_change > 2.0:
+                return None
+            time.sleep(0.02)
+            continue
+        try:
+            size = (claim.parent / os.readlink(claim)).stat().st_size
+        except OSError:
+            size = -1
+        if size != last_size:
+            last_size, last_change = size, time.time()
+        elif time.time() - last_change > stall:
+            return None
+        time.sleep(0.05)
+
+
 def _fetch_into_cache(backend, key: str, cache_root: Path,
-                      excludes=None) -> Tuple[Path, bool]:
+                      excludes=None,
+                      wait_parent: bool = False,
+                      blob_cache_name: Optional[str] = None,
+                      blob_remote_name: Optional[str] = None
+                      ) -> Tuple[Path, bool]:
     """Pull ``key`` from ``backend`` into the peer cache, preserving the
     blob-vs-tree distinction so we can re-serve it unchanged. Returns
     (local path, is_tree).
 
     Publishes atomically: siblings assigned the same source write this same
-    cache path concurrently while we may already be serving it. Blobs go
-    through tmp-file + ``os.replace``; trees are staged into a private dir
-    and swapped in via symlink replace (the serving side realpath-pins a
-    version per request, so readers never see a half-synced tree)."""
+    cache path concurrently while we may already be serving it. Blobs
+    stream through ``.part`` + ``os.replace`` (serving children mid-fetch,
+    see :func:`_stream_blob_into_cache`); trees are staged into a private
+    dir and swapped in via symlink replace (the serving side realpath-pins
+    a version per request, so readers never see a half-synced tree)."""
     from kubetorch_tpu.data_store.sync import DEFAULT_EXCLUDES
 
     excludes = DEFAULT_EXCLUDES if excludes is None else excludes
@@ -125,12 +352,10 @@ def _fetch_into_cache(backend, key: str, cache_root: Path,
     manifest_resp = backend._request(
         "GET", backend._url(f"/tree/{key}/manifest"))
     if manifest_resp.status_code == 404:
-        blob = backend.get_blob(key)
-        local.parent.mkdir(parents=True, exist_ok=True)
-        tmp = local.with_name(
-            f".{local.name}.{os.getpid()}-{uuid.uuid4().hex[:6]}.tmp")
-        tmp.write_bytes(blob)
-        os.replace(tmp, local)
+        local = _stream_blob_into_cache(backend, key, cache_root,
+                                        wait_parent=wait_parent,
+                                        cache_name=blob_cache_name,
+                                        remote_name=blob_remote_name)
         return local, False
     backend._raise_for(manifest_resp, "manifest")
     # "tmp-" prefix marks an in-progress stage: the sweeper must never
@@ -214,23 +439,34 @@ def _sweep_stale_trees(cache_root: Path, grace: float = 120.0,
 
 
 def broadcast_get(store_backend, key: str, window: BroadcastWindow,
-                  dest: Optional[Path] = None, excludes=None):
+                  dest: Optional[Path] = None, excludes=None,
+                  cache_root: Optional[Path] = None):
     """Coordinated fetch. Returns blob bytes, or the dest/cache Path for
     trees. Falls back to a direct store fetch if the parent peer dies."""
     from kubetorch_tpu.data_store.http_store import HttpStoreBackend
 
+    cache_root = Path(cache_root or window.cache_root or _CACHE_ROOT)
     group = window.resolved_group(key)
     mid = _member_id()
     deadline = time.time() + window.timeout
+    # Advertise BEFORE fetching: with the chunk-pipelined relay a member
+    # becomes a usable parent the moment its own download starts, so the
+    # coordinator needs the serve URL at join time, not at completion.
+    serve_url = None
+    if window.serve:
+        peer = PeerServer.ensure(cache_root)
+        if peer is not None:
+            serve_url = peer.url
     state = store_backend.bcast_join(
         group, key=key, member_id=mid, world_size=window.world_size,
-        fanout=window.fanout, lease=window.lease)
+        fanout=window.fanout, lease=window.lease,
+        serve_url=serve_url, stream=bool(serve_url))
     while state["status"] == "joined":
         if time.time() > deadline:
             raise DataStoreError(
                 f"broadcast {group!r}: no source within "
                 f"{window.timeout:.0f}s (rank {state['rank']})")
-        time.sleep(0.1)
+        time.sleep(0.02)
         try:
             state = store_backend.bcast_member(group, mid)
         except DataStoreError as e:
@@ -250,21 +486,29 @@ def broadcast_get(store_backend, key: str, window: BroadcastWindow,
               else HttpStoreBackend(parent_url, retry_attempts=1))
     import httpx
 
+    # Version-scope the blob's cache name: a peer advertised at JOIN time
+    # may still hold the previous put's bytes under the plain key — a
+    # child must only ever be satisfied by THIS content version (the
+    # coordinator invalidates groups on re-put, the .bv suffix extends
+    # that guarantee to the peers' caches). Peers are asked for the
+    # versioned name; the central store for the real key.
+    version = state.get("version")
+    cache_name = f"{key}.bv{version}" if version is not None else None
+
     try:
-        local, is_tree = _fetch_into_cache(parent, key, _CACHE_ROOT,
-                                           excludes=excludes)
+        local, is_tree = _fetch_into_cache(
+            parent, key, cache_root, excludes=excludes,
+            wait_parent=parent is not store_backend,
+            blob_cache_name=cache_name,
+            blob_remote_name=(cache_name if parent is not store_backend
+                              else None))
     except (DataStoreError, OSError, httpx.HTTPError):
         if parent is store_backend:
             raise
         # Parent peer died mid-serve: the store always has the bytes.
-        local, is_tree = _fetch_into_cache(store_backend, key, _CACHE_ROOT,
-                                           excludes=excludes)
-
-    serve_url = None
-    if window.serve:
-        peer = PeerServer.ensure()
-        if peer is not None:
-            serve_url = peer.url
+        local, is_tree = _fetch_into_cache(store_backend, key, cache_root,
+                                           excludes=excludes,
+                                           blob_cache_name=cache_name)
     try:
         store_backend.bcast_complete(group, mid, serve_url=serve_url)
     except (DataStoreError, httpx.HTTPError):

@@ -78,13 +78,19 @@ def iter_packed(tree: Any, chunk: int = 8 << 20):
     buffer — a multi-GB param tree streams straight onto the wire."""
     host_leaves, treedef = _host_leaves(tree)
     yield _pack_header(host_leaves, treedef)
+    for block in _iter_leaf_bytes(host_leaves, chunk):
+        yield bytes(block)
+
+
+def _iter_leaf_bytes(host_leaves, chunk: int = 32 << 20):
+    """Zero-copy memoryview chunks over the leaves' raw bytes."""
     for array in host_leaves:
         # uint8 view: ml_dtypes dtypes (bfloat16/fp8) have no buffer
         # protocol of their own, but any contiguous array views as bytes
         flat = np.ascontiguousarray(array).reshape(-1).view(np.uint8)
         mv = memoryview(flat)
         for i in range(0, len(mv), chunk):
-            yield bytes(mv[i:i + chunk])
+            yield mv[i:i + chunk]
 
 
 def unpack_arrays(data: bytes, template: Optional[Any] = None) -> Any:
@@ -121,9 +127,20 @@ def put_arrays(key: str, tree: Any) -> str:
     from kubetorch_tpu.data_store.client import DataStoreClient
 
     backend = DataStoreClient.default()._backend()
-    if hasattr(backend, "put_blob_stream"):
-        return backend.put_blob_stream(key, lambda: iter_packed(tree))
-    return backend.put_blob(key, pack_arrays(tree))
+    if not hasattr(backend, "put_blob_stream"):
+        return backend.put_blob(key, pack_arrays(tree))
+    host_leaves, treedef = _host_leaves(tree)
+    header = _pack_header(host_leaves, treedef)
+    total = len(header) + sum(a.nbytes for a in host_leaves)
+
+    def chunks():
+        yield header
+        yield from _iter_leaf_bytes(host_leaves)
+
+    # known total length → the store's raw sendall path: leaf bytes go
+    # memoryview→socket with zero copies (publish used to trail raw
+    # blob-put by ~28% purely on pack/frame copies)
+    return backend.put_blob_stream(key, chunks, length=total)
 
 
 def get_arrays(

@@ -163,15 +163,86 @@ class StoreServer:
         return web.json_response({"key": key, "size": size})
 
     async def h_get_blob(self, request):
+        """Blob reads, including the chunk-pipelined broadcast relay.
+
+        A blob this node is still FETCHING (``.part`` + ``.size`` sidecar,
+        written by ``broadcast._stream_blob_into_cache``) is served in
+        windows: children probe ``?progress=1`` for the bytes available so
+        far, then issue ranged GETs against the growing ``.part`` —
+        answered by ``FileResponse`` (sendfile), so relayed bytes never
+        pass through Python. That lets a broadcast-tree child start while
+        its parent's own download is in flight: tree wall-clock ≈ one
+        transfer regardless of depth. Reference analogue: fs-broadcast
+        children block on FULL parent completion
+        (``pod_data_server.py:2182``); the windowed tail removes that
+        serialization.
+
+        ``?wait=1`` (broadcast children) polls briefly for the fetch to
+        start instead of 404ing — children are often assigned a parent
+        before the parent's first byte arrives.
+        """
+        import asyncio
+
         key = _norm_key(request.match_info["key"])
         path = self._path(key)
-        if not path.is_file():
-            raise web.HTTPNotFound(text=f"no such key {key!r}")
-        self.stats["gets"] += 1
-        self.stats["bytes_out"] += path.stat().st_size
-        # FileResponse: sendfile-backed, no whole-blob buffering
-        return web.FileResponse(
-            path, headers={"Content-Type": "application/octet-stream"})
+        claim = path.with_name(path.name + ".part")  # symlink → private part
+
+        def part_info():
+            """(private part path, declared total, bytes so far) or Nones.
+            The claim is a symlink to the live fetcher's private part file
+            (see broadcast._stream_blob_into_cache); its .size sidecar is
+            written before the first byte."""
+            try:
+                target = claim.parent / os.readlink(claim)
+                total = int(target.with_name(target.name + ".size")
+                            .read_text().strip())
+                return target, total, target.stat().st_size
+            except (OSError, ValueError):
+                return None, None, None
+
+        deadline = time.time() + (10.0 if request.query.get("wait") else 0.0)
+        part, total, have = part_info()
+        while not path.is_file() and part is None:
+            if time.time() > deadline:
+                raise web.HTTPNotFound(text=f"no such key {key!r}")
+            await asyncio.sleep(0.02)
+            part, total, have = part_info()
+
+        def span_bytes(size):
+            """Bytes a ranged request will actually ship (stats)."""
+            rng = request.http_range
+            try:
+                start = rng.start or 0
+                stop = rng.stop if rng.stop is not None else size
+                return max(0, min(stop, size) - start)
+            except (TypeError, ValueError):
+                return size
+
+        if path.is_file():
+            size = path.stat().st_size
+            if request.query.get("progress"):
+                return web.json_response(
+                    {"size": size, "have": size, "complete": True})
+            self.stats["gets"] += 1
+            self.stats["bytes_out"] += span_bytes(size)
+            # FileResponse: sendfile-backed, no whole-blob buffering
+            return web.FileResponse(
+                path, headers={"Content-Type": "application/octet-stream"})
+
+        if request.query.get("progress"):
+            return web.json_response(
+                {"size": total, "have": have, "complete": False})
+        if request.headers.get("Range"):
+            # the child only requests spans it saw in a progress probe,
+            # so the range is always within the current .part
+            self.stats["gets"] += 1
+            self.stats["bytes_out"] += span_bytes(have)
+            return web.FileResponse(
+                part, headers={"Content-Type": "application/octet-stream",
+                               "X-KT-Blob-Size": str(total)})
+        # plain GET of an in-flight blob: tell the caller to window
+        return web.json_response(
+            {"size": total, "have": have, "complete": False}, status=202)
 
     async def h_keys(self, request):
         prefix = request.query.get("prefix", "").strip("/")
@@ -356,16 +427,32 @@ class StoreServer:
                     g["active"][pid] = max(0, g["active"].get(pid, 1) - 1)
         peers: List[tuple] = [  # (member_id, url)
             (mid, m["serve_url"]) for mid, m in g["members"].items()
-            if m["status"] == "complete" and m["serve_url"]]
+            if m["serve_url"]
+            and (m["status"] == "complete"
+                 # chunk-pipelined relay: a member still fetching a BLOB
+                 # serves its .part tail, so children chain immediately
+                 # instead of waiting out the parent's full download
+                 or (m["status"] == "fetching" and m.get("stream")))]
+        any_complete = any(m["status"] == "complete"
+                           for m in g["members"].values())
         for m in sorted(g["members"].values(), key=lambda m: m["rank"]):
             if m["status"] != "joined":
                 continue
             # Peers first, store ("") as last resort: once the tree has any
             # completed peer, new joiners ride ICI-local copies and the
-            # store's egress stays O(fanout) for the whole group.
+            # store's egress stays O(fanout) for the whole group. During
+            # bootstrap (streaming relay, nobody complete yet) the store's
+            # spare fanout competes equally — chaining every early joiner
+            # behind rank 0 would trade tree depth for nothing, the store
+            # is idle anyway.
             open_sources = [(sid, url) for sid, url in peers
                             if g["active"].get(sid, 0) < fanout]
-            if not open_sources and g["active"].get("", 0) < fanout:
+            store_open = g["active"].get("", 0) < fanout
+            if store_open and not any_complete:
+                # bootstrap: fill the origin's fanout before chaining —
+                # the store is depth 0, every peer hop adds relay latency
+                open_sources = [("", "")]
+            elif store_open and not open_sources:
                 open_sources = [("", "")]
             if not open_sources:
                 return  # all sources saturated; member keeps polling
@@ -389,11 +476,16 @@ class StoreServer:
                 "rank": len(g["members"]), "status": "joined",
                 "parent": None, "parent_id": None,
                 "serve_url": info.get("serve_url"),
+                # streaming relay only works for blobs (a tree has no
+                # single .part to tail) and only if the client opted in
+                "stream": (bool(info.get("stream"))
+                           and self._path(g["key"]).is_file()),
             }
         self._bcast_assign(g)
         return web.json_response({
             "rank": member["rank"], "status": member["status"],
-            "parent": member["parent"], "key": g["key"]})
+            "parent": member["parent"], "key": g["key"],
+            "version": g["fingerprint"]})
 
     async def h_bcast_member(self, request):
         g = self._bcast_group(request.match_info["group"])
@@ -404,7 +496,8 @@ class StoreServer:
         self._bcast_assign(g)
         return web.json_response({
             "rank": member["rank"], "status": member["status"],
-            "parent": member["parent"], "key": g["key"]})
+            "parent": member["parent"], "key": g["key"],
+            "version": g["fingerprint"]})
 
     async def h_bcast_complete(self, request):
         g = self._bcast_group(request.match_info["group"])

@@ -58,6 +58,10 @@ class BroadcastWindow:
     # coordinator (crashed-child protection). Raise for very large payloads
     # on slow links.
     lease: float = 120.0
+    # Override the peer-cache directory for this fetch (default
+    # KT_PEER_CACHE). Lets co-located members keep distinct caches — e.g.
+    # the dataplane bench simulating one pod per worker.
+    cache_root: Optional[str] = None
 
     def resolved_group(self, key: str) -> str:
         return self.group_id or f"bcast-{key.replace('/', '-')}"

@@ -44,6 +44,32 @@ def _quantize_leaf(w: jax.Array):
     return q.astype(jnp.int8), scale.astype(w.dtype)
 
 
+def fuse_decode_layers(layers: Dict[str, Any]) -> Dict[str, Any]:
+    """Pack same-input quantized projections into single weights.
+
+    ``wq+wk+wv → wqkv`` and ``w_gate+w_up → wgu`` (scales concatenated the
+    same way). Decode then issues one weight-streaming kernel call where it
+    issued three (QKV) / two (gate·up): at 32 layers × 128 steps the fixed
+    per-call cost is a measurable slice of the decode step, and larger
+    column counts keep the DMA pipeline full longer.
+
+    Serving-only layout: ``llama._block_cached`` / ``_mlp`` read the fused
+    keys when present; the training forward and ``dequantize_params`` do
+    not (keep the unfused tree for anything but a Generator).
+    """
+    layers = dict(layers)
+    for fused, parts in (("wqkv", ("wq", "wk", "wv")),
+                         ("wgu", ("w_gate", "w_up"))):
+        if not all(p in layers and p + "_scale" in layers for p in parts):
+            continue
+        layers[fused] = jnp.concatenate([layers[p] for p in parts], axis=-1)
+        layers[fused + "_scale"] = jnp.concatenate(
+            [layers[p + "_scale"] for p in parts], axis=-1)
+        for p in parts:
+            del layers[p], layers[p + "_scale"]
+    return layers
+
+
 def quantize_params(params: Dict[str, Any],
                     keys: Sequence[str] = QUANT_KEYS,
                     quantize_unembed: bool = False) -> Dict[str, Any]:
@@ -112,6 +138,10 @@ def dequantize_params(params: Dict[str, Any],
                       dtype=jnp.bfloat16) -> Dict[str, Any]:
     """Materialize full-precision weights back (debug / quality checks)."""
     layers = dict(params["layers"])
+    if "wqkv" in layers or "wgu" in layers:
+        raise ValueError(
+            "fused decode layout (wqkv/wgu) cannot be dequantized — keep "
+            "the unfused tree for debugging; fusion is serving-only")
     for name in list(layers):
         if name.endswith("_scale"):
             base = name[: -len("_scale")]
@@ -130,7 +160,8 @@ def dequantize_params(params: Dict[str, Any],
 
 
 def init_quantized(key: jax.Array, cfg,
-                   keys: Sequence[str] = QUANT_KEYS) -> Dict[str, Any]:
+                   keys: Sequence[str] = QUANT_KEYS,
+                   fuse: bool = False) -> Dict[str, Any]:
     """Random params initialized *directly* in int8-quantized form.
 
     For serving-scale benchmarks and smoke tests of models whose bf16 tree
@@ -190,6 +221,8 @@ def init_quantized(key: jax.Array, cfg,
         if not cfg.tie_embeddings:
             out["lm_head"] = jax.random.normal(
                 next(ks), (E, V), pdt) * (E ** -0.5)
+        if fuse:
+            out["layers"] = fuse_decode_layers(out["layers"])
         return out
 
     return jax.jit(build)(key)

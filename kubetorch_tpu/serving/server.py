@@ -436,7 +436,8 @@ class PodServer:
                 headers={"Content-Type": "application/zip",
                          "X-Trace-Dir": result.get("dir", "")})
         return web.json_response(
-            {k: v for k, v in result.items() if not isinstance(v, bytes)})
+            {k: v for k, v in result.items()
+             if not isinstance(v, (bytes, bytearray))})
 
     async def h_proxy(self, request: web.Request):
         """Reverse proxy to an App's own HTTP port (reference:

@@ -45,7 +45,7 @@ def store(tmp_path, monkeypatch):
     import kubetorch_tpu.data_store.broadcast as bcast
 
     monkeypatch.setattr(bcast, "_CACHE_ROOT", tmp_path / "peer-cache")
-    monkeypatch.setattr(bcast.PeerServer, "_instance", None)
+    monkeypatch.setattr(bcast.PeerServer, "_instances", {})
     yield url
     proc.terminate()
     proc.wait(5)
@@ -85,6 +85,47 @@ def test_blob_broadcast_tree_offloads_store(store):
     # once peers complete they absorb later joiners — so a meaningful share
     # of the group must have fetched from peers, not the store.
     assert status["store_children"] <= world - 2
+
+
+@pytest.mark.level("minimal")
+def test_reput_never_serves_stale_peer_bytes(store, tmp_path):
+    """A peer advertised at JOIN time still holds the previous put's bytes
+    in its cache; children of the new round's group must get the NEW
+    content (version-scoped .bv cache names)."""
+    backend = HttpStoreBackend(store)
+    world = 4
+
+    def fan_out(expect):
+        results = [None] * world
+        errors = []
+
+        def worker(i):
+            try:
+                window = BroadcastWindow(
+                    world_size=world, fanout=1, timeout=60,
+                    cache_root=str(tmp_path / f"peer{i}"))
+                be = HttpStoreBackend(store)
+                results[i] = be.get_blob("bcast/reput.bin",
+                                         broadcast=window)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(90)
+        assert not errors, errors
+        assert all(bytes(r) == expect for r in results)
+
+    round1 = os.urandom(128 * 1024)
+    backend.put_blob("bcast/reput.bin", round1)
+    fan_out(round1)
+
+    round2 = os.urandom(128 * 1024)
+    backend.put_blob("bcast/reput.bin", round2)
+    fan_out(round2)
 
 
 @pytest.mark.level("minimal")

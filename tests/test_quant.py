@@ -77,6 +77,52 @@ def test_quantized_generator_runs():
 
 
 @pytest.mark.level("unit")
+def test_fused_decode_layout_matches_unfused():
+    """wqkv/wgu fusion (serving layout) must produce identical cached
+    forwards to the unfused quantized tree."""
+    from kubetorch_tpu.models.quant import fuse_decode_layers
+
+    cfg = _cfg()
+    params = quantize_params(llama.init(jax.random.key(7), cfg))
+    fused = dict(params)
+    fused["layers"] = fuse_decode_layers(params["layers"])
+    assert "wqkv" in fused["layers"] and "wq" not in fused["layers"]
+    assert "wgu" in fused["layers"] and "w_up" not in fused["layers"]
+
+    B, P, max_len = 2, 6, 16
+    toks = jnp.asarray([[5, 3, 9, 2, 8, 1], [7, 2, 4, 8, 1, 6]], jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(P)[None, :], (B, P))
+    m = jnp.arange(max_len)[None, None, :]
+    t = jnp.arange(P)[None, :, None]
+    mask = (m <= t) & (m < P)
+    cache = llama.init_cache(cfg, B, max_len)
+    want, _ = llama.forward_cached(
+        params, toks, positions, cache, 0, mask, cfg)
+    got, _ = llama.forward_cached(
+        fused, toks, positions, cache, 0, mask, cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # fusion is serving-only: debugging keeps the unfused tree
+    with pytest.raises(ValueError):
+        from kubetorch_tpu.models.quant import dequantize_params as dq
+
+        dq(fused)
+
+
+@pytest.mark.level("unit")
+def test_init_quantized_fused_structure():
+    from kubetorch_tpu.models import quant
+
+    cfg = LlamaConfig.tiny(n_layers=2)
+    ref = quant.quantize_params(llama.init(jax.random.key(0), cfg))
+    ref_fused = quant.fuse_decode_layers(ref["layers"])
+    new = quant.init_quantized(jax.random.key(1), cfg, fuse=True)
+    ref_map = {k: (v.shape, v.dtype) for k, v in ref_fused.items()}
+    new_map = {k: (v.shape, v.dtype) for k, v in new["layers"].items()}
+    assert ref_map == new_map
+
+
+@pytest.mark.level("unit")
 def test_quantized_moe_forward():
     cfg = _cfg(mlp_dim=64,
                moe=MoEConfig(num_experts=4, top_k=2, expert_mlp_dim=64,
