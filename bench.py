@@ -105,7 +105,12 @@ def _bench_weight_sync(cfg):
     jax.block_until_ready(params)
     nbytes = sum(x.nbytes for x in jax.tree.leaves(params))
 
-    tmp = Path(tempfile.mkdtemp(prefix="ktpu-wsync-"))
+    # RAM-backed store root when available: this stage measures the
+    # framework's pack/wire/unpack path — on a ~100 MB/s VM disk the
+    # number otherwise degenerates into a page-cache lottery (0.1-0.8 GB/s
+    # run to run for identical code)
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    tmp = Path(tempfile.mkdtemp(prefix="ktpu-wsync-", dir=base))
     store = _Store(tmp / "root")
     old_env = os.environ.get("KT_STORE_URL")
     os.environ["KT_STORE_URL"] = store.url
@@ -119,13 +124,17 @@ def _bench_weight_sync(cfg):
         t0 = time.perf_counter()
         host = jax.tree.map(np.asarray, params)
         stage_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        dt.put_arrays("bench/weights", host)
-        put_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        fetched = dt.get_arrays("bench/weights", template=host)
-        get_s = time.perf_counter() - t0
-        del fetched
+        # best-of-2: on a 1-CPU host the client and store processes share
+        # a core and single-shot timings swing ±3×
+        put_s = get_s = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            dt.put_arrays("bench/weights", host)
+            put_s = min(put_s, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            fetched = dt.get_arrays("bench/weights", template=host)
+            get_s = min(get_s, time.perf_counter() - t0)
+            del fetched
         return {"param_gb": round(nbytes / 1e9, 2),
                 "device_stage_GBps": round(nbytes / 1e9 / stage_s, 3),
                 "store_publish_GBps": round(nbytes / 1e9 / put_s, 2),
@@ -270,9 +279,14 @@ def _bench_tpu():
     # Largest-fitting single-chip train config (north star #3 proxy at
     # 1 chip): ~1.5B incl. 128k-vocab untied embeddings, B=2 S=2048.
     try:
-        big = LlamaConfig.llama3_1b(remat=True, remat_policy="dots")
+        # B=4 fits under dots_no_mlp (r3 sweep: B=2/dots 12.8k tok/s at
+        # 0.521 MFU → B=4/dots_no_mlp/chunk-4096 13.1k at 0.535 — larger
+        # optimizer amortization beats the mlp recompute; grad accumulation
+        # OOMs: the f32 grad accumulator can't sit beside adam state)
+        big = LlamaConfig.llama3_1b(remat=True, remat_policy="dots_no_mlp",
+                                    xent_chunk=4096)
         _free_device_memory()
-        r = _bench_train(big, batch=2, seq=2048, steps=8, n_dev=n_dev)
+        r = _bench_train(big, batch=4, seq=2048, steps=8, n_dev=n_dev)
         r.pop("params")
         extra["llama_1.5b_train_tok_s_per_chip"] = round(
             r["tokens_per_sec_per_chip"], 1)

@@ -174,7 +174,8 @@ def bench_broadcast(store: "_Store", world: int = 8,
     # per-worker cache roots: each worker simulates its own pod — a shared
     # root would let the O_EXCL fetch-dedup collapse the tree into one
     # download + 7 local cache hits and measure nothing network-shaped
-    cache_base = Path(tempfile.mkdtemp(prefix="ktpu-bcast-cache-"))
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    cache_base = Path(tempfile.mkdtemp(prefix="ktpu-bcast-cache-", dir=base))
 
     def bcast_fetch(key, expect):
         def fetch(b, i):
@@ -206,7 +207,9 @@ def bench_broadcast(store: "_Store", world: int = 8,
 
 
 def run() -> Dict[str, float]:
-    tmp = Path(tempfile.mkdtemp(prefix="ktpu-dpbench-"))
+    # RAM-backed when available: measure the data plane, not the VM disk
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    tmp = Path(tempfile.mkdtemp(prefix="ktpu-dpbench-", dir=base))
     store = None
     try:
         store = _Store(tmp / "root")

@@ -259,7 +259,11 @@ def teardown(service):
 @click.option("--pod", type=int, default=0, help="replica index to attach to")
 @click.option("--port", type=int, default=None,
               help="in-pod debug port (default 5678 + LOCAL_RANK)")
-def debug(service, pod, port):
+@click.option("--pty", is_flag=True,
+              help="raw-terminal PTY session (pair with a "
+                   "deep_breakpoint(pty=True) server): tty line editing, "
+                   "echo, and window resizes")
+def debug(service, pod, port, pty):
     """Attach to a deep_breakpoint() inside a deployed service."""
     from kubetorch_tpu.provisioning.backend import get_backend
     from kubetorch_tpu.serving.debugger import attach
@@ -275,7 +279,7 @@ def debug(service, pod, port):
             f"pod index {pod} out of range ({len(urls)} pods)")
     click.echo(f"attaching to {urls[pod]} ... (q to quit pdb, Ctrl-D to "
                f"detach)")
-    sys.exit(attach(urls[pod], port=port))
+    sys.exit(attach(urls[pod], port=port, pty=pty))
 
 
 # ---------------------------------------------------------------- profile

@@ -171,7 +171,9 @@ class ControllerServer:
             persist = LogPersistence(
                 Path(obs_dir) / "logs",
                 retain_bytes=int(retain_mb * 1024 * 1024),
-                retain_secs=retain_h * 3600.0)
+                retain_secs=retain_h * 3600.0,
+                max_pending_batches=int(
+                    os.environ.get("KT_LOG_MAX_PENDING", "512")))
             snapshot = MetricsSnapshot(Path(obs_dir) / "metrics.json")
         self.log_sink = LogSink(persist=persist)
         self.metrics_store = MetricsStore(snapshot=snapshot)
@@ -336,6 +338,10 @@ class ControllerServer:
             "connected_pods": sum(
                 len(p) for p in self.hub.by_service.values()),
             "waiting_pods": len(self.hub.waiting),
+            # log batches shed by the bounded persist buffer under flood
+            # (0 in healthy operation) — watch this before raising caps
+            "log_batches_dropped": getattr(
+                self.log_sink.persist, "dropped_batches", 0),
         })
 
     async def h_config(self, request):

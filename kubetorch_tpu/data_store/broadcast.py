@@ -137,7 +137,6 @@ def _stream_blob_into_cache(backend, key: str, cache_root: Path,
     fetch hasn't started yet (``?wait=1``; peers only).
     """
     import http.client as _hc
-    from urllib.parse import quote, urlsplit
 
     from kubetorch_tpu.retry import RetryableStatus, with_retries
 
@@ -174,22 +173,20 @@ def _stream_blob_into_cache(backend, key: str, cache_root: Path,
                 return winner
             raise DataStoreError(f"local fetch of {key!r} wedged")
 
+    from kubetorch_tpu.data_store.http_store import raw_target
+
     query = "?wait=1" if wait_parent else ""
-    parts = urlsplit(f"{backend.base_url}/blob/"
-                     f"{quote(remote_name or key, safe='/')}{query}")
-    conn_cls = (_hc.HTTPSConnection if parts.scheme == "https"
-                else _hc.HTTPConnection)
-    port = parts.port or (443 if parts.scheme == "https" else 80)
+    make_conn, req_path = raw_target(
+        f"{backend.base_url}/blob/{remote_name or key}{query}")
 
     def attempt():
         import json as _json
 
-        conn = conn_cls(parts.hostname, port, timeout=30.0)
+        conn = make_conn()
         buf = bytearray(4 << 20)
         view = memoryview(buf)
         try:
-            conn.request("GET", parts.path + (f"?{parts.query}"
-                                              if parts.query else ""))
+            conn.request("GET", req_path)
             resp = conn.getresponse()
             if resp.status in (502, 503, 504):
                 raise RetryableStatus(resp.status,
@@ -207,7 +204,9 @@ def _stream_blob_into_cache(backend, key: str, cache_root: Path,
                 info = _json.loads(resp.read())
                 total = int(info["size"])
                 size_f.write_text(str(total))
-                return _windowed_fetch(conn, parts.path, part, total, view)
+                plain_path = req_path.split("?")[0]
+                return _windowed_fetch(conn, plain_path, part, total,
+                                       view)
             # complete source: one streamed body
             total = (resp.getheader("X-KT-Blob-Size")
                      or resp.getheader("Content-Length"))

@@ -33,6 +33,27 @@ from kubetorch_tpu.data_store.sync import (
 _TIMEOUT = httpx.Timeout(connect=10.0, read=600.0, write=600.0, pool=10.0)
 
 
+def raw_target(url: str):
+    """(conn_factory, path_with_query) for the stdlib-``http.client`` fast
+    paths (multi-GB blob GET/PUT and the broadcast relay use raw
+    connections: httpx/h11 framing caps throughput at weight scale).
+    ``conn_factory()`` returns a fresh connection with a 30 s per-recv
+    timeout — bounds an unresponsive host without limiting transfer size.
+    """
+    import http.client as _hc
+    from urllib.parse import quote, urlsplit
+
+    parts = urlsplit(url)
+    conn_cls = (_hc.HTTPSConnection if parts.scheme == "https"
+                else _hc.HTTPConnection)
+    port = parts.port or (443 if parts.scheme == "https" else 80)
+    path = quote(parts.path, safe="/%")
+    if parts.query:
+        path += f"?{parts.query}"
+    host = parts.hostname
+    return (lambda: conn_cls(host, port, timeout=30.0)), path
+
+
 class HttpStoreBackend:
     def __init__(self, base_url: str, retry_attempts: int = 0):
         """``retry_attempts``: 0 = policy default (KT_RETRY_ATTEMPTS);
@@ -171,16 +192,11 @@ class HttpStoreBackend:
             self._raise_for(resp, "put")
             return key
         import http.client as _hc
-        from urllib.parse import quote, urlsplit
 
-        parts = urlsplit(self._url(f"/blob/{key}"))
-        conn_cls = (_hc.HTTPSConnection if parts.scheme == "https"
-                    else _hc.HTTPConnection)
-        port = parts.port or (443 if parts.scheme == "https" else 80)
-        quoted_path = quote(parts.path, safe="/%")
+        make_conn, quoted_path = raw_target(self._url(f"/blob/{key}"))
 
         def attempt():
-            conn = conn_cls(parts.hostname, port, timeout=30.0)
+            conn = make_conn()
             try:
                 conn.putrequest("PUT", quoted_path)
                 conn.putheader("Content-Length", str(length))
@@ -232,21 +248,14 @@ class HttpStoreBackend:
             return broadcast_get(self, key, broadcast)
         # stdlib http.client for the raw download: ~0.9 GB/s vs httpx's
         # ~0.12 (h11 receive overhead dominates multi-GB weight fetches).
+        # raw_target quotes the path like httpx does on PUT — the request
+        # lines must match or keys with spaces write fine and fail to read
         import http.client as _hc
-        from urllib.parse import quote, urlsplit
 
-        parts = urlsplit(self._url(f"/blob/{key}"))
-        conn_cls = (_hc.HTTPSConnection if parts.scheme == "https"
-                    else _hc.HTTPConnection)
-        port = parts.port or (443 if parts.scheme == "https" else 80)
-        # httpx percent-encodes on PUT; the raw request line must match
-        # or keys with spaces/non-ASCII write fine and fail to read back
-        quoted_path = quote(parts.path, safe="/%")
+        make_conn, quoted_path = raw_target(self._url(f"/blob/{key}"))
 
         def attempt():
-            # socket timeout applies per recv(), so a 30 s cap bounds an
-            # unresponsive host without limiting multi-GB transfers
-            conn = conn_cls(parts.hostname, port, timeout=30.0)
+            conn = make_conn()
             try:
                 conn.request("GET", quoted_path)
                 resp = conn.getresponse()

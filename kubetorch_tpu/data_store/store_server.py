@@ -90,6 +90,7 @@ class StoreServer:
         r.add_get("/blob/{key:.+}", self.h_get_blob)
         r.add_get("/keys", self.h_keys)
         r.add_delete("/key/{key:.+}", self.h_delete)
+        r.add_post("/cleanup", self.h_cleanup)
         r.add_post("/tree/{key:.+}/diff", self.h_tree_diff)
         r.add_post("/tree/{key:.+}/upload", self.h_tree_upload)
         r.add_get("/tree/{key:.+}/manifest", self.h_tree_manifest)
@@ -158,6 +159,7 @@ class StoreServer:
         # the 1h source TTL).
         self.sources.pop(key, None)
         self.versions[key] = self.versions.get(key, 0) + 1
+        self._stamp(key)
         self.stats["puts"] += 1
         self.stats["bytes_in"] += size
         return web.json_response({"key": key, "size": size})
@@ -250,6 +252,9 @@ class StoreServer:
         out = []
         if base.exists():
             for path in sorted(base.rglob("*")):
+                # skip retention stamps and in-flight .tmp staging files
+                if path.name.endswith(".kt-stamp") or path.name.startswith("."):
+                    continue
                 if path.is_file():
                     stat = path.stat()
                     out.append({"key": str(path.relative_to(self.root)),
@@ -272,9 +277,89 @@ class StoreServer:
         else:
             path.unlink()
             count = 1
+        path.with_name(path.name + ".kt-stamp").unlink(missing_ok=True)
         self.sources.pop(key, None)
         self.versions[key] = self.versions.get(key, 0) + 1
         return web.json_response({"deleted": count})
+
+    def _stamp(self, key: str):
+        """Record the key's last WRITE time in a sidecar. Retention must
+        not key off file mtimes: tar extraction preserves source mtimes
+        (the delta manifest depends on that), so a freshly-uploaded tree
+        full of year-old vendored files would look expired on day one."""
+        path = self._path(key)
+        stamp = path.with_name(path.name + ".kt-stamp")
+        try:
+            stamp.touch()
+        except OSError:
+            pass
+
+    async def h_cleanup(self, request):
+        """Retention sweep: delete KEYS (whole blob or tree) not written
+        for longer than ``max_age_s`` (optionally under ``prefix``),
+        pruning emptied dirs. Key age comes from the ``.kt-stamp`` sidecar
+        written on every put/upload; unstamped entries are left alone —
+        never delete what can't be dated.
+
+        The chart's store-cleanup CronJob POSTs here daily — the store owns
+        its retention instead of a sidecar kubectl-exec'ing ``find -mmin``
+        into the pod (reference
+        ``charts/kubetorch/templates/data-store/cronjob/cleanup.yaml``,
+        which needed an extra image + pods/exec RBAC and deleted by
+        directory age at the same whole-service granularity).
+        """
+        import asyncio
+
+        body = await request.json() if request.can_read_body else {}
+        max_age = float(body.get("max_age_s", 7 * 86400))
+        prefix = str(body.get("prefix", "")).strip("/")
+        if ".." in prefix.split("/"):
+            raise web.HTTPBadRequest(text=f"invalid prefix {prefix!r}")
+        base = self._path(prefix) if prefix else self.root
+        cutoff = time.time() - max_age
+
+        def sweep() -> int:
+            deleted = 0
+            if not base.exists():
+                return 0
+            stamps = ([base.with_name(base.name + ".kt-stamp")]
+                      if base.is_file() else list(base.rglob("*.kt-stamp")))
+            for stamp in stamps:
+                try:
+                    if not stamp.is_file() or stamp.stat().st_mtime >= cutoff:
+                        continue
+                    target = stamp.with_name(
+                        stamp.name[:-len(".kt-stamp")])
+                    rel = str(target.relative_to(self.root))
+                    if target.is_dir():
+                        deleted += sum(
+                            1 for p in target.rglob("*") if p.is_file())
+                        shutil.rmtree(target, ignore_errors=True)
+                    elif target.is_file():
+                        target.unlink(missing_ok=True)
+                        deleted += 1
+                    stamp.unlink(missing_ok=True)
+                    self.sources.pop(rel, None)
+                    self.versions[rel] = self.versions.get(rel, 0) + 1
+                except OSError:
+                    continue  # raced with a concurrent write/delete
+            for dirpath in sorted(
+                    (p for p in base.rglob("*") if p.is_dir()),
+                    key=lambda p: len(p.parts), reverse=True):
+                try:
+                    dirpath.rmdir()  # only succeeds when emptied
+                except OSError:
+                    pass
+            return deleted
+
+        # executor: a big PVC sweep is seconds of stat/unlink — on the
+        # event loop it would freeze every in-flight transfer (including
+        # broadcast relay probes) for the duration of the nightly cron
+        deleted = await asyncio.get_running_loop().run_in_executor(
+            None, sweep)
+        return web.json_response({"deleted": deleted,
+                                  "max_age_s": max_age,
+                                  "prefix": prefix})
 
     # ------------------------------------------------------ tree sync
     async def h_tree_diff(self, request):
@@ -308,6 +393,7 @@ class StoreServer:
                 target.unlink()
         self.sources.pop(key, None)  # peers hold the pre-upload tree
         self.versions[key] = self.versions.get(key, 0) + 1
+        self._stamp(key)
         self.stats["puts"] += 1
         self.stats["bytes_in"] += len(body)
         return web.json_response({"applied": count, "deleted": len(deletes)})

@@ -49,6 +49,10 @@ class LlamaConfig:
     # "auto" → pallas flash for long tileable sequences, XLA otherwise;
     # "ring" is engaged by passing a mesh with sp>1 to forward().
     attn_impl: str = "auto"        # auto | xla | flash
+    # fused-xent token chunk (ops/xent.py): live logits are [chunk, V] f32.
+    # 512 is optimal at 32k vocab; 128k-vocab configs measure faster at
+    # 2048-4096 (fewer scan steps, fatter unembed matmul).
+    xent_chunk: int = 512
 
     @property
     def compute_dtype(self):

@@ -22,7 +22,9 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional
@@ -40,7 +42,8 @@ class LogPersistence:
     def __init__(self, root: Path,
                  segment_bytes: int = 16 * 1024 * 1024,
                  retain_bytes: int = 256 * 1024 * 1024,
-                 retain_secs: float = 72 * 3600.0):
+                 retain_secs: float = 72 * 3600.0,
+                 max_pending_batches: int = 512):
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
         self.segment_bytes = segment_bytes
@@ -49,6 +52,15 @@ class LogPersistence:
         self._fh = None
         self._current: Optional[Path] = None
         self._current_size = 0
+        # Bounded intake: when pods push faster than the disk drains, shed
+        # the OLDEST queued batches (logs are telemetry — bounded loss
+        # beats unbounded controller memory growth; the reference shipped
+        # this problem to Loki). ``dropped_batches`` surfaces the shedding.
+        self._buf: "deque" = deque()
+        self._buf_lock = threading.Lock()
+        self._draining = False
+        self.max_pending_batches = max_pending_batches
+        self.dropped_batches = 0
         self._io = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="kt-obs-log")
         # Rotation-only enforcement never fires for low-volume or
@@ -79,7 +91,31 @@ class LogPersistence:
         self._current_size += len(chunk)
 
     def append(self, entries: List[Dict[str, Any]]):
-        self._io.submit(self._append_sync, list(entries))
+        with self._buf_lock:
+            while len(self._buf) >= self.max_pending_batches:
+                self._buf.popleft()
+                self.dropped_batches += 1
+            self._buf.append(list(entries))
+            if self._draining:
+                return  # the live drain will pick this batch up
+            self._draining = True
+        self._io.submit(self._drain)
+
+    def _drain(self):
+        while True:
+            with self._buf_lock:
+                if not self._buf:
+                    self._draining = False
+                    return
+                batch = self._buf.popleft()
+            try:
+                self._append_sync(batch)
+            except Exception:
+                # disk trouble (ENOSPC, rotation error): that batch is
+                # lost, but the pump must survive — a raised exception
+                # here would leave _draining wedged True and stop ALL
+                # future persistence until restart
+                self.dropped_batches += 1
 
     def append_drop(self, service: str):
         self.append([{"_drop": service, "ts": time.time()}])
@@ -104,6 +140,12 @@ class LogPersistence:
     def close(self):
         """Drain queued writes and release the segment handle."""
         self._io.shutdown(wait=True)
+        while True:  # batches that raced the shutdown: write inline
+            with self._buf_lock:
+                if not self._buf:
+                    break
+                batch = self._buf.popleft()
+            self._append_sync(batch)
         if self._fh is not None:
             self._fh.close()
             self._fh = None

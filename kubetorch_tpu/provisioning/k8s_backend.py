@@ -17,6 +17,7 @@ supported remote path).
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -99,10 +100,15 @@ class K8sBackend:
 
     # ------------------------------------------------------------------
     def _pods(self, service_name: str,
-              namespace: Optional[str] = None) -> List[Dict[str, Any]]:
-        return self.client.list(
-            "Pod", namespace,
-            label_selector=f"kubetorch.com/service={service_name}")
+              namespace: Optional[str] = None,
+              launch_id: str = "") -> List[Dict[str, Any]]:
+        selector = f"kubetorch.com/service={service_name}"
+        if launch_id:
+            # readiness/fail-fast scope: only THIS deploy generation's pods
+            # (a prior generation's terminating pods keep the service label
+            # and can stay Ready deep into a redeploy)
+            selector += f",kubetorch.com/launch-id={launch_id}"
+        return self.client.list("Pod", namespace, label_selector=selector)
 
     def _extract_pod_failure(self, pod: Dict[str, Any]):
         """Typed launch failures from container statuses."""
@@ -129,8 +135,15 @@ class K8sBackend:
         deadline = time.time() + timeout
         want = compute.num_pods
         controller = self._controller()
+        poll = float(os.environ.get("KT_READY_POLL", "2.0"))
+        # BYO pods (selector mode) are not launched by us and carry no
+        # launch-id label; generation-scoping only applies to pods our own
+        # manifests created.
+        gen = launch_id if compute.deployment_mode != "selector" else ""
+        knative = compute.deployment_mode == "knative"
         while time.time() < deadline:
-            pods = self._pods(service_name, compute.namespace)
+            pods = self._pods(service_name, compute.namespace,
+                              launch_id=gen)
             ready = 0
             for pod in pods:
                 self._extract_pod_failure(pod)
@@ -138,7 +151,21 @@ class K8sBackend:
                 if any(c.get("type") == "Ready" and c.get("status") == "True"
                        for c in conditions):
                     ready += 1
-            if ready >= want:
+            if knative:
+                # Knative's reconciler owns readiness: the ksvc Ready
+                # condition covers revision + route, and at min-scale 0
+                # a perfectly healthy service has zero pods. Pods are
+                # still scanned above for typed failure extraction.
+                ksvc = self.client.get(
+                    {"apiVersion": "serving.knative.dev/v1",
+                     "kind": "Service", "metadata": {}},
+                    service_name, compute.namespace)
+                conditions = ((ksvc or {}).get("status", {})
+                              .get("conditions") or [])
+                if any(c.get("type") == "Ready"
+                       and c.get("status") == "True" for c in conditions):
+                    return
+            elif ready >= want:
                 return
             if controller is not None:
                 # Pods push setup status over their controller WS; a
@@ -167,8 +194,11 @@ class K8sBackend:
                             f"pod {pod_info.get('pod_name')} of "
                             f"{service_name} failed setup: "
                             f"{pod_info['setup_error']}")
-            time.sleep(2.0)
-        pods = self._pods(service_name, compute.namespace)
+            time.sleep(poll)
+        # diagnostic scoped to THIS generation too — listing the previous
+        # generation's (healthy, terminating) pods here would report
+        # exactly the confusion the launch-id filter exists to prevent
+        pods = self._pods(service_name, compute.namespace, launch_id=gen)
         phases = {p["metadata"]["name"]: p.get("status", {}).get("phase")
                   for p in pods}
         raise ServiceTimeoutError(

@@ -433,6 +433,35 @@ def build_workload_record(
     }
 
 
+LAUNCH_ID_LABEL = "kubetorch.com/launch-id"
+
+
+def _stamp_launch_id(manifest: Dict[str, Any], launch_id: str):
+    """Stamp the deploy generation into every pod/job template's labels.
+
+    Launch waiters filter pods by this label: under one service label a
+    terminating previous-generation pod can stay Ready (and WS-connected
+    with a stale setup_error) well into a redeploy — counting it toward
+    readiness would declare the new launch healthy before its own pods
+    even pulled images."""
+    if not launch_id:
+        return
+
+    def walk(node):
+        if isinstance(node, dict):
+            template = node.get("template")
+            if isinstance(template, dict) and "spec" in template:
+                meta = template.setdefault("metadata", {})
+                meta.setdefault("labels", {})[LAUNCH_ID_LABEL] = launch_id
+            for value in node.values():
+                walk(value)
+        elif isinstance(node, list):
+            for item in node:
+                walk(item)
+
+    walk(manifest)
+
+
 def build_manifests(
     service_name: str, compute: Compute,
     env: Optional[Dict[str, str]] = None,
@@ -471,4 +500,7 @@ def build_manifests(
             out.append(build_service_manifest(
                 service_name, compute, headless=True,
                 selector=compute.selector))
+    launch_id = (env or {}).get("KT_LAUNCH_ID", "")
+    for manifest in out:
+        _stamp_launch_id(manifest, launch_id)
     return out

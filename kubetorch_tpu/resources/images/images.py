@@ -1,6 +1,40 @@
-"""Prebuilt image factories (reference: resources/images/images.py)."""
+"""Prebuilt image factories (reference: resources/images/images.py).
+
+The ``server*``/``ubuntu_base`` factories point at the published default
+image matrix (release/default_images/ — base, TPU, OTel-traced, Ubuntu
+variants, mirroring the reference's 5-image set)."""
+
+import os
 
 from kubetorch_tpu.resources.images.image import Image
+
+
+def _published(name: str) -> Image:
+    # env read at call time like every other KT_* knob — mirrored-registry
+    # users set KT_IMAGE_REGISTRY after import
+    registry = os.environ.get("KT_IMAGE_REGISTRY", "ghcr.io/kubetorch-tpu")
+    tag = os.environ.get("KT_IMAGE_TAG", "latest")
+    return Image(f"{registry}/{name}:{tag}")
+
+
+def server() -> Image:
+    """Slim Debian workload base (pod-server deps + CPU jax)."""
+    return _published("server")
+
+
+def server_tpu() -> Image:
+    """Workload base + jax[tpu]/libtpu — the default for tpus= Computes."""
+    return _published("server-tpu")
+
+
+def server_otel() -> Image:
+    """Workload base + OpenTelemetry export (traced serving)."""
+    return _published("server-otel")
+
+
+def ubuntu_base() -> Image:
+    """Published Ubuntu workload base (apt ecosystem preinstalled)."""
+    return _published("ubuntu")
 
 
 def python311() -> Image:

@@ -76,17 +76,35 @@ class _KtPdb:
     resumes (continue/quit), and stepping keeps them open.
     """
 
-    def __new__(cls, conn, listener, port=None, **kwargs):
+    def __new__(cls, conn, listener, port=None, extra_fds=(), **kwargs):
         import pdb
 
         class _Impl(pdb.Pdb):
             def _kt_close(self):
                 with _active_lock:
                     _active_ports.discard(port)
+                    _pty_masters.pop(port, None)
                 for sock in (conn, listener):
+                    # shutdown BEFORE close: close() alone defers the FIN
+                    # while a pump thread is blocked in recv (the in-flight
+                    # syscall pins the file) or a makefile() reader holds
+                    # an io ref — the attached client would never see the
+                    # session end and hang in its websocket read forever
+                    try:
+                        sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass  # listener: ENOTCONN, nothing to shut down
                     try:
                         sock.close()
                     except OSError:
+                        pass
+                for fd in extra_fds:
+                    # ints (pty master/slave) and the pdb stdio file
+                    # objects; closing ALL slave fds is what EIO-wakes the
+                    # output pump so its thread exits with the session
+                    try:
+                        fd.close() if hasattr(fd, "close") else os.close(fd)
+                    except Exception:
                         pass
 
             def set_continue(self):
@@ -102,8 +120,133 @@ class _KtPdb:
         return impl
 
 
-def deep_breakpoint(port: Optional[int] = None, timeout: float = 600.0):
+# In-band resize control (OSC-style, never produced by normal typing):
+# the WS bridge translates the client's {"type": "resize"} frame into this
+# byte sequence because the PTY master lives in the WORKER process — one
+# TCP hop past the pod server, where a WS control frame can't reach an
+# ioctl. Port → master fd, for resize and tests.
+RESIZE_PREFIX = b"\x1b]kt;resize;"
+RESIZE_SUFFIX = b"\x07"
+_pty_masters: dict = {}
+
+
+def resize_escape(rows: int, cols: int) -> bytes:
+    return RESIZE_PREFIX + f"{int(rows)};{int(cols)}".encode() + RESIZE_SUFFIX
+
+
+def _apply_resize(master_fd: int, rows: int, cols: int):
+    import fcntl
+    import struct
+    import termios
+
+    fcntl.ioctl(master_fd, termios.TIOCSWINSZ,
+                struct.pack("HHHH", rows, cols, 0, 0))
+
+
+def _pump_with_resizes(buf: bytes, master: int) -> bytes:
+    """Write ``buf`` to the PTY master, applying embedded resize escapes.
+    Returns the unconsumed tail (a possibly-partial escape sequence)."""
+    while buf:
+        start = buf.find(RESIZE_PREFIX)
+        if start == -1:
+            # flush everything except a partial prefix at the very end
+            split = len(buf)
+            for k in range(len(RESIZE_PREFIX) - 1, 0, -1):
+                if buf.endswith(RESIZE_PREFIX[:k]):
+                    split = len(buf) - k
+                    break
+            if split:
+                os.write(master, buf[:split])
+            return buf[split:]
+        end = buf.find(RESIZE_SUFFIX, start + len(RESIZE_PREFIX))
+        if end == -1:
+            if start:
+                os.write(master, buf[:start])
+            return buf[start:]
+        if start:
+            os.write(master, buf[:start])
+        body = buf[start + len(RESIZE_PREFIX):end]
+        try:
+            rows, cols = (int(x) for x in body.split(b";"))
+            _apply_resize(master, rows, cols)
+        except (ValueError, OSError):
+            pass
+        buf = buf[end + 1:]
+    return b""
+
+
+def _pty_session(conn: socket.socket, listener: socket.socket, port: int):
+    """PTY-backed pdb session (reference: ``serving/pdb_websocket.py:217``
+    ``pdb-ui``/PTY mode).
+
+    pdb's stdin/stdout ride a real PTY slave: the tty line discipline gives
+    canonical line editing (backspace/^U/^W) + echo, and TIOCSWINSZ resize
+    reaches full-screen tools the user may shell into from pdb. Two pump
+    threads splice the TCP connection to the master; the client end stays
+    byte-transparent (raw mode).
+
+    Returns (stdin_file, stdout_file, extra_fds) for ``_KtPdb``.
+    """
+    import pty as _pty
+
+    master, slave = _pty.openpty()
+    _pty_masters[port] = master
+    # each pump owns a PRIVATE dup of the master: _kt_close closes the
+    # originals from the debugged thread while the pumps may be mid-read —
+    # a shared fd closed under a blocked thread is an fd-reuse hazard (the
+    # number can be recycled by any other open() in the process and the
+    # pump would read/write a stranger's fd)
+    in_fd = os.dup(master)
+    out_fd = os.dup(master)
+
+    def conn_to_master():
+        pending = b""
+        try:
+            while True:
+                data = conn.recv(4096)
+                if not data:
+                    break
+                pending = _pump_with_resizes(pending + data, in_fd)
+        except OSError:
+            pass
+        finally:
+            try:
+                os.write(in_fd, b"c\n")  # client vanished: resume user code
+            except OSError:
+                pass
+            os.close(in_fd)
+
+    def master_to_conn():
+        try:
+            while True:
+                # EIO once every slave fd closes (session teardown)
+                data = os.read(out_fd, 4096)
+                if not data:
+                    break
+                conn.sendall(data)
+        except OSError:
+            pass
+        finally:
+            os.close(out_fd)
+
+    threading.Thread(target=conn_to_master, daemon=True,
+                     name="kt-pdb-pty-in").start()
+    threading.Thread(target=master_to_conn, daemon=True,
+                     name="kt-pdb-pty-out").start()
+    fin = os.fdopen(os.dup(slave), "r", encoding="utf-8", newline="\n")
+    fout = os.fdopen(os.dup(slave), "w", encoding="utf-8")
+    return fin, fout, (master, slave, fin, fout)
+
+
+def deep_breakpoint(port: Optional[int] = None, timeout: float = 600.0,
+                    pty: bool = False):
     """Open a TCP pdb server and block until a debugger client attaches.
+
+    ``pty=True`` backs the session with a real PTY (reference
+    ``serving/pdb_websocket.py:217`` pdb-ui mode): tty line editing + echo
+    server-side, window resizes honored; pair with ``ktpu debug --pty``.
+    The plain socket mode stays the default — it works from any client,
+    including non-tty pipes.
 
     The announcement line below reaches the log sink (LogCapture tees
     stdout), so `ktpu logs -f` shows exactly where to attach — the
@@ -140,8 +283,13 @@ def deep_breakpoint(port: Optional[int] = None, timeout: float = 600.0):
         listener.close()
         return
 
-    sio = _SocketIO(conn)
-    debugger = _KtPdb(conn, listener, port=port, stdin=sio, stdout=sio)
+    if pty:
+        fin, fout, extra = _pty_session(conn, listener, port)
+        debugger = _KtPdb(conn, listener, port=port, extra_fds=extra,
+                          stdin=fin, stdout=fout)
+    else:
+        sio = _SocketIO(conn)
+        debugger = _KtPdb(conn, listener, port=port, stdin=sio, stdout=sio)
     # Must be the LAST statement: the first step-stop is the caller's next
     # line; any code here would become the stop site instead.
     debugger.set_trace(sys._getframe(1))
@@ -179,6 +327,8 @@ async def ws_tcp_bridge(request):
             if not ws.closed:
                 await ws.close()
 
+    import json
+
     pump = asyncio.ensure_future(tcp_to_ws())
     try:
         async for msg in ws:
@@ -186,7 +336,19 @@ async def ws_tcp_bridge(request):
                 writer.write(msg.data)
                 await writer.drain()
             elif msg.type == WSMsgType.TEXT:
-                writer.write(msg.data.encode())
+                # control frames ride TEXT; resize becomes the in-band
+                # escape the worker-side PTY pump understands (the master
+                # fd lives one TCP hop away, out of ioctl reach here)
+                try:
+                    control = json.loads(msg.data)
+                except ValueError:
+                    control = None
+                if (isinstance(control, dict)
+                        and control.get("type") == "resize"):
+                    writer.write(resize_escape(control.get("rows", 24),
+                                               control.get("cols", 80)))
+                else:
+                    writer.write(msg.data.encode())
                 await writer.drain()
             else:
                 break
@@ -198,9 +360,13 @@ async def ws_tcp_bridge(request):
 
 # ---------------------------------------------------------------- client
 def attach(pod_url: str, port: Optional[int] = None,
-           stdin=None, stdout=None) -> int:
+           stdin=None, stdout=None, pty: bool = False) -> int:
     """Interactive debugger client: bridge this terminal to the pod's pdb
     over the WS endpoint (reference: ``kt debug``, cli.py:349).
+
+    ``pty=True`` (with a ``deep_breakpoint(pty=True)`` server): local
+    terminal goes raw, bytes stream character-wise, window size follows
+    SIGWINCH — the remote PTY's line discipline does editing + echo.
 
     Returns 0 on clean detach, 1 if the bridge reported an error.
     """
@@ -212,6 +378,8 @@ def attach(pod_url: str, port: Optional[int] = None,
     stdin = stdin or sys.stdin
     stdout = stdout or sys.stdout
     params = {"port": str(port)} if port else {}
+    if pty:
+        return _attach_pty(pod_url, params, stdin, stdout)
 
     async def run() -> int:
         async with aiohttp.ClientSession() as session:
@@ -277,3 +445,102 @@ def attach(pod_url: str, port: Optional[int] = None,
                 return rc
 
     return asyncio.run(run())
+
+
+def _attach_pty(pod_url: str, params: dict, stdin, stdout) -> int:
+    """Raw-terminal client half of the PTY mode."""
+    import asyncio
+    import json
+    import shutil
+    import signal
+
+    import aiohttp
+
+    in_fd = stdin.fileno()
+    out_fd = stdout.fileno()
+    is_tty = os.isatty(in_fd)
+    saved = None
+    if is_tty:
+        import termios
+        import tty as _tty
+
+        saved = termios.tcgetattr(in_fd)
+        _tty.setraw(in_fd)
+
+    async def run() -> int:
+        async with aiohttp.ClientSession() as session:
+            async with session.ws_connect(
+                    f"{pod_url.rstrip('/')}/_debug/ws", params=params,
+                    heartbeat=30.0) as ws:
+                loop = asyncio.get_running_loop()
+
+                async def send_winsize():
+                    size = shutil.get_terminal_size()
+                    await ws.send_str(json.dumps(
+                        {"type": "resize", "rows": size.lines,
+                         "cols": size.columns}))
+
+                await send_winsize()
+                if is_tty:
+                    loop.add_signal_handler(
+                        signal.SIGWINCH,
+                        lambda: asyncio.ensure_future(send_winsize()))
+
+                byte_q: asyncio.Queue = asyncio.Queue()
+
+                def read_stdin():
+                    while True:
+                        try:
+                            data = os.read(in_fd, 1024)
+                        except OSError:
+                            data = b""
+                        try:
+                            loop.call_soon_threadsafe(
+                                byte_q.put_nowait, data)
+                        except RuntimeError:
+                            return
+                        if not data:
+                            return
+
+                threading.Thread(target=read_stdin, daemon=True,
+                                 name="kt-debug-stdin").start()
+
+                async def pump_stdin():
+                    while True:
+                        data = await byte_q.get()
+                        if not data:
+                            await asyncio.sleep(2.0)
+                            if not ws.closed:
+                                await ws.close()
+                            return
+                        await ws.send_bytes(data)
+
+                feeder = asyncio.ensure_future(pump_stdin())
+                rc = 0
+                try:
+                    async for msg in ws:
+                        if msg.type == aiohttp.WSMsgType.BINARY:
+                            os.write(out_fd, msg.data)
+                        elif msg.type == aiohttp.WSMsgType.TEXT:
+                            try:
+                                payload = json.loads(msg.data)
+                                if "error" in payload:
+                                    os.write(out_fd, (payload["error"]
+                                                      + "\r\n").encode())
+                                    rc = 1
+                                    break
+                            except ValueError:
+                                os.write(out_fd, msg.data.encode())
+                        else:
+                            break
+                finally:
+                    feeder.cancel()
+                return rc
+
+    try:
+        return asyncio.run(run())
+    finally:
+        if saved is not None:
+            import termios
+
+            termios.tcsetattr(in_fd, termios.TCSADRAIN, saved)

@@ -108,7 +108,7 @@ def make_train_step(
             mesh=ring_mesh)
         return fused_cross_entropy(
             x, llama.unembedding(params, cfg), batch["targets"],
-            batch.get("mask"))
+            batch.get("mask"), chunk_size=cfg.xent_chunk)
 
     compute_loss = loss_fn or default_loss
     grad_fn = jax.value_and_grad(compute_loss, has_aux=True)

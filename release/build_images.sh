@@ -1,18 +1,39 @@
 #!/usr/bin/env bash
-# Build + optionally push the kubetorch_tpu server image.
-# (reference: release/build_images.sh — here one image covers server,
-# controller, and store: the entrypoint picks the role.)
+# Build + optionally push the kubetorch_tpu image matrix (reference:
+# release/build_images.sh + default_images/ — 5 images there, 5 here):
+#   kubetorch-tpu  full stack (pod server + controller + store; the
+#                  chart's one image — entrypoint picks the role)
+#   server         slim Debian workload base (CPU jax)
+#   server-tpu     workload base + jax[tpu]/libtpu
+#   server-otel    workload base + OpenTelemetry export
+#   ubuntu         Ubuntu workload base (apt ecosystem)
 set -euo pipefail
 
 REGISTRY="${REGISTRY:-ghcr.io/kubetorch-tpu}"
 PUSH="${PUSH:-0}"
+ONLY="${ONLY:-}"
 
 cd "$(dirname "$0")/.."
 VERSION="$(python -c 'from kubetorch_tpu.version import __version__; print(__version__)')"
-docker build -f release/Dockerfile -t "${REGISTRY}/kubetorch-tpu:${VERSION}" \
-  -t "${REGISTRY}/kubetorch-tpu:latest" .
-echo "built ${REGISTRY}/kubetorch-tpu:${VERSION}"
-if [[ "${PUSH}" == "1" ]]; then
-  docker push "${REGISTRY}/kubetorch-tpu:${VERSION}"
-  docker push "${REGISTRY}/kubetorch-tpu:latest"
-fi
+
+build() {  # name dockerfile [build-args...]
+  local name="$1"; shift
+  local dockerfile="$1"; shift
+  if [[ -n "${ONLY}" && "${ONLY}" != "${name}" ]]; then return; fi
+  docker build -f "${dockerfile}" "$@" \
+    -t "${REGISTRY}/${name}:${VERSION}" -t "${REGISTRY}/${name}:latest" .
+  echo "built ${REGISTRY}/${name}:${VERSION}"
+  if [[ "${PUSH}" == "1" ]]; then
+    docker push "${REGISTRY}/${name}:${VERSION}"
+    docker push "${REGISTRY}/${name}:latest"
+  fi
+}
+
+build kubetorch-tpu release/Dockerfile
+build server release/default_images/server
+build ubuntu release/default_images/ubuntu
+# variants layer on the freshly-built server base
+build server-tpu release/default_images/server-tpu \
+  --build-arg "BASE_IMAGE=${REGISTRY}/server:${VERSION}"
+build server-otel release/default_images/server-otel \
+  --build-arg "BASE_IMAGE=${REGISTRY}/server:${VERSION}"

@@ -1,0 +1,273 @@
+"""In-memory Kubernetes API server for behavioral backend tests.
+
+Speaks the subset of the K8s REST API that ``provisioning/k8s_client.py``
+uses — server-side apply (PATCH), get/list (with labelSelector), delete,
+pod logs — and *simulates the pod lifecycle*: applying a workload manifest
+(Deployment / JobSet / Knative Service) materializes pods whose status
+evolves per a configurable behavior:
+
+    fake.behave(service, ready_after=0.1)        # happy path
+    fake.behave(service, image_pull_error=True)  # ErrImagePull forever
+    fake.behave(service, crash_loop=True, logs="traceback...")
+    fake.behave(service, never_ready=True)       # Pending forever
+
+Counterpart of the reference's CI clusters (its dominant test strategy —
+``.github/workflows/minimal_tests.yaml`` provisions real GKE namespaces);
+this fake trades cluster fidelity for speed and failure injection, which
+CI-on-GKE cannot do deterministically.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+WORKLOAD_PLURALS = {"deployments", "jobsets", "rayclusters"}
+
+
+def _match_selector(labels: Dict[str, str], selector: str) -> bool:
+    for clause in filter(None, selector.split(",")):
+        key, _, want = clause.partition("=")
+        if labels.get(key.strip()) != want.strip():
+            return False
+    return True
+
+
+class FakeK8s:
+    def __init__(self):
+        # (ns, plural, name) -> manifest
+        self.objects: Dict[Tuple[str, str, str], dict] = {}
+        self.behaviors: Dict[str, dict] = {}
+        self.logs: Dict[str, str] = {}
+        self.deleted: List[Tuple[str, str]] = []  # (plural, name)
+        self.applied: List[dict] = []
+        self._lock = threading.Lock()
+        self._rv = 0
+
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _body(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                return (json.loads(self.rfile.read(length))
+                        if length else {})
+
+            def _send(self, code: int, payload):
+                data = (payload if isinstance(payload, bytes)
+                        else json.dumps(payload).encode())
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_PATCH(self):
+                self._send(*fake.handle("PATCH", self.path, self._body()))
+
+            def do_GET(self):
+                self._send(*fake.handle("GET", self.path, None))
+
+            def do_DELETE(self):
+                self._send(*fake.handle("DELETE", self.path, None))
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.server.server_port}"
+
+    def close(self):
+        self.server.shutdown()
+
+    # ----------------------------------------------------------- control
+    def behave(self, service: str, **behavior):
+        """Set the pod-lifecycle behavior for a service's pods."""
+        self.behaviors[service] = behavior
+
+    def add_pod(self, name: str, labels: Dict[str, str],
+                ns: str = "default", ready: bool = True,
+                ip: str = "10.0.0.9"):
+        """Pre-create a pod outside any workload (BYO / stale pods)."""
+        self.objects[(ns, "pods", name)] = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns, "labels": labels,
+                         "creationTimestamp": time.time()},
+            "spec": {"nodeName": "node-a"},
+            "status": {
+                "phase": "Running" if ready else "Pending",
+                "podIP": ip,
+                "conditions": ([{"type": "Ready", "status": "True"}]
+                               if ready else []),
+            },
+            "_static": True,  # not driven by a behavior
+        }
+
+    def admit(self, name: str, ns: str = "default"):
+        """Kueue admission: unsuspend a queued JobSet → its pods start."""
+        manifest = self.objects[(ns, "jobsets", name)]
+        manifest["spec"]["suspend"] = False
+        with self._lock:
+            self._spawn_pods(ns, manifest)
+
+    # ------------------------------------------------------ pod lifecycle
+    def _spawn_pods(self, ns: str, manifest: dict):
+        kind = manifest.get("kind", "")
+        name = manifest["metadata"]["name"]
+        if kind == "Deployment":
+            template = manifest["spec"]["template"]
+            count = int(manifest["spec"].get("replicas", 1))
+        elif kind == "JobSet":
+            if manifest["spec"].get("suspend"):
+                return  # Kueue gate: no pods until admitted
+            job = manifest["spec"]["replicatedJobs"][0]
+            jt = job["template"]["spec"]
+            template = jt["template"]
+            count = (int(job.get("replicas", 1))
+                     * int(jt.get("parallelism", 1)))
+        elif kind == "Service" and "serving.knative.dev" in manifest.get(
+                "apiVersion", ""):
+            template = manifest["spec"]["template"]
+            ann = template.get("metadata", {}).get("annotations", {})
+            count = int(ann.get("autoscaling.knative.dev/min-scale", 1))
+            manifest["_created"] = time.time()
+        else:
+            return
+        labels = dict(template.get("metadata", {}).get("labels", {}))
+        # replace this workload's previous generation of pods (a rolling
+        # update would overlap; tests that need overlap pre-create pods
+        # via add_pod)
+        for key in [k for k, v in self.objects.items()
+                    if k[1] == "pods" and v.get("_owner") == name]:
+            del self.objects[key]
+        for i in range(count):
+            pod_name = f"{name}-{uuid.uuid4().hex[:5]}-{i}"
+            self.objects[(ns, "pods", pod_name)] = {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": pod_name, "namespace": ns,
+                             "labels": labels,
+                             "creationTimestamp": time.time()},
+                "spec": {"nodeName": f"node-{i}"},
+                "status": {"phase": "Pending", "podIP": f"10.0.0.{i + 10}"},
+                "_owner": name,
+                "_created": time.time(),
+            }
+
+    def _tick(self):
+        """Advance simulated pod + knative-service statuses."""
+        for key, obj in self.objects.items():
+            if (key[1] == "services"
+                    and "serving.knative.dev" in obj.get("apiVersion", "")
+                    and "_created" in obj):
+                service = obj["metadata"]["name"]
+                behavior = self.behaviors.get(service, {})
+                if (not behavior.get("never_ready")
+                        and time.time() - obj["_created"]
+                        >= behavior.get("ready_after", 0.05)):
+                    obj.setdefault("status", {})["conditions"] = [
+                        {"type": "Ready", "status": "True"}]
+        for key, pod in self.objects.items():
+            if key[1] != "pods" or pod.get("_static"):
+                continue
+            service = pod["metadata"]["labels"].get("kubetorch.com/service")
+            behavior = self.behaviors.get(service, {})
+            elapsed = time.time() - pod.get("_created", 0)
+            if behavior.get("image_pull_error"):
+                pod["status"]["containerStatuses"] = [{
+                    "state": {"waiting": {
+                        "reason": "ImagePullBackOff",
+                        "message": "Back-off pulling image \"missing:tag\"",
+                    }}}]
+            elif behavior.get("crash_loop"):
+                self.logs[pod["metadata"]["name"]] = behavior.get(
+                    "logs", "boom")
+                pod["status"]["containerStatuses"] = [{
+                    "state": {"waiting": {
+                        "reason": "CrashLoopBackOff",
+                        "message": "back-off restarting failed container",
+                    }}}]
+            elif behavior.get("never_ready"):
+                pass  # Pending forever
+            elif elapsed >= behavior.get("ready_after", 0.05):
+                pod["status"]["phase"] = "Running"
+                pod["status"]["conditions"] = [
+                    {"type": "Ready", "status": "True"}]
+
+    # ------------------------------------------------------------ routing
+    def handle(self, verb: str, path: str, body):
+        with self._lock:
+            return self._handle(verb, path, body)
+
+    def _handle(self, verb: str, path: str, body):
+        parts = urlsplit(path)
+        query = {k: v[0] for k, v in parse_qs(parts.query).items()}
+        segs = [s for s in parts.path.split("/") if s]
+        # /api/v1/... or /apis/{group}/{version}/...
+        if segs[0] == "api":
+            segs = segs[2:]
+        elif segs[0] == "apis":
+            segs = segs[3:]
+        else:
+            return 404, {"message": "unknown prefix"}
+        if not segs or segs[0] != "namespaces":
+            return 404, {"message": "cluster-scoped not faked"}
+        ns, plural = segs[1], segs[2]
+        name = segs[3] if len(segs) > 3 else None
+        sub = segs[4] if len(segs) > 4 else None
+
+        if plural in ("pods", "services"):
+            self._tick()
+
+        if verb == "PATCH":
+            manifest = body
+            manifest.setdefault("metadata", {}).setdefault("namespace", ns)
+            self._rv += 1
+            self.objects[(ns, plural, name)] = manifest
+            self.applied.append(manifest)
+            if plural in WORKLOAD_PLURALS or (
+                    plural == "services"
+                    and "serving.knative.dev" in manifest.get(
+                        "apiVersion", "")):
+                self._spawn_pods(ns, manifest)
+            return 200, manifest
+
+        if verb == "GET" and name and sub == "log":
+            return 200, self.logs.get(name, "").encode()
+
+        if verb == "GET" and name:
+            obj = self.objects.get((ns, plural, name))
+            return (200, obj) if obj else (404, {"message": "not found"})
+
+        if verb == "GET":
+            selector = query.get("labelSelector", "")
+            items = [obj for (ons, oplural, _), obj in self.objects.items()
+                     if ons == ns and oplural == plural
+                     and _match_selector(
+                         obj.get("metadata", {}).get("labels", {}),
+                         selector)]
+            return 200, {"items": items,
+                         "metadata": {"resourceVersion": str(self._rv)}}
+
+        if verb == "DELETE" and name:
+            obj = self.objects.pop((ns, plural, name), None)
+            if obj is None:
+                return 404, {"message": "not found"}
+            self.deleted.append((plural, name))
+            if plural in WORKLOAD_PLURALS or plural == "services":
+                # cascade: a workload's pods go with it
+                for key in [k for k, v in self.objects.items()
+                            if k[1] == "pods" and v.get("_owner") == name]:
+                    del self.objects[key]
+            return 200, {"status": "Success"}
+
+        return 405, {"message": f"unhandled {verb} {path}"}

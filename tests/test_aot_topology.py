@@ -1,0 +1,20 @@
+"""AOT whole-slice compile: the 8B FSDP train step compiles for a 64-chip
+v5e TopologyDescription with zero TPU hardware (``__graft_entry__.aot_v5e64``
+— the TPU-native superpower SURVEY §4 hints at; no reference analogue).
+
+One layout here (~75 s of XLA compile); the driver's graft entry runs both.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+@pytest.mark.level("minimal")
+def test_8b_fsdp64_train_step_compiles_for_v5e64():
+    import __graft_entry__ as graft
+
+    graft.aot_v5e64(layouts=("fsdp64",))

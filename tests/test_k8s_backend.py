@@ -1,0 +1,190 @@
+"""Behavioral tests for the Kubernetes backend against the fake K8s API
+(tests/fake_k8s.py): launch→ready, typed failure extraction, generation
+scoping, deployment modes, teardown cascade, logs.
+
+Counterpart of the reference's CI-on-GKE suites
+(``.github/workflows/minimal_tests.yaml:103-200`` +
+``python_client/tests/test_imperative.py`` etc.) — the production path
+(``provisioning/k8s_backend.py``) exercised end-to-end without a cluster.
+"""
+
+import pytest
+
+from kubetorch_tpu.exceptions import (
+    ImagePullError,
+    PodContainerError,
+    ServiceTimeoutError,
+)
+from kubetorch_tpu.provisioning.k8s_backend import K8sBackend
+from kubetorch_tpu.provisioning.k8s_client import K8sClient
+from kubetorch_tpu.resources.compute.compute import Compute
+
+from fake_k8s import FakeK8s
+
+
+@pytest.fixture()
+def fake(monkeypatch):
+    server = FakeK8s()
+    monkeypatch.setenv("KT_READY_POLL", "0.05")
+    # no controller in these tests: the backend's direct-apply path
+    monkeypatch.delenv("KT_CONTROLLER_URL", raising=False)
+    yield server
+    server.close()
+
+
+@pytest.fixture()
+def backend(fake):
+    return K8sBackend(client=K8sClient(fake.url, namespace="default"))
+
+
+def _launch(backend, name, compute=None, timeout=10, launch_id="gen1"):
+    return backend.launch(
+        name,
+        module_env={"KT_MODULE": name},
+        compute_dict=(compute or Compute(cpus="1")).to_dict(),
+        module_meta={"import_path": f"{name}:fn"},
+        launch_timeout=timeout,
+        launch_id=launch_id,
+    )
+
+
+@pytest.mark.level("unit")
+def test_launch_deployment_to_ready(fake, backend):
+    fake.behave("svc-a", ready_after=0.05)
+    record = _launch(backend, "svc-a")
+    assert record["service_name"] == "svc-a"
+    # applied: Deployment + routing Service (+ workload record attempt)
+    kinds = [m["kind"] for m in fake.applied]
+    assert "Deployment" in kinds and "Service" in kinds
+    deployment = fake.objects[("default", "deployments", "svc-a")]
+    labels = deployment["spec"]["template"]["metadata"]["labels"]
+    assert labels["kubetorch.com/service"] == "svc-a"
+    assert labels["kubetorch.com/launch-id"] == "gen1"
+    assert backend.is_up("svc-a")
+
+
+@pytest.mark.level("unit")
+def test_image_pull_failure_fails_fast(fake, backend):
+    fake.behave("svc-pull", image_pull_error=True)
+    with pytest.raises(ImagePullError, match="ImagePullBackOff"):
+        _launch(backend, "svc-pull", timeout=30)
+
+
+@pytest.mark.level("unit")
+def test_crash_loop_surfaces_pod_logs(fake, backend):
+    fake.behave("svc-crash", crash_loop=True,
+                logs="ImportError: no module named userlib")
+    with pytest.raises(PodContainerError) as err:
+        _launch(backend, "svc-crash", timeout=30)
+    assert "CrashLoopBackOff" in str(err.value)
+    assert "ImportError: no module named userlib" in str(err.value)
+
+
+@pytest.mark.level("unit")
+def test_timeout_reports_pod_phases(fake, backend):
+    fake.behave("svc-slow", never_ready=True)
+    with pytest.raises(ServiceTimeoutError, match="Pending"):
+        _launch(backend, "svc-slow", timeout=1)
+
+
+@pytest.mark.level("unit")
+def test_redeploy_ignores_prior_generation_ready_pods(fake, backend):
+    """A terminating previous-generation pod keeps the service label and
+    Ready=True; it must not satisfy the new launch's readiness."""
+    fake.add_pod("svc-b-old-0",
+                 {"kubetorch.com/service": "svc-b",
+                  "kubetorch.com/launch-id": "gen0"}, ready=True)
+    fake.behave("svc-b", never_ready=True)
+    with pytest.raises(ServiceTimeoutError):
+        _launch(backend, "svc-b", timeout=1, launch_id="gen1")
+    # and when the new generation does come up, launch succeeds
+    fake.behave("svc-b", ready_after=0.05)
+    _launch(backend, "svc-b", timeout=10, launch_id="gen2")
+
+
+@pytest.mark.level("unit")
+def test_jobset_mode_launches_all_workers(fake, backend):
+    compute = Compute(tpus="v5e-16")  # multi-host slice → jobset
+    assert compute.deployment_mode == "jobset"
+    fake.behave("svc-js", ready_after=0.05)
+    _launch(backend, "svc-js", compute=compute, timeout=15)
+    assert ("default", "jobsets", "svc-js") in fake.objects
+    pods = backend.pods("svc-js")
+    assert len(pods) == compute.num_pods
+    assert all(p["ip"] for p in pods)
+
+
+@pytest.mark.level("unit")
+def test_selector_mode_routes_to_byo_pods(fake, backend):
+    """selector= Compute creates no workload; pre-existing pods (no
+    launch-id label) must still satisfy readiness."""
+    fake.add_pod("byo-0", {"kubetorch.com/service": "svc-sel",
+                           "team": "mine"}, ready=True)
+    compute = Compute(cpus="1", selector={"team": "mine"})
+    _launch(backend, "svc-sel", compute=compute, timeout=5)
+    assert ("default", "deployments", "svc-sel") not in fake.objects
+    service = fake.objects[("default", "services", "svc-sel")]
+    assert service["spec"]["selector"] == {"team": "mine"}
+
+
+@pytest.mark.level("unit")
+def test_byo_manifest_mode_is_stamped_and_launched(fake, backend):
+    manifest = {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "ignored"},
+        "spec": {"replicas": 2,
+                 "template": {"metadata": {"labels": {}},
+                              "spec": {"containers": [
+                                  {"name": "main", "image": "me:latest"}]}}},
+    }
+    compute = Compute.from_manifest(manifest)
+    fake.behave("svc-byo", ready_after=0.05)
+    _launch(backend, "svc-byo", compute=compute, timeout=10)
+    deployment = fake.objects[("default", "deployments", "svc-byo")]
+    labels = deployment["spec"]["template"]["metadata"]["labels"]
+    assert labels["kubetorch.com/service"] == "svc-byo"
+    assert labels["kubetorch.com/launch-id"] == "gen1"
+    assert len(backend.pods("svc-byo")) == 2
+
+
+@pytest.mark.level("unit")
+def test_teardown_cascades_workload_and_services(fake, backend):
+    fake.behave("svc-down", ready_after=0.05)
+    _launch(backend, "svc-down")
+    assert backend.teardown("svc-down") is True
+    assert ("default", "deployments", "svc-down") not in fake.objects
+    assert ("default", "services", "svc-down") not in fake.objects
+    assert not backend.pods("svc-down")
+    with pytest.raises(KeyError):
+        backend.teardown("svc-down")
+    assert backend.teardown("svc-down", quiet=True) is False
+
+
+@pytest.mark.level("unit")
+def test_logs_reads_pod_logs(fake, backend):
+    fake.behave("svc-log", ready_after=0.05)
+    _launch(backend, "svc-log")
+    pod = backend.pods("svc-log")[0]["name"]
+    fake.logs[pod] = "hello from the pod\n"
+    out = backend.logs("svc-log")
+    assert pod in out and "hello from the pod" in out
+
+
+@pytest.mark.level("unit")
+def test_lookup_and_list_without_controller(fake, backend):
+    fake.behave("svc-look", ready_after=0.05)
+    _launch(backend, "svc-look")
+    record = backend.lookup("svc-look")
+    assert record["service_name"] == "svc-look"
+    assert record["namespace"] == "default"
+    names = [r["service_name"] for r in backend.list_services()]
+    assert "svc-look" in names
+    assert backend.lookup("nope") is None
+
+
+@pytest.mark.level("unit")
+def test_pod_urls_use_pod_ips(fake, backend):
+    fake.behave("svc-url", ready_after=0.05)
+    _launch(backend, "svc-url")
+    urls = backend.pod_urls("svc-url")
+    assert urls and all(u.startswith("http://10.0.0.") for u in urls)

@@ -1,0 +1,124 @@
+"""Knative autoscaling + Kueue admission behavior through the fake K8s API
+(tests/fake_k8s.py). Reference: ``python_client/tests/test_autoscale.py``
+(real KPA scale-up / scale-to-zero) and ``test_kueue.py`` (queue labels +
+``suspend`` admission gating) — the same flows, driven deterministically.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubetorch_tpu.exceptions import ServiceTimeoutError
+from kubetorch_tpu.provisioning.k8s_backend import K8sBackend
+from kubetorch_tpu.provisioning.k8s_client import K8sClient
+from kubetorch_tpu.resources.compute.compute import Compute
+
+from fake_k8s import FakeK8s
+
+
+@pytest.fixture()
+def fake(monkeypatch):
+    server = FakeK8s()
+    monkeypatch.setenv("KT_READY_POLL", "0.05")
+    monkeypatch.delenv("KT_CONTROLLER_URL", raising=False)
+    yield server
+    server.close()
+
+
+@pytest.fixture()
+def backend(fake):
+    return K8sBackend(client=K8sClient(fake.url, namespace="default"))
+
+
+def _launch(backend, name, compute, timeout=10, launch_id="gen1"):
+    return backend.launch(
+        name,
+        module_env={"KT_MODULE": name},
+        compute_dict=compute.to_dict(),
+        module_meta={"import_path": f"{name}:fn"},
+        launch_timeout=timeout,
+        launch_id=launch_id,
+    )
+
+
+# ------------------------------------------------------------- knative
+@pytest.mark.level("unit")
+def test_knative_deploy_ready_and_annotated(fake, backend):
+    compute = Compute(cpus="1").autoscale(min_scale=1, max_scale=5,
+                                          target=10)
+    assert compute.deployment_mode == "knative"
+    fake.behave("kn-a", ready_after=0.05)
+    _launch(backend, "kn-a", compute)
+    ksvc = fake.objects[("default", "services", "kn-a")]
+    ann = ksvc["spec"]["template"]["metadata"]["annotations"]
+    assert ann["autoscaling.knative.dev/min-scale"] == "1"
+    assert ann["autoscaling.knative.dev/max-scale"] == "5"
+    assert ann["autoscaling.knative.dev/target"] == "10"
+    # the KPA spun up min-scale pods with the service label
+    assert len(backend.pods("kn-a")) == 1
+
+
+@pytest.mark.level("unit")
+def test_knative_scale_to_zero_is_ready_with_no_pods(fake, backend):
+    """min-scale 0: a healthy ksvc has ZERO pods — readiness must gate on
+    the ksvc Ready condition, not a pod count that never arrives."""
+    compute = Compute(cpus="1").autoscale(min_scale=0, max_scale=3)
+    fake.behave("kn-zero", ready_after=0.05)
+    _launch(backend, "kn-zero", compute, timeout=5)
+    assert backend.pods("kn-zero") == []
+
+
+@pytest.mark.level("unit")
+def test_knative_never_ready_times_out(fake, backend):
+    compute = Compute(cpus="1").autoscale(min_scale=1)
+    fake.behave("kn-stuck", never_ready=True)
+    with pytest.raises(ServiceTimeoutError):
+        _launch(backend, "kn-stuck", compute, timeout=1)
+
+
+# --------------------------------------------------------------- kueue
+@pytest.mark.level("unit")
+def test_kueue_jobset_suspended_until_admitted(fake, backend):
+    """queue_name gates the JobSet behind Kueue: suspend=true at apply,
+    no pods until admission, gang-launch after."""
+    compute = Compute(tpus="v5e-16", queue_name="tpu-queue")
+    assert compute.deployment_mode == "jobset"
+    fake.behave("q-svc", ready_after=0.05)
+
+    result, errors = [], []
+
+    def launch():
+        try:
+            result.append(_launch(backend, "q-svc", compute, timeout=20))
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    t = threading.Thread(target=launch)
+    t.start()
+    deadline = time.time() + 5
+    while ("default", "jobsets", "q-svc") not in fake.objects:
+        assert time.time() < deadline, "jobset never applied"
+        time.sleep(0.02)
+    jobset = fake.objects[("default", "jobsets", "q-svc")]
+    assert jobset["spec"]["suspend"] is True
+    assert (jobset["metadata"]["labels"]["kueue.x-k8s.io/queue-name"]
+            == "tpu-queue")
+    time.sleep(0.3)  # launch is polling; nothing may start while queued
+    assert not backend.pods("q-svc"), "pods started before admission"
+    assert not result and not errors
+
+    fake.admit("q-svc")
+    t.join(20)
+    assert not errors, errors
+    assert result and result[0]["service_name"] == "q-svc"
+    # gang: every worker pod of the slice started together
+    assert len(backend.pods("q-svc")) == compute.num_pods
+
+
+@pytest.mark.level("unit")
+def test_kueue_never_admitted_times_out(fake, backend):
+    compute = Compute(tpus="v5e-16", queue_name="tpu-queue")
+    fake.behave("q-stuck", ready_after=0.05)
+    with pytest.raises(ServiceTimeoutError):
+        _launch(backend, "q-stuck", compute, timeout=1)

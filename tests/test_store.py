@@ -177,3 +177,61 @@ def test_store_via_env_uses_http(tmp_path, monkeypatch, http_store):
     assert store.get("env/test") == {"v": 1}
     monkeypatch.delenv("KT_STORE_URL")
     DataStoreClient._default = None
+
+
+@pytest.mark.level("minimal")
+def test_store_cleanup_retention(tmp_path):
+    """POST /cleanup prunes files older than max_age_s and empty dirs —
+    the behavior the chart's store-cleanup CronJob drives daily (reference:
+    charts/kubetorch/templates/data-store/cronjob/cleanup.yaml via
+    kubectl-exec'd find)."""
+    import httpx
+
+    from kubetorch_tpu.bench_dataplane import _Store
+
+    server = _Store(tmp_path / "root")
+    try:
+        be = HttpStoreBackend(server.url)
+        be.put_blob("old/stale.bin", b"x" * 128)
+        be.put_blob("new/fresh.bin", b"y" * 128)
+        old_path = tmp_path / "root" / "old" / "stale.bin"
+        stale = time.time() - 8 * 86400
+        # age = the .kt-stamp WRITE time, never file mtimes (tree files
+        # keep source mtimes; a fresh upload of old files must survive)
+        os.utime(old_path.with_name("stale.bin.kt-stamp"), (stale, stale))
+
+        # a freshly-uploaded TREE whose source files are old must survive:
+        # tar extraction preserves source mtimes (the delta manifest needs
+        # them), so retention ages by the upload stamp, not file mtimes
+        src = tmp_path / "proj"
+        (src / "pkg").mkdir(parents=True)
+        vendored = src / "pkg" / "vendored.py"
+        vendored.write_text("OLD = 1\n")
+        os.utime(vendored, (stale, stale))
+        be.put_path("code/proj", src)
+
+        out = httpx.post(f"{server.url}/cleanup",
+                         json={"max_age_s": 7 * 86400}, timeout=10).json()
+        assert out["deleted"] == 1
+        assert (tmp_path / "root" / "code" / "proj"
+                / "pkg" / "vendored.py").exists()
+        assert not old_path.exists()
+        assert not old_path.parent.exists()  # emptied dir pruned
+        assert bytes(be.get_blob("new/fresh.bin")) == b"y" * 128
+        with pytest.raises(Exception):
+            be.get_blob("old/stale.bin")
+
+        # prefix-scoped sweep only touches that subtree
+        be.put_blob("a/one.bin", b"1")
+        be.put_blob("b/two.bin", b"2")
+        for rel in ("a/one.bin", "b/two.bin"):
+            path = tmp_path / "root" / rel
+            os.utime(path.with_name(path.name + ".kt-stamp"),
+                     (stale, stale))
+        out = httpx.post(f"{server.url}/cleanup",
+                         json={"max_age_s": 7 * 86400, "prefix": "a"},
+                         timeout=10).json()
+        assert out["deleted"] == 1
+        assert bytes(be.get_blob("b/two.bin")) == b"2"
+    finally:
+        server.close()
