@@ -1,0 +1,197 @@
+"""Serving bench: Llama-3-8B int8 through the continuous-batching engine.
+
+VERDICT r3 #1: the 5.7k tok/s headline was the *static* ``Generator`` — a
+batch-blocking decoder no serving system would run. This bench runs the
+flagship through :class:`~kubetorch_tpu.models.rolling.RollingGenerator`
+(the engine under ``RollingService``) and reports:
+
+- ``rolling_tok_s``: steady-state decode throughput at full occupancy —
+  chunks timed back-to-back on one executable, directly comparable to the
+  static scan number (same B, P, N).
+- ``ttft_ms`` / request-latency p50/p99 under a Poisson arrival load at
+  ~80% of measured capacity, wall-clock-true on this host.
+
+Axon-tunnel caveats (absent on real PJRT TPU; see BASELINE.md): each jit
+dispatch costs ~100-200 ms through the tunnel, and swapping between two
+compiled executables (admission prefill ↔ decode chunk) reloads the
+program. The steady-state window therefore times decode chunks only (the
+same discipline the static bench uses), and the Poisson phase additionally
+reports ``swap_overhead_ms`` — the measured excess of a post-admission
+chunk over the steady median — so the tunnel tax is bounded, not buried.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Optional
+
+HBM_BW = 819e9
+
+
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def _pct(xs, p):
+    xs = sorted(xs)
+    if not xs:
+        return 0.0
+    i = min(len(xs) - 1, int(round(p / 100 * (len(xs) - 1))))
+    return xs[i]
+
+
+def bench_8b_rolling(B: int = 112, P: int = 128, N: int = 128,
+                     steps_per_call: int = 16,
+                     poisson_requests: int = 96,
+                     static_tok_s: Optional[float] = None,
+                     seed: int = 0) -> Optional[dict]:
+    """Build the 8B int8 engine and run both phases. Returns the metrics
+    dict, or None if no batch on the ladder fits the chip."""
+    import jax
+    import numpy as np
+
+    from kubetorch_tpu.models import LlamaConfig, quant
+    from kubetorch_tpu.models.rolling import RollingGenerator
+
+    cfg = LlamaConfig.llama3_8b(max_seq_len=1024)
+    params = quant.init_quantized(jax.random.key(0), cfg, fuse=True)
+    jax.block_until_ready(params)
+
+    rng = np.random.default_rng(seed)
+    for b in sorted({x for x in (B, 96, 64) if x <= B}, reverse=True):
+        try:
+            out = _run_phases(params, cfg, b, P, N, steps_per_call,
+                              poisson_requests, rng)
+            if static_tok_s:
+                out["vs_static"] = round(out["rolling_tok_s"]
+                                         / static_tok_s, 4)
+            return out
+        except Exception as e:  # OOM → step down the slot ladder
+            print(f"# 8b rolling B={b} failed ({type(e).__name__}: {e}); "
+                  f"stepping down", file=sys.stderr)
+            import gc
+
+            gc.collect()
+            jax.block_until_ready(jax.device_put(0))
+    return None
+
+
+def _run_phases(params, cfg, B, P, N, steps_per_call, n_poisson, rng):
+    import jax
+    import numpy as np
+
+    from kubetorch_tpu.models.rolling import RollingGenerator
+
+    max_len = P + N + 2 * steps_per_call
+    eng = RollingGenerator(params, cfg, max_slots=B, max_len=max_len,
+                           steps_per_call=steps_per_call, admit_width=16,
+                           seed=0)
+
+    def prompt():
+        return rng.integers(1, cfg.vocab_size, P).tolist()
+
+    # ---- phase 1: steady-state throughput at full occupancy ------------
+    # Budgets exceed the timed window so no slot frees mid-measurement:
+    # every timed step() is the same decode executable back-to-back.
+    for _ in range(B):
+        eng.submit(prompt(), max_new_tokens=N, temperature=0.8)
+    t0 = time.perf_counter()
+    while eng._queue:                       # admission prefills (compile)
+        eng.step()
+    admit_s = time.perf_counter() - t0
+    eng.step()                              # decode compile + first chunk
+    chunk_times = []
+    timed_steps = 0
+    while timed_steps + steps_per_call <= N - 2 * steps_per_call:
+        t0 = time.perf_counter()
+        eng.step()
+        chunk_times.append(time.perf_counter() - t0)
+        timed_steps += steps_per_call
+    med = _median(chunk_times)
+    rolling_tok_s = B * steps_per_call / med
+    # drain the rest so phase 2 starts empty
+    while eng.pending:
+        eng.step()
+
+    # bytes/step: int8 weight stream (minus embedding) + KV at average fill
+    nbytes = sum(x.nbytes for x in jax.tree.leaves(params))
+    emb = params["embedding"].nbytes
+    kv = sum(x.nbytes for x in jax.tree.leaves(
+        {"k": eng.cache["k"], "v": eng.cache["v"]}))
+    avg_fill = (P + N / 2) / max_len
+    mbu = ((nbytes - emb) + kv * avg_fill) / (med / steps_per_call) / HBM_BW
+
+    out = {
+        "batch": B,
+        "rolling_tok_s": round(rolling_tok_s, 1),
+        "chunk_ms_median": round(med * 1e3, 1),
+        "ms_per_step": round(med / steps_per_call * 1e3, 2),
+        "steps_per_call": steps_per_call,
+        "admit_s": round(admit_s, 2),
+        "mbu": round(mbu, 4),
+    }
+
+    # ---- phase 2: Poisson arrivals → TTFT + request latency ------------
+    # Arrival rate ~80% of measured capacity (in requests/s of avg-length
+    # requests); budgets drawn uniformly so slots churn continuously.
+    lens = rng.integers(N // 4, N + 1, n_poisson)
+    lam = 0.8 * rolling_tok_s / float(np.mean(lens))
+    gaps = rng.exponential(1.0 / lam, n_poisson)
+    arrive_at = np.cumsum(gaps)
+
+    t_start = time.perf_counter()
+    submit_t: dict = {}
+    first_tok_t: dict = {}
+    done_t: dict = {}
+    next_i = 0
+    post_admit = []                       # chunk time right after admission
+    steady = []                           # chunk time with no admission
+    while len(done_t) < n_poisson:
+        now = time.perf_counter() - t_start
+        while next_i < n_poisson and arrive_at[next_i] <= now:
+            rid = eng.submit(prompt(), max_new_tokens=int(lens[next_i]),
+                             temperature=0.8)
+            submit_t[rid] = time.perf_counter()
+            next_i += 1
+        if not eng.pending:
+            if next_i < n_poisson:        # idle gap: sleep to next arrival
+                time.sleep(max(0.0, arrive_at[next_i]
+                               - (time.perf_counter() - t_start)))
+            continue
+        admitted = bool(eng._queue) and bool(eng._free)
+        t0 = time.perf_counter()
+        events = eng.step()
+        dt = time.perf_counter() - t0
+        (post_admit if admitted else steady).append(dt)
+        tnow = time.perf_counter()
+        for rid, toks, done in events:
+            if toks and rid not in first_tok_t:
+                first_tok_t[rid] = tnow
+            if done:
+                done_t[rid] = tnow
+
+    ttft = [(first_tok_t[r] - submit_t[r]) * 1e3 for r in first_tok_t]
+    lat = [(done_t[r] - submit_t[r]) * 1e3 for r in done_t]
+    total_toks = int(np.sum(lens))
+    wall = max(done_t.values()) - t_start
+    out.update({
+        "poisson_requests": n_poisson,
+        "poisson_tok_s": round(total_toks / wall, 1),
+        "ttft_ms_p50": round(_pct(ttft, 50), 1),
+        "ttft_ms_p99": round(_pct(ttft, 99), 1),
+        "latency_ms_p50": round(_pct(lat, 50), 1),
+        "latency_ms_p99": round(_pct(lat, 99), 1),
+        "swap_overhead_ms": round(
+            (_median(post_admit) - _median(steady)) * 1e3, 1)
+        if post_admit and steady else None,
+    })
+    return out
+
+
+if __name__ == "__main__":
+    import json
+
+    r = bench_8b_rolling(static_tok_s=5673.0)
+    print(json.dumps(r, indent=2))

@@ -112,7 +112,8 @@ def _member_id() -> str:
 def _stream_blob_into_cache(backend, key: str, cache_root: Path,
                             wait_parent: bool = False,
                             cache_name: Optional[str] = None,
-                            remote_name: Optional[str] = None) -> Path:
+                            remote_name: Optional[str] = None,
+                            expect_version: Optional[int] = None) -> Path:
     """Streaming blob download into the peer cache.
 
     Bytes land in a fetcher-private ``.part-<pid>-<uuid>`` file as they
@@ -135,6 +136,10 @@ def _stream_blob_into_cache(backend, key: str, cache_root: Path,
     scheme), the plain key when it is the central store.
     ``wait_parent``: ask the source to hold the request briefly if its own
     fetch hasn't started yet (``?wait=1``; peers only).
+    ``expect_version``: abort if the central store's X-KT-Blob-Version no
+    longer matches — a member pulling the plain key (rank 0, or the
+    parent-death fallback) but caching under the join-time ``.bv{N}`` name
+    must never relay a racing re-put's bytes labeled as the old version.
     """
     import http.client as _hc
 
@@ -208,6 +213,13 @@ def _stream_blob_into_cache(backend, key: str, cache_root: Path,
                 return _windowed_fetch(conn, plain_path, part, total,
                                        view)
             # complete source: one streamed body
+            if expect_version is not None:
+                served = resp.getheader("X-KT-Blob-Version")
+                if served is not None and int(served) != expect_version:
+                    raise DataStoreError(
+                        f"blob {key!r} changed mid-broadcast (version "
+                        f"{served} != group's {expect_version}); rejoin "
+                        f"the (re-keyed) group for the new content")
             total = (resp.getheader("X-KT-Blob-Size")
                      or resp.getheader("Content-Length"))
             if total is not None:
@@ -332,7 +344,8 @@ def _fetch_into_cache(backend, key: str, cache_root: Path,
                       excludes=None,
                       wait_parent: bool = False,
                       blob_cache_name: Optional[str] = None,
-                      blob_remote_name: Optional[str] = None
+                      blob_remote_name: Optional[str] = None,
+                      blob_expect_version: Optional[int] = None
                       ) -> Tuple[Path, bool]:
     """Pull ``key`` from ``backend`` into the peer cache, preserving the
     blob-vs-tree distinction so we can re-serve it unchanged. Returns
@@ -354,7 +367,8 @@ def _fetch_into_cache(backend, key: str, cache_root: Path,
         local = _stream_blob_into_cache(backend, key, cache_root,
                                         wait_parent=wait_parent,
                                         cache_name=blob_cache_name,
-                                        remote_name=blob_remote_name)
+                                        remote_name=blob_remote_name,
+                                        expect_version=blob_expect_version)
         return local, False
     backend._raise_for(manifest_resp, "manifest")
     # "tmp-" prefix marks an in-progress stage: the sweeper must never
@@ -460,12 +474,20 @@ def broadcast_get(store_backend, key: str, window: BroadcastWindow,
         group, key=key, member_id=mid, world_size=window.world_size,
         fanout=window.fanout, lease=window.lease,
         serve_url=serve_url, stream=bool(serve_url))
+    # Poll fast while assignment is imminent, then back off: at large
+    # world sizes with saturated fanout a flat 20ms is thousands of pure
+    # polling req/s against the coordinator's single event loop — the
+    # same loop relaying the actual transfers.
+    join_start = time.time()
+    poll = 0.02
     while state["status"] == "joined":
         if time.time() > deadline:
             raise DataStoreError(
                 f"broadcast {group!r}: no source within "
                 f"{window.timeout:.0f}s (rank {state['rank']})")
-        time.sleep(0.02)
+        if time.time() - join_start > 1.0:
+            poll = min(0.25, poll * 1.5)
+        time.sleep(poll)
         try:
             state = store_backend.bcast_member(group, mid)
         except DataStoreError as e:
@@ -500,14 +522,30 @@ def broadcast_get(store_backend, key: str, window: BroadcastWindow,
             wait_parent=parent is not store_backend,
             blob_cache_name=cache_name,
             blob_remote_name=(cache_name if parent is not store_backend
-                              else None))
+                              else None),
+            blob_expect_version=(version if parent is store_backend
+                                 else None))
     except (DataStoreError, OSError, httpx.HTTPError):
         if parent is store_backend:
             raise
         # Parent peer died mid-serve: the store always has the bytes.
         local, is_tree = _fetch_into_cache(store_backend, key, cache_root,
                                            excludes=excludes,
-                                           blob_cache_name=cache_name)
+                                           blob_cache_name=cache_name,
+                                           blob_expect_version=version)
+    if not is_tree and cache_name is not None and serve_url:
+        # Publish the plain-key name too (hardlink: same bytes, no copy):
+        # bcast_complete registers this peer as a P2P source for the
+        # plain key, and /sources consumers fetch /blob/{key} — which
+        # must resolve here, not 404 against the .bv-scoped cache file.
+        plain = cache_root / key
+        pub = plain.with_name(
+            f".{plain.name}.{os.getpid()}-{uuid.uuid4().hex[:6]}.pub")
+        try:
+            os.link(local, pub)
+            os.replace(pub, plain)
+        except OSError:
+            pub.unlink(missing_ok=True)
     try:
         store_backend.bcast_complete(group, mid, serve_url=serve_url)
     except (DataStoreError, httpx.HTTPError):

@@ -281,22 +281,37 @@ class HttpStoreBackend:
             finally:
                 conn.close()
 
-        try:
-            status, body = with_retries(
-                attempt, retry_on=(OSError, _hc.HTTPException,
-                                   RetryableStatus),
-                max_attempts=self.retry_attempts)
-        except RetryableStatus as exc:
-            raise DataStoreError(
-                f"store get {key!r} failed after retries: {exc}",
-                status=exc.status) from None
-        except _hc.HTTPException as exc:
-            # normalize to the store error contract: callers' fallbacks
-            # (broadcast dead-parent → direct store fetch) catch
-            # DataStoreError/OSError, not http.client internals
-            raise DataStoreError(
-                f"store get {key!r} failed: {type(exc).__name__}: {exc}"
-            ) from exc
+        import time as _time
+
+        deadline = _time.time() + 120.0
+        while True:
+            try:
+                status, body = with_retries(
+                    attempt, retry_on=(OSError, _hc.HTTPException,
+                                       RetryableStatus),
+                    max_attempts=self.retry_attempts)
+            except RetryableStatus as exc:
+                raise DataStoreError(
+                    f"store get {key!r} failed after retries: {exc}",
+                    status=exc.status) from None
+            except _hc.HTTPException as exc:
+                # normalize to the store error contract: callers' fallbacks
+                # (broadcast dead-parent → direct store fetch) catch
+                # DataStoreError/OSError, not http.client internals
+                raise DataStoreError(
+                    f"store get {key!r} failed: {type(exc).__name__}: {exc}"
+                ) from exc
+            if status != 202:
+                break
+            # 202 = a serving peer cache is still mid-fetch of this blob
+            # (body is the {size, have, complete} progress JSON, NOT blob
+            # bytes). Only the broadcast streaming client windows over a
+            # growing .part; a plain GET polls until the copy is published.
+            if _time.time() > deadline:
+                raise DataStoreError(
+                    f"blob {key!r} still in-flight at source after 120s",
+                    status=202)
+            _time.sleep(0.1)
         if status == 404:
             raise DataStoreError(f"no such key {key!r}", status=404)
         if status >= 400:

@@ -38,6 +38,7 @@ from __future__ import annotations
 import io
 import json
 import os
+import re
 import shutil
 import tarfile
 import time
@@ -57,6 +58,31 @@ def _norm_key(key: str) -> str:
     if not key or ".." in key.split("/"):
         raise web.HTTPBadRequest(text=f"invalid key {key!r}")
     return key
+
+
+# Internal bookkeeping files that must stay invisible to /keys. Matched by
+# known patterns only — a legitimately dot-named key (".env-snapshot")
+# stays listable (it is put/get/deletable, so hiding it was a lie).
+_INTERNAL_SUFFIXES = (".kt-stamp", ".size", ".tombstone", ".steal", ".lnk",
+                      ".pub")
+
+
+def _is_internal(rel: Path) -> bool:
+    if ".trees" in rel.parts:  # peer-cache tree version store
+        return True
+    name = rel.name
+    # relay files: "<name>.part" claim symlink, "<name>.part-<pid>-<hex>"
+    # private part (anchored — a user key like "report.part1.csv" stays
+    # visible)
+    if name.endswith(_INTERNAL_SUFFIXES) or re.search(r"\.part(-|$)", name):
+        return True
+    # h_put_blob / _fetch_into_cache staging: ".<name>.<pid>-<hex>.tmp"
+    if name.startswith(".") and name.endswith(".tmp"):
+        return True
+    # version-scoped broadcast cache files in peer caches ("key.bv3")
+    if re.search(r"\.bv\d+$", name):
+        return True
+    return False
 
 
 class StoreServer:
@@ -227,9 +253,17 @@ class StoreServer:
                     {"size": size, "have": size, "complete": True})
             self.stats["gets"] += 1
             self.stats["bytes_out"] += span_bytes(size)
-            # FileResponse: sendfile-backed, no whole-blob buffering
+            # FileResponse: sendfile-backed, no whole-blob buffering.
+            # X-KT-Blob-Version lets broadcast members detect a re-put
+            # racing their fetch: a member pulling the plain key but
+            # caching under a version-scoped name aborts when the served
+            # content no longer matches its group's version (peer caches
+            # don't track versions — the header is 0 there and clients
+            # only enforce it against the central store).
             return web.FileResponse(
-                path, headers={"Content-Type": "application/octet-stream"})
+                path, headers={
+                    "Content-Type": "application/octet-stream",
+                    "X-KT-Blob-Version": str(self.versions.get(key, 0))})
 
         if request.query.get("progress"):
             return web.json_response(
@@ -252,8 +286,7 @@ class StoreServer:
         out = []
         if base.exists():
             for path in sorted(base.rglob("*")):
-                # skip retention stamps and in-flight .tmp staging files
-                if path.name.endswith(".kt-stamp") or path.name.startswith("."):
+                if _is_internal(path.relative_to(self.root)):
                     continue
                 if path.is_file():
                     stat = path.stat()
@@ -600,7 +633,11 @@ class StoreServer:
             member["status"] = "complete"
             # A straggler that fetched old bytes before a re-put must not
             # re-register as a source: the group's fingerprint predates the
-            # new content, so its copy is last round's weights.
+            # new content, so its copy is last round's weights. (Completed
+            # peers DO hold the plain key: broadcast_get publishes the
+            # version-scoped cache file under the plain name right before
+            # reporting complete, so /sources consumers fetching
+            # /blob/{key} from this peer are served.)
             stale = g["fingerprint"] != self._key_fingerprint(g["key"])
             if not stale and info.get("serve_url"):
                 member["serve_url"] = info["serve_url"]

@@ -97,13 +97,20 @@ class RollingGenerator:
                  mesh=None, rules: Optional[ShardingRules] = None,
                  eos_id: Optional[int] = None, top_k: Optional[int] = None,
                  top_p: Optional[float] = None, seed: int = 0,
-                 steps_per_call: int = 8):
+                 steps_per_call: int = 8, admit_width: int = 0):
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
         self.rules = rules or ShardingRules.default()
         self.max_slots = max_slots
         self.max_len = max_len or cfg.max_seq_len
+        # Widest single prefill call. At serving scale (112 slots × 8B)
+        # full-width admission is wrong twice over: the private prefill
+        # cache is [L, width, p_pad, Hkv, D] (≈2 GB transient at width
+        # 112 beside the 4 GB grid + 9 GB weights), and a churn wave of
+        # 3 arrivals would pay a 112-row prefill. 0 = max_slots (the
+        # small-engine default, where one width keeps compiles at 2).
+        self.admit_width = min(admit_width or max_slots, max_slots)
         self.eos_id = eos_id
         self.top_k = top_k
         self.top_p = top_p
@@ -198,7 +205,9 @@ class RollingGenerator:
             key = (_bucket(len(req.prompt)), req.prefix_id)
             by_key.setdefault(key, []).append(req)
         for (p_pad, prefix_id), group in by_key.items():
-            self._admit_group(group, p_pad, prefix_id)
+            for i in range(0, len(group), self.admit_width):
+                self._admit_group(group[i:i + self.admit_width], p_pad,
+                                  prefix_id)
         if not self._slots:
             return []
         return self._decode_chunk()
@@ -252,7 +261,7 @@ class RollingGenerator:
         n = len(group)
         # two admission shapes only (single vs full-width) — prefill FLOPs
         # on dummy rows are cheap; compiles are not
-        n_pad = 1 if n == 1 else self.max_slots
+        n_pad = 1 if n == 1 else self.admit_width
         toks = np.zeros((n_pad, p_pad), np.int32)
         lens = np.ones(n_pad, np.int32)
         slots = np.full(n_pad, self.max_slots, np.int32)  # OOB → dropped
@@ -355,39 +364,54 @@ class RollingGenerator:
                       prompt_lens, slots, *, p_pad, cfg, rules):
         """Prefill N slots at once: one forward over a private N-row
         cache, then scatter the rows into the shared grid at ``slots``
-        (out-of-range dummy rows drop)."""
-        M = cache["k"].shape[2]
+        (out-of-range dummy rows drop).
+
+        The private cache covers only the ``p_pad`` rows prefill writes —
+        full-``M`` would be a second multi-GB grid live beside the real
+        one (4 GB transient at 8B serving scale). Likewise the forward
+        unembeds at the last real token only (``unembed_positions``):
+        [N, P, V] float32 logits are 7 GB at N=112, V=128k."""
         N = tokens.shape[0]
         positions = jnp.broadcast_to(jnp.arange(p_pad)[None, :], (N, p_pad))
-        m = jnp.arange(M)[None, None, :]
+        m = jnp.arange(p_pad)[None, None, :]
         t = positions[:, :, None]
         mask = (m <= t) & (m < prompt_lens[:, None, None])
-        own = llama.init_cache(cfg, N, M, dtype=cache["k"].dtype)
+        own = llama.init_cache(cfg, N, p_pad, dtype=cache["k"].dtype)
         out, own = llama.forward_cached(
-            params, tokens, positions, own, 0, mask, cfg, rules)
+            params, tokens, positions, own, 0, mask, cfg, rules,
+            unembed_positions=prompt_lens - 1)
         return RollingGenerator._finish_admit(
-            cache, own, out, logits, dpos, dactive, slots, prompt_lens,
-            prompt_lens - 1)
+            cache, own, out[:, 0], logits, dpos, dactive, slots,
+            prompt_lens)
 
     @staticmethod
-    def _finish_admit(cache, own, out, logits, dpos, dactive, slots,
-                      new_pos, last_t):
+    def _finish_admit(cache, own, last, logits, dpos, dactive, slots,
+                      new_pos):
         """Splice own-cache rows into the grid and update per-slot state.
 
         Gather + masked select, NOT a scatter: batched-axis scatters on the
         [L,B,M,Hkv,D] grid lower to a serialized generic scatter on TPU
         (measured ~7 s per admission on the 0.8B bench vs ~60 ms this way).
+        ``own`` spans rows [0, M_own) of the grid's M axis — prefill always
+        writes from position 0 (prefixed admission broadcasts the prefix
+        into the own-cache first), so the splice touches only that span.
+        ``last``: [N, V] logits at each row's final real token.
         """
         B = cache["k"].shape[1]
+        M_own = own["k"].shape[2]
         onehot = slots[None, :] == jnp.arange(B)[:, None]       # [B, N]
         valid = onehot.any(axis=1)[None, :, None, None, None]
         sel = jnp.argmax(onehot, axis=1)                        # [B]
         cache = {
-            "k": jnp.where(valid, own["k"][:, sel], cache["k"]),
-            "v": jnp.where(valid, own["v"][:, sel], cache["v"]),
+            "k": jax.lax.dynamic_update_slice_in_dim(
+                cache["k"],
+                jnp.where(valid, own["k"][:, sel],
+                          cache["k"][:, :, :M_own]), 0, axis=2),
+            "v": jax.lax.dynamic_update_slice_in_dim(
+                cache["v"],
+                jnp.where(valid, own["v"][:, sel],
+                          cache["v"][:, :, :M_own]), 0, axis=2),
         }
-        last = jnp.take_along_axis(
-            out, last_t[:, None, None], axis=1)[:, 0]           # [N, V]
         logits = logits.at[slots].set(last, mode="drop")
         dpos = dpos.at[slots].set(new_pos, mode="drop")
         dactive = dactive.at[slots].set(True, mode="drop")
@@ -401,8 +425,9 @@ class RollingGenerator:
         mask = (m <= positions[:, :, None]) & (m < prefix_len)
         own = llama.init_cache(cfg, 1, p_pad)
         out, own = llama.forward_cached(
-            params, tokens, positions, own, 0, mask, cfg, rules)
-        return own["k"], own["v"], out[0, prefix_len - 1]
+            params, tokens, positions, own, 0, mask, cfg, rules,
+            unembed_positions=(prefix_len - 1)[None])
+        return own["k"], own["v"], out[0, 0]
 
     @staticmethod
     def _prefill_px_impl(params, cache, logits, dpos, dactive, pk, pv,
@@ -417,7 +442,14 @@ class RollingGenerator:
         M = cache["k"].shape[2]
         N = tokens.shape[0]
         L, _, Ppad, Hkv, D = pk.shape
-        own = llama.init_cache(cfg, N, M, dtype=cache["k"].dtype)
+        # Rows needed: the prefix block plus the suffix span — suffix rows
+        # write at [prefix_len, prefix_len + p_pad) and prefix_len ≤ Ppad.
+        # Clamped to the grid's M: a long prefix whose BUCKET plus the
+        # suffix bucket overshoots max_len (the real tokens fit — submit()
+        # checked) must not build an own-cache wider than the grid it
+        # splices into.
+        own = llama.init_cache(cfg, N, min(Ppad + p_pad, M),
+                               dtype=cache["k"].dtype)
         own = {
             "k": jax.lax.dynamic_update_slice(
                 own["k"], jnp.broadcast_to(pk, (L, N, Ppad, Hkv, D))
@@ -428,13 +460,14 @@ class RollingGenerator:
         }
         positions = prefix_len + jnp.broadcast_to(
             jnp.arange(p_pad)[None, :], (N, p_pad))
-        m = jnp.arange(M)[None, None, :]
+        m = jnp.arange(own["k"].shape[2])[None, None, :]
         mask = m <= positions[:, :, None]
         out, own = llama.forward_cached(
-            params, tokens, positions, own, prefix_len, mask, cfg, rules)
+            params, tokens, positions, own, prefix_len, mask, cfg, rules,
+            unembed_positions=prompt_lens - 1)
         return RollingGenerator._finish_admit(
-            cache, own, out, logits, dpos, dactive, slots,
-            prefix_len + prompt_lens, prompt_lens - 1)
+            cache, own, out[:, 0], logits, dpos, dactive, slots,
+            prefix_len + prompt_lens)
 
     @staticmethod
     def _decode_impl(params, cache, last_logits, pos, active, temps,

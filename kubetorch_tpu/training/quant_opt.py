@@ -1,0 +1,138 @@
+"""Memory-quantized AdamW: int8 block-quantized moments for optax.
+
+VERDICT r3 #4: the 1.5B single-chip config stalls at 54.3% MFU and the
+diagnosis is Adam state traffic (~21 GB/step of HBM at B=4·S=2048 — the
+moments are already bf16, ``optax.adamw`` inherits the param dtype). This
+transformation stores both moments as **int8 with per-block float32
+absmax scales** (bitsandbytes' 8-bit Adam idea, re-derived TPU-first):
+
+- ``m`` quantizes linearly (signed absmax / 127 per block).
+- ``v`` quantizes on the **sqrt** scale — second moments span many orders
+  of magnitude and a linear int8 would zero the small ones; sqrt halves
+  the dynamic range and the Adam denominator only ever consumes
+  ``sqrt(v)``, so the stored quantity is exactly what the update needs.
+- Blocks run along the LAST axis (``block`` elements, clamped to the axis
+  and falling back to whole-axis scaling when it doesn't divide), so the
+  int8 state keeps the param's shape and leading axes — fsdp/tp shardings
+  propagate onto it unchanged, which a flattened [k, block] layout would
+  break on a mesh.
+
+HBM effect at 1.53B params: moment state drops 6.1 GB → 1.53 GB resident
+(+scales), cutting ~9 GB of read+write traffic per step AND freeing ~4.6 GB
+of residency for a larger batch or a lighter remat policy — the second
+effect is the bigger MFU lever on a 16 GB chip.
+
+Dequant → f32 Adam math → requant happens inside the fused train step;
+XLA streams the int8 arrays once per step. The master params stay whatever
+``param_dtype`` says (bf16 for the bench configs).
+
+Reference: the CUDA stack reaches for ``bitsandbytes.optim.Adam8bit``
+(torch ecosystem); this is the native equivalent with no custom kernel —
+TPU VPUs eat the elementwise dequant/requant inside the fused update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class _QMoment(NamedTuple):
+    q: Any          # int8, param-shaped
+    scale: Any      # f32, param.shape[:-1] + (n_blocks,)
+
+
+class ScaleByQuantAdamState(NamedTuple):
+    count: Any      # int32 scalar
+    mu: Any         # pytree of _QMoment
+    nu: Any         # pytree of _QMoment (sqrt-scale)
+
+
+def _block_shape(shape, block):
+    last = shape[-1] if shape else 1
+    if last >= block and last % block == 0:
+        return block
+    return last  # whole-axis scale (tiny or indivisible trailing axis)
+
+
+def _quantize(x, block):
+    """x [..., n] f32 → (int8 [..., n], f32 scales [..., n//b])."""
+    b = _block_shape(x.shape, block)
+    if x.ndim == 0:
+        x = x[None]
+        q, s = _quantize(x, block)
+        return q[0], s[0]
+    blocks = x.reshape(x.shape[:-1] + (x.shape[-1] // b, b))
+    absmax = jnp.max(jnp.abs(blocks), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(blocks / scale[..., None]), -127, 127)
+    return q.reshape(x.shape).astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def _dequantize(q, scale, block):
+    b = _block_shape(q.shape, block)
+    if q.ndim == 0:
+        return _dequantize(q[None], scale[None], block)[0]
+    blocks = q.reshape(q.shape[:-1] + (q.shape[-1] // b, b))
+    return (blocks.astype(jnp.float32) * scale[..., None]).reshape(q.shape)
+
+
+def scale_by_quant_adam(b1: float = 0.9, b2: float = 0.95,
+                        eps: float = 1e-8,
+                        block: int = 256) -> optax.GradientTransformation:
+    """Adam scaling with int8 block-quantized moments (see module doc)."""
+
+    def init_fn(params):
+        def zero(p):
+            b = _block_shape(p.shape, block)
+            sshape = (p.shape[:-1] + (p.shape[-1] // b,)) if p.ndim else ()
+            return _QMoment(jnp.zeros(p.shape, jnp.int8),
+                            jnp.ones(sshape, jnp.float32))
+
+        return ScaleByQuantAdamState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(zero, params),
+            nu=jax.tree.map(zero, params))
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = state.count + 1
+        bc1 = 1.0 - b1 ** count.astype(jnp.float32)
+        bc2 = 1.0 - b2 ** count.astype(jnp.float32)
+
+        def one(g, qm, qn):
+            g = g.astype(jnp.float32)
+            m = b1 * _dequantize(qm.q, qm.scale, block) + (1 - b1) * g
+            # nu stores sqrt(v): square on load, sqrt on store
+            v_old = _dequantize(qn.q, qn.scale, block) ** 2
+            v = b2 * v_old + (1 - b2) * g * g
+            upd = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            return upd, _QMoment(*_quantize(m, block)), \
+                _QMoment(*_quantize(jnp.sqrt(v), block))
+
+        flat_g, treedef = jax.tree.flatten(updates)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_n = treedef.flatten_up_to(state.nu)
+        out = [one(g, m, n) for g, m, n in zip(flat_g, flat_m, flat_n)]
+        new_updates = treedef.unflatten([o[0] for o in out])
+        new_mu = treedef.unflatten([o[1] for o in out])
+        new_nu = treedef.unflatten([o[2] for o in out])
+        return new_updates, ScaleByQuantAdamState(count, new_mu, new_nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def adamw_quant(learning_rate, b1: float = 0.9, b2: float = 0.95,
+                eps: float = 1e-8, weight_decay: float = 0.0,
+                block: int = 256,
+                mask: Optional[Any] = None) -> optax.GradientTransformation:
+    """AdamW with int8 block-quantized moments — drop-in for
+    ``optax.adamw`` wherever the moment state dominates HBM."""
+    tx = [scale_by_quant_adam(b1=b1, b2=b2, eps=eps, block=block)]
+    if weight_decay:
+        tx.append(optax.add_decayed_weights(weight_decay, mask=mask))
+    tx.append(optax.scale_by_learning_rate(learning_rate))
+    return optax.chain(*tx)

@@ -312,3 +312,87 @@ def test_straggler_completion_does_not_register_stale_source(store):
     backend.bcast_complete("g2", "m2", serve_url="http://10.1.1.2:1")
     s = backend.get_source("w/x")
     assert s["peer"] is True and s["source"] == "http://10.1.1.2:1"
+
+
+@pytest.mark.level("minimal")
+def test_completed_peer_serves_plain_key(store, tmp_path):
+    """ADVICE r3 (medium): bcast_complete registers peers as P2P sources
+    for the PLAIN key, so a /sources consumer fetching /blob/{key} from
+    the peer must be served — the peer publishes its version-scoped cache
+    file under the plain name at completion."""
+    import httpx
+
+    backend = HttpStoreBackend(store)
+    payload = os.urandom(64 * 1024)
+    backend.put_blob("bcast/plain.bin", payload)
+    window = BroadcastWindow(world_size=1, fanout=2, timeout=30,
+                             cache_root=str(tmp_path / "peer0"))
+    got = backend.get_blob("bcast/plain.bin", broadcast=window)
+    assert bytes(got) == payload
+
+    src = backend.get_source("bcast/plain.bin")
+    assert src["peer"] is True, src
+    resp = httpx.get(f"{src['source']}/blob/bcast/plain.bin", timeout=10)
+    assert resp.status_code == 200
+    assert resp.content == payload
+
+
+@pytest.mark.level("minimal")
+def test_plain_get_polls_inflight_peer_cache(tmp_path):
+    """ADVICE r3: a plain GET against a serving cache mid-fetch gets 202
+    (progress JSON) — get_blob must poll until the blob is published, not
+    hand the JSON back as blob bytes."""
+    from kubetorch_tpu.data_store.broadcast import PeerServer
+
+    root = tmp_path / "cache"
+    (root / "w").mkdir(parents=True)
+    payload = os.urandom(32 * 1024)
+    final = root / "w" / "x.bin"
+    part = final.with_name("x.bin.part-123-abc")
+    part.write_bytes(payload[: len(payload) // 2])
+    part.with_name(part.name + ".size").write_text(str(len(payload)))
+    (final.with_name("x.bin.part")).symlink_to(part.name)
+
+    peer = PeerServer.ensure(root)
+    assert peer is not None
+    backend = HttpStoreBackend(f"http://127.0.0.1:{peer.port}")
+
+    def publish():
+        time.sleep(0.5)
+        # atomic, like the production path (.part → os.replace): a plain
+        # write_bytes can be observed half-written by the poller
+        staged = final.with_name("x.bin.staged")
+        staged.write_bytes(payload)
+        import os as _os
+
+        _os.replace(staged, final)
+        final.with_name("x.bin.part").unlink()
+
+    t = threading.Thread(target=publish)
+    t.start()
+    got = backend.get_blob("w/x.bin")
+    t.join()
+    assert bytes(got) == payload
+
+
+@pytest.mark.level("minimal")
+def test_store_version_header_aborts_raced_fetch(store, tmp_path):
+    """ADVICE r3: the store stamps blob GETs with X-KT-Blob-Version; a
+    broadcast member caching under a join-time .bv name must abort when
+    the store's content has moved on (re-put racing the fetch)."""
+    from kubetorch_tpu.exceptions import DataStoreError
+    from kubetorch_tpu.data_store.broadcast import _stream_blob_into_cache
+
+    backend = HttpStoreBackend(store)
+    backend.put_blob("w/raced.bin", b"v1" * 1000)   # version 1
+    cache = tmp_path / "cache"
+    local = _stream_blob_into_cache(
+        backend, "w/raced.bin", cache,
+        cache_name="w/raced.bin.bv1", expect_version=1)
+    assert local.read_bytes() == b"v1" * 1000
+
+    backend.put_blob("w/raced.bin", b"v2" * 1000)   # version 2
+    with pytest.raises(DataStoreError, match="changed mid-broadcast"):
+        _stream_blob_into_cache(
+            backend, "w/raced.bin", cache,
+            cache_name="w/raced.bin.bv1b", expect_version=1)

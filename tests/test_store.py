@@ -235,3 +235,18 @@ def test_store_cleanup_retention(tmp_path):
         assert bytes(be.get_blob("b/two.bin")) == b"2"
     finally:
         server.close()
+
+
+@pytest.mark.level("minimal")
+def test_keys_lists_dot_named_keys_hides_internal(http_store):
+    """ADVICE r3: /keys must hide only known-internal bookkeeping files
+    (.kt-stamp sidecars, .part relays, staging tmps), not every dot-named
+    key — '.env-snapshot' is put/get/deletable, so it must be listable."""
+    backend = HttpStoreBackend(http_store)
+    backend.put_blob("dot/.env-snapshot", b"SECRET=1")
+    names = {e["key"] for e in backend.list_keys("dot")}
+    assert "dot/.env-snapshot" in names
+    # the put also wrote a .kt-stamp sidecar: must stay hidden
+    assert not any(n.endswith(".kt-stamp") for n in names)
+    assert backend.get_blob("dot/.env-snapshot") == b"SECRET=1"
+    assert backend.delete("dot/.env-snapshot") == 1
