@@ -24,6 +24,8 @@ import logging
 import time
 from typing import Any, Dict, List, Optional, Set
 
+from kubetorch_tpu.exceptions import WatchExpiredError
+
 logger = logging.getLogger(__name__)
 
 EVENTS_JOB = "kubetorch-events"
@@ -146,6 +148,15 @@ class EventWatcher:
                     self.watch_once(timeout_seconds=60, stop=stop)
                 else:
                     self.poll_once()
+            except WatchExpiredError:
+                # Routine resourceVersion compaction (410 Gone): the next
+                # cycle's list_with_version re-seeds from a fresh version.
+                # NOT a watch failure — an idle cluster expires versions
+                # on a timer and must not degrade to polling. The short
+                # wait stops a lagging watch cache (list → instant 410,
+                # repeatedly) from hot-spinning full LISTs.
+                stop.wait(min(1.0, self.interval))
+                continue
             except Exception as exc:  # cluster flake: keep watching
                 logger.debug("event watch/poll failed: %s", exc)
                 self._note_watch_failure(exc)

@@ -227,6 +227,17 @@ class ControllerServer:
         from kubetorch_tpu.observability import log_sink as _ls
 
         _ls.mount(app, self.log_sink, self.metrics_store)
+        # controller-level gauges joining the /metrics scrape (pool count,
+        # pod hub occupancy, log-buffer shedding — the /health numbers,
+        # now PromQL-queryable)
+        app._kt_prom_extra = lambda: [
+            ("controller_pools", {}, len(self.db.list_pools())),
+            ("controller_connected_pods", {},
+             sum(len(p) for p in self.hub.by_service.values())),
+            ("controller_waiting_pods", {}, len(self.hub.waiting)),
+            ("controller_log_batches_dropped_total", {},
+             getattr(self.log_sink.persist, "dropped_batches", 0)),
+        ]
         app.on_startup.append(self._on_startup)
         app.on_shutdown.append(self._on_shutdown)
         return app

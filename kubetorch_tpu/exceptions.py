@@ -52,6 +52,26 @@ class VersionMismatchError(KubetorchError):
     """Client and in-cluster server versions are incompatible."""
 
 
+class ConflictError(KubetorchError):
+    """K8s API 409: optimistic-concurrency or field-manager conflict.
+
+    ``K8sClient.apply`` retries these a few times (concurrent applies of
+    the same service from two clients are routine); it surfaces only after
+    retries exhaust."""
+
+
+class AdmissionRejectedError(KubetorchError):
+    """K8s admission (webhook/quota/policy) denied a manifest (422/403
+    Invalid). Carries the API server's message so the user sees *which*
+    policy rejected the launch instead of a generic launch failure."""
+
+
+class WatchExpiredError(KubetorchError):
+    """K8s watch resourceVersion expired (410 Gone / ERROR event code
+    410): the window the client tried to resume from has been compacted.
+    Consumers re-list and re-watch from the fresh resourceVersion."""
+
+
 class QuorumTimeoutError(KubetorchError):
     """Distributed quorum (worker discovery) not reached in time."""
 

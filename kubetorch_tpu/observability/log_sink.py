@@ -277,11 +277,35 @@ class MetricsStore:
             "last_activity": self.last_activity(service),
         })
 
+    def prometheus_text(self, extra_samples=None) -> str:
+        """All latest pod snapshots in Prometheus exposition format —
+        (service, pod) become labels, pushed values become gauges/counters
+        (observability/prometheus.py). ``extra_samples``: additional
+        ``(name, labels, value)`` rows (controller-level gauges)."""
+        from kubetorch_tpu.observability import prometheus as prom
+
+        samples = list(prom.snapshot_samples(
+            {svc: self.latest(svc) for svc in self._data}))
+        if extra_samples:
+            samples.extend(extra_samples)
+        return prom.render(samples)
+
+    async def h_prometheus(self, request: web.Request):
+        extra = getattr(request.app, "_kt_prom_extra", None)
+        return web.Response(
+            text=self.prometheus_text(extra() if extra else None),
+            content_type="text/plain", charset="utf-8")
+
 
 def mount(app: web.Application, sink: LogSink, metrics: MetricsStore):
-    """Attach sink + metrics routes to an aiohttp app."""
+    """Attach sink + metrics routes to an aiohttp app. ``GET /metrics``
+    is the Prometheus scrape surface (reference parity: the reference
+    hands users real Prometheus; here the controller IS the exporter).
+    An app may set ``app._kt_prom_extra = callable`` returning extra
+    samples to include controller-level gauges in the scrape."""
     app.router.add_post("/logs/push", sink.h_push)
     app.router.add_get("/logs/query", sink.h_query)
     app.router.add_get("/logs/tail", sink.h_tail)
     app.router.add_post("/metrics/push", metrics.h_push)
     app.router.add_get("/metrics/query/{service}", metrics.h_query)
+    app.router.add_get("/metrics", metrics.h_prometheus)

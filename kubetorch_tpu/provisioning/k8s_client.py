@@ -21,7 +21,12 @@ from typing import Any, Dict, List, Optional
 import httpx
 import yaml
 
-from kubetorch_tpu.exceptions import KubetorchError
+from kubetorch_tpu.exceptions import (
+    AdmissionRejectedError,
+    ConflictError,
+    KubetorchError,
+    WatchExpiredError,
+)
 
 _SA_ROOT = Path("/var/run/secrets/kubernetes.io/serviceaccount")
 
@@ -184,22 +189,51 @@ class K8sClient:
 
     def _check(self, resp: httpx.Response) -> Any:
         if resp.status_code >= 400:
-            raise KubetorchError(
-                f"k8s API {resp.request.method} {resp.request.url.path} → "
-                f"{resp.status_code}: {resp.text[:500]}")
+            detail = resp.text[:500]
+            where = (f"k8s API {resp.request.method} "
+                     f"{resp.request.url.path}")
+            if resp.status_code == 409:
+                raise ConflictError(f"{where} → 409: {detail}")
+            if resp.status_code in (400, 403, 422) and (
+                    "admission" in detail or "denied" in detail
+                    or resp.status_code == 422):
+                # admission webhook / quota / policy denial: surface the
+                # server's message as a typed launch error
+                try:
+                    msg = resp.json().get("message", detail)
+                except Exception:
+                    msg = detail
+                raise AdmissionRejectedError(f"{where} rejected: {msg}")
+            raise KubetorchError(f"{where} → {resp.status_code}: {detail}")
         return resp.json() if resp.content else None
 
     # ------------------------------------------------------------ verbs
     def apply(self, manifest: Dict[str, Any],
-              field_manager: str = "kubetorch") -> Dict[str, Any]:
-        """Server-side apply (create-or-update any kind)."""
+              field_manager: str = "kubetorch",
+              conflict_retries: int = 3) -> Dict[str, Any]:
+        """Server-side apply (create-or-update any kind).
+
+        409s retry with backoff: two clients applying the same service
+        (redeploy racing a TTL-reaper teardown, parallel CI jobs) is
+        routine and the second apply is correct once the first settles.
+        """
         url = self._resource_url(manifest)
-        resp = self.client.patch(
-            url,
-            params={"fieldManager": field_manager, "force": "true"},
-            headers={"Content-Type": "application/apply-patch+yaml"},
-            content=json.dumps(manifest))
-        return self._check(resp)
+        attempt = 0
+        while True:
+            resp = self.client.patch(
+                url,
+                params={"fieldManager": field_manager, "force": "true"},
+                headers={"Content-Type": "application/apply-patch+yaml"},
+                content=json.dumps(manifest))
+            try:
+                return self._check(resp)
+            except ConflictError:
+                attempt += 1
+                if attempt > conflict_retries:
+                    raise
+                import time as _time
+
+                _time.sleep(0.2 * (2 ** (attempt - 1)))
 
     def patch(self, kind_or_manifest: Any, name: Optional[str] = None,
               body: Optional[Dict[str, Any]] = None,
@@ -257,6 +291,11 @@ class K8sClient:
                 timeout=httpx.Timeout(connect=10.0,
                                       read=timeout_seconds + 30,
                                       write=60.0, pool=10.0)) as resp:
+            if resp.status_code == 410:
+                resp.read()
+                raise WatchExpiredError(
+                    f"watch {url}: resourceVersion "
+                    f"{resource_version!r} expired (410 Gone)")
             if resp.status_code >= 400:
                 resp.read()
                 raise KubetorchError(
@@ -266,7 +305,15 @@ class K8sClient:
                 if not line:
                     continue
                 evt = json.loads(line)
-                yield evt.get("type", ""), evt.get("object") or {}
+                etype = evt.get("type", "")
+                obj = evt.get("object") or {}
+                if etype == "ERROR" and obj.get("code") == 410:
+                    # mid-stream expiry arrives as an ERROR event carrying
+                    # a 410 Status — same remedy as the HTTP 410: re-list
+                    raise WatchExpiredError(
+                        f"watch {url}: expired mid-stream "
+                        f"({obj.get('message', '410 Gone')})")
+                yield etype, obj
 
     def list_with_version(self, kind_or_manifest: Any,
                           namespace: Optional[str] = None,

@@ -364,7 +364,22 @@ class PodServer:
     async def h_metrics(self, request):
         healthy = (self.supervisor.healthy()
                    if self.supervisor is not None else True)
-        return web.json_response({**self.metrics, "workers_healthy": healthy})
+        data = {**self.metrics, "workers_healthy": healthy}
+        from kubetorch_tpu.observability import prometheus as prom
+
+        if prom.wants_prometheus(request):
+            # Prometheus/OpenMetrics scrapers (Accept: text/plain...) get
+            # the exposition format; the framework's JSON clients keep the
+            # dict shape. Pod identity rides as labels so a cluster-level
+            # scrape aggregates cleanly.
+            labels = {
+                "service": self.metadata.get("service_name", ""),
+                "pod": os.environ.get("KT_POD_NAME", ""),
+            }
+            return web.Response(
+                text=prom.render(prom.flatten_metrics(data, labels)),
+                content_type="text/plain", charset="utf-8")
+        return web.json_response(data)
 
     async def h_app_status(self, request):
         if self.app_proc is None:

@@ -69,3 +69,27 @@ def test_every_template_balances_helm_blocks():
         opens = len(re.findall(r"\{\{-?\s*(?:if|range|with)\b", text))
         ends = len(re.findall(r"\{\{-?\s*end\s*-?\}\}", text))
         assert opens == ends, f"{path.name}: {opens} opens vs {ends} ends"
+
+
+@pytest.mark.level("unit")
+def test_monitoring_template_and_dashboard():
+    """Prometheus-operator objects + Grafana dashboard ship with the chart
+    (VERDICT r3 #5): ServiceMonitor/PodMonitor gated on values, dashboard
+    ConfigMap labeled for sidecar discovery, JSON parses."""
+    import json
+
+    mon = _template("monitoring.yaml")
+    assert "kind: ServiceMonitor" in mon
+    assert "kind: PodMonitor" in mon
+    assert ".Values.monitoring.enabled" in mon
+    assert "path: /metrics" in mon
+    assert 'grafana_dashboard: "1"' in mon
+    values = yaml.safe_load((CHART / "values.yaml").read_text())
+    assert values["monitoring"]["enabled"] is False  # opt-in
+    assert values["monitoring"]["grafanaDashboard"] is True
+    dash = json.loads((CHART / "dashboards" / "kubetorch.json").read_text())
+    exprs = [t["expr"] for p in dash["panels"] for t in p.get("targets", [])]
+    assert any("kubetorch_http_requests_total" in e for e in exprs)
+    assert any("kubetorch_controller_pools" in e for e in exprs)
+    # every metric the dashboard queries uses the exposition prefix
+    assert all("kubetorch_" in e or "time()" in e for e in exprs)

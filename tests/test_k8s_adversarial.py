@@ -1,0 +1,142 @@
+"""Adversarial K8s API semantics against the fake (VERDICT r3 #6): 409
+conflicts with client retry, admission rejection as a typed launch error,
+and watch resourceVersion expiry (410 Gone) relisting through the event
+watcher. These are the behaviors a real API server exercises that a
+happy-path fake never would."""
+
+import pytest
+
+from kubetorch_tpu.exceptions import (
+    AdmissionRejectedError,
+    ConflictError,
+    WatchExpiredError,
+)
+from kubetorch_tpu.provisioning.k8s_backend import K8sBackend
+from kubetorch_tpu.provisioning.k8s_client import K8sClient
+from kubetorch_tpu.resources.compute.compute import Compute
+
+from fake_k8s import FakeK8s
+
+
+@pytest.fixture()
+def fake(monkeypatch):
+    server = FakeK8s()
+    monkeypatch.setenv("KT_READY_POLL", "0.05")
+    monkeypatch.delenv("KT_CONTROLLER_URL", raising=False)
+    yield server
+    server.close()
+
+
+@pytest.fixture()
+def client(fake):
+    return K8sClient(fake.url, namespace="default")
+
+
+def _manifest(name):
+    return {"apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"replicas": 1, "template": {"metadata": {"labels": {
+                "kubetorch.com/service": name}}}}}
+
+
+@pytest.mark.level("unit")
+def test_apply_retries_conflicts_then_succeeds(fake, client):
+    fake.conflict_next(2)
+    out = client.apply(_manifest("svc-409"))
+    assert out["metadata"]["name"] == "svc-409"
+    assert fake.conflict_hits == 2
+    assert ("default", "deployments", "svc-409") in fake.objects
+
+
+@pytest.mark.level("unit")
+def test_apply_conflict_exhaustion_raises_typed(fake, client):
+    fake.conflict_next(10)
+    with pytest.raises(ConflictError, match="409"):
+        client.apply(_manifest("svc-409b"), conflict_retries=2)
+    assert fake.conflict_hits == 3  # initial + 2 retries
+
+
+@pytest.mark.level("unit")
+def test_admission_rejection_surfaces_as_typed_launch_error(fake):
+    backend = K8sBackend(client=K8sClient(fake.url, namespace="default"))
+    fake.reject_admission("svc-adm", "TPU quota exceeded in queue ml")
+    with pytest.raises(AdmissionRejectedError,
+                       match="TPU quota exceeded in queue ml"):
+        backend.launch(
+            "svc-adm",
+            module_env={"KT_MODULE": "svc-adm"},
+            compute_dict=Compute(cpus="1").to_dict(),
+            module_meta={"import_path": "svc:fn"},
+            launch_timeout=5,
+            launch_id="gen1",
+        )
+
+
+@pytest.mark.level("unit")
+def test_watch_410_raises_watch_expired(fake, client):
+    fake.expire_watches()
+    with pytest.raises(WatchExpiredError, match="410"):
+        list(client.watch("Event", "default", resource_version="1"))
+
+
+@pytest.mark.level("unit")
+def test_watch_replays_events_after_resource_version(fake, client):
+    fake.push_event("e1", uid="u1", message="first")
+    items, version = client.list_with_version("Event", "default")
+    assert len(items) == 1
+    fake.push_event("e2", uid="u2", message="second")
+    got = list(client.watch("Event", "default", resource_version=version))
+    assert [o["metadata"]["uid"] for _, o in got] == ["u2"]
+
+
+@pytest.mark.level("unit")
+def test_event_watcher_survives_expiry_and_never_duplicates(fake, client):
+    """watch_once drives list→watch; an expiry surfaces typed (the loop
+    relists on it), and the catch-up list after expiry pushes each event
+    exactly once."""
+    from kubetorch_tpu.controller.event_watcher import EventWatcher
+    from kubetorch_tpu.observability.log_sink import LogSink
+
+    sink = LogSink()
+    watcher = EventWatcher(sink, k8s_client=client,
+                           namespace="default",
+                           list_services=lambda: [])
+    fake.push_event("e1", uid="u1", message="one")
+    assert watcher.watch_once(timeout_seconds=1) == 1
+
+    # expiry mid-cycle: list catches up (pushes the new event), then the
+    # stream 410s and the typed error propagates for the loop to handle
+    fake.push_event("e2", uid="u2", message="two")
+    fake.expire_watches()
+    with pytest.raises(WatchExpiredError):
+        watcher.watch_once(timeout_seconds=1)
+    # the pre-expiry list already delivered e2 — a fresh cycle must not
+    # re-push it
+    assert watcher.watch_once(timeout_seconds=1) == 0
+    lines = [e["line"] for e in sink.query({"job": "kubetorch-events"})]
+    assert len(lines) == 2
+    assert len([ln for ln in lines if "two" in ln]) == 1
+
+
+@pytest.mark.level("unit")
+def test_watcher_loop_treats_expiry_as_routine(fake, client):
+    """The loop-level contract: WatchExpiredError does NOT count toward
+    the watch-failure fallback that degrades to polling."""
+    from kubetorch_tpu.controller.event_watcher import EventWatcher
+    from kubetorch_tpu.observability.log_sink import LogSink
+
+    watcher = EventWatcher(LogSink(), k8s_client=client,
+                           namespace="default", interval=0.01,
+                           list_services=lambda: [])
+    import threading
+
+    fake.expire_watches()
+    stop = threading.Event()
+    t = threading.Thread(target=watcher._loop, args=(stop,), daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.5)
+    stop.set()
+    t.join(5)
+    assert watcher._watch_ok, "410 expiry degraded the watcher to polling"

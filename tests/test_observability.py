@@ -8,6 +8,8 @@ controller-hosted so the loop closes in-process + over HTTP.
 
 import json
 import logging
+import os
+import re
 import threading
 import time
 import urllib.request
@@ -748,3 +750,147 @@ def test_controller_responsive_during_log_flood(tmp_path):
     finally:
         proc.terminate()
         proc.wait(5)
+
+
+# --------------------------------------------------------------- prometheus
+_EXPO_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*")*\})? -?[0-9.e+-]+$')
+
+
+def _assert_exposition_parses(text: str):
+    names = set()
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            assert len(parts) == 4 and parts[3] in ("gauge", "counter"), line
+            continue
+        assert _EXPO_LINE.match(line), f"bad exposition line: {line!r}"
+        names.add(line.split("{")[0].split(" ")[0])
+    return names
+
+
+@pytest.mark.level("unit")
+def test_prometheus_render_format():
+    from kubetorch_tpu.observability import prometheus as prom
+
+    text = prom.render([
+        ("http_requests_total", {"service": "a b", "pod": 'p"0'}, 3),
+        ("last_activity_timestamp", {"service": "a"}, 1.5),
+        ("weird name!", {}, 7),
+        ("hostname", {}, "not-a-number"),     # skipped
+        ("workers_healthy", {}, True),        # bool → 0/1
+    ])
+    names = _assert_exposition_parses(text)
+    assert "kubetorch_http_requests_total" in names
+    assert "kubetorch_weird_name_" in names
+    assert "kubetorch_workers_healthy" in names
+    assert "hostname" not in text
+    assert "# TYPE kubetorch_http_requests_total counter" in text
+    assert "# TYPE kubetorch_last_activity_timestamp gauge" in text
+
+
+@pytest.mark.level("unit")
+def test_metrics_store_prometheus_text():
+    store = MetricsStore()
+    store.push("svc-a", "pod-0", {
+        "http_requests_total": 10,
+        "last_activity_timestamp": 123.0,
+        "device_bytes_in_use": 5_000_000,
+    })
+    store.push("svc-b", "pod-1", {"http_requests_total": 2})
+    text = store.prometheus_text(
+        extra_samples=[("controller_pools", {}, 2)])
+    names = _assert_exposition_parses(text)
+    assert {"kubetorch_http_requests_total",
+            "kubetorch_device_bytes_in_use",
+            "kubetorch_metrics_age_seconds",
+            "kubetorch_controller_pools"} <= names
+    assert 'service="svc-a",pod="pod-0"' in text.replace(
+        'pod="pod-0",service="svc-a"', 'service="svc-a",pod="pod-0"')
+
+
+@pytest.mark.level("minimal")
+def test_controller_metrics_scrape_endpoint(tmp_path):
+    """GET /metrics on a live controller returns parseable exposition with
+    pushed pod metrics AND controller gauges (VERDICT r3 #5)."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.controller.server",
+         "--host", "127.0.0.1", "--port", str(port), "--db", ":memory:"],
+        env={**os.environ}, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    url = f"http://127.0.0.1:{port}"
+    try:
+        for _ in range(100):
+            try:
+                if httpx.get(f"{url}/health", timeout=2.0).status_code == 200:
+                    break
+            except httpx.HTTPError:
+                time.sleep(0.2)
+        httpx.post(f"{url}/metrics/push", json={
+            "service": "scrape-svc", "pod": "pod-0",
+            "metrics": {"http_requests_total": 4,
+                        "last_activity_timestamp": time.time()}})
+        resp = httpx.get(f"{url}/metrics", timeout=5.0,
+                         headers={"Accept": "text/plain;version=0.0.4"})
+        assert resp.status_code == 200
+        assert resp.headers["content-type"].startswith("text/plain")
+        names = _assert_exposition_parses(resp.text)
+        assert "kubetorch_http_requests_total" in names
+        assert "kubetorch_controller_pools" in names
+        assert 'service="scrape-svc"' in resp.text
+    finally:
+        proc.terminate()
+        proc.wait(5)
+
+
+@pytest.mark.level("minimal")
+def test_pod_metrics_content_negotiation(tmp_path):
+    """A pod's /metrics stays JSON for framework clients and turns into
+    Prometheus exposition when the scraper's Accept header asks."""
+    import socket
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    pod = subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.serving.server",
+         "--host", "127.0.0.1", "--port", str(port)],
+        env={**os.environ, "KT_SERVICE_NAME": "negsvc",
+             "KT_POD_NAME": "negsvc-0",
+             "KT_SERVER_PORT": str(port),
+             "PYTHONPATH": str(Path(__file__).resolve().parents[1])},
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    url = f"http://127.0.0.1:{port}"
+    try:
+        for _ in range(100):
+            try:
+                if httpx.get(f"{url}/health", timeout=2.0).status_code == 200:
+                    break
+            except httpx.HTTPError:
+                time.sleep(0.2)
+        as_json = httpx.get(f"{url}/metrics", timeout=5.0).json()
+        assert "http_requests_total" in as_json
+        resp = httpx.get(
+            f"{url}/metrics", timeout=5.0,
+            headers={"Accept": "application/openmetrics-text,"
+                               "text/plain;version=0.0.4"})
+        names = _assert_exposition_parses(resp.text)
+        assert "kubetorch_http_requests_total" in names
+        assert 'service="negsvc"' in resp.text and 'pod="negsvc-0"' in resp.text
+        # explicit opt-in works without the header too
+        resp2 = httpx.get(f"{url}/metrics?format=prometheus", timeout=5.0)
+        assert "kubetorch_http_requests_total" in resp2.text
+    finally:
+        pod.terminate()
+        pod.wait(5)
