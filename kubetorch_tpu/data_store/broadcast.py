@@ -472,7 +472,7 @@ def broadcast_get(store_backend, key: str, window: BroadcastWindow,
             serve_url = peer.url
     state = store_backend.bcast_join(
         group, key=key, member_id=mid, world_size=window.world_size,
-        fanout=window.fanout, lease=window.lease,
+        fanout=window.effective_fanout(), lease=window.lease,
         serve_url=serve_url, stream=bool(serve_url))
     # Poll fast while assignment is imminent, then back off: at large
     # world sizes with saturated fanout a flat 20ms is thousands of pure

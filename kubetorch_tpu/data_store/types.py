@@ -62,6 +62,21 @@ class BroadcastWindow:
     # KT_PEER_CACHE). Lets co-located members keep distinct caches — e.g.
     # the dataplane bench simulating one pod per worker.
     cache_root: Optional[str] = None
+    # Adaptive direct/tree policy: at or below this world size every
+    # member fetches straight from the store (effective fanout =
+    # world_size) — a relay tree only pays off once the egress saving
+    # beats the per-hop relay latency, measured ~4× egress at 8 peers vs
+    # a wall-clock loss at ≤4 (BASELINE.md broadcast rows). Set 0 to
+    # always build the tree.
+    direct_below: int = 4
+
+    def effective_fanout(self) -> int:
+        """Per-source child bound the coordinator should enforce for this
+        window: wide-open below the direct threshold, the configured tree
+        fanout above it."""
+        if self.direct_below and self.world_size <= self.direct_below:
+            return max(self.fanout, self.world_size)
+        return self.fanout
 
     def resolved_group(self, key: str) -> str:
         return self.group_id or f"bcast-{key.replace('/', '-')}"

@@ -549,6 +549,79 @@ def _cached_attn(q, ck, cv, mask, cfg: LlamaConfig):
     return out.reshape(B, T, H, D).astype(q.dtype)
 
 
+def _cached_attn_merged(q, gk, gv, ek, ev, gmask, emask, cfg: LlamaConfig):
+    """Attention over a read-only grid cache PLUS a small chunk cache,
+    without materializing their concatenation.
+
+    q: [B,T,H,D]; gk/gv: [B,M,Hkv,D] (grid); ek/ev: [B,K,Hkv,D] (chunk);
+    gmask: [B,T,M]; emask: [B,T,K]. Scores over both sources concatenate
+    (tiny: [B,Hkv,G,T,M+K] float32), one softmax spans them, and the two
+    value contractions sum — so the multi-GB grid is only ever *read*.
+    This is what lets rolling decode defer per-sequence cache writes to a
+    once-per-chunk merge instead of rewriting cache layers every step
+    (the one-hot write was ~2× the whole step at 8B serving scale).
+    """
+    B, T, H, D = q.shape
+    Hkv = gk.shape[2]
+    G = H // Hkv
+    qg = q.reshape(B, T, Hkv, G, D).astype(jnp.float32)
+    sg = jnp.einsum("btkgd,bmkd->bkgtm", qg,
+                    gk.astype(jnp.float32)) * (D ** -0.5)
+    se = jnp.einsum("btkgd,bmkd->bkgtm", qg,
+                    ek.astype(jnp.float32)) * (D ** -0.5)
+    sg = jnp.where(gmask[:, None, None, :, :], sg, -1e30)
+    se = jnp.where(emask[:, None, None, :, :], se, -1e30)
+    p = jax.nn.softmax(jnp.concatenate([sg, se], axis=-1), axis=-1)
+    M = gk.shape[1]
+    out = (jnp.einsum("bkgtm,bmkd->btkgd", p[..., :M],
+                      gv.astype(jnp.float32))
+           + jnp.einsum("bkgtm,bmkd->btkgd", p[..., M:],
+                        ev.astype(jnp.float32)))
+    return out.reshape(B, T, H, D).astype(q.dtype)
+
+
+def _block_cached_chunk(x, layer, li, sin, cos, gk_all, gv_all, ek_all,
+                        ev_all, col, gmask, emask, cfg: LlamaConfig,
+                        rules: ShardingRules):
+    """Chunk-mode decoder block: the stacked grid caches are READ-ONLY;
+    this step's K/V lands at uniform column ``col`` of the small stacked
+    chunk caches (a plain dynamic-update-slice — no per-sequence offsets,
+    so no full-layer rewrite), and attention merges grid + chunk."""
+    dt = cfg.compute_dtype
+    B, T, E = x.shape
+    H, Hkv, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+    if "wqkv" in layer:
+        qkv = _proj(h, layer, "wqkv", dt)
+        q, k, v = jnp.split(qkv, [H * D, H * D + Hkv * D], axis=-1)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, Hkv, D)
+        v = v.reshape(B, T, Hkv, D)
+    else:
+        q = _proj(h, layer, "wq", dt).reshape(B, T, H, D)
+        k = _proj(h, layer, "wk", dt).reshape(B, T, Hkv, D)
+        v = _proj(h, layer, "wv", dt).reshape(B, T, Hkv, D)
+    q = apply_rope(q, None, cfg.rope_theta, sin=sin, cos=cos)
+    k = apply_rope(k, None, cfg.rope_theta, sin=sin, cos=cos)
+
+    cdt = ek_all.dtype
+    ek_all = jax.lax.dynamic_update_slice(
+        ek_all, k.astype(cdt)[None], (li, 0, col, 0, 0))
+    ev_all = jax.lax.dynamic_update_slice(
+        ev_all, v.astype(cdt)[None], (li, 0, col, 0, 0))
+    gk = jax.lax.dynamic_index_in_dim(gk_all, li, 0, keepdims=False)
+    gv = jax.lax.dynamic_index_in_dim(gv_all, li, 0, keepdims=False)
+    ek = jax.lax.dynamic_index_in_dim(ek_all, li, 0, keepdims=False)
+    ev = jax.lax.dynamic_index_in_dim(ev_all, li, 0, keepdims=False)
+
+    attn = _cached_attn_merged(q, gk, gv, ek, ev, gmask, emask,
+                               cfg).reshape(B, T, H * D)
+    x = x + _proj(attn, layer, "wo", dt)
+    x = x + _mlp(x, layer, cfg, rules)
+    return x, ek_all, ev_all
+
+
 def _block_cached(x, layer, li, sin, cos, ck_all, cv_all, write_at, mask,
                   cfg: LlamaConfig, rules: ShardingRules):
     """One decoder block in cache mode, updating the stacked ``[L, ...]``
@@ -636,6 +709,9 @@ def forward_cached(
     cfg: LlamaConfig,
     rules: Optional[ShardingRules] = None,
     unembed_positions: Optional[jax.Array] = None,  # [B] — logits only there
+    chunk: Optional[Dict[str, jax.Array]] = None,   # [L,B,K,Hkv,D] stacked
+    chunk_col=None,                                 # scalar: uniform column
+    chunk_mask: Optional[jax.Array] = None,         # [B, T, K] bool
 ):
     """Forward with KV cache → (logits [B, T, V] float32, new cache).
 
@@ -644,24 +720,47 @@ def forward_cached(
     real token's logits; materializing [B, P, V] float32 there is pure HBM
     waste (4.2 GB at B=64, P=128, V=128k — an OOM on a 16 GB chip that
     never needed to happen).
+
+    ``chunk`` mode (rolling decode): ``cache`` is READ-ONLY and this
+    step's K/V is written at the uniform ``chunk_col`` of the small
+    stacked chunk caches; attention spans grid (under ``mask``) plus
+    chunk (under ``chunk_mask``). The returned cache dict is the updated
+    CHUNK, not the grid — the caller merges it into the grid once per
+    decode chunk (``RollingGenerator._decode_impl``). This exists because
+    per-sequence grid writes rewrite whole cache layers every step.
     """
     rules = rules or ShardingRules.default()
     dt = cfg.compute_dtype
     x = params["embedding"].astype(dt)[tokens]
     sin, cos = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
-
-    def scan_body(carry, inp):
-        x, ck_all, cv_all = carry
-        layer, li = inp
-        x, ck_all, cv_all = _block_cached(x, layer, li, sin, cos,
-                                          ck_all, cv_all,
-                                          write_at, mask, cfg, rules)
-        return (x, ck_all, cv_all), None
-
     n_layers = cache["k"].shape[0]
-    (x, new_k, new_v), _ = jax.lax.scan(
-        scan_body, (x, cache["k"], cache["v"]),
-        (params["layers"], jnp.arange(n_layers)))
+
+    if chunk is not None:
+        grid_k, grid_v = cache["k"], cache["v"]
+
+        def scan_chunk(carry, inp):
+            x, ek_all, ev_all = carry
+            layer, li = inp
+            x, ek_all, ev_all = _block_cached_chunk(
+                x, layer, li, sin, cos, grid_k, grid_v, ek_all, ev_all,
+                chunk_col, mask, chunk_mask, cfg, rules)
+            return (x, ek_all, ev_all), None
+
+        (x, new_k, new_v), _ = jax.lax.scan(
+            scan_chunk, (x, chunk["k"], chunk["v"]),
+            (params["layers"], jnp.arange(n_layers)))
+    else:
+        def scan_body(carry, inp):
+            x, ck_all, cv_all = carry
+            layer, li = inp
+            x, ck_all, cv_all = _block_cached(x, layer, li, sin, cos,
+                                              ck_all, cv_all,
+                                              write_at, mask, cfg, rules)
+            return (x, ck_all, cv_all), None
+
+        (x, new_k, new_v), _ = jax.lax.scan(
+            scan_body, (x, cache["k"], cache["v"]),
+            (params["layers"], jnp.arange(n_layers)))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     if unembed_positions is not None:
         x = jnp.take_along_axis(x, unembed_positions[:, None, None], axis=1)

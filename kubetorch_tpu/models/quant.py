@@ -109,11 +109,14 @@ def quantize_params(params: Dict[str, Any],
     return out
 
 
-def quantized_logical_axes(cfg, base: Optional[Dict[str, Any]] = None):
+def quantized_logical_axes(cfg, base: Optional[Dict[str, Any]] = None,
+                           quantize_unembed: bool = False):
     """Logical-axis tree matching :func:`quantize_params` output.
 
     Scales keep the layer axis and replicate the rest (they are ~1/in_dim
-    the weight's size — sharding them buys nothing).
+    the weight's size — sharding them buys nothing). ``quantize_unembed``
+    must match the value passed to :func:`quantize_params` — it decides
+    whether the tree carries lm_head/unembed scale entries at all.
     """
     from kubetorch_tpu.models import llama
 
@@ -126,11 +129,12 @@ def quantized_logical_axes(cfg, base: Optional[Dict[str, Any]] = None):
         layers[name + "_scale"] = ("layer",) + (None,) * (len(w_axes) - 1)
     out = dict(axes)
     out["layers"] = layers
-    if "lm_head" in out:
-        out["lm_head_scale"] = (None, None)
-    else:
-        out["unembed_q"] = ("embed_fsdp", "vocab")
-        out["unembed_scale"] = (None, None)
+    if quantize_unembed:
+        if "lm_head" in out:
+            out["lm_head_scale"] = (None, None)
+        else:
+            out["unembed_q"] = ("embed_fsdp", "vocab")
+            out["unembed_scale"] = (None, None)
     return out
 
 

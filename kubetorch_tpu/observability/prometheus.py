@@ -107,8 +107,12 @@ def wants_prometheus(request) -> bool:
     """Content negotiation for a shared /metrics route: Prometheus sends
     ``Accept: application/openmetrics-text, text/plain;version=0.0.4``;
     the framework's own JSON clients send ``*/*`` (or ask explicitly with
-    ``?format=prometheus``)."""
+    ``?format=prometheus``). A client that lists ``application/json``
+    keeps JSON even if a generic ``text/plain`` trails it (axios-style
+    default Accept headers name both)."""
     if request.query.get("format") == "prometheus":
         return True
     accept = request.headers.get("Accept", "")
-    return "text/plain" in accept or "openmetrics" in accept
+    if "openmetrics" in accept:
+        return True
+    return "text/plain" in accept and "application/json" not in accept
