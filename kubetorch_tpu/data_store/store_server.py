@@ -169,7 +169,15 @@ class StoreServer:
         size = 0
         try:
             with open(tmp, "wb") as fh:
-                async for chunk in request.content.iter_chunked(4 << 20):
+                # readany(): write whatever the parser has buffered —
+                # iter_chunked would re-slice/copy into fixed 4MB pieces
+                # first. On the upload path every copy is CPU the GET
+                # side's sendfile never pays; this is the cheap half of
+                # closing the PUT/GET asymmetry.
+                while True:
+                    chunk = await request.content.readany()
+                    if not chunk:
+                        break
                     size += len(chunk)
                     if size > limit:
                         raise web.HTTPRequestEntityTooLarge(
