@@ -149,6 +149,25 @@ def list_cmd():
 
 
 @main.command()
+@click.option("--host", default="127.0.0.1")
+@click.option("--port", type=int, default=0, help="0 = pick a free port")
+@click.option("--no-browser", is_flag=True)
+def dashboard(host, port, no_browser):
+    """Serve a live status page over the controller API (services, runs,
+    metrics, recent logs). Needs KT_CONTROLLER_URL (reference parity: the
+    hidden `kt dashboard`; Grafana via the chart is the production path)."""
+    from kubetorch_tpu.controller.client import ControllerClient
+    from kubetorch_tpu.dashboard import serve
+
+    controller = ControllerClient.maybe()
+    if controller is None:
+        raise click.ClickException(
+            "no controller reachable — set KT_CONTROLLER_URL (see "
+            "`ktpu port-forward`)")
+    serve(controller, host=host, port=port, open_browser=not no_browser)
+
+
+@main.command()
 @click.argument("service")
 def describe(service):
     """Describe a deployed service."""

@@ -142,6 +142,20 @@ class ControllerClient:
         return bool(self._check(self.client.delete(
             f"{self.base_url}/runs/{run_id}"))["deleted"])
 
+    # ---------------------------------------------------- observability
+    def query_metrics(self, service: str) -> Dict[str, Any]:
+        """Latest per-pod metric snapshots + last activity for a service
+        (the MetricsStore's JSON view; /metrics is the Prom exposition)."""
+        return self._check(self.client.get(
+            f"{self.base_url}/metrics/query/{service}")) or {}
+
+    def query_logs(self, labels: Optional[Dict[str, str]] = None,
+                   limit: int = 200) -> List[Dict[str, Any]]:
+        params: Dict[str, Any] = {"limit": limit, **(labels or {})}
+        return (self._check(self.client.get(
+            f"{self.base_url}/logs/query", params=params))
+                or {}).get("entries") or []
+
     # ------------------------------------------------------------- k8s
     # Generic passthrough over the controller's dynamic-client proxy
     # (server.py h_k8s_*; responses wrap the op result as {"result": ...}).

@@ -1,0 +1,190 @@
+"""``ktpu dashboard`` — a local live status page over the controller API.
+
+Reference: the hidden ``kt dashboard`` command
+(``python_client/kubetorch/cli_utils.py`` ``load_runhouse_dashboard``)
+opens a hosted web dashboard; this build serves a single-file page from
+the CLI itself — no hosted service, works against any reachable
+controller (port-forwarded or in-cluster), and reads only existing
+endpoints: ``/pools``, ``/metrics/query/{service}``, ``/runs``,
+``/logs/query``. Grafana (charts/kubetorch-tpu/dashboards/) is the
+production monitoring story; this is the zero-install one.
+"""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>kubetorch-tpu</title>
+<style>
+ body { font-family: ui-monospace, SFMono-Regular, Menlo, monospace;
+        margin: 2rem; background: #111; color: #ddd; }
+ h1 { font-size: 1.1rem; } h2 { font-size: 0.95rem; color: #9ad; }
+ table { border-collapse: collapse; width: 100%; margin-bottom: 1.5rem; }
+ th, td { text-align: left; padding: 0.25rem 0.75rem;
+          border-bottom: 1px solid #333; font-size: 0.85rem; }
+ th { color: #888; font-weight: normal; }
+ .ok { color: #7c6; } .warn { color: #ec5; } .err { color: #e66; }
+ #log { white-space: pre-wrap; font-size: 0.8rem; color: #aaa;
+        max-height: 20rem; overflow-y: auto; border: 1px solid #333;
+        padding: 0.5rem; }
+</style></head><body>
+<h1>kubetorch-tpu <span id="ctl" class="warn">connecting…</span></h1>
+<h2>Services</h2>
+<table id="pools"><tr><th>service</th><th>pods</th><th>last activity</th>
+<th>requests</th><th>errors</th><th>TPU HBM</th></tr></table>
+<h2>Runs</h2>
+<table id="runs"><tr><th>id</th><th>status</th><th>created</th>
+<th>note</th></tr></table>
+<h2>Recent events & logs</h2>
+<div id="log"></div>
+<script>
+const fmtAge = (ts) => {
+  if (!ts) return "—";
+  const s = Math.max(0, Date.now() / 1000 - ts);
+  return s < 90 ? `${s.toFixed(0)}s ago` : s < 5400 ?
+    `${(s / 60).toFixed(0)}m ago` : `${(s / 3600).toFixed(1)}h ago`;
+};
+const fmtB = (b) => b > 1e9 ? `${(b / 1e9).toFixed(1)}G` :
+  b > 1e6 ? `${(b / 1e6).toFixed(0)}M` : `${b}`;
+async function tick() {
+  try {
+    const data = await (await fetch("data")).json();
+    document.getElementById("ctl").textContent =
+      `controller ${data.controller} · v${data.version}`;
+    document.getElementById("ctl").className = "ok";
+    const pools = document.getElementById("pools");
+    while (pools.rows.length > 1) pools.deleteRow(1);
+    for (const p of data.pools) {
+      const r = pools.insertRow();
+      const m = p.metrics || {};
+      r.insertCell().textContent = p.service;
+      r.insertCell().textContent = p.pods;
+      r.insertCell().textContent = fmtAge(m.last_activity_timestamp);
+      r.insertCell().textContent = m.http_requests_total ?? "—";
+      const e = r.insertCell();
+      e.textContent = m.http_request_errors_total ?? "—";
+      if (m.http_request_errors_total > 0) e.className = "err";
+      r.insertCell().textContent = m.device_bytes_in_use
+        ? `${fmtB(m.device_bytes_in_use)}/${fmtB(m.device_bytes_limit)}`
+        : "—";
+    }
+    const runs = document.getElementById("runs");
+    while (runs.rows.length > 1) runs.deleteRow(1);
+    for (const run of data.runs.slice(0, 12)) {
+      const r = runs.insertRow();
+      r.insertCell().textContent = run.run_id ?? run.id;
+      const st = r.insertCell();
+      st.textContent = run.status;
+      st.className = run.status === "failed" ? "err" :
+        run.status === "running" ? "warn" : "ok";
+      r.insertCell().textContent = run.created_at ?? "";
+      r.insertCell().textContent = (run.notes || []).slice(-1)[0] ?? "";
+    }
+    document.getElementById("log").textContent =
+      data.logs.map(l => l.line).join("\\n");
+  } catch (err) {
+    document.getElementById("ctl").textContent = `error: ${err}`;
+    document.getElementById("ctl").className = "err";
+  }
+  // chain, don't overlap: a slow controller must not pile up fetches
+  setTimeout(tick, 3000);
+}
+tick();
+</script></body></html>
+"""
+
+
+def build_app(controller) -> web.Application:
+    """``controller``: a ControllerClient. One page + one JSON feed."""
+
+    async def page(request):
+        return web.Response(text=_PAGE, content_type="text/html")
+
+    async def data(request):
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+
+        def gather():
+            out = {"controller": controller.base_url, "version": "?",
+                   "pools": [], "runs": [], "logs": []}
+            try:
+                health = controller.health()
+                out["version"] = health.get("version", "?")
+            except Exception:
+                pass
+            try:
+                for pool in controller.list_pools():
+                    service = pool.get("service_name", "")
+                    entry = {"service": service,
+                             "pods": pool.get("num_pods", ""),
+                             "metrics": {}}
+                    try:
+                        snaps = controller.query_metrics(service)
+                        # Aggregate across pods: counters/bytes SUM
+                        # (last-pod-wins would hide another pod's
+                        # errors); timestamps take the freshest.
+                        merged: dict = {}
+                        for snap in (snaps.get("pods") or {}).values():
+                            for k, v in (snap.get("metrics") or {}).items():
+                                if not isinstance(v, (int, float)) or \
+                                        isinstance(v, bool):
+                                    merged.setdefault(k, v)
+                                elif k.endswith("timestamp"):
+                                    merged[k] = max(merged.get(k, 0), v)
+                                else:
+                                    merged[k] = merged.get(k, 0) + v
+                        if snaps.get("last_activity"):
+                            merged["last_activity_timestamp"] = \
+                                snaps["last_activity"]
+                        entry["metrics"] = merged
+                    except Exception:
+                        pass
+                    out["pools"].append(entry)
+            except Exception:
+                pass
+            try:
+                out["runs"] = controller.list_runs()
+            except Exception:
+                pass
+            try:
+                out["logs"] = controller.query_logs({}, limit=60)
+            except Exception:
+                pass
+            return out
+
+        return web.json_response(await loop.run_in_executor(None, gather))
+
+    app = web.Application()
+    app.router.add_get("/", page)
+    app.router.add_get("/data", data)
+    return app
+
+
+def serve(controller, host: str = "127.0.0.1", port: int = 0,
+          open_browser: bool = True) -> None:
+    """Run the dashboard server (blocks). Prints the URL; optionally opens
+    the local browser like the reference's ``kt dashboard`` did."""
+    import socket
+
+    # bind ONCE and hand the listening socket to aiohttp: probe-then-
+    # rebind races another process onto the port, and a browser opened
+    # against an already-listening socket just waits in the backlog
+    # instead of getting connection-refused
+    sock = socket.socket()
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind((host, port))
+    sock.listen(128)
+    url = f"http://{host}:{sock.getsockname()[1]}/"
+    print(f"dashboard: {url}  (Ctrl-C to stop)")
+    if open_browser:
+        try:
+            import webbrowser
+
+            webbrowser.open(url)
+        except Exception:
+            pass
+    web.run_app(build_app(controller), sock=sock, print=None)

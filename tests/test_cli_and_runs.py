@@ -128,3 +128,85 @@ def test_run_failure_status(tmp_path):
     finally:
         os.chdir(old)
     assert result.exit_code == 3
+
+
+def test_dashboard_serves_pools_and_logs(monkeypatch):
+    """`ktpu dashboard` page + JSON feed against a live controller
+    (reference parity: the hidden `kt dashboard`)."""
+    import socket
+    import subprocess
+    import sys
+    import threading
+    import time
+
+    import httpx
+
+    from kubetorch_tpu.controller.client import ControllerClient
+    from kubetorch_tpu.dashboard import build_app
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    cport = free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.controller.server",
+         "--host", "127.0.0.1", "--port", str(cport), "--db", ":memory:"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    url = f"http://127.0.0.1:{cport}"
+    try:
+        for _ in range(100):
+            try:
+                if httpx.get(f"{url}/health", timeout=2).status_code == 200:
+                    break
+            except httpx.HTTPError:
+                pass
+            time.sleep(0.2)
+        else:
+            raise RuntimeError("controller did not become healthy")
+        httpx.post(f"{url}/pool", json={
+            "service_name": "dash-svc", "num_pods": 2,
+            "module_meta": {}, "compute": {}})
+        httpx.post(f"{url}/metrics/push", json={
+            "service": "dash-svc", "pod": "p0",
+            "metrics": {"http_requests_total": 3,
+                        "last_activity_timestamp": time.time()}})
+        httpx.post(f"{url}/logs/push", json={"entries": [
+            {"line": "dash hello", "labels": {"service": "dash-svc"}}]})
+
+        from aiohttp import web as _web
+        import asyncio
+
+        app = build_app(ControllerClient(url))
+        dport = free_port()
+        loop = asyncio.new_event_loop()
+
+        def run():
+            asyncio.set_event_loop(loop)
+            runner = _web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            site = _web.TCPSite(runner, "127.0.0.1", dport)
+            loop.run_until_complete(site.start())
+            loop.run_forever()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        page = None
+        for _ in range(50):
+            try:
+                page = httpx.get(f"http://127.0.0.1:{dport}/", timeout=2)
+                break
+            except httpx.HTTPError:
+                time.sleep(0.1)
+        assert page is not None, "dashboard never came up"
+        assert "kubetorch-tpu" in page.text
+        data = httpx.get(f"http://127.0.0.1:{dport}/data", timeout=10).json()
+        assert any(p["service"] == "dash-svc" and
+                   p["metrics"].get("http_requests_total") == 3
+                   for p in data["pools"])
+        assert any("dash hello" in entry["line"] for entry in data["logs"])
+        loop.call_soon_threadsafe(loop.stop)
+    finally:
+        proc.terminate()
+        proc.wait(5)
