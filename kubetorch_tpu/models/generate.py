@@ -89,15 +89,21 @@ class Generator:
 
     def __init__(self, params: Dict[str, Any], cfg: LlamaConfig,
                  mesh=None, rules: Optional[ShardingRules] = None,
-                 pad_id: int = 0):
+                 pad_id: int = 0, kv_dtype: str = "bf16"):
+        """``kv_dtype="int8"``: per-vector-quantized KV cache — halves
+        the decode's cache stream and residency (the batch ceiling moves
+        up accordingly); greedy outputs are near-identical to the bf16
+        cache (argmax flips on near-ties only — pinned in tests)."""
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
         self.rules = rules or ShardingRules.default()
         self.pad_id = pad_id
+        self.kv_quantized = kv_dtype == "int8"
         self._prefill = jax.jit(
-            partial(self._prefill_impl, cfg=cfg, rules=self.rules),
-            static_argnames=("max_len",))
+            partial(self._prefill_impl, cfg=cfg, rules=self.rules,
+                    quantized=self.kv_quantized),
+            static_argnames=("max_len", "quantized"))
         # note: no cache donation — the decode returns only tokens, so XLA
         # has no same-shaped output to alias the donated buffer to.
         self._decode = jax.jit(
@@ -107,14 +113,15 @@ class Generator:
 
     # -------------------------------------------------------------- impl
     @staticmethod
-    def _prefill_impl(params, tokens, prompt_lens, *, max_len, cfg, rules):
+    def _prefill_impl(params, tokens, prompt_lens, *, max_len, cfg, rules,
+                      quantized=False):
         B, P = tokens.shape
         positions = jnp.broadcast_to(jnp.arange(P)[None, :], (B, P))
         # causal over the prompt region; pad queries produce unused rows.
         m = jnp.arange(max_len)[None, None, :]
         t = jnp.arange(P)[None, :, None]
         mask = (m <= t) & (m < prompt_lens[:, None, None])
-        cache = llama.init_cache(cfg, B, max_len)
+        cache = llama.init_cache(cfg, B, max_len, quantized=quantized)
         # next-token logits at each sequence's last real token only — the
         # full [B, P, V] logits would be GBs of HBM at 128k vocab.
         logits, cache = llama.forward_cached(

@@ -518,16 +518,65 @@ def forward_pipeline(
 # --------------------------------------------------------------------------
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
-               dtype=None) -> Dict[str, jax.Array]:
+               dtype=None, quantized: bool = False) -> Dict[str, jax.Array]:
     """Preallocated KV cache: ``{"k","v"}`` of [L, B, max_len, Hkv, D].
 
     Static shapes — the decode step compiles once and runs for any sequence
     shorter than ``max_len``. The reference has no inference path at all
     (orchestration only); on TPU the framework owns it (BASELINE #5 rollouts).
+
+    ``quantized=True``: int8 K/V with per-vector float32 absmax scales
+    (``"ks"``/``"vs"`` of [L, B, max_len, Hkv] — one scale per head-vector,
+    1.6% overhead at D=128). Halves the KV stream AND residency; the
+    dequant folds into the attention einsums exactly like the int8 weight
+    path (scale is per key row, so ``scores·scale`` and ``(p·scale)·V``
+    are algebraically exact factorizations — see ``_cached_attn_q``).
     """
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    if quantized:
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "ks": jnp.zeros(shape[:-1], jnp.float32),
+                "vs": jnp.zeros(shape[:-1], jnp.float32)}
     dt = jnp.dtype(dtype) if dtype is not None else cfg.compute_dtype
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _kv_quantize(x: jax.Array):
+    """[B, T, Hkv, D] → (int8 same shape, f32 scale [B, T, Hkv]):
+    symmetric per-head-vector absmax."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def _cached_attn_q(q, ck, cv, ks, vs, mask, cfg: LlamaConfig):
+    """Quantized-KV attention: ck/cv int8 [B,M,Hkv,D], ks/vs f32
+    [B,M,Hkv]. The int8→f32 convert fuses into the einsum operand read
+    (the property the int8 weight path measured at 583 GB/s); scales
+    apply per key row AFTER the contraction (K side) and fold into the
+    probabilities BEFORE it (V side) — both exact."""
+    B, T, H, D = q.shape
+    Hkv = ck.shape[2]
+    G = H // Hkv
+    qg = q.reshape(B, T, Hkv, G, D)
+    # int8 operands converted to bf16 (not f32) with f32 accumulation:
+    # the convert then fuses into the contraction's operand read the same
+    # way the int8 weight einsums do — an f32 cast materializes a
+    # 4×-the-cache copy per step instead.
+    s = jnp.einsum("btkgd,bmkd->bkgtm", qg.astype(jnp.bfloat16),
+                   ck.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32) * (D ** -0.5)
+    s = s * ks.transpose(0, 2, 1)[:, :, None, None, :]      # [B,Hkv,1,1,M]
+    s = jnp.where(mask[:, None, None, :, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    p = p * vs.transpose(0, 2, 1)[:, :, None, None, :]
+    out = jnp.einsum("bkgtm,bmkd->btkgd", p.astype(jnp.bfloat16),
+                     cv.astype(jnp.bfloat16),
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, T, H, D).astype(q.dtype)
 
 
 def _cached_attn(q, ck, cv, mask, cfg: LlamaConfig):
@@ -588,22 +637,9 @@ def _block_cached_chunk(x, layer, li, sin, cos, gk_all, gv_all, ek_all,
     chunk caches (a plain dynamic-update-slice — no per-sequence offsets,
     so no full-layer rewrite), and attention merges grid + chunk."""
     dt = cfg.compute_dtype
-    B, T, E = x.shape
-    H, Hkv, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-
-    h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-    if "wqkv" in layer:
-        qkv = _proj(h, layer, "wqkv", dt)
-        q, k, v = jnp.split(qkv, [H * D, H * D + Hkv * D], axis=-1)
-        q = q.reshape(B, T, H, D)
-        k = k.reshape(B, T, Hkv, D)
-        v = v.reshape(B, T, Hkv, D)
-    else:
-        q = _proj(h, layer, "wq", dt).reshape(B, T, H, D)
-        k = _proj(h, layer, "wk", dt).reshape(B, T, Hkv, D)
-        v = _proj(h, layer, "wv", dt).reshape(B, T, Hkv, D)
-    q = apply_rope(q, None, cfg.rope_theta, sin=sin, cos=cos)
-    k = apply_rope(k, None, cfg.rope_theta, sin=sin, cos=cos)
+    B, T, _ = x.shape
+    H, D = cfg.n_heads, cfg.head_dim
+    q, k, v = _qkv_proj(x, layer, sin, cos, cfg)
 
     cdt = ek_all.dtype
     ek_all = jax.lax.dynamic_update_slice(
@@ -620,6 +656,63 @@ def _block_cached_chunk(x, layer, li, sin, cos, gk_all, gv_all, ek_all,
     x = x + _proj(attn, layer, "wo", dt)
     x = x + _mlp(x, layer, cfg, rules)
     return x, ek_all, ev_all
+
+
+def _qkv_proj(x, layer, sin, cos, cfg: LlamaConfig):
+    """Norm → QKV projection (fused ``wqkv`` serving layout or separate
+    weights) → RoPE. The shared front half of every cached decoder-block
+    variant — bf16 grid, chunk-mode, and quantized-cache — so a layout
+    change can't silently diverge them."""
+    dt = cfg.compute_dtype
+    B, T, _ = x.shape
+    H, Hkv, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+    if "wqkv" in layer:
+        qkv = _proj(h, layer, "wqkv", dt)
+        q, k, v = jnp.split(qkv, [H * D, H * D + Hkv * D], axis=-1)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, Hkv, D)
+        v = v.reshape(B, T, Hkv, D)
+    else:
+        q = _proj(h, layer, "wq", dt).reshape(B, T, H, D)
+        k = _proj(h, layer, "wk", dt).reshape(B, T, Hkv, D)
+        v = _proj(h, layer, "wv", dt).reshape(B, T, Hkv, D)
+    q = apply_rope(q, None, cfg.rope_theta, sin=sin, cos=cos)
+    k = apply_rope(k, None, cfg.rope_theta, sin=sin, cos=cos)
+    return q, k, v
+
+
+def _block_cached_q(x, layer, li, sin, cos, ck_all, cv_all, ks_all, vs_all,
+                    write_at, mask, cfg: LlamaConfig, rules: ShardingRules):
+    """Decoder block over a QUANTIZED cache (int8 K/V + per-vector
+    scales). Scalar ``write_at`` only (the static Generator's uniform
+    slots — rolling keeps bf16 for now): this step's K/V quantize on
+    write, attention dequants via scale folding."""
+    dt = cfg.compute_dtype
+    B, T, _ = x.shape
+    H, D = cfg.n_heads, cfg.head_dim
+    q, k, v = _qkv_proj(x, layer, sin, cos, cfg)
+
+    kq, kscale = _kv_quantize(k)
+    vq, vscale = _kv_quantize(v)
+    ck_all = jax.lax.dynamic_update_slice(
+        ck_all, kq[None], (li, 0, write_at, 0, 0))
+    cv_all = jax.lax.dynamic_update_slice(
+        cv_all, vq[None], (li, 0, write_at, 0, 0))
+    ks_all = jax.lax.dynamic_update_slice(
+        ks_all, kscale[None], (li, 0, write_at, 0))
+    vs_all = jax.lax.dynamic_update_slice(
+        vs_all, vscale[None], (li, 0, write_at, 0))
+    ck = jax.lax.dynamic_index_in_dim(ck_all, li, 0, keepdims=False)
+    cv = jax.lax.dynamic_index_in_dim(cv_all, li, 0, keepdims=False)
+    ks = jax.lax.dynamic_index_in_dim(ks_all, li, 0, keepdims=False)
+    vs = jax.lax.dynamic_index_in_dim(vs_all, li, 0, keepdims=False)
+
+    attn = _cached_attn_q(q, ck, cv, ks, vs, mask, cfg).reshape(B, T, H * D)
+    x = x + _proj(attn, layer, "wo", dt)
+    x = x + _mlp(x, layer, cfg, rules)
+    return x, ck_all, cv_all, ks_all, vs_all
 
 
 def _block_cached(x, layer, li, sin, cos, ck_all, cv_all, write_at, mask,
@@ -639,24 +732,9 @@ def _block_cached(x, layer, li, sin, cos, ck_all, cv_all, write_at, mask,
     place under the compiled while loop.
     """
     dt = cfg.compute_dtype
-    B, T, E = x.shape
-    H, Hkv, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-
-    h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-    if "wqkv" in layer:
-        # serving layout (quant.fuse_decode_layers): one weight stream for
-        # q, k and v instead of three kernel launches per layer
-        qkv = _proj(h, layer, "wqkv", dt)
-        q, k, v = jnp.split(qkv, [H * D, H * D + Hkv * D], axis=-1)
-        q = q.reshape(B, T, H, D)
-        k = k.reshape(B, T, Hkv, D)
-        v = v.reshape(B, T, Hkv, D)
-    else:
-        q = _proj(h, layer, "wq", dt).reshape(B, T, H, D)
-        k = _proj(h, layer, "wk", dt).reshape(B, T, Hkv, D)
-        v = _proj(h, layer, "wv", dt).reshape(B, T, Hkv, D)
-    q = apply_rope(q, None, cfg.rope_theta, sin=sin, cos=cos)
-    k = apply_rope(k, None, cfg.rope_theta, sin=sin, cos=cos)
+    B, T, _ = x.shape
+    H, D = cfg.n_heads, cfg.head_dim
+    q, k, v = _qkv_proj(x, layer, sin, cos, cfg)
 
     cdt = ck_all.dtype
     if jnp.ndim(write_at) == 0:
@@ -734,6 +812,33 @@ def forward_cached(
     x = params["embedding"].astype(dt)[tokens]
     sin, cos = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
     n_layers = cache["k"].shape[0]
+
+    if "ks" in cache:
+        # quantized cache (int8 + per-vector scales): scalar write_at
+        # (static Generator path)
+        assert chunk is None, (
+            "chunk-mode decode over a quantized cache is not supported "
+            "(RollingGenerator keeps a bf16 grid) — silently dropping "
+            "the chunk write would corrupt generation")
+
+        def scan_q(carry, inp):
+            x, ck_all, cv_all, ks_all, vs_all = carry
+            layer, li = inp
+            x, ck_all, cv_all, ks_all, vs_all = _block_cached_q(
+                x, layer, li, sin, cos, ck_all, cv_all, ks_all, vs_all,
+                write_at, mask, cfg, rules)
+            return (x, ck_all, cv_all, ks_all, vs_all), None
+
+        (x, new_k, new_v, new_ks, new_vs), _ = jax.lax.scan(
+            scan_q, (x, cache["k"], cache["v"], cache["ks"], cache["vs"]),
+            (params["layers"], jnp.arange(n_layers)))
+        x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+        if unembed_positions is not None:
+            x = jnp.take_along_axis(
+                x, unembed_positions[:, None, None], axis=1)
+        logits = jnp.einsum("bse,ev->bsv", x, unembedding(params, cfg))
+        return logits.astype(jnp.float32), {
+            "k": new_k, "v": new_v, "ks": new_ks, "vs": new_vs}
 
     if chunk is not None:
         grid_k, grid_v = cache["k"], cache["v"]
