@@ -30,6 +30,27 @@ def _free_port():
         return s.getsockname()[1]
 
 
+def _wait_health(base: str, proc=None, attempts: int = 200) -> None:
+    """Poll {base}/health until 200. Fails FAST on a dead subprocess
+    (connection-refused is instant — spinning the full window hides the
+    real error) and raises on timeout instead of falling through to a
+    confusing downstream failure. Window sized for the 1-CPU box under
+    xdist: each spawned interpreter pays ~2s of site-level imports while
+    sharing the core with 3 other workers."""
+    for _ in range(attempts):
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(
+                f"server process exited rc={proc.returncode} before "
+                f"{base}/health answered")
+        try:
+            if httpx.get(f"{base}/health", timeout=2.0).status_code == 200:
+                return
+        except httpx.HTTPError:
+            pass
+        time.sleep(0.2)
+    raise RuntimeError(f"{base}/health never answered 200")
+
+
 @pytest.fixture(scope="module")
 def controller(tmp_path_factory):
     port = _free_port()
@@ -40,15 +61,11 @@ def controller(tmp_path_factory):
          "--reaper-interval", "1.0"],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
     url = f"http://127.0.0.1:{port}"
-    for _ in range(100):
-        try:
-            if httpx.get(f"{url}/health", timeout=2.0).status_code == 200:
-                break
-        except httpx.HTTPError:
-            time.sleep(0.2)
-    else:
+    try:
+        _wait_health(url, proc)
+    except RuntimeError:
         proc.kill()
-        raise RuntimeError("controller did not start")
+        raise
     yield url
     proc.terminate()
     proc.wait(5)
@@ -124,12 +141,7 @@ def test_pod_ws_register_push_reload_and_ack(controller, client, tmp_path):
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
     try:
         url = f"http://127.0.0.1:{port}"
-        for _ in range(100):
-            try:
-                if httpx.get(f"{url}/health", timeout=2.0).status_code == 200:
-                    break
-            except httpx.HTTPError:
-                time.sleep(0.2)
+        _wait_health(url, proc)
         # pod should appear as waiting on the controller
         for _ in range(150):
             health = client.health()
@@ -203,12 +215,7 @@ class TestAuth:
              "--host", "127.0.0.1", "--port", str(port), "--db", ":memory:"],
             env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
         base = f"http://127.0.0.1:{port}"
-        for _ in range(50):
-            try:
-                httpx.get(f"{base}/health", timeout=1.0)
-                break
-            except Exception:
-                time.sleep(0.2)
+        _wait_health(base, proc)
         return proc, base
 
     def test_static_token(self, tmp_path):
@@ -244,7 +251,13 @@ class TestAuth:
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
         try:
             ok = False
-            for _ in range(100):
+            # generous window: the pod subprocess pays the ~2s site-level
+            # import tax and shares ONE core with 3 other xdist workers —
+            # 20s flaked under full-suite contention
+            for _ in range(300):
+                assert pod.poll() is None, (
+                    f"pod process died rc={pod.returncode} before "
+                    f"registering over WS")
                 health = httpx.get(f"{base}/health", timeout=2.0).json()
                 if health["waiting_pods"] + health["connected_pods"] >= 1:
                     ok = True
@@ -321,12 +334,7 @@ def test_k8s_proxy_routes_501_without_creds(tmp_path):
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     base = f"http://127.0.0.1:{port}"
     try:
-        for _ in range(50):
-            try:
-                httpx.get(f"{base}/health", timeout=1.0)
-                break
-            except Exception:
-                time.sleep(0.2)
+        _wait_health(base, proc)
         assert httpx.get(f"{base}/k8s/pods").status_code == 501
         assert httpx.get(f"{base}/k8s/nodes/n1").status_code == 501
         assert httpx.delete(f"{base}/k8s/pods/p1").status_code == 501
