@@ -53,6 +53,8 @@ _LAZY = {
     "Lifespan": ("kubetorch_tpu.data_store.types", "Lifespan"),
     # debugging
     "deep_breakpoint": ("kubetorch_tpu.serving.debugger", "deep_breakpoint"),
+    # single-controller actor mode (Monarch analogue)
+    "actors": ("kubetorch_tpu.actors", None),
     # runs
     "note": ("kubetorch_tpu.runs.api", "note"),
     "artifact": ("kubetorch_tpu.runs.api", "artifact"),

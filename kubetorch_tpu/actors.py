@@ -1,0 +1,284 @@
+"""Single-controller actor API: spawn and drive actors across the mesh.
+
+The user-facing half of actor mode (see
+``serving/actor_supervisor.py`` — reference: Monarch's controller-side
+``RemoteAllocator`` over per-node allocators,
+``serving/monarch_supervisor.py:31``). Used *inside* the controller
+program of a ``.distribute("actor", workers=N)`` deployment:
+
+    import kubetorch_tpu as kt
+
+    class Shard:
+        def __init__(self, rank): self.rank = rank
+        def step(self, x): return x * self.rank
+
+    def controller():                      # the deployed callable
+        m = kt.actors.mesh()               # all pods of this service
+        h = m.spawn("shard", Shard, init_args_per_host=[
+            {"args": [i]} for i in range(m.size)])
+        outs = h.call("step", 3)           # broadcast → one result per host
+        first = h.rank(0).call("step", 3)  # address one actor
+        h.stop()
+        return outs
+
+Actors are persistent, stateful, per-pod processes (``ActorHost``); calls
+are plain pod-server HTTP with the framework's serialization + remote
+exception rehydration — the same wire as ordinary ``kt.fn`` calls.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+from kubetorch_tpu import serialization
+from kubetorch_tpu.exceptions import StartupError
+from kubetorch_tpu.serving.http_client import call_method, sync_client
+
+_SER = "pickle"  # actor payloads are arbitrary Python by design
+
+# One fan-out executor per process, shared by every mesh: controller
+# programs build a fresh ActorMesh per invocation, and per-mesh pools
+# would leave their idle threads behind in the persistent worker process.
+_POOL: Optional[ThreadPoolExecutor] = None
+_POOL_LOCK = threading.Lock()
+
+
+def _shared_pool() -> ThreadPoolExecutor:
+    global _POOL
+    if _POOL is None:
+        with _POOL_LOCK:
+            if _POOL is None:
+                _POOL = ThreadPoolExecutor(
+                    max_workers=64, thread_name_prefix="kt-actor-mesh")
+    return _POOL
+
+
+def _entry_url(entry: str) -> str:
+    from kubetorch_tpu.serving.spmd_supervisor import _entry_url as f
+
+    return f(entry)
+
+
+def _class_pointer(cls: Union[type, str]) -> tuple:
+    """(import_path, class_name) from a live class or an
+    ``"pkg.mod:Class"`` / ``"pkg.mod.Class"`` string."""
+    if isinstance(cls, str):
+        if ":" in cls:
+            mod, name = cls.split(":", 1)
+        else:
+            mod, _, name = cls.rpartition(".")
+        if not mod or not name:
+            raise StartupError(
+                f"actor class string must be 'module:Class', got {cls!r}")
+        return mod, name
+    from kubetorch_tpu.resources.callables.pointers import extract_pointers
+
+    _, import_path, name = extract_pointers(cls)
+    return import_path, name
+
+
+class ActorRef:
+    """One actor on one host."""
+
+    def __init__(self, host: str, name: str, *, timeout: Optional[float]):
+        self.host = host
+        self.name = name
+        self._timeout = timeout
+
+    def call(self, method: str, *args, **kwargs) -> Any:
+        return call_method(
+            _entry_url(self.host), f"_actors/{self.name}", method,
+            args=args, kwargs=kwargs, ser=_SER, timeout=self._timeout)
+
+    def __repr__(self):
+        return f"ActorRef({self.name!r}@{self.host})"
+
+
+class ActorHandle:
+    """The spawned actor across its hosts (Monarch: an actor mesh)."""
+
+    def __init__(self, mesh: "ActorMesh", name: str, hosts: List[str]):
+        self._mesh = mesh
+        self.name = name
+        self.hosts = hosts
+
+    @property
+    def size(self) -> int:
+        return len(self.hosts)
+
+    def rank(self, i: int) -> ActorRef:
+        return ActorRef(self.hosts[i], self.name,
+                        timeout=self._mesh.call_timeout)
+
+    def refs(self) -> List[ActorRef]:
+        return [self.rank(i) for i in range(self.size)]
+
+    # -------------------------------------------------------------- calls
+    def call(self, method: str, *args, **kwargs) -> List[Any]:
+        """Broadcast; results ordered by host rank. Raises the first
+        remote exception (others complete — actors stay consistent)."""
+        futs = self.call_async(method, *args, **kwargs)
+        results, first_err = [], None
+        for f in futs:
+            try:
+                results.append(f.result())
+            except Exception as exc:  # noqa: BLE001
+                first_err = first_err or exc
+                results.append(None)
+        if first_err is not None:
+            raise first_err
+        return results
+
+    def call_async(self, method: str, *args, **kwargs) -> List[Future]:
+        return [
+            self._mesh._pool.submit(self.rank(i).call, method,
+                                    *args, **kwargs)
+            for i in range(self.size)
+        ]
+
+    def call_per_host(self, method: str,
+                      args_per_host: Sequence[tuple]) -> List[Any]:
+        """Scatter: host i gets ``args_per_host[i]``."""
+        if len(args_per_host) != self.size:
+            raise ValueError(
+                f"args_per_host has {len(args_per_host)} entries for "
+                f"{self.size} hosts")
+        futs = [self._mesh._pool.submit(self.rank(i).call, method, *a)
+                for i, a in enumerate(args_per_host)]
+        return [f.result() for f in futs]
+
+    # ------------------------------------------------------------- mgmt
+    def stop(self):
+        self._mesh._stop_actor(self.name, self.hosts)
+
+    def __repr__(self):
+        return f"ActorHandle({self.name!r} on {self.size} hosts)"
+
+
+class ActorMesh:
+    """All pods of the service, as actor hosts."""
+
+    def __init__(self, hosts: Optional[List[str]] = None, *,
+                 spawn_timeout: float = 300.0,
+                 call_timeout: Optional[float] = None):
+        if hosts is None:
+            raw = os.environ.get("KT_ACTOR_HOSTS", "")
+            hosts = [h for h in raw.split(",") if h]
+        if not hosts:
+            raise StartupError(
+                "no actor hosts: kt.actors.mesh() must run inside a "
+                ".distribute('actor') deployment (KT_ACTOR_HOSTS unset) "
+                "or be given hosts=[...] explicitly")
+        self.hosts = hosts
+        self.spawn_timeout = spawn_timeout
+        self.call_timeout = call_timeout
+        self._pool = _shared_pool()
+
+    @property
+    def size(self) -> int:
+        return len(self.hosts)
+
+    # ------------------------------------------------------------- spawn
+    def spawn(
+        self,
+        name: str,
+        cls: Union[type, str],
+        *,
+        init_args: Optional[dict] = None,
+        init_args_per_host: Optional[Sequence[Optional[dict]]] = None,
+        hosts: Optional[Sequence[int]] = None,
+        env: Optional[Dict[str, str]] = None,
+        root_path: Optional[str] = None,
+    ) -> ActorHandle:
+        """Spawn ``cls`` as the named actor on every selected host.
+
+        ``init_args`` / per-host entries follow the framework's ``cls``
+        convention: ``{"args": [...], "kwargs": {...}}``. ``hosts`` is a
+        list of mesh indices (default: all). The class must be importable
+        from the synced code on the pods — same rule as any deployed
+        ``kt.cls``.
+        """
+        import_path, class_name = _class_pointer(cls)
+        sel = list(range(self.size)) if hosts is None else list(hosts)
+        if init_args_per_host is not None and \
+                len(init_args_per_host) != len(sel):
+            raise ValueError(
+                f"init_args_per_host has {len(init_args_per_host)} "
+                f"entries for {len(sel)} hosts")
+        target_hosts = [self.hosts[i] for i in sel]
+
+        def do_spawn(pos_host):
+            pos, host = pos_host
+            ia = (init_args_per_host[pos] if init_args_per_host is not None
+                  else init_args)
+            spec = {
+                "actor": name, "import_path": import_path,
+                "class_name": class_name, "init_args": ia,
+                "env": env or {},
+                "root_path": root_path or "",
+            }
+            body = serialization.dumps(spec, _SER)
+            resp = sync_client().post(
+                f"{_entry_url(host)}/_actors/spawn", content=body,
+                headers={serialization.HEADER: _SER,
+                         "Content-Type": "application/octet-stream"},
+                timeout=self.spawn_timeout)
+            if resp.status_code != 200:
+                from kubetorch_tpu.exceptions import rehydrate_exception
+
+                # parse-then-raise: the rehydrated exception may itself be
+                # a KeyError/ValueError and must not be mistaken for a
+                # malformed error body
+                try:
+                    error = resp.json()["error"]
+                except (KeyError, ValueError):
+                    raise StartupError(
+                        f"actor spawn on {host} failed: "
+                        f"{resp.status_code} {resp.text[:300]}") from None
+                raise rehydrate_exception(error)
+
+        futs = [self._pool.submit(do_spawn, (p, h))
+                for p, h in enumerate(target_hosts)]
+        errs = []
+        for f in futs:
+            try:
+                f.result()
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+        if errs:
+            # leave no half-spawned mesh behind
+            self._stop_actor(name, target_hosts, quiet=True)
+            raise errs[0]
+        return ActorHandle(self, name, target_hosts)
+
+    # ------------------------------------------------------------- mgmt
+    def list(self, host_index: int = 0) -> List[dict]:
+        resp = sync_client().get(
+            f"{_entry_url(self.hosts[host_index])}/_actors", timeout=30)
+        resp.raise_for_status()
+        return resp.json()["actors"]
+
+    def _stop_actor(self, name: str, hosts: List[str], quiet: bool = False):
+        def do_stop(host):
+            try:
+                sync_client().delete(
+                    f"{_entry_url(host)}/_actors/{name}", timeout=30)
+            except Exception:  # noqa: BLE001
+                if not quiet:
+                    raise
+
+        futs = [self._pool.submit(do_stop, h) for h in hosts]
+        for f in futs:
+            f.result()
+
+    def shutdown(self):
+        """No-op: the fan-out pool is process-shared (see _shared_pool)."""
+
+
+def mesh(hosts: Optional[List[str]] = None, **kwargs) -> ActorMesh:
+    """The service's actor mesh (from ``KT_ACTOR_HOSTS`` inside a
+    ``.distribute('actor')`` controller program)."""
+    return ActorMesh(hosts, **kwargs)

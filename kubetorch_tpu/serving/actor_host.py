@@ -1,0 +1,114 @@
+"""Per-pod actor host: named, persistent, stateful actor processes.
+
+The single-controller analogue of the reference's Monarch mode
+(``serving/monarch_supervisor.py:31`` — rank 0 builds a RemoteAllocator over
+per-node ``process_allocator`` services and drives actors on them). Monarch's
+Rust actor runtime has no TPU analogue worth carrying; what the mode *means*
+is: one controller process owns the program, every pod can host named actor
+processes the controller spawns, addresses, and stops. This host is that
+allocator service, built on the same ``ProcessPool``/``ProcessWorker``
+machinery as ordinary callables — an actor is a ``cls`` callable loaded into
+its own dedicated process, so it keeps state across calls, is isolated from
+the pod server and from other actors, and dies cleanly with ``stop()``.
+
+Exposed on every pod server as ``/_actors/*`` routes (spawn / call / list /
+stop); driven from the controller function via ``kubetorch_tpu.actors``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from kubetorch_tpu.exceptions import StartupError
+from kubetorch_tpu.serving.process_pool import ProcessPool
+
+
+class ActorHost:
+    """Owns this pod's named actors; one ProcessPool (num_procs=1) each."""
+
+    def __init__(self):
+        self._actors: Dict[str, ProcessPool] = {}
+        self._specs: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def spawn(
+        self,
+        name: str,
+        *,
+        root_path: str,
+        import_path: str,
+        class_name: str,
+        init_args: Optional[dict] = None,
+        env: Optional[Dict[str, str]] = None,
+        num_procs: int = 1,
+    ) -> dict:
+        """Create (or replace) the named actor.
+
+        Replacement semantics: a re-spawn under an existing name stops the
+        old process first — the controller's retry after a crash must not
+        end up with two processes both claiming the name.
+        """
+        if not name or "/" in name:
+            raise StartupError(f"invalid actor name {name!r}")
+        pool = ProcessPool(num_procs, base_env=dict(env or {}))
+        pool.start()
+        try:
+            pool.setup_all(
+                root_path=root_path, import_path=import_path,
+                name=class_name, callable_type="cls",
+                init_args=init_args)
+        except Exception:
+            pool.stop()
+            raise
+        with self._lock:
+            old = self._actors.pop(name, None)
+            self._actors[name] = pool
+            self._specs[name] = {
+                "import_path": import_path, "class_name": class_name,
+                "num_procs": num_procs}
+        if old is not None:
+            old.stop()
+        return {"name": name, "procs": num_procs}
+
+    # ------------------------------------------------------------------
+    def call(
+        self,
+        name: str,
+        body: bytes,
+        serialization_method: str,
+        method: Optional[str] = None,
+        timeout: Optional[float] = None,
+        allowed: Optional[tuple] = None,
+    ) -> dict:
+        with self._lock:
+            pool = self._actors.get(name)
+        if pool is None:
+            raise KeyError(f"no actor {name!r} on this pod "
+                           f"(have: {sorted(self._actors)})")
+        return pool.call(body, serialization_method, method=method,
+                         allowed=allowed, timeout=timeout)
+
+    # ------------------------------------------------------------------
+    def stop(self, name: str) -> bool:
+        with self._lock:
+            pool = self._actors.pop(name, None)
+            self._specs.pop(name, None)
+        if pool is None:
+            return False
+        pool.stop()
+        return True
+
+    def list(self) -> List[dict]:
+        with self._lock:
+            return [{"name": n, "healthy": p.healthy, **self._specs[n]}
+                    for n, p in sorted(self._actors.items())]
+
+    def cleanup(self):
+        with self._lock:
+            pools = list(self._actors.values())
+            self._actors.clear()
+            self._specs.clear()
+        for pool in pools:
+            pool.stop()

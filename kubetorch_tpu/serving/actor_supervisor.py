@@ -1,0 +1,142 @@
+"""Single-controller ("actor" / "monarch") supervisor.
+
+Reference: ``serving/monarch_supervisor.py:31`` — Monarch's single-controller
+actor framework: rank 0 is the controller, every node runs a process
+allocator, and the controller's program drives actors across them. The
+TPU-native rebuild keeps the *topology* (one controller process owns the
+program; worker pods host actors on demand via :class:`ActorHost`) and
+replaces Monarch's Rust actor runtime with the framework's own process +
+HTTP machinery — no new wire protocols, no extra daemons.
+
+Execution model:
+
+- ``.distribute("actor", workers=N)`` deploys N pods. The callable (the
+  *controller program*) loads and runs ONLY on the coordinator (lowest
+  sorted member entry — same election as SPMD/Ray). Calls that land on
+  other pods via the round-robin Service are proxied to it.
+- The controller program sees ``KT_ACTOR_HOSTS`` (all member entries) in
+  its environment and uses :mod:`kubetorch_tpu.actors` to spawn/drive/stop
+  actors on any subset of pods, including its own.
+- Worker pods run nothing until the controller spawns actors on them;
+  their pod server (and its ``/_actors/*`` routes) is the allocator.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from kubetorch_tpu import serialization
+from kubetorch_tpu.exceptions import StartupError
+from kubetorch_tpu.serving.process_pool import ProcessPool
+from kubetorch_tpu.serving.spmd_supervisor import (
+    DistributedSupervisor,
+    _entry_url,
+)
+
+
+class ActorSupervisor(DistributedSupervisor):
+    """Controller-only execution; worker pods are pure actor hosts."""
+
+    def __init__(self, metadata: Dict[str, Any]):
+        super().__init__(metadata)
+        self.is_coordinator = False
+        self.coord_entry: str = "127.0.0.1"
+        self._mesh_members: list = []
+
+    # ------------------------------------------------------------------
+    def setup(self):
+        members = self.discover()
+        self_index, _ = self.self_entry(members)
+        self._mesh_members = members
+        self.coord_entry = members[0]
+        self.is_coordinator = self_index == 0 or len(members) == 1
+
+        if self.is_coordinator:
+            self.pool = ProcessPool(self.num_procs)
+            self.pool.start(self._controller_env(members))
+            self._setup_callable()
+        # non-coordinator pods: no callable; the pod server's ActorHost
+        # is the whole job.
+        self.start_monitoring(members)
+
+    def _controller_env(self, members):
+        # RANK/WORLD_SIZE reflect the controller program itself (a world of
+        # one) — actor-mode worlds are defined by spawned actors, not by
+        # the driver. KT_ACTOR_HOSTS carries the mesh.
+        env = {"KT_ACTOR_HOSTS": ",".join(members)}
+        fw = self.framework(self.num_procs)
+        return [
+            {**fw.rank_env(node_rank=0, local_rank=i, num_nodes=1,
+                           pod_ips=[m.split(":")[0] for m in members]),
+             **env}
+            for i in range(self.num_procs)
+        ]
+
+    def reload(self, metadata: Optional[Dict[str, Any]] = None):
+        if metadata:
+            self.metadata.update(metadata)
+        if not self.is_coordinator and self._mesh_members:
+            return  # nothing loaded here; actors respawn on next drive
+        if self.pool is None:
+            self.setup()
+        else:
+            self._setup_callable()
+
+    # ------------------------------------------------------------------
+    def call(self, body, serialization_method=serialization.DEFAULT,
+             method=None, query=None, timeout=None, request_id=None,
+             **kwargs):
+        self.check_membership()
+        if not self.is_coordinator:
+            if (query or {}).get("actor_controller_call"):
+                raise StartupError(
+                    "actor controller election inconsistent: proxied call "
+                    "landed on a non-coordinator pod")
+            return self._proxy_to_coordinator(
+                body, serialization_method, method, query=query,
+                request_id=request_id)
+        resp = self.pool.call(
+            body, serialization_method, method=method,
+            allowed=self.allowed, timeout=timeout)
+        self.check_membership()
+        return resp
+
+    def _proxy_to_coordinator(self, body, ser, method, query=None,
+                              request_id=None) -> dict:
+        from kubetorch_tpu.serving.http_client import sync_client
+
+        target = (f"{_entry_url(self.coord_entry)}/"
+                  f"{self.metadata.get('name')}")
+        if method:
+            target += f"/{method}"
+        params = dict(query or {})
+        params["actor_controller_call"] = "true"
+        headers = {serialization.HEADER: ser,
+                   "Content-Type": "application/octet-stream"}
+        if params.pop("_stream_req", None):
+            # re-issue the caller's stream ask so the coordinator frames
+            # its generator result; the framed bytes pass through whole
+            # (buffered, not progressive — but shape-identical to a direct
+            # hit, which is what the client's frame parser keys on)
+            headers["X-KT-Stream"] = "request"
+        if request_id:
+            headers["X-Request-ID"] = request_id
+        resp = sync_client().post(target, content=body, params=params,
+                                  headers=headers, timeout=None)
+        if resp.status_code != 200:
+            try:
+                error = resp.json().get("error")
+            except Exception:
+                error = {"type": "RuntimeError", "message": resp.text[:500]}
+            return {"ok": False, "error": error}
+        out = {"ok": True, "payload": resp.content,
+               "serialization": resp.headers.get(serialization.HEADER, ser)}
+        if resp.headers.get("X-KT-Stream"):
+            out["extra_headers"] = {
+                "X-KT-Stream": resp.headers["X-KT-Stream"]}
+        return out
+
+    def healthy(self) -> bool:
+        if not self.is_coordinator:
+            return True
+        return super().healthy()

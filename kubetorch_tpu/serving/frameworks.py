@@ -201,6 +201,8 @@ FRAMEWORKS = {
     "pytorch": PyTorchProcess,
     "tensorflow": TensorFlowProcess,
     "spmd": FrameworkProcess,  # bare RANK/WORLD_SIZE contract only
+    "actor": FrameworkProcess,  # single-controller mode: POD_IPS is the mesh
+    "monarch": FrameworkProcess,  # reference-name alias for "actor"
 }
 
 

@@ -146,6 +146,10 @@ class RaySupervisor(ExecutionSupervisor):
         params["ray_head_call"] = "true"
         headers = {serialization.HEADER: ser,
                    "Content-Type": "application/octet-stream"}
+        if params.pop("_stream_req", None):
+            # re-issue the caller's stream ask so the head frames its
+            # generator result and the frame shape survives the hop
+            headers["X-KT-Stream"] = "request"
         if request_id:
             headers["X-Request-ID"] = request_id
         resp = sync_client().post(
@@ -158,8 +162,12 @@ class RaySupervisor(ExecutionSupervisor):
                 error = {"type": "RuntimeError",
                          "message": resp.text[:500]}
             return {"ok": False, "error": error}
-        return {"ok": True, "payload": resp.content,
-                "serialization": resp.headers.get(serialization.HEADER, ser)}
+        out = {"ok": True, "payload": resp.content,
+               "serialization": resp.headers.get(serialization.HEADER, ser)}
+        if resp.headers.get("X-KT-Stream"):
+            out["extra_headers"] = {
+                "X-KT-Stream": resp.headers["X-KT-Stream"]}
+        return out
 
     def healthy(self) -> bool:
         ray_ok = (self._ray_proc is not None

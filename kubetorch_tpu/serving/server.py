@@ -23,6 +23,7 @@ import contextvars
 import json
 import os
 import signal
+import threading
 import time
 import uuid
 from typing import Any, Dict, Optional
@@ -41,7 +42,7 @@ request_id_var: contextvars.ContextVar = contextvars.ContextVar(
     "kt_request_id", default="-")
 
 _RESERVED = {"health", "ready", "metrics", "app", "http", "_reload",
-             "_teardown", "_gpu", "_debug", "_profile"}
+             "_teardown", "_gpu", "_debug", "_profile", "_actors"}
 
 
 def metadata_from_env() -> Dict[str, Any]:
@@ -93,6 +94,22 @@ class PodServer:
         self.setup_error: Optional[str] = None
         self.controller_ws = None
         self._activity_task = None
+        self._actor_host = None
+        self._actor_host_lock = threading.Lock()
+
+    @property
+    def actor_host(self):
+        """Lazy: most pods never host actors (single-controller mode only,
+        serving/actor_supervisor.py). Locked — concurrent first spawns from
+        executor threads must not each build a host and orphan the loser's
+        actor processes."""
+        if self._actor_host is None:
+            from kubetorch_tpu.serving.actor_host import ActorHost
+
+            with self._actor_host_lock:
+                if self._actor_host is None:
+                    self._actor_host = ActorHost()
+        return self._actor_host
 
     # ------------------------------------------------------------- app
     def build_app(self) -> web.Application:
@@ -109,6 +126,10 @@ class PodServer:
         app.router.add_get("/_debug/ws", self.h_debug_ws)
         app.router.add_post("/_profile/{action}", self.h_profile)
         app.router.add_route("*", "/http/{tail:.*}", self.h_proxy)
+        app.router.add_post("/_actors/spawn", self.h_actor_spawn)
+        app.router.add_get("/_actors", self.h_actor_list)
+        app.router.add_delete("/_actors/{actor}", self.h_actor_stop)
+        app.router.add_post("/_actors/{actor}/{method}", self.h_actor_call)
         app.router.add_post("/{callable}", self.h_call)
         app.router.add_post("/{callable}/{method}", self.h_call)
         app.on_startup.append(self._on_startup)
@@ -209,6 +230,8 @@ class PodServer:
             self._app_ready_task.cancel()
         if self.supervisor is not None:
             self.supervisor.cleanup()
+        if self._actor_host is not None:
+            self._actor_host.cleanup()
         if self.app_proc and self.app_proc.returncode is None:
             self.app_proc.terminate()
 
@@ -478,6 +501,90 @@ class PodServer:
                     body=payload, status=upstream.status,
                     content_type=upstream.content_type)
 
+    # ----------------------------------------------------------- actors
+    # Single-controller mode (reference: Monarch's per-node allocator,
+    # serving/monarch_supervisor.py): this pod hosts named persistent
+    # actor processes spawned/driven by the mesh's controller program.
+    async def h_actor_spawn(self, request: web.Request):
+        ser = request.headers.get(serialization.HEADER, serialization.DEFAULT)
+        body = await request.read()
+        try:
+            allowed = (self.supervisor.allowed if self.supervisor
+                       else serialization.METHODS)
+            ser = serialization.check_allowed(ser, allowed)
+            spec = serialization.loads(body, ser)
+        except Exception as exc:  # noqa: BLE001
+            return web.json_response(package_exception(exc), status=400)
+        loop = asyncio.get_running_loop()
+        try:
+            info = await loop.run_in_executor(None, lambda: (
+                self.actor_host.spawn(
+                    spec["actor"],
+                    root_path=(spec.get("root_path")
+                               or self.metadata.get("root_path", "")),
+                    import_path=spec["import_path"],
+                    class_name=spec["class_name"],
+                    init_args=spec.get("init_args"),
+                    env=spec.get("env"),
+                    num_procs=int(spec.get("num_procs") or 1))))
+        except Exception as exc:  # noqa: BLE001
+            return web.json_response(package_exception(exc), status=500)
+        return web.json_response(info)
+
+    async def h_actor_list(self, request: web.Request):
+        host = self._actor_host
+        return web.json_response(
+            {"actors": host.list() if host is not None else []})
+
+    async def h_actor_stop(self, request: web.Request):
+        name = request.match_info["actor"]
+        host = self._actor_host
+        stopped = False
+        if host is not None:
+            stopped = await asyncio.get_running_loop().run_in_executor(
+                None, host.stop, name)
+        return web.json_response({"stopped": stopped})
+
+    async def h_actor_call(self, request: web.Request):
+        name = request.match_info["actor"]
+        method = request.match_info["method"]
+        host = self._actor_host
+        if host is None:
+            return web.json_response(package_exception(
+                KeyError(f"no actors hosted here (wanted {name!r})")),
+                status=404)
+        ser = request.headers.get(serialization.HEADER, serialization.DEFAULT)
+        try:
+            allowed = (self.supervisor.allowed if self.supervisor
+                       else serialization.METHODS)
+            ser = serialization.check_allowed(ser, allowed)
+        except Exception as exc:  # noqa: BLE001
+            return web.json_response(package_exception(exc), status=400)
+        body = await request.read()
+        loop = asyncio.get_running_loop()
+        try:
+            resp = await loop.run_in_executor(
+                None, lambda: host.call(
+                    name, body, ser, method=method, allowed=allowed))
+        except KeyError as exc:
+            return web.json_response(package_exception(exc), status=404)
+        except Exception as exc:  # noqa: BLE001
+            return web.json_response(package_exception(exc), status=500)
+        if not resp.get("ok"):
+            return web.json_response({"error": resp["error"]}, status=500)
+        if "stream" in resp:
+            # actor generator results: drain to one list (same contract as
+            # plain h_call callers)
+            resp, err = await self._drain_stream(resp, ser, allowed)
+            if err is not None:
+                return err
+        used = resp.get("serialization", ser)
+        return web.Response(
+            body=resp["payload"],
+            content_type=("application/json" if used == "json"
+                          else "application/octet-stream"),
+            headers={serialization.HEADER: used})
+
     async def h_call(self, request: web.Request):
         name = request.match_info["callable"]
         method = request.match_info.get("method")
@@ -506,6 +613,14 @@ class PodServer:
         restart_procs = request.query.get("restart_procs") == "true"
         workers = request.query.get("workers", "all")
 
+        query = dict(request.query)
+        if request.headers.get("X-KT-Stream") == "request":
+            # thread the stream ask through supervisor-level proxies
+            # (actor/ray coordinator election): the proxy re-issues the
+            # header so the coordinator frames its response, and the frame
+            # shape survives the hop (see _proxy_to_coordinator)
+            query["_stream_req"] = "1"
+
         loop = asyncio.get_running_loop()
         try:
             resp = await loop.run_in_executor(
@@ -514,7 +629,7 @@ class PodServer:
                     body, ser, method=method,
                     distributed_subcall=distributed_subcall,
                     restart_procs=restart_procs, workers=workers,
-                    query=dict(request.query),
+                    query=query,
                     request_id=request_id_var.get()))
         except Exception as exc:
             return web.json_response(package_exception(exc), status=500)
@@ -530,23 +645,10 @@ class PodServer:
             # plain caller: drain the generator into one list result (one
             # executor handoff for the whole drain — no progressive
             # delivery is needed here)
-            try:
-                chunks = await asyncio.get_running_loop().run_in_executor(
-                    None, list, iter(resp["stream"]))
-            except TimeoutError as exc:
-                return web.json_response(package_exception(exc), status=500)
-            items, used = [], ser
-            for chunk in chunks:
-                items.append(serialization.loads(
-                    chunk["payload"], chunk["serialization"])["result"])
-                used = chunk["serialization"]
-            terminal = resp["stream"].terminal or {}
-            if not terminal.get("ok"):
-                return web.json_response({"error": terminal["error"]},
-                                         status=500)
-            payload, used = serialization.choose(
-                {"result": items}, used, self.supervisor.allowed)
-            resp = {**terminal, "payload": payload, "serialization": used}
+            resp, err = await self._drain_stream(
+                resp, ser, self.supervisor.allowed)
+            if err is not None:
+                return err
         stats = resp.pop("device_stats", None)
         if stats:
             # workers attach accelerator memory stats to responses; the
@@ -557,7 +659,31 @@ class PodServer:
             body=resp["payload"],
             content_type=("application/json" if used == "json"
                           else "application/octet-stream"),
-            headers={serialization.HEADER: used})
+            headers={serialization.HEADER: used,
+                     **resp.get("extra_headers", {})})
+
+    async def _drain_stream(self, resp, ser, allowed):
+        """Drain a generator-result stream into one list-valued payload.
+        Returns (resp_dict, None), or (None, error_response) when the
+        stream stalls or ends in a packaged error."""
+        try:
+            chunks = await asyncio.get_running_loop().run_in_executor(
+                None, list, iter(resp["stream"]))
+        except TimeoutError as exc:
+            return None, web.json_response(package_exception(exc),
+                                           status=500)
+        items, used = [], ser
+        for chunk in chunks:
+            items.append(serialization.loads(
+                chunk["payload"], chunk["serialization"])["result"])
+            used = chunk["serialization"]
+        terminal = resp["stream"].terminal or {}
+        if not terminal.get("ok"):
+            return None, web.json_response({"error": terminal["error"]},
+                                           status=500)
+        payload, used = serialization.choose(
+            {"result": items}, used, allowed)
+        return {**terminal, "payload": payload, "serialization": used}, None
 
     async def _respond_stream(self, request, stream, default_ser):
         """Chunked frame response for generator results: each frame is

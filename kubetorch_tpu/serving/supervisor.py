@@ -110,6 +110,7 @@ def supervisor_factory(metadata: Dict[str, Any]) -> ExecutionSupervisor:
 
     distributed.type: None/local → ExecutionSupervisor;
     ray → RaySupervisor (head-only);
+    actor/monarch → ActorSupervisor (single-controller actor mode);
     jax/pytorch/tensorflow/spmd → SPMDDistributedSupervisor.
     """
     dist = metadata.get("distributed") or {}
@@ -120,6 +121,10 @@ def supervisor_factory(metadata: Dict[str, Any]) -> ExecutionSupervisor:
         from kubetorch_tpu.serving.ray_supervisor import RaySupervisor
 
         return RaySupervisor(metadata)
+    if dist_type in ("actor", "monarch"):
+        from kubetorch_tpu.serving.actor_supervisor import ActorSupervisor
+
+        return ActorSupervisor(metadata)
     from kubetorch_tpu.serving.spmd_supervisor import (
         SPMDDistributedSupervisor,
     )

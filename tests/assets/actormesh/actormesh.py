@@ -1,0 +1,92 @@
+"""Deployable asset for single-controller actor mode tests.
+
+``controller_program`` is the deployed callable (runs only on the
+coordinator pod); ``ShardActor`` is what it spawns across the mesh.
+"""
+
+import os
+
+
+class ShardActor:
+    def __init__(self, shard_id=0):
+        self.shard_id = shard_id
+        self.state = 0
+
+    def bump(self, by=1):
+        self.state += by
+        return {
+            "shard": self.shard_id,
+            "state": self.state,
+            "pid": os.getpid(),
+            "pod": os.environ.get("KT_REPLICA_INDEX"),
+        }
+
+    def get_state(self):
+        return self.state
+
+    def fail(self, message="shard down"):
+        raise RuntimeError(message)
+
+
+def controller_program(rounds=2):
+    """Drive a ShardActor on every pod; prove state persistence, rank
+    addressing, scatter calls, and cleanup."""
+    import kubetorch_tpu as kt
+
+    m = kt.actors.mesh()
+    handle = m.spawn(
+        "shard", ShardActor,
+        init_args_per_host=[{"kwargs": {"shard_id": i}}
+                            for i in range(m.size)])
+    try:
+        last = None
+        for _ in range(rounds):
+            last = handle.call("bump", 1)          # broadcast
+        solo = handle.rank(0).call("bump", 10)     # single actor
+        scattered = handle.call_per_host(
+            "bump", [(100 * (i + 1),) for i in range(handle.size)])
+        listed = m.list()
+        return {
+            "mesh_size": m.size,
+            "hosts": m.hosts,
+            "broadcast": last,
+            "solo": solo,
+            "scatter": scattered,
+            "actors_listed": listed,
+            "controller_pod": os.environ.get("KT_REPLICA_INDEX"),
+        }
+    finally:
+        handle.stop()
+
+
+def controller_actor_error():
+    """An actor exception must rehydrate in the controller program."""
+    import kubetorch_tpu as kt
+
+    m = kt.actors.mesh()
+    handle = m.spawn("failer", ShardActor)
+    try:
+        try:
+            handle.call("fail", "deliberate shard failure")
+        except RuntimeError as exc:
+            return {"caught": str(exc)}
+        return {"caught": None}
+    finally:
+        handle.stop()
+
+
+def controller_respawn():
+    """Re-spawning under the same name replaces the actor (fresh state,
+    new process)."""
+    import kubetorch_tpu as kt
+
+    m = kt.actors.mesh()
+    h1 = m.spawn("gen", ShardActor)
+    h1.call("bump", 5)
+    pid1 = h1.rank(0).call("bump", 0)["pid"]
+    h2 = m.spawn("gen", ShardActor)     # replace
+    try:
+        out = h2.rank(0).call("bump", 0)
+        return {"pid1": pid1, "pid2": out["pid"], "state2": out["state"]}
+    finally:
+        h2.stop()

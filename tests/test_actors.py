@@ -1,0 +1,178 @@
+"""Single-controller actor mode on the local backend.
+
+The Monarch-analogue execution mode (reference:
+``serving/monarch_supervisor.py:31`` — rank-0 controller drives actors on
+per-node allocators). Here: 2 subprocess "pods", the deployed callable runs
+only on the coordinator, and it spawns/drives/stops persistent ShardActor
+processes on both pods via the ``/_actors/*`` allocator routes.
+"""
+
+import os
+from pathlib import Path
+
+import pytest
+
+import kubetorch_tpu as kt
+from kubetorch_tpu.resources.callables.fn import Fn
+
+ASSETS = Path(__file__).parent / "assets" / "actormesh"
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _local_state(tmp_path_factory):
+    state = tmp_path_factory.mktemp("ktlocal-actors")
+    os.environ["KT_LOCAL_STATE"] = str(state)
+    import kubetorch_tpu.provisioning.backend as backend
+
+    backend._LOCAL_ROOT = state
+    yield
+    for record in backend.LocalBackend().list_services():
+        backend.LocalBackend().teardown(record["service_name"], quiet=True)
+
+
+@pytest.fixture(scope="module")
+def actor_service():
+    remote = Fn(root_path=str(ASSETS), import_path="actormesh",
+                callable_name="controller_program", name="actor-ctl")
+    compute = kt.Compute(cpus="0.1").distribute(
+        "actor", workers=2, monitor_members=False)
+    remote.to(compute)
+    yield remote
+    remote.teardown()
+
+
+@pytest.mark.level("minimal")
+def test_actor_mesh_end_to_end(actor_service):
+    out = actor_service(rounds=2)
+    assert out["mesh_size"] == 2
+    # broadcast hit one stateful actor per pod: state == rounds, distinct
+    # shard ids, distinct pids, both pods represented
+    bcast = out["broadcast"]
+    assert [r["shard"] for r in bcast] == [0, 1]
+    assert all(r["state"] == 2 for r in bcast)
+    assert len({r["pid"] for r in bcast}) == 2
+    assert len({r["pod"] for r in bcast}) == 2
+    # rank(0) call lands on shard 0 only and keeps its state
+    assert out["solo"]["shard"] == 0 and out["solo"]["state"] == 12
+    # scatter: per-host args (state carries forward from prior calls)
+    assert [r["shard"] for r in out["scatter"]] == [0, 1]
+    assert out["scatter"][0]["state"] == 112   # 2 + 10 + 100
+    assert out["scatter"][1]["state"] == 202   # 2 + 200
+    # allocator introspection saw the actor while live
+    assert any(a["name"] == "shard" for a in out["actors_listed"])
+
+
+@pytest.mark.level("minimal")
+def test_actor_exception_rehydrates_in_controller(actor_service):
+    # reuse the service: swap the callable via the same module
+    remote = Fn(root_path=str(ASSETS), import_path="actormesh",
+                callable_name="controller_actor_error", name="actor-err")
+    compute = kt.Compute(cpus="0.1").distribute(
+        "actor", workers=2, monitor_members=False)
+    remote.to(compute)
+    try:
+        out = remote()
+        assert out["caught"] == "deliberate shard failure"
+    finally:
+        remote.teardown()
+
+
+@pytest.mark.level("minimal")
+def test_actor_respawn_replaces_process_and_state(actor_service):
+    remote = Fn(root_path=str(ASSETS), import_path="actormesh",
+                callable_name="controller_respawn", name="actor-respawn")
+    compute = kt.Compute(cpus="0.1").distribute(
+        "actor", workers=2, monitor_members=False)
+    remote.to(compute)
+    try:
+        out = remote()
+        assert out["pid1"] != out["pid2"]   # new process
+        assert out["state2"] == 0           # fresh state
+    finally:
+        remote.teardown()
+
+
+@pytest.mark.level("minimal")
+def test_actors_stopped_after_controller_returns(actor_service):
+    # the controller's finally stopped the "shard" actor on every pod;
+    # the allocator on pod 0 must list nothing afterwards
+    out = actor_service(rounds=1)
+    host = out["hosts"][0]
+    from kubetorch_tpu.serving.http_client import sync_client
+    from kubetorch_tpu.serving.spmd_supervisor import _entry_url
+
+    resp = sync_client().get(f"{_entry_url(host)}/_actors", timeout=30)
+    assert resp.status_code == 200
+    assert resp.json()["actors"] == []
+
+
+@pytest.mark.level("minimal")
+def test_actor_proxy_preserves_stream_shape():
+    """A stream ask that lands on a non-coordinator pod must re-issue the
+    X-KT-Stream header to the coordinator and pass the framed response
+    header back — frame shape identical to a direct coordinator hit."""
+    import asyncio
+    import threading
+
+    from aiohttp import web
+
+    from kubetorch_tpu import serialization
+    from kubetorch_tpu.serving.actor_supervisor import ActorSupervisor
+
+    seen = {}
+
+    async def fake_coordinator(request):
+        seen["stream_hdr"] = request.headers.get("X-KT-Stream")
+        seen["query_flag"] = request.query.get("_stream_req")
+        assert request.query.get("actor_controller_call") == "true"
+        return web.Response(body=b"FRAMED",
+                            headers={serialization.HEADER: "json",
+                                     "X-KT-Stream": "1"})
+
+    app = web.Application()
+    app.router.add_post("/ctl", fake_coordinator)
+    runner = web.AppRunner(app)
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    try:
+        asyncio.run_coroutine_threadsafe(runner.setup(), loop).result(10)
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        asyncio.run_coroutine_threadsafe(site.start(), loop).result(10)
+        port = runner.addresses[0][1]
+
+        sup = ActorSupervisor({"import_path": "x", "name": "ctl",
+                               "distributed": {"type": "actor",
+                                               "workers": 2}})
+        sup.is_coordinator = False
+        sup.coord_entry = f"127.0.0.1:{port}"
+        resp = sup.call(b"{}", "json", query={"_stream_req": "1"})
+        assert resp["ok"]
+        assert seen["stream_hdr"] == "request"   # header re-issued
+        assert seen["query_flag"] is None        # internal flag stripped
+        assert resp["extra_headers"] == {"X-KT-Stream": "1"}
+        assert resp["payload"] == b"FRAMED"
+
+        # a proxied call arriving at a non-coordinator must not loop
+        with pytest.raises(kt.StartupError, match="election"):
+            sup.call(b"{}", "json", query={"actor_controller_call": "true"})
+    finally:
+        asyncio.run_coroutine_threadsafe(runner.cleanup(), loop).result(10)
+        loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.mark.level("unit")
+def test_mesh_requires_hosts():
+    os.environ.pop("KT_ACTOR_HOSTS", None)
+    with pytest.raises(kt.StartupError):
+        kt.actors.mesh()
+
+
+@pytest.mark.level("unit")
+def test_class_pointer_forms():
+    from kubetorch_tpu.actors import _class_pointer
+
+    assert _class_pointer("pkg.mod:Thing") == ("pkg.mod", "Thing")
+    assert _class_pointer("pkg.mod.Thing") == ("pkg.mod", "Thing")
+    with pytest.raises(kt.StartupError):
+        _class_pointer("NoModule")
