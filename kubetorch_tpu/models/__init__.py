@@ -18,11 +18,14 @@ def __getattr__(name):
     # __getattr__ before importing, recursing forever on module names.
     import importlib
 
-    if name in ("generate", "quant", "rolling"):
+    if name in ("generate", "quant", "rolling", "speculative"):
         return importlib.import_module(f"kubetorch_tpu.models.{name}")
     if name == "Generator":
         return importlib.import_module(
             "kubetorch_tpu.models.generate").Generator
+    if name == "SpeculativeGenerator":
+        return importlib.import_module(
+            "kubetorch_tpu.models.speculative").SpeculativeGenerator
     if name == "quantize_params":
         return importlib.import_module(
             "kubetorch_tpu.models.quant").quantize_params
@@ -33,4 +36,5 @@ def __getattr__(name):
 
 
 __all__ = ["LlamaConfig", "MoEConfig", "ViTConfig", "llama", "Generator",
-           "generate", "quant", "quantize_params", "RollingGenerator"]
+           "generate", "quant", "quantize_params", "RollingGenerator",
+           "SpeculativeGenerator", "speculative"]

@@ -598,6 +598,54 @@ def _cached_attn(q, ck, cv, mask, cfg: LlamaConfig):
     return out.reshape(B, T, H, D).astype(q.dtype)
 
 
+def merge_chunk_into_grid(cache: Dict[str, jax.Array],
+                          chunk: Dict[str, jax.Array],
+                          start: jax.Array, count: jax.Array
+                          ) -> Dict[str, jax.Array]:
+    """Write chunk cols ``[0, count[b])`` into grid slots
+    ``start[b] + col`` for every layer — the ONLY per-sequence-offset
+    cache write in the decode paths, amortized over a whole chunk.
+
+    A one-hot EINSUM select, not take_along_axis/scatter: generic gathers
+    with computed index maps serialize on TPU (measured ~1.8 s/step — 50×
+    the whole decode step — when this was a full-cache take_along_axis;
+    same pathology as generic scatters). The einsum is matmul-shaped, so
+    it runs on the MXU at HBM speed; scanning per layer keeps the temp at
+    one layer's [B, M, Hkv, D]. Shared by rolling decode (uniform count =
+    chunk size for active slots) and speculative verify (count = accepted
+    prefix; rejected drafts never land, so there is no rollback).
+    """
+    gk_all, gv_all = cache["k"], cache["v"]
+    K = chunk["k"].shape[2]
+    M = gk_all.shape[2]
+    L = gk_all.shape[0]
+    cdt = gk_all.dtype
+    idx = jnp.arange(M)[None, :] - start[:, None]              # [B, M]
+    inwin = (idx >= 0) & (idx < count[:, None])
+    onehot = (jnp.arange(K)[None, None, :] == idx[:, :, None]
+              ).astype(cdt) * inwin[:, :, None].astype(cdt)    # [B, M, K]
+
+    def merge_layer(carry, inp):
+        gk_all, gv_all = carry
+        li, ek, ev = inp                       # ek/ev: [B, K, Hkv, D]
+        mk = jnp.einsum("bmk,bkhd->bmhd", onehot,
+                        ek.astype(cdt)).astype(cdt)
+        mv = jnp.einsum("bmk,bkhd->bmhd", onehot,
+                        ev.astype(cdt)).astype(cdt)
+        gk = jax.lax.dynamic_index_in_dim(gk_all, li, 0, keepdims=False)
+        gv = jax.lax.dynamic_index_in_dim(gv_all, li, 0, keepdims=False)
+        gk = jnp.where(inwin[:, :, None, None], mk, gk)
+        gv = jnp.where(inwin[:, :, None, None], mv, gv)
+        gk_all = jax.lax.dynamic_update_index_in_dim(gk_all, gk, li, 0)
+        gv_all = jax.lax.dynamic_update_index_in_dim(gv_all, gv, li, 0)
+        return (gk_all, gv_all), None
+
+    (new_k, new_v), _ = jax.lax.scan(
+        merge_layer, (gk_all, gv_all),
+        (jnp.arange(L), chunk["k"], chunk["v"]))
+    return {"k": new_k, "v": new_v}
+
+
 def _cached_attn_merged(q, gk, gv, ek, ev, gmask, emask, cfg: LlamaConfig):
     """Attention over a read-only grid cache PLUS a small chunk cache,
     without materializing their concatenation.

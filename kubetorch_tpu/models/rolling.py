@@ -541,42 +541,13 @@ class RollingGenerator:
             one, (chunk0, last_logits, pos, window),
             (jnp.arange(n_steps), jax.random.split(key, n_steps)))
 
-        # Merge the chunk into the grid at each slot's offset — the only
-        # per-sequence-offset write, amortized over the whole chunk. A
-        # one-hot EINSUM select, not take_along_axis/scatter: generic
-        # gathers with computed index maps serialize on TPU (measured
-        # ~1.8 s/step — 50× the whole decode step — when this merge was a
-        # full-cache take_along_axis; the same pathology as the scatter
-        # note in _finish_admit). The einsum is matmul-shaped, so it runs
-        # on the MXU at HBM speed, and scanning it per layer keeps the
-        # temp at one layer's [B, M, Hkv, D] instead of the whole grid.
-        cdt = cache["k"].dtype
-        idx = jnp.arange(M)[None, :] - pos0[:, None]           # [B, M]
-        inwin = ((idx >= 0) & (idx < n_steps)
-                 & active[:, None])                            # [B, M]
-        onehot = (jnp.arange(n_steps)[None, None, :] == idx[:, :, None]
-                  )[..., None] & active[:, None, None, None]   # [B,M,K,1]
-        onehot = onehot[..., 0].astype(cdt)                    # [B, M, K]
-
-        def merge_layer(carry, inp):
-            gk_all, gv_all = carry
-            li, ek, ev = inp                       # ek/ev: [B, K, Hkv, D]
-            mk = jnp.einsum("bmk,bkhd->bmhd", onehot,
-                            ek.astype(cdt)).astype(cdt)
-            mv = jnp.einsum("bmk,bkhd->bmhd", onehot,
-                            ev.astype(cdt)).astype(cdt)
-            gk = jax.lax.dynamic_index_in_dim(gk_all, li, 0, keepdims=False)
-            gv = jax.lax.dynamic_index_in_dim(gv_all, li, 0, keepdims=False)
-            gk = jnp.where(inwin[:, :, None, None], mk, gk)
-            gv = jnp.where(inwin[:, :, None, None], mv, gv)
-            gk_all = jax.lax.dynamic_update_index_in_dim(gk_all, gk, li, 0)
-            gv_all = jax.lax.dynamic_update_index_in_dim(gv_all, gv, li, 0)
-            return (gk_all, gv_all), None
-
-        (new_k, new_v), _ = jax.lax.scan(
-            merge_layer, (cache["k"], cache["v"]),
-            (jnp.arange(L), chunk["k"], chunk["v"]))
-        return {"k": new_k, "v": new_v}, logits, pos, toks
+        # Merge the chunk into the grid at each slot's offset — shared
+        # one-hot einsum select (llama.merge_chunk_into_grid; see its
+        # docstring for why never take_along_axis/scatter). Inactive
+        # slots merge nothing: count 0.
+        new_cache = llama.merge_chunk_into_grid(
+            cache, chunk, pos0, jnp.where(active, n_steps, 0))
+        return new_cache, logits, pos, toks
 
 
 class RollingService:

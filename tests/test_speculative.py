@@ -1,0 +1,160 @@
+"""Speculative greedy decoding: exact equivalence + acceptance behavior.
+
+The defining property (models/speculative.py): k>1 output is
+token-identical to non-speculative greedy — drafts only survive where
+they equal the model's own argmax. Pinned three ways: against k=1 (same
+layout, speculation off), against the static ``Generator`` at
+temperature 0, and against a manual argmax rollout.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubetorch_tpu.models import LlamaConfig, llama
+from kubetorch_tpu.models.generate import Generator
+from kubetorch_tpu.models.speculative import (
+    SpeculativeGenerator,
+    _ngram_draft,
+)
+
+pytestmark = pytest.mark.level("unit")
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return LlamaConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return llama.init(jax.random.key(0), cfg)
+
+
+def _greedy_rollout_with_margins(params, cfg, prompt, n):
+    """Manual argmax rollout + per-step top-1/top-2 logit margins.
+
+    The speculative==greedy property is exact only when the k-token
+    verify forward and the 1-token step produce identical argmaxes; on a
+    random-init model the top-1 margin can be ~1e-4, where two
+    differently-compiled XLA programs may legitimately disagree. Tests
+    therefore compare token-for-token only while the reference margin is
+    comfortable, and stop at the first near-tie."""
+    seq = list(prompt)
+    margins = []
+    for _ in range(n):
+        logits = llama.forward(params, jnp.array([seq]), cfg)[0, -1]
+        top2 = jax.lax.top_k(logits, 2)[0]
+        margins.append(float(top2[0] - top2[1]))
+        seq.append(int(jnp.argmax(logits)))
+    return seq[len(prompt):], margins
+
+
+def _strict_prefix(margins, tol=1e-3):
+    """Number of leading steps whose argmax is numerically unambiguous."""
+    for i, m in enumerate(margins):
+        if m < tol:
+            return i
+    return len(margins)
+
+
+def test_ngram_draft_proposes_continuation_of_latest_match():
+    ctx = jnp.zeros((1, 16), jnp.int32)
+    ctx = ctx.at[0, :6].set(jnp.array([1, 2, 3, 4, 1, 2]))
+    clen = jnp.array([6], jnp.int32)
+    nt = jnp.array([3], jnp.int32)
+    cext = ctx.at[0, 6].set(3)
+    drafts = _ngram_draft(cext, clen, nt, n=3, k=4)
+    # suffix [1,2,3] matched at positions 0-2; continuation is [4,1,2]
+    assert drafts.tolist() == [[4, 1, 2]]
+
+
+def test_ngram_draft_no_match_falls_back_to_nt():
+    ctx = jnp.zeros((1, 16), jnp.int32)
+    ctx = ctx.at[0, :4].set(jnp.array([5, 6, 7, 8]))
+    clen = jnp.array([4], jnp.int32)
+    nt = jnp.array([9], jnp.int32)
+    cext = ctx.at[0, 4].set(9)
+    drafts = _ngram_draft(cext, clen, nt, n=3, k=3)
+    assert drafts.tolist() == [[9, 9]]
+
+
+def test_speculative_matches_plain_greedy(cfg, params):
+    """k=6 output == k=1 output == Generator greedy, token for token
+    wherever the argmax is numerically unambiguous (ragged prompts
+    included)."""
+    prompts = [[3, 7, 11, 2, 9], [1, 4], [2, 2, 2, 2, 2, 2, 2, 2]]
+    N = 24
+    spec = SpeculativeGenerator(params, cfg, k=6, ngram=3)
+    plain = SpeculativeGenerator(params, cfg, k=1)
+    gen = Generator(params, cfg)
+
+    out_spec = spec.generate(prompts, max_new_tokens=N)
+    out_plain = plain.generate(prompts, max_new_tokens=N)
+    out_gen = gen.generate(prompts, max_new_tokens=N, temperature=0.0)
+    compared = 0
+    for i, p in enumerate(prompts):
+        _, margins = _greedy_rollout_with_margins(params, cfg, p, N)
+        s = _strict_prefix(margins)
+        assert out_spec[i][:s] == out_plain[i][:s] == out_gen[i][:s]
+        compared += s
+    assert compared >= N, "margins too weak to exercise equivalence"
+    assert all(len(o) == N for o in out_spec)
+
+
+def test_speculative_matches_manual_rollout(cfg, params):
+    prompt = [3, 7, 11, 2, 9]
+    N = 8
+    spec = SpeculativeGenerator(params, cfg, k=4, ngram=2)
+    out = spec.generate([prompt], max_new_tokens=N)[0]
+
+    ref, margins = _greedy_rollout_with_margins(params, cfg, prompt, N)
+    s = _strict_prefix(margins)
+    assert s >= 2, f"degenerate margins {margins}"
+    assert out[:s] == ref[:s]
+
+
+def test_repetitive_context_accepts_multiple_per_pass(cfg, params):
+    """A looping continuation must verify >1 token per model pass; the
+    same budget on k=1 takes one round per token."""
+    # find a prompt whose greedy continuation actually loops: tiny random
+    # models settle into short cycles quickly, so take any greedy rollout
+    # and re-feed its own tail as the prompt.
+    gen = Generator(params, cfg)
+    warm = gen.generate([[5, 9, 13]], max_new_tokens=32,
+                        temperature=0.0)[0]
+    prompt = [5, 9, 13] + warm[:24]
+    spec = SpeculativeGenerator(params, cfg, k=8, ngram=2)
+    out, stats = spec.generate([prompt], max_new_tokens=24,
+                               return_stats=True)
+    plain = SpeculativeGenerator(params, cfg, k=1)
+    outp, pstats = plain.generate([prompt], max_new_tokens=24,
+                                  return_stats=True)
+    _, margins = _greedy_rollout_with_margins(params, cfg, prompt, 24)
+    s = _strict_prefix(margins)
+    assert out[0][:s] == outp[0][:s]
+    assert pstats["rounds"] == 24
+    # the cycle must be picked up by the n-gram draft: strictly fewer
+    # model passes than tokens
+    assert stats["rounds"] < 24, stats
+    assert stats["tokens_per_pass"] > 1.0
+
+
+def test_eos_truncates_mid_acceptance(cfg, params):
+    prompt = [3, 7, 11, 2, 9]
+    full, margins = _greedy_rollout_with_margins(params, cfg, prompt, 8)
+    s = _strict_prefix(margins)
+    assert s >= 2, f"degenerate margins {margins}"
+    # stop on the deepest unambiguous token so the stop still lands
+    # mid-acceptance but never on a numeric near-tie
+    eos = full[min(3, s - 1)]
+    spec = SpeculativeGenerator(params, cfg, k=6, ngram=2)
+    out = spec.generate([prompt], max_new_tokens=8, eos_id=eos)[0]
+    expect = full[:full.index(eos) + 1]
+    assert out == expect
+
+
+def test_k_must_be_positive(cfg, params):
+    with pytest.raises(ValueError):
+        SpeculativeGenerator(params, cfg, k=0)
