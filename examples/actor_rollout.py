@@ -1,0 +1,119 @@
+"""Single-controller actor mode: one driver program, per-pod model shards.
+
+The Monarch-analogue execution mode (reference:
+``serving/monarch_supervisor.py`` — rank 0 drives actors on per-node
+allocators). Here the deployed callable is a *controller program* that owns
+the whole rollout loop; each pod hosts a persistent, stateful
+``RolloutActor`` process it spawns, addresses, and stops. Compare
+``grpo_elastic.py``, where coordination is pull-based through the data
+store — actor mode is the push-based, driver-owns-the-loop topology.
+
+Run (cluster or local backend):
+
+    python examples/actor_rollout.py            # deploys 2 pods
+    python examples/actor_rollout.py --smoke    # in-process, no deploy
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+class RolloutActor:
+    """Stateful per-pod worker: keeps its model + RNG across calls."""
+
+    def __init__(self, shard_id: int = 0, seed: int = 0):
+        import jax
+
+        from kubetorch_tpu.models import LlamaConfig, llama
+
+        self.shard_id = shard_id
+        self.cfg = LlamaConfig.tiny()
+        self.params = llama.init(jax.random.key(seed), self.cfg)
+        self.version = 0
+        self.rollouts = 0
+
+    def set_weights(self, version: int, scale: float):
+        """Weight push from the controller (stand-in for a real tree —
+        see grpo_elastic.py for store-based weight shipping)."""
+        import jax
+
+        self.params = jax.tree.map(lambda x: x * scale, self.params)
+        self.version = version
+        return {"shard": self.shard_id, "version": self.version}
+
+    def rollout(self, prompt, n_tokens: int = 8):
+        from kubetorch_tpu.models.generate import Generator
+
+        gen = Generator(self.params, self.cfg)
+        out = gen.generate([list(prompt)], max_new_tokens=n_tokens,
+                           temperature=0.0)[0]
+        self.rollouts += 1
+        return {"shard": self.shard_id, "version": self.version,
+                "tokens": out, "rollouts_served": self.rollouts}
+
+
+def controller(rounds: int = 2) -> dict:
+    """The deployed callable: runs ONLY on the coordinator pod and drives
+    a RolloutActor on every pod of the service."""
+    import kubetorch_tpu as kt
+
+    m = kt.actors.mesh()
+    fleet = m.spawn(
+        "rollout", RolloutActor,
+        init_args_per_host=[{"kwargs": {"shard_id": i, "seed": i}}
+                            for i in range(m.size)])
+    history = []
+    try:
+        for r in range(rounds):
+            # push a new "weight version", then scatter distinct prompts
+            acks = fleet.call("set_weights", r + 1, 1.0)
+            prompts = [[2 + i, 5, 7] for i in range(fleet.size)]
+            outs = fleet.call_per_host(
+                "rollout", [(p, 6) for p in prompts])
+            history.append({
+                "round": r + 1,
+                "versions": sorted(a["version"] for a in acks),
+                "per_shard_rollouts": [o["rollouts_served"] for o in outs],
+            })
+        # address one actor directly: shard 0's state survives the loop
+        final = fleet.rank(0).call("rollout", [3, 1, 4], 4)
+        return {"mesh_size": m.size, "history": history,
+                "shard0_total_rollouts": final["rollouts_served"]}
+    finally:
+        fleet.stop()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the actor logic in-process (no deploy)")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--rounds", type=int, default=2)
+    args = ap.parse_args()
+
+    if args.smoke:
+        actor = RolloutActor(shard_id=0)
+        actor.set_weights(1, 1.0)
+        out = actor.rollout([2, 5, 7], 6)
+        print(json.dumps({"smoke": True, "rollout": out["tokens"],
+                          "rollouts_served": out["rollouts_served"]}))
+        return
+
+    import kubetorch_tpu as kt
+
+    remote = kt.fn(controller).to(
+        kt.Compute(cpus="0.5").distribute("actor", workers=args.workers,
+                                          monitor_members=False))
+    try:
+        result = remote(rounds=args.rounds)
+        print(json.dumps(result, indent=2))
+        assert result["mesh_size"] == args.workers
+        assert result["shard0_total_rollouts"] == args.rounds + 1
+    finally:
+        remote.teardown()
+
+
+if __name__ == "__main__":
+    main()

@@ -83,3 +83,11 @@ def test_grpo_elastic_smoke(tmp_path):
     result = _run_smoke("grpo_elastic.py", tmp_path)
     assert result["trainer"]["published"] == 2
     assert result["sampler"]["sampled"] == 4
+
+
+@pytest.mark.level("minimal")
+def test_actor_rollout_smoke(tmp_path):
+    result = _run_smoke("actor_rollout.py", tmp_path)
+    assert result["smoke"] is True
+    assert len(result["rollout"]) == 6
+    assert result["rollouts_served"] == 1
