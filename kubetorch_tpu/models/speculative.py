@@ -36,9 +36,15 @@ TPU-first mechanics:
   matching, the K-token verify forward, acceptance-prefix math, the
   merge, and the output scatter all run on device with static shapes.
 
-Greedy only (temperature 0): acceptance for sampled decoding needs
-rejection-sampling bookkeeping that changes the verify contract; the
-static ``Generator``/``RollingGenerator`` cover sampled generation.
+Sampling (temperature > 0) uses speculative **rejection sampling**,
+which is exact for the deterministic n-gram draft: the draft
+distribution is a point mass, so draft ``d`` is accepted with
+probability ``p(d)`` under the (temperature/top-k/top-p filtered)
+target distribution, and on rejection the next token is sampled from
+the residual ``p`` with ``d``'s mass removed and renormalized — the
+emitted sequence is distributed exactly as non-speculative sampling
+(pinned by a Monte-Carlo distribution test). ``repetition_penalty`` is
+not supported here (use the static ``Generator``/``RollingGenerator``).
 """
 
 from __future__ import annotations
@@ -95,11 +101,12 @@ class SpeculativeGenerator:
     >>> outs = gen.generate(prompts, max_new_tokens=128, eos_id=2)
 
     ``k`` tokens are verified per model pass (1 carried token + k-1
-    drafts); ``k=1`` disables speculation (plain greedy in the same
+    drafts); ``k=1`` disables speculation (plain decode in the same
     layout — the equivalence tests pin ``k>1`` output to it token for
-    token). bf16 KV cache only: the verify write is per-sequence
-    multi-token, which the quantized cache's uniform-slot fast path
-    deliberately does not implement.
+    token). ``temperature>0`` switches to exact speculative rejection
+    sampling (module docstring). bf16 KV cache only: the verify write is
+    per-sequence multi-token, which the quantized cache's uniform-slot
+    fast path deliberately does not implement.
     """
 
     def __init__(self, params: Dict[str, Any], cfg: LlamaConfig,
@@ -119,7 +126,8 @@ class SpeculativeGenerator:
             static_argnames=("max_len",))
         self._decode = jax.jit(
             partial(self._decode_impl, cfg=cfg, rules=self.rules),
-            static_argnames=("max_new", "k", "ngram", "eos_id", "pad_id"))
+            static_argnames=("max_new", "k", "ngram", "eos_id", "pad_id",
+                             "temperature", "top_k", "top_p"))
 
     # -------------------------------------------------------------- impl
     @staticmethod
@@ -136,14 +144,36 @@ class SpeculativeGenerator:
         return logits[:, 0], cache
 
     @staticmethod
-    def _decode_impl(params, cache, first_logits, prompt_lens, ctx0, *,
-                     max_new, k, ngram, eos_id, pad_id, cfg, rules):
+    def _decode_impl(params, cache, first_logits, prompt_lens, ctx0, rng, *,
+                     max_new, k, ngram, eos_id, pad_id, temperature,
+                     top_k, top_p, cfg, rules):
+        from kubetorch_tpu.models.generate import (
+            filter_logits,
+            sample_tokens,
+        )
+
         B = first_logits.shape[0]
         M = cache["k"].shape[2]
         L = ctx0.shape[1]
         nL = cache["k"].shape[0]
+        sampled = temperature > 0.0
 
-        nt0 = jnp.argmax(first_logits, axis=-1).astype(jnp.int32)
+        def _probs(lg):
+            # [*, V] filtered target distribution — same tempering/filter
+            # order as generate.sample_tokens, so spec sampling draws from
+            # the identical per-position distribution. filter_logits is
+            # [rows, V]-shaped; flatten any leading dims.
+            shp = lg.shape
+            flat = filter_logits(lg.reshape(-1, shp[-1]) / temperature,
+                                 top_k, top_p)
+            return jax.nn.softmax(flat, axis=-1).reshape(shp)
+
+        if sampled:
+            rng, key0 = jax.random.split(rng)
+            nt0 = sample_tokens(key0, first_logits, temperature,
+                                top_k, top_p).astype(jnp.int32)
+        else:
+            nt0 = jnp.argmax(first_logits, axis=-1).astype(jnp.int32)
         out0 = jnp.full((B, max_new), pad_id, jnp.int32)
         bidx = jnp.arange(B)[:, None]
         chunk0 = {
@@ -153,12 +183,13 @@ class SpeculativeGenerator:
                            cache["v"].dtype)}
 
         def cond(state):
-            _, _, _, _, _, _, _, done, rounds = state
+            _, _, _, _, _, _, _, done, rounds, _ = state
             # done already folds in the token budget (see body's tail)
             return (rounds < max_new) & jnp.any(~done)
 
         def body(state):
-            cache, chunk, ctx, clen, nt, out, out_len, done, rounds = state
+            (cache, chunk, ctx, clen, nt, out, out_len, done, rounds,
+             rng) = state
             # --- draft k-1 tokens from the context (+ nt at slot clen)
             cext = ctx.at[bidx, clen[:, None]].set(nt[:, None], mode="drop")
             if k > 1:
@@ -180,14 +211,60 @@ class SpeculativeGenerator:
             logits, chunk = llama.forward_cached(
                 params, feed, positions, cache, None, gmask, cfg, rules,
                 chunk=chunk, chunk_col=0, chunk_mask=emask)
-            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)    # [B, k]
-            # --- acceptance prefix: drafts[i] (= feed[i+1]) vs g[:, i]
-            if k > 1:
-                ok = (feed[:, 1:] == g[:, :-1])
-                acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32),
-                                          axis=1), axis=1)       # [B] 0..k-1
+            if sampled:
+                # Rejection sampling over the point-mass draft: accept
+                # draft d at position i with prob p_i(d); the first reject
+                # resamples from the residual (p with d's mass removed,
+                # renormalized). Exact: emitted tokens are distributed as
+                # non-speculative sampling from the same filtered p.
+                rng, ku, ks = jax.random.split(rng, 3)
+                probs = _probs(logits)                           # [B,k,V]
+                if k > 1:
+                    p_draft = jnp.take_along_axis(
+                        probs[:, :-1], feed[:, 1:, None],
+                        axis=2)[..., 0]                          # [B,k-1]
+                    u = jax.random.uniform(ku, (B, k - 1))
+                    ok = u < p_draft
+                    acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32),
+                                              axis=1), axis=1)   # 0..k-1
+                else:
+                    acc = jnp.zeros((B,), jnp.int32)
+                # next-token distribution at the break position
+                j = jnp.clip(acc, 0, k - 1)
+                p_j = jnp.take_along_axis(
+                    probs, j[:, None, None], axis=1)[:, 0]       # [B, V]
+                rejected = acc < (k - 1)
+                if k > 1:
+                    d_rej = jnp.take_along_axis(
+                        feed, jnp.clip(acc + 1, 0, k - 1)[:, None],
+                        axis=1)[:, 0]
+                    removed = jnp.where(
+                        rejected[:, None],
+                        jnp.arange(probs.shape[-1])[None, :]
+                        == d_rej[:, None], False)
+                    resid = jnp.where(removed, 0.0, p_j)
+                    total = jnp.sum(resid, axis=-1, keepdims=True)
+                    # p(d)≈1 rejected has ~zero residual mass (measure-
+                    # zero); fall back to p_j rather than divide by ~0
+                    p_next = jnp.where(total > 1e-9, resid / total, p_j)
+                else:
+                    p_next = p_j
+                nxt = jax.random.categorical(
+                    ks, jnp.log(p_next + 1e-30)).astype(jnp.int32)
             else:
-                acc = jnp.zeros((B,), jnp.int32)
+                g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,k]
+                # acceptance prefix: drafts[i] (= feed[i+1]) vs g[:, i]
+                if k > 1:
+                    ok = (feed[:, 1:] == g[:, :-1])
+                    acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32),
+                                              axis=1), axis=1)   # 0..k-1
+                else:
+                    acc = jnp.zeros((B,), jnp.int32)
+                # next carried token: the model's argmax after the last
+                # accepted token (correction on reject, bonus on full
+                # accept)
+                nxt = jnp.take_along_axis(
+                    g, jnp.clip(acc, 0, k - 1)[:, None], axis=1)[:, 0]
             emit = 1 + acc                                       # nt + drafts
             # eos truncation within the emitted prefix
             if eos_id is not None:
@@ -221,20 +298,16 @@ class SpeculativeGenerator:
             cache = llama.merge_chunk_into_grid(cache, chunk, clen, emit)
             clen = clen + emit
             out_len = out_len + emit
-            # next carried token: the model's argmax after the last
-            # accepted token (correction on reject, bonus on full accept)
-            nxt = jnp.take_along_axis(
-                g, jnp.clip(acc, 0, k - 1)[:, None], axis=1)[:, 0]
             nt = jnp.where(new_done, nt, nxt)
             new_done = new_done | (out_len >= max_new)
             return (cache, chunk, ctx, clen, nt, out, out_len, new_done,
-                    rounds + 1)
+                    rounds + 1, rng)
 
         state = (cache, chunk0, ctx0, prompt_lens.astype(jnp.int32), nt0,
                  out0, jnp.zeros((B,), jnp.int32), jnp.zeros((B,), bool),
-                 jnp.int32(0))
+                 jnp.int32(0), rng)
         state = jax.lax.while_loop(cond, body, state)
-        _, _, _, _, _, out, out_len, _, rounds = state
+        out, out_len, rounds = state[5], state[6], state[8]
         return out, out_len, rounds
 
     # -------------------------------------------------------------- api
@@ -244,10 +317,19 @@ class SpeculativeGenerator:
         max_new_tokens: int = 128,
         eos_id: Optional[int] = None,
         return_stats: bool = False,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        seed: int = 0,
     ):
-        """Greedy continuations (token-identical to non-speculative
-        greedy); optionally also per-call stats
-        ``{"rounds", "tokens", "tokens_per_pass"}``."""
+        """Continuations; optionally also per-call stats
+        ``{"rounds", "tokens", "tokens_per_pass"}``.
+
+        ``temperature=0`` (default): greedy, token-identical to
+        non-speculative greedy. ``temperature>0``: speculative rejection
+        sampling — exact samples from the same filtered distribution as
+        ``Generator.generate`` (module docstring), drafts accepted with
+        probability ``p(draft)``."""
         B = len(prompts)
         lens = np.array([len(p) for p in prompts], np.int32)
         if (lens <= 0).any():
@@ -272,8 +354,10 @@ class SpeculativeGenerator:
                 max_len=max_len)
             out, out_len, rounds = self._decode(
                 self.params, cache, first_logits, jnp.asarray(lens),
-                jnp.asarray(ctx0), max_new=max_new_tokens, k=self.k,
-                ngram=self.ngram, eos_id=eos_id, pad_id=self.pad_id)
+                jnp.asarray(ctx0), jax.random.key(seed),
+                max_new=max_new_tokens, k=self.k,
+                ngram=self.ngram, eos_id=eos_id, pad_id=self.pad_id,
+                temperature=float(temperature), top_k=top_k, top_p=top_p)
         out = np.asarray(jax.device_get(out))
         out_len = np.asarray(jax.device_get(out_len))
         rounds = int(jax.device_get(rounds))
