@@ -347,6 +347,53 @@ def profile(service, seconds, pod, rank, out):
                f"(unzip + `tensorboard --logdir`)")
 
 
+@main.command()
+@click.argument("service")
+@click.option("--pod", type=int, default=None,
+              help="only this replica (default: all)")
+@click.option("--stop", default=None, metavar="NAME",
+              help="stop the named actor instead of listing")
+def actors(service, pod, stop):
+    """List (or stop) actors hosted on a single-controller service's pods
+    (``.distribute("actor")`` — see kt.actors)."""
+    import httpx
+
+    from kubetorch_tpu.provisioning.backend import get_backend
+
+    try:
+        urls = get_backend().pod_urls(service)
+    except KeyError:
+        raise click.ClickException(f"no service {service!r}")
+    if pod is not None and not 0 <= pod < len(urls):
+        raise click.ClickException(
+            f"pod index {pod} out of range ({len(urls)} pods)")
+    sel = urls if pod is None else [urls[pod]]
+    with httpx.Client(timeout=30.0) as client:
+        for i, base in enumerate(sel):
+            idx = pod if pod is not None else i
+            if stop:
+                resp = client.delete(f"{base}/_actors/{stop}")
+                if resp.status_code != 200:
+                    click.echo(f"pod {idx}: error {resp.status_code}")
+                    continue
+                ok = resp.json().get("stopped")
+                click.echo(f"pod {idx}: {'stopped' if ok else 'no actor'} "
+                           f"{stop!r}")
+                continue
+            resp = client.get(f"{base}/_actors")
+            if resp.status_code != 200:
+                click.echo(f"pod {idx}: error {resp.status_code}")
+                continue
+            rows = resp.json().get("actors", [])
+            if not rows:
+                click.echo(f"pod {idx}: (no actors)")
+            for a in rows:
+                click.echo(
+                    f"pod {idx}: {a['name']}  class={a['class_name']}  "
+                    f"procs={a['num_procs']}  "
+                    f"{'healthy' if a.get('healthy') else 'DEAD'}")
+
+
 # ---------------------------------------------------------------- runs
 @main.command(context_settings={"ignore_unknown_options": True})
 @click.option("--name", default=None, help="run name prefix")

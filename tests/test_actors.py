@@ -161,6 +161,41 @@ def test_actor_proxy_preserves_stream_shape():
         loop.call_soon_threadsafe(loop.stop)
 
 
+@pytest.mark.level("minimal")
+def test_actors_cli_lists_and_stops(actor_service):
+    """`ktpu actors <svc>` shows live actors; --stop removes one."""
+    from click.testing import CliRunner
+
+    import kubetorch_tpu.provisioning.backend as backend
+    from kubetorch_tpu.actors import ActorMesh
+    from kubetorch_tpu.cli import main as cli_main
+
+    svc = actor_service.service_name
+    urls = backend.get_backend().pod_urls(svc)
+    hosts = [u.split("//", 1)[1] for u in urls]
+    mesh = ActorMesh(hosts)
+    handle = mesh.spawn(
+        "cli-probe", "actormesh:ShardActor",
+        init_args={"kwargs": {"shard_id": 7}},
+        root_path=str(ASSETS))
+    try:
+        runner = CliRunner()
+        res = runner.invoke(cli_main, ["actors", svc])
+        assert res.exit_code == 0, res.output
+        assert "cli-probe" in res.output
+        assert "ShardActor" in res.output and "healthy" in res.output
+
+        res = runner.invoke(cli_main,
+                            ["actors", svc, "--stop", "cli-probe"])
+        assert res.exit_code == 0, res.output
+        assert "stopped" in res.output
+
+        res = runner.invoke(cli_main, ["actors", svc])
+        assert "cli-probe" not in res.output
+    finally:
+        handle.stop()
+
+
 @pytest.mark.level("unit")
 def test_mesh_requires_hosts():
     os.environ.pop("KT_ACTOR_HOSTS", None)

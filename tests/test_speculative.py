@@ -160,7 +160,7 @@ def test_k_must_be_positive(cfg, params):
         SpeculativeGenerator(params, cfg, k=0)
 
 
-def _pair_hist(outs, vocab):
+def _pair_hist(outs):
     import collections
 
     h = collections.Counter()
@@ -190,8 +190,8 @@ def test_sampled_speculation_matches_plain_distribution(cfg, params):
     gen = Generator(params, cfg)
     out_plain = gen.generate(prompts, seed=321, **kw)
 
-    h_spec = _pair_hist(out_spec, cfg.vocab_size)
-    h_plain = _pair_hist(out_plain, cfg.vocab_size)
+    h_spec = _pair_hist(out_spec)
+    h_plain = _pair_hist(out_plain)
     tv = _tv(h_spec, h_plain)
     assert tv < 0.1, (tv, sorted(h_spec.items())[:6],
                       sorted(h_plain.items())[:6])
