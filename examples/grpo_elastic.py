@@ -22,13 +22,20 @@ import argparse
 import json
 
 WEIGHTS_KEY = "grpo/policy-weights"
+ADAPTER_KEY = "grpo/policy-lora"
 
 
 # ---------------------------------------------------------------- trainer
 def grpo_train(rounds: int = 2, group_size: int = 8, seq_len: int = 32,
-               sync_every: int = 1, model: str = "tiny") -> dict:
+               sync_every: int = 1, model: str = "tiny",
+               use_lora: bool = False) -> dict:
     """GRPO: sample G completions per prompt, normalize rewards within the
-    group (advantage = (r - mean) / std), ascend sum(adv * logp)."""
+    group (advantage = (r - mean) / std), ascend sum(adv * logp).
+
+    ``use_lora=True`` is the reference's actual async-GRPO topology: the
+    policy trains LoRA adapters on a frozen base, and weight sync ships
+    ONLY the adapter tree (MBs, ~100× fewer bytes per round than the full
+    tree) — samplers merge into their resident base."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -36,6 +43,7 @@ def grpo_train(rounds: int = 2, group_size: int = 8, seq_len: int = 32,
 
     from kubetorch_tpu.data_store.device_transfer import put_arrays
     from kubetorch_tpu.models import LlamaConfig, llama
+    from kubetorch_tpu.models import lora as lora_mod
     from kubetorch_tpu.parallel import MeshSpec
     from kubetorch_tpu.training import Trainer
 
@@ -52,11 +60,28 @@ def grpo_train(rounds: int = 2, group_size: int = 8, seq_len: int = 32,
         loss = -(advantages * seq_logp).mean()
         return loss, {"mean_seq_logp": seq_logp.mean()}
 
-    trainer = Trainer(cfg, mesh, optimizer=optax.adamw(1e-4),
-                      loss_fn=grpo_loss)
+    if use_lora:
+        from kubetorch_tpu.training.trainer import param_shardings
+
+        lcfg = lora_mod.LoraConfig(rank=8)
+        # frozen base initialized SHARDED — a plain jit would replicate
+        # the full tree per device and defeat fsdp at 1B scale
+        from kubetorch_tpu.parallel.sharding import ShardingRules
+
+        base = jax.jit(
+            lambda k: llama.init(k, cfg),
+            out_shardings=param_shardings(cfg, mesh,
+                                          ShardingRules.default())
+        )(jax.random.key(0))
+        trainer = Trainer.lora(cfg, mesh, base, lcfg,
+                               optimizer=optax.adamw(1e-3),
+                               loss_fn=grpo_loss)
+    else:
+        trainer = Trainer(cfg, mesh, optimizer=optax.adamw(1e-4),
+                          loss_fn=grpo_loss)
 
     rng = np.random.default_rng(0)
-    losses, published = [], 0
+    losses, published, sync_bytes = [], 0, 0
     for round_ix in range(rounds):
         # stand-in rollouts: random token groups + a toy reward
         tokens = jnp.asarray(
@@ -67,18 +92,27 @@ def grpo_train(rounds: int = 2, group_size: int = 8, seq_len: int = 32,
         metrics = trainer.step({"tokens": tokens, "advantages": advantages})
         losses.append(float(metrics["loss"]))
         if (round_ix + 1) % sync_every == 0:
-            put_arrays(WEIGHTS_KEY, trainer.state["params"])
+            tree = trainer.state["params"]
+            key = ADAPTER_KEY if use_lora else WEIGHTS_KEY
+            put_arrays(key, tree)
+            sync_bytes = sum(int(x.size) * x.dtype.itemsize
+                             for x in jax.tree.leaves(tree))
             published += 1
 
-    return {"rounds": rounds, "published": published,
-            "loss_first": round(losses[0], 4),
-            "loss_last": round(losses[-1], 4)}
+    out = {"rounds": rounds, "published": published,
+           "loss_first": round(losses[0], 4),
+           "loss_last": round(losses[-1], 4),
+           "sync_bytes_per_round": sync_bytes}
+    if use_lora:
+        out["base_bytes"] = sum(int(x.size) * x.dtype.itemsize
+                                for x in jax.tree.leaves(base))
+    return out
 
 
 # ---------------------------------------------------------------- sampler
 def grpo_sample(n_prompts: int = 4, seq_len: int = 8,
                 max_new_tokens: int = 8, model: str = "tiny",
-                fleet_size: int = 1) -> dict:
+                fleet_size: int = 1, use_lora: bool = False) -> dict:
     """Pull freshest policy weights, run real KV-cache rollouts.
 
     ``fleet_size`` > 1 tells the store how many samplers are fetching the
@@ -97,12 +131,26 @@ def grpo_sample(n_prompts: int = 4, seq_len: int = 8,
     from kubetorch_tpu.models.rolling import RollingGenerator
 
     cfg = (LlamaConfig.llama3_1b() if model == "1b" else LlamaConfig.tiny())
-    # abstract init (no FLOPs) recovers the param tree structure the
-    # trainer packed, so the blob unflattens to a real param pytree.
-    template = jax.eval_shape(lambda: llama.init(jax.random.key(0), cfg))
     window = (BroadcastWindow(world_size=fleet_size, fanout=3)
               if fleet_size > 1 else None)
-    params = get_arrays(WEIGHTS_KEY, template=template, broadcast=window)
+    if use_lora:
+        # samplers keep the frozen base resident and pull only the tiny
+        # adapter tree each round, merging locally
+        from kubetorch_tpu.models import lora as lora_mod
+
+        lcfg = lora_mod.LoraConfig(rank=8)
+        base = jax.jit(lambda k: llama.init(k, cfg))(jax.random.key(0))
+        template = jax.eval_shape(
+            lambda: lora_mod.init(jax.random.key(0), base, lcfg))
+        adapters = get_arrays(ADAPTER_KEY, template=template,
+                              broadcast=window)
+        params = jax.jit(
+            lambda b, a: lora_mod.merge(b, a, lcfg))(base, adapters)
+    else:
+        # abstract init (no FLOPs) recovers the param tree structure the
+        # trainer packed, so the blob unflattens to a real param pytree.
+        template = jax.eval_shape(lambda: llama.init(jax.random.key(0), cfg))
+        params = get_arrays(WEIGHTS_KEY, template=template, broadcast=window)
     rng = np.random.default_rng(1)
     eng = RollingGenerator(params, cfg, max_slots=min(8, n_prompts),
                            steps_per_call=4)
@@ -130,9 +178,14 @@ def main():
             "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
         train_result = grpo_train(rounds=2)
         sample_result = grpo_sample()
+        # the LoRA weight-sync topology: adapter-only publish + merge
+        lora_train = grpo_train(rounds=2, use_lora=True)
+        lora_sample = grpo_sample(use_lora=True)
         print(json.dumps({"example": "grpo_elastic",
                           "trainer": train_result,
-                          "sampler": sample_result}))
+                          "sampler": sample_result,
+                          "lora_trainer": lora_train,
+                          "lora_sampler": lora_sample}))
         return
 
     import kubetorch_tpu as kt

@@ -18,8 +18,11 @@ def __getattr__(name):
     # __getattr__ before importing, recursing forever on module names.
     import importlib
 
-    if name in ("generate", "quant", "rolling", "speculative"):
+    if name in ("generate", "quant", "rolling", "speculative", "lora"):
         return importlib.import_module(f"kubetorch_tpu.models.{name}")
+    if name == "LoraConfig":
+        return importlib.import_module(
+            "kubetorch_tpu.models.lora").LoraConfig
     if name == "Generator":
         return importlib.import_module(
             "kubetorch_tpu.models.generate").Generator
@@ -37,4 +40,4 @@ def __getattr__(name):
 
 __all__ = ["LlamaConfig", "MoEConfig", "ViTConfig", "llama", "Generator",
            "generate", "quant", "quantize_params", "RollingGenerator",
-           "SpeculativeGenerator", "speculative"]
+           "SpeculativeGenerator", "speculative", "lora", "LoraConfig"]

@@ -63,16 +63,45 @@ def init_train_state(
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
     rules: Optional[ShardingRules] = None,
+    init_fn: Optional[Callable] = None,
 ) -> TrainState:
-    """Initialize params + optimizer state directly sharded on ``mesh``."""
+    """Initialize params + optimizer state directly sharded on ``mesh``.
+
+    ``init_fn(key) -> params`` overrides the llama tree (LoRA adapters,
+    custom heads); its output shardings are left to propagation (adapter
+    trees are small — replication is the right default)."""
     rules = rules or ShardingRules.default()
-    shardings = param_shardings(cfg, mesh, rules)
-    params = jax.jit(partial(llama.init, cfg=cfg), out_shardings=shardings)(key)
+    if init_fn is None:
+        shardings = param_shardings(cfg, mesh, rules)
+        params = jax.jit(partial(llama.init, cfg=cfg),
+                         out_shardings=shardings)(key)
+    else:
+        params = jax.jit(init_fn)(key)
     # zeros_like-derived states inherit param shardings via propagation.
     opt_state = jax.jit(optimizer.init)(params)
     step = jax.device_put(
         jnp.zeros((), jnp.int32), NamedSharding(mesh, PartitionSpec()))
     return {"params": params, "opt_state": opt_state, "step": step}
+
+
+def make_default_loss(cfg: LlamaConfig, rules: ShardingRules,
+                      ring_mesh: Optional[Mesh] = None) -> Callable:
+    """The LM objective: fused chunked cross-entropy over hidden states —
+    never materializes [B, S, V] float32 logits (ops/xent.py)."""
+
+    def default_loss(params, batch):
+        from kubetorch_tpu.ops.xent import fused_cross_entropy
+
+        x = llama.hidden_states(
+            params, batch["inputs"], cfg, rules,
+            segment_ids=batch.get("segment_ids"),
+            positions=batch.get("positions"),  # packed rows: RoPE restarts
+            mesh=ring_mesh)
+        return fused_cross_entropy(
+            x, llama.unembedding(params, cfg), batch["targets"],
+            batch.get("mask"), chunk_size=cfg.xent_chunk)
+
+    return default_loss
 
 
 def make_train_step(
@@ -94,23 +123,7 @@ def make_train_step(
     # Ring attention only engages when sequence parallelism is active.
     ring_mesh = (mesh if mesh is not None
                  and mesh.shape.get("sp", 1) > 1 else None)
-
-    def default_loss(params, batch):
-        # Fused path: never materializes [B, S, V] float32 logits — the
-        # unembedding matmul + xent run chunkwise (ops/xent.py). Cuts ~1 GB
-        # of HBM traffic at Llama scale vs. forward()+cross_entropy_loss.
-        from kubetorch_tpu.ops.xent import fused_cross_entropy
-
-        x = llama.hidden_states(
-            params, batch["inputs"], cfg, rules,
-            segment_ids=batch.get("segment_ids"),
-            positions=batch.get("positions"),  # packed rows: RoPE restarts
-            mesh=ring_mesh)
-        return fused_cross_entropy(
-            x, llama.unembedding(params, cfg), batch["targets"],
-            batch.get("mask"), chunk_size=cfg.xent_chunk)
-
-    compute_loss = loss_fn or default_loss
+    compute_loss = loss_fn or make_default_loss(cfg, rules, ring_mesh)
     grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
 
     def compute_grads(params, batch):
@@ -190,10 +203,13 @@ class Trainer:
         seed: int = 0,
         loss_fn=None,
         accum_steps: int = 1,
+        init_fn=None,
     ):
         """``loss_fn(params, batch) -> (loss, aux_dict)`` overrides the LM
         cross-entropy objective (RL losses, distillation, ...).
-        ``accum_steps`` enables gradient accumulation over microbatches."""
+        ``accum_steps`` enables gradient accumulation over microbatches.
+        ``init_fn(key) -> params`` overrides the trained tree (see
+        :meth:`lora`)."""
         self.cfg = cfg
         self.mesh = mesh
         self.rules = rules or ShardingRules.default()
@@ -201,10 +217,48 @@ class Trainer:
             3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
         with use_mesh(self.mesh):
             self.state = init_train_state(
-                jax.random.key(seed), cfg, mesh, self.optimizer, self.rules)
+                jax.random.key(seed), cfg, mesh, self.optimizer, self.rules,
+                init_fn=init_fn)
             self._step = make_train_step(cfg, self.optimizer, self.rules,
                                          loss_fn=loss_fn, mesh=mesh,
                                          accum_steps=accum_steps)
+
+    @classmethod
+    def lora(
+        cls,
+        cfg: LlamaConfig,
+        mesh: Mesh,
+        base_params,
+        lora_cfg,
+        optimizer: Optional[optax.GradientTransformation] = None,
+        rules: Optional[ShardingRules] = None,
+        seed: int = 0,
+        accum_steps: int = 1,
+        loss_fn=None,
+    ) -> "Trainer":
+        """LoRA fine-tuning: ``state["params"]`` is the adapter tree; the
+        frozen base keeps whatever sharding the caller gave it (init it
+        through ``param_shardings`` on multi-device meshes — a plainly
+        jitted base replicates per device and defeats FSDP) and the loss
+        differentiates through ``lora.merge`` (models/lora.py — exact
+        LoRA gradients, no model-code changes). Adam state is
+        adapter-sized, so configs whose full-tree optimizer state OOMs
+        fine-tune comfortably.
+
+        ``loss_fn(params, batch) -> (loss, aux)`` overrides the LM
+        objective (GRPO/RL losses — see examples/grpo_elastic.py); it
+        receives the MERGED params."""
+        from kubetorch_tpu.models import lora as lora_mod
+
+        rules = rules or ShardingRules.default()
+        if loss_fn is None:
+            ring_mesh = mesh if mesh.shape.get("sp", 1) > 1 else None
+            loss_fn = make_default_loss(cfg, rules, ring_mesh)
+        loss = lora_mod.make_lora_loss(loss_fn, base_params, lora_cfg)
+        return cls(
+            cfg, mesh, optimizer=optimizer, rules=rules, seed=seed,
+            loss_fn=loss, accum_steps=accum_steps,
+            init_fn=lambda key: lora_mod.init(key, base_params, lora_cfg))
 
     def step(self, batch: Dict[str, jax.Array]):
         with use_mesh(self.mesh):
