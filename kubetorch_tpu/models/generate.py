@@ -89,17 +89,33 @@ class Generator:
 
     def __init__(self, params: Dict[str, Any], cfg: LlamaConfig,
                  mesh=None, rules: Optional[ShardingRules] = None,
-                 pad_id: int = 0, kv_dtype: str = "bf16"):
+                 pad_id: int = 0, kv_dtype: str = "bf16",
+                 adapters=None, adapter_scale: Optional[float] = None):
         """``kv_dtype="int8"``: per-vector-quantized KV cache — halves
         the decode's cache stream and residency (the batch ceiling moves
         up accordingly); greedy outputs are near-identical to the bf16
-        cache (argmax flips on near-ties only — pinned in tests)."""
+        cache (argmax flips on near-ties only — pinned in tests).
+
+        ``adapters``: multi-adapter serving — a stacked tree from
+        ``models.lora.stack_adapters`` (``{name: {"a": [L,n,K,r],
+        "b": [L,n,r,N]}}``); each request picks its adapter via
+        ``generate(..., adapter_ids=[...])`` (index -1 = base model).
+        ``adapter_scale`` defaults to LoraConfig's alpha/rank — pass the
+        value used in training."""
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
         self.rules = rules or ShardingRules.default()
         self.pad_id = pad_id
         self.kv_quantized = kv_dtype == "int8"
+        self.adapters = adapters
+        if adapters is not None and adapter_scale is None:
+            raise ValueError(
+                "adapters need adapter_scale (= LoraConfig.scale used "
+                "in training)")
+        self.adapter_scale = adapter_scale
+        self.n_adapters = (next(iter(adapters.values()))["a"].shape[1]
+                           if adapters is not None else 0)
         self._prefill = jax.jit(
             partial(self._prefill_impl, cfg=cfg, rules=self.rules,
                     quantized=self.kv_quantized),
@@ -113,8 +129,8 @@ class Generator:
 
     # -------------------------------------------------------------- impl
     @staticmethod
-    def _prefill_impl(params, tokens, prompt_lens, *, max_len, cfg, rules,
-                      quantized=False):
+    def _prefill_impl(params, tokens, prompt_lens, lora, *, max_len, cfg,
+                      rules, quantized=False):
         B, P = tokens.shape
         positions = jnp.broadcast_to(jnp.arange(P)[None, :], (B, P))
         # causal over the prompt region; pad queries produce unused rows.
@@ -126,11 +142,12 @@ class Generator:
         # full [B, P, V] logits would be GBs of HBM at 128k vocab.
         logits, cache = llama.forward_cached(
             params, tokens, positions, cache, 0, mask, cfg, rules,
-            unembed_positions=prompt_lens - 1)
+            unembed_positions=prompt_lens - 1, lora=lora)
         return logits[:, 0], cache
 
     @staticmethod
-    def _decode_impl(params, cache, first_logits, prompt_lens, rng, win0, *,
+    def _decode_impl(params, cache, first_logits, prompt_lens, rng, win0,
+                     lora, *,
                      n_steps, temperature, top_k, top_p, eos_id, pad_id,
                      repetition_penalty, cfg, rules):
         B = first_logits.shape[0]
@@ -165,7 +182,7 @@ class Generator:
                     | ((slot_idx >= Pmax) & (slot_idx <= write_at)))[:, None, :]
             logits, cache = llama.forward_cached(
                 params, tok[:, None], positions, cache, write_at, mask,
-                cfg, rules)
+                cfg, rules, lora=lora)
             return (cache, logits[:, 0], done, rng, win), tok
 
         done0 = jnp.zeros((B,), bool)
@@ -186,10 +203,13 @@ class Generator:
         seed: int = 0,
         repetition_penalty: float = 1.0,
         stop: Optional[Sequence[Sequence[int]]] = None,
+        adapter_ids: Optional[Sequence[int]] = None,
     ) -> List[List[int]]:
         """Generate continuations; returns per-prompt token lists
         (truncated at ``eos_id`` if given, which is included).
 
+        ``adapter_ids`` (multi-adapter serving): per-prompt index into
+        the stacked adapter tree; -1 serves the bare base model.
         ``repetition_penalty`` (HF semantics, last-64-token window; seeded
         from the prompt tail) runs inside the scan. ``stop`` sequences trim
         post-hoc — the static scan still runs ``max_new_tokens`` steps, so
@@ -219,13 +239,33 @@ class Generator:
             for i, p in enumerate(prompts):
                 tail = list(p)[-W:]
                 win0[i, -len(tail):] = tail
+        lora = None
+        if self.adapters is not None:
+            ids = [-1] * B if adapter_ids is None else list(adapter_ids)
+            if len(ids) != B:
+                raise ValueError(
+                    f"adapter_ids has {len(ids)} entries for {B} prompts")
+            onehot = np.zeros((B, self.n_adapters), np.float32)
+            for i, a in enumerate(ids):
+                if not -1 <= a < self.n_adapters:
+                    raise ValueError(
+                        f"adapter id {a} out of range "
+                        f"({self.n_adapters} adapters; -1 = base)")
+                if a >= 0:
+                    onehot[i, a] = 1.0
+            lora = {"adapters": self.adapters,
+                    "onehot": jnp.asarray(onehot),
+                    "scale": float(self.adapter_scale)}
+        elif adapter_ids is not None:
+            raise ValueError("adapter_ids passed but Generator has no "
+                             "adapters")
         with ctx:
             first_logits, cache = self._prefill(
-                self.params, jnp.asarray(toks), jnp.asarray(lens),
+                self.params, jnp.asarray(toks), jnp.asarray(lens), lora,
                 max_len=max_len)
             out, done = self._decode(
                 self.params, cache, first_logits, jnp.asarray(lens),
-                jax.random.key(seed), jnp.asarray(win0),
+                jax.random.key(seed), jnp.asarray(win0), lora,
                 n_steps=max_new_tokens,
                 temperature=float(temperature), top_k=top_k, top_p=top_p,
                 eos_id=eos_id, pad_id=self.pad_id,

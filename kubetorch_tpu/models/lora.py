@@ -113,6 +113,75 @@ def make_lora_loss(base_loss_fn, base_params, lcfg: LoraConfig):
     return loss
 
 
+def stack_adapters(adapters, lcfg: LoraConfig,
+                   layer_names=None) -> Dict[str, Any]:
+    """Stack N adapter trees for multi-adapter batched serving.
+
+    Returns ``{name: {"a": [L, n, K, r], "b": [L, n, r, N]}}`` — layer-
+    major so the tree rides the decode layer scan as xs, adapter axis
+    second for the per-slot one-hot select (llama._lora_apply).
+
+    ``layer_names``: the serving layer dict's weight names. When the
+    model was fused for decode (``quant.fuse_decode_layers``:
+    wq/wk/wv → "wqkv", w_gate/w_up → "wgu"), per-target adapters fuse
+    too: A-factors concatenate on the rank axis and B-factors become a
+    block-diagonal over the concatenated output — algebraically exactly
+    the concatenated per-target deltas.
+    """
+    if not adapters:
+        raise ValueError("no adapters to stack")
+    names = list(adapters[0])
+    for ad in adapters[1:]:
+        if list(ad) != names:
+            raise ValueError("adapter trees disagree on targets")
+
+    def stacked(name):
+        a = jnp.stack([ad[name]["a"] for ad in adapters], axis=1)
+        b = jnp.stack([ad[name]["b"] for ad in adapters], axis=1)
+        return a, b  # [L, n, K, r], [L, n, r, N]
+
+    fuse_groups = []
+    if layer_names is not None:
+        if "wqkv" in layer_names:
+            fuse_groups.append(("wqkv", ("wq", "wk", "wv")))
+        if "wgu" in layer_names:
+            fuse_groups.append(("wgu", ("w_gate", "w_up")))
+    fused_members = {m for _, ms in fuse_groups for m in ms}
+
+    out: Dict[str, Any] = {}
+    for name in names:
+        if name in fused_members:
+            continue
+        a, b = stacked(name)
+        out[name] = {"a": a, "b": b}
+    for fused_name, members in fuse_groups:
+        present = [m for m in members if m in names]
+        if not present:
+            continue
+        if len(present) != len(members):
+            # a partially-covered fuse group would need the missing
+            # members' output widths to place the block-diagonal slices;
+            # demand full coverage rather than guess
+            raise ValueError(
+                f"fused serving layout: LoRA targets must cover all of "
+                f"{members} or none (have {tuple(present)}) — add the "
+                f"missing targets to LoraConfig or serve unfused")
+        parts = [stacked(m) for m in present]
+        a = jnp.concatenate([p[0] for p in parts], axis=-1)   # rank axis
+        widths = [p[1].shape[-1] for p in parts]
+        L, n, r, _ = parts[0][1].shape
+        btot = jnp.zeros((L, n, r * len(parts), sum(widths)),
+                         parts[0][1].dtype)
+        ro = co = 0
+        for p, w in zip(parts, widths):
+            btot = jax.lax.dynamic_update_slice(
+                btot, p[1], (0, 0, ro, co))
+            ro += r
+            co += w
+        out[fused_name] = {"a": a, "b": btot}
+    return out
+
+
 def num_params(lora: Dict[str, Any]) -> int:
     return sum(int(jnp.size(v)) for ab in lora.values()
                for v in ab.values())

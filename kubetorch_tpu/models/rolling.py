@@ -54,7 +54,7 @@ def _bucket(n: int, lo: int = 16) -> int:
 class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature",
                  "tokens", "done", "slot", "prefix_id", "stop",
-                 "repetition_penalty")
+                 "repetition_penalty", "adapter_id")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature):
         self.rid = rid
@@ -67,6 +67,7 @@ class Request:
         self.prefix_id: Optional[int] = None
         self.stop: List[List[int]] = []
         self.repetition_penalty: float = 1.0
+        self.adapter_id: int = -1
 
     def match_stop(self) -> Optional[int]:
         """Earliest index (exclusive) at which a stop sequence completes in
@@ -97,7 +98,8 @@ class RollingGenerator:
                  mesh=None, rules: Optional[ShardingRules] = None,
                  eos_id: Optional[int] = None, top_k: Optional[int] = None,
                  top_p: Optional[float] = None, seed: int = 0,
-                 steps_per_call: int = 8, admit_width: int = 0):
+                 steps_per_call: int = 8, admit_width: int = 0,
+                 adapters=None, adapter_scale: Optional[float] = None):
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
@@ -116,6 +118,17 @@ class RollingGenerator:
         self.top_p = top_p
         self.steps_per_call = max(1, steps_per_call)
         self._rng = jax.random.key(seed)
+        # multi-adapter serving (models/lora.py stack_adapters): per-slot
+        # one-hot rides every prefill/decode call; zero row = base model
+        self.adapters = adapters
+        if adapters is not None and adapter_scale is None:
+            raise ValueError("adapters need adapter_scale "
+                             "(= LoraConfig.scale used in training)")
+        self.adapter_scale = adapter_scale
+        self.n_adapters = (next(iter(adapters.values()))["a"].shape[1]
+                           if adapters is not None else 0)
+        self._slot_onehot = np.zeros((max_slots, max(self.n_adapters, 1)),
+                                     np.float32)
 
         # device-resident decode state
         self.cache = llama.init_cache(cfg, max_slots, self.max_len)
@@ -162,12 +175,26 @@ class RollingGenerator:
                temperature: float = 0.0,
                prefix_id: Optional[int] = None,
                stop: Optional[List[List[int]]] = None,
-               repetition_penalty: float = 1.0) -> int:
+               repetition_penalty: float = 1.0,
+               adapter_id: int = -1) -> int:
         """``stop``: token sequences that terminate generation when they
         appear (included in the output, like ``eos_id``). Checked host-side
         per chunk — multi-token stop strings cost nothing on device.
         ``repetition_penalty`` > 1 discounts tokens seen in the last 64
         positions (HF semantics), applied on device inside the scan."""
+        if adapter_id >= 0:
+            if self.adapters is None:
+                raise ValueError("adapter_id passed but engine has no "
+                                 "adapters")
+            if adapter_id >= self.n_adapters:
+                raise ValueError(f"adapter id {adapter_id} out of range "
+                                 f"({self.n_adapters} adapters)")
+            if prefix_id is not None:
+                # a shared prefix's KV was computed with the BASE model;
+                # silently mixing it with an adapted suffix would be a
+                # correctness lie — keep them exclusive
+                raise ValueError("prefix_id and adapter_id are mutually "
+                                 "exclusive (prefix KV is base-model)")
         prefix_len = 0
         if prefix_id is not None:
             if prefix_id not in self._prefixes:
@@ -187,6 +214,7 @@ class RollingGenerator:
         req.prefix_id = prefix_id
         req.stop = [list(s) for s in (stop or []) if s]
         req.repetition_penalty = float(repetition_penalty)
+        req.adapter_id = adapter_id
         self._queue.append(req)
         return rid
 
@@ -265,10 +293,16 @@ class RollingGenerator:
         toks = np.zeros((n_pad, p_pad), np.int32)
         lens = np.ones(n_pad, np.int32)
         slots = np.full(n_pad, self.max_slots, np.int32)  # OOB → dropped
+        oh = np.zeros((n_pad, max(self.n_adapters, 1)), np.float32)
         for i, req in enumerate(group):
             toks[i, :len(req.prompt)] = req.prompt
             lens[i] = len(req.prompt)
             slots[i] = req.slot
+            self._slot_onehot[req.slot] = 0.0
+            aid = getattr(req, "adapter_id", -1)
+            if aid >= 0:
+                oh[i, aid] = 1.0
+                self._slot_onehot[req.slot, aid] = 1.0
             self._temps[req.slot] = req.temperature
             self._penalties[req.slot] = req.repetition_penalty
             W = self._win.shape[1]
@@ -283,7 +317,8 @@ class RollingGenerator:
                  self._dactive) = self._prefill(
                     self.params, self.cache, self._logits, self._dpos,
                     self._dactive, jnp.asarray(toks), jnp.asarray(lens),
-                    jnp.asarray(slots), p_pad=p_pad)
+                    jnp.asarray(slots), self._lora(oh),
+                    p_pad=p_pad)
             else:
                 pfx = self._prefixes[prefix_id]
                 (self.cache, self._logits, self._dpos,
@@ -292,6 +327,15 @@ class RollingGenerator:
                     self._dactive, pfx["k"], pfx["v"],
                     jnp.int32(pfx["len"]), jnp.asarray(toks),
                     jnp.asarray(lens), jnp.asarray(slots), p_pad=p_pad)
+
+    def _lora(self, onehot_np):
+        """None when no adapters — the hot path must not pay a
+        host->device onehot upload it would discard."""
+        if self.adapters is None:
+            return None
+        return {"adapters": self.adapters,
+                "onehot": jnp.asarray(onehot_np),
+                "scale": float(self.adapter_scale)}
 
     def _mesh_ctx(self):
         import contextlib
@@ -308,6 +352,7 @@ class RollingGenerator:
                 self.params, self.cache, self._logits, self._dpos,
                 self._dactive, jnp.asarray(self._temps),
                 jnp.asarray(self._penalties), jnp.asarray(self._win), key,
+                self._lora(self._slot_onehot),
                 top_k=self.top_k, top_p=self.top_p,
                 n_steps=self.steps_per_call)
         toks = np.asarray(toks)                       # [K, B] — the one sync
@@ -352,6 +397,7 @@ class RollingGenerator:
             idx = jnp.asarray(freed, jnp.int32)
             self._dactive = self._dactive.at[idx].set(False)
             self._dpos = self._dpos.at[idx].set(0)
+            self._slot_onehot[freed] = 0.0
             for slot in freed:
                 self._win[slot] = -1
                 self._penalties[slot] = 1.0
@@ -361,7 +407,7 @@ class RollingGenerator:
     # ------------------------------------------------------------- jitted
     @staticmethod
     def _prefill_impl(params, cache, logits, dpos, dactive, tokens,
-                      prompt_lens, slots, *, p_pad, cfg, rules):
+                      prompt_lens, slots, lora, *, p_pad, cfg, rules):
         """Prefill N slots at once: one forward over a private N-row
         cache, then scatter the rows into the shared grid at ``slots``
         (out-of-range dummy rows drop).
@@ -379,7 +425,7 @@ class RollingGenerator:
         own = llama.init_cache(cfg, N, p_pad, dtype=cache["k"].dtype)
         out, own = llama.forward_cached(
             params, tokens, positions, own, 0, mask, cfg, rules,
-            unembed_positions=prompt_lens - 1)
+            unembed_positions=prompt_lens - 1, lora=lora)
         return RollingGenerator._finish_admit(
             cache, own, out[:, 0], logits, dpos, dactive, slots,
             prompt_lens)
@@ -471,7 +517,7 @@ class RollingGenerator:
 
     @staticmethod
     def _decode_impl(params, cache, last_logits, pos, active, temps,
-                     penalties, window, key, *,
+                     penalties, window, key, lora, *,
                      top_k, top_p, n_steps, cfg, rules):
         """``n_steps`` tokens for every slot, each at its own depth, in one
         ``lax.scan`` — one dispatch, one emitted [K, B] block.
@@ -534,7 +580,8 @@ class RollingGenerator:
                      & active[:, None, None])
             out, chunk = llama.forward_cached(
                 params, tok[:, None], positions, cache, None, gmask, cfg,
-                rules, chunk=chunk, chunk_col=j, chunk_mask=emask)
+                rules, chunk=chunk, chunk_col=j, chunk_mask=emask,
+                lora=lora)
             return (chunk, out[:, 0], pos + 1, win), tok
 
         (chunk, logits, pos, _), toks = jax.lax.scan(
@@ -576,7 +623,8 @@ class RollingService:
     def generate(self, prompt, max_new_tokens: int = 128,
                  temperature: float = 0.0, prefix_id: Optional[int] = None,
                  stop: Optional[List[List[int]]] = None,
-                 timeout: Optional[float] = None) -> List[int]:
+                 timeout: Optional[float] = None,
+                 adapter_id: int = -1) -> List[int]:
         """Submit and block until this request finishes; other callers'
         requests decode in the same chunks meanwhile."""
         import time as _time
@@ -585,7 +633,8 @@ class RollingService:
         with self._wake:
             rid = self.engine.submit(prompt, max_new_tokens=max_new_tokens,
                                      temperature=temperature,
-                                     prefix_id=prefix_id, stop=stop)
+                                     prefix_id=prefix_id, stop=stop,
+                                     adapter_id=adapter_id)
             self._results[rid] = []
             self._done[rid] = False
             self._wake.notify_all()
@@ -600,7 +649,8 @@ class RollingService:
     def generate_iter(self, prompt, max_new_tokens: int = 128,
                       temperature: float = 0.0,
                       prefix_id: Optional[int] = None,
-                      stop: Optional[List[List[int]]] = None):
+                      stop: Optional[List[List[int]]] = None,
+                      adapter_id: int = -1):
         """Yield tokens as decode chunks land — compose with the call
         path's result streaming for end-to-end token streaming."""
         import queue as _queue
@@ -609,7 +659,8 @@ class RollingService:
         with self._wake:
             rid = self.engine.submit(prompt, max_new_tokens=max_new_tokens,
                                      temperature=temperature,
-                                     prefix_id=prefix_id, stop=stop)
+                                     prefix_id=prefix_id, stop=stop,
+                                     adapter_id=adapter_id)
             self._live[rid] = live
             self._wake.notify_all()
         while True:

@@ -252,7 +252,8 @@ class Trainer:
 
         rules = rules or ShardingRules.default()
         if loss_fn is None:
-            ring_mesh = mesh if mesh.shape.get("sp", 1) > 1 else None
+            ring_mesh = (mesh if mesh is not None
+                         and mesh.shape.get("sp", 1) > 1 else None)
             loss_fn = make_default_loss(cfg, rules, ring_mesh)
         loss = lora_mod.make_lora_loss(loss_fn, base_params, lora_cfg)
         return cls(

@@ -170,5 +170,6 @@ def test_int8_kv_cache_greedy_agreement():
     assert agree >= 66, (agree, ref, q8)   # ≥92% of 72 tokens
     # the quantized cache really is int8 + scales (not silently bf16)
     _, cache = Generator(params, cfg, kv_dtype="int8")._prefill(
-        params, jnp.asarray([[1, 2, 3, 0]]), jnp.asarray([3]), max_len=8)
+        params, jnp.asarray([[1, 2, 3, 0]]), jnp.asarray([3]), None,
+        max_len=8)
     assert cache["k"].dtype == jnp.int8 and "ks" in cache
