@@ -624,11 +624,58 @@ def merge_chunk_into_grid(cache: Dict[str, jax.Array],
     K = chunk["k"].shape[2]
     M = gk_all.shape[2]
     L = gk_all.shape[0]
-    cdt = gk_all.dtype
+    quantized = "ks" in cache
+    cdt = jnp.bfloat16 if quantized else gk_all.dtype
     idx = jnp.arange(M)[None, :] - start[:, None]              # [B, M]
     inwin = (idx >= 0) & (idx < count[:, None])
     onehot = (jnp.arange(K)[None, None, :] == idx[:, :, None]
               ).astype(cdt) * inwin[:, :, None].astype(cdt)    # [B, M, K]
+
+    if quantized:
+        # int8 grid: quantize the chunk rows first, then one-hot-select
+        # the int8 values and their per-vector scales into the grid's
+        # planes. Selection on int8-as-f32 is exact (0/1 weights, values
+        # in [-127, 127]).
+        gks_all, gvs_all = cache["ks"], cache["vs"]
+
+        def merge_layer_q(carry, inp):
+            gk_all, gv_all, gks_all, gvs_all = carry
+            li, ek, ev = inp                   # ek/ev: [B, K, Hkv, D]
+            qk, sk = _kv_quantize(ek)
+            qv, sv = _kv_quantize(ev)
+            ohf = onehot.astype(jnp.float32)
+            mk = jnp.einsum("bmk,bkhd->bmhd", ohf,
+                            qk.astype(jnp.float32))
+            mv = jnp.einsum("bmk,bkhd->bmhd", ohf,
+                            qv.astype(jnp.float32))
+            msk = jnp.einsum("bmk,bkh->bmh", ohf, sk)
+            msv = jnp.einsum("bmk,bkh->bmh", ohf, sv)
+            gk = jax.lax.dynamic_index_in_dim(gk_all, li, 0,
+                                              keepdims=False)
+            gv = jax.lax.dynamic_index_in_dim(gv_all, li, 0,
+                                              keepdims=False)
+            gks = jax.lax.dynamic_index_in_dim(gks_all, li, 0,
+                                               keepdims=False)
+            gvs = jax.lax.dynamic_index_in_dim(gvs_all, li, 0,
+                                               keepdims=False)
+            w4 = inwin[:, :, None, None]
+            w3 = inwin[:, :, None]
+            gk = jnp.where(w4, mk.astype(jnp.int8), gk)
+            gv = jnp.where(w4, mv.astype(jnp.int8), gv)
+            gks = jnp.where(w3, msk, gks)
+            gvs = jnp.where(w3, msv, gvs)
+            gk_all = jax.lax.dynamic_update_index_in_dim(gk_all, gk, li, 0)
+            gv_all = jax.lax.dynamic_update_index_in_dim(gv_all, gv, li, 0)
+            gks_all = jax.lax.dynamic_update_index_in_dim(
+                gks_all, gks, li, 0)
+            gvs_all = jax.lax.dynamic_update_index_in_dim(
+                gvs_all, gvs, li, 0)
+            return (gk_all, gv_all, gks_all, gvs_all), None
+
+        (new_k, new_v, new_ks, new_vs), _ = jax.lax.scan(
+            merge_layer_q, (gk_all, gv_all, gks_all, gvs_all),
+            (jnp.arange(L), chunk["k"], chunk["v"]))
+        return {"k": new_k, "v": new_v, "ks": new_ks, "vs": new_vs}
 
     def merge_layer(carry, inp):
         gk_all, gv_all = carry
@@ -680,6 +727,72 @@ def _cached_attn_merged(q, gk, gv, ek, ev, gmask, emask, cfg: LlamaConfig):
            + jnp.einsum("bkgtm,bmkd->btkgd", p[..., M:],
                         ev.astype(jnp.float32)))
     return out.reshape(B, T, H, D).astype(q.dtype)
+
+
+def _cached_attn_merged_q(q, gk, gv, gks, gvs, ek, ev, gmask, emask,
+                          cfg: LlamaConfig):
+    """Merged grid+chunk attention over a QUANTIZED grid.
+
+    gk/gv int8 [B,M,Hkv,D] with per-vector scales gks/gvs [B,M,Hkv];
+    ek/ev bf16 chunk [B,K,Hkv,D]. Exactly `_cached_attn_merged` with the
+    int8 path's scale folding (scores·ks after the QK contraction,
+    p·vs before the PV one) applied to the grid half only — one softmax
+    spans both sources, so rolling decode can run the serving grid at
+    half the cache bytes and residency."""
+    B, T, H, D = q.shape
+    Hkv = gk.shape[2]
+    G = H // Hkv
+    qg = q.reshape(B, T, Hkv, G, D)
+    qb = qg.astype(jnp.bfloat16)
+    sg = jnp.einsum("btkgd,bmkd->bkgtm", qb, gk.astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32) * (D ** -0.5)
+    sg = sg * gks.transpose(0, 2, 1)[:, :, None, None, :]
+    se = jnp.einsum("btkgd,bmkd->bkgtm", qb, ek.astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32) * (D ** -0.5)
+    sg = jnp.where(gmask[:, None, None, :, :], sg, -1e30)
+    se = jnp.where(emask[:, None, None, :, :], se, -1e30)
+    p = jax.nn.softmax(jnp.concatenate([sg, se], axis=-1), axis=-1)
+    M = gk.shape[1]
+    pg = (p[..., :M] * gvs.transpose(0, 2, 1)[:, :, None, None, :]
+          ).astype(jnp.bfloat16)
+    out = (jnp.einsum("bkgtm,bmkd->btkgd", pg, gv.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+           + jnp.einsum("bkgtm,bmkd->btkgd",
+                        p[..., M:].astype(jnp.bfloat16),
+                        ev.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32))
+    return out.reshape(B, T, H, D).astype(q.dtype)
+
+
+def _block_cached_chunk_q(x, layer, li, sin, cos, gk_all, gv_all, gks_all,
+                          gvs_all, ek_all, ev_all, col, gmask, emask,
+                          cfg: LlamaConfig, rules: ShardingRules,
+                          lctx=None):
+    """Chunk-mode decoder block over a QUANTIZED read-only grid; the
+    step's K/V land bf16 at uniform chunk column ``col``."""
+    dt = cfg.compute_dtype
+    B, T, _ = x.shape
+    H, D = cfg.n_heads, cfg.head_dim
+    q, k, v = _qkv_proj(x, layer, sin, cos, cfg, lctx)
+
+    cdt = ek_all.dtype
+    ek_all = jax.lax.dynamic_update_slice(
+        ek_all, k.astype(cdt)[None], (li, 0, col, 0, 0))
+    ev_all = jax.lax.dynamic_update_slice(
+        ev_all, v.astype(cdt)[None], (li, 0, col, 0, 0))
+    gk = jax.lax.dynamic_index_in_dim(gk_all, li, 0, keepdims=False)
+    gv = jax.lax.dynamic_index_in_dim(gv_all, li, 0, keepdims=False)
+    gks = jax.lax.dynamic_index_in_dim(gks_all, li, 0, keepdims=False)
+    gvs = jax.lax.dynamic_index_in_dim(gvs_all, li, 0, keepdims=False)
+    ek = jax.lax.dynamic_index_in_dim(ek_all, li, 0, keepdims=False)
+    ev = jax.lax.dynamic_index_in_dim(ev_all, li, 0, keepdims=False)
+
+    attn = _cached_attn_merged_q(q, gk, gv, gks, gvs, ek, ev, gmask,
+                                 emask, cfg).reshape(B, T, H * D)
+    x = x + _proj(attn, layer, "wo", dt) \
+        + _lora_apply(attn, lctx, "wo")
+    x = x + _mlp(x, layer, cfg, rules, lctx)
+    return x, ek_all, ev_all
 
 
 def _block_cached_chunk(x, layer, li, sin, cos, gk_all, gv_all, ek_all,
@@ -768,8 +881,10 @@ def _block_cached_q(x, layer, li, sin, cos, ck_all, cv_all, ks_all, vs_all,
                     write_at, mask, cfg: LlamaConfig, rules: ShardingRules,
                     lctx=None):
     """Decoder block over a QUANTIZED cache (int8 K/V + per-vector
-    scales). Scalar ``write_at`` only (the static Generator's uniform
-    slots — rolling keeps bf16 for now): this step's K/V quantize on
+    scales). Scalar ``write_at`` only — used by the static Generator's
+    uniform slots AND by rolling admission prefills over a private
+    quantized own-cache (``RollingGenerator(kv_dtype="int8")``, which
+    splices the rows into the int8 grid): this step's K/V quantize on
     write, attention dequants via scale folding."""
     dt = cfg.compute_dtype
     B, T, _ = x.shape
@@ -908,13 +1023,34 @@ def forward_cached(
             return None
         return (lslice, lora["onehot"], lora["scale"])
 
+    if "ks" in cache and chunk is not None:
+        # quantized READ-ONLY grid + bf16 chunk (rolling decode at int8
+        # serving density): the returned dict is the updated CHUNK
+        grid_k, grid_v = cache["k"], cache["v"]
+        grid_ks, grid_vs = cache["ks"], cache["vs"]
+
+        def scan_chunk_q(carry, inp):
+            x, ek_all, ev_all = carry
+            layer, li, lslice = inp
+            x, ek_all, ev_all = _block_cached_chunk_q(
+                x, layer, li, sin, cos, grid_k, grid_v, grid_ks, grid_vs,
+                ek_all, ev_all, chunk_col, mask, chunk_mask, cfg, rules,
+                lctx_of(lslice))
+            return (x, ek_all, ev_all), None
+
+        (x, new_k, new_v), _ = jax.lax.scan(
+            scan_chunk_q, (x, chunk["k"], chunk["v"]),
+            (params["layers"], jnp.arange(n_layers), ltree))
+        x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+        if unembed_positions is not None:
+            x = jnp.take_along_axis(
+                x, unembed_positions[:, None, None], axis=1)
+        logits = jnp.einsum("bse,ev->bsv", x, unembedding(params, cfg))
+        return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
     if "ks" in cache:
         # quantized cache (int8 + per-vector scales): scalar write_at
         # (static Generator path)
-        assert chunk is None, (
-            "chunk-mode decode over a quantized cache is not supported "
-            "(RollingGenerator keeps a bf16 grid) — silently dropping "
-            "the chunk write would corrupt generation")
 
         def scan_q(carry, inp):
             x, ck_all, cv_all, ks_all, vs_all = carry

@@ -99,7 +99,14 @@ class RollingGenerator:
                  eos_id: Optional[int] = None, top_k: Optional[int] = None,
                  top_p: Optional[float] = None, seed: int = 0,
                  steps_per_call: int = 8, admit_width: int = 0,
-                 adapters=None, adapter_scale: Optional[float] = None):
+                 adapters=None, adapter_scale: Optional[float] = None,
+                 kv_dtype: str = "bf16"):
+        """``kv_dtype="int8"``: per-vector-quantized grid — halves the
+        serving cache's stream and residency, moving the slot ceiling the
+        same way it moved the static Generator's batch ceiling (112 → 192
+        at 8B). Decode chunks stay bf16 and quantize at the once-per-chunk
+        merge; admission prefills quantize on write. Shared prefixes
+        (``register_prefix``) require the bf16 grid."""
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
@@ -131,7 +138,12 @@ class RollingGenerator:
                                      np.float32)
 
         # device-resident decode state
-        self.cache = llama.init_cache(cfg, max_slots, self.max_len)
+        if kv_dtype not in ("bf16", "int8"):
+            raise ValueError(f"kv_dtype must be 'bf16' or 'int8', "
+                             f"got {kv_dtype!r}")
+        self.kv_quantized = kv_dtype == "int8"
+        self.cache = llama.init_cache(cfg, max_slots, self.max_len,
+                                      quantized=self.kv_quantized)
         self._logits = jnp.zeros((max_slots, cfg.vocab_size), jnp.float32)
         self._dpos = jnp.zeros((max_slots,), jnp.int32)
         self._dactive = jnp.zeros((max_slots,), bool)
@@ -254,6 +266,10 @@ class RollingGenerator:
         prefix's KV rows are copied into the slot at admission. vLLM's
         prefix-caching idea at slot granularity (static shapes: the prefix
         KV block is [L, 1, p_pad, Hkv, D])."""
+        if self.kv_quantized:
+            raise ValueError(
+                "register_prefix requires the bf16 grid (prefix KV blocks "
+                "splice in unquantized) — use kv_dtype='bf16'")
         tokens = list(tokens)
         p_pad = _bucket(len(tokens))
         toks = np.zeros((1, p_pad), np.int32)
@@ -422,7 +438,10 @@ class RollingGenerator:
         m = jnp.arange(p_pad)[None, None, :]
         t = positions[:, :, None]
         mask = (m <= t) & (m < prompt_lens[:, None, None])
-        own = llama.init_cache(cfg, N, p_pad, dtype=cache["k"].dtype)
+        own = llama.init_cache(cfg, N, p_pad,
+                               dtype=(None if "ks" in cache
+                                      else cache["k"].dtype),
+                               quantized="ks" in cache)
         out, own = llama.forward_cached(
             params, tokens, positions, own, 0, mask, cfg, rules,
             unembed_positions=prompt_lens - 1, lora=lora)
@@ -446,18 +465,18 @@ class RollingGenerator:
         B = cache["k"].shape[1]
         M_own = own["k"].shape[2]
         onehot = slots[None, :] == jnp.arange(B)[:, None]       # [B, N]
-        valid = onehot.any(axis=1)[None, :, None, None, None]
         sel = jnp.argmax(onehot, axis=1)                        # [B]
-        cache = {
-            "k": jax.lax.dynamic_update_slice_in_dim(
-                cache["k"],
-                jnp.where(valid, own["k"][:, sel],
-                          cache["k"][:, :, :M_own]), 0, axis=2),
-            "v": jax.lax.dynamic_update_slice_in_dim(
-                cache["v"],
-                jnp.where(valid, own["v"][:, sel],
-                          cache["v"][:, :, :M_own]), 0, axis=2),
-        }
+        any_valid = onehot.any(axis=1)
+
+        def splice(plane_c, plane_o):
+            # plane-generic (int8 grids add 4-D ks/vs scale planes)
+            v = any_valid.reshape((1, B) + (1,) * (plane_c.ndim - 2))
+            return jax.lax.dynamic_update_slice_in_dim(
+                plane_c,
+                jnp.where(v, plane_o[:, sel], plane_c[:, :, :M_own]),
+                0, axis=2)
+
+        cache = {kk: splice(cache[kk], own[kk]) for kk in cache}
         logits = logits.at[slots].set(last, mode="drop")
         dpos = dpos.at[slots].set(new_pos, mode="drop")
         dactive = dactive.at[slots].set(True, mode="drop")
@@ -545,9 +564,10 @@ class RollingGenerator:
         # chunk cache. So the grid mask is loop-invariant.
         gmask = ((jnp.arange(M)[None, None, :] < pos0[:, None, None])
                  & active[:, None, None])
+        cdt = (jnp.bfloat16 if "ks" in cache else cache["k"].dtype)
         chunk0 = {
-            "k": jnp.zeros((L, B, n_steps, Hkv, D), cache["k"].dtype),
-            "v": jnp.zeros((L, B, n_steps, Hkv, D), cache["v"].dtype),
+            "k": jnp.zeros((L, B, n_steps, Hkv, D), cdt),
+            "v": jnp.zeros((L, B, n_steps, Hkv, D), cdt),
         }
 
         def one(carry, inp):
