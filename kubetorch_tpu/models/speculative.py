@@ -104,16 +104,22 @@ class SpeculativeGenerator:
     drafts); ``k=1`` disables speculation (plain decode in the same
     layout — the equivalence tests pin ``k>1`` output to it token for
     token). ``temperature>0`` switches to exact speculative rejection
-    sampling (module docstring). bf16 KV cache only: the verify write is
-    per-sequence multi-token, which the quantized cache's uniform-slot
-    fast path deliberately does not implement.
+    sampling (module docstring). ``kv_dtype="int8"`` runs the quantized
+    grid (serving density): the verify forward reads the int8 grid + a
+    bf16 chunk and only the accepted prefix quantizes into the grid at
+    the merge — same machinery as the int8 rolling engine.
     """
 
     def __init__(self, params: Dict[str, Any], cfg: LlamaConfig,
                  mesh=None, rules: Optional[ShardingRules] = None,
-                 pad_id: int = 0, k: int = 8, ngram: int = 3):
+                 pad_id: int = 0, k: int = 8, ngram: int = 3,
+                 kv_dtype: str = "bf16"):
         if k < 1:
             raise ValueError("k must be >= 1")
+        if kv_dtype not in ("bf16", "int8"):
+            raise ValueError(f"kv_dtype must be 'bf16' or 'int8', "
+                             f"got {kv_dtype!r}")
+        self.kv_quantized = kv_dtype == "int8"
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
@@ -122,8 +128,9 @@ class SpeculativeGenerator:
         self.k = int(k)
         self.ngram = int(ngram)
         self._prefill = jax.jit(
-            partial(self._prefill_impl, cfg=cfg, rules=self.rules),
-            static_argnames=("max_len",))
+            partial(self._prefill_impl, cfg=cfg, rules=self.rules,
+                    quantized=self.kv_quantized),
+            static_argnames=("max_len", "quantized"))
         self._decode = jax.jit(
             partial(self._decode_impl, cfg=cfg, rules=self.rules),
             static_argnames=("max_new", "k", "ngram", "eos_id", "pad_id",
@@ -131,13 +138,14 @@ class SpeculativeGenerator:
 
     # -------------------------------------------------------------- impl
     @staticmethod
-    def _prefill_impl(params, tokens, prompt_lens, *, max_len, cfg, rules):
+    def _prefill_impl(params, tokens, prompt_lens, *, max_len, cfg, rules,
+                      quantized=False):
         B, P = tokens.shape
         positions = jnp.broadcast_to(jnp.arange(P)[None, :], (B, P))
         m = jnp.arange(max_len)[None, None, :]
         t = jnp.arange(P)[None, :, None]
         mask = (m <= t) & (m < prompt_lens[:, None, None])
-        cache = llama.init_cache(cfg, B, max_len)
+        cache = llama.init_cache(cfg, B, max_len, quantized=quantized)
         logits, cache = llama.forward_cached(
             params, tokens, positions, cache, 0, mask, cfg, rules,
             unembed_positions=prompt_lens - 1)
@@ -176,11 +184,10 @@ class SpeculativeGenerator:
             nt0 = jnp.argmax(first_logits, axis=-1).astype(jnp.int32)
         out0 = jnp.full((B, max_new), pad_id, jnp.int32)
         bidx = jnp.arange(B)[:, None]
+        cdt = jnp.bfloat16 if "ks" in cache else cache["k"].dtype
         chunk0 = {
-            "k": jnp.zeros((nL, B, k) + cache["k"].shape[3:],
-                           cache["k"].dtype),
-            "v": jnp.zeros((nL, B, k) + cache["v"].shape[3:],
-                           cache["v"].dtype)}
+            "k": jnp.zeros((nL, B, k) + cache["k"].shape[3:], cdt),
+            "v": jnp.zeros((nL, B, k) + cache["v"].shape[3:], cdt)}
 
         def cond(state):
             _, _, _, _, _, _, _, done, rounds, _ = state

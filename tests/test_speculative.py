@@ -230,3 +230,24 @@ def test_sampled_first_token_matches_exact_probs(cfg, params):
     tv = 0.5 * sum(abs(h.get(t, 0) / B - p[t])
                    for t in range(cfg.vocab_size) if p[t] > 0 or t in h)
     assert tv < 0.08, (tv, h.most_common(6))
+
+
+def test_int8_grid_speculation(cfg, params):
+    """kv_dtype='int8': speculation over the quantized serving grid —
+    same mechanism, quantization near-ties aside."""
+    gen = Generator(params, cfg)
+    warm = gen.generate([[5, 9, 13]], max_new_tokens=32,
+                        temperature=0.0)[0]
+    prompt = [5, 9, 13] + warm[:24]
+    spec_q = SpeculativeGenerator(params, cfg, k=8, ngram=2,
+                                  kv_dtype="int8")
+    out, stats = spec_q.generate([prompt], max_new_tokens=24,
+                                 return_stats=True)
+    assert len(out[0]) == 24
+    assert stats["rounds"] < 24          # speculation engaged
+    spec_b = SpeculativeGenerator(params, cfg, k=8, ngram=2)
+    ref = spec_b.generate([prompt], max_new_tokens=24)[0]
+    agree = sum(a == b for a, b in zip(out[0], ref))
+    assert agree >= 16, (agree, out, ref)
+    with pytest.raises(ValueError, match="kv_dtype"):
+        SpeculativeGenerator(params, cfg, kv_dtype="fp4")
