@@ -59,8 +59,11 @@ class LlamaServer:
                 RollingService,
             )
 
+            # int8 KV grid: half the serving cache stream/residency —
+            # the bench's primary rolling config (slot ceiling 192 at 8B)
             self.service = RollingService(RollingGenerator(
-                gen_params, cfg, max_slots=max_slots, top_p=0.95))
+                gen_params, cfg, max_slots=max_slots, top_p=0.95,
+                kv_dtype="int8"))
 
     def generate(self, prompts, max_new_tokens: int = 32,
                  temperature: float = 0.8, top_p: float = 0.95,
