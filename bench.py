@@ -280,15 +280,15 @@ def _bench_8b_decode(P=128, N=128):
         lens = np.full((b,), P, np.int32)
         first_logits, cache = gen._prefill(
             params, jax.numpy.asarray(prompts), jax.numpy.asarray(lens),
-            max_len=P + N)
+            None, max_len=P + N)
         win0 = jax.numpy.asarray(np.full((b, 64), -1, np.int32))
         kw = dict(n_steps=N, temperature=0.8, top_k=None, top_p=None,
                   eos_id=None, pad_id=0, repetition_penalty=1.0)
         args = (params, cache, first_logits, jax.numpy.asarray(lens))
-        out, _ = gen._decode(*args, jax.random.key(0), win0, **kw)
+        out, _ = gen._decode(*args, jax.random.key(0), win0, None, **kw)
         np.asarray(jax.device_get(out))
         t0 = time.perf_counter()
-        out, _ = gen._decode(*args, jax.random.key(1), win0, **kw)
+        out, _ = gen._decode(*args, jax.random.key(1), win0, None, **kw)
         np.asarray(jax.device_get(out))
         dt = time.perf_counter() - t0
         emb_bytes = params["embedding"].nbytes
