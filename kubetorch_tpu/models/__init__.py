@@ -18,7 +18,8 @@ def __getattr__(name):
     # __getattr__ before importing, recursing forever on module names.
     import importlib
 
-    if name in ("generate", "quant", "rolling", "speculative", "lora"):
+    if name in ("generate", "quant", "rolling", "speculative", "lora",
+                "embed"):
         return importlib.import_module(f"kubetorch_tpu.models.{name}")
     if name == "LoraConfig":
         return importlib.import_module(
@@ -35,9 +36,13 @@ def __getattr__(name):
     if name == "RollingGenerator":
         return importlib.import_module(
             "kubetorch_tpu.models.rolling").RollingGenerator
+    if name == "Embedder":
+        return importlib.import_module(
+            "kubetorch_tpu.models.embed").Embedder
     raise AttributeError(name)
 
 
 __all__ = ["LlamaConfig", "MoEConfig", "ViTConfig", "llama", "Generator",
            "generate", "quant", "quantize_params", "RollingGenerator",
-           "SpeculativeGenerator", "speculative", "lora", "LoraConfig"]
+           "SpeculativeGenerator", "speculative", "lora", "LoraConfig",
+           "embed", "Embedder"]

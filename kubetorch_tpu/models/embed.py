@@ -29,8 +29,8 @@ POOLINGS = ("mean", "last", "first")
 
 def _embed_impl(params, tokens, lens, *, pooling, normalize, cfg, rules):
     B, P = tokens.shape
+    # hidden_states already applies the final RMS norm
     x = llama.hidden_states(params, tokens, cfg, rules)      # [B, P, E]
-    x = llama.rms_norm(x, params["final_norm"], cfg.rms_eps)
     x = x.astype(jnp.float32)
     mask = (jnp.arange(P)[None, :] < lens[:, None])
     if pooling == "mean":

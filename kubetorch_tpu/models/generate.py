@@ -116,6 +116,12 @@ class Generator:
         self.adapter_scale = adapter_scale
         self.n_adapters = (next(iter(adapters.values()))["a"].shape[1]
                            if adapters is not None else 0)
+        if adapters is not None:
+            from kubetorch_tpu.models.lora import validate_adapter_targets
+
+            # fail fast on fused/unfused target mismatch (a missing
+            # target silently contributes a zero delta inside the model)
+            validate_adapter_targets(adapters, params["layers"])
         self._prefill = jax.jit(
             partial(self._prefill_impl, cfg=cfg, rules=self.rules,
                     quantized=self.kv_quantized),

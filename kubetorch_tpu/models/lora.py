@@ -140,12 +140,12 @@ def stack_adapters(adapters, lcfg: LoraConfig,
         b = jnp.stack([ad[name]["b"] for ad in adapters], axis=1)
         return a, b  # [L, n, K, r], [L, n, r, N]
 
+    from kubetorch_tpu.models.quant import FUSE_GROUPS
+
     fuse_groups = []
     if layer_names is not None:
-        if "wqkv" in layer_names:
-            fuse_groups.append(("wqkv", ("wq", "wk", "wv")))
-        if "wgu" in layer_names:
-            fuse_groups.append(("wgu", ("w_gate", "w_up")))
+        fuse_groups = [(f, ms) for f, ms in FUSE_GROUPS
+                       if f in layer_names]
     fused_members = {m for _, ms in fuse_groups for m in ms}
 
     out: Dict[str, Any] = {}
@@ -180,6 +180,42 @@ def stack_adapters(adapters, lcfg: LoraConfig,
             co += w
         out[fused_name] = {"a": a, "b": btot}
     return out
+
+
+def _fuse_map() -> Dict[str, str]:
+    from kubetorch_tpu.models.quant import FUSE_GROUPS
+
+    return {m: f for f, ms in FUSE_GROUPS for m in ms}
+
+
+def validate_adapter_targets(adapters: Dict[str, Any],
+                             layers: Dict[str, Any]) -> None:
+    """Raise unless every stacked-adapter target exists in the serving
+    layer dict.
+
+    ``llama._lora_apply`` returns 0 for a target name the layer dict
+    doesn't carry — convenient inside the model, but lethal at the API
+    boundary: adapters stacked WITHOUT ``layer_names`` but served on a
+    fused tree (``quant.fuse_decode_layers``: wq/wk/wv→wqkv,
+    w_gate/w_up→wgu) would silently drop their qkv and gate/up deltas
+    while wo/w_down still apply — partially-adapted outputs with no
+    error. Engines call this at init so the mismatch fails fast.
+    """
+    missing = [t for t in adapters if t not in layers]
+    if not missing:
+        return
+    fmap = _fuse_map()
+    fused = sorted({fmap[t] for t in missing if fmap.get(t) in layers})
+    if fused:
+        raise ValueError(
+            f"adapter targets {sorted(missing)} are absent from the "
+            f"serving layer dict, which carries the FUSED weights "
+            f"{fused} — re-stack with stack_adapters(..., "
+            f"layer_names=params['layers']) so the adapters fuse the "
+            f"same way")
+    raise ValueError(
+        f"adapter targets {sorted(missing)} not found in the serving "
+        f"layer dict (have {sorted(layers)})")
 
 
 def num_params(lora: Dict[str, Any]) -> int:

@@ -44,6 +44,13 @@ def _quantize_leaf(w: jax.Array):
     return q.astype(jnp.int8), scale.astype(w.dtype)
 
 
+# The decode-layout fuse groups — single source of truth shared by
+# fuse_decode_layers (weights), lora.stack_adapters (adapter factors),
+# and lora.validate_adapter_targets (the fused/unfused mismatch hint).
+FUSE_GROUPS = (("wqkv", ("wq", "wk", "wv")),
+               ("wgu", ("w_gate", "w_up")))
+
+
 def fuse_decode_layers(layers: Dict[str, Any]) -> Dict[str, Any]:
     """Pack same-input quantized projections into single weights.
 
@@ -58,8 +65,7 @@ def fuse_decode_layers(layers: Dict[str, Any]) -> Dict[str, Any]:
     not (keep the unfused tree for anything but a Generator).
     """
     layers = dict(layers)
-    for fused, parts in (("wqkv", ("wq", "wk", "wv")),
-                         ("wgu", ("w_gate", "w_up"))):
+    for fused, parts in FUSE_GROUPS:
         if not all(p in layers and p + "_scale" in layers for p in parts):
             continue
         layers[fused] = jnp.concatenate([layers[p] for p in parts], axis=-1)

@@ -134,6 +134,12 @@ class RollingGenerator:
         self.adapter_scale = adapter_scale
         self.n_adapters = (next(iter(adapters.values()))["a"].shape[1]
                            if adapters is not None else 0)
+        if adapters is not None:
+            from kubetorch_tpu.models.lora import validate_adapter_targets
+
+            # fail fast on fused/unfused target mismatch (a missing
+            # target silently contributes a zero delta inside the model)
+            validate_adapter_targets(adapters, params["layers"])
         self._slot_onehot = np.zeros((max_slots, max(self.n_adapters, 1)),
                                      np.float32)
 
@@ -194,13 +200,15 @@ class RollingGenerator:
         per chunk — multi-token stop strings cost nothing on device.
         ``repetition_penalty`` > 1 discounts tokens seen in the last 64
         positions (HF semantics), applied on device inside the scan."""
+        if adapter_id >= 0 and self.adapters is None:
+            raise ValueError("adapter_id passed but engine has no "
+                             "adapters")
+        if adapter_id != -1 and not 0 <= adapter_id < self.n_adapters:
+            # mirror Generator: -1 = base model; any other negative is a
+            # caller bug, not a base-model request
+            raise ValueError(f"adapter id {adapter_id} out of range "
+                             f"({self.n_adapters} adapters; -1 = base)")
         if adapter_id >= 0:
-            if self.adapters is None:
-                raise ValueError("adapter_id passed but engine has no "
-                                 "adapters")
-            if adapter_id >= self.n_adapters:
-                raise ValueError(f"adapter id {adapter_id} out of range "
-                                 f"({self.n_adapters} adapters)")
             if prefix_id is not None:
                 # a shared prefix's KV was computed with the BASE model;
                 # silently mixing it with an adapted suffix would be a

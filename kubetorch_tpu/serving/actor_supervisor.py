@@ -94,7 +94,7 @@ class ActorSupervisor(DistributedSupervisor):
                     "landed on a non-coordinator pod")
             return self._proxy_to_coordinator(
                 body, serialization_method, method, query=query,
-                request_id=request_id)
+                request_id=request_id, timeout=timeout)
         resp = self.pool.call(
             body, serialization_method, method=method,
             allowed=self.allowed, timeout=timeout)
@@ -102,8 +102,8 @@ class ActorSupervisor(DistributedSupervisor):
         return resp
 
     def _proxy_to_coordinator(self, body, ser, method, query=None,
-                              request_id=None) -> dict:
-        from kubetorch_tpu.serving.http_client import sync_client
+                              request_id=None, timeout=None) -> dict:
+        from kubetorch_tpu.serving.http_client import sync_client, proxy_timeout
 
         target = (f"{_entry_url(self.coord_entry)}/"
                   f"{self.metadata.get('name')}")
@@ -121,8 +121,13 @@ class ActorSupervisor(DistributedSupervisor):
             headers["X-KT-Stream"] = "request"
         if request_id:
             headers["X-Request-ID"] = request_id
+        # Bounded even when the caller set no timeout: every
+        # non-coordinator pod proxies through here, so an unbounded wait
+        # on a hung coordinator would pin the proxying pod's executor
+        # thread forever (ADVICE r4).
         resp = sync_client().post(target, content=body, params=params,
-                                  headers=headers, timeout=None)
+                                  headers=headers,
+                                  timeout=proxy_timeout(timeout))
         if resp.status_code != 200:
             try:
                 error = resp.json().get("error")

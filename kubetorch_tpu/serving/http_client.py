@@ -26,6 +26,19 @@ _sync_client: Optional[httpx.Client] = None
 _async_client: Optional[httpx.AsyncClient] = None
 
 
+def proxy_timeout(timeout: Optional[float] = None) -> httpx.Timeout:
+    """Timeout for pod→pod proxy hops (actor coordinator / Ray head).
+
+    The caller's explicit timeout wins; otherwise a bounded default
+    (``KT_PROXY_TIMEOUT``, seconds) — a hung peer must not pin the
+    proxying pod's executor thread indefinitely."""
+    import os
+
+    if timeout is None:
+        timeout = float(os.environ.get("KT_PROXY_TIMEOUT", "600"))
+    return httpx.Timeout(connect=10.0, read=timeout, write=60.0, pool=10.0)
+
+
 def sync_client() -> httpx.Client:
     """Shared pooled client (reference: serving/global_http_clients.py)."""
     global _sync_client

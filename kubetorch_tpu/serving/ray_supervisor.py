@@ -130,7 +130,7 @@ class RaySupervisor(ExecutionSupervisor):
                             query=query, **kwargs)
 
     def _proxy_to_head(self, body, ser, method, query=None,
-                       request_id=None, **_ignored) -> dict:
+                       request_id=None, timeout=None, **_ignored) -> dict:
         """Forward the call verbatim: the original query string (carrying
         restart_procs / workers / timeout and any user params) and the
         request id must survive the hop, or call semantics would depend on
@@ -152,9 +152,13 @@ class RaySupervisor(ExecutionSupervisor):
             headers["X-KT-Stream"] = "request"
         if request_id:
             headers["X-Request-ID"] = request_id
+        from kubetorch_tpu.serving.http_client import proxy_timeout
+
+        # Bounded even without a caller timeout — a hung head must not
+        # pin the proxying pod's executor thread forever (ADVICE r4).
         resp = sync_client().post(
             target, content=body, params=params, headers=headers,
-            timeout=None)
+            timeout=proxy_timeout(timeout))
         if resp.status_code != 200:
             try:
                 error = resp.json().get("error")

@@ -173,3 +173,35 @@ def test_int8_kv_cache_greedy_agreement():
         params, jnp.asarray([[1, 2, 3, 0]]), jnp.asarray([3]), None,
         max_len=8)
     assert cache["k"].dtype == jnp.int8 and "ks" in cache
+
+
+def test_embedder_poolings(cfg, params):
+    """Pooled embeddings: shapes, normalization, and pooling semantics
+    against a hand-computed mean over the final hidden states."""
+    from kubetorch_tpu.models.embed import Embedder
+
+    prompts = [[1, 5, 9, 2], [3, 7]]
+    emb = Embedder(params, cfg, pooling="mean", normalize=True)
+    vecs = emb.embed(prompts)
+    assert vecs.shape == (2, cfg.embed_dim)
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=-1), 1.0,
+                               rtol=1e-5)
+    # mean pooling == masked mean of hidden_states (no double final-norm)
+    toks = jnp.zeros((1, 16), jnp.int32).at[0, :4].set(
+        jnp.asarray(prompts[0]))
+    h = np.asarray(llama.hidden_states(params, toks, cfg),
+                   np.float32)[0, :4]
+    want = h.mean(axis=0)
+    want = want / np.linalg.norm(want)
+    np.testing.assert_allclose(vecs[0], want, rtol=2e-3, atol=2e-3)
+    # last/first pooling pick the right positions
+    last = Embedder(params, cfg, pooling="last", normalize=False).embed(
+        prompts)
+    np.testing.assert_allclose(last[0], h[3], rtol=2e-3, atol=2e-3)
+    first = Embedder(params, cfg, pooling="first", normalize=False).embed(
+        prompts)
+    np.testing.assert_allclose(first[0], h[0], rtol=2e-3, atol=2e-3)
+    with pytest.raises(ValueError, match="pooling"):
+        Embedder(params, cfg, pooling="max")
+    import kubetorch_tpu.models as M
+    assert M.Embedder is Embedder

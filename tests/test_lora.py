@@ -288,3 +288,47 @@ def test_stack_partial_fused_coverage_raises(cfg, params):
     # unfused layout: partial targets are fine
     out = stack_adapters(ads, lcfg)
     assert set(out) == {"wq", "wv", "wo"}
+
+
+def test_fused_tree_unfused_adapters_rejected(cfg, params):
+    """Adapters stacked WITHOUT layer_names must not silently lose their
+    qkv/gate-up deltas on a fused serving tree (ADVICE r4 medium)."""
+    from kubetorch_tpu.models.generate import Generator
+    from kubetorch_tpu.models.lora import stack_adapters
+    from kubetorch_tpu.models.quant import (
+        fuse_decode_layers,
+        quantize_params,
+    )
+    from kubetorch_tpu.models.rolling import RollingGenerator
+
+    lcfg = LoraConfig(rank=2)
+    stacked = stack_adapters(
+        [lora_mod.init(jax.random.key(0), params, lcfg)], lcfg)
+    qparams = jax.jit(quantize_params)(params)
+    qparams = {**qparams, "layers": fuse_decode_layers(qparams["layers"])}
+    with pytest.raises(ValueError, match="stack_adapters"):
+        Generator(qparams, cfg, kv_dtype="int8", adapters=stacked,
+                  adapter_scale=lcfg.scale)
+    with pytest.raises(ValueError, match="stack_adapters"):
+        RollingGenerator(qparams, cfg, kv_dtype="int8", adapters=stacked,
+                         adapter_scale=lcfg.scale)
+    # correctly re-stacked adapters pass the same check
+    ok = stack_adapters([lora_mod.init(jax.random.key(0), params, lcfg)],
+                        lcfg, layer_names=set(qparams["layers"]))
+    Generator(qparams, cfg, kv_dtype="int8", adapters=ok,
+              adapter_scale=lcfg.scale)
+
+
+def test_rolling_negative_adapter_id_rejected(cfg, params):
+    from kubetorch_tpu.models.lora import stack_adapters
+    from kubetorch_tpu.models.rolling import RollingGenerator
+
+    lcfg = LoraConfig(rank=2)
+    stacked = stack_adapters(
+        [lora_mod.init(jax.random.key(0), params, lcfg)], lcfg)
+    eng = RollingGenerator(params, cfg, max_slots=2, adapters=stacked,
+                           adapter_scale=lcfg.scale)
+    with pytest.raises(ValueError, match="out of range"):
+        eng.submit([1, 2], adapter_id=-5)
+    # -1 = base model stays valid
+    eng.submit([1, 2], max_new_tokens=2, adapter_id=-1)
