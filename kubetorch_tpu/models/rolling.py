@@ -100,13 +100,30 @@ class RollingGenerator:
                  top_p: Optional[float] = None, seed: int = 0,
                  steps_per_call: int = 8, admit_width: int = 0,
                  adapters=None, adapter_scale: Optional[float] = None,
-                 kv_dtype: str = "bf16"):
+                 kv_dtype: str = "bf16", spec_k: int = 0,
+                 spec_ngram: int = 3):
         """``kv_dtype="int8"``: per-vector-quantized grid — halves the
         serving cache's stream and residency, moving the slot ceiling the
         same way it moved the static Generator's batch ceiling (112 → 192
         at 8B). Decode chunks stay bf16 and quantize at the once-per-chunk
-        merge; admission prefills quantize on write. Shared prefixes
-        (``register_prefix``) require the bf16 grid."""
+        merge; admission prefills quantize on write.
+
+        ``spec_k > 1``: speculative continuous batching — each decode
+        "step" becomes a VERIFY ROUND: per-slot prompt-lookup (n-gram)
+        drafts of ``spec_k − 1`` tokens ride one chunk-mode forward of
+        ``spec_k`` tokens, and only each slot's accepted prefix merges
+        into the grid (``models/speculative.py`` machinery, per-slot
+        depths). Greedy output stays token-identical to the plain engine;
+        ``steps_per_call`` then counts rounds per dispatch, so one
+        dispatch can emit up to ``steps_per_call × spec_k`` tokens per
+        slot. Decode is weight-bound below the compute roofline, so at
+        low-to-mid occupancy every accepted draft is nearly free — this
+        is the latency-regime lever vLLM gets from its n-gram speculator.
+        Composes with the int8 grid (verify reads int8 grid + bf16 chunk;
+        accepted prefixes quantize at the merge) and per-request LoRA
+        (the adapter one-hot rides the verify forward; drafting is
+        model-free). Greedy only: ``submit`` rejects ``temperature > 0``
+        and ``repetition_penalty != 1`` on a speculative engine."""
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
@@ -147,12 +164,25 @@ class RollingGenerator:
         if kv_dtype not in ("bf16", "int8"):
             raise ValueError(f"kv_dtype must be 'bf16' or 'int8', "
                              f"got {kv_dtype!r}")
+        if spec_k < 0 or spec_k == 1:
+            raise ValueError("spec_k must be 0 (off) or >= 2")
         self.kv_quantized = kv_dtype == "int8"
+        self.spec_k = spec_k
+        self.spec_ngram = spec_ngram
+        self.spec = spec_k > 1
         self.cache = llama.init_cache(cfg, max_slots, self.max_len,
                                       quantized=self.kv_quantized)
         self._logits = jnp.zeros((max_slots, cfg.vocab_size), jnp.float32)
         self._dpos = jnp.zeros((max_slots,), jnp.int32)
         self._dactive = jnp.zeros((max_slots,), bool)
+        if self.spec:
+            # device-resident token context per slot (prompt + accepted
+            # tokens) — the n-gram draft matcher's haystack. Width
+            # max_len + 1 so the carried token can sit at slot pos.
+            self._ctx = jnp.zeros((max_slots, self.max_len + 1), jnp.int32)
+            # acceptance accounting for the serving bench / stats API
+            self._spec_rounds = 0
+            self._spec_emitted = 0
 
         # host bookkeeping
         self._free = list(range(max_slots))
@@ -178,16 +208,47 @@ class RollingGenerator:
             static_argnames=("top_k", "top_p", "n_steps"),
             donate_argnums=(1, 2, 3))
         self._prefix_fill = jax.jit(
-            partial(self._prefix_fill_impl, cfg=cfg, rules=self.rules),
+            partial(self._prefix_fill_impl, cfg=cfg, rules=self.rules,
+                    quantized=self.kv_quantized),
             static_argnames=("p_pad",))
         self._prefill_px = jax.jit(
             partial(self._prefill_px_impl, cfg=cfg, rules=self.rules),
             static_argnames=("p_pad",), donate_argnums=(1, 2, 3, 4))
+        if self.spec:
+            self._decode_sp = jax.jit(
+                partial(self._decode_spec_impl, cfg=cfg, rules=self.rules),
+                static_argnames=("k", "ngram", "n_rounds"),
+                donate_argnums=(1, 2, 3, 5))
+            self._ctx_admit = jax.jit(
+                lambda ctx, rows, slots: ctx.at[slots].set(
+                    rows, mode="drop"),
+                donate_argnums=(0,))
+
+    def _check_adapter_id(self, adapter_id: int) -> None:
+        if adapter_id >= 0 and self.adapters is None:
+            raise ValueError("adapter_id passed but engine has no "
+                             "adapters")
+        if adapter_id != -1 and not 0 <= adapter_id < self.n_adapters:
+            # mirror Generator: -1 = base model; any other negative is a
+            # caller bug, not a base-model request
+            raise ValueError(f"adapter id {adapter_id} out of range "
+                             f"({self.n_adapters} adapters; -1 = base)")
 
     # ------------------------------------------------------------ public
     @property
     def pending(self) -> int:
         return len(self._queue) + len(self._slots)
+
+    @property
+    def spec_stats(self) -> Dict[str, float]:
+        """Cumulative speculative acceptance: ``tokens_per_pass`` is the
+        wall-clock-free speedup bound (each verify pass costs ≈ one
+        plain decode step in the weight-bound regime)."""
+        if not self.spec:
+            return {}
+        r, e = self._spec_rounds, self._spec_emitted
+        return {"rounds": r, "emitted": e,
+                "tokens_per_pass": e / r if r else 0.0}
 
     def submit(self, prompt, max_new_tokens: int = 128,
                temperature: float = 0.0,
@@ -200,21 +261,25 @@ class RollingGenerator:
         per chunk — multi-token stop strings cost nothing on device.
         ``repetition_penalty`` > 1 discounts tokens seen in the last 64
         positions (HF semantics), applied on device inside the scan."""
-        if adapter_id >= 0 and self.adapters is None:
-            raise ValueError("adapter_id passed but engine has no "
-                             "adapters")
-        if adapter_id != -1 and not 0 <= adapter_id < self.n_adapters:
-            # mirror Generator: -1 = base model; any other negative is a
-            # caller bug, not a base-model request
-            raise ValueError(f"adapter id {adapter_id} out of range "
-                             f"({self.n_adapters} adapters; -1 = base)")
-        if adapter_id >= 0:
-            if prefix_id is not None:
-                # a shared prefix's KV was computed with the BASE model;
-                # silently mixing it with an adapted suffix would be a
-                # correctness lie — keep them exclusive
-                raise ValueError("prefix_id and adapter_id are mutually "
-                                 "exclusive (prefix KV is base-model)")
+        self._check_adapter_id(adapter_id)
+        if prefix_id is not None and prefix_id in self._prefixes:
+            # prefix KV is weight-dependent: it must have been computed
+            # with exactly the adapter this request decodes under, or the
+            # spliced rows would silently mix two models
+            pfx_aid = self._prefixes[prefix_id]["adapter_id"]
+            if pfx_aid != adapter_id:
+                raise ValueError(
+                    f"prefix {prefix_id} was registered with adapter "
+                    f"{pfx_aid}; submit passed adapter_id {adapter_id} "
+                    f"(prefix KV is weight-dependent — register one "
+                    f"prefix per adapter)")
+        if self.spec and (temperature > 0 or repetition_penalty != 1.0):
+            # speculative verify is greedy-only (acceptance compares the
+            # draft against the model's argmax); penalty windows would
+            # need per-draft-position re-application inside the verify
+            raise ValueError(
+                "speculative engine (spec_k > 1) is greedy-only: "
+                "temperature must be 0 and repetition_penalty 1")
         prefix_len = 0
         if prefix_id is not None:
             if prefix_id not in self._prefixes:
@@ -223,11 +288,15 @@ class RollingGenerator:
                 raise ValueError("prefixed submit needs >= 1 suffix token")
             prefix_len = self._prefixes[prefix_id]["len"]
         total = prefix_len + len(prompt) + max_new_tokens
-        if total + self.steps_per_call > self.max_len:
+        # worst-case per-dispatch overrun: a request can finish mid-chunk
+        # and keep advancing until the chunk boundary (spec: every round
+        # can emit spec_k tokens)
+        margin = self.steps_per_call * (self.spec_k if self.spec else 1)
+        if total + margin > self.max_len:
             raise ValueError(
-                f"prefix+prompt+max_new_tokens+steps_per_call "
+                f"prefix+prompt+max_new_tokens+chunk_margin "
                 f"{prefix_len}+{len(prompt)}+{max_new_tokens}"
-                f"+{self.steps_per_call} exceeds max_len {self.max_len}")
+                f"+{margin} exceeds max_len {self.max_len}")
         rid = self._next_rid
         self._next_rid += 1
         req = Request(rid, prompt, max_new_tokens, temperature)
@@ -258,6 +327,8 @@ class RollingGenerator:
                                   prefix_id)
         if not self._slots:
             return []
+        if self.spec:
+            return self._decode_spec_chunk()
         return self._decode_chunk()
 
     def run(self) -> Dict[int, List[int]]:
@@ -268,27 +339,42 @@ class RollingGenerator:
                 out.setdefault(rid, []).extend(toks)
         return out
 
-    def register_prefix(self, tokens) -> int:
+    def register_prefix(self, tokens, adapter_id: int = -1) -> int:
         """Prefill a shared prefix (system prompt) ONCE; later submissions
         pass ``prefix_id`` and only their suffix is prefetched — the
         prefix's KV rows are copied into the slot at admission. vLLM's
         prefix-caching idea at slot granularity (static shapes: the prefix
-        KV block is [L, 1, p_pad, Hkv, D])."""
-        if self.kv_quantized:
-            raise ValueError(
-                "register_prefix requires the bf16 grid (prefix KV blocks "
-                "splice in unquantized) — use kv_dtype='bf16'")
+        KV block is [L, 1, p_pad, Hkv, D]).
+
+        On the int8 grid the prefix fills a QUANTIZED private cache (the
+        same per-vector absmax writes admission prefills use), so its
+        int8 values + scale planes splice straight into the grid — the
+        serving config keeps both the int8 density win and the
+        shared-prefix win. (The prefix forward runs at its own padded
+        width, so low-bit K/V values — and near-tie argmaxes — can
+        differ from a full-prompt admission, like any cross-width
+        comparison.)
+
+        ``adapter_id``: prefix KV is weight-dependent, so a prefix is
+        bound to the adapter it was computed with (−1 = base model);
+        ``submit`` must pass the matching ``adapter_id``. Per-adapter
+        prefix caches are just multiple ``register_prefix`` calls."""
+        self._check_adapter_id(adapter_id)
         tokens = list(tokens)
         p_pad = _bucket(len(tokens))
         toks = np.zeros((1, p_pad), np.int32)
         toks[0, :len(tokens)] = tokens
+        oh = np.zeros((1, max(self.n_adapters, 1)), np.float32)
+        if adapter_id >= 0:
+            oh[0, adapter_id] = 1.0
         with self._mesh_ctx():
-            k, v, logits = self._prefix_fill(
+            planes, logits = self._prefix_fill(
                 self.params, jnp.asarray(toks),
-                jnp.int32(len(tokens)), p_pad=p_pad)
+                jnp.int32(len(tokens)), self._lora(oh), p_pad=p_pad)
         pid = len(self._prefixes)
         self._prefixes[pid] = {
-            "k": k, "v": v, "len": len(tokens), "logits": logits,
+            "planes": planes, "len": len(tokens), "logits": logits,
+            "tokens": tokens, "adapter_id": adapter_id,
         }
         return pid
 
@@ -348,9 +434,23 @@ class RollingGenerator:
                 (self.cache, self._logits, self._dpos,
                  self._dactive) = self._prefill_px(
                     self.params, self.cache, self._logits, self._dpos,
-                    self._dactive, pfx["k"], pfx["v"],
+                    self._dactive, pfx["planes"],
                     jnp.int32(pfx["len"]), jnp.asarray(toks),
-                    jnp.asarray(lens), jnp.asarray(slots), p_pad=p_pad)
+                    jnp.asarray(lens), jnp.asarray(slots), self._lora(oh),
+                    p_pad=p_pad)
+            if self.spec:
+                # seed the draft haystack: the full token context (shared
+                # prefix + prompt) per admitted slot. One extra tiny
+                # dispatch per admission wave — the hot path (the decode
+                # chunk) stays one dispatch.
+                rows = np.zeros((n_pad, self._ctx.shape[1]), np.int32)
+                head = (self._prefixes[prefix_id]["tokens"]
+                        if prefix_id is not None else [])
+                for i, req in enumerate(group):
+                    seq = head + req.prompt
+                    rows[i, :len(seq)] = seq
+                self._ctx = self._ctx_admit(
+                    self._ctx, jnp.asarray(rows), jnp.asarray(slots))
 
     def _lora(self, onehot_np):
         """None when no adapters — the hot path must not pay a
@@ -389,11 +489,45 @@ class RollingGenerator:
             self._win[:, :-K] = self._win[:, K:]
             self._win[:, -K:] = toks.T
 
+        return self._finish_events(
+            {slot: [int(t) for t in toks[:, slot]]
+             for slot in self._slots})
+
+    def _decode_spec_chunk(self) -> List[Tuple[int, List[int], bool]]:
+        """One dispatch = ``steps_per_call`` verify rounds; each round
+        emits 1..spec_k tokens per slot (the accepted draft prefix plus
+        the model's own next token)."""
+        with self._mesh_ctx():
+            (self.cache, self._logits, self._dpos, self._ctx,
+             toks, emits) = self._decode_sp(
+                self.params, self.cache, self._logits, self._dpos,
+                self._dactive, self._ctx, self._lora(self._slot_onehot),
+                k=self.spec_k, ngram=self.spec_ngram,
+                n_rounds=self.steps_per_call)
+        toks = np.asarray(toks)                # [R, B, k] — the one sync
+        emits = np.asarray(emits)              # [R, B]
+        new_by_slot: Dict[int, List[int]] = {}
+        for slot in self._slots:
+            new: List[int] = []
+            for r in range(toks.shape[0]):
+                e = int(emits[r, slot])
+                if e:
+                    new.extend(int(t) for t in toks[r, slot, :e])
+            new_by_slot[slot] = new
+            self._spec_rounds += toks.shape[0]
+            self._spec_emitted += len(new)
+        return self._finish_events(new_by_slot)
+
+    def _finish_events(self, new_by_slot: Dict[int, List[int]]
+                       ) -> List[Tuple[int, List[int], bool]]:
+        """Trim each slot's freshly decoded tokens to its budget / eos /
+        stop sequences, emit (rid, tokens, done) events, and free
+        finished slots at the chunk boundary."""
         events: List[Tuple[int, List[int], bool]] = []
         freed: List[int] = []
         for slot in list(self._slots):
             req = self._slots[slot]
-            new = [int(t) for t in toks[:, slot]]
+            new = new_by_slot[slot]
             # trim to budget; cut at eos
             room = req.max_new_tokens - len(req.tokens)
             new = new[:room]
@@ -491,30 +625,45 @@ class RollingGenerator:
         return cache, logits, dpos, dactive
 
     @staticmethod
-    def _prefix_fill_impl(params, tokens, prefix_len, *, p_pad, cfg, rules):
-        """Forward a shared prefix once → its KV block + last logits."""
+    def _prefix_fill_impl(params, tokens, prefix_len, lora, *, p_pad, cfg,
+                          rules, quantized=False):
+        """Forward a shared prefix once → its KV planes + last logits.
+
+        On the int8 grid the private cache is quantized, so the stored
+        block carries int8 values + per-vector scale planes written by
+        the exact same path admission prefills use and splices straight
+        into the grid (this forward runs at the prefix's own padded
+        width, so low bits can differ from a full-prompt admission).
+        ``lora``: adapter-bound prefixes forward under the owning
+        adapter's one-hot."""
         positions = jnp.arange(p_pad)[None, :]
         m = jnp.arange(p_pad)[None, None, :]
         mask = (m <= positions[:, :, None]) & (m < prefix_len)
-        own = llama.init_cache(cfg, 1, p_pad)
+        own = llama.init_cache(cfg, 1, p_pad, quantized=quantized)
         out, own = llama.forward_cached(
             params, tokens, positions, own, 0, mask, cfg, rules,
-            unembed_positions=(prefix_len - 1)[None])
-        return own["k"], own["v"], out[0, 0]
+            unembed_positions=(prefix_len - 1)[None], lora=lora)
+        return own, out[0, 0]
 
     @staticmethod
-    def _prefill_px_impl(params, cache, logits, dpos, dactive, pk, pv,
-                         prefix_len, tokens, prompt_lens, slots, *,
+    def _prefill_px_impl(params, cache, logits, dpos, dactive, planes,
+                         prefix_len, tokens, prompt_lens, slots, lora, *,
                          p_pad, cfg, rules):
         """Prefill N suffixes on top of a shared, already-computed prefix:
         the prefix KV block is broadcast into each slot's rows [0, Ppad)
         and only the suffix runs through the model (vLLM prefix caching at
         slot granularity). Suffix rows write at ``prefix_len``, so the
         layout stays contiguous and any prefix-pad garbage lives beyond
-        every future ``pos`` — never attended."""
+        every future ``pos`` — never attended.
+
+        ``planes``: the stored prefix cache dict — bf16 {k, v} or int8
+        {k, v, ks, vs}; quantized planes broadcast into a quantized
+        private cache, so the int8 serving grid composes with shared
+        prefixes. ``lora``: the suffix forward runs under the prefix's
+        owning adapter (submit enforced the match)."""
         M = cache["k"].shape[2]
         N = tokens.shape[0]
-        L, _, Ppad, Hkv, D = pk.shape
+        L, _, Ppad, Hkv, D = planes["k"].shape
         # Rows needed: the prefix block plus the suffix span — suffix rows
         # write at [prefix_len, prefix_len + p_pad) and prefix_len ≤ Ppad.
         # Clamped to the grid's M: a long prefix whose BUCKET plus the
@@ -522,22 +671,24 @@ class RollingGenerator:
         # checked) must not build an own-cache wider than the grid it
         # splices into.
         own = llama.init_cache(cfg, N, min(Ppad + p_pad, M),
-                               dtype=cache["k"].dtype)
-        own = {
-            "k": jax.lax.dynamic_update_slice(
-                own["k"], jnp.broadcast_to(pk, (L, N, Ppad, Hkv, D))
-                .astype(own["k"].dtype), (0, 0, 0, 0, 0)),
-            "v": jax.lax.dynamic_update_slice(
-                own["v"], jnp.broadcast_to(pv, (L, N, Ppad, Hkv, D))
-                .astype(own["v"].dtype), (0, 0, 0, 0, 0)),
-        }
+                               dtype=(None if "ks" in cache
+                                      else cache["k"].dtype),
+                               quantized="ks" in cache)
+
+        def bcast(plane_own, plane_px):
+            shp = (L, N) + plane_px.shape[2:]
+            return jax.lax.dynamic_update_slice(
+                plane_own, jnp.broadcast_to(plane_px, shp)
+                .astype(plane_own.dtype), (0,) * plane_own.ndim)
+
+        own = {kk: bcast(own[kk], planes[kk]) for kk in own}
         positions = prefix_len + jnp.broadcast_to(
             jnp.arange(p_pad)[None, :], (N, p_pad))
         m = jnp.arange(own["k"].shape[2])[None, None, :]
         mask = m <= positions[:, :, None]
         out, own = llama.forward_cached(
             params, tokens, positions, own, prefix_len, mask, cfg, rules,
-            unembed_positions=prompt_lens - 1)
+            unembed_positions=prompt_lens - 1, lora=lora)
         return RollingGenerator._finish_admit(
             cache, own, out[:, 0], logits, dpos, dactive, slots,
             prefix_len + prompt_lens)
@@ -623,6 +774,86 @@ class RollingGenerator:
         new_cache = llama.merge_chunk_into_grid(
             cache, chunk, pos0, jnp.where(active, n_steps, 0))
         return new_cache, logits, pos, toks
+
+    @staticmethod
+    def _decode_spec_impl(params, cache, last_logits, pos, active, ctx,
+                          lora, *, k, ngram, n_rounds, cfg, rules):
+        """``n_rounds`` speculative verify rounds in one ``lax.scan``.
+
+        Per round and slot: the carried next token (= argmax of the
+        carried logits) plus ``k − 1`` prompt-lookup drafts from the
+        slot's device context run through ONE chunk-mode forward at the
+        slot's own depth; the accepted prefix (drafts matching the
+        model's argmax, greedy-exact by construction) merges into the
+        grid with the shared one-hot einsum (per-slot variable count —
+        rejected drafts never land, so there is no rollback). The carry
+        logits move to the acceptance-break position, which makes the
+        next round's carried token the model's own correction — greedy
+        output is token-identical to the plain engine.
+
+        Unlike the plain chunk (grid merged once per dispatch), each
+        round merges: round r+1's verify must read round r's accepted
+        K/V, and per-slot acceptance lengths break the uniform-column
+        chunk layout. One merge per ~tokens_per_pass tokens instead of
+        one per ``steps_per_call`` — priced in; the verify forward
+        replacing several single-token steps is the bigger term in the
+        weight-bound regime this mode targets.
+        """
+        from kubetorch_tpu.models.speculative import _ngram_draft
+
+        M = cache["k"].shape[2]
+        B = last_logits.shape[0]
+        L = cache["k"].shape[0]
+        Hkv, D = cache["k"].shape[3], cache["k"].shape[4]
+        Lctx = ctx.shape[1]
+        bidx = jnp.arange(B)[:, None]
+        cdt = jnp.bfloat16 if "ks" in cache else cache["k"].dtype
+
+        def one(carry, _):
+            cache, logits, pos, ctx = carry
+            nt = jnp.argmax(logits, axis=-1).astype(jnp.int32)    # [B]
+            cext = ctx.at[bidx, pos[:, None]].set(nt[:, None],
+                                                  mode="drop")
+            if k > 1:
+                drafts = _ngram_draft(cext, pos, nt, n=ngram, k=k)
+                feed = jnp.concatenate([nt[:, None], drafts], axis=1)
+            else:
+                feed = nt[:, None]
+            positions = pos[:, None] + jnp.arange(k)[None, :]
+            gmask = jnp.broadcast_to(
+                (jnp.arange(M)[None, None, :] < pos[:, None, None])
+                & active[:, None, None], (B, k, M))
+            emask = jnp.broadcast_to(
+                jnp.arange(k)[None, None, :]
+                <= jnp.arange(k)[None, :, None], (B, k, k)) \
+                & active[:, None, None]
+            chunk = {"k": jnp.zeros((L, B, k, Hkv, D), cdt),
+                     "v": jnp.zeros((L, B, k, Hkv, D), cdt)}
+            lg, chunk = llama.forward_cached(
+                params, feed, positions, cache, None, gmask, cfg, rules,
+                chunk=chunk, chunk_col=0, chunk_mask=emask, lora=lora)
+            g = jnp.argmax(lg, axis=-1).astype(jnp.int32)         # [B, k]
+            if k > 1:
+                ok = (feed[:, 1:] == g[:, :-1]).astype(jnp.int32)
+                acc = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)    # 0..k-1
+            else:
+                acc = jnp.zeros((B,), jnp.int32)
+            emit = jnp.where(active, 1 + acc, 0)
+            cache = llama.merge_chunk_into_grid(cache, chunk, pos, emit)
+            # context mirrors the grid's accepted prefix
+            cpos = pos[:, None] + jnp.arange(k)[None, :]
+            cvalid = jnp.arange(k)[None, :] < emit[:, None]
+            ctx = ctx.at[bidx, jnp.where(cvalid, cpos, Lctx)].set(
+                jnp.where(cvalid, feed, 0), mode="drop")
+            # carry logits at the acceptance break → next round's nt is
+            # the model's correction (or the bonus token on full accept)
+            logits = jnp.take_along_axis(
+                lg, jnp.clip(acc, 0, k - 1)[:, None, None], axis=1)[:, 0]
+            return (cache, logits, pos + emit, ctx), (feed, emit)
+
+        (cache, logits, pos, ctx), (toks, emits) = jax.lax.scan(
+            one, (cache, last_logits, pos, ctx), None, length=n_rounds)
+        return cache, logits, pos, ctx, toks, emits
 
 
 class RollingService:

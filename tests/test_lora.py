@@ -270,9 +270,16 @@ def test_rolling_adapter_validation(cfg, params):
                            adapter_scale=lcfg.scale)
     with pytest.raises(ValueError, match="out of range"):
         eng.submit([1, 2], adapter_id=5)
+    # prefix KV is weight-dependent: a base-model prefix cannot serve an
+    # adapted request (register a per-adapter prefix instead)
     pid = eng.register_prefix([1, 2, 3, 4])
-    with pytest.raises(ValueError, match="mutually exclusive"):
+    with pytest.raises(ValueError, match="weight-dependent"):
         eng.submit([5], prefix_id=pid, adapter_id=0)
+    pid0 = eng.register_prefix([1, 2, 3, 4], adapter_id=0)
+    with pytest.raises(ValueError, match="weight-dependent"):
+        eng.submit([5], prefix_id=pid0, adapter_id=-1)
+    with pytest.raises(ValueError, match="out of range"):
+        eng.register_prefix([1, 2], adapter_id=7)
     plain = RollingGenerator(params, cfg, max_slots=2)
     with pytest.raises(ValueError, match="no .*adapters|adapters"):
         plain.submit([1, 2], adapter_id=0)
