@@ -4,11 +4,27 @@ The naive LM loss materializes logits ``[B, S, V]`` in float32 — at
 Llama-scale (V=32k+, S=2k+) that is the single largest activation in the
 train step (~1 GB at B=4/S=2048/V=32768) and its HBM write+read dominates
 bandwidth around the unembedding matmul. This op never materializes full
-logits: tokens are processed in chunks under ``lax.scan`` with a
-``jax.checkpoint``-ed body, so the forward keeps only one chunk of logits
-live ([chunk, V] f32) and the backward recomputes each chunk's logits while
-accumulating ``d_hidden`` and ``d_head`` — the same memory shape XLA's
-scan-transpose produces for free.
+logits: tokens are processed in chunks under ``lax.scan``, keeping only
+one chunk of logits live ([chunk, V] f32) in either pass.
+
+Two backward strategies:
+
+- ``backward="streaming"`` (default): a ``jax.custom_vjp`` whose forward
+  accumulates the UNSCALED gradient contributions per chunk —
+  ``gx = (softmax(logits) − onehot(t))·m @ headᵀ`` and
+  ``gW = xcᵀ @ (softmax(logits) − onehot(t))·m`` — alongside the loss.
+  The true gradient is linear in the loss cotangent, so the backward
+  pass is two scalar multiplies: no recompute, 3 unembedding-shaped
+  matmuls per chunk total (logits, gx, gW), the algebraic minimum.
+  The unembedding is ~21% of step FLOPs at 0.8B/V=32k, so eliminating
+  its backward recompute (strategy below) is a direct MFU lever.
+  Evaluation (no grad) takes the primal path and does only the loss
+  matmul — ``jax.custom_vjp`` invokes the fwd rule only under
+  differentiation.
+- ``backward="recompute"``: the previous ``jax.checkpoint`` form — the
+  backward recomputes each chunk's logits (4 matmuls per chunk). Kept
+  for A/B measurement and as the fallback if a transform composes badly
+  with the custom VJP.
 
 The reference framework has no compute path at all (it orchestrates torch
 user code — SURVEY §2.7); this belongs to the TPU build's owned compute
@@ -17,15 +33,104 @@ stack, same tier as the Pallas attention kernels.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _pad_to_multiple(n: int, chunk: int) -> int:
     """Padded token count: smallest multiple of ``chunk`` >= n."""
     return ((n + chunk - 1) // chunk) * chunk
+
+
+def _chunk_stats(xc, head, tc, mc):
+    """One chunk's loss/accuracy sums (logits live only here)."""
+    logits = jax.lax.dot_general(
+        xc, head, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [chunk, V] f32
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+    correct = (jnp.argmax(logits, axis=-1) == tc).astype(jnp.float32)
+    loss = ((logz - gold) * mc).sum()
+    acc = (correct * mc).sum()
+    return logits, logz, loss, acc
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _streaming_sums(x, head, t, m, meta):
+    """(loss_sum, acc_sum) over chunked tokens; custom VJP streams the
+    gradient accumulation through the forward. ``x``/``t``/``m`` arrive
+    pre-chunked ``[n_chunks, chunk, ...]``; ``meta`` is the static
+    ``(head_grad, head_shape)`` — ``head_grad=False`` (frozen head, e.g.
+    LoRA) skips the gW matmul and its [E, V] f32 residual entirely."""
+
+    def body(carry, inp):
+        xc, tc, mc = inp
+        _, _, loss, acc = _chunk_stats(xc, head, tc, mc)
+        return (carry[0] + loss, carry[1] + acc), None
+
+    (loss_sum, acc_sum), _ = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.float32(0.0)), (x, t, m))
+    return loss_sum, acc_sum
+
+
+def _streaming_sums_fwd(x, head, t, m, meta):
+    # (custom_vjp passes nondiff args in place to the fwd rule, and
+    # first to the bwd rule)
+    head_grad, _ = meta
+    E, V = head.shape
+
+    def body(carry, inp):
+        xc, tc, mc = inp
+        loss_sum, acc_sum, gW = carry
+        logits, logz, loss, acc = _chunk_stats(xc, head, tc, mc)
+        # unscaled dlogits: (softmax − onehot(target)) · mask. The onehot
+        # is an iota-compare — XLA fuses it into the subtract, so no
+        # second [chunk, V] buffer materializes.
+        p = jnp.exp(logits - logz[:, None])
+        onehot = (tc[:, None] == jnp.arange(V)[None, :]
+                  ).astype(jnp.float32)
+        dl = (p - onehot) * mc[:, None]                  # [chunk, V] f32
+        gx = jax.lax.dot_general(
+            dl, head, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [chunk, E]
+        if head_grad:
+            gW = gW + jax.lax.dot_general(
+                xc, dl, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [E, V]
+        # ∂loss_sum/∂m_i = logz_i − gold_i (gold = logits at target)
+        gm = logz - jnp.take_along_axis(logits, tc[:, None],
+                                        axis=-1)[:, 0]
+        return (loss_sum + loss, acc_sum + acc, gW), (gx, gm)
+
+    gW0 = (jnp.zeros((E, V), jnp.float32) if head_grad
+           else jnp.zeros((), jnp.float32))
+    (loss_sum, acc_sum, gW), (gx, gm) = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.float32(0.0), gW0), (x, t, m))
+    # residuals stored at primal dtype (halves memory for bf16 hidden;
+    # the f32→primal cast is where plain autodiff would cast anyway)
+    return (loss_sum, acc_sum), (gx.astype(x.dtype),
+                                 gW.astype(head.dtype), gm)
+
+
+def _streaming_sums_bwd(meta, res, cts):
+    head_grad, head_shape = meta
+    gx, gW, gm = res
+    d_loss, _ = cts                       # acc_sum is not differentiated
+    dx = (gx.astype(jnp.float32) * d_loss).astype(gx.dtype)
+    if head_grad:
+        dW = (gW.astype(jnp.float32) * d_loss).astype(gW.dtype)
+    else:
+        dW = jnp.zeros(head_shape, gW.dtype)
+    dt = np.zeros(gx.shape[:2], jax.dtypes.float0)  # int targets: no grad
+    dm = gm * d_loss                                # mask built f32 by caller
+    return dx, dW, dt, dm
+
+
+_streaming_sums.defvjp(_streaming_sums_fwd, _streaming_sums_bwd)
 
 
 def fused_cross_entropy(
@@ -36,15 +141,23 @@ def fused_cross_entropy(
     chunk_size: int = 512,  # interleaved A/B at 0.8B/V=32k on v5e:
                             # 512 ≈ +1% train throughput over 1024
                             # (smaller live [chunk, V] logits tile)
+    backward: str = "streaming",
+    head_grad: bool = True,
 ) -> Tuple[jax.Array, dict]:
     """Masked mean LM cross-entropy without materializing [B,S,V] logits.
 
     Matches ``training.cross_entropy_loss(hidden @ head, targets, mask)`` to
     float tolerance (logits are computed chunkwise with f32 accumulation).
-    Returns ``(loss, {"tokens", "accuracy"})``.
-    """
+    Returns ``(loss, {"tokens", "accuracy"})``. ``backward``: see module
+    docstring — "streaming" (forward-accumulated exact gradients, no
+    recompute) or "recompute" (checkpointed chunk body). ``head_grad=False``
+    (streaming only) declares the unembedding frozen — the fwd skips the
+    [E, V] gradient matmul + residual; its cotangent comes back zero, so
+    only use it when ``head`` is truly not being differentiated (LoRA)."""
+    if backward not in ("streaming", "recompute"):
+        raise ValueError(f"backward must be 'streaming' or 'recompute', "
+                         f"got {backward!r}")
     B, S, E = hidden.shape
-    V = head.shape[1]
     n = B * S
     chunk = min(chunk_size, n)
     n_pad = _pad_to_multiple(n, chunk)
@@ -63,23 +176,19 @@ def fused_cross_entropy(
     t = t.reshape(n_chunks, chunk)
     m = m.reshape(n_chunks, chunk)
 
-    def body(carry, inp):
-        xc, tc, mc = inp
-        logits = jax.lax.dot_general(
-            xc, head, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [chunk, V] f32
-        logz = jax.scipy.special.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
-        correct = (jnp.argmax(logits, axis=-1) == tc).astype(jnp.float32)
-        loss_sum, acc_sum = carry
-        loss_sum = loss_sum + ((logz - gold) * mc).sum()
-        acc_sum = acc_sum + (correct * mc).sum()
-        return (loss_sum, acc_sum), None
+    if backward == "streaming":
+        loss_sum, acc_sum = _streaming_sums(
+            x, head, t, m, (head_grad, (E, head.shape[1])))
+    else:
+        def body(carry, inp):
+            xc, tc, mc = inp
+            _, _, loss, acc = _chunk_stats(xc, head, tc, mc)
+            return (carry[0] + loss, carry[1] + acc), None
 
-    # checkpoint: backward recomputes the chunk's logits instead of saving
-    # them — peak live logits stay [chunk, V] in both passes.
-    (loss_sum, acc_sum), _ = jax.lax.scan(
-        jax.checkpoint(body), (jnp.float32(0.0), jnp.float32(0.0)),
-        (x, t, m))
+        # checkpoint: backward recomputes the chunk's logits instead of
+        # saving them — peak live logits stay [chunk, V] in both passes.
+        (loss_sum, acc_sum), _ = jax.lax.scan(
+            jax.checkpoint(body), (jnp.float32(0.0), jnp.float32(0.0)),
+            (x, t, m))
     n_tok = jnp.maximum(m.sum(), 1.0)
     return loss_sum / n_tok, {"tokens": n_tok, "accuracy": acc_sum / n_tok}

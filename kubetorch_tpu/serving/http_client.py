@@ -31,12 +31,17 @@ def proxy_timeout(timeout: Optional[float] = None) -> httpx.Timeout:
 
     The caller's explicit timeout wins; otherwise a bounded default
     (``KT_PROXY_TIMEOUT``, seconds) — a hung peer must not pin the
-    proxying pod's executor thread indefinitely."""
+    proxying pod's executor thread indefinitely. The read bound gets a
+    30 s margin over the caller's timeout so the REMOTE's structured
+    timeout error (raised at ~timeout by the peer's pool) wins the race
+    against this transport-level ReadTimeout and the error payload
+    survives the hop."""
     import os
 
     if timeout is None:
         timeout = float(os.environ.get("KT_PROXY_TIMEOUT", "600"))
-    return httpx.Timeout(connect=10.0, read=timeout, write=60.0, pool=10.0)
+    return httpx.Timeout(connect=10.0, read=timeout + 30.0, write=60.0,
+                         pool=10.0)
 
 
 def sync_client() -> httpx.Client:

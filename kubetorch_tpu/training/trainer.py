@@ -85,9 +85,12 @@ def init_train_state(
 
 
 def make_default_loss(cfg: LlamaConfig, rules: ShardingRules,
-                      ring_mesh: Optional[Mesh] = None) -> Callable:
+                      ring_mesh: Optional[Mesh] = None,
+                      head_grad: bool = True) -> Callable:
     """The LM objective: fused chunked cross-entropy over hidden states —
-    never materializes [B, S, V] float32 logits (ops/xent.py)."""
+    never materializes [B, S, V] float32 logits (ops/xent.py).
+    ``head_grad=False``: the unembedding is frozen (LoRA fine-tuning) —
+    the streaming backward skips its [E, V] gradient accumulation."""
 
     def default_loss(params, batch):
         from kubetorch_tpu.ops.xent import fused_cross_entropy
@@ -99,7 +102,8 @@ def make_default_loss(cfg: LlamaConfig, rules: ShardingRules,
             mesh=ring_mesh)
         return fused_cross_entropy(
             x, llama.unembedding(params, cfg), batch["targets"],
-            batch.get("mask"), chunk_size=cfg.xent_chunk)
+            batch.get("mask"), chunk_size=cfg.xent_chunk,
+            head_grad=head_grad)
 
     return default_loss
 
@@ -254,7 +258,10 @@ class Trainer:
         if loss_fn is None:
             ring_mesh = (mesh if mesh is not None
                          and mesh.shape.get("sp", 1) > 1 else None)
-            loss_fn = make_default_loss(cfg, rules, ring_mesh)
+            # LoRA never targets the unembedding — skip its [E, V]
+            # gradient accumulation in the streaming backward
+            loss_fn = make_default_loss(cfg, rules, ring_mesh,
+                                        head_grad=False)
         loss = lora_mod.make_lora_loss(loss_fn, base_params, lora_cfg)
         return cls(
             cfg, mesh, optimizer=optimizer, rules=rules, seed=seed,

@@ -105,3 +105,93 @@ def test_trainer_default_loss_uses_fused_and_trains():
     for _ in range(4):
         m = tr.step(batch)
     assert float(m["loss"]) < float(m0["loss"])
+
+
+def test_streaming_and_recompute_backwards_agree():
+    """The custom-VJP streaming backward must produce the same gradients
+    as the checkpointed-recompute backward (and the naive path) — masked,
+    padded, both argnums."""
+    hidden, head, targets = _setup(batch=2, seq=13)   # pads at chunk 4
+    mask = (jnp.arange(13)[None, :] < jnp.array([[6], [11]])).astype(
+        jnp.float32)
+
+    def fn(h, w, backward):
+        loss, _ = fused_cross_entropy(h, w, targets, mask, chunk_size=4,
+                                      backward=backward)
+        return loss
+
+    def naive_fn(h, w):
+        loss, _ = cross_entropy_loss(
+            jnp.einsum("bse,ev->bsv", h, w), targets, mask)
+        return loss
+
+    gs_h, gs_w = jax.grad(fn, argnums=(0, 1))(hidden, head, "streaming")
+    gr_h, gr_w = jax.grad(fn, argnums=(0, 1))(hidden, head, "recompute")
+    gn_h, gn_w = jax.grad(naive_fn, argnums=(0, 1))(hidden, head)
+    np.testing.assert_allclose(gs_h, gr_h, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(gs_w, gr_w, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(gs_h, gn_h, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(gs_w, gn_w, rtol=1e-4, atol=1e-6)
+
+
+def test_streaming_value_and_grad_aux():
+    """value_and_grad(has_aux=True) — the Trainer's exact usage — works
+    through the custom VJP and the aux metrics match the eval path."""
+    hidden, head, targets = _setup()
+
+    def fn(h):
+        return fused_cross_entropy(h, head, targets, chunk_size=6)
+
+    (loss, aux), g = jax.value_and_grad(fn, has_aux=True)(hidden)
+    eval_loss, eval_aux = jax.jit(fn)(hidden)
+    np.testing.assert_allclose(loss, eval_loss, rtol=1e-6)
+    np.testing.assert_allclose(aux["accuracy"], eval_aux["accuracy"],
+                               rtol=1e-6)
+    assert g.shape == hidden.shape and jnp.isfinite(g).all()
+
+
+def test_backward_arg_validated():
+    hidden, head, targets = _setup()
+    with pytest.raises(ValueError, match="backward"):
+        fused_cross_entropy(hidden, head, targets, backward="magic")
+
+
+def test_mask_gradient_matches_naive_both_backwards():
+    """grad w.r.t. the mask must agree across streaming, recompute, and
+    the naive logits path (the streaming VJP carries the per-token
+    (logz − gold) term explicitly)."""
+    hidden, head, targets = _setup()
+    mask0 = jnp.ones((2, 12), jnp.float32)
+
+    def naive_fn(m):
+        loss, _ = cross_entropy_loss(
+            jnp.einsum("bse,ev->bsv", hidden, head), targets, m)
+        return loss
+
+    def fn(m, backward):
+        loss, _ = fused_cross_entropy(hidden, head, targets, m,
+                                      chunk_size=4, backward=backward)
+        return loss
+
+    gn = jax.grad(naive_fn)(mask0)
+    gs = jax.grad(fn)(mask0, "streaming")
+    gr = jax.grad(fn)(mask0, "recompute")
+    np.testing.assert_allclose(gs, gr, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(gs, gn, rtol=1e-4, atol=1e-6)
+
+
+def test_frozen_head_skips_head_grad():
+    """head_grad=False: hidden grads unchanged, head cotangent zero —
+    the LoRA trainer's configuration."""
+    hidden, head, targets = _setup()
+
+    def fn(h, w, head_grad):
+        loss, _ = fused_cross_entropy(h, w, targets, chunk_size=6,
+                                      head_grad=head_grad)
+        return loss
+
+    g_h, g_w = jax.grad(fn, argnums=(0, 1))(hidden, head, True)
+    f_h, f_w = jax.grad(fn, argnums=(0, 1))(hidden, head, False)
+    np.testing.assert_allclose(g_h, f_h, rtol=1e-6)
+    assert not np.asarray(f_w).any()
+    assert np.asarray(g_w).any()
