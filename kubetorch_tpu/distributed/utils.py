@@ -93,10 +93,41 @@ def pod_ips(
     """Discover peer addresses, waiting for quorum.
 
     Resolution order:
-    1. ``LOCAL_IPS`` env (comma-separated ``host[:port]`` — local mode/tests),
-    2. ``TPU_WORKER_HOSTNAMES`` (slice gang membership, already complete),
-    3. DNS A records of ``<service_name>-headless``.
+    1. ``KT_POD_IPS_FILE`` env — a file holding comma/newline-separated
+       entries; re-read on every call, so local-mode tests can mutate
+       membership mid-run the way a K8s endpoint list changes under
+       scale-down (a missing/empty file falls through),
+    2. ``LOCAL_IPS`` env (comma-separated ``host[:port]`` — local mode/tests),
+    3. ``TPU_WORKER_HOSTNAMES`` (slice gang membership, already complete),
+    4. DNS A records of ``<service_name>-headless``.
     """
+    ips_file = os.environ.get("KT_POD_IPS_FILE")
+    if ips_file:
+        def read_file() -> List[str]:
+            try:
+                with open(ips_file) as fh:       # noqa: PTH123
+                    raw = fh.read().replace("\n", ",")
+            except OSError:
+                # deleted/mid-rewrite: treat as empty (docstring contract:
+                # a missing/empty file falls through)
+                return []
+            return [x.strip() for x in raw.split(",") if x.strip()]
+
+        ips = read_file()
+        if ips and quorum_workers and len(ips) < quorum_workers:
+            # the file mutates mid-run by design (that's its purpose) —
+            # an under-quorum snapshot may be a rewrite in progress, so
+            # poll like the DNS path instead of failing instantly
+            deadline = time.time() + quorum_timeout
+            while time.time() < deadline and len(ips) < quorum_workers:
+                time.sleep(poll_interval)
+                ips = read_file()
+            if len(ips) < quorum_workers:
+                raise QuorumTimeoutError(
+                    f"KT_POD_IPS_FILE has {len(ips)} workers, "
+                    f"quorum={quorum_workers} (after {quorum_timeout}s)")
+        if ips:
+            return ips
     local = os.environ.get("LOCAL_IPS") or os.environ.get("KT_POD_IPS")
     if local:
         ips = [x.strip() for x in local.split(",") if x.strip()]

@@ -175,7 +175,11 @@ class K8sClient:
             namespace = namespace or meta.get("namespace", self.namespace)
             name = name or meta.get("name")
         else:
-            api_version, kind = "v1", manifest_or_kind
+            # bare-string kinds route to their real API group — a real
+            # server 404s apps/v1 kinds addressed under /api/v1 (the fake
+            # ignores the prefix, which hid this)
+            kind = kind_for(manifest_or_kind)
+            api_version = API_VERSIONS.get(kind, "v1")
             namespace = namespace or self.namespace
         prefix = ("/api/v1" if api_version == "v1"
                   else f"/apis/{api_version}")

@@ -37,13 +37,19 @@ from kubetorch_tpu.exceptions import (
 from kubetorch_tpu.serving.frameworks import framework_class
 from kubetorch_tpu.serving.supervisor import ExecutionSupervisor
 
-TREE_MINIMUM = 100
-FANOUT = 50
+# Env-overridable so small local deployments can exercise the real tree
+# path (production: tree only above 100 pods, fanout 50 — reference
+# thresholds; tests: KT_TREE_MINIMUM=4 KT_FANOUT=2 drives a 3-level tree
+# with 6 subprocess pods).
+TREE_MINIMUM = int(os.environ.get("KT_TREE_MINIMUM", "100"))
+FANOUT = int(os.environ.get("KT_FANOUT", "50"))
 DEFAULT_POD_PORT = 32300
 
 
-def get_tree_children(index: int, total: int, fanout: int = FANOUT) -> List[int]:
+def get_tree_children(index: int, total: int,
+                      fanout: Optional[int] = None) -> List[int]:
     """Indices of this node's children in a fanout-ary broadcast tree."""
+    fanout = FANOUT if fanout is None else fanout
     first = index * fanout + 1
     return [i for i in range(first, min(first + fanout, total))]
 

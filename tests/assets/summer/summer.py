@@ -127,3 +127,31 @@ def jax_allgather():
 
 def env_values(keys):
     return {k: os.environ.get(k) for k in keys}
+
+
+def slow_whoami(seconds=8.0):
+    import time
+
+    time.sleep(float(seconds))
+    return {
+        "rank": os.environ.get("RANK"),
+        "pod": os.environ.get("KT_REPLICA_INDEX"),
+    }
+
+
+def ray_probe():
+    """Runs on the Ray HEAD pod (RaySupervisor executes head-only):
+    joins the local GCS and proves a remote task round-trip."""
+    import ray
+
+    ray.init(address="auto", ignore_reinit_error=True,
+             log_to_driver=False)
+
+    @ray.remote
+    def double(x):
+        return 2 * x
+
+    nodes = [n for n in ray.nodes() if n.get("Alive")]
+    out = ray.get(double.remote(21))
+    return {"nodes": len(nodes), "double": out,
+            "pod": os.environ.get("KT_REPLICA_INDEX")}

