@@ -56,11 +56,65 @@ def _pack_header(host_leaves, treedef) -> bytes:
     return _MAGIC + len(head).to_bytes(8, "little") + head
 
 
-def _host_leaves(tree: Any):
-    import jax
+def device_get_chunked(leaves, chunk_bytes: int = 256 << 20):
+    """Device→host fetch of many arrays in O(total/chunk) transfers
+    instead of O(leaves).
 
+    Each ``jax.device_get`` pays a per-call fixed cost (dispatch +
+    transfer setup); a param tree has hundreds of leaves, so per-leaf
+    fetches turn the staging hop into n_leaves × fixed-cost — on a
+    remote-dispatch link (the measured r4 weight-sync regression) that
+    fixed cost is ~100 ms/call and dominates end to end. Packing leaves
+    (grouped by dtype) into ≤``chunk_bytes`` on-device buffers cuts the
+    call count to a handful; the on-device concatenate is an HBM copy,
+    orders of magnitude faster than any host link. Multi-device-sharded
+    leaves fall back to the direct fetch (concatenating across meshes
+    would force a gather the caller didn't ask for).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    out = [None] * len(leaves)
+    groups: dict = {}
+    for i, leaf in enumerate(leaves):
+        if not isinstance(leaf, jax.Array) or len(leaf.devices()) > 1:
+            out[i] = np.asarray(jax.device_get(leaf))
+            continue
+        # group by (dtype, device): concatenating same-dtype leaves
+        # committed to DIFFERENT devices raises — those batch per device
+        dev = next(iter(leaf.devices()))
+        groups.setdefault((leaf.dtype, dev.id), []).append(i)
+
+    def flush(batch):
+        if not batch:
+            return
+        if len(batch) == 1:
+            i = batch[0]
+            out[i] = np.asarray(jax.device_get(leaves[i]))
+            return
+        buf = jnp.concatenate([leaves[i].ravel() for i in batch])
+        host = np.asarray(jax.device_get(buf))
+        off = 0
+        for i in batch:
+            n = leaves[i].size
+            out[i] = host[off:off + n].reshape(leaves[i].shape)
+            off += n
+
+    for idxs in groups.values():
+        batch, size = [], 0
+        for i in idxs:
+            if batch and size + leaves[i].nbytes > chunk_bytes:
+                flush(batch)
+                batch, size = [], 0
+            batch.append(i)
+            size += leaves[i].nbytes
+        flush(batch)
+    return out
+
+
+def _host_leaves(tree: Any):
     leaves, treedef = _tree_flatten(tree)
-    return [np.asarray(jax.device_get(leaf)) for leaf in leaves], treedef
+    return device_get_chunked(leaves), treedef
 
 
 def pack_arrays(tree: Any) -> bytes:

@@ -552,9 +552,17 @@ class RollingGenerator:
                 del self._slots[slot]
                 freed.append(slot)
         if freed:
-            idx = jnp.asarray(freed, jnp.int32)
-            self._dactive = self._dactive.at[idx].set(False)
-            self._dpos = self._dpos.at[idx].set(0)
+            # FIXED-shape mask update, never a variable-length index
+            # scatter: `.at[freed].set` compiles a fresh executable per
+            # distinct len(freed), and on a remote-dispatch link each of
+            # those tiny compiles costs seconds — speculative drains
+            # (scattered finish times) measured 7-14 s spikes per new
+            # freed-count until this was masked
+            mask = np.zeros(self.max_slots, bool)
+            mask[freed] = True
+            mask = jnp.asarray(mask)
+            self._dactive = jnp.where(mask, False, self._dactive)
+            self._dpos = jnp.where(mask, 0, self._dpos)
             self._slot_onehot[freed] = 0.0
             for slot in freed:
                 self._win[slot] = -1
