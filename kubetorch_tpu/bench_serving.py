@@ -99,6 +99,12 @@ def _run_phases(params, cfg, B, P, N, chunk_pair, n_poisson, rng,
 
     from kubetorch_tpu.models.rolling import RollingGenerator
 
+    # The load phase must outlive its own transient: occupancy on a
+    # B-slot engine builds one admission wave at a time, so a request
+    # count small relative to B measures ramp-up/drain edges, not steady
+    # state (r5: 64 requests on 192 slots never got past ~30% occupancy
+    # and the consistency check kept failing on edge effects).
+    n_poisson = max(n_poisson, 3 * B)
     steps_per_call, spc2 = chunk_pair
     max_len = P + N + spc2
     eng = RollingGenerator(params, cfg, max_slots=B, max_len=max_len,
@@ -198,57 +204,73 @@ def _run_phases(params, cfg, B, P, N, chunk_pair, n_poisson, rng,
     churn_tok_s = float(np.sum(cal_lens)) / (time.perf_counter() - t0)
     out["churn_tok_s_host"] = round(churn_tok_s, 1)
 
+    def run_poisson(lam):
+        gaps = rng.exponential(1.0 / lam, n_poisson)
+        arrive_at = np.cumsum(gaps)
+        t_start = time.perf_counter()
+        submit_t: dict = {}
+        first_tok_t: dict = {}
+        done_t: dict = {}
+        next_i = 0
+        post_admit = []                   # chunk time right after admission
+        steady = []                       # chunk time with no admission
+        while len(done_t) < n_poisson:
+            now = time.perf_counter() - t_start
+            while next_i < n_poisson and arrive_at[next_i] <= now:
+                rid = eng.submit(prompt(),
+                                 max_new_tokens=int(lens[next_i]),
+                                 temperature=0.8)
+                submit_t[rid] = time.perf_counter()
+                next_i += 1
+            if not eng.pending:
+                if next_i < n_poisson:    # idle gap: sleep to next arrival
+                    time.sleep(max(0.0, arrive_at[next_i]
+                                   - (time.perf_counter() - t_start)))
+                continue
+            admitted = bool(eng._queue) and bool(eng._free)
+            t0 = time.perf_counter()
+            events = eng.step()
+            dt = time.perf_counter() - t0
+            (post_admit if admitted else steady).append(dt)
+            tnow = time.perf_counter()
+            for rid, toks, done in events:
+                if toks and rid not in first_tok_t:
+                    first_tok_t[rid] = tnow
+                if done:
+                    done_t[rid] = tnow
+        ttft = [(first_tok_t[r] - submit_t[r]) * 1e3 for r in first_tok_t]
+        lat = [(done_t[r] - submit_t[r]) * 1e3 for r in done_t]
+        wall = max(done_t.values()) - t_start
+        return ttft, lat, wall, post_admit, steady
+
+    # Two-pass λ calibration: the churn phase measures SATURATED
+    # capacity, where big admission waves amortize the per-wave
+    # dispatch+swap cost; open-loop arrivals spread admissions out and
+    # absorb less. Pass 1 offers 0.8× churn; if the engine can't keep
+    # up (delivered < 0.75× offered), the measured delivered rate IS
+    # the open-loop capacity — pass 2 re-offers 80% of that, and the
+    # consistency flag is judged on the final pass.
     lam = 0.8 * churn_tok_s / float(np.mean(lens))
-    gaps = rng.exponential(1.0 / lam, n_poisson)
-    arrive_at = np.cumsum(gaps)
-
-    t_start = time.perf_counter()
-    submit_t: dict = {}
-    first_tok_t: dict = {}
-    done_t: dict = {}
-    next_i = 0
-    post_admit = []                       # chunk time right after admission
-    steady = []                           # chunk time with no admission
-    while len(done_t) < n_poisson:
-        now = time.perf_counter() - t_start
-        while next_i < n_poisson and arrive_at[next_i] <= now:
-            rid = eng.submit(prompt(), max_new_tokens=int(lens[next_i]),
-                             temperature=0.8)
-            submit_t[rid] = time.perf_counter()
-            next_i += 1
-        if not eng.pending:
-            if next_i < n_poisson:        # idle gap: sleep to next arrival
-                time.sleep(max(0.0, arrive_at[next_i]
-                               - (time.perf_counter() - t_start)))
-            continue
-        admitted = bool(eng._queue) and bool(eng._free)
-        t0 = time.perf_counter()
-        events = eng.step()
-        dt = time.perf_counter() - t0
-        (post_admit if admitted else steady).append(dt)
-        tnow = time.perf_counter()
-        for rid, toks, done in events:
-            if toks and rid not in first_tok_t:
-                first_tok_t[rid] = tnow
-            if done:
-                done_t[rid] = tnow
-
-    ttft = [(first_tok_t[r] - submit_t[r]) * 1e3 for r in first_tok_t]
-    lat = [(done_t[r] - submit_t[r]) * 1e3 for r in done_t]
     total_toks = int(np.sum(lens))
-    wall = max(done_t.values()) - t_start
-    offered = lam * float(np.mean(lens))
-    delivered = total_toks / wall
-    # Internal consistency: λ was sized to 0.8× measured host capacity,
-    # so delivered must track offered — a large gap means the load phase
-    # degenerated into queueing collapse again and its latency numbers
-    # describe the queue, not the engine.
-    consistent = abs(delivered - offered) / offered <= 0.25
+    passes = 0
+    while True:
+        ttft, lat, wall, post_admit, steady = run_poisson(lam)
+        offered = lam * float(np.mean(lens))
+        delivered = total_toks / wall
+        # one-sided: only UNDER-delivery is queueing collapse (the wall
+        # ends at the last completion, so a fast drain of bunched
+        # arrivals can legitimately deliver above the offered rate)
+        consistent = delivered >= 0.75 * offered
+        passes += 1
+        if consistent or passes >= 2:
+            break
+        lam = 0.8 * delivered / float(np.mean(lens))
     out.update({
         "poisson_requests": n_poisson,
         "poisson_offered_tok_s": round(offered, 1),
         "poisson_tok_s": round(delivered, 1),
         "poisson_valid": bool(consistent),
+        "poisson_calibration_passes": passes,
         "ttft_ms_p50": round(_pct(ttft, 50), 1),
         "ttft_ms_p99": round(_pct(ttft, 99), 1),
         "latency_ms_p50": round(_pct(lat, 50), 1),
@@ -324,10 +346,15 @@ def bench_rolling_spec(params, cfg, slots: int = 16, k: int = 8,
     prompts = [s + w for s, w in zip(seeds_, warm)]
     del gen
 
-    def drain(spec_k, spc):
+    def drain(spec_k, spc, spc_pair_max):
+        # max_len from the LARGER chunk size of the differencing pair:
+        # both engines in a pair must share the grid size, or the
+        # subtraction attributes the bigger engine's extra KV-read cost
+        # to per-step device time (phase 1 differences one engine at
+        # fixed max_len for the same reason)
         eng = RollingGenerator(
             params, cfg, max_slots=slots, admit_width=slots,
-            max_len=2 * P + N + 2 * spc * max(spec_k, 1),
+            max_len=2 * P + N + 2 * spc_pair_max * max(spec_k, 1),
             steps_per_call=spc, kv_dtype=kv_dtype, spec_k=spec_k)
         for p in prompts:
             eng.submit(p, max_new_tokens=N)
@@ -342,8 +369,8 @@ def bench_rolling_spec(params, cfg, slots: int = 16, k: int = 8,
         return (_median(times[1:-1] if len(times) > 2 else times), stats)
 
     # plain rolling: device ms/step via (2K − K)/K differencing
-    med_k, _ = drain(0, 8)
-    med_2k, _ = drain(0, 16)
+    med_k, _ = drain(0, 8, 16)
+    med_2k, _ = drain(0, 16, 16)
     step_dev = (med_2k - med_k) / 8
     if step_dev <= 0:
         raise RuntimeError(
@@ -353,8 +380,8 @@ def bench_rolling_spec(params, cfg, slots: int = 16, k: int = 8,
 
     # speculative: device ms/ROUND via the same differencing; tokens per
     # round from the engine's acceptance accounting
-    med_r, st_r = drain(k, 4)
-    med_2r, st_2r = drain(k, 8)
+    med_r, st_r = drain(k, 4, 8)
+    med_2r, st_2r = drain(k, 8, 8)
     round_dev = (med_2r - med_r) / 4
     if round_dev <= 0:
         raise RuntimeError(

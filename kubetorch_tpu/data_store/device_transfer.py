@@ -81,9 +81,11 @@ def device_get_chunked(leaves, chunk_bytes: int = 256 << 20):
             out[i] = np.asarray(jax.device_get(leaf))
             continue
         # group by (dtype, device): concatenating same-dtype leaves
-        # committed to DIFFERENT devices raises — those batch per device
+        # committed to DIFFERENT devices raises — those batch per device.
+        # The device OBJECT is the key (ids are only unique per backend:
+        # cpu:0 and tpu:0 would collide on .id)
         dev = next(iter(leaf.devices()))
-        groups.setdefault((leaf.dtype, dev.id), []).append(i)
+        groups.setdefault((leaf.dtype, dev), []).append(i)
 
     def flush(batch):
         if not batch:
@@ -92,7 +94,16 @@ def device_get_chunked(leaves, chunk_bytes: int = 256 << 20):
             i = batch[0]
             out[i] = np.asarray(jax.device_get(leaves[i]))
             return
-        buf = jnp.concatenate([leaves[i].ravel() for i in batch])
+        try:
+            buf = jnp.concatenate([leaves[i].ravel() for i in batch])
+        except Exception:
+            # the packed buffer needs up to chunk_bytes of fresh
+            # contiguous HBM — at-HBM-edge states (where this repo
+            # deliberately runs) can refuse it; per-leaf staging is the
+            # slow-but-safe fallback the old path always used
+            for i in batch:
+                out[i] = np.asarray(jax.device_get(leaves[i]))
+            return
         host = np.asarray(jax.device_get(buf))
         off = 0
         for i in batch:
