@@ -282,7 +282,10 @@ def teardown(service):
               help="raw-terminal PTY session (pair with a "
                    "deep_breakpoint(pty=True) server): tty line editing, "
                    "echo, and window resizes")
-def debug(service, pod, port, pty):
+@click.option("--ui", is_flag=True,
+              help="print the pod's browser debugger URL (/_debug/ui — "
+                   "the reference's pdb-ui mode) instead of attaching")
+def debug(service, pod, port, pty, ui):
     """Attach to a deep_breakpoint() inside a deployed service."""
     from kubetorch_tpu.provisioning.backend import get_backend
     from kubetorch_tpu.serving.debugger import attach
@@ -296,6 +299,17 @@ def debug(service, pod, port, pty):
     if pod >= len(urls):
         raise click.ClickException(
             f"pod index {pod} out of range ({len(urls)} pods)")
+    if ui:
+        if pty:
+            # the page is line-mode only: a PTY session echoes input
+            # server-side (double-rendered lines) and emits control
+            # sequences the dumb renderer doesn't handle
+            raise click.ClickException(
+                "--ui pairs with plain deep_breakpoint() sessions; use "
+                "`ktpu debug --pty` in a terminal for PTY breakpoints")
+        suffix = f"?port={port}" if port else ""
+        click.echo(f"open in a browser: {urls[pod]}/_debug/ui{suffix}")
+        return
     click.echo(f"attaching to {urls[pod]} ... (q to quit pdb, Ctrl-D to "
                f"detach)")
     sys.exit(attach(urls[pod], port=port, pty=pty))

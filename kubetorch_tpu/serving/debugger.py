@@ -544,3 +544,77 @@ def _attach_pty(pod_url: str, params: dict, stdin, stdout) -> int:
             import termios
 
             termios.tcsetattr(in_fd, termios.TCSADRAIN, saved)
+
+
+# ---------------------------------------------------------------- browser UI
+DEBUG_UI_HTML = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>kubetorch-tpu debugger</title>
+<style>
+ body { background:#111; color:#ddd; font-family:ui-monospace,monospace;
+        margin:0; display:flex; flex-direction:column; height:100vh; }
+ #hdr { padding:6px 10px; background:#1c2333; color:#9ecbff;
+        font-size:13px; }
+ #out { flex:1; overflow-y:auto; white-space:pre-wrap; padding:10px;
+        font-size:13px; line-height:1.35; }
+ #row { display:flex; border-top:1px solid #333; }
+ #prompt { padding:8px 0 8px 10px; color:#7ee787; }
+ #in { flex:1; background:#111; color:#ddd; border:0; outline:0;
+       font:inherit; padding:8px 10px; }
+ .err { color:#ff7b72; }
+</style></head><body>
+<div id="hdr">kubetorch-tpu remote pdb — browser UI (reference pdb-ui
+analogue). Enter sends a command; `c` continues, `q` quits.</div>
+<div id="out"></div>
+<div id="row"><span id="prompt">(Pdb)</span>
+<input id="in" autofocus autocomplete="off" spellcheck="false"></div>
+<script>
+ const out = document.getElementById("out");
+ const inp = document.getElementById("in");
+ const qs = new URLSearchParams(location.search);
+ const port = qs.get("port") || "";
+ const proto = location.protocol === "https:" ? "wss" : "ws";
+ const ws = new WebSocket(proto + "://" + location.host +
+                          "/_debug/ws" + (port ? "?port=" + port : ""));
+ ws.binaryType = "arraybuffer";
+ const dec = new TextDecoder();
+ function show(text, cls) {
+   const span = document.createElement("span");
+   if (cls) span.className = cls;
+   // strip ANSI escapes for the dumb renderer
+   span.textContent = text.replace(/\\x1b\\[[0-9;?]*[A-Za-z]/g, "");
+   out.appendChild(span);
+   out.scrollTop = out.scrollHeight;
+ }
+ ws.onmessage = (ev) => {
+   if (typeof ev.data === "string") {
+     try {
+       const j = JSON.parse(ev.data);
+       if (j.error) { show(j.error + "\\n", "err"); return; }
+     } catch (e) {}
+     show(ev.data);
+   } else {
+     show(dec.decode(ev.data, {stream: true}));
+   }
+ };
+ ws.onclose = () => show("\\n[session closed]\\n", "err");
+ ws.onerror = () => show("\\n[connection error]\\n", "err");
+ inp.addEventListener("keydown", (ev) => {
+   if (ev.key === "Enter") {
+     show(inp.value + "\\n");
+     ws.send(inp.value + "\\n");
+     inp.value = "";
+   }
+ });
+</script></body></html>
+"""
+
+
+async def debug_ui(request):
+    """aiohttp handler: the self-contained browser debugger page
+    (reference ``serving/pdb_websocket.py:217`` supports modes
+    ``pdb``/``pdb-ui``; this is the native ``pdb-ui`` analogue — the
+    page speaks the same WS↔TCP bridge `ktpu debug` uses, mounted as
+    ``/_debug/ui`` by serving/server.py)."""
+    from aiohttp import web
+
+    return web.Response(text=DEBUG_UI_HTML, content_type="text/html")

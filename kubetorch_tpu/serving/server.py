@@ -124,6 +124,7 @@ class PodServer:
         app.router.add_post("/_reload", self.h_reload)
         app.router.add_post("/_teardown", self.h_teardown)
         app.router.add_get("/_debug/ws", self.h_debug_ws)
+        app.router.add_get("/_debug/ui", self.h_debug_ui)
         app.router.add_post("/_profile/{action}", self.h_profile)
         app.router.add_route("*", "/http/{tail:.*}", self.h_proxy)
         app.router.add_post("/_actors/spawn", self.h_actor_spawn)
@@ -444,6 +445,13 @@ class PodServer:
         from kubetorch_tpu.serving.debugger import ws_tcp_bridge
 
         return await ws_tcp_bridge(request)
+
+    async def h_debug_ui(self, request):
+        """Browser debugger page over the same bridge (reference
+        pdb-ui mode)."""
+        from kubetorch_tpu.serving.debugger import debug_ui
+
+        return await debug_ui(request)
 
     async def h_profile(self, request):
         """jax.profiler trace control: POST /_profile/start |

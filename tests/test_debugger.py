@@ -240,3 +240,72 @@ class TestPtyMode:
         finally:
             os.close(master)
             os.close(slave)
+
+
+class TestBrowserUI:
+    """The /_debug/ui page (reference pdb-ui mode): served by the pod
+    server, speaks the same WS bridge the terminal client uses."""
+
+    @pytest.mark.level("minimal")
+    def test_debug_ui_page_served_and_drives_session(self, tmp_path,
+                                                     monkeypatch):
+        import httpx
+
+        import kubetorch_tpu as kt
+        import kubetorch_tpu.provisioning.backend as backend_mod
+        from kubetorch_tpu.resources.callables.fn import Fn
+
+        state = tmp_path / "state3"
+        monkeypatch.setenv("KT_LOCAL_STATE", str(state))
+        monkeypatch.setattr(backend_mod, "_LOCAL_ROOT", state)
+        debug_port = _free_port()
+        remote = None
+        try:
+            remote = Fn(root_path=str(ASSETS), import_path="summer",
+                        callable_name="debug_me", name="dbg-ui").to(
+                kt.Compute(cpus="0.1",
+                           env={"KT_DEBUG_PORT": str(debug_port)}))
+            url = remote.pod_urls()[0]
+            # the page itself: self-contained, points at the bridge
+            page = httpx.get(f"{url}/_debug/ui", timeout=10.0)
+            assert page.status_code == 200
+            assert "text/html" in page.headers["content-type"]
+            assert "/_debug/ws" in page.text
+            assert "WebSocket" in page.text
+
+            # drive a real session exactly as the page's JS does: text
+            # frames in, binary pdb output back
+            call_result = {}
+
+            def do_call():
+                call_result["value"] = remote(21)
+
+            caller = threading.Thread(target=do_call, daemon=True)
+            caller.start()
+            time.sleep(1.5)
+
+            import asyncio
+
+            import aiohttp
+
+            async def drive():
+                buf = b""
+                async with aiohttp.ClientSession() as s:
+                    async with s.ws_connect(
+                            f"{url}/_debug/ws?port={debug_port}") as ws:
+                        await ws.send_str("p doubled\n")
+                        await ws.send_str("c\n")
+                        async for msg in ws:
+                            if msg.type == aiohttp.WSMsgType.BINARY:
+                                buf += msg.data
+                            else:
+                                break
+                return buf
+
+            out = asyncio.run(asyncio.wait_for(drive(), 30))
+            caller.join(15.0)
+            assert b"42" in out, out
+            assert call_result.get("value") == 42
+        finally:
+            if remote is not None:
+                remote.teardown()
