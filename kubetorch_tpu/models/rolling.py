@@ -122,8 +122,12 @@ class RollingGenerator:
         Composes with the int8 grid (verify reads int8 grid + bf16 chunk;
         accepted prefixes quantize at the merge) and per-request LoRA
         (the adapter one-hot rides the verify forward; drafting is
-        model-free). Greedy only: ``submit`` rejects ``temperature > 0``
-        and ``repetition_penalty != 1`` on a speculative engine."""
+        model-free). ``temperature > 0`` runs exact per-slot speculative
+        REJECTION sampling (drafts accepted with probability ``p(draft)``
+        under the filtered distribution; rejections draw from the
+        residual — the emitted stream is distributed exactly as
+        non-speculative sampling); ``repetition_penalty != 1`` is
+        rejected, matching the static ``SpeculativeGenerator``."""
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
@@ -180,9 +184,21 @@ class RollingGenerator:
             # tokens) — the n-gram draft matcher's haystack. Width
             # max_len + 1 so the carried token can sit at slot pos.
             self._ctx = jnp.zeros((max_slots, self.max_len + 1), jnp.int32)
+            # Carried next-token state. Exact speculative SAMPLING must
+            # draw the post-rejection token from the RESIDUAL
+            # distribution inside the verify round — a distribution that
+            # cannot be reconstructed later from logits — so rounds
+            # carry the drawn TOKEN (`_dnt`); `_dnt_valid` is False for
+            # freshly admitted slots, whose first token comes from the
+            # prefill logits instead.
+            self._dnt = jnp.zeros((max_slots,), jnp.int32)
+            self._dnt_valid = jnp.zeros((max_slots,), bool)
             # acceptance accounting for the serving bench / stats API
             self._spec_rounds = 0
             self._spec_emitted = 0
+            # sticky: flips True on the first sampled request (see
+            # _decode_spec_chunk)
+            self._spec_sampling = False
 
         # host bookkeeping
         self._free = list(range(max_slots))
@@ -217,12 +233,14 @@ class RollingGenerator:
         if self.spec:
             self._decode_sp = jax.jit(
                 partial(self._decode_spec_impl, cfg=cfg, rules=self.rules),
-                static_argnames=("k", "ngram", "n_rounds"),
-                donate_argnums=(1, 2, 3, 5))
+                static_argnames=("k", "ngram", "n_rounds", "top_k",
+                                 "top_p", "sampling"),
+                donate_argnums=(1, 3, 5, 6, 7))
             self._ctx_admit = jax.jit(
-                lambda ctx, rows, slots: ctx.at[slots].set(
-                    rows, mode="drop"),
-                donate_argnums=(0,))
+                lambda ctx, valid, rows, slots: (
+                    ctx.at[slots].set(rows, mode="drop"),
+                    valid.at[slots].set(False, mode="drop")),
+                donate_argnums=(0, 1))
 
     def _check_adapter_id(self, adapter_id: int) -> None:
         if adapter_id >= 0 and self.adapters is None:
@@ -273,13 +291,14 @@ class RollingGenerator:
                     f"{pfx_aid}; submit passed adapter_id {adapter_id} "
                     f"(prefix KV is weight-dependent — register one "
                     f"prefix per adapter)")
-        if self.spec and (temperature > 0 or repetition_penalty != 1.0):
-            # speculative verify is greedy-only (acceptance compares the
-            # draft against the model's argmax); penalty windows would
-            # need per-draft-position re-application inside the verify
+        if self.spec and repetition_penalty != 1.0:
+            # penalty windows would need per-draft-position
+            # re-application inside the verify (same restriction as the
+            # static SpeculativeGenerator). Sampling IS supported: exact
+            # per-slot speculative rejection sampling.
             raise ValueError(
-                "speculative engine (spec_k > 1) is greedy-only: "
-                "temperature must be 0 and repetition_penalty 1")
+                "speculative engine (spec_k > 1) does not support "
+                "repetition_penalty (temperature/top-k/top-p are fine)")
         prefix_len = 0
         if prefix_id is not None:
             if prefix_id not in self._prefixes:
@@ -449,8 +468,9 @@ class RollingGenerator:
                 for i, req in enumerate(group):
                     seq = head + req.prompt
                     rows[i, :len(seq)] = seq
-                self._ctx = self._ctx_admit(
-                    self._ctx, jnp.asarray(rows), jnp.asarray(slots))
+                self._ctx, self._dnt_valid = self._ctx_admit(
+                    self._ctx, self._dnt_valid, jnp.asarray(rows),
+                    jnp.asarray(slots))
 
     def _lora(self, onehot_np):
         """None when no adapters — the hot path must not pay a
@@ -497,13 +517,26 @@ class RollingGenerator:
         """One dispatch = ``steps_per_call`` verify rounds; each round
         emits 1..spec_k tokens per slot (the accepted draft prefix plus
         the model's own next token)."""
+        # STICKY sampling flag: the first sampled request upgrades the
+        # dispatch to the sampling executable and it stays there —
+        # flapping between the greedy and sampling executables per
+        # occupancy mix would pay an executable swap per flip on
+        # remote-dispatch links
+        if not self._spec_sampling and any(
+                self._slots[s].temperature > 0 for s in self._slots):
+            self._spec_sampling = True
+        self._rng, key = jax.random.split(self._rng)
         with self._mesh_ctx():
-            (self.cache, self._logits, self._dpos, self._ctx,
-             toks, emits) = self._decode_sp(
+            (self.cache, self._dpos, self._ctx, self._dnt,
+             self._dnt_valid, toks, emits) = self._decode_sp(
                 self.params, self.cache, self._logits, self._dpos,
-                self._dactive, self._ctx, self._lora(self._slot_onehot),
+                self._dactive, self._ctx, self._dnt, self._dnt_valid,
+                jnp.asarray(self._temps), key,
+                self._lora(self._slot_onehot),
                 k=self.spec_k, ngram=self.spec_ngram,
-                n_rounds=self.steps_per_call)
+                n_rounds=self.steps_per_call,
+                top_k=self.top_k, top_p=self.top_p,
+                sampling=self._spec_sampling)
         toks = np.asarray(toks)                # [R, B, k] — the one sync
         emits = np.asarray(emits)              # [R, B]
         new_by_slot: Dict[int, List[int]] = {}
@@ -752,13 +785,16 @@ class RollingGenerator:
             logits = logits.at[jnp.arange(B)[:, None], sidx].set(
                 adjusted, mode="drop")
 
-            logits_f = filter_logits(logits, top_k=top_k, top_p=top_p)
+            # temper BEFORE filtering — generate.sample_tokens order, so
+            # the top-p nucleus is computed on the tempered distribution
+            # (filter-then-temper picked a different support whenever
+            # top_p was set and temperature != 1)
+            logits_f = filter_logits(
+                logits / jnp.maximum(temps, 1e-6)[:, None],
+                top_k=top_k, top_p=top_p)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            keys = jax.random.split(step_key, B)
-            sampled = jax.vmap(
-                lambda k, l, t: jax.random.categorical(
-                    k, l / jnp.maximum(t, 1e-6))
-            )(keys, logits_f, temps).astype(jnp.int32)
+            sampled = jax.random.categorical(
+                step_key, logits_f, axis=-1).astype(jnp.int32)
             tok = jnp.where(temps > 0, sampled, greedy)
             win = jnp.concatenate([win[:, 1:], tok[:, None]], axis=1)
 
@@ -785,19 +821,28 @@ class RollingGenerator:
 
     @staticmethod
     def _decode_spec_impl(params, cache, last_logits, pos, active, ctx,
-                          lora, *, k, ngram, n_rounds, cfg, rules):
+                          dnt, dnt_valid, temps, key, lora, *, k, ngram,
+                          n_rounds, top_k, top_p, sampling, cfg, rules):
         """``n_rounds`` speculative verify rounds in one ``lax.scan``.
 
-        Per round and slot: the carried next token (= argmax of the
-        carried logits) plus ``k − 1`` prompt-lookup drafts from the
-        slot's device context run through ONE chunk-mode forward at the
-        slot's own depth; the accepted prefix (drafts matching the
-        model's argmax, greedy-exact by construction) merges into the
-        grid with the shared one-hot einsum (per-slot variable count —
-        rejected drafts never land, so there is no rollback). The carry
-        logits move to the acceptance-break position, which makes the
-        next round's carried token the model's own correction — greedy
-        output is token-identical to the plain engine.
+        Per round and slot: the carried next token plus ``k − 1``
+        prompt-lookup drafts from the slot's device context run through
+        ONE chunk-mode forward at the slot's own depth; the accepted
+        prefix merges into the grid with the shared one-hot einsum
+        (per-slot variable count — rejected drafts never land, so there
+        is no rollback).
+
+        Greedy slots (temp 0): a draft survives where it equals the
+        model's argmax and the carried token becomes the argmax at the
+        break — token-identical to the plain engine. Sampled slots:
+        exact speculative REJECTION sampling per slot (the static
+        ``SpeculativeGenerator``'s math) — draft ``d`` accepted with
+        probability ``p(d)`` under the filtered/tempered distribution;
+        on rejection the next token draws from the residual (``d``'s
+        mass removed, renormalized). The residual draw cannot be
+        reconstructed outside the round, so rounds carry the drawn
+        TOKEN (``dnt``); ``dnt_valid=False`` rows (fresh admissions)
+        take their first token from the prefill logits instead.
 
         Unlike the plain chunk (grid merged once per dispatch), each
         round merges: round r+1's verify must read round r's accepted
@@ -807,7 +852,11 @@ class RollingGenerator:
         replacing several single-token steps is the bigger term in the
         weight-bound regime this mode targets.
         """
-        from kubetorch_tpu.models.speculative import _ngram_draft
+        from kubetorch_tpu.models.speculative import (
+            _ngram_draft,
+            rejection_accept,
+            residual_next,
+        )
 
         M = cache["k"].shape[2]
         B = last_logits.shape[0]
@@ -816,10 +865,39 @@ class RollingGenerator:
         Lctx = ctx.shape[1]
         bidx = jnp.arange(B)[:, None]
         cdt = jnp.bfloat16 if "ks" in cache else cache["k"].dtype
+        # `sampling` is STATIC (the host re-jits once if sampled traffic
+        # ever appears): all-greedy dispatches — the established serving
+        # path — must not pay the softmax/filter/categorical machinery
+        # whose outputs a where() would discard.
+        sampled = temps > 0
+        tk = jnp.maximum(temps, 1e-6)
 
-        def one(carry, _):
-            cache, logits, pos, ctx = carry
-            nt = jnp.argmax(logits, axis=-1).astype(jnp.int32)    # [B]
+        def _probs(lg):
+            # temper BEFORE filtering — generate.sample_tokens order, so
+            # the rejection test draws from the identical distribution
+            shp = lg.shape
+            flat = filter_logits(
+                (lg / tk.reshape((-1,) + (1,) * (lg.ndim - 1))
+                 ).reshape(-1, shp[-1]), top_k, top_p)
+            return jax.nn.softmax(flat, axis=-1).reshape(shp)
+
+        # fresh rows' first token comes from the (loop-invariant) prefill
+        # logits — computed ONCE, not per round
+        key, k_fresh = jax.random.split(key)
+        nt0 = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+        if sampling:
+            nt0 = jnp.where(
+                sampled,
+                jax.random.categorical(
+                    k_fresh, jnp.log(_probs(last_logits) + 1e-30)
+                ).astype(jnp.int32),
+                nt0)
+        dnt = jnp.where(dnt_valid, dnt, nt0)
+
+        def one(carry, key_r):
+            cache, pos, ctx, dnt, dnt_valid = carry
+            k_acc, k_res = jax.random.split(key_r)
+            nt = dnt
             cext = ctx.at[bidx, pos[:, None]].set(nt[:, None],
                                                   mode="drop")
             if k > 1:
@@ -842,10 +920,16 @@ class RollingGenerator:
                 chunk=chunk, chunk_col=0, chunk_mask=emask, lora=lora)
             g = jnp.argmax(lg, axis=-1).astype(jnp.int32)         # [B, k]
             if k > 1:
-                ok = (feed[:, 1:] == g[:, :-1]).astype(jnp.int32)
-                acc = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)    # 0..k-1
+                ok_g = (feed[:, 1:] == g[:, :-1]).astype(jnp.int32)
+                acc = jnp.sum(jnp.cumprod(ok_g, axis=1), axis=1)  # 0..k-1
             else:
                 acc = jnp.zeros((B,), jnp.int32)
+            if sampling:
+                # exact per-slot rejection sampling — shared helpers
+                # with the static SpeculativeGenerator
+                probs = _probs(lg)                               # [B,k,V]
+                acc_s = rejection_accept(probs, feed, k_acc, k=k)
+                acc = jnp.where(sampled, acc_s, acc)
             emit = jnp.where(active, 1 + acc, 0)
             cache = llama.merge_chunk_into_grid(cache, chunk, pos, emit)
             # context mirrors the grid's accepted prefix
@@ -853,15 +937,20 @@ class RollingGenerator:
             cvalid = jnp.arange(k)[None, :] < emit[:, None]
             ctx = ctx.at[bidx, jnp.where(cvalid, cpos, Lctx)].set(
                 jnp.where(cvalid, feed, 0), mode="drop")
-            # carry logits at the acceptance break → next round's nt is
-            # the model's correction (or the bonus token on full accept)
-            logits = jnp.take_along_axis(
-                lg, jnp.clip(acc, 0, k - 1)[:, None, None], axis=1)[:, 0]
-            return (cache, logits, pos + emit, ctx), (feed, emit)
+            # next carried token at the acceptance break: the model's
+            # correction/bonus (greedy) or a residual draw (sampled)
+            j = jnp.clip(acc, 0, k - 1)
+            dnt = jnp.take_along_axis(g, j[:, None], axis=1)[:, 0]
+            if sampling:
+                nxt_s = residual_next(probs, feed, acc, k_res, k=k)
+                dnt = jnp.where(sampled, nxt_s, dnt)
+            dnt_valid = dnt_valid | active
+            return (cache, pos + emit, ctx, dnt, dnt_valid), (feed, emit)
 
-        (cache, logits, pos, ctx), (toks, emits) = jax.lax.scan(
-            one, (cache, last_logits, pos, ctx), None, length=n_rounds)
-        return cache, logits, pos, ctx, toks, emits
+        (cache, pos, ctx, dnt, dnt_valid), (toks, emits) = jax.lax.scan(
+            one, (cache, pos, ctx, dnt, dnt_valid),
+            jax.random.split(key, n_rounds))
+        return cache, pos, ctx, dnt, dnt_valid, toks, emits
 
 
 class RollingService:

@@ -94,6 +94,50 @@ def _ngram_draft(cext: jax.Array, clen: jax.Array, nt: jax.Array,
     return jnp.where(valid, drafts, nt[:, None])
 
 
+def rejection_accept(probs, feed, key, *, k):
+    """Speculative rejection acceptance for a point-mass draft: [B]
+    accepted-draft count (0..k-1). Draft ``feed[:, i+1]`` is accepted at
+    position ``i`` with probability ``p_i(draft)`` under ``probs``
+    [B, k, V]; acceptance stops at the first reject (cumprod). Shared by
+    the static generator and the rolling engine's sampled spec path —
+    the math must never diverge between them."""
+    B = feed.shape[0]
+    if k <= 1:
+        return jnp.zeros((B,), jnp.int32)
+    p_draft = jnp.take_along_axis(
+        probs[:, :-1], feed[:, 1:, None], axis=2)[..., 0]    # [B, k-1]
+    u = jax.random.uniform(key, (B, k - 1))
+    ok = u < p_draft
+    return jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+
+
+def residual_next(probs, feed, acc, key, *, k):
+    """Exact next-token draw at the acceptance break: the residual
+    distribution (the rejected draft's mass removed, renormalized) on a
+    rejection, the full break-position distribution on a full accept —
+    together with :func:`rejection_accept` this makes the emitted
+    stream distributed exactly as non-speculative sampling."""
+    V = probs.shape[-1]
+    j = jnp.clip(acc, 0, k - 1)
+    p_j = jnp.take_along_axis(probs, j[:, None, None], axis=1)[:, 0]
+    if k > 1:
+        rejected = acc < (k - 1)
+        d_rej = jnp.take_along_axis(
+            feed, jnp.clip(acc + 1, 0, k - 1)[:, None], axis=1)[:, 0]
+        removed = jnp.where(
+            rejected[:, None],
+            jnp.arange(V)[None, :] == d_rej[:, None], False)
+        resid = jnp.where(removed, 0.0, p_j)
+        total = jnp.sum(resid, axis=-1, keepdims=True)
+        # p(d)≈1 rejected has ~zero residual mass (measure-zero); fall
+        # back to p_j rather than divide by ~0
+        p_next = jnp.where(total > 1e-9, resid / total, p_j)
+    else:
+        p_next = p_j
+    return jax.random.categorical(
+        key, jnp.log(p_next + 1e-30)).astype(jnp.int32)
+
+
 class SpeculativeGenerator:
     """Greedy generation with n-gram speculative verification.
 
@@ -219,45 +263,14 @@ class SpeculativeGenerator:
                 params, feed, positions, cache, None, gmask, cfg, rules,
                 chunk=chunk, chunk_col=0, chunk_mask=emask)
             if sampled:
-                # Rejection sampling over the point-mass draft: accept
-                # draft d at position i with prob p_i(d); the first reject
-                # resamples from the residual (p with d's mass removed,
-                # renormalized). Exact: emitted tokens are distributed as
-                # non-speculative sampling from the same filtered p.
+                # Rejection sampling over the point-mass draft (shared
+                # helpers — the rolling engine's sampled spec path uses
+                # the same math): exact, emitted tokens are distributed
+                # as non-speculative sampling from the same filtered p.
                 rng, ku, ks = jax.random.split(rng, 3)
                 probs = _probs(logits)                           # [B,k,V]
-                if k > 1:
-                    p_draft = jnp.take_along_axis(
-                        probs[:, :-1], feed[:, 1:, None],
-                        axis=2)[..., 0]                          # [B,k-1]
-                    u = jax.random.uniform(ku, (B, k - 1))
-                    ok = u < p_draft
-                    acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32),
-                                              axis=1), axis=1)   # 0..k-1
-                else:
-                    acc = jnp.zeros((B,), jnp.int32)
-                # next-token distribution at the break position
-                j = jnp.clip(acc, 0, k - 1)
-                p_j = jnp.take_along_axis(
-                    probs, j[:, None, None], axis=1)[:, 0]       # [B, V]
-                rejected = acc < (k - 1)
-                if k > 1:
-                    d_rej = jnp.take_along_axis(
-                        feed, jnp.clip(acc + 1, 0, k - 1)[:, None],
-                        axis=1)[:, 0]
-                    removed = jnp.where(
-                        rejected[:, None],
-                        jnp.arange(probs.shape[-1])[None, :]
-                        == d_rej[:, None], False)
-                    resid = jnp.where(removed, 0.0, p_j)
-                    total = jnp.sum(resid, axis=-1, keepdims=True)
-                    # p(d)≈1 rejected has ~zero residual mass (measure-
-                    # zero); fall back to p_j rather than divide by ~0
-                    p_next = jnp.where(total > 1e-9, resid / total, p_j)
-                else:
-                    p_next = p_j
-                nxt = jax.random.categorical(
-                    ks, jnp.log(p_next + 1e-30)).astype(jnp.int32)
+                acc = rejection_accept(probs, feed, ku, k=k)
+                nxt = residual_next(probs, feed, acc, ks, k=k)
             else:
                 g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,k]
                 # acceptance prefix: drafts[i] (= feed[i+1]) vs g[:, i]

@@ -563,10 +563,10 @@ def test_spec_rolling_validation(model):
         RollingGenerator(params, cfg, max_slots=2, spec_k=1)
     eng = RollingGenerator(params, cfg, max_slots=2, spec_k=4,
                            steps_per_call=2)
-    with pytest.raises(ValueError, match="greedy-only"):
-        eng.submit([1, 2], max_new_tokens=4, temperature=0.7)
-    with pytest.raises(ValueError, match="greedy-only"):
+    with pytest.raises(ValueError, match="repetition_penalty"):
         eng.submit([1, 2], max_new_tokens=4, repetition_penalty=1.3)
+    # sampling is supported (exact rejection sampling per slot)
+    eng.submit([1, 2], max_new_tokens=4, temperature=0.7)
 
 
 @pytest.mark.level("minimal")
@@ -656,3 +656,53 @@ def test_serving_width_rolling_int8_parity(model):
     assert mismatch <= 2, (
         mismatch, [(acc[r], iso[i]) for i, r in enumerate(rids)
                    if acc[r] != iso[i]])
+
+
+@pytest.mark.level("minimal")
+def test_spec_rolling_sampled_matches_plain_distribution(model):
+    """temperature>0 on a speculative engine: exact per-slot rejection
+    sampling — the emitted stream must be distributed as non-speculative
+    sampling. Monte-Carlo over the first two tokens (top_k=4 keeps the
+    support small), identical prompts as independent requests."""
+    import collections
+
+    params, cfg = model
+    B = 768
+    prompt = [3, 7, 11, 2, 9]
+
+    def hist(eng):
+        rids = [eng.submit(list(prompt), max_new_tokens=2,
+                           temperature=1.0) for _ in range(B)]
+        res = eng.run()
+        return collections.Counter(tuple(res[r]) for r in rids)
+
+    plain = RollingGenerator(params, cfg, max_slots=128, top_k=4,
+                             steps_per_call=2, seed=11)
+    h_plain = hist(plain)
+    spec = RollingGenerator(params, cfg, max_slots=128, top_k=4,
+                            steps_per_call=1, spec_k=4, seed=22)
+    h_spec = hist(spec)
+    keys = set(h_plain) | set(h_spec)
+    tv = 0.5 * sum(abs(h_plain.get(t, 0) / B - h_spec.get(t, 0) / B)
+                   for t in keys)
+    assert tv < 0.12, (tv, h_plain.most_common(5), h_spec.most_common(5))
+
+
+@pytest.mark.level("minimal")
+def test_spec_rolling_sampled_accepts_drafts(model):
+    """Sampling must still ACCEPT drafts on loopy low-temperature
+    traffic (zero-acceptance rejection sampling is just plain sampling —
+    the distribution test alone can't see that regression)."""
+    params, cfg = model
+    gen = Generator(params, cfg)
+    warm = gen.generate([[5, 9, 13]], max_new_tokens=32,
+                        temperature=0.0)[0]
+    loopy = [5, 9, 13] + warm[:24]
+    eng = RollingGenerator(params, cfg, max_slots=4, spec_k=8,
+                           spec_ngram=2, steps_per_call=2, top_k=4,
+                           seed=3)
+    rids = [eng.submit(list(loopy), max_new_tokens=16, temperature=0.2)
+            for _ in range(4)]
+    res = eng.run()
+    assert all(len(res[r]) == 16 for r in rids)
+    assert eng.spec_stats["tokens_per_pass"] > 1.0, eng.spec_stats
