@@ -237,3 +237,53 @@ def test_int8_grid_rolling_on_device():
     agree = sum(a == b for rid, expect in zip(rids, iso)
                 for a, b in zip(out[rid], expect))
     assert agree >= 22, (agree, [out[r] for r in rids], iso)
+
+
+def test_spec_rolling_on_device():
+    """Speculative continuous batching ON DEVICE (r5): verify rounds,
+    per-slot accepted-prefix merges, and the device-resident draft
+    context must reproduce the plain rolling engine's greedy stream —
+    CPU parity can't see Mosaic lowering differences in the per-round
+    merge path. Loopy traffic also pins that acceptance actually
+    engages on hardware."""
+    import jax
+
+    from kubetorch_tpu.models import LlamaConfig, llama
+    from kubetorch_tpu.models.generate import Generator
+    from kubetorch_tpu.models.quant import quantize_params
+    from kubetorch_tpu.models.rolling import RollingGenerator
+
+    cfg = LlamaConfig(vocab_size=4096, embed_dim=512, n_layers=4,
+                      n_heads=8, n_kv_heads=4, head_dim=64, mlp_dim=2048,
+                      remat=False, dtype="bfloat16",
+                      param_dtype="bfloat16", max_seq_len=512)
+    params = jax.jit(lambda key: llama.init(key, cfg))(jax.random.key(0))
+    qparams = jax.jit(quantize_params)(params)
+
+    gen = Generator(qparams, cfg)
+    warm = gen.generate([[5, 9, 13]], max_new_tokens=48,
+                        temperature=0.0)[0]
+    loopy = [5, 9, 13] + warm[:32]
+    prompts = [loopy, [1, 2, 3, 4, 5], loopy[:20]]
+
+    plain = RollingGenerator(qparams, cfg, max_slots=4, steps_per_call=4,
+                             kv_dtype="int8")
+    rid_p = [plain.submit(list(p), max_new_tokens=24) for p in prompts]
+    out_p = plain.run()
+
+    spec = RollingGenerator(qparams, cfg, max_slots=4, steps_per_call=2,
+                            spec_k=6, spec_ngram=2, kv_dtype="int8")
+    rid_s = [spec.submit(list(p), max_new_tokens=24) for p in prompts]
+    out_s = spec.run()
+
+    assert all(len(out_s[r]) == 24 for r in rid_s)
+    # int8 per-round (spec) vs per-chunk (plain) quantization timing
+    # allows near-tie flips, and one early flip desynchronizes the rest
+    # of that row — tolerate ONE fully-desynced 24-token row (the other
+    # int8 device rows hold a comparable ~2/3 bar for the same reason)
+    agree = sum(a == b for rp, rs in zip(rid_p, rid_s)
+                for a, b in zip(out_p[rp], out_s[rs]))
+    assert agree >= 48, (agree, [out_p[r] for r in rid_p],
+                         [out_s[r] for r in rid_s])
+    # speculation must engage on the loopy rows
+    assert spec.spec_stats["tokens_per_pass"] > 1.2, spec.spec_stats
