@@ -434,7 +434,7 @@ def _bench_tpu():
         # vs 1.6 s/round under pressure)
         _free_device_memory()
         extra["rolling_spec_16slot"] = bench_rolling_spec(
-            params, cfg, slots=16, k=8, kv_dtype="int8", P=112, N=192)
+            params, cfg, slots=16, k=8, kv_dtype="int8", P=112, N=384)
     except Exception as e:
         print(f"# rolling-spec bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)

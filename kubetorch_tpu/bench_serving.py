@@ -318,7 +318,7 @@ def _run_phases(params, cfg, B, P, N, chunk_pair, n_poisson, rng,
 
 def bench_rolling_spec(params, cfg, slots: int = 16, k: int = 8,
                        kv_dtype: str = "int8", P: int = 112,
-                       N: int = 192, seed: int = 0) -> dict:
+                       N: int = 384, seed: int = 0) -> dict:
     """Speculative continuous batching vs plain rolling at LOW occupancy
     (VERDICT r4 #1 done-bar: 8–16 occupied slots — the latency-sensitive
     regime where decode is weight-bound and accepted drafts are nearly
@@ -368,10 +368,15 @@ def bench_rolling_spec(params, cfg, slots: int = 16, k: int = 8,
         stats = dict(eng.spec_stats) if spec_k else {}
         return (_median(times[1:-1] if len(times) > 2 else times), stats)
 
-    # plain rolling: device ms/step via (2K − K)/K differencing
-    med_k, _ = drain(0, 8, 16)
-    med_2k, _ = drain(0, 16, 16)
-    step_dev = (med_2k - med_k) / 8
+    # plain rolling: device ms/step via (4K − K)/3K differencing. The
+    # WIDE pair matters at this low-occupancy scale: a 16-slot 0.8B step
+    # is ~3 ms device, so an 8-vs-16 pair's ~22 ms delta drowns in the
+    # ~150 ms tunnel dispatch jitter (a run measured 155/155 ms and the
+    # guard refused); 8-vs-32 puts ~65 ms of device time between the
+    # medians.
+    med_k, _ = drain(0, 8, 32)
+    med_2k, _ = drain(0, 32, 32)
+    step_dev = (med_2k - med_k) / 24
     if step_dev <= 0:
         raise RuntimeError(
             f"plain differencing invalid: {med_k * 1e3:.0f} / "
@@ -380,9 +385,9 @@ def bench_rolling_spec(params, cfg, slots: int = 16, k: int = 8,
 
     # speculative: device ms/ROUND via the same differencing; tokens per
     # round from the engine's acceptance accounting
-    med_r, st_r = drain(k, 4, 8)
-    med_2r, st_2r = drain(k, 8, 8)
-    round_dev = (med_2r - med_r) / 4
+    med_r, st_r = drain(k, 4, 16)
+    med_2r, st_2r = drain(k, 16, 16)
+    round_dev = (med_2r - med_r) / 12
     if round_dev <= 0:
         raise RuntimeError(
             f"spec differencing invalid: {med_r * 1e3:.0f} / "
