@@ -706,3 +706,44 @@ def test_spec_rolling_sampled_accepts_drafts(model):
     res = eng.run()
     assert all(len(res[r]) == 16 for r in rids)
     assert eng.spec_stats["tokens_per_pass"] > 1.0, eng.spec_stats
+
+
+@pytest.mark.level("minimal")
+def test_spec_rolling_service_token_streaming(model):
+    """generate_iter yields tokens INCREMENTALLY from a speculative
+    engine (per decode chunk, one int at a time) — verified by
+    observing the first token while the request is still mid-flight,
+    under a deadline so a dead driver thread fails instead of hanging."""
+    import queue as _queue
+    import threading
+
+    from kubetorch_tpu.models.rolling import RollingService
+
+    params, cfg = model
+    svc = RollingService(RollingGenerator(params, cfg, max_slots=2,
+                                          spec_k=4, steps_per_call=1))
+    plain = RollingGenerator(params, cfg, max_slots=2, steps_per_call=4)
+    rid = plain.submit([1, 2, 3], max_new_tokens=10)
+    want = plain.run()[rid]
+
+    seen = _queue.Queue()
+    got = []
+
+    def consume():
+        for i, tok in enumerate(svc.generate_iter([1, 2, 3],
+                                                  max_new_tokens=10)):
+            got.append(tok)
+            if i == 0:
+                # first token observed while the request is still
+                # decoding — incremental delivery, not a drained batch
+                seen.put(svc.engine.pending)
+        seen.put("done")
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    pending_at_first = seen.get(timeout=60)
+    assert pending_at_first > 0, "first token arrived only after drain"
+    assert seen.get(timeout=60) == "done"
+    t.join(10)
+    assert not t.is_alive()
+    assert got == want, (got, want)
