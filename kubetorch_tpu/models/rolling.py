@@ -397,16 +397,24 @@ class RollingGenerator:
         }
         return pid
 
-    def warmup(self, prompt_buckets=(16, 64, 128)) -> None:
+    def warmup(self, prompt_buckets=(16, 64, 128),
+               sampling: bool = False) -> None:
         """Compile the serving shapes up front: the decode chunk plus both
         admission widths for each prompt bucket. Call before taking
         traffic — a cold (bucket, width) pair compiles mid-request
-        otherwise (tens of seconds on a cold compile cache)."""
+        otherwise (tens of seconds on a cold compile cache).
+
+        ``sampling=True`` on a speculative engine also compiles the
+        SAMPLING decode executable (the sticky upgrade the first
+        ``temperature > 0`` request would otherwise trigger
+        mid-traffic); plain engines bake sampling into the one
+        executable, so the flag is a no-op there."""
+        temp = 1.0 if sampling and self.spec else 0.0
         for p_pad in sorted(set(_bucket(b) for b in prompt_buckets)):
             for width in sorted({1, self.max_slots}):
                 for _ in range(width):
                     self.submit([1] * min(p_pad, self.max_len // 2),
-                                max_new_tokens=1)
+                                max_new_tokens=1, temperature=temp)
                 self.run()
 
     # ----------------------------------------------------------- interns

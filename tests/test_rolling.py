@@ -747,3 +747,22 @@ def test_spec_rolling_service_token_streaming(model):
     t.join(10)
     assert not t.is_alive()
     assert got == want, (got, want)
+
+
+@pytest.mark.level("minimal")
+def test_spec_warmup_compiles_sampling_executable(model):
+    """warmup(sampling=True) pre-flips the sticky sampling upgrade so
+    the first temperature>0 request doesn't compile mid-traffic; the
+    engine still serves greedy traffic identically afterwards."""
+    params, cfg = model
+    eng = RollingGenerator(params, cfg, max_slots=2, spec_k=4,
+                           steps_per_call=2)
+    eng.warmup(prompt_buckets=(16,), sampling=True)
+    assert eng._spec_sampling
+    plain = RollingGenerator(params, cfg, max_slots=2, steps_per_call=4)
+    rid_p = plain.submit([1, 2, 3], max_new_tokens=8)
+    want = plain.run()[rid_p]
+    rid = eng.submit([1, 2, 3], max_new_tokens=8)       # greedy request
+    assert eng.run()[rid] == want
+    rid_s = eng.submit([1, 2, 3], max_new_tokens=8, temperature=0.9)
+    assert len(eng.run()[rid_s]) == 8
