@@ -93,8 +93,10 @@ def grpo_train(rounds: int = 2, group_size: int = 8, seq_len: int = 32,
         losses.append(float(metrics["loss"]))
         if (round_ix + 1) % sync_every == 0:
             tree = trainer.state["params"]
-            key = ADAPTER_KEY if use_lora else WEIGHTS_KEY
-            put_arrays(key, tree)
+            if use_lora:
+                lora_mod.publish_adapters(ADAPTER_KEY, tree)
+            else:
+                put_arrays(WEIGHTS_KEY, tree)
             sync_bytes = sum(int(x.size) * x.dtype.itemsize
                              for x in jax.tree.leaves(tree))
             published += 1
@@ -142,15 +144,20 @@ def grpo_sample(n_prompts: int = 4, seq_len: int = 8,
         base = jax.jit(lambda k: llama.init(k, cfg))(jax.random.key(0))
         template = jax.eval_shape(
             lambda: lora_mod.init(jax.random.key(0), base, lcfg))
-        adapters = get_arrays(ADAPTER_KEY, template=template,
-                              broadcast=window)
+        adapters = lora_mod.fetch_adapters(ADAPTER_KEY, template,
+                                           broadcast=window)
         params = jax.jit(
             lambda b, a: lora_mod.merge(b, a, lcfg))(base, adapters)
     else:
         # abstract init (no FLOPs) recovers the param tree structure the
         # trainer packed, so the blob unflattens to a real param pytree.
+        # shardings= lands each leaf on this sampler's devices as its
+        # bytes arrive (streamed, pipelined restore) — no intermediate
+        # full-host copy of the whole weight tree.
         template = jax.eval_shape(lambda: llama.init(jax.random.key(0), cfg))
-        params = get_arrays(WEIGHTS_KEY, template=template, broadcast=window)
+        params = get_arrays(
+            WEIGHTS_KEY, template=template, broadcast=window,
+            shardings=jax.sharding.SingleDeviceSharding(jax.devices()[0]))
     rng = np.random.default_rng(1)
     eng = RollingGenerator(params, cfg, max_slots=min(8, n_prompts),
                            steps_per_call=4)

@@ -453,9 +453,15 @@ def _sweep_stale_trees(cache_root: Path, grace: float = 120.0,
 
 def broadcast_get(store_backend, key: str, window: BroadcastWindow,
                   dest: Optional[Path] = None, excludes=None,
-                  cache_root: Optional[Path] = None):
+                  cache_root: Optional[Path] = None,
+                  as_path: bool = False):
     """Coordinated fetch. Returns blob bytes, or the dest/cache Path for
-    trees. Falls back to a direct store fetch if the parent peer dies."""
+    trees. Falls back to a direct store fetch if the parent peer dies.
+
+    ``as_path=True`` returns the peer-cache Path for blobs too (no
+    ``read_bytes`` of a multi-GB body) — the streaming restore reads it in
+    chunks. The file may be reclaimed by a later re-put's cache sweep, so
+    consume it promptly."""
     from kubetorch_tpu.data_store.http_store import HttpStoreBackend
 
     cache_root = Path(cache_root or window.cache_root or _CACHE_ROOT)
@@ -563,6 +569,8 @@ def broadcast_get(store_backend, key: str, window: BroadcastWindow,
             sync_tree(local, Path(dest),
                       DEFAULT_EXCLUDES if excludes is None else excludes)
             return Path(dest)
+        return local
+    if as_path and dest is None:
         return local
     data = local.read_bytes()
     if dest is not None:

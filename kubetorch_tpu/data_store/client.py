@@ -132,6 +132,19 @@ class LocalStoreBackend:
             raise DataStoreError(f"no such key {key!r}")
         return path.read_bytes()
 
+    def get_blob_stream(self, key: str, chunk_bytes: int = 4 << 20,
+                        **kw):
+        """Chunked reads off disk — same iterator contract as the HTTP
+        backend's, so the streaming array restore works identically in
+        laptop/test mode (``broadcast`` is a no-op here, as in
+        ``get_blob``)."""
+        from kubetorch_tpu.data_store.http_store import _iter_file_chunks
+
+        path = self._path(key)
+        if not path.exists() or path.is_dir():
+            raise DataStoreError(f"no such key {key!r}")
+        return _iter_file_chunks(path, chunk_bytes)
+
     def list_keys(self, prefix: str = "", **kw) -> List[dict]:
         base = self.root / prefix if prefix else self.root
         if not base.exists():

@@ -18,7 +18,10 @@ fetch — the async-GRPO pattern); steady-state checkpointing should prefer
 from __future__ import annotations
 
 import io
-from typing import Any, Optional
+import queue
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import msgpack
 import numpy as np
@@ -26,6 +29,18 @@ import numpy as np
 from kubetorch_tpu.data_store import commands as store
 
 _MAGIC = b"KTARRV1\x00"
+
+# Decomposition of the most recent get_arrays restore in this process —
+# read by bench_dataplane and mirrored into the Prometheus counters
+# (observability.prometheus.record_restore). Plain dict, overwritten per
+# restore: the bench and the metrics push both want "the last one".
+_LAST_RESTORE: Dict[str, float] = {}
+
+
+def last_restore_stats() -> Dict[str, float]:
+    """Decomposition of the most recent streamed restore: wall/fetch/place
+    seconds, bytes, leaves, and the fetch/placement overlap ratio."""
+    return dict(_LAST_RESTORE)
 
 
 def _dtype_from_name(name: str) -> np.dtype:
@@ -158,12 +173,21 @@ def _iter_leaf_bytes(host_leaves, chunk: int = 32 << 20):
             yield mv[i:i + chunk]
 
 
-def unpack_arrays(data: bytes, template: Optional[Any] = None) -> Any:
+def unpack_arrays(data: bytes, template: Optional[Any] = None,
+                  copy: bool = False) -> Any:
     """Unpack to numpy leaves; structure comes from ``template`` when given
-    (exact pytree round-trip), else a flat list."""
+    (exact pytree round-trip), else a flat list.
+
+    ``copy=False`` (default) returns zero-copy ``np.frombuffer`` views into
+    ``data`` — fastest, but every view pins the ENTIRE blob: one surviving
+    1 KB leaf keeps a multi-GB buffer alive. ``copy=True`` materializes
+    each leaf into its own freshly-owned array so ``data`` is collectable
+    the moment this returns — what :func:`get_arrays` uses on its blocking
+    fallback (and what the streaming path gets for free, since streamed
+    leaves are assembled into owned buffers, never views)."""
     import jax
 
-    if not data.startswith(_MAGIC):
+    if not bytes(data[:len(_MAGIC)]) == _MAGIC:
         raise ValueError("not a packed-array buffer")
     # memoryview slices: bytes slicing would COPY each multi-GB leaf
     mv = memoryview(data)
@@ -179,12 +203,151 @@ def unpack_arrays(data: bytes, template: Optional[Any] = None) -> Any:
         nbytes = count * dtype.itemsize
         array = np.frombuffer(
             mv[offset:offset + nbytes], dtype=dtype).reshape(spec["shape"])
+        if copy:
+            array = np.array(array)  # owns its memory; releases the blob
         leaves.append(array)
         offset += nbytes
     if template is not None:
         treedef = jax.tree.structure(template)
         return jax.tree.unflatten(treedef, leaves)
     return leaves
+
+
+class StreamUnpacker:
+    """Incremental parser for the packed-array wire format.
+
+    Feed it chunks as they come off the socket; it hands back complete
+    leaves as soon as their last byte arrives. Peak buffering is
+    O(header + chunk + current leaf): incoming bytes are copied straight
+    into each leaf's own freshly-allocated buffer (so, unlike
+    ``unpack_arrays``'s views, finished leaves never pin the stream), and
+    the only other storage is the pre-header accumulation buffer plus
+    whatever tail of the current chunk hasn't been consumed yet —
+    the whole blob is never materialized.
+    """
+
+    def __init__(self):
+        self._pending = bytearray()   # unparsed bytes before the header ends
+        self.header: Optional[dict] = None
+        self._specs: List[Tuple[tuple, np.dtype, int]] = []
+        self._leaf_ix = 0
+        self._cur: Optional[np.ndarray] = None   # flat uint8 view being filled
+        self._cur_arr: Optional[np.ndarray] = None
+        self._cur_off = 0
+        self.bytes_fed = 0
+        self.peak_buffered = 0  # max(pending + current-leaf allocation)
+
+    @property
+    def num_leaves(self) -> Optional[int]:
+        return len(self._specs) if self.header is not None else None
+
+    @property
+    def complete(self) -> bool:
+        return (self.header is not None
+                and self._leaf_ix >= len(self._specs)
+                and not self._pending)
+
+    def _note_buffered(self):
+        cur = self._cur.nbytes if self._cur is not None else 0
+        self.peak_buffered = max(self.peak_buffered,
+                                 len(self._pending) + cur)
+
+    def _start_leaf(self) -> List[Tuple[int, np.ndarray]]:
+        """Allocate the next leaf buffer; emit any zero-byte leaves."""
+        done = []
+        while self._leaf_ix < len(self._specs):
+            shape, dtype, nbytes = self._specs[self._leaf_ix]
+            if nbytes == 0:
+                done.append((self._leaf_ix,
+                             np.empty(shape, dtype=dtype)))
+                self._leaf_ix += 1
+                continue
+            arr = np.empty(shape, dtype=dtype)
+            self._cur_arr = arr
+            self._cur = arr.reshape(-1).view(np.uint8).reshape(-1)
+            self._cur_off = 0
+            break
+        return done
+
+    def _parse_header(self) -> bool:
+        base = len(_MAGIC) + 8
+        if len(self._pending) < base:
+            return False
+        if bytes(self._pending[:len(_MAGIC)]) != _MAGIC:
+            raise ValueError("not a packed-array stream")
+        head_len = int.from_bytes(self._pending[len(_MAGIC):base], "little")
+        if len(self._pending) < base + head_len:
+            return False
+        self.header = msgpack.unpackb(bytes(
+            self._pending[base:base + head_len]))
+        for spec in self.header["leaves"]:
+            dtype = _dtype_from_name(spec["dtype"])
+            count = int(np.prod(spec["shape"])) if spec["shape"] else 1
+            self._specs.append(
+                (tuple(spec["shape"]), dtype, count * dtype.itemsize))
+        del self._pending[:base + head_len]
+        return True
+
+    def feed(self, data) -> List[Tuple[int, np.ndarray]]:
+        """Consume one chunk; return the ``(leaf_index, array)`` pairs that
+        completed inside it (possibly none, possibly several)."""
+        mv = memoryview(data)
+        if mv.ndim != 1 or mv.itemsize != 1:
+            mv = mv.cast("B")
+        self.bytes_fed += len(mv)
+        out: List[Tuple[int, np.ndarray]] = []
+        off = 0
+        if self.header is None:
+            self._pending += mv
+            self._note_buffered()
+            if not self._parse_header():
+                return out
+            out.extend(self._start_leaf())
+            # the header tail may carry leaf bytes: drain pending below
+            mv = memoryview(bytes(self._pending))
+            self._pending.clear()
+        while off < len(mv):
+            if self._cur is None:
+                if self._leaf_ix >= len(self._specs):
+                    raise ValueError(
+                        f"stream carries {len(mv) - off} bytes past the "
+                        f"declared leaves")
+                out.extend(self._start_leaf())
+                if self._cur is None:
+                    continue
+            take = min(len(mv) - off, len(self._cur) - self._cur_off)
+            self._cur[self._cur_off:self._cur_off + take] = \
+                np.frombuffer(mv[off:off + take], dtype=np.uint8)
+            self._cur_off += take
+            off += take
+            if self._cur_off == len(self._cur):
+                out.append((self._leaf_ix, self._cur_arr))
+                self._leaf_ix += 1
+                self._cur = self._cur_arr = None
+                out.extend(self._start_leaf())
+            self._note_buffered()
+        return out
+
+    def finish(self):
+        """Raise unless every declared leaf arrived in full."""
+        if self.header is None:
+            raise ValueError("stream ended before the header completed")
+        if self._cur is not None or self._leaf_ix < len(self._specs):
+            raise ValueError(
+                f"stream ended at leaf {self._leaf_ix}/"
+                f"{len(self._specs)} (short read)")
+
+
+def iter_unpack_arrays(chunks: Iterable) -> Iterable[Tuple[int, np.ndarray]]:
+    """Streaming twin of :func:`unpack_arrays`: yield ``(leaf_index,
+    array)`` pairs as each leaf's bytes arrive from ``chunks``, without
+    ever holding the whole blob (peak memory O(chunk + largest leaf)).
+    Yielded arrays own their memory. Raises on a short stream."""
+    unpacker = StreamUnpacker()
+    for chunk in chunks:
+        for item in unpacker.feed(chunk):
+            yield item
+    unpacker.finish()
 
 
 def put_arrays(key: str, tree: Any) -> str:
@@ -199,6 +362,11 @@ def put_arrays(key: str, tree: Any) -> str:
     total = len(header) + sum(a.nbytes for a in host_leaves)
 
     def chunks():
+        # A GENERATOR FUNCTION, not a generator: put_blob_stream invokes
+        # the factory once per retry attempt, so every attempt re-yields
+        # the header before the leaf bytes. Handing it a single exhausted
+        # generator would make a retried publish stream leaf bytes with no
+        # header (or nothing at all) — the backend guards against that.
         yield header
         yield from _iter_leaf_bytes(host_leaves)
 
@@ -208,28 +376,278 @@ def put_arrays(key: str, tree: Any) -> str:
     return backend.put_blob_stream(key, chunks, length=total)
 
 
+class _PlacementPipeline:
+    """Background host→device placement for the streaming restore.
+
+    The producer (network thread) enqueues batches of completed host
+    leaves; this thread issues one coalesced ``jax.device_put`` per batch
+    (a list of arrays + one sharding — a single dispatch, the restore
+    mirror of ``device_get_chunked``). The bounded queue double-buffers:
+    one batch in flight on the device link while the next fills from the
+    wire, so transfer-setup time hides under network time instead of
+    adding to it.
+    """
+
+    def __init__(self, out: List, depth: int = 2):
+        self.out = out
+        self.queue: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self.error: Optional[BaseException] = None
+        self.place_s = 0.0
+        self.leaves_placed = 0
+        self.bytes_placed = 0
+        self._thread = threading.Thread(
+            target=self._run, name="kt-restore-place", daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        import jax
+
+        while True:
+            item = self.queue.get()
+            if item is None:
+                return
+            if self.error is not None:
+                continue  # drain so the producer never blocks forever
+            idxs, arrays, sharding = item
+            t0 = time.perf_counter()
+            try:
+                placed = jax.device_put(arrays, sharding)
+                # block HERE, on the pipeline thread: device_put returns
+                # before the copy lands, so without this the next batch's
+                # host buffers could be freed/reused mid-transfer and
+                # place_s would measure dispatch, not transfer. The main
+                # thread keeps draining the wire regardless.
+                jax.block_until_ready(placed)
+            except BaseException as exc:  # surfaced in close()/submit()
+                self.error = exc
+                continue
+            self.place_s += time.perf_counter() - t0
+            for i, arr in zip(idxs, placed):
+                self.out[i] = arr
+            self.leaves_placed += len(idxs)
+            self.bytes_placed += sum(a.nbytes for a in arrays)
+
+    def submit(self, idxs: List[int], arrays: List[np.ndarray], sharding):
+        if self.error is not None:
+            raise self.error
+        self.queue.put((idxs, arrays, sharding))
+
+    def close(self):
+        self.queue.put(None)
+        self._thread.join()
+        if self.error is not None:
+            raise self.error
+
+
+def _flat_shardings(shardings: Any, template: Optional[Any],
+                    n_leaves: int) -> List[Any]:
+    """Per-leaf sharding list from the user-facing ``shardings`` arg (a
+    single Sharding/device applied to every leaf, or a pytree matching
+    ``template``)."""
+    import jax
+
+    structured = isinstance(shardings, (list, dict, tuple)) or hasattr(
+        shardings, "keys")
+    if not structured:
+        return [shardings] * n_leaves
+    if template is not None:
+        flat = jax.tree.structure(template).flatten_up_to(shardings)
+    else:
+        flat = list(shardings)
+    if len(flat) != n_leaves:
+        raise ValueError(
+            f"shardings tree has {len(flat)} leaves; stream carries "
+            f"{n_leaves}")
+    return flat
+
+
+def _sharding_group_key(dtype: np.dtype, sharding) -> tuple:
+    try:
+        hash(sharding)
+        return (dtype.name, sharding)
+    except TypeError:
+        return (dtype.name, id(sharding))
+
+
+def _streamed_restore(chunks: Iterable, template: Optional[Any],
+                      shardings: Optional[Any],
+                      batch_bytes: int = 64 << 20,
+                      pipeline_depth: int = 2) -> Any:
+    """Assemble leaves from a chunk stream and place them as they land.
+
+    Completed leaves batch per (dtype, sharding) up to ``batch_bytes``;
+    each full batch goes to the placement thread while the wire keeps
+    filling the next — fetch and host→device transfer overlap instead of
+    summing. Peak host memory is O(chunk + largest leaf +
+    pipeline_depth × batch_bytes), never O(total blob).
+    """
+    import jax
+
+    t_start = time.perf_counter()
+    unpacker = StreamUnpacker()
+    out: List[Any] = []
+    flat_sh: Optional[List[Any]] = None
+    pipeline: Optional[_PlacementPipeline] = None
+    # (dtype, sharding) → [indices, arrays, nbytes, sharding]
+    groups: Dict[tuple, list] = {}
+    fetch_s = 0.0
+    bytes_streamed = 0
+
+    def on_leaf(ix: int, arr: np.ndarray):
+        nonlocal pipeline
+        if flat_sh is None or flat_sh[ix] is None:
+            out[ix] = arr
+            return
+        if pipeline is None:
+            pipeline = _PlacementPipeline(out, depth=pipeline_depth)
+        sharding = flat_sh[ix]
+        key = _sharding_group_key(arr.dtype, sharding)
+        group = groups.setdefault(key, [[], [], 0, sharding])
+        group[0].append(ix)
+        group[1].append(arr)
+        group[2] += arr.nbytes
+        if group[2] >= batch_bytes:
+            pipeline.submit(group[0], group[1], group[3])
+            del groups[key]
+
+    try:
+        it = iter(chunks)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                chunk = next(it)
+            except StopIteration:
+                fetch_s += time.perf_counter() - t0
+                break
+            fetch_s += time.perf_counter() - t0
+            bytes_streamed += len(chunk)
+            completed = unpacker.feed(chunk)
+            if out == [] and unpacker.header is not None:
+                n = unpacker.num_leaves
+                out = [None] * n
+                if shardings is not None:
+                    flat_sh = _flat_shardings(shardings, template, n)
+            for ix, arr in completed:
+                on_leaf(ix, arr)
+        unpacker.finish()
+        if unpacker.num_leaves == 0:
+            out = []
+        for group in groups.values():
+            assert pipeline is not None
+            pipeline.submit(group[0], group[1], group[3])
+        groups.clear()
+    except BaseException:
+        if pipeline is not None:
+            try:
+                pipeline.close()
+            except BaseException:
+                pass  # the original error is the one to surface
+        raise
+    place_s = 0.0
+    if pipeline is not None:
+        pipeline.close()
+        place_s = pipeline.place_s
+    wall_s = time.perf_counter() - t_start
+    # Fraction of placement time hidden under the fetch: 1.0 = placement
+    # fully overlapped (wall ≈ fetch), 0.0 = serial fetch-then-place.
+    hidden = fetch_s + place_s - wall_s
+    overlap = max(0.0, min(1.0, hidden / place_s)) if place_s > 1e-9 else 1.0
+    _LAST_RESTORE.clear()
+    _LAST_RESTORE.update({
+        "wall_s": wall_s, "fetch_s": fetch_s, "place_s": place_s,
+        "bytes_streamed": bytes_streamed,
+        "leaves": len(out),
+        "leaves_placed": pipeline.leaves_placed if pipeline else 0,
+        "overlap_ratio": round(overlap, 4),
+        "peak_buffered_bytes": unpacker.peak_buffered,
+        "streaming": 1.0,
+    })
+    try:
+        from kubetorch_tpu.observability.prometheus import record_restore
+
+        record_restore(_LAST_RESTORE)
+    except Exception:
+        pass  # metrics must never fail a restore
+    if template is not None:
+        return jax.tree.unflatten(jax.tree.structure(template), out)
+    return out
+
+
 def get_arrays(
     key: str,
     template: Optional[Any] = None,
     shardings: Optional[Any] = None,
     broadcast=None,
+    *,
+    streaming: Optional[bool] = None,
+    chunk_bytes: int = 8 << 20,
+    batch_bytes: int = 64 << 20,
+    pipeline_depth: int = 2,
 ) -> Any:
     """Fetch arrays; ``shardings`` (pytree of Sharding or a single one)
     device_puts each leaf — onto a *different* mesh/layout than the publisher
     used if desired. ``broadcast`` (a :class:`BroadcastWindow`) coordinates
     many simultaneous getters through the store's rolling fan-out tree — the
     RL weight-sync path at scale (reference: GPU broadcast groups,
-    SURVEY.md §3.5)."""
+    SURVEY.md §3.5).
+
+    Restore is **streamed and pipelined** when the backend supports it
+    (``streaming=None`` auto-detects; force with True/False): leaves are
+    assembled from ``chunk_bytes``-sized reads as they arrive and handed to
+    a background placement thread in coalesced per-(dtype, sharding)
+    batches of up to ``batch_bytes`` (``pipeline_depth`` batches in
+    flight), so wire time hides host→device transfer time and peak host
+    memory stays O(chunk + largest leaf) instead of O(total blob). The
+    blocking fallback fetches the whole blob, then unpacks with
+    ``copy=True`` so the returned leaves never pin the fetched buffer.
+    """
     import jax
 
     from kubetorch_tpu.data_store.client import DataStoreClient
 
-    blob = DataStoreClient.default()._backend().get_blob(
-        key, broadcast=broadcast)
-    tree = unpack_arrays(blob, template)
-    if shardings is None:
-        return tree
-    if isinstance(shardings, (list, dict, tuple)) or hasattr(
-            shardings, "keys"):
-        return jax.tree.map(jax.device_put, tree, shardings)
-    return jax.tree.map(lambda x: jax.device_put(x, shardings), tree)
+    backend = DataStoreClient.default()._backend()
+    if streaming is None:
+        streaming = hasattr(backend, "get_blob_stream")
+    elif streaming and not hasattr(backend, "get_blob_stream"):
+        from kubetorch_tpu.exceptions import DataStoreError
+
+        raise DataStoreError(
+            f"streaming=True but backend {type(backend).__name__} has no "
+            f"get_blob_stream; use streaming=None to auto-fallback")
+    if streaming:
+        chunks = backend.get_blob_stream(key, chunk_bytes=chunk_bytes,
+                                         broadcast=broadcast)
+        return _streamed_restore(chunks, template, shardings,
+                                 batch_bytes=batch_bytes,
+                                 pipeline_depth=pipeline_depth)
+    t0 = time.perf_counter()
+    blob = backend.get_blob(key, broadcast=broadcast)
+    fetch_s = time.perf_counter() - t0
+    # copy=True: frombuffer views would keep the whole multi-GB blob
+    # alive for as long as ANY returned leaf survives
+    tree = unpack_arrays(blob, template, copy=(shardings is None))
+    t1 = time.perf_counter()
+    if shardings is not None:
+        if isinstance(shardings, (list, dict, tuple)) or hasattr(
+                shardings, "keys"):
+            tree = jax.tree.map(jax.device_put, tree, shardings)
+        else:
+            tree = jax.tree.map(
+                lambda x: jax.device_put(x, shardings), tree)
+    place_s = time.perf_counter() - t1
+    _LAST_RESTORE.clear()
+    _LAST_RESTORE.update({
+        "wall_s": fetch_s + place_s, "fetch_s": fetch_s,
+        "place_s": place_s, "bytes_streamed": len(blob),
+        "leaves": len(jax.tree.leaves(tree)),
+        "leaves_placed": (len(jax.tree.leaves(tree))
+                          if shardings is not None else 0),
+        "overlap_ratio": 0.0, "streaming": 0.0,
+    })
+    try:
+        from kubetorch_tpu.observability.prometheus import record_restore
+
+        record_restore(_LAST_RESTORE)
+    except Exception:
+        pass
+    return tree

@@ -186,6 +186,15 @@ class HttpStoreBackend:
         """PUT a blob produced by ``factory()`` (a fresh bytes-iterator
         per retry) — multi-GB payloads never materialize client-side.
 
+        ``factory`` MUST be re-invocable: it is called once per attempt,
+        and every attempt must produce the complete byte sequence from the
+        first byte (``put_arrays`` relies on this — its first chunk is the
+        packed-tree header, and a retry that resumed a half-exhausted
+        iterator would upload leaf bytes with no header). Passing
+        ``lambda: gen`` around one generator is rejected: a second attempt
+        that gets back the same (partially consumed) iterator raises
+        instead of silently uploading a corrupt tail.
+
         With ``length`` (total byte count) the upload takes a raw
         ``http.client`` path: Content-Length framing + ``sendall`` of
         bytes-like chunks, so memoryview chunks go to the socket with zero
@@ -199,8 +208,24 @@ class HttpStoreBackend:
         import http.client as _hc
 
         make_conn, quoted_path = raw_target(self._url(f"/blob/{key}"))
+        seen_iters: list = []
 
         def attempt():
+            chunks = factory()
+            # Same OBJECT again is fine iff it re-iterates from the start
+            # (a list/tuple); an iterator is its own iter() and would
+            # resume half-exhausted — that's the corrupt-retry case.
+            try:
+                one_shot = iter(chunks) is chunks
+            except TypeError:
+                one_shot = True
+            if one_shot and any(chunks is prev for prev in seen_iters):
+                raise DataStoreError(
+                    f"store put {key!r}: factory() returned the same "
+                    f"iterator on retry — it must build a FRESH chunk "
+                    f"stream per attempt (pass a generator function, not "
+                    f"a generator)")
+            seen_iters.append(chunks)
             conn = make_conn()
             try:
                 conn.putrequest("PUT", quoted_path)
@@ -208,7 +233,7 @@ class HttpStoreBackend:
                 conn.putheader("Content-Type", "application/octet-stream")
                 conn.endheaders()
                 sent = 0
-                for chunk in factory():
+                for chunk in chunks:
                     conn.send(chunk)
                     sent += len(chunk)
                 if sent != length:
@@ -325,6 +350,148 @@ class HttpStoreBackend:
                 status=status)
         return body
 
+    def get_blob_stream(self, key: str, chunk_bytes: int = 4 << 20,
+                        broadcast=None, **kw):
+        """Generator of ``bytes`` chunks for a blob — the streaming twin of
+        :meth:`get_blob`, for consumers (the pipelined array restore) that
+        never want the whole body in memory at once.
+
+        Same raw ``http.client`` path as ``get_blob``. A transport error
+        mid-body does NOT restart the download: the retry reconnects with
+        ``Range: bytes=<offset>-`` and resumes where the stream broke
+        (the server answers ranged blob GETs with sendfile). A re-put
+        racing the stream is detected via ``X-KT-Blob-Version`` on resume
+        and raises rather than splicing two different blobs together.
+
+        With ``broadcast``, the bytes come through the broadcast window's
+        peer-cache file (the rolling fan-out tree populates it on disk),
+        then stream off disk in ``chunk_bytes`` pieces — same bounded
+        memory, same iterator contract.
+        """
+        if broadcast is not None:
+            def chunks():
+                # LAZY: the fan-out download runs on first next(), inside
+                # the consumer's iteration — so a timed restore attributes
+                # the real wire time to fetch, not to generator creation
+                # (broadcast bytes must fully land in the peer-cache file
+                # before unpacking starts: the cache is also this member's
+                # serve copy, so overlap ratios near 0 are honest here).
+                from kubetorch_tpu.data_store.broadcast import broadcast_get
+
+                path = broadcast_get(self, key, broadcast, as_path=True)
+                yield from _iter_file_chunks(path, chunk_bytes)
+
+            return chunks()
+        return self._iter_blob_stream(key, chunk_bytes)
+
+    def _iter_blob_stream(self, key: str, chunk_bytes: int):
+        import http.client as _hc
+        import time as _time
+
+        from kubetorch_tpu.retry import attempts as _policy_attempts
+
+        make_conn, quoted_path = raw_target(self._url(f"/blob/{key}"))
+        max_attempts = self.retry_attempts or _policy_attempts()
+        offset = 0
+        progressed_to = 0
+        total = None
+        version = None
+        attempt = 0
+        delay = 0.25
+        deadline_202 = None
+        while True:
+            attempt += 1
+            conn = None
+            try:
+                conn = make_conn()
+                headers = ({"Range": f"bytes={offset}-"} if offset else {})
+                conn.request("GET", quoted_path, headers=headers)
+                resp = conn.getresponse()
+                if resp.status in (502, 503, 504):
+                    raise RetryableStatus(resp.status,
+                                          resp.read(200).decode("latin1"))
+                if resp.status == 202:
+                    # a serving peer is still mid-fetch of this blob: poll
+                    # until published (mirrors get_blob; streams only
+                    # window over .part files via the broadcast client)
+                    resp.read()
+                    if deadline_202 is None:
+                        deadline_202 = _time.time() + 120.0
+                    if _time.time() > deadline_202:
+                        raise DataStoreError(
+                            f"blob {key!r} still in-flight at source "
+                            f"after 120s", status=202)
+                    attempt -= 1  # polling is not a failure
+                    _time.sleep(0.1)
+                    continue
+                if resp.status == 404:
+                    raise DataStoreError(f"no such key {key!r}", status=404)
+                if resp.status not in (200, 206):
+                    raise DataStoreError(
+                        f"store get failed ({resp.status}): "
+                        f"{resp.read(200)[:200]!r}", status=resp.status)
+                served = resp.getheader("X-KT-Blob-Version")
+                if version is None:
+                    version = served
+                elif served is not None and served != version:
+                    raise DataStoreError(
+                        f"blob {key!r} changed mid-stream (version "
+                        f"{served} != {version}); restart the restore")
+                if resp.status == 206:
+                    rng = resp.getheader("Content-Range", "")
+                    start = rng.split(" ")[-1].split("-")[0]
+                    if start.isdigit() and int(start) != offset:
+                        raise DataStoreError(
+                            f"store resumed {key!r} at byte {start}, "
+                            f"expected {offset}")
+                elif offset:
+                    # 200 to a ranged request: server ignored Range —
+                    # skip the bytes we already yielded
+                    skip = offset
+                    while skip:
+                        waste = resp.read(min(skip, chunk_bytes))
+                        if not waste:
+                            raise OSError("short read while skipping")
+                        skip -= len(waste)
+                if total is None:
+                    length = resp.getheader("Content-Length")
+                    if length is not None:
+                        total = offset + int(length)
+                while True:
+                    data = resp.read(chunk_bytes)
+                    if not data:
+                        break
+                    offset += len(data)
+                    yield data
+                if total is not None and offset != total:
+                    raise OSError(f"short blob stream {offset}/{total}")
+                return
+            except (OSError, _hc.HTTPException, RetryableStatus) as exc:
+                if offset > progressed_to:
+                    # the connection DID advance the stream before dying:
+                    # a fresh drop, not the same failure repeating — reset
+                    # the budget so a multi-GB restore survives as many
+                    # drops as the wire throws at it, while a server that
+                    # fails at one offset still exhausts attempts
+                    progressed_to = offset
+                    attempt = 1
+                    delay = 0.25
+                if attempt >= max_attempts:
+                    if isinstance(exc, RetryableStatus):
+                        raise DataStoreError(
+                            f"store get {key!r} failed after retries: "
+                            f"{exc}", status=exc.status) from None
+                    if isinstance(exc, _hc.HTTPException):
+                        raise DataStoreError(
+                            f"store get {key!r} failed: "
+                            f"{type(exc).__name__}: {exc}") from exc
+                    raise
+                _time.sleep(delay)
+                delay = min(delay * 2, 4.0)
+            finally:
+                if conn is not None:
+                    conn.close()
+
     # ------------------------------------------------------- metadata
     def list_keys(self, prefix: str = "", **kw) -> List[dict]:
         resp = self._request("GET", self._url("/keys"),
@@ -377,6 +544,18 @@ class HttpStoreBackend:
             raise DataStoreError(f"no source for {key!r}", status=404)
         self._raise_for(resp, "get_source")
         return resp.json()
+
+
+def _iter_file_chunks(path, chunk_bytes: int = 4 << 20):
+    """Stream a local file as bytes chunks (broadcast peer-cache blobs and
+    the local backend share this so every backend speaks the same
+    ``get_blob_stream`` iterator contract)."""
+    with open(path, "rb") as fh:
+        while True:
+            data = fh.read(chunk_bytes)
+            if not data:
+                return
+            yield data
 
 
 def _safe_extract(tar: tarfile.TarFile, dest: Path):

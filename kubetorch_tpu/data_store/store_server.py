@@ -261,16 +261,24 @@ class StoreServer:
                     {"size": size, "have": size, "complete": True})
             self.stats["gets"] += 1
             self.stats["bytes_out"] += span_bytes(size)
-            # FileResponse: sendfile-backed, no whole-blob buffering.
+            # FileResponse: sendfile-backed, no whole-blob buffering, and
+            # it answers Range requests natively (206 + Content-Range) —
+            # that single property serves BOTH resumable streaming restores
+            # (get_blob_stream reconnects with Range: bytes=<offset>- after
+            # a mid-body drop) and the broadcast relay's windowed tails.
+            # Accept-Ranges advertises it so generic clients resume too.
             # X-KT-Blob-Version lets broadcast members detect a re-put
             # racing their fetch: a member pulling the plain key but
             # caching under a version-scoped name aborts when the served
             # content no longer matches its group's version (peer caches
             # don't track versions — the header is 0 there and clients
-            # only enforce it against the central store).
+            # only enforce it against the central store); the streaming
+            # client checks it on every resume so a re-put mid-restore can
+            # never splice two blobs' bytes into one tree.
             return web.FileResponse(
                 path, headers={
                     "Content-Type": "application/octet-stream",
+                    "Accept-Ranges": "bytes",
                     "X-KT-Blob-Version": str(self.versions.get(key, 0))})
 
         if request.query.get("progress"):

@@ -218,6 +218,31 @@ def validate_adapter_targets(adapters: Dict[str, Any],
         f"layer dict (have {sorted(layers)})")
 
 
+def publish_adapters(key: str, lora: Dict[str, Any]) -> str:
+    """Trainer side of adapter weight-sync: pack the adapter pytree and
+    stream it into the data store under ``key`` (the length-framed
+    zero-copy publish path — ``device_transfer.put_arrays``)."""
+    from kubetorch_tpu.data_store.device_transfer import put_arrays
+
+    return put_arrays(key, lora)
+
+
+def fetch_adapters(key: str, template: Any, shardings: Any = None,
+                   broadcast=None, **stream_kw) -> Dict[str, Any]:
+    """Sampler side of adapter weight-sync: the streaming pipelined
+    restore (``device_transfer.get_arrays``) — leaves land on the
+    sampler's own mesh layout (``shardings``) as their bytes arrive, and
+    fleet-wide fetches coordinate through ``broadcast`` (a
+    :class:`~kubetorch_tpu.data_store.types.BroadcastWindow`). ``template``
+    is typically ``jax.eval_shape`` of :func:`init` — structure without
+    FLOPs. Extra kwargs (``chunk_bytes``, ``batch_bytes``,
+    ``pipeline_depth``, ``streaming``) pass through to ``get_arrays``."""
+    from kubetorch_tpu.data_store.device_transfer import get_arrays
+
+    return get_arrays(key, template=template, shardings=shardings,
+                      broadcast=broadcast, **stream_kw)
+
+
 def num_params(lora: Dict[str, Any]) -> int:
     return sum(int(jnp.size(v)) for ab in lora.values()
                for v in ab.values())

@@ -21,6 +21,7 @@ model means no push-gateway state. The chart ships a ``PodMonitor``/
 from __future__ import annotations
 
 import re
+import threading
 import time
 from typing import Any, Dict, Iterable, Optional, Tuple
 
@@ -101,6 +102,60 @@ def snapshot_samples(data: Dict[str, Dict[str, dict]],
             labels = {"service": service, "pod": pod}
             yield "metrics_age_seconds", labels, now - snap.get("ts", now)
             yield from flatten_metrics(snap.get("metrics"), labels)
+
+
+# ------------------------------------------------------------------
+# Data-plane restore counters (streaming pipelined weight-sync restore,
+# data_store/device_transfer.get_arrays). Process-local, updated by every
+# restore; rendered into the pod's /metrics exposition via
+# restore_samples() and folded into pushed metric snapshots by callers of
+# restore_metrics(). Counters accumulate; *_last_* are gauges for the most
+# recent restore so dashboards can plot the overlap ratio directly.
+_RESTORE_LOCK = threading.Lock()
+_RESTORE: Dict[str, float] = {
+    "restore_bytes_streamed_total": 0.0,
+    "restore_leaves_placed_total": 0.0,
+    "restore_count_total": 0.0,
+    "restore_last_wall_seconds": 0.0,
+    "restore_last_fetch_seconds": 0.0,
+    "restore_last_place_seconds": 0.0,
+    "restore_last_overlap_ratio": 0.0,
+    "restore_last_streaming": 0.0,
+}
+
+
+def record_restore(stats: Dict[str, float]) -> None:
+    """Fold one get_arrays restore decomposition into the counters."""
+    with _RESTORE_LOCK:
+        _RESTORE["restore_bytes_streamed_total"] += float(
+            stats.get("bytes_streamed", 0))
+        _RESTORE["restore_leaves_placed_total"] += float(
+            stats.get("leaves_placed", 0))
+        _RESTORE["restore_count_total"] += 1
+        _RESTORE["restore_last_wall_seconds"] = float(
+            stats.get("wall_s", 0.0))
+        _RESTORE["restore_last_fetch_seconds"] = float(
+            stats.get("fetch_s", 0.0))
+        _RESTORE["restore_last_place_seconds"] = float(
+            stats.get("place_s", 0.0))
+        _RESTORE["restore_last_overlap_ratio"] = float(
+            stats.get("overlap_ratio", 0.0))
+        _RESTORE["restore_last_streaming"] = float(
+            stats.get("streaming", 0.0))
+
+
+def restore_metrics() -> Dict[str, float]:
+    """Snapshot of the restore counters (for metric pushes / tests)."""
+    with _RESTORE_LOCK:
+        return dict(_RESTORE)
+
+
+def restore_samples(labels: Optional[Dict[str, str]] = None):
+    """Exposition samples for the restore counters — append to the pod
+    server's sample stream: ``render([*..., *restore_samples()])``."""
+    labels = labels or {}
+    for name, value in restore_metrics().items():
+        yield f"data_store_{name}", labels, value
 
 
 def wants_prometheus(request) -> bool:

@@ -29,6 +29,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kubetorch_tpu.parallel.mesh import (
+    axis_size as _axis_size,
+    pcast_varying as _pcast_varying,
+    shard_map_check_kwargs,
+)
 from kubetorch_tpu.ops.flash_attention import (
     _STATS,
     _flash_backward,
@@ -42,6 +47,10 @@ try:
     from jax import shard_map  # jax >= 0.8
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
+
+# flash bodies (pallas interpret mode) trip the VMA checker — disable it
+# on every jax generation (see mesh.shard_map_check_kwargs)
+_NOCHECK = shard_map_check_kwargs(shard_map, disable_on_new=True)
 
 _NEG_INF = -1e30
 
@@ -71,7 +80,7 @@ def _chunk_scores(q, k, v, q_off, k_off, scale, causal):
 def _ring_body(q, k, v, *, axis_name: str, scale: float, causal: bool,
                mesh_axes: tuple = ()):
     """Runs inside shard_map: q/k/v are local [B, S_local, H(,kv), D]."""
-    sp = jax.lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, S, H, D = q.shape
     s_local = S
@@ -79,11 +88,10 @@ def _ring_body(q, k, v, *, axis_name: str, scale: float, causal: bool,
     acc = jnp.zeros((B, S, H, D), jnp.float32)
     m = jnp.full((B, S, H), _NEG_INF, jnp.float32)
     l = jnp.zeros((B, S, H), jnp.float32)
-    if mesh_axes:
-        # shard_map VMA typing: scan carries must enter as 'varying' over the
-        # same axes as the inputs, since the loop body makes them
-        # device-varying (ppermute / axis_index).
-        acc, m, l = jax.lax.pcast((acc, m, l), mesh_axes, to="varying")
+    # shard_map VMA typing: scan carries must enter as 'varying' over the
+    # same axes as the inputs, since the loop body makes them
+    # device-varying (ppermute / axis_index). No-op on pre-VMA jax.
+    acc, m, l = _pcast_varying((acc, m, l), mesh_axes)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
     def step(i, carry):
@@ -153,7 +161,7 @@ def _merge(o, lse, o_c, lse_c):
 
 def _ring_fwd_flash(q, k, v, *, axis_name, scale, interpret, causal):
     """Forward ring pass with flash chunks. Returns (out, lse)."""
-    sp = jax.lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, S, H, D = q.shape
     perm = [(i, (i + 1) % sp) for i in range(sp)]
@@ -182,7 +190,7 @@ def _ring_bwd_flash(q, k, v, out, lse, g, *, axis_name, scale, interpret,
     accumulate while the chunk travels and arrive home after the full
     rotation (sp steps of shift-by-1 = identity).
     """
-    sp = jax.lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
@@ -319,7 +327,7 @@ def ring_attention(
         return shard_map(
             body, mesh=mesh,
             in_specs=(spec_q, spec_kv, spec_kv),
-            out_specs=spec_q, check_vma=False,
+            out_specs=spec_q, **_NOCHECK,
         )(q, k, v)
     body = functools.partial(
         _ring_body, axis_name=axis_name, scale=scale, causal=causal,

@@ -65,14 +65,17 @@ def _maybe_device_stats() -> Optional[Dict[str, int]]:
     """
     import sys
 
+    agg: Dict[str, int] = {}
     jax = sys.modules.get("jax")
     if jax is None:
         return None
     try:
         xla_bridge = sys.modules.get("jax._src.xla_bridge")
         if xla_bridge is None or not getattr(xla_bridge, "_backends", None):
-            return None  # backend not live; stay hands-off
-        agg: Dict[str, int] = {}
+            # backend not live; stay hands-off the devices — but restore
+            # counters (host-side work) still ride along if any exist
+            _attach_restore_metrics(agg)
+            return agg or None
         devices = jax.local_devices()
         for dev in devices:
             stats = dev.memory_stats() or {}
@@ -81,9 +84,30 @@ def _maybe_device_stats() -> Optional[Dict[str, int]]:
                 if value is not None:
                     agg[f"device_{key}"] = agg.get(f"device_{key}", 0) + value
         agg["device_count"] = len(devices)
+        _attach_restore_metrics(agg)
         return agg
     except Exception:
         return None
+
+
+def _attach_restore_metrics(agg: Dict[str, int]) -> None:
+    """Piggyback this worker's weight-sync restore counters on the same
+    response channel as the device stats: the counters are process-local,
+    and user code (get_arrays) runs HERE, not in the pod server that
+    answers /metrics — without the hop the pod would always report zeros.
+
+    Reported as one pid-tagged sub-dict (NOT flat keys): the pod server
+    keeps a per-worker snapshot and SUMS the ``*_total`` counters across
+    workers — a flat last-writer-wins merge would make the pod's counters
+    flip between workers' totals, which Prometheus reads as resets."""
+    try:
+        from kubetorch_tpu.observability.prometheus import restore_metrics
+
+        restore = restore_metrics()
+        if restore.get("restore_count_total"):
+            agg["data_store_restore"] = {"pid": os.getpid(), **restore}
+    except Exception:
+        pass  # metrics must never break a call response
 
 
 def _load_target(root_path: str, import_path: str, name: str,

@@ -90,6 +90,10 @@ class PodServer:
             "http_request_duration_seconds_sum": 0.0,
             "last_activity_timestamp": time.time(),
         }
+        # per-process weight-sync restore snapshots (worker pid → counter
+        # dict; "server" = this process): *_total sums across processes
+        # stay monotonic where a flat merge would flip between workers
+        self._restore_by_proc: Dict[Any, Dict[str, float]] = {}
         self.ready = False
         self.setup_error: Optional[str] = None
         self.controller_ws = None
@@ -385,12 +389,45 @@ class PodServer:
                 {"ready": False, "reason": "setting up"}, status=503)
         return web.json_response({"ready": True})
 
+    def _merge_worker_stats(self, stats: Dict[str, Any]):
+        """Fold a worker's per-call stats dict into pod metrics. Plain
+        gauges (device memory) merge flat — freshest wins; the pid-tagged
+        restore snapshot goes through per-process aggregation."""
+        entry = stats.pop("data_store_restore", None)
+        if entry is not None:
+            self._merge_restore_snapshot(entry.pop("pid", 0), dict(entry))
+        if stats:
+            self.metrics.update(stats)
+
+    def _merge_restore_snapshot(self, proc_id, snap: Dict[str, float]):
+        """Re-aggregate flat ``data_store_restore_*`` metrics from
+        per-process snapshots: ``*_total`` counters SUM across processes
+        (each worker's own counter is monotonic, so the sum is too —
+        last-writer-wins would flip between workers' totals, which
+        Prometheus reads as counter resets); ``last_*`` gauges come from
+        ``snap``, the process that reported most recently."""
+        self._restore_by_proc[proc_id] = snap
+        for key in snap:
+            if key.endswith("_total"):
+                self.metrics[f"data_store_{key}"] = sum(
+                    s.get(key, 0) for s in self._restore_by_proc.values())
+            else:
+                self.metrics[f"data_store_{key}"] = snap[key]
+
     async def h_metrics(self, request):
         healthy = (self.supervisor.healthy()
                    if self.supervisor is not None else True)
-        data = {**self.metrics, "workers_healthy": healthy}
         from kubetorch_tpu.observability import prometheus as prom
 
+        # Weight-sync restore decomposition. Worker processes report their
+        # counters on the call-response channel (process_worker attaches a
+        # pid-tagged snapshot next to device_stats; _merge_worker_stats
+        # folds it in); restores run IN-SERVER (app mode) come from this
+        # process's own counters. Same names either way, one render source.
+        restore = prom.restore_metrics()
+        if restore["restore_count_total"]:
+            self._merge_restore_snapshot("server", restore)
+        data = {**self.metrics, "workers_healthy": healthy}
         if prom.wants_prometheus(request):
             # Prometheus/OpenMetrics scrapers (Accept: text/plain...) get
             # the exposition format; the framework's JSON clients keep the
@@ -661,7 +698,7 @@ class PodServer:
         if stats:
             # workers attach accelerator memory stats to responses; the
             # freshest snapshot rides the next metrics push (DCGM analogue)
-            self.metrics.update(stats)
+            self._merge_worker_stats(stats)
         used = resp.get("serialization", ser)
         return web.Response(
             body=resp["payload"],
@@ -742,7 +779,7 @@ class PodServer:
         else:
             stats = terminal.get("device_stats")
             if stats:
-                self.metrics.update(stats)
+                self._merge_worker_stats(stats)
             await response.write(frame(b"Z"))
         await response.write_eof()
         return response

@@ -46,8 +46,33 @@ class CheckpointManager:
         # Abstract template: restores directly sharded like the template.
         template = jax.tree.map(ocp.utils.to_shape_dtype_struct,
                                 state_template)
-        return self._manager.restore(
+        restored = self._manager.restore(
             step, args=ocp.args.StandardRestore(template))
+
+        # Orbax restores every leaf COMMITTED to a concrete placement. For
+        # leaves the template held uncommitted (optax scalars like
+        # ``count`` — created outside any mesh, movable by jit), that
+        # commitment is new information the template never had, and a jit
+        # over the mixed state refuses to compile ("incompatible
+        # devices": count pinned to device 0, params on the 8-device
+        # mesh). Mirror the template: demote such leaves to host numpy
+        # (uncommitted — jit replaces them freely, exactly like the
+        # freshly-initialized state), and re-pin any leaf whose committed
+        # sharding drifted from a committed template's.
+        def _repin(restored_leaf, template_leaf):
+            if not isinstance(restored_leaf, jax.Array):
+                return restored_leaf
+            if (isinstance(template_leaf, jax.Array)
+                    and not getattr(template_leaf, "_committed", True)):
+                import numpy as np
+
+                return np.asarray(jax.device_get(restored_leaf))
+            want = getattr(template_leaf, "sharding", None)
+            if want is not None and restored_leaf.sharding != want:
+                return jax.device_put(restored_leaf, want)
+            return restored_leaf
+
+        return jax.tree.map(_repin, restored, state_template)
 
     def latest_step(self) -> Optional[int]:
         return self._manager.latest_step()

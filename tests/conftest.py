@@ -36,7 +36,12 @@ import jax  # noqa: E402
 
 if not _TPU_TIER:
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # jax < 0.5: no such option — the XLA_FLAGS host-platform flag set
+        # above already forces the 8-device CPU mesh.
+        pass
     # Persistent XLA compilation cache: the model/parallel tests are
     # compile-bound (~5 min of the suite is jit compiles of programs that
     # never change between runs). Warm runs hit the cache and the suite

@@ -13,6 +13,10 @@ import pytest
 sys.path.insert(0, str(Path(__file__).parent.parent))
 
 
+# slow: these deliberately bypass the persistent compile cache (the point
+# is "does the 8B graph still compile"), so each is minutes of XLA work —
+# out of the tier-1 wall-clock budget, in for release runs.
+@pytest.mark.slow
 @pytest.mark.level("minimal")
 def test_8b_fsdp64_train_step_compiles_for_v5e64():
     import __graft_entry__ as graft
@@ -20,6 +24,7 @@ def test_8b_fsdp64_train_step_compiles_for_v5e64():
     graft.aot_v5e64(layouts=("fsdp64",))
 
 
+@pytest.mark.slow
 @pytest.mark.level("minimal")
 def test_8b_decode_compiles_for_v5e8():
     """Serving counterpart (VERDICT r3 #3): the 8B tp=8 decode scan

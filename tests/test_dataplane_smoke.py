@@ -1,0 +1,52 @@
+"""Tier-1-safe data-plane smoke: ``bench_dataplane.run(dryrun=True)`` runs
+every bench — including the streaming pipelined restore — at toy sizes on
+CPU, and this test fails if any metric KEY disappears (a silently-dropped
+measurement is how a perf regression hides)."""
+
+import pytest
+
+# The bench's stable contract: every key BENCH_r* rounds chart. Values are
+# environment-dependent; keys are not. Adding keys is fine; losing one
+# fails here first, not in the next bench round's diff.
+EXPECTED_KEYS = {
+    "blob_put_MBps",
+    "blob_get_MBps",
+    "codesync_cold_ms",
+    "codesync_warm_ms",
+    "codepull_cold_ms",
+    "codepull_warm_ms",
+    "bcast_direct_ms",
+    "bcast_tree_ms",
+    "bcast_direct_egress_mb",
+    "bcast_tree_egress_mb",
+    "bcast_egress_ratio",
+    "bcast_2peer_direct_ms",
+    "bcast_2peer_relay_ms",
+    "bcast_relay_tax_ms",
+    # streaming pipelined restore decomposition
+    "restore_fetch_GBps",
+    "restore_blocking_ms",
+    "restore_streamed_ms",
+    "restore_place_GBps",
+    "restore_overlap_ratio",
+    "restore_speedup",
+    "restore_vs_wire_ratio",
+}
+
+
+@pytest.mark.level("minimal")
+def test_dataplane_dryrun_metric_keys():
+    from kubetorch_tpu import bench_dataplane
+
+    out = bench_dataplane.run(dryrun=True)
+    missing = EXPECTED_KEYS - set(out)
+    assert not missing, (
+        f"dataplane bench dropped metric keys: {sorted(missing)} — a "
+        f"measurement went silent; restore it (or update EXPECTED_KEYS "
+        f"if the rename is deliberate)")
+    # sanity: the restore decomposition carries real measurements
+    assert out["restore_streamed_ms"] > 0
+    assert out["restore_blocking_ms"] > 0
+    assert 0.0 <= out["restore_overlap_ratio"] <= 1.0
+    assert "vs_prior_round_gt20pct" not in out, (
+        "dryrun toy values must never be compared against prior rounds")

@@ -143,10 +143,10 @@ def test_quantized_logical_axes_cover_tree():
     cfg = _cfg()
     params = quantize_params(llama.init(jax.random.key(6), cfg))
     axes = quantized_logical_axes(cfg)
-    flat_p = jax.tree.leaves_with_path(params)
+    flat_p = jax.tree_util.tree_leaves_with_path(params)
     flat_a = {jax.tree_util.keystr(k) for k, _ in
-              jax.tree.leaves_with_path(axes, is_leaf=lambda x:
-                                        isinstance(x, tuple))}
+              jax.tree_util.tree_leaves_with_path(
+                  axes, is_leaf=lambda x: isinstance(x, tuple))}
     for key, _ in flat_p:
         assert jax.tree_util.keystr(key) in flat_a, key
 
@@ -164,10 +164,10 @@ def test_init_quantized_matches_quantize_params_structure():
         new = quant.init_quantized(jax.random.key(1), cfg)
         ref_map = {
             jax.tree_util.keystr(k): (v.shape, v.dtype)
-            for k, v in jax.tree.flatten_with_path(ref)[0]}
+            for k, v in jax.tree_util.tree_flatten_with_path(ref)[0]}
         new_map = {
             jax.tree_util.keystr(k): (v.shape, v.dtype)
-            for k, v in jax.tree.flatten_with_path(new)[0]}
+            for k, v in jax.tree_util.tree_flatten_with_path(new)[0]}
         assert ref_map == new_map, cfg
 
 

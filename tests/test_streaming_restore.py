@@ -1,0 +1,443 @@
+"""Streaming pipelined weight-sync restore tests: streamed/blocking
+equivalence, restore onto a different sharding than the publisher used,
+Range-based mid-stream resume, bounded reassembly memory, the
+leaf-lifetime (blob pin) regression, and publish retry safety."""
+
+import gc
+import os
+import socket
+import subprocess
+import sys
+import time
+import weakref
+
+import numpy as np
+import pytest
+
+from kubetorch_tpu.data_store.client import DataStoreClient
+from kubetorch_tpu.data_store.device_transfer import (
+    StreamUnpacker,
+    get_arrays,
+    iter_unpack_arrays,
+    last_restore_stats,
+    pack_arrays,
+    put_arrays,
+    unpack_arrays,
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_store(tmp_path, monkeypatch):
+    monkeypatch.setenv("KT_LOCAL_STORE", str(tmp_path / "store"))
+    import kubetorch_tpu.data_store.client as client_mod
+
+    monkeypatch.setattr(client_mod, "_LOCAL_STORE", tmp_path / "store")
+    DataStoreClient._default = None
+    yield
+    DataStoreClient._default = None
+
+
+@pytest.fixture()
+def http_store_url(tmp_path):
+    """A real store-server subprocess (the Range/resume paths need the
+    aiohttp FileResponse behavior, not the local-backend shortcut)."""
+    root = tmp_path / "store-root"
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {**os.environ, "KT_STORE_ROOT": str(root)}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.data_store.store_server",
+         "--host", "127.0.0.1", "--port", str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    url = f"http://127.0.0.1:{port}"
+    import httpx
+
+    for _ in range(100):
+        try:
+            if httpx.get(f"{url}/health", timeout=2.0).status_code == 200:
+                break
+        except httpx.HTTPError:
+            time.sleep(0.2)
+    else:
+        proc.kill()
+        raise RuntimeError("store server did not start")
+    yield url
+    proc.terminate()
+    proc.wait(5)
+
+
+def _mixed_tree():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    return {
+        "w": jnp.asarray(rng.random((64, 32)), jnp.float32),
+        "bf16": jnp.asarray(rng.random((129,)), jnp.bfloat16),
+        "i8": jnp.asarray(rng.integers(-100, 100, (16, 4)), jnp.int8),
+        "scalar": jnp.asarray(3.5, jnp.float32),  # 0-d
+        "empty": jnp.zeros((0, 3), jnp.float32),  # zero-size leaf
+        "nested": {"b": jnp.ones((5,), jnp.float32)},
+    }
+
+
+# ------------------------------------------------------------ equivalence
+@pytest.mark.level("unit")
+def test_streamed_blocking_byte_identical():
+    """Streamed and blocking get_arrays must agree bit-for-bit on a
+    mixed-dtype pytree, at several chunk sizes (including chunks that
+    split leaves and the header)."""
+    import jax
+
+    tree = _mixed_tree()
+    put_arrays("eq/params", tree)
+    blocking = get_arrays("eq/params", template=tree, streaming=False)
+    for chunk in (7, 1 << 10, 1 << 24):
+        streamed = get_arrays("eq/params", template=tree, streaming=True,
+                              chunk_bytes=chunk)
+        for a, b in zip(jax.tree.leaves(streamed),
+                        jax.tree.leaves(blocking)):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    stats = last_restore_stats()
+    assert stats["streaming"] == 1.0
+    assert stats["bytes_streamed"] > 0
+
+
+@pytest.mark.level("unit")
+def test_iter_unpack_matches_unpack_arrays():
+    tree = _mixed_tree()
+    blob = pack_arrays(tree)
+    ref = unpack_arrays(blob)
+    for chunk in (1, 13, 4096):
+        got = dict(iter_unpack_arrays(
+            blob[i:i + chunk] for i in range(0, len(blob), chunk)))
+        assert sorted(got) == list(range(len(ref)))
+        for i, r in enumerate(ref):
+            np.testing.assert_array_equal(got[i], np.asarray(r))
+            assert got[i].dtype == r.dtype
+
+
+@pytest.mark.level("unit")
+def test_iter_unpack_short_stream_raises():
+    blob = pack_arrays(_mixed_tree())
+    with pytest.raises(ValueError, match="short read"):
+        list(iter_unpack_arrays([blob[:len(blob) - 3]]))
+    with pytest.raises(ValueError, match="header"):
+        list(iter_unpack_arrays([blob[:4]]))
+
+
+# ----------------------------------------------------- sharding / mesh
+@pytest.mark.level("unit")
+def test_streamed_restore_onto_different_sharding():
+    """Publisher commits the tree to one mesh layout; the streamed getter
+    lands it directly on a DIFFERENT layout — no intermediate full-host
+    tree, leaves placed from the wire."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubetorch_tpu.parallel import (
+        MeshSpec,
+        ShardingRules,
+        named_sharding,
+    )
+
+    mesh_pub = MeshSpec(fsdp=8).build()
+    rules = ShardingRules.default()
+    sh_pub = named_sharding(mesh_pub, rules, "embed_fsdp", "heads")
+    tree = {"w": jax.device_put(
+        jnp.arange(64, dtype=jnp.float32).reshape(8, 8), sh_pub)}
+    put_arrays("resh/params", tree)
+
+    mesh_get = MeshSpec(fsdp=4, tp=2).build()
+    sh_get = named_sharding(mesh_get, rules, "embed_fsdp", "heads")
+    out = get_arrays("resh/params", template=tree,
+                     shardings={"w": sh_get}, streaming=True,
+                     chunk_bytes=64)
+    assert out["w"].sharding == sh_get
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  np.arange(64, dtype=np.float32)
+                                  .reshape(8, 8))
+    stats = last_restore_stats()
+    assert stats["streaming"] == 1.0 and stats["leaves_placed"] == 1
+
+
+# ------------------------------------------------------ bounded memory
+@pytest.mark.level("unit")
+def test_stream_unpacker_memory_bounded():
+    """Peak reassembly buffering must stay O(header + chunk + largest
+    leaf), never O(total blob) — the property that lets an 8B-param
+    restore run without full-blob host RAM."""
+    rng = np.random.default_rng(0)
+    tree = {f"w{i}": rng.random(4096).astype(np.float32)
+            for i in range(32)}  # 32 × 16 KB leaves = 512 KB blob
+    blob = pack_arrays(tree)
+    largest = max(a.nbytes for a in tree.values())
+    chunk = 8 << 10  # 8 KB chunks
+    unpacker = StreamUnpacker()
+    for i in range(0, len(blob), chunk):
+        unpacker.feed(blob[i:i + chunk])
+    unpacker.finish()
+    header_slack = 8 << 10
+    assert unpacker.peak_buffered <= largest + chunk + header_slack, (
+        f"peak {unpacker.peak_buffered} exceeds "
+        f"O(chunk + largest leaf) = {largest + chunk + header_slack} "
+        f"(total blob {len(blob)})")
+    assert unpacker.peak_buffered < len(blob) // 2
+
+
+@pytest.mark.level("unit")
+def test_streamed_restore_never_materializes_blob(monkeypatch):
+    """The streaming path must not fall back to get_blob."""
+    from kubetorch_tpu.data_store import client as client_mod
+
+    tree = _mixed_tree()
+    put_arrays("nb/params", tree)
+
+    def boom(self, key, **kw):
+        raise AssertionError("streaming restore called get_blob")
+
+    monkeypatch.setattr(client_mod.LocalStoreBackend, "get_blob", boom)
+    out = get_arrays("nb/params", template=tree, streaming=True)
+    assert set(out) == set(tree)
+
+
+# ------------------------------------------------- leaf lifetime (pin)
+def _tracked_blob(tree):
+    """(weakref-able backing buffer, bytes-like view of the packed blob).
+    bytes can't be weakref'd, so back the blob with an ndarray."""
+    backing = np.frombuffer(pack_arrays(tree), dtype=np.uint8).copy()
+    return backing, memoryview(backing)
+
+
+@pytest.mark.level("unit")
+def test_unpack_copy_releases_blob():
+    """copy=True leaves must not pin the source blob: the multi-GB fetch
+    buffer has to be collectable the moment restore returns. The default
+    zero-copy views DO pin it (documented), which is why the blocking
+    get_arrays fallback passes copy=True."""
+    tree = _mixed_tree()
+    backing, mv = _tracked_blob(tree)
+    ref = weakref.ref(backing)
+    copied = unpack_arrays(mv, template=tree, copy=True)
+    del backing, mv
+    gc.collect()
+    assert ref() is None, "copy=True restore kept the blob alive"
+    assert np.asarray(copied["w"]).shape == (64, 32)
+
+    backing2, mv2 = _tracked_blob(tree)
+    ref2 = weakref.ref(backing2)
+    views = unpack_arrays(mv2, template=tree)  # default: zero-copy views
+    del backing2, mv2
+    gc.collect()
+    assert ref2() is not None, (
+        "zero-copy views no longer pin the blob — if frombuffer semantics "
+        "changed, revisit the copy=True default decision")
+    del views
+    gc.collect()
+    assert ref2() is None
+
+
+# ------------------------------------------------------ range resume
+class _FlakyResponse:
+    def __init__(self, resp, fail_after_reads):
+        self._resp = resp
+        self._fail_after = fail_after_reads
+        self._reads = 0
+
+    @property
+    def status(self):
+        return self._resp.status
+
+    def getheader(self, *args, **kw):
+        return self._resp.getheader(*args, **kw)
+
+    def read(self, amt=None):
+        if self._fail_after is not None and self._reads >= self._fail_after:
+            raise OSError("injected mid-stream connection drop")
+        self._reads += 1
+        return self._resp.read(amt)
+
+
+class _FlakyConn:
+    def __init__(self, conn, state, fail_after_reads):
+        self._conn = conn
+        self._state = state
+        self._fail = fail_after_reads
+
+    def request(self, method, path, headers=None, **kw):
+        if headers and "Range" in headers:
+            self._state["ranges"].append(headers["Range"])
+        self._conn.request(method, path, headers=headers or {}, **kw)
+
+    def getresponse(self):
+        return _FlakyResponse(self._conn.getresponse(), self._fail)
+
+    def close(self):
+        self._conn.close()
+
+
+@pytest.mark.level("minimal")
+def test_get_blob_stream_resumes_with_range(http_store_url, monkeypatch):
+    """Drop the connection mid-body; the stream must reconnect with a
+    Range header at the exact break offset and deliver identical bytes."""
+    from kubetorch_tpu.data_store import http_store
+    from kubetorch_tpu.data_store.http_store import HttpStoreBackend
+
+    be = HttpStoreBackend(http_store_url)
+    payload = os.urandom(1 << 20)
+    be.put_blob("resume/blob.bin", payload)  # before patching raw_target
+
+    real = http_store.raw_target
+    state = {"conns": 0, "ranges": []}
+
+    def patched(url):
+        make_conn, path = real(url)
+
+        def mk():
+            state["conns"] += 1
+            # first data connection delivers one chunk, then dies
+            fail_after = 1 if state["conns"] == 1 else None
+            return _FlakyConn(make_conn(), state, fail_after)
+
+        return mk, path
+
+    monkeypatch.setattr(http_store, "raw_target", patched)
+    chunk = 128 << 10
+    got = b"".join(be.get_blob_stream("resume/blob.bin",
+                                      chunk_bytes=chunk))
+    assert got == payload
+    assert state["conns"] >= 2, "drop was not injected"
+    assert state["ranges"], "resume did not send a Range header"
+    start = int(state["ranges"][0].split("=")[1].split("-")[0])
+    assert 0 < start < len(payload)
+    assert start == chunk  # resumed exactly where the stream broke
+
+
+@pytest.mark.level("minimal")
+def test_streamed_get_arrays_over_http(http_store_url, monkeypatch):
+    """End-to-end streamed restore against the real server equals the
+    blocking fetch."""
+    import jax
+
+    monkeypatch.setenv("KT_STORE_URL", http_store_url)
+    DataStoreClient._default = None
+    tree = _mixed_tree()
+    put_arrays("e2e/params", tree)
+    streamed = get_arrays("e2e/params", template=tree, streaming=True,
+                          chunk_bytes=1 << 10)
+    blocking = get_arrays("e2e/params", template=tree, streaming=False)
+    for a, b in zip(jax.tree.leaves(streamed), jax.tree.leaves(blocking)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -------------------------------------------------- publish retry safety
+class _PutConn:
+    """Fake raw connection for PUT: records sent bytes; optionally dies at
+    getresponse (after the body went out — the worst retry case)."""
+
+    def __init__(self, state, fail):
+        self._state = state
+        self._fail = fail
+        self.sent = bytearray()
+
+    def putrequest(self, *a, **kw):
+        pass
+
+    def putheader(self, *a, **kw):
+        pass
+
+    def endheaders(self):
+        pass
+
+    def send(self, chunk):
+        self.sent += bytes(chunk)
+
+    def getresponse(self):
+        self._state["attempts"].append(bytes(self.sent))
+        if self._fail:
+            raise OSError("injected post-body connection drop")
+
+        class _Resp:
+            status = 200
+
+            def read(self, n=None):
+                return b"{}"
+
+        return _Resp()
+
+    def close(self):
+        pass
+
+
+@pytest.mark.level("unit")
+def test_put_arrays_retry_reyields_header(monkeypatch):
+    """A retried publish must re-stream the COMPLETE payload — header
+    first — not resume a half-exhausted iterator (a headerless body would
+    be unreadable by every getter)."""
+    from kubetorch_tpu.data_store import http_store
+    from kubetorch_tpu.data_store.device_transfer import _MAGIC
+
+    state = {"attempts": [], "conns": 0}
+
+    def patched(url):
+        def mk():
+            state["conns"] += 1
+            return _PutConn(state, fail=(state["conns"] == 1))
+
+        return mk, "/blob/retry/params"
+
+    monkeypatch.setattr(http_store, "raw_target", patched)
+    monkeypatch.setenv("KT_STORE_URL", "http://127.0.0.1:9")
+    DataStoreClient._default = None
+
+    tree = _mixed_tree()
+    put_arrays("retry/params", tree)
+    assert len(state["attempts"]) == 2
+    first, second = state["attempts"]
+    assert second == first, "retry streamed different bytes"
+    assert second.startswith(_MAGIC), "retry lost the packed-tree header"
+    assert unpack_arrays(second) is not None  # full, parseable payload
+
+
+@pytest.mark.level("unit")
+def test_put_blob_stream_rejects_reused_iterator(monkeypatch):
+    """factory() returning the SAME exhausted generator on retry is a
+    silent-corruption footgun — the backend must refuse it."""
+    from kubetorch_tpu.data_store import http_store
+    from kubetorch_tpu.data_store.http_store import HttpStoreBackend
+    from kubetorch_tpu.exceptions import DataStoreError
+
+    state = {"attempts": [], "conns": 0}
+
+    def patched(url):
+        def mk():
+            state["conns"] += 1
+            return _PutConn(state, fail=True)  # every attempt dies
+
+        return mk, "/blob/k"
+
+    monkeypatch.setattr(http_store, "raw_target", patched)
+    be = HttpStoreBackend("http://127.0.0.1:9")
+    gen = iter([b"abc", b"def"])
+    with pytest.raises(DataStoreError, match="FRESH chunk stream"):
+        be.put_blob_stream("k", lambda: gen, length=6)
+
+
+# ------------------------------------------------------------- metrics
+@pytest.mark.level("unit")
+def test_restore_metrics_recorded():
+    from kubetorch_tpu.observability import prometheus as prom
+
+    tree = _mixed_tree()
+    put_arrays("m/params", tree)
+    before = prom.restore_metrics()
+    get_arrays("m/params", template=tree, streaming=True)
+    after = prom.restore_metrics()
+    assert after["restore_count_total"] == before["restore_count_total"] + 1
+    assert (after["restore_bytes_streamed_total"]
+            > before["restore_bytes_streamed_total"])
+    assert after["restore_last_streaming"] == 1.0
+    text = prom.render(prom.restore_samples({"pod": "p0"}))
+    assert "kubetorch_data_store_restore_count_total" in text
+    assert 'pod="p0"' in text
