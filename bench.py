@@ -510,6 +510,31 @@ def _bench_tpu():
     except Exception as e:
         print(f"# 8b rolling failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+        roll = None
+
+    # Call-tunnel phase (ISSUE 2): the per-call dispatch tax through the
+    # serving path — POST vs persistent channel vs pipelined channel at
+    # depth 2 — against a pod-server subprocess whose simulated chunk
+    # costs the rolling phase's measured per-chunk device time, so
+    # serving_tok_s_pipelined IS the projected tunnel-wall rate for the
+    # engine above (reported as rolling_tok_s_tunnel_wall_pipelined).
+    try:
+        from kubetorch_tpu.bench_serving import bench_call_channel
+
+        if roll:
+            chan = bench_call_channel(
+                device_ms=roll["ms_per_step_device"]
+                * roll["steps_per_call"],
+                batch=roll["batch"],
+                steps_per_call=roll["steps_per_call"])
+            chan["rolling_tok_s_tunnel_wall_pipelined"] = \
+                chan["serving_tok_s_pipelined"]
+        else:
+            chan = bench_call_channel(dryrun=True)
+        extra["serving_call_tunnel"] = chan
+    except Exception as e:
+        print(f"# call-tunnel phase failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
 
 
     return ("llama_0.8b_train_tokens_per_sec_per_chip",

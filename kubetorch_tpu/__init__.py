@@ -51,6 +51,8 @@ _LAZY = {
     "BroadcastWindow": ("kubetorch_tpu.data_store.types", "BroadcastWindow"),
     "Locale": ("kubetorch_tpu.data_store.types", "Locale"),
     "Lifespan": ("kubetorch_tpu.data_store.types", "Lifespan"),
+    # persistent pipelined call channel (serving call path)
+    "CallChannel": ("kubetorch_tpu.serving.channel", "CallChannel"),
     # debugging
     "deep_breakpoint": ("kubetorch_tpu.serving.debugger", "deep_breakpoint"),
     # single-controller actor mode (Monarch analogue)

@@ -961,6 +961,79 @@ class RollingGenerator:
         return cache, pos, ctx, dnt, dnt_valid, toks, emits
 
 
+class RollingDecoder:
+    """Remote-facing decode driver: the serving twin of driving a local
+    :class:`RollingGenerator` by hand.
+
+    Deploy as a ``kt.cls`` (one instance per worker process owns the
+    engine + TPU) and drive it over the **persistent pipelined call
+    channel** (``serving/channel.py``): every method takes/returns plain
+    JSON-able values, and ``step()`` is safe to pipeline at depth ≥ 2 —
+    the channel executes calls FIFO per connection, so chunk N+1 is
+    serialized + shipped while chunk N is still on device, hiding the
+    per-call dispatch tax the POST path pays (BENCH_r05: ~144 ms/chunk
+    through the tunnel).
+
+    >>> remote = kt.cls(MyDecoderFactory)(...).to(compute)
+    >>> chan = remote.channel(depth=2)
+    >>> chan.call("submit", prompt, max_new_tokens=64)
+    >>> calls = []
+    >>> while True:
+    ...     while len(calls) < 2:           # keep the pipeline full
+    ...         calls.append(chan.submit(method="step"))
+    ...     out = calls.pop(0).result()     # chunk N; N+1 already queued
+    ...     if not out["pending"]:
+    ...         break
+    """
+
+    def __init__(self, engine: "RollingGenerator"):
+        self.engine = engine
+
+    def submit(self, prompt, max_new_tokens: int = 128,
+               temperature: float = 0.0,
+               prefix_id: Optional[int] = None,
+               stop: Optional[List[List[int]]] = None,
+               repetition_penalty: float = 1.0,
+               adapter_id: int = -1) -> int:
+        return self.engine.submit(
+            [int(t) for t in prompt], max_new_tokens=max_new_tokens,
+            temperature=temperature, prefix_id=prefix_id, stop=stop,
+            repetition_penalty=repetition_penalty, adapter_id=adapter_id)
+
+    def step(self) -> Dict[str, Any]:
+        """One decode chunk. Returns ``{"events": [[rid, tokens, done],
+        ...], "pending": n, "device_ms": t}`` — ``device_ms`` is the
+        chunk's measured wall time in the engine-owning process, the
+        ground truth the call-path latency decomposition compares its
+        ``device`` stage against."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        events = self.engine.step()
+        device_ms = (_time.perf_counter() - t0) * 1e3
+        return {
+            "events": [[rid, [int(t) for t in toks], bool(done)]
+                       for rid, toks, done in events],
+            "pending": self.engine.pending,
+            "device_ms": round(device_ms, 3),
+        }
+
+    def pending(self) -> int:
+        return self.engine.pending
+
+    def warmup(self, prompt_buckets=(16, 64, 128)) -> bool:
+        self.engine.warmup(tuple(int(b) for b in prompt_buckets))
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        eng = self.engine
+        return {"max_slots": eng.max_slots, "max_len": eng.max_len,
+                "steps_per_call": eng.steps_per_call,
+                "free_slots": len(eng._free), "queued": len(eng._queue),
+                "active": len(eng._slots),
+                **({"spec": eng.spec_stats} if eng.spec else {})}
+
+
 class RollingService:
     """Thread-safe facade: concurrent callers share one rolling batch.
 

@@ -29,8 +29,9 @@ _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _LABEL_ESC = str.maketrans({"\\": r"\\", '"': r"\"", "\n": r"\n"})
 
 # metric name suffix → TYPE hint (exposition metadata; scrapers work
-# without it but Grafana's rate() suggestions use it)
-_COUNTER_SUFFIXES = ("_total", "_sum", "_count")
+# without it but Grafana's rate() suggestions use it). ``_bucket``
+# samples are cumulative histogram counters.
+_COUNTER_SUFFIXES = ("_total", "_sum", "_count", "_bucket")
 
 
 def metric_name(raw: str, prefix: str = "kubetorch_") -> str:
@@ -156,6 +157,138 @@ def restore_samples(labels: Optional[Dict[str, str]] = None):
     labels = labels or {}
     for name, value in restore_metrics().items():
         yield f"data_store_{name}", labels, value
+
+
+# ------------------------------------------------------------------
+# Serving call-path decomposition (persistent pipelined call channel,
+# serving/channel.py ↔ PodServer.h_channel). Process-local, like the
+# restore counters above: the pod-server process records server-side
+# stages (queue/dispatch/device) plus channel lifecycle counters; worker
+# processes record their own call counters and piggyback them on the
+# call-response channel (pid-tagged, summed by the pod server exactly
+# like the restore snapshot); client processes record client_ser/wire.
+# Stage histograms use fixed buckets so the tunnel-wall vs device gap is
+# a measured distribution, not a single number that hides the tail.
+
+CALL_STAGES = ("client_ser", "wire", "server_queue", "worker_dispatch",
+               "device")
+# 1 ms .. 10 s — per-call dispatch on a remote-attached TPU measured
+# ~100-200 ms (BENCH_r05); the low buckets resolve the post-channel world
+_HIST_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1.0, 2.5, 10.0)
+
+_SERVING_LOCK = threading.Lock()
+_SERVING: Dict[str, float] = {
+    "serving_channel_connects_total": 0.0,
+    "serving_channel_reconnects_total": 0.0,
+    "serving_channel_calls_total": 0.0,
+    "serving_channel_errors_total": 0.0,
+    "serving_channel_inflight": 0.0,
+    "serving_worker_calls_total": 0.0,
+    "serving_worker_exec_seconds_total": 0.0,
+    "serving_worker_dispatch_seconds_total": 0.0,
+}
+# stage -> {"sum": float, "count": float, "buckets": [count per le]}
+_HISTS: Dict[str, Dict[str, Any]] = {}
+
+
+def record_call_stage(stage: str, seconds: float) -> None:
+    """Fold one stage duration into its histogram (seconds)."""
+    with _SERVING_LOCK:
+        h = _HISTS.get(stage)
+        if h is None:
+            h = _HISTS[stage] = {"sum": 0.0, "count": 0.0,
+                                 "buckets": [0.0] * len(_HIST_BUCKETS)}
+        h["sum"] += seconds
+        h["count"] += 1
+        for i, le in enumerate(_HIST_BUCKETS):
+            if seconds <= le:
+                h["buckets"][i] += 1
+
+
+def record_call_stages(stages: Dict[str, float]) -> None:
+    """Record several stages of one call ({stage: seconds}; unknown or
+    negative entries are skipped — clock skew must not poison a bucket)."""
+    for stage, seconds in (stages or {}).items():
+        if isinstance(seconds, (int, float)) and seconds >= 0:
+            record_call_stage(stage, float(seconds))
+
+
+def record_channel_event(event: str, n: float = 1) -> None:
+    """Bump a channel lifecycle counter: ``connect`` / ``reconnect`` /
+    ``call`` / ``error``."""
+    key = f"serving_channel_{event}s_total"
+    with _SERVING_LOCK:
+        if key in _SERVING:
+            _SERVING[key] += n
+
+
+def channel_inflight(delta: int) -> float:
+    """Adjust (and return) the in-flight channel-call depth gauge."""
+    with _SERVING_LOCK:
+        _SERVING["serving_channel_inflight"] = max(
+            0.0, _SERVING["serving_channel_inflight"] + delta)
+        return _SERVING["serving_channel_inflight"]
+
+
+def record_worker_call(exec_s: float, dispatch_s: float = 0.0) -> None:
+    """Worker-process accounting for one executed call (summed across
+    worker processes by the pod server's pid-tagged merge)."""
+    with _SERVING_LOCK:
+        _SERVING["serving_worker_calls_total"] += 1
+        _SERVING["serving_worker_exec_seconds_total"] += max(0.0, exec_s)
+        _SERVING["serving_worker_dispatch_seconds_total"] += max(
+            0.0, dispatch_s)
+
+
+def serving_metrics() -> Dict[str, float]:
+    """Flat snapshot: lifecycle counters + per-stage latency totals
+    (``serving_call_<stage>_seconds_total`` / ``_calls_total``). Both
+    end in ``_total`` so the pod server's cross-process merge SUMS them,
+    and NEITHER collides with the exposition histogram series names
+    (``..._seconds_sum``/``_count``/``_bucket``) — the pod renders this
+    flat dict AND serving_histogram_samples() side by side, and a
+    duplicated sample name would make Prometheus reject the whole
+    scrape. The histogram buckets are exposition-only — a flat dict key
+    per bucket would be noise in the JSON metrics surface."""
+    with _SERVING_LOCK:
+        out = dict(_SERVING)
+        for stage, h in _HISTS.items():
+            out[f"serving_call_{stage}_seconds_total"] = h["sum"]
+            out[f"serving_call_{stage}_calls_total"] = h["count"]
+    return out
+
+
+def serving_histogram_samples(labels: Optional[Dict[str, str]] = None):
+    """``le``-labeled histogram series per recorded stage (full
+    ``_bucket``/``_sum``/``_count``). The pod server appends these to
+    its exposition next to the flat metrics dict; the flat dict's
+    per-stage keys use distinct ``*_total`` names (serving_metrics), so
+    no sample name appears twice — Prometheus rejects a scrape with
+    duplicate samples."""
+    labels = labels or {}
+    with _SERVING_LOCK:
+        hists = {s: {"sum": h["sum"], "count": h["count"],
+                     "buckets": list(h["buckets"])}
+                 for s, h in _HISTS.items()}
+    for stage, h in hists.items():
+        base = f"serving_call_{stage}_seconds"
+        for le, count in zip(_HIST_BUCKETS, h["buckets"]):
+            yield f"{base}_bucket", {**labels, "le": repr(le)}, count
+        yield f"{base}_bucket", {**labels, "le": "+Inf"}, h["count"]
+        yield f"{base}_sum", labels, h["sum"]
+        yield f"{base}_count", labels, h["count"]
+
+
+def serving_samples(labels: Optional[Dict[str, str]] = None):
+    """Standalone exposition (clients, tests): counters + gauge + the
+    full histogram series."""
+    labels = labels or {}
+    with _SERVING_LOCK:
+        snap = dict(_SERVING)
+    for name, value in snap.items():
+        yield name, labels, value
+    yield from serving_histogram_samples(labels)
 
 
 def wants_prometheus(request) -> bool:

@@ -351,6 +351,34 @@ class Module:
             if streamer is not None:
                 streamer.stop()
 
+    def channel(self, depth: Optional[int] = None,
+                serialization: Optional[str] = None,
+                timeout: Optional[float] = None, **kwargs):
+        """Open a persistent pipelined call channel to this service
+        (``serving/channel.py``): one long-lived connection carries every
+        call, and up to ``depth`` calls ride in flight at once — the
+        serving-path answer to the per-call POST dispatch tax. Calls on
+        one channel execute in submission order on the pod, so stateful
+        engines (``RollingDecoder.step``) pipeline safely.
+
+        >>> chan = remote.channel(depth=2)
+        >>> calls = [chan.submit(method="step") for _ in range(2)]
+        >>> first = calls[0].result()   # chunk 2 already on the wire
+        """
+        from kubetorch_tpu.serving.channel import CallChannel
+
+        cfg = get_config()
+        allowed = (self.compute.allowed_serialization
+                   if self.compute else ("json", "pickle"))
+        return CallChannel(
+            self.service_url(),
+            self.callable_name or self.service_name,
+            depth=depth,
+            ser=serialization or cfg.serialization,
+            allowed=allowed,
+            call_timeout=timeout,
+            **kwargs)
+
     async def _call_remote_async(
         self,
         method: Optional[str] = None,

@@ -1,0 +1,480 @@
+"""Persistent, multiplexed call channel to a pod server.
+
+The per-call POST path pays one connection + header negotiation + two
+full serialize/deserialize hops per call — BENCH_r05 measured that fixed
+cost at ~103 ms/call on the serving staging path, which is the whole gap
+between on-device rolling decode (6,850 tok/s) and the tunnel-wall rate
+(4,168 tok/s). This channel removes the per-call share of that cost:
+
+- **one long-lived WebSocket** (``GET /_channel`` on the pod server)
+  carries every call — connection and header cost amortize to zero;
+- **pipeline depth**: up to ``depth`` calls may be in flight at once, so
+  the client serializes + ships decode chunk N+1 while chunk N is still
+  on device. ``depth=1`` degenerates to strict request/response (the old
+  numbers); depth 2 is enough to hide a dispatch tax smaller than the
+  per-chunk device time;
+- **opaque payloads**: the pod server parses only the tiny JSON control
+  header; the call body and the result payload pass through
+  PodServer → ProcessPool → ProcessWorker as bytes (zero
+  re-serialization at the pod hop);
+- **in-order execution**: calls on one channel execute FIFO on the
+  server (unless submitted with ``concurrent=True``), so a stateful
+  engine like :class:`~kubetorch_tpu.models.rolling.RollingDecoder` can
+  be driven pipelined without interleaving chunks. An exception on chunk
+  N rehydrates on N's handle; N+1 (already in flight) still runs and
+  resolves independently.
+
+Every call handle carries a latency decomposition (client serialize,
+wire, server queue, worker dispatch, device) — the same stages the
+Prometheus histograms in ``observability/prometheus.py`` record — so the
+tunnel-wall vs device gap stays a measured number.
+
+The channel owns a private event-loop thread; ``submit``/``result`` are
+called from ordinary (sync) code. Wire format: one WebSocket binary
+message per call/response, ``frames.pack_envelope`` layout.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import queue
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterable, Optional
+
+from kubetorch_tpu import serialization
+from kubetorch_tpu.exceptions import rehydrate_exception
+from kubetorch_tpu.serving import frames
+
+DEFAULT_DEPTH_ENV = "KT_CHANNEL_DEPTH"
+
+
+def _set_nodelay(conn) -> None:
+    """Disable Nagle on the channel socket. aiohttp (3.11) never sets
+    TCP_NODELAY itself, and the pipelined pattern is exactly the one
+    Nagle punishes: the client writes chunk N+1 while N's bytes are
+    still unacknowledged, so the second small write sits in the kernel
+    until the peer's (possibly delayed) ACK — measured as 25-50 ms
+    stalls per chunk, bigger than the dispatch tax the pipeline exists
+    to hide. Depth-1 (strict request/response) never trips it, which is
+    why the bug only shows with pipelining on."""
+    try:
+        transport = getattr(conn, "transport", None)
+        if transport is not None:
+            from aiohttp.tcp_helpers import tcp_nodelay
+
+            tcp_nodelay(transport, True)
+    except Exception:  # noqa: BLE001 — an exotic transport still works
+        pass
+
+
+def default_depth() -> int:
+    try:
+        return max(1, int(os.environ.get(DEFAULT_DEPTH_ENV, "2")))
+    except ValueError:
+        return 2
+
+
+class ChannelClosedError(ConnectionError):
+    """The channel dropped with this call unresolved. The call may or may
+    not have executed — resubmitting a non-idempotent call is on the
+    caller (same contract as the POST path's read-failure case)."""
+
+
+class ChannelCall:
+    """Handle for one in-flight channel call."""
+
+    def __init__(self, cid: int, client_ser_s: float, stream: bool,
+                 timeout: Optional[float], on_terminal):
+        self.cid = cid
+        self.stream = stream
+        self._timeout = timeout
+        self._on_terminal = on_terminal
+        self._event = threading.Event()
+        self._payload: Optional[bytes] = None
+        self._ser = serialization.DEFAULT
+        self._exc: Optional[BaseException] = None
+        self._items: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._t_send = time.perf_counter()
+        # decomposition (seconds); wire fills in at terminal
+        self._t: Dict[str, float] = {"client_ser": client_ser_s}
+
+    # ------------------------------------------------------ loop side
+    def _resolve(self, header: dict, payload: bytes):
+        kind = header.get("kind")
+        server_t = header.get("t") or {}
+        if kind == "item":
+            self._items.put((header.get("ser", serialization.DEFAULT),
+                             payload))
+            return False
+        if kind == "error":
+            try:
+                self._exc = rehydrate_exception(json.loads(payload))
+            except Exception:  # noqa: BLE001 — malformed error frame
+                self._exc = RuntimeError(
+                    f"channel call {self.cid} failed: {payload[:200]!r}")
+        elif kind == "result":
+            self._payload = payload
+            self._ser = header.get("ser", serialization.DEFAULT)
+            if self.stream:
+                # a stream=True call whose method returned a plain value:
+                # surface it as a one-item stream, matching the POST
+                # path's non-generator fallback — never drop a result
+                self._items.put((self._ser, payload))
+        # kind == "end": stream finished cleanly (no payload)
+        self._finish(server_t)
+        return True
+
+    def _fail(self, exc: BaseException):
+        self._exc = exc
+        # record=False: a transport failure's wall time (which can be
+        # the whole pending duration) is not a round trip — it would
+        # poison the wire histogram the tunnel decomposition is built on
+        self._finish({}, record=False)
+
+    def _finish(self, server_t: Dict[str, float], record: bool = True):
+        wall = time.perf_counter() - self._t_send
+        self._t["wall"] = wall
+        for stage, key in (("server", "server_s"),
+                           ("server_queue", "queue_s"),
+                           ("worker_dispatch", "dispatch_s"),
+                           ("device", "exec_s")):
+            if isinstance(server_t.get(key), (int, float)):
+                self._t[stage] = float(server_t[key])
+        self._t["wire"] = max(0.0, wall - self._t.get("server", 0.0))
+        if record:
+            try:
+                from kubetorch_tpu.observability import prometheus as prom
+
+                prom.record_call_stages(
+                    {"client_ser": self._t["client_ser"],
+                     "wire": self._t["wire"]})
+            except Exception:  # noqa: BLE001 — metrics never break a call
+                pass
+        self._items.put(None)  # unblock a stream iterator
+        cb, self._on_terminal = self._on_terminal, None
+        if cb is not None:
+            cb()
+        self._event.set()
+
+    # ---------------------------------------------------- caller side
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def timings(self) -> Dict[str, float]:
+        """Latency decomposition in milliseconds (after completion):
+        ``client_ser / wire / server_queue / worker_dispatch / device``
+        plus ``server`` (total in-server) and ``wall``."""
+        return {k: v * 1e3 for k, v in self._t.items()}
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """Block for the terminal response; returns the deserialized
+        result or raises the rehydrated remote exception. Streamed calls
+        return ``self`` (iterate for items)."""
+        timeout = timeout if timeout is not None else self._timeout
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"channel call {self.cid} timed out after {timeout}s")
+        if self._exc is not None:
+            raise self._exc
+        if self.stream:
+            return self
+        data = serialization.loads(self._payload, self._ser)
+        if isinstance(data, dict) and "result" in data:
+            return data["result"]
+        return data
+
+    def __iter__(self):
+        """Stream items as they arrive (``submit(..., stream=True)``)."""
+        while True:
+            try:
+                item = self._items.get(timeout=self._timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"channel stream {self.cid} stalled: no item within "
+                    f"{self._timeout}s") from None
+            if item is None:
+                if self._exc is not None:
+                    raise self._exc
+                return
+            ser, payload = item
+            yield serialization.loads(payload, ser)["result"]
+
+
+class CallChannel:
+    """Client of ``PodServer.h_channel``. Thread-safe: submit from any
+    thread; a private event-loop thread owns the socket.
+
+    >>> chan = CallChannel(url, "decoder", depth=2)
+    >>> calls = [chan.submit("step") for _ in range(8)]   # pipelined
+    >>> events = [c.result() for c in calls]              # in order
+    """
+
+    def __init__(self, base_url: str, callable_name: str,
+                 method: Optional[str] = None, depth: Optional[int] = None,
+                 ser: str = serialization.DEFAULT,
+                 allowed: Iterable[str] = serialization.METHODS,
+                 connect_timeout: float = 10.0,
+                 call_timeout: Optional[float] = None):
+        self.base_url = base_url.rstrip("/")
+        self.callable_name = callable_name
+        self.default_method = method
+        self.depth = depth if depth is not None else default_depth()
+        self.ser = ser
+        self.allowed = tuple(allowed)
+        self.connect_timeout = connect_timeout
+        self.call_timeout = call_timeout
+        self._sem = (threading.BoundedSemaphore(self.depth)
+                     if self.depth and self.depth > 0 else None)
+        self._cids = itertools.count(1)
+        self._calls: Dict[int, ChannelCall] = {}
+        self._calls_lock = threading.Lock()
+        self._loop = None
+        self._thread: Optional[threading.Thread] = None
+        self._loop_lock = threading.Lock()
+        self._loop_ready = threading.Event()
+        # guards _ensure_ws: a burst of first submits must not each dial
+        # a socket (calls split across connections would break the FIFO
+        # ordering contract). asyncio.Lock binds to the loop on first
+        # await (py3.10+), so creating it here off-loop is safe.
+        import asyncio as _asyncio
+
+        self._connect_lock = _asyncio.Lock()
+        self._ws = None
+        self._session = None
+        self._reader = None
+        self._ever_connected = False
+        self._closed = False
+        self.connects = 0  # lifetime connections (1 + reconnects)
+
+    # --------------------------------------------------------- public
+    def submit(self, *args, method: Optional[str] = None,
+               kwargs: Optional[dict] = None, ser: Optional[str] = None,
+               stream: bool = False, concurrent: bool = False,
+               timeout: Optional[float] = None) -> ChannelCall:
+        """Serialize + enqueue one call; returns immediately with a
+        handle unless ``depth`` calls are already in flight (then blocks
+        until a slot frees — that backpressure IS the pipeline depth).
+
+        ``concurrent=True`` opts this call out of the channel's FIFO
+        execution order (independent requests that may run on any free
+        worker); the default keeps per-channel ordering for stateful
+        engines."""
+        if self._closed:
+            raise ChannelClosedError("channel is closed")
+        from kubetorch_tpu.resources.callables.pointers import (
+            build_call_body,
+        )
+
+        t0 = time.perf_counter()
+        body, used = serialization.choose(
+            build_call_body(args, kwargs or {}), ser or self.ser,
+            self.allowed)
+        ser_s = time.perf_counter() - t0
+        if self._sem is not None:
+            self._sem.acquire()
+        cid = next(self._cids)
+        call = ChannelCall(
+            cid, ser_s, stream,
+            timeout if timeout is not None else self.call_timeout,
+            (self._sem.release if self._sem is not None else None))
+        with self._calls_lock:
+            self._calls[cid] = call
+        header = {
+            "cid": cid, "kind": "call",
+            "callable": self.callable_name,
+            "method": method or self.default_method,
+            "ser": used, "stream": bool(stream),
+            "concurrent": bool(concurrent),
+            "rid": uuid.uuid4().hex[:12],
+        }
+        envelope = frames.pack_envelope(header, body)
+        call._t_send = time.perf_counter()
+        self._run_soon(self._send(cid, envelope), call)
+        return call
+
+    def call(self, *args, **kwargs) -> Any:
+        """Submit + wait: drop-in for ``http_client.call_method`` on the
+        channel (pipelining needs :meth:`submit`)."""
+        return self.submit(*args, **kwargs).result()
+
+    @property
+    def inflight(self) -> int:
+        with self._calls_lock:
+            return len(self._calls)
+
+    def close(self):
+        """Close the socket and fail any in-flight calls."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._loop is not None:
+            import asyncio
+
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    self._shutdown(), self._loop).result(5.0)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+        self._fail_pending(ChannelClosedError("channel closed"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------ loop side
+    def _ensure_loop(self):
+        # locked: two threads racing the first submit must not each
+        # spawn a loop thread — calls split across two loops would leak
+        # one forever and break the single-socket FIFO contract
+        with self._loop_lock:
+            if self._thread is None:
+                import asyncio
+
+                def _run():
+                    loop = asyncio.new_event_loop()
+                    asyncio.set_event_loop(loop)
+                    self._loop = loop
+                    self._loop_ready.set()
+                    loop.run_forever()
+                    # drain pending tasks on stop, then close
+                    try:
+                        loop.run_until_complete(asyncio.sleep(0))
+                    finally:
+                        loop.close()
+
+                self._thread = threading.Thread(
+                    target=_run, name="kt-channel", daemon=True)
+                self._thread.start()
+        self._loop_ready.wait(10.0)
+        return self._loop
+
+    def _run_soon(self, coro, call: ChannelCall):
+        import asyncio
+
+        fut = asyncio.run_coroutine_threadsafe(coro, self._ensure_loop())
+
+        def _check(f):
+            exc = f.exception() if not f.cancelled() else None
+            if exc is not None:
+                self._drop_call(call.cid)
+                call._fail(exc if isinstance(exc, ConnectionError)
+                           else ChannelClosedError(str(exc)))
+
+        fut.add_done_callback(_check)
+
+    async def _ensure_ws(self):
+        if self._ws is not None and not self._ws.closed:
+            return self._ws
+        async with self._connect_lock:
+            if self._ws is not None and not self._ws.closed:
+                return self._ws
+            return await self._connect()
+
+    async def _connect(self):
+        import aiohttp
+
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        self._ws = await self._session.ws_connect(
+            f"{self.base_url}/_channel", max_msg_size=1024 ** 3,
+            timeout=aiohttp.ClientWSTimeout(ws_close=self.connect_timeout),
+            heartbeat=30.0,
+            # tell the pod this is a re-dial: the server can't infer it
+            # (it has no client identity), and reconnect churn must be
+            # visible on the POD's /metrics, where operators alert
+            headers=({"X-KT-Channel-Reconnect": "1"}
+                     if self._ever_connected else {}))
+        _set_nodelay(getattr(self._ws, "_conn", None))
+        self.connects += 1
+        try:
+            from kubetorch_tpu.observability import prometheus as prom
+
+            prom.record_channel_event(
+                "reconnect" if self._ever_connected else "connect")
+        except Exception:  # noqa: BLE001
+            pass
+        self._ever_connected = True
+        import asyncio
+
+        self._reader = asyncio.ensure_future(self._read(self._ws))
+        return self._ws
+
+    def _call_alive(self, cid: int) -> bool:
+        with self._calls_lock:
+            return cid in self._calls
+
+    async def _send(self, cid: int, envelope: bytes):
+        # A socket drop between submit() and this coroutine running
+        # fails the call via _fail_pending (the caller is told "may or
+        # may not have executed"). Shipping its envelope anyway on the
+        # reconnected socket would EXECUTE a call the client already
+        # reported failed — a stateful FIFO engine would double-step
+        # when the caller resubmits. Check before dialing (don't
+        # reconnect for a dead call) and again right before the write;
+        # _fail_pending runs on this loop thread, and there is no await
+        # between the second check and the write, so the pair is atomic.
+        if not self._call_alive(cid):
+            return
+        ws = await self._ensure_ws()
+        if not self._call_alive(cid):
+            return
+        await ws.send_bytes(envelope)
+
+    async def _read(self, ws):
+        import aiohttp
+
+        try:
+            async for msg in ws:
+                if msg.type == aiohttp.WSMsgType.BINARY:
+                    self._dispatch(msg.data)
+                elif msg.type in (aiohttp.WSMsgType.ERROR,
+                                  aiohttp.WSMsgType.CLOSE):
+                    break
+        finally:
+            # A dropped socket fails every unresolved call: the channel
+            # cannot know whether they executed (ChannelClosedError says
+            # so). The next submit() re-dials and counts a reconnect.
+            self._fail_pending(ChannelClosedError(
+                "call channel connection lost"))
+
+    async def _shutdown(self):
+        if self._reader is not None:
+            self._reader.cancel()
+        if self._ws is not None and not self._ws.closed:
+            await self._ws.close()
+        if self._session is not None:
+            await self._session.close()
+
+    def _dispatch(self, data: bytes):
+        try:
+            header, payload = frames.unpack_envelope(data)
+        except Exception:  # noqa: BLE001 — a garbled frame kills nothing
+            return
+        cid = header.get("cid")
+        with self._calls_lock:
+            call = self._calls.get(cid)
+        if call is None:
+            return
+        if call._resolve(header, payload):
+            self._drop_call(cid)
+
+    def _drop_call(self, cid: int):
+        with self._calls_lock:
+            self._calls.pop(cid, None)
+
+    def _fail_pending(self, exc: BaseException):
+        with self._calls_lock:
+            pending, self._calls = list(self._calls.values()), {}
+        for call in pending:
+            call._fail(exc)

@@ -1,0 +1,125 @@
+"""Wire framing shared by the streaming call path and the persistent
+call channel.
+
+One frame = 1-byte kind + 8-byte LE length + body. Three kinds ride the
+HTTP result stream (``PodServer._respond_stream`` writes them,
+``http_client._stream_call`` parses them):
+
+- ``D`` — one yielded item; the body leads with a 1-byte serialization
+  code (``serialization.method_code``) so the worker may flip json↔pickle
+  per item, followed by the serialized ``{"result": ...}`` payload.
+- ``E`` — a packaged exception (JSON body, rehydrated client-side).
+- ``Z`` — clean end of stream (empty body).
+
+The persistent channel (``serving/channel.py`` ↔ ``PodServer.h_channel``)
+multiplexes many calls over one connection, so its messages additionally
+carry a JSON control header in front of an *opaque* payload:
+
+``[4-byte LE header length][header JSON][payload bytes]``
+
+The header is the only part the pod server parses — the payload (the
+serialized call body, or the serialized result) passes through
+PodServer → ProcessPool → ProcessWorker untouched, so the pod hop costs
+zero re-serialization.
+
+Everything here is transport-agnostic bytes-in/bytes-out so the exact
+same parser is unit-testable against adversarial chunkings (partial
+reads, frame boundaries split mid-length) without a socket.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, Iterator, Tuple
+
+from kubetorch_tpu import serialization
+
+KIND_DATA = b"D"
+KIND_ERROR = b"E"
+KIND_END = b"Z"
+
+_LEN_BYTES = 8
+_HDR_LEN_BYTES = 4
+
+
+def encode_frame(kind: bytes, body: bytes = b"") -> bytes:
+    """``kind`` is a single byte (``D``/``E``/``Z``)."""
+    return kind + len(body).to_bytes(_LEN_BYTES, "little") + body
+
+
+def encode_item(payload: bytes, method: str) -> bytes:
+    """Body of a ``D`` frame: 1-byte serialization code + payload."""
+    return serialization.method_code(method) + payload
+
+
+def decode_item(body: bytes) -> Tuple[str, bytes]:
+    """Inverse of :func:`encode_item` → (method, payload)."""
+    return serialization.method_from_code(body[0]), body[1:]
+
+
+def iter_frames(chunks: Iterable[bytes]) -> Iterator[Tuple[bytes, bytes]]:
+    """Parse a byte stream (arbitrary chunk boundaries) into
+    ``(kind, body)`` frames. The stream may split anywhere — mid-kind,
+    mid-length, mid-body. Ends cleanly only at a frame boundary; a stream
+    that stops mid-frame raises RuntimeError (a truncated response must
+    never look like a short but complete one)."""
+    buf = b""
+    it = iter(chunks)
+
+    def take(n: int) -> bytes:
+        nonlocal buf
+        while len(buf) < n:
+            try:
+                buf += next(it)
+            except StopIteration:
+                raise RuntimeError(
+                    "result stream truncated mid-frame") from None
+        out, buf = buf[:n], buf[n:]
+        return out
+
+    while True:
+        # a clean end is only legal between frames
+        while not buf:
+            try:
+                buf = next(it)
+            except StopIteration:
+                return
+        kind = take(1)
+        size = int.from_bytes(take(_LEN_BYTES), "little")
+        yield kind, (take(size) if size else b"")
+
+
+def iter_stream_items(chunks: Iterable[bytes]) -> Iterator:
+    """Decode a framed result stream into deserialized items; an ``E``
+    frame raises the rehydrated remote exception, ``Z`` ends iteration.
+
+    A stream that ends WITHOUT a terminal frame raises, even when the
+    last frame was complete: the server always closes with ``Z``/``E``,
+    so a bare EOF (proxy cut the response at a frame boundary) is a
+    truncated stream — and a shortened item list must never look like a
+    complete one."""
+    from kubetorch_tpu.exceptions import rehydrate_exception
+
+    for kind, body in iter_frames(chunks):
+        if kind == KIND_DATA:
+            method, payload = decode_item(body)
+            yield serialization.loads(payload, method)["result"]
+        elif kind == KIND_ERROR:
+            raise rehydrate_exception(json.loads(body))
+        else:  # KIND_END
+            return
+    raise RuntimeError(
+        "result stream truncated: ended without a terminal frame")
+
+
+# ------------------------------------------------------------- channel
+def pack_envelope(header: dict, payload: bytes = b"") -> bytes:
+    """One channel message: tiny JSON control header + opaque payload."""
+    hdr = json.dumps(header, separators=(",", ":")).encode()
+    return len(hdr).to_bytes(_HDR_LEN_BYTES, "little") + hdr + payload
+
+
+def unpack_envelope(data: bytes) -> Tuple[dict, bytes]:
+    hlen = int.from_bytes(data[:_HDR_LEN_BYTES], "little")
+    hdr = json.loads(data[_HDR_LEN_BYTES:_HDR_LEN_BYTES + hlen])
+    return hdr, data[_HDR_LEN_BYTES + hlen:]

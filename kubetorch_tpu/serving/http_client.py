@@ -7,6 +7,7 @@ errors into real exception classes (``CustomResponse.raise_for_status:88``).
 
 from __future__ import annotations
 
+import threading
 import uuid
 from typing import Any, Iterable, Optional, Tuple
 
@@ -21,9 +22,16 @@ from kubetorch_tpu.retry import (
 )
 
 _TIMEOUT = httpx.Timeout(connect=10.0, read=None, write=60.0, pool=10.0)
+# Explicit keep-alive pool: every call/retry to the same pod must ride an
+# already-open connection whenever one exists — the per-call TCP(+TLS)
+# handshake is exactly the fixed dispatch cost the serving path can't
+# afford (ISSUE 2; the persistent channel takes this further).
+_LIMITS = httpx.Limits(max_connections=64, max_keepalive_connections=32,
+                       keepalive_expiry=30.0)
 
 _sync_client: Optional[httpx.Client] = None
 _async_client: Optional[httpx.AsyncClient] = None
+_client_lock = threading.Lock()
 
 
 def proxy_timeout(timeout: Optional[float] = None) -> httpx.Timeout:
@@ -45,17 +53,27 @@ def proxy_timeout(timeout: Optional[float] = None) -> httpx.Timeout:
 
 
 def sync_client() -> httpx.Client:
-    """Shared pooled client (reference: serving/global_http_clients.py)."""
+    """Shared pooled client (reference: serving/global_http_clients.py).
+
+    Locked: concurrent first calls from executor threads must not each
+    build a client — the loser's pool (and its keep-alive connections)
+    would leak and every call on it would re-handshake."""
     global _sync_client
     if _sync_client is None or _sync_client.is_closed:
-        _sync_client = httpx.Client(timeout=_TIMEOUT)
+        with _client_lock:
+            if _sync_client is None or _sync_client.is_closed:
+                _sync_client = httpx.Client(timeout=_TIMEOUT,
+                                            limits=_LIMITS)
     return _sync_client
 
 
 def async_client() -> httpx.AsyncClient:
     global _async_client
     if _async_client is None or _async_client.is_closed:
-        _async_client = httpx.AsyncClient(timeout=_TIMEOUT)
+        with _client_lock:
+            if _async_client is None or _async_client.is_closed:
+                _async_client = httpx.AsyncClient(timeout=_TIMEOUT,
+                                                  limits=_LIMITS)
     return _async_client
 
 
@@ -124,8 +142,14 @@ def call_method(
     # re-POSTing after a read failure could double-execute a
     # non-idempotent user function. Reference: rsync_client.py:41 retry
     # discipline, applied to the call path with the narrower error set.
+    # The pooled client is resolved ONCE, outside the retry closure: every
+    # attempt reuses the same keep-alive pool, so a retry re-dials only
+    # the one dead connection instead of paying a fresh client (and a
+    # fresh TCP+TLS handshake for every connection in it).
+    client = sync_client()
+
     def attempt():
-        return sync_client().post(
+        return client.post(
             url, content=body, headers=headers, params=query or {},
             timeout=timeout if timeout is not None else _TIMEOUT)
 
@@ -134,8 +158,11 @@ def call_method(
 
 
 def _stream_call(url, body, headers, query, timeout):
-    """Generator over framed stream items (see server _respond_stream)."""
-    import json as _json
+    """Generator over framed stream items (see server _respond_stream).
+    Frame parsing lives in :mod:`kubetorch_tpu.serving.frames` — the same
+    parser the persistent channel uses, unit-tested against partial
+    reads and mid-stream error frames."""
+    from kubetorch_tpu.serving.frames import iter_stream_items
 
     with sync_client().stream(
             "POST", url, content=body, headers=headers, params=query or {},
@@ -147,33 +174,7 @@ def _stream_call(url, body, headers, query, timeout):
             resp.read()
             yield _handle(resp)
             return
-        buf = b""
-        itr = resp.iter_bytes()
-
-        def take(n: int) -> bytes:
-            nonlocal buf
-            while len(buf) < n:
-                try:
-                    buf += next(itr)
-                except StopIteration:
-                    raise RuntimeError(
-                        "result stream truncated mid-frame") from None
-            out, rest = buf[:n], buf[n:]
-            buf = rest
-            return out
-
-        while True:
-            kind = take(1)
-            size = int.from_bytes(take(8), "little")
-            payload = take(size) if size else b""
-            if kind == b"D":
-                # first body byte: per-item serialization method
-                used = serialization.method_from_code(payload[0])
-                yield serialization.loads(payload[1:], used)["result"]
-            elif kind == b"E":
-                raise rehydrate_exception(_json.loads(payload))
-            else:  # b"Z"
-                return
+        yield from iter_stream_items(resp.iter_bytes())
 
 
 async def call_method_async(
@@ -192,9 +193,12 @@ async def call_method_async(
     if method:
         url += f"/{method}"
 
-    # same connect-tier-only retry discipline as call_method
+    # same connect-tier-only retry discipline (and same single pooled
+    # client across attempts) as call_method
+    client = async_client()
+
     async def attempt():
-        return await async_client().post(
+        return await client.post(
             url, content=body, headers=headers, params=query or {},
             timeout=timeout if timeout is not None else _TIMEOUT)
 

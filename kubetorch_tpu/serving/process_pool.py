@@ -10,6 +10,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import time
 import uuid
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
@@ -151,6 +152,10 @@ class ProcessPool:
                 self._collect[req["req_id"]] = []
             else:
                 self._streams[req["req_id"]] = chan
+        # wall-clock submit stamp (time.time: comparable across the
+        # process boundary) — the worker differences it into the
+        # per-call dispatch stage of the latency decomposition
+        req["_t_submit"] = time.time()
         worker.send(req)
         return fut, chan
 

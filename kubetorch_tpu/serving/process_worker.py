@@ -20,6 +20,7 @@ import inspect
 import multiprocessing as mp
 import os
 import sys
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
@@ -58,54 +59,64 @@ def _maybe_device_stats() -> Optional[Dict[str, int]]:
     DCGM-analogue for the metrics pipeline (SURVEY §5.5 "replace DCGM with
     TPU metrics"): summed over local devices, attached to call responses so
     the pod server can report them without ever touching the devices
-    itself. Only reports when user code already *initialized* a backend —
-    a bare ``import jax`` (e.g. for tree utils, or before a deliberate
-    ``jax.distributed.initialize``) must not trigger device acquisition
-    from the metrics hook.
+    itself. Device stats only report when user code already *initialized*
+    a backend — a bare ``import jax`` (e.g. for tree utils, or before a
+    deliberate ``jax.distributed.initialize``) must not trigger device
+    acquisition from the metrics hook. Host-side counters (restore +
+    serving) ride along regardless — a jax-free callable still serves.
     """
     import sys
 
     agg: Dict[str, int] = {}
     jax = sys.modules.get("jax")
-    if jax is None:
-        return None
     try:
-        xla_bridge = sys.modules.get("jax._src.xla_bridge")
-        if xla_bridge is None or not getattr(xla_bridge, "_backends", None):
-            # backend not live; stay hands-off the devices — but restore
-            # counters (host-side work) still ride along if any exist
-            _attach_restore_metrics(agg)
-            return agg or None
-        devices = jax.local_devices()
-        for dev in devices:
-            stats = dev.memory_stats() or {}
-            for key in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use"):
-                value = stats.get(key)
-                if value is not None:
-                    agg[f"device_{key}"] = agg.get(f"device_{key}", 0) + value
-        agg["device_count"] = len(devices)
-        _attach_restore_metrics(agg)
-        return agg
+        if jax is not None:
+            xla_bridge = sys.modules.get("jax._src.xla_bridge")
+            if xla_bridge is not None and getattr(xla_bridge, "_backends",
+                                                 None):
+                devices = jax.local_devices()
+                for dev in devices:
+                    stats = dev.memory_stats() or {}
+                    for key in ("bytes_in_use", "bytes_limit",
+                                "peak_bytes_in_use"):
+                        value = stats.get(key)
+                        if value is not None:
+                            agg[f"device_{key}"] = (
+                                agg.get(f"device_{key}", 0) + value)
+                agg["device_count"] = len(devices)
     except Exception:
-        return None
+        agg = {}
+    _attach_worker_metrics(agg)
+    return agg or None
 
 
-def _attach_restore_metrics(agg: Dict[str, int]) -> None:
-    """Piggyback this worker's weight-sync restore counters on the same
-    response channel as the device stats: the counters are process-local,
-    and user code (get_arrays) runs HERE, not in the pod server that
-    answers /metrics — without the hop the pod would always report zeros.
+def _attach_worker_metrics(agg: Dict[str, int]) -> None:
+    """Piggyback this worker's process-local counters (weight-sync
+    restores + serving call accounting) on the same response channel as
+    the device stats: the counted work runs HERE, not in the pod server
+    that answers /metrics — without the hop the pod would always report
+    zeros.
 
-    Reported as one pid-tagged sub-dict (NOT flat keys): the pod server
+    Reported as pid-tagged sub-dicts (NOT flat keys): the pod server
     keeps a per-worker snapshot and SUMS the ``*_total`` counters across
     workers — a flat last-writer-wins merge would make the pod's counters
-    flip between workers' totals, which Prometheus reads as resets."""
+    flip between workers' totals, which Prometheus reads as resets. The
+    serving snapshot carries ONLY ``serving_worker_*`` keys — the
+    server-process gauges/histogram sums are not this worker's to report
+    (a zero here would clobber them in the non-``_total`` merge)."""
     try:
-        from kubetorch_tpu.observability.prometheus import restore_metrics
+        from kubetorch_tpu.observability.prometheus import (
+            restore_metrics,
+            serving_metrics,
+        )
 
         restore = restore_metrics()
         if restore.get("restore_count_total"):
             agg["data_store_restore"] = {"pid": os.getpid(), **restore}
+        serving = {k: v for k, v in serving_metrics().items()
+                   if k.startswith("serving_worker_") and v}
+        if serving:
+            agg["serving"] = {"pid": os.getpid(), **serving}
     except Exception:
         pass  # metrics must never break a call response
 
@@ -232,6 +243,13 @@ class _WorkerLoop:
                     self.executor, self._profile, req)
                 return {"req_id": req_id, "ok": True, "payload": payload}
 
+            # Dispatch stage of the latency decomposition: how long the
+            # request sat in the mp queue + event loop before user code
+            # ran (time.time on both sides — perf_counter isn't
+            # comparable across the process boundary).
+            t_start = time.time()
+            dispatch_s = max(0.0, t_start - float(
+                req.get("_t_submit") or t_start))
             # Per-call env (distributed rank assignment happens at call time,
             # after quorum — reference: process_pool.call_all per-rank env).
             # KT_REQUEST_ID goes into a contextvar instead: env is
@@ -251,6 +269,12 @@ class _WorkerLoop:
                 args = body.get("args", [])
                 kwargs = body.get("kwargs", {})
                 fn = self._resolve_method(req.get("method"))
+                # exec_s brackets ONLY the user callable (+ generator
+                # drain): body deserialization above and result
+                # serialization below are worker overhead, and folding
+                # them into the 'device' stage would overstate device
+                # time exactly where it matters (multi-MB pickled args)
+                t_exec0 = time.perf_counter()
                 if inspect.iscoroutinefunction(fn):
                     result = await fn(*args, **kwargs)
                 else:
@@ -270,7 +294,10 @@ class _WorkerLoop:
                     await self._stream_result(req, result)
                     return {"req_id": req_id, "ok": True,
                             "stream_end": True,
+                            "timings": self._call_timings(
+                                time.perf_counter() - t_exec0, dispatch_s),
                             "device_stats": _maybe_device_stats()}
+                exec_s = time.perf_counter() - t_exec0
             finally:
                 request_id_var.reset(rid_token)
             payload, used = serialization.choose(
@@ -278,12 +305,31 @@ class _WorkerLoop:
                 req.get("allowed", serialization.METHODS))
             return {"req_id": req_id, "ok": True, "payload": payload,
                     "serialization": used,
+                    "timings": self._call_timings(exec_s, dispatch_s),
                     "device_stats": _maybe_device_stats()}
         except BaseException as exc:  # noqa: BLE001 — must package everything
             if isinstance(exc, (KeyboardInterrupt, SystemExit)):
                 raise
             return {"req_id": req_id, "ok": False,
                     "error": package_exception(exc)["error"]}
+
+    def _call_timings(self, exec_s: float, dispatch_s: float) -> dict:
+        """Worker-side stages of the per-call decomposition: ``exec_s``
+        is the user callable's wall time in THIS process — for an engine
+        chunk that IS the device time (the one host sync included) —
+        ``dispatch_s`` the queue transit from the pod server. Also folds
+        both into the worker's serving counters (summed across worker
+        processes by the pod server's pid-tagged merge)."""
+        try:
+            from kubetorch_tpu.observability.prometheus import (
+                record_worker_call,
+            )
+
+            record_worker_call(exec_s, dispatch_s)
+        except Exception:  # noqa: BLE001 — metrics never break a call
+            pass
+        return {"exec_s": round(exec_s, 6), "dispatch_s": round(
+            dispatch_s, 6)}
 
     async def _stream_result(self, req: dict, gen):
         """Drain a (sync or async) generator result, pushing each item as

@@ -28,7 +28,7 @@ import time
 import uuid
 from typing import Any, Dict, Optional
 
-from aiohttp import ClientSession, web
+from aiohttp import ClientSession, WSMsgType, web
 
 from kubetorch_tpu import serialization
 from kubetorch_tpu.exceptions import (
@@ -42,7 +42,8 @@ request_id_var: contextvars.ContextVar = contextvars.ContextVar(
     "kt_request_id", default="-")
 
 _RESERVED = {"health", "ready", "metrics", "app", "http", "_reload",
-             "_teardown", "_gpu", "_debug", "_profile", "_actors"}
+             "_teardown", "_gpu", "_debug", "_profile", "_actors",
+             "_channel"}
 
 
 def metadata_from_env() -> Dict[str, Any]:
@@ -90,10 +91,13 @@ class PodServer:
             "http_request_duration_seconds_sum": 0.0,
             "last_activity_timestamp": time.time(),
         }
-        # per-process weight-sync restore snapshots (worker pid → counter
+        # per-process metric snapshots (group → worker pid → counter
         # dict; "server" = this process): *_total sums across processes
-        # stay monotonic where a flat merge would flip between workers
-        self._restore_by_proc: Dict[Any, Dict[str, float]] = {}
+        # stay monotonic where a flat merge would flip between workers.
+        # Groups: "data_store_restore" (weight-sync restore counters,
+        # merged under a data_store_ prefix) and "serving" (call-path
+        # counters, already serving_*-named).
+        self._stats_by_proc: Dict[str, Dict[Any, Dict[str, float]]] = {}
         self.ready = False
         self.setup_error: Optional[str] = None
         self.controller_ws = None
@@ -125,6 +129,7 @@ class PodServer:
         app.router.add_get("/ready", self.h_ready)
         app.router.add_get("/metrics", self.h_metrics)
         app.router.add_get("/app/status", self.h_app_status)
+        app.router.add_get("/_channel", self.h_channel)
         app.router.add_post("/_reload", self.h_reload)
         app.router.add_post("/_teardown", self.h_teardown)
         app.router.add_get("/_debug/ws", self.h_debug_ws)
@@ -389,30 +394,39 @@ class PodServer:
                 {"ready": False, "reason": "setting up"}, status=503)
         return web.json_response({"ready": True})
 
+    # group name in a worker's stats dict → metric-name prefix
+    _PROC_GROUPS = {"data_store_restore": "data_store_", "serving": ""}
+
     def _merge_worker_stats(self, stats: Dict[str, Any]):
         """Fold a worker's per-call stats dict into pod metrics. Plain
-        gauges (device memory) merge flat — freshest wins; the pid-tagged
-        restore snapshot goes through per-process aggregation."""
-        entry = stats.pop("data_store_restore", None)
-        if entry is not None:
-            self._merge_restore_snapshot(entry.pop("pid", 0), dict(entry))
+        gauges (device memory) merge flat — freshest wins; pid-tagged
+        snapshots (restore + serving counters) go through per-process
+        aggregation."""
+        for group in self._PROC_GROUPS:
+            entry = stats.pop(group, None)
+            if entry is not None:
+                entry = dict(entry)
+                self._merge_proc_snapshot(group, entry.pop("pid", 0), entry)
         if stats:
             self.metrics.update(stats)
 
-    def _merge_restore_snapshot(self, proc_id, snap: Dict[str, float]):
-        """Re-aggregate flat ``data_store_restore_*`` metrics from
-        per-process snapshots: ``*_total`` counters SUM across processes
-        (each worker's own counter is monotonic, so the sum is too —
-        last-writer-wins would flip between workers' totals, which
-        Prometheus reads as counter resets); ``last_*`` gauges come from
-        ``snap``, the process that reported most recently."""
-        self._restore_by_proc[proc_id] = snap
+    def _merge_proc_snapshot(self, group: str, proc_id,
+                             snap: Dict[str, float]):
+        """Re-aggregate flat per-process metric snapshots: ``*_total``
+        counters SUM across processes (each worker's own counter is
+        monotonic, so the sum is too — last-writer-wins would flip
+        between workers' totals, which Prometheus reads as counter
+        resets); everything else (``last_*``/histogram-sum gauges) comes
+        from ``snap``, the process that reported most recently."""
+        prefix = self._PROC_GROUPS[group]
+        by_proc = self._stats_by_proc.setdefault(group, {})
+        by_proc[proc_id] = snap
         for key in snap:
             if key.endswith("_total"):
-                self.metrics[f"data_store_{key}"] = sum(
-                    s.get(key, 0) for s in self._restore_by_proc.values())
+                self.metrics[f"{prefix}{key}"] = sum(
+                    s.get(key, 0) for s in by_proc.values())
             else:
-                self.metrics[f"data_store_{key}"] = snap[key]
+                self.metrics[f"{prefix}{key}"] = snap[key]
 
     async def h_metrics(self, request):
         healthy = (self.supervisor.healthy()
@@ -426,7 +440,15 @@ class PodServer:
         # process's own counters. Same names either way, one render source.
         restore = prom.restore_metrics()
         if restore["restore_count_total"]:
-            self._merge_restore_snapshot("server", restore)
+            self._merge_proc_snapshot("data_store_restore", "server",
+                                      restore)
+        # Serving call-path counters: the server process records channel
+        # lifecycle + server-side stage totals; worker processes piggyback
+        # their own serving_worker_* counters on call responses (merged
+        # pid-tagged above, summed like the restore counters).
+        serving = prom.serving_metrics()
+        if any(serving.values()):
+            self._merge_proc_snapshot("serving", "server", serving)
         data = {**self.metrics, "workers_healthy": healthy}
         if prom.wants_prometheus(request):
             # Prometheus/OpenMetrics scrapers (Accept: text/plain...) get
@@ -438,7 +460,12 @@ class PodServer:
                 "pod": os.environ.get("KT_POD_NAME", ""),
             }
             return web.Response(
-                text=prom.render(prom.flatten_metrics(data, labels)),
+                text=prom.render([
+                    *prom.flatten_metrics(data, labels),
+                    # le-labeled call-stage histograms (the flat dict
+                    # above carries only their sums/counts)
+                    *prom.serving_histogram_samples(labels),
+                ]),
                 content_type="text/plain", charset="utf-8")
         return web.json_response(data)
 
@@ -622,7 +649,7 @@ class PodServer:
             # plain h_call callers)
             resp, err = await self._drain_stream(resp, ser, allowed)
             if err is not None:
-                return err
+                return web.json_response(err, status=500)
         used = resp.get("serialization", ser)
         return web.Response(
             body=resp["payload"],
@@ -635,24 +662,18 @@ class PodServer:
         method = request.match_info.get("method")
         if name in _RESERVED:
             raise web.HTTPNotFound()
-        if self.supervisor is None or not self.ready:
-            exc = PodTerminatedError if self.terminating else None
-            msg = self.setup_error or "callable not loaded"
-            err = (exc or RuntimeError)(msg)
-            return web.json_response(package_exception(err), status=503)
-        expected = self.metadata.get("name") or self.metadata.get("callable_name")
-        if expected and name not in (expected, self.metadata.get("service_name")):
-            return web.json_response(package_exception(KeyError(
-                f"callable {name!r} not served here (serving {expected!r})")),
-                status=404)
-
-        ser = request.headers.get(serialization.HEADER, serialization.DEFAULT)
-        try:
-            ser = serialization.check_allowed(
-                ser, self.supervisor.allowed)
-        except Exception as exc:
-            return web.json_response(package_exception(exc), status=400)
+        ser, err = self._validate_call(
+            name, request.headers.get(serialization.HEADER,
+                                      serialization.DEFAULT))
+        if err is not None:
+            exc, status = err
+            return web.json_response(package_exception(exc), status=status)
         body = await request.read()
+        # t_recv AFTER the body upload: a slow client link's upload time
+        # is wire, not server queue — stamping at handler entry would
+        # misattribute it in the latency decomposition (the channel path
+        # stamps at message receipt, where the payload is already here).
+        t_recv = time.perf_counter()
         distributed_subcall = (
             request.query.get("distributed_subcall") == "true")
         restart_procs = request.query.get("restart_procs") == "true"
@@ -667,6 +688,7 @@ class PodServer:
             query["_stream_req"] = "1"
 
         loop = asyncio.get_running_loop()
+        t_exec = time.perf_counter()
         try:
             resp = await loop.run_in_executor(
                 None,
@@ -693,30 +715,81 @@ class PodServer:
             resp, err = await self._drain_stream(
                 resp, ser, self.supervisor.allowed)
             if err is not None:
-                return err
+                return web.json_response(err, status=500)
         stats = resp.pop("device_stats", None)
         if stats:
             # workers attach accelerator memory stats to responses; the
             # freshest snapshot rides the next metrics push (DCGM analogue)
             self._merge_worker_stats(stats)
+        # Latency decomposition (same stages the channel reports): the
+        # POST path records it too, so the per-call dispatch tax is a
+        # measured histogram on either path, and the client can read the
+        # X-KT-Timing header to split wall into wire vs server time.
+        t = self._call_timings(resp, t_recv, t_exec)
         used = resp.get("serialization", ser)
         return web.Response(
             body=resp["payload"],
             content_type=("application/json" if used == "json"
                           else "application/octet-stream"),
             headers={serialization.HEADER: used,
+                     "X-KT-Timing": json.dumps(t),
                      **resp.get("extra_headers", {})})
+
+    def _validate_call(self, name: str, ser: str):
+        """The one call gate both transports share (POST h_call and the
+        channel) — readiness, served-name, and serialization-allowlist
+        checks must never diverge between the two paths. Returns
+        ``(checked_ser, None)`` or ``(None, (exception, http_status))``;
+        the transport wraps the error (JSON status / error frame)."""
+        if self.supervisor is None or not self.ready:
+            exc_cls = (PodTerminatedError if self.terminating
+                       else RuntimeError)
+            return None, (exc_cls(self.setup_error
+                                  or "callable not loaded"), 503)
+        expected = (self.metadata.get("name")
+                    or self.metadata.get("callable_name"))
+        if name in _RESERVED or (
+                expected and name not in (
+                    expected, self.metadata.get("service_name"))):
+            return None, (KeyError(
+                f"callable {name!r} not served here "
+                f"(serving {expected!r})"), 404)
+        try:
+            return serialization.check_allowed(
+                ser, self.supervisor.allowed), None
+        except Exception as exc:  # noqa: BLE001
+            return None, (exc, 400)
+
+    def _call_timings(self, resp: Dict[str, Any], t_recv: float,
+                      t_exec: float) -> Dict[str, float]:
+        """Pop worker-side timings off a response, fold the server-side
+        stages into the Prometheus histograms, and return the wire-ready
+        decomposition dict ({server_s, queue_s, dispatch_s, exec_s})."""
+        from kubetorch_tpu.observability import prometheus as prom
+
+        now = time.perf_counter()
+        worker_t = resp.pop("timings", None) or {}
+        t = {"server_s": now - t_recv, "queue_s": t_exec - t_recv}
+        for key in ("dispatch_s", "exec_s"):
+            if isinstance(worker_t.get(key), (int, float)):
+                t[key] = float(worker_t[key])
+        prom.record_call_stages({
+            "server_queue": t["queue_s"],
+            "worker_dispatch": t.get("dispatch_s"),
+            "device": t.get("exec_s"),
+        })
+        return {k: round(v, 6) for k, v in t.items()}
 
     async def _drain_stream(self, resp, ser, allowed):
         """Drain a generator-result stream into one list-valued payload.
-        Returns (resp_dict, None), or (None, error_response) when the
-        stream stalls or ends in a packaged error."""
+        Returns (resp_dict, None), or (None, packaged_error_dict) when
+        the stream stalls or ends in a packaged error — the caller wraps
+        the error for its transport (HTTP 500 / channel 'error' frame)."""
         try:
             chunks = await asyncio.get_running_loop().run_in_executor(
                 None, list, iter(resp["stream"]))
         except TimeoutError as exc:
-            return None, web.json_response(package_exception(exc),
-                                           status=500)
+            return None, package_exception(exc)
         items, used = [], ser
         for chunk in chunks:
             items.append(serialization.loads(
@@ -724,8 +797,7 @@ class PodServer:
             used = chunk["serialization"]
         terminal = resp["stream"].terminal or {}
         if not terminal.get("ok"):
-            return None, web.json_response({"error": terminal["error"]},
-                                           status=500)
+            return None, {"error": terminal["error"]}
         payload, used = serialization.choose(
             {"result": items}, used, allowed)
         return {**terminal, "payload": payload, "serialization": used}, None
@@ -738,6 +810,8 @@ class PodServer:
         item, written as produced — the remote analogue of iterating the
         generator locally. A client disconnect cancels the worker-side
         generator so it doesn't hold an executor thread forever."""
+        from kubetorch_tpu.serving import frames
+
         loop = asyncio.get_running_loop()
         it = iter(stream)
         response = web.StreamResponse(headers={
@@ -746,18 +820,15 @@ class PodServer:
             "Content-Type": "application/octet-stream",
         })
         await response.prepare(request)
-
-        def frame(kind: bytes, body: bytes = b"") -> bytes:
-            return kind + len(body).to_bytes(8, "little") + body
-
         try:
             while True:
                 chunk = await loop.run_in_executor(None, next, it, None)
                 if chunk is None:
                     break
-                ser_code = serialization.method_code(chunk["serialization"])
-                await response.write(frame(b"D",
-                                           ser_code + chunk["payload"]))
+                await response.write(frames.encode_frame(
+                    frames.KIND_DATA,
+                    frames.encode_item(chunk["payload"],
+                                       chunk["serialization"])))
         except (ConnectionResetError, asyncio.CancelledError):
             cancel = getattr(stream, "cancel", None)
             if cancel is not None:
@@ -767,22 +838,220 @@ class PodServer:
             # Stream stalled past the call timeout (StreamResult already
             # cancelled the worker generator): tell the client with an 'E'
             # frame instead of silently truncating the stream.
-            await response.write(frame(
-                b"E", json.dumps({"error": package_exception(exc)["error"]}
-                                 ).encode()))
+            await response.write(frames.encode_frame(
+                frames.KIND_ERROR,
+                json.dumps({"error": package_exception(exc)["error"]}
+                           ).encode()))
             await response.write_eof()
             return response
         terminal = stream.terminal or {}
         if not terminal.get("ok"):
-            await response.write(frame(
-                b"E", json.dumps({"error": terminal["error"]}).encode()))
+            await response.write(frames.encode_frame(
+                frames.KIND_ERROR,
+                json.dumps({"error": terminal["error"]}).encode()))
         else:
             stats = terminal.get("device_stats")
             if stats:
                 self._merge_worker_stats(stats)
-            await response.write(frame(b"Z"))
+            await response.write(frames.encode_frame(frames.KIND_END))
         await response.write_eof()
         return response
+
+    # ---------------------------------------------------------- channel
+    async def h_channel(self, request: web.Request):
+        """Persistent multiplexed call channel (client:
+        ``serving/channel.py``). One WebSocket carries many calls; each
+        binary message is a ``frames.pack_envelope`` — a tiny JSON
+        control header plus an *opaque* payload. The payload is never
+        parsed here: it passes straight through supervisor → ProcessPool
+        → ProcessWorker, so the pod hop costs zero re-serialization.
+
+        Calls execute FIFO in arrival order per channel — a stateful
+        engine (``RollingDecoder``) driven pipelined must never see
+        chunk N+1 start before chunk N finishes; a call whose header
+        sets ``concurrent`` opts out and runs out-of-band. Responses
+        carry the server-side latency decomposition
+        (queue/dispatch/device) in the reply header."""
+        from kubetorch_tpu.observability import prometheus as prom
+        from kubetorch_tpu.serving import frames
+
+        ws = web.WebSocketResponse(max_msg_size=1024 ** 3)
+        await ws.prepare(request)
+        try:
+            # Nagle off: reply frames are small and the next chunk's
+            # request is usually already in flight the other way —
+            # without this the kernel holds replies for the delayed ACK
+            # (aiohttp 3.11 does not set TCP_NODELAY itself; see
+            # channel._set_nodelay for the measured stall).
+            from aiohttp.tcp_helpers import tcp_nodelay
+
+            if request.transport is not None:
+                tcp_nodelay(request.transport, True)
+        except Exception:  # noqa: BLE001
+            pass
+        prom.record_channel_event("connect")
+        if request.headers.get("X-KT-Channel-Reconnect") == "1":
+            # the client re-dialed after a drop: count it HERE too —
+            # operators alert on the pod's counters, not the client's
+            prom.record_channel_event("reconnect")
+        send_lock = asyncio.Lock()
+        fifo: asyncio.Queue = asyncio.Queue()
+        side_tasks: set = set()
+
+        async def _fifo_worker():
+            while True:
+                header, payload, t_recv = await fifo.get()
+                await self._channel_execute(ws, send_lock, header,
+                                            payload, t_recv)
+
+        dispatcher = asyncio.create_task(_fifo_worker())
+        try:
+            async for msg in ws:
+                if msg.type != WSMsgType.BINARY:
+                    continue
+                t_recv = time.perf_counter()
+                try:
+                    header, payload = frames.unpack_envelope(msg.data)
+                except Exception:  # noqa: BLE001
+                    continue  # garbled envelope: no cid to answer to
+                if header.get("kind") != "call":
+                    continue
+                # in-flight counts from RECEIPT, not execution start: a
+                # depth-2 pipeline with chunk N executing and N+1 queued
+                # must read 2 (the documented health check), not 1
+                prom.record_channel_event("call")
+                self.metrics["serving_channel_inflight"] = \
+                    prom.channel_inflight(+1)
+                self.metrics["http_requests_total"] += 1
+                self.metrics["last_activity_timestamp"] = time.time()
+                if header.get("concurrent"):
+                    task = asyncio.create_task(self._channel_execute(
+                        ws, send_lock, header, payload, t_recv))
+                    side_tasks.add(task)
+                    task.add_done_callback(side_tasks.discard)
+                else:
+                    fifo.put_nowait((header, payload, t_recv))
+        finally:
+            # client went away: stop executing its queue; in-flight
+            # worker calls finish on their own (same as a POST client
+            # disconnect), streamed generators are cancelled in
+            # _channel_stream's CancelledError path
+            dispatcher.cancel()
+            for task in side_tasks:
+                task.cancel()
+            # queued-but-never-executed calls would otherwise pin the
+            # inflight gauge forever (their _channel_execute finally
+            # never runs)
+            while not fifo.empty():
+                fifo.get_nowait()
+                self.metrics["serving_channel_inflight"] = \
+                    prom.channel_inflight(-1)
+        return ws
+
+    async def _channel_execute(self, ws, send_lock, header, payload,
+                               t_recv):
+        """Run one channel call and write its response frame(s)."""
+        from kubetorch_tpu.observability import prometheus as prom
+        from kubetorch_tpu.serving import frames
+
+        cid = header.get("cid")
+        rid = header.get("rid") or uuid.uuid4().hex[:12]
+
+        async def reply(hdr: dict, body: bytes = b""):
+            hdr["cid"] = cid
+            async with send_lock:
+                await ws.send_bytes(frames.pack_envelope(hdr, body))
+
+        async def reply_error(exc_or_error, t=None):
+            prom.record_channel_event("error")
+            self.metrics["http_request_errors_total"] += 1
+            error = (package_exception(exc_or_error)["error"]
+                     if isinstance(exc_or_error, BaseException)
+                     else exc_or_error)
+            hdr: Dict[str, Any] = {"kind": "error"}
+            if t:
+                hdr["t"] = t
+            await reply(hdr, json.dumps({"error": error}).encode())
+
+        try:
+            name = header.get("callable") or ""
+            method = header.get("method")
+            ser, err = self._validate_call(
+                name, header.get("ser", serialization.DEFAULT))
+            if err is not None:
+                return await reply_error(err[0])
+            loop = asyncio.get_running_loop()
+            t_exec = time.perf_counter()
+            try:
+                resp = await loop.run_in_executor(
+                    None, lambda: self.supervisor.call(
+                        payload, ser, method=method, request_id=rid))
+            except Exception as exc:  # noqa: BLE001
+                return await reply_error(exc)
+            if resp is None:
+                return await reply_error(
+                    RuntimeError("worker returned no response"))
+            if not resp.get("ok"):
+                return await reply_error(
+                    resp["error"],
+                    t=self._call_timings(resp, t_recv, t_exec))
+            if "stream" in resp:
+                if header.get("stream"):
+                    return await self._channel_stream(
+                        reply, reply_error, resp["stream"], t_recv, t_exec)
+                resp, err = await self._drain_stream(
+                    resp, ser, self.supervisor.allowed)
+                if err is not None:
+                    return await reply_error(err["error"])
+            stats = resp.pop("device_stats", None)
+            if stats:
+                self._merge_worker_stats(stats)
+            t = self._call_timings(resp, t_recv, t_exec)
+            used = resp.get("serialization", ser)
+            await reply({"kind": "result", "ser": used, "t": t},
+                        resp["payload"])
+        except (ConnectionResetError, asyncio.CancelledError):
+            raise
+        except Exception as exc:  # noqa: BLE001 — a reply must always go
+            try:
+                await reply_error(exc)
+            except Exception:  # noqa: BLE001 — socket already gone
+                pass
+        finally:
+            self.metrics["serving_channel_inflight"] = \
+                prom.channel_inflight(-1)
+
+    async def _channel_stream(self, reply, reply_error, stream, t_recv,
+                              t_exec):
+        """Forward a generator result over the channel: one 'item' frame
+        per yielded chunk (opaque payload + per-item serialization in
+        the header), then 'end' with the timing decomposition — the
+        channel twin of :meth:`_respond_stream`."""
+        loop = asyncio.get_running_loop()
+        it = iter(stream)
+        try:
+            while True:
+                chunk = await loop.run_in_executor(None, next, it, None)
+                if chunk is None:
+                    break
+                await reply({"kind": "item",
+                             "ser": chunk["serialization"]},
+                            chunk["payload"])
+        except TimeoutError as exc:
+            return await reply_error(exc)
+        except (ConnectionResetError, asyncio.CancelledError):
+            cancel = getattr(stream, "cancel", None)
+            if cancel is not None:
+                cancel()
+            raise
+        terminal = stream.terminal or {}
+        if not terminal.get("ok"):
+            return await reply_error(terminal["error"])
+        stats = terminal.get("device_stats")
+        if stats:
+            self._merge_worker_stats(stats)
+        t = self._call_timings(dict(terminal), t_recv, t_exec)
+        await reply({"kind": "end", "t": t})
 
 
 def main():

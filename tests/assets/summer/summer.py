@@ -155,3 +155,39 @@ def ray_probe():
     out = ray.get(double.remote(21))
     return {"nodes": len(nodes), "double": out,
             "pod": os.environ.get("KT_REPLICA_INDEX")}
+
+
+class ChunkEngine:
+    """Stateful decode-chunk simulator for call-channel tests: step order
+    is observable (seq), chunks can blow up on demand, and device time is
+    controllable — the FIFO/pipelining/exception semantics of the
+    persistent channel are asserted against it."""
+
+    def __init__(self):
+        self.seq = []
+
+    def step(self, i, delay=0.0, boom=False):
+        import time
+
+        if delay:
+            time.sleep(delay)
+        if boom:
+            raise ValueError(f"chunk {i} blew up")
+        self.seq.append(i)
+        return {"i": i, "seq": list(self.seq)}
+
+    def chunk_stream(self, n, delay=0.0):
+        import time
+
+        for i in range(n):
+            if delay:
+                time.sleep(delay)
+            yield {"i": i}
+
+    def pid_sleep(self, seconds=0.0):
+        import os
+        import time
+
+        if seconds:
+            time.sleep(seconds)
+        return os.getpid()

@@ -1,0 +1,316 @@
+"""Persistent pipelined call channel: one WebSocket carries many calls,
+up to ``depth`` in flight, FIFO execution per channel, opaque payloads
+through the pod hop, per-call latency decomposition, and exception
+rehydration with later chunks already in flight (ISSUE 2 acceptance).
+
+Also covers the satellite work: ``StreamResult.cancel()`` must free the
+worker slot and not leak the per-request queue, and the channel
+lifecycle counters must surface on the pod's /metrics."""
+
+import os
+import time
+from pathlib import Path
+
+import pytest
+
+import kubetorch_tpu as kt
+from kubetorch_tpu.resources.callables.cls import Cls
+
+ASSETS = Path(__file__).parent / "assets" / "summer"
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _local_state(tmp_path_factory):
+    state = tmp_path_factory.mktemp("ktlocal-channel")
+    os.environ["KT_LOCAL_STATE"] = str(state)
+    import kubetorch_tpu.provisioning.backend as backend
+
+    backend._LOCAL_ROOT = state
+    yield
+    for record in backend.LocalBackend().list_services():
+        backend.LocalBackend().teardown(record["service_name"], quiet=True)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    remote = Cls(root_path=str(ASSETS), import_path="summer",
+                 callable_name="ChunkEngine", name="chunkengine")
+    remote.to(kt.Compute(cpus="0.1"))
+    yield remote
+    remote.teardown()
+
+
+@pytest.mark.level("minimal")
+def test_channel_basic_call_and_timings(engine):
+    with engine.channel(depth=1) as chan:
+        out = chan.call(1001, method="step")
+        assert out["i"] == 1001 and out["seq"][-1] == 1001
+        # second call rides the SAME connection
+        out2 = chan.call(1002, method="step")
+        assert out2["seq"][-2:] == [1001, 1002]
+        assert chan.connects == 1
+        # decomposition present and sane: device covers the worker-side
+        # execution; wall covers everything
+        call = chan.submit(1004, method="step", kwargs={"delay": 0.05})
+        call.result()
+        t = call.timings
+        for key in ("client_ser", "wire", "server_queue",
+                    "worker_dispatch", "device", "wall"):
+            assert key in t, f"missing stage {key}: {t}"
+        assert t["device"] >= 50.0  # the 50 ms sleep is device time
+        assert t["wall"] >= t["device"]
+
+
+@pytest.mark.level("minimal")
+def test_channel_fifo_order_under_pipelining(engine):
+    """Chunks submitted pipelined at depth 3 must EXECUTE in submission
+    order — a stateful engine's correctness depends on it."""
+    with engine.channel(depth=3) as chan:
+        # NO warm-up call: the first burst races the connect itself —
+        # all three in-flight submits must share ONE socket (a second
+        # socket would split the FIFO order across connections)
+        ids = list(range(2001, 2011))
+        calls = [chan.submit(i, method="step",
+                             kwargs={"delay": 0.02}) for i in ids]
+        results = [c.result(timeout=60) for c in calls]
+        assert chan.connects == 1
+    for k, res in enumerate(results):
+        assert res["i"] == ids[k]
+        # the engine's seq ends with exactly the ids submitted so far
+        assert res["seq"][-(k + 1):] == ids[:k + 1], (k, res["seq"])
+
+
+@pytest.mark.level("minimal")
+def test_channel_pipelining_overlaps_wire_with_device(engine):
+    """Depth 2 must keep chunk N+1 in flight while N is on device. On
+    localhost the hidden cost (client serialize + RTT) is ~1 ms, far
+    below host noise, so the proof is structural, not a wall-clock race:
+    with real overlap, the SUM of per-call in-flight times exceeds the
+    run's wall time (two calls share every wall second), and throughput
+    sits near the device floor n*d."""
+    n, d = 6, 0.15
+    with engine.channel(depth=2) as chan:
+        chan.call(3100, method="step")  # warm
+        t0 = time.perf_counter()
+        calls = [chan.submit(3101 + i, method="step",
+                             kwargs={"delay": d}) for i in range(n)]
+        for c in calls:
+            c.result(timeout=60)
+        pipe_wall = time.perf_counter() - t0
+    in_flight = sum(c.timings["wall"] for c in calls) / 1e3
+    assert in_flight > pipe_wall * 1.3, (in_flight, pipe_wall)
+    # near the device floor: the per-chunk dispatch tax is hidden
+    assert pipe_wall < n * d * 1.7, (pipe_wall, n * d)
+
+
+@pytest.mark.level("minimal")
+def test_channel_exception_with_next_chunk_in_flight(engine):
+    """ISSUE 2 acceptance: an exception on chunk N rehydrates on N's
+    handle while N+1 (already in flight) still executes and resolves —
+    pipelining must not smear one chunk's failure across its neighbors."""
+    with engine.channel(depth=2) as chan:
+        c1 = chan.submit(4001, method="step", kwargs={"delay": 0.05})
+        c2 = chan.submit(4002, method="step", kwargs={"boom": True})
+        c3 = chan.submit(4003, method="step")
+        assert c1.result(timeout=60)["i"] == 4001
+        with pytest.raises(ValueError, match="chunk 4002 blew up"):
+            c2.result(timeout=60)
+        out3 = c3.result(timeout=60)
+        assert out3["i"] == 4003
+        # 4002 raised before mutating state: seq has 4001 then 4003
+        assert out3["seq"][-2:] == [4001, 4003]
+
+
+@pytest.mark.level("minimal")
+def test_channel_concurrent_calls_multiplex(engine):
+    """concurrent=True opts out of FIFO: three 0.4 s sleeps overlap in
+    the worker instead of serializing."""
+    with engine.channel(depth=4) as chan:
+        t0 = time.perf_counter()
+        calls = [chan.submit(method="pid_sleep", kwargs={"seconds": 0.4},
+                             concurrent=True) for _ in range(3)]
+        pids = {c.result(timeout=60) for c in calls}
+        wall = time.perf_counter() - t0
+    assert len(pids) == 1  # one worker process served all three
+    assert wall < 1.1, wall  # 3 × 0.4 s serialized would be ≥ 1.2 s
+
+
+@pytest.mark.level("minimal")
+def test_channel_stream_and_pickle(engine):
+    with engine.channel(depth=2) as chan:
+        items = list(chan.submit(4, method="chunk_stream", stream=True)
+                     .result(timeout=60))
+        assert items == [{"i": i} for i in range(4)]
+        # opaque pickle payload through the same channel
+        out = chan.call(5001, method="step", ser="pickle")
+        assert out["i"] == 5001
+        # stream=True on a plain (non-generator) method: one-item stream,
+        # matching the POST path's fallback — the result is never dropped
+        one = list(chan.submit(5002, method="step",
+                               stream=True).result(timeout=60))
+        assert len(one) == 1 and one[0]["i"] == 5002
+
+
+@pytest.mark.level("minimal")
+def test_channel_reconnects_after_drop(engine):
+    """A dropped socket fails in-flight calls with ChannelClosedError;
+    the next submit re-dials (connects bumps, reconnect counter too)."""
+    import asyncio
+
+    from kubetorch_tpu.observability import prometheus as prom
+    from kubetorch_tpu.serving.channel import ChannelClosedError
+
+    with engine.channel(depth=2) as chan:
+        assert chan.call(6001, method="step")["i"] == 6001
+        before = prom.serving_metrics()["serving_channel_reconnects_total"]
+        # kill the socket under a call that is still in flight
+        slow = chan.submit(6002, method="step", kwargs={"delay": 3.0})
+        time.sleep(0.2)  # let it reach the server
+        asyncio.run_coroutine_threadsafe(
+            chan._ws.close(), chan._loop).result(5.0)
+        with pytest.raises(ChannelClosedError):
+            slow.result(timeout=30)
+        # next call transparently reconnects
+        assert chan.call(6003, method="step")["i"] == 6003
+        assert chan.connects == 2
+        after = prom.serving_metrics()["serving_channel_reconnects_total"]
+        assert after == before + 1
+    # the POD counts the re-dial too (X-KT-Channel-Reconnect header):
+    # operators alert on the pod's /metrics, not the client's
+    import httpx
+
+    data = httpx.get(f"{engine.service_url()}/metrics", timeout=10).json()
+    assert data.get("serving_channel_reconnects_total", 0) >= 1
+
+
+@pytest.mark.level("minimal")
+def test_channel_metrics_surface_on_pod(engine):
+    """Satellite: channel lifecycle counters + in-flight gauge + worker
+    call counters (summed across worker processes like the restore
+    snapshot) land on the pod's /metrics."""
+    import httpx
+
+    with engine.channel(depth=2) as chan:
+        for i in range(3):
+            chan.call(7001 + i, method="step")
+    url = engine.service_url()
+    data = httpx.get(f"{url}/metrics", timeout=10).json()
+    assert data.get("serving_channel_calls_total", 0) >= 3
+    assert data.get("serving_channel_connects_total", 0) >= 1
+    assert data.get("serving_channel_inflight") == 0
+    assert data.get("serving_worker_calls_total", 0) >= 3
+    assert data.get("serving_worker_exec_seconds_total", 0) > 0
+    # prometheus exposition carries the le-labeled stage histograms
+    text = httpx.get(f"{url}/metrics?format=prometheus", timeout=10).text
+    assert "kubetorch_serving_channel_calls_total" in text
+    assert 'kubetorch_serving_call_device_seconds_bucket' in text
+    assert 'le="+Inf"' in text
+    # and NO duplicate samples: a (name, labels) pair appearing twice
+    # makes Prometheus reject the WHOLE scrape (the flat merged dict and
+    # the histogram series must use disjoint names)
+    samples = [line.split(" ")[0] for line in text.splitlines()
+               if line and not line.startswith("#")]
+    dupes = {s for s in samples if samples.count(s) > 1}
+    assert not dupes, f"duplicate exposition samples: {sorted(dupes)}"
+
+
+@pytest.mark.level("minimal")
+def test_client_standalone_exposition():
+    """A client process (no pod server) can render its own serving
+    counters + stage histograms via serving_samples — and that standalone
+    exposition must be duplicate-free too."""
+    from kubetorch_tpu.observability import prometheus as prom
+
+    prom.record_call_stages({"client_ser": 0.001, "wire": 0.004})
+    text = prom.render(list(prom.serving_samples({"client": "bench"})))
+    assert "kubetorch_serving_call_wire_seconds_bucket" in text
+    assert "kubetorch_serving_call_wire_seconds_sum" in text
+    assert "kubetorch_serving_channel_connects_total" in text
+    samples = [line.split(" ")[0] for line in text.splitlines()
+               if line and not line.startswith("#")]
+    dupes = {s for s in samples if samples.count(s) > 1}
+    assert not dupes, f"duplicate exposition samples: {sorted(dupes)}"
+
+
+@pytest.mark.level("minimal")
+def test_send_drops_calls_failed_before_shipping(engine):
+    """Reconnect race guard: an envelope whose call was already failed
+    (socket dropped between submit and the send coroutine running) must
+    NOT be shipped on a fresh socket — the server would execute a call
+    the client reported as failed, double-stepping a stateful engine on
+    resubmit. _send returns before even dialing for a dead cid."""
+    import asyncio
+
+    with engine.channel(depth=2) as chan:
+        loop = chan._ensure_loop()
+        # cid 999 was never registered (the moral equivalent of a call
+        # wiped by _fail_pending): _send must bail before connecting
+        asyncio.run_coroutine_threadsafe(
+            chan._send(999, b"\x00\x00\x00\x02{}"), loop).result(10)
+        assert chan.connects == 0, "dead-call envelope dialed a socket"
+        # a live call still connects and executes normally
+        assert chan.call(9001, method="step")["i"] == 9001
+        assert chan.connects == 1
+
+
+@pytest.mark.level("minimal")
+def test_post_path_unchanged_and_timed(engine):
+    """The plain POST path still works next to the channel and now
+    carries the server-side decomposition header."""
+    out = engine.step(8001)
+    assert out["i"] == 8001
+    import httpx
+
+    from kubetorch_tpu import serialization as ser
+    from kubetorch_tpu.serving.http_client import sync_client
+
+    resp = sync_client().post(
+        f"{engine.service_url()}/ChunkEngine/step",
+        content=ser.dumps({"args": [8002], "kwargs": {}}),
+        headers={ser.HEADER: "json"})
+    assert resp.status_code == 200
+    import json
+
+    t = json.loads(resp.headers["X-KT-Timing"])
+    assert t["server_s"] > 0 and "exec_s" in t
+
+
+@pytest.mark.level("minimal")
+def test_stream_cancel_frees_slot_and_queue():
+    """Satellite: cancelling a streamed call mid-iteration must free the
+    worker slot AND drop the per-request routing entries (futures /
+    stream queue) once the terminal lands — a leak here grows without
+    bound on a long-lived serving pod."""
+    from kubetorch_tpu import serialization
+    from kubetorch_tpu.serving.process_pool import ProcessPool
+
+    pool = ProcessPool(num_procs=1)
+    pool.start()
+    try:
+        pool.setup_all(root_path=str(ASSETS), import_path="summer",
+                       name="ChunkEngine", callable_type="cls")
+        body = serialization.dumps(
+            {"args": [100000], "kwargs": {"delay": 0.005}}, "json")
+        resp = pool.call(body, "json", method="chunk_stream", timeout=30)
+        stream = resp["stream"]
+        it = iter(stream)
+        next(it)
+        next(it)
+        stream.cancel()
+        # drain to the terminal: must arrive promptly
+        t0 = time.perf_counter()
+        leftover = sum(1 for _ in it)
+        assert time.perf_counter() - t0 < 10
+        assert leftover < 1000
+        assert stream.terminal.get("ok")
+        # NO leaked routing state once the terminal landed
+        assert pool._streams == {}, "per-request stream queue leaked"
+        assert pool._futures == {}, "response future leaked"
+        assert pool._collect == {}
+        # the worker slot is free: a fresh call executes normally
+        body2 = serialization.dumps({"args": [1], "kwargs": {}}, "json")
+        resp2 = pool.call(body2, "json", method="step", timeout=30)
+        assert resp2["ok"]
+        assert pool._streams == {} and pool._futures == {}
+    finally:
+        pool.stop()

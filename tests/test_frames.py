@@ -1,0 +1,125 @@
+"""Frame-protocol round-trip: the byte-level framing shared by the HTTP
+result stream (``PodServer._respond_stream`` ↔ ``_stream_call``) and the
+persistent call channel. The parser must survive adversarial chunkings
+(partial reads split anywhere), decode per-item serialization codes, and
+rehydrate mid-stream exception frames — previously all untested edge
+paths inside ``_stream_call``."""
+
+import json
+
+import pytest
+
+from kubetorch_tpu import serialization
+from kubetorch_tpu.exceptions import package_exception
+from kubetorch_tpu.serving import frames
+
+pytestmark = pytest.mark.level("unit")
+
+
+def _data_frame(obj, method="json"):
+    payload, used = serialization.choose({"result": obj}, method,
+                                         serialization.METHODS)
+    return frames.encode_frame(frames.KIND_DATA,
+                               frames.encode_item(payload, used))
+
+
+def _chunked(blob: bytes, n: int):
+    """Split a byte blob into n-byte reads (worst case n=1)."""
+    return [blob[i:i + n] for i in range(0, len(blob), n)]
+
+
+class TestFrameRoundTrip:
+    def test_items_round_trip_one_read(self):
+        blob = (_data_frame({"i": 0}) + _data_frame([1, 2])
+                + frames.encode_frame(frames.KIND_END))
+        assert list(frames.iter_stream_items([blob])) == [{"i": 0}, [1, 2]]
+
+    @pytest.mark.parametrize("read_size", [1, 2, 3, 7, 8, 9, 64])
+    def test_partial_reads_any_boundary(self, read_size):
+        """Frames split mid-kind, mid-length, and mid-body must all
+        reassemble — the wire owes the parser nothing about alignment."""
+        blob = (_data_frame({"i": 0}) + _data_frame("x" * 100)
+                + _data_frame({"deep": {"nest": [1]}})
+                + frames.encode_frame(frames.KIND_END))
+        items = list(frames.iter_stream_items(_chunked(blob, read_size)))
+        assert items == [{"i": 0}, "x" * 100, {"deep": {"nest": [1]}}]
+
+    def test_per_item_serialization_codes(self):
+        """A stream may flip json→pickle mid-way; the 1-byte code per D
+        frame is what keeps each item decodable."""
+        blob = (_data_frame({"plain": 1}, "json")
+                + _data_frame({1, 2, 3}, "pickle")
+                + frames.encode_frame(frames.KIND_END))
+        items = list(frames.iter_stream_items(_chunked(blob, 3)))
+        assert items[0] == {"plain": 1}
+        assert items[1] == {1, 2, 3} and isinstance(items[1], set)
+        # codes map back through serialization.method_from_code
+        kinds = [k for k, _ in frames.iter_frames([blob])]
+        assert kinds == [frames.KIND_DATA, frames.KIND_DATA,
+                         frames.KIND_END]
+        bodies = [b for _, b in frames.iter_frames([blob])]
+        assert serialization.method_from_code(bodies[0][0]) == "json"
+        assert serialization.method_from_code(bodies[1][0]) == "pickle"
+
+    def test_midstream_exception_frame_rehydrates(self):
+        """Items before the failure are delivered, then the E frame
+        raises the rehydrated remote exception class."""
+        err = package_exception(ValueError("stream blew up"))
+        blob = (_data_frame(0) + _data_frame(1)
+                + frames.encode_frame(frames.KIND_ERROR,
+                                      json.dumps(err).encode()))
+        got = []
+        with pytest.raises(ValueError, match="stream blew up"):
+            for item in frames.iter_stream_items(_chunked(blob, 2)):
+                got.append(item)
+        assert got == [0, 1]
+
+    def test_truncated_stream_raises_not_truncates(self):
+        """A stream that dies mid-frame must raise — a short-but-clean
+        iteration would silently drop the tail."""
+        blob = _data_frame({"i": 0}) + _data_frame({"i": 1})
+        for cut in (len(blob) - 1, len(blob) - 5,
+                    len(_data_frame({"i": 0})) + 4):
+            with pytest.raises(RuntimeError, match="truncated mid-frame"):
+                list(frames.iter_stream_items(_chunked(blob[:cut], 3)))
+
+    def test_missing_terminal_frame_raises(self):
+        """EOF at a frame boundary but without Z/E is still truncation:
+        the server always closes with a terminal frame, so a proxy
+        cutting the response between frames must not yield a shortened
+        item list indistinguishable from a complete one."""
+        blob = _data_frame({"i": 0}) + _data_frame({"i": 1})
+        got = []
+        with pytest.raises(RuntimeError, match="without a terminal"):
+            for item in frames.iter_stream_items(_chunked(blob, 4)):
+                got.append(item)
+        assert got == [{"i": 0}, {"i": 1}]  # items before EOF delivered
+
+    def test_clean_end_only_at_frame_boundary(self):
+        """EOF exactly between frames (no Z) ends iteration of raw
+        frames cleanly — the stream-level contract (Z required) lives a
+        layer up."""
+        blob = _data_frame({"i": 0})
+        assert len(list(frames.iter_frames(_chunked(blob, 1)))) == 1
+
+    def test_empty_body_frames(self):
+        blob = frames.encode_frame(frames.KIND_END)
+        assert list(frames.iter_frames([blob])) == [(frames.KIND_END, b"")]
+
+
+class TestEnvelope:
+    def test_envelope_round_trip_opaque_payload(self):
+        """The channel's control header parses; the payload comes back
+        byte-identical (the pod hop never touches it)."""
+        payload = bytes(range(256)) * 17
+        hdr = {"cid": 42, "kind": "call", "callable": "engine",
+               "method": "step", "ser": "pickle", "stream": False}
+        data = frames.pack_envelope(hdr, payload)
+        hdr2, payload2 = frames.unpack_envelope(data)
+        assert hdr2 == hdr
+        assert payload2 == payload
+
+    def test_envelope_empty_payload(self):
+        hdr, payload = frames.unpack_envelope(
+            frames.pack_envelope({"cid": 1, "kind": "end"}))
+        assert hdr == {"cid": 1, "kind": "end"} and payload == b""

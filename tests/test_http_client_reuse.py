@@ -1,0 +1,141 @@
+"""Connection reuse on the call path: sequential ``call_method`` calls —
+including calls made AFTER a retried connect failure — must ride the one
+cached pooled client's keep-alive connection instead of paying a fresh
+TCP handshake per call/attempt. The test server counts distinct TCP
+connections (peer ports), which is the ground truth pooling claim."""
+
+import asyncio
+import socket
+import threading
+
+import httpx
+import pytest
+
+from kubetorch_tpu.serving import http_client
+
+pytestmark = pytest.mark.level("minimal")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _CountingServer:
+    """Local aiohttp server recording each TCP connection's peername."""
+
+    def __init__(self):
+        from aiohttp import web
+
+        self.peers = []
+        self.calls = 0
+        self.port = _free_port()
+        self._started = threading.Event()
+
+        async def handler(request):
+            peer = request.transport.get_extra_info("peername")
+            if peer not in self.peers:
+                self.peers.append(peer)
+            self.calls += 1
+            return web.json_response(
+                {"result": self.calls},
+                headers={"X-Serialization": "json"})
+
+        app = web.Application()
+        app.router.add_post("/{callable}", handler)
+        app.router.add_post("/{callable}/{method}", handler)
+
+        def _run():
+            self.loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self.loop)
+            self.runner = web.AppRunner(app)
+            self.loop.run_until_complete(self.runner.setup())
+            site = web.TCPSite(self.runner, "127.0.0.1", self.port)
+            self.loop.run_until_complete(site.start())
+            self._started.set()
+            self.loop.run_forever()
+
+        self.thread = threading.Thread(target=_run, daemon=True)
+        self.thread.start()
+        assert self._started.wait(10)
+        self.url = f"http://127.0.0.1:{self.port}"
+
+    def stop(self):
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self.runner.cleanup(), self.loop).result(5)
+        except Exception:
+            pass
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)
+
+
+@pytest.fixture()
+def server():
+    srv = _CountingServer()
+    yield srv
+    srv.stop()
+
+
+def _fresh_pool():
+    """Drop the module-level cached clients so each test counts from a
+    clean pool."""
+    if http_client._sync_client is not None:
+        try:
+            http_client._sync_client.close()
+        except Exception:
+            pass
+    http_client._sync_client = None
+
+
+def test_sequential_calls_reuse_one_connection(server):
+    _fresh_pool()
+    for i in range(5):
+        assert http_client.call_method(server.url, "fn") == i + 1
+    assert server.calls == 5
+    assert len(server.peers) == 1, (
+        f"5 keep-alive calls opened {len(server.peers)} connections")
+
+
+def test_retry_path_keeps_the_cached_pooled_client(server, monkeypatch):
+    """A call whose every attempt dies with a connect error (dead port)
+    must NOT torch the pooled client: the client object survives, and
+    the next call to a live server reuses its existing keep-alive
+    connection — zero new handshakes."""
+    monkeypatch.setenv("KT_RETRY_ATTEMPTS", "2")
+    _fresh_pool()
+    # establish a pooled connection
+    assert http_client.call_method(server.url, "fn") == 1
+    client_before = http_client.sync_client()
+    assert len(server.peers) == 1
+
+    dead = f"http://127.0.0.1:{_free_port()}"
+    with pytest.raises(httpx.ConnectError):
+        http_client.call_method(dead, "fn", timeout=2.0)
+
+    # same client object, and the live server sees NO new connection
+    assert http_client.sync_client() is client_before
+    assert http_client.call_method(server.url, "fn") == 2
+    assert len(server.peers) == 1, (
+        "retry-exhausted connect failure cost the pooled keep-alive "
+        f"connection: {server.peers}")
+
+
+def test_concurrent_first_use_builds_one_client(server):
+    """The lazy pooled client is created once under the lock even when
+    executor threads race the first call."""
+    _fresh_pool()
+    clients = []
+    barrier = threading.Barrier(8)
+
+    def grab():
+        barrier.wait()
+        clients.append(http_client.sync_client())
+
+    threads = [threading.Thread(target=grab) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(set(id(c) for c in clients)) == 1

@@ -766,3 +766,34 @@ def test_spec_warmup_compiles_sampling_executable(model):
     assert eng.run()[rid] == want
     rid_s = eng.submit([1, 2, 3], max_new_tokens=8, temperature=0.9)
     assert len(eng.run()[rid_s]) == 8
+
+
+@pytest.mark.level("minimal")
+def test_rolling_decoder_remote_facing_driver(model):
+    """RollingDecoder: the JSON-able submit/step wrapper driven through
+    the pipelined call channel. Events must be plain types (survive the
+    json wire), match the engine's own output, and step() must report
+    the measured device time the latency decomposition checks against."""
+    import json
+
+    from kubetorch_tpu.models.rolling import RollingDecoder
+
+    params, cfg = model
+    eng = RollingGenerator(params, cfg, max_slots=4)
+    dec = RollingDecoder(eng)
+    rid = dec.submit([1, 2, 3, 4, 5], max_new_tokens=10)
+    got = []
+    while True:
+        out = dec.step()
+        json.dumps(out)  # the whole step result must be wire-safe
+        assert out["device_ms"] > 0
+        for erid, toks, done in out["events"]:
+            if erid == rid:
+                got.extend(toks)
+        if not out["pending"]:
+            break
+    gen = Generator(params, cfg)
+    expect = gen.generate([[1, 2, 3, 4, 5]], max_new_tokens=10,
+                          temperature=0.0, seed=0)[0]
+    assert got == expect
+    assert dec.stats()["free_slots"] == 4

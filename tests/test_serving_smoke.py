@@ -1,0 +1,58 @@
+"""Tier-1-safe serving-bench smoke: ``bench_serving.run(dryrun=True)``
+drives the call-tunnel phase (real pod server + worker subprocess +
+persistent channel) at toy sizes on CPU, and this test fails if any
+``serving_*`` metric KEY disappears — a silently-dropped measurement is
+how a perf regression hides (same contract as test_dataplane_smoke)."""
+
+import pytest
+
+# The bench's stable contract: every serving_* key BENCH_r* rounds chart.
+# Values are environment-dependent; keys are not. Adding keys is fine;
+# losing one fails here first, not in the next bench round's diff.
+EXPECTED_KEYS = {
+    "serving_pipeline_depth",
+    "serving_device_ms_cfg",
+    "serving_chunk_tokens",
+    "serving_post_ms_p50",
+    "serving_chan_ms_p50",
+    "serving_chunk_ms_pipelined",
+    "serving_chunk_ms_pipelined_spread",
+    # per-call latency decomposition (medians over depth-1 channel calls)
+    "serving_client_ser_ms",
+    "serving_wire_ms",
+    "serving_server_queue_ms",
+    "serving_worker_dispatch_ms",
+    "serving_device_ms",
+    # derived: tax above device time + tok/s per tunnel flavor
+    "serving_dispatch_tax_ms_post",
+    "serving_dispatch_tax_ms_chan",
+    "serving_dispatch_tax_ms_pipelined",
+    "serving_tok_s_post",
+    "serving_tok_s_chan",
+    "serving_tok_s_pipelined",
+    "serving_pipeline_speedup",
+}
+
+
+@pytest.mark.level("minimal")
+def test_serving_dryrun_metric_keys():
+    from kubetorch_tpu import bench_serving
+
+    out = bench_serving.run(dryrun=True)
+    missing = EXPECTED_KEYS - set(out)
+    assert not missing, (
+        f"serving bench dropped metric keys: {sorted(missing)} — a "
+        f"measurement went silent; restore it (or update EXPECTED_KEYS "
+        f"if the rename is deliberate)")
+    # sanity: real measurements, right shapes
+    assert out["serving_post_ms_p50"] > 0
+    assert out["serving_chan_ms_p50"] > 0
+    assert out["serving_chunk_ms_pipelined"] > 0
+    assert out["serving_tok_s_pipelined"] > 0
+    lo, hi = out["serving_chunk_ms_pipelined_spread"]
+    assert lo <= out["serving_chunk_ms_pipelined"] <= hi
+    # the simulated device time must show up in the measured device
+    # stage (worker-side execution covers the sleep)
+    assert out["serving_device_ms"] >= out["serving_device_ms_cfg"]
+    # dryrun toy values must never be compared against prior rounds
+    assert "rolling_tok_s_tunnel_wall" not in out
