@@ -397,6 +397,135 @@ def bench_restore(store: "_Store", total_mb: float = 64.0,
     return out
 
 
+def _weight_sync_tree(total_mb: float, lora_frac: float = 0.005):
+    """A weight-sync-shaped float32 tree: a big frozen backbone (the bulk
+    of the bytes) plus small LoRA-style adapter leaves (~0.5%) — the
+    blob the codec/delta layer exists for."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    total = int(total_mb * (1 << 20))
+    lora_bytes = max(8192, int(total * lora_frac))
+    backbone = total - lora_bytes
+    tree = {"backbone": {}, "lora": {}}
+    n_bb = 8
+    for i in range(n_bb):
+        rows = max(1, backbone // n_bb // 4 // 64)
+        tree["backbone"][f"w{i}"] = rng.standard_normal(
+            (rows, 64)).astype(np.float32)
+    for i in range(4):
+        rows = max(1, lora_bytes // 4 // 4 // 64)
+        tree["lora"][f"a{i}"] = rng.standard_normal(
+            (rows, 64)).astype(np.float32)
+    return tree
+
+
+def bench_codec(store: "_Store", total_mb: float = 64.0,
+                reps: int = REPS) -> Dict[str, float]:
+    """Wire-bytes decomposition of the quantized delta codec on the
+    weight-sync blob: raw vs int8 wire bytes (the ≥2× reduction), codec
+    encode/decode rates, and the delta publish/fetch path — a LoRA-only
+    update must ship <1% of the full blob's bytes in BOTH directions,
+    with the delta counters proving unchanged leaves were skipped."""
+    import jax
+    import numpy as np
+
+    from kubetorch_tpu.data_store.client import DataStoreClient
+    from kubetorch_tpu.data_store.device_transfer import (
+        get_arrays,
+        last_publish_stats,
+        last_restore_stats,
+        put_arrays,
+    )
+
+    tree = _weight_sync_tree(total_mb)
+    raw_bytes = sum(a.nbytes for a in jax.tree.leaves(tree))
+    raw_mb = raw_bytes / 1e6
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    cache_dir = tempfile.mkdtemp(prefix="ktpu-restore-cache-", dir=base)
+    prev_env = {k: os.environ.get(k)
+                for k in ("KT_STORE_URL", "KT_RESTORE_CACHE")}
+    prev_default = DataStoreClient._default
+    os.environ["KT_STORE_URL"] = store.url
+    os.environ["KT_RESTORE_CACHE"] = cache_dir
+    DataStoreClient._default = None
+    out: Dict[str, float] = {}
+    try:
+        sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+
+        # raw vs int8 wire bytes on the same blob
+        put_arrays("bench/codec-raw", tree, codec="raw")
+        get_arrays("bench/codec-raw", template=tree, shardings=sharding,
+                   streaming=True)
+        wire_raw = last_restore_stats()["wire_bytes"]
+        encode, decode, streamed = [], [], []
+        for _ in range(reps):
+            put_arrays("bench/codec-int8", tree, codec="int8")
+            encode.append(max(1e-9, last_publish_stats()["encode_s"]))
+            streamed.append(_timed(lambda: get_arrays(
+                "bench/codec-int8", template=tree, shardings=sharding,
+                streaming=True)))
+            decode.append(max(1e-9,
+                              last_restore_stats()["codec_decode_s"]))
+        stats = last_restore_stats()
+        wire_int8 = stats["wire_bytes"]
+        out["restore_wire_bytes_raw_mb"] = round(wire_raw / 1e6, 2)
+        out["restore_wire_bytes_int8_mb"] = round(wire_int8 / 1e6, 2)
+        out["restore_wire_reduction_int8"] = round(
+            wire_raw / max(1, wire_int8), 2)
+        _spread(streamed, "restore_int8_streamed_ms", out, scale=1e3)
+        out["codec_int8_encode_MBps"] = round(
+            raw_mb / sorted(encode)[len(encode) // 2], 1)
+        out["codec_int8_decode_MBps"] = round(
+            raw_mb / sorted(decode)[len(decode) // 2], 1)
+        out["codec_int8_dequant_ms"] = round(
+            stats.get("dequant_s", 0.0) * 1e3, 2)
+
+        # delta publish/fetch: full round, then LoRA-only updates
+        put_arrays("bench/codec-delta", tree, codec="int8", delta=True)
+        out["delta_publish_full_mb"] = round(
+            last_publish_stats()["wire_bytes"] / 1e6, 2)
+        get_arrays("bench/codec-delta", template=tree, shardings=sharding,
+                   delta=True)  # populates the restore cache (miss)
+        upd_pub, upd_fetch, skipped = [], [], []
+        rng = np.random.default_rng(1)
+        for _ in range(reps):
+            for name in tree["lora"]:
+                tree["lora"][name] = (
+                    tree["lora"][name]
+                    + rng.standard_normal(1).astype(np.float32))
+            put_arrays("bench/codec-delta", tree, codec="int8",
+                       delta=True)
+            pub = last_publish_stats()
+            upd_pub.append(pub["wire_bytes"])
+            skipped.append(pub["leaves_skipped"])
+            get_arrays("bench/codec-delta", template=tree,
+                       shardings=sharding, delta=True)
+            fs = last_restore_stats()
+            if fs.get("delta_hit") != 1.0:
+                raise AssertionError(
+                    "delta fetch missed with a warm restore cache")
+            upd_fetch.append(fs["wire_bytes"])
+        out["delta_publish_update_mb"] = round(
+            sorted(upd_pub)[len(upd_pub) // 2] / 1e6, 3)
+        out["delta_publish_update_pct"] = round(
+            100.0 * sorted(upd_pub)[len(upd_pub) // 2] / raw_bytes, 2)
+        out["delta_publish_leaves_skipped"] = sorted(
+            skipped)[len(skipped) // 2]
+        out["delta_fetch_wire_mb"] = round(
+            sorted(upd_fetch)[len(upd_fetch) // 2] / 1e6, 3)
+        out["delta_fetch_hit"] = 1.0
+    finally:
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        DataStoreClient._default = prev_default
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    return out
+
+
 def _prior_round_dataplane():
     """The newest BENCH_r*.json's dataplane block (+ its round number;
     empty/-1 if none) — the baseline for the >20% regression flags."""
@@ -442,6 +571,8 @@ def run(dryrun: bool = False) -> Dict[str, float]:
                                    mb=(1 if dryrun else 16), reps=reps))
         out.update(bench_restore(store, total_mb=(8 if dryrun else 64),
                                  reps=reps))
+        out.update(bench_codec(store, total_mb=(8 if dryrun else 64),
+                               reps=reps))
     finally:
         if store is not None:
             store.close()

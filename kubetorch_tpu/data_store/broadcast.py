@@ -109,6 +109,32 @@ def _member_id() -> str:
     return f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
 
 
+def peer_cache_candidates(key: str, cache_root=None) -> list:
+    """Peer-cache files that may hold a copy of ``key``'s blob — the
+    plain-key publish plus version-scoped ``.bv{N}`` files, newest
+    version first. Delta fetches use these as splice bases: a broadcast
+    member's last fan-out copy is a perfectly good previous version even
+    when the restore cache is cold."""
+    root = Path(cache_root or _CACHE_ROOT)
+    local = root / key
+
+    def _bv(p: Path) -> int:
+        try:
+            return int(p.name.rsplit(".bv", 1)[1])
+        except (IndexError, ValueError):
+            return -1
+
+    out = []
+    if local.parent.is_dir():
+        out = sorted(
+            (p for p in local.parent.glob(local.name + ".bv*")
+             if p.is_file() and ".part" not in p.name),
+            key=_bv, reverse=True)
+    if local.is_file():
+        out.insert(0, local)
+    return out
+
+
 def _stream_blob_into_cache(backend, key: str, cache_root: Path,
                             wait_parent: bool = False,
                             cache_name: Optional[str] = None,

@@ -124,6 +124,45 @@ class LocalStoreBackend:
         path = self._path(key)
         path.parent.mkdir(parents=True, exist_ok=True)
         path.write_bytes(blob)
+        # a full put supersedes any recorded delta chain: a stale patch
+        # sidecar would let an old-base fetcher splice itself to the
+        # PREVIOUS version and miss this one
+        from kubetorch_tpu.data_store.types import BLOB_DELTA_SUFFIX
+
+        path.with_name(path.name + BLOB_DELTA_SUFFIX).unlink(
+            missing_ok=True)
+        return key
+
+    def put_blob_delta(self, key: str, delta: bytes) -> str:
+        """Splice a delta patch against the stored blob (the local twin
+        of the store server's ``X-KT-Delta`` PUT); keeps the patch as the
+        fetch sidecar. 409 when the base doesn't match the patch."""
+        import os as _os
+
+        from kubetorch_tpu.data_store import codec as codec_mod
+        from kubetorch_tpu.data_store.types import BLOB_DELTA_SUFFIX
+
+        path = self._path(key)
+        if not path.is_file():
+            raise DataStoreError(f"no blob {key!r} to delta against",
+                                 status=409)
+        tmp = path.with_name(f".{path.name}.{_os.getpid()}.tmp")
+        try:
+            codec_mod.splice_delta(delta, path, tmp)
+        except codec_mod.DeltaMismatch as exc:
+            tmp.unlink(missing_ok=True)
+            raise DataStoreError(str(exc), status=409) from exc
+        except BaseException:
+            tmp.unlink(missing_ok=True)
+            raise
+        # sidecar first (atomically), blob second: the reverse order
+        # crashing mid-way would pair the NEW blob with the OLD patch and
+        # splice old-base fetchers onto a superseded version
+        side = path.with_name(path.name + BLOB_DELTA_SUFFIX)
+        side_tmp = side.with_name(side.name + ".tmp")
+        side_tmp.write_bytes(delta)
+        _os.replace(side_tmp, side)
+        _os.replace(tmp, path)
         return key
 
     def get_blob(self, key: str, **kw) -> bytes:
@@ -132,7 +171,8 @@ class LocalStoreBackend:
             raise DataStoreError(f"no such key {key!r}")
         return path.read_bytes()
 
-    def get_blob_stream(self, key: str, chunk_bytes: int = 4 << 20,
+    def get_blob_stream(self, key: str,
+                        chunk_bytes: Optional[int] = None,
                         **kw):
         """Chunked reads off disk — same iterator contract as the HTTP
         backend's, so the streaming array restore works identically in
@@ -146,11 +186,15 @@ class LocalStoreBackend:
         return _iter_file_chunks(path, chunk_bytes)
 
     def list_keys(self, prefix: str = "", **kw) -> List[dict]:
+        from kubetorch_tpu.data_store.types import BLOB_DELTA_SUFFIX
+
         base = self.root / prefix if prefix else self.root
         if not base.exists():
             return []
         out = []
         for path in sorted(base.rglob("*")):
+            if path.name.endswith(BLOB_DELTA_SUFFIX):
+                continue  # internal delta-patch sidecar
             if path.is_file():
                 stat = path.stat()
                 out.append({
@@ -161,6 +205,8 @@ class LocalStoreBackend:
         return out
 
     def delete(self, key: str, recursive: bool = False, **kw) -> int:
+        from kubetorch_tpu.data_store.types import BLOB_DELTA_SUFFIX
+
         path = self._path(key)
         if not path.exists():
             return 0
@@ -172,4 +218,6 @@ class LocalStoreBackend:
             shutil.rmtree(path)
             return count
         path.unlink()
+        path.with_name(path.name + BLOB_DELTA_SUFFIX).unlink(
+            missing_ok=True)
         return 1

@@ -1,0 +1,655 @@
+"""Blob wire codecs + delta publish for the weight-sync path.
+
+PR 1 overlapped fetch with placement and PR 2 removed per-call dispatch,
+which leaves the weight-sync WIRE as the dataplane bottleneck (~0.4-0.6
+GB/s host-staged; a 16 GB bf16 sync pays ~70 s/round on publish+fetch).
+This module shrinks the bytes instead of only overlapping them
+(EQuARX, arxiv 2506.17615, shows quantized collectives recover most of
+the bandwidth at negligible quality cost; the same applies to our
+host-staged transfers):
+
+- **Framed codecs** for the packed-array format: every leaf payload is
+  length-prefixed and independently encoded as ``raw`` (bytes as-is),
+  ``zlib``/``zstd`` (lossless; zstd falls back to zlib when the optional
+  ``zstandard`` extra is absent), or ``int8`` (per-row symmetric
+  quantization with float32 scales — the same absmax/127 math as
+  ``models/quant.py``; non-float leaves fall back to raw so a mixed tree
+  stays bit-exact where it must). The codec is negotiated via the blob
+  header: V1 blobs (no codec) stay readable forever, V2 headers name the
+  codec per leaf.
+- **Delta publish**: a publisher keeps a per-leaf content-digest manifest
+  of its last published blob and re-sends only changed leaves as a byte-
+  level patch (copy-from-base / data ops). The store splices the patch
+  against its current full blob, so fetchers always see a complete blob;
+  a fetcher holding the previous version locally pulls just the patch
+  sidecar and splices from its own cache — a LoRA-only update ships
+  kilobytes instead of gigabytes in both directions. Patches name their
+  base by header digest, so a mismatched base can never be spliced.
+
+Layering: this module owns the byte-level frame/patch formats and the
+per-leaf encoders/decoders; ``device_transfer.py`` orchestrates trees,
+streams, and device placement on top. numpy/ml_dtypes imports are lazy so
+the store server (which only needs :func:`splice_delta`) stays light.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+import zlib
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import msgpack
+
+from kubetorch_tpu.data_store.types import BLOB_DELTA_SUFFIX, WIRE_CODECS
+
+__all__ = [
+    "BLOB_DELTA_SUFFIX", "WIRE_CODECS", "MAGIC_V2", "MAGIC_DELTA",
+    "DeltaMismatch", "QuantLeaf", "default_chunk_bytes", "default_codec",
+    "delta_enabled", "restore_cache_root", "have_zstd", "resolve_codec",
+    "leaf_codec", "leaf_meta", "leaf_digest", "encode_leaf",
+    "encoded_size", "make_decoder", "build_header", "parse_header",
+    "pack_stream", "packed_size", "build_delta", "parse_delta_plan",
+    "splice_delta", "blob_header_digest",
+]
+
+MAGIC_V2 = b"KTARRV2\x00"
+MAGIC_DELTA = b"KTARRD1\x00"
+
+LOSSLESS = ("raw", "zlib", "zstd")
+_SCALE_DTYPE = "float32"  # int8 codec per-row scale storage
+
+
+# ------------------------------------------------------------------ knobs
+def default_chunk_bytes(fallback: int = 4 << 20) -> int:
+    """The one stream-granularity knob (``KT_STREAM_CHUNK_BYTES``) shared
+    by the HTTP blob chunkers, file streamers, and the pipelined restore's
+    ``chunk_bytes`` default — previously three hard-coded ``4 << 20``."""
+    try:
+        return max(1 << 16, int(os.environ["KT_STREAM_CHUNK_BYTES"]))
+    except (KeyError, ValueError):
+        return fallback
+
+
+def default_codec() -> str:
+    """Wire codec when the caller doesn't pick one (``KT_WIRE_CODEC``).
+    ``raw`` keeps publishes byte-identical to the V1 format."""
+    return os.environ.get("KT_WIRE_CODEC", "raw").strip().lower() or "raw"
+
+
+def delta_enabled(explicit: Optional[bool] = None) -> bool:
+    """Delta-publish/fetch default (``KT_WIRE_DELTA``); off unless asked —
+    delta tracking hashes every leaf, which full-raw publishes skip."""
+    if explicit is not None:
+        return explicit
+    return os.environ.get("KT_WIRE_DELTA", "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def restore_cache_root() -> Path:
+    """Where fetchers keep the last restored blob per key — the local
+    splice base for delta fetches (``KT_RESTORE_CACHE``)."""
+    return Path(os.environ.get(
+        "KT_RESTORE_CACHE", "~/.ktpu/restore_cache")).expanduser()
+
+
+def have_zstd() -> bool:
+    return _zstd() is not None
+
+
+def _zstd():
+    """The ``zstandard`` module or None — optional extra, never required
+    (the ``zstd`` codec silently degrades to zlib on encode; decode of a
+    genuinely zstd-framed blob without the module raises with the install
+    hint)."""
+    try:
+        import zstandard
+
+        return zstandard
+    except ImportError:
+        return None
+
+
+def resolve_codec(name: Optional[str]) -> str:
+    """Normalize a requested codec: None → env default; ``zstd`` without
+    the optional ``zstandard`` module degrades to ``zlib`` (lossless
+    either way); unknown names raise."""
+    name = (name or default_codec()).strip().lower()
+    if name == "zstd" and _zstd() is None:
+        name = "zlib"
+    if name not in WIRE_CODECS:
+        raise ValueError(
+            f"unknown wire codec {name!r} (choose from {WIRE_CODECS})")
+    return name
+
+
+# ------------------------------------------------------------ leaf codecs
+def _np():
+    import numpy as np
+
+    return np
+
+
+def _is_float_dtype(dtype) -> bool:
+    # ml_dtypes (bfloat16, fp8) register with kind 'V'; name-match those.
+    return (dtype.kind == "f"
+            or dtype.name.startswith(("bfloat", "float8")))
+
+
+def leaf_codec(requested: str, arr) -> str:
+    """Per-leaf codec: ``int8`` only compresses ≥2-D float leaves with
+    >1-byte items — everything else (ints, bools, empty/0-d leaves,
+    already-int8 storage, and 1-D vectors) stays lossless raw. The 1-D
+    exclusion covers norm gains/biases: they are a negligible byte
+    fraction but quality-sensitive, and a flat vector would get ONE
+    scale for every element (same reasoning as ``models/quant.py``
+    leaving norms in the original dtype). A mixed tree under the int8
+    codec is therefore bit-exact wherever it has to be."""
+    if requested == "int8":
+        if (_is_float_dtype(arr.dtype) and arr.dtype.itemsize > 1
+                and arr.size > 0 and arr.ndim >= 2):
+            return "int8"
+        return "raw"
+    return requested
+
+
+def leaf_meta(codec: str, arr) -> Dict[str, Any]:
+    meta: Dict[str, Any] = {"shape": list(arr.shape),
+                            "dtype": arr.dtype.name, "codec": codec}
+    if codec == "int8":
+        meta["cols"] = int(arr.shape[-1]) if arr.ndim else 1
+        meta["sdt"] = _SCALE_DTYPE
+    return meta
+
+
+def _contig_bytes(arr):
+    """Contiguous uint8 view of a host array (ml_dtypes leaves have no
+    buffer protocol of their own, but any contiguous array views as
+    bytes)."""
+    np = _np()
+    return np.ascontiguousarray(arr).reshape(-1).view(np.uint8).reshape(-1)
+
+
+def leaf_digest(arr) -> str:
+    """Content digest of a host leaf's raw bytes (blake2b-64: in-memory,
+    fast, and stable across processes — the delta manifest currency)."""
+    return hashlib.blake2b(_contig_bytes(arr), digest_size=8).hexdigest()
+
+
+def _quantize_rows(arr):
+    """Per-row symmetric int8 with float32 scales over the last axis —
+    the host-side (numpy) twin of ``models/quant._quantize_leaf``'s
+    absmax/127 math (that one reduces axis=-2 for matmul layouts; the
+    wire codec quantizes per row of the flattened-to-2D leaf, which keeps
+    the worst-case error one half-step of each row's own absmax)."""
+    np = _np()
+    cols = int(arr.shape[-1]) if arr.ndim else 1
+    f = np.ascontiguousarray(arr).reshape(-1, cols).astype(np.float32)
+    absmax = np.max(np.abs(f), axis=1)
+    scale = (np.maximum(absmax, 1e-8) / 127.0).astype(np.float32)
+    q = np.clip(np.rint(f / scale[:, None]), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def encode_leaf(codec: str, arr) -> Tuple[List[Any], int]:
+    """Encode one host leaf → (payload chunks, encoded byte count).
+    Raw chunks are zero-copy memoryviews; compressed/quantized payloads
+    materialize per leaf (peak O(one encoded leaf), matching the
+    unpacker's memory bound)."""
+    if codec == "raw":
+        mv = memoryview(_contig_bytes(arr))
+        step = default_chunk_bytes(32 << 20)
+        chunks = [mv[i:i + step] for i in range(0, len(mv), step)] or []
+        return chunks, len(mv)
+    if codec in ("zlib", "zstd"):
+        data = bytes(_contig_bytes(arr))
+        if codec == "zstd":
+            zs = _zstd()
+            if zs is None:  # resolve_codec degrades, but guard anyway
+                codec, payload = "zlib", zlib.compress(data, 1)
+            else:
+                payload = zs.ZstdCompressor(level=3).compress(data)
+        else:
+            # level 1: the wire is ~0.5 GB/s — a fast level that keeps
+            # encode faster than the link beats a tighter, slower one
+            payload = zlib.compress(data, 1)
+        return [payload], len(payload)
+    if codec == "int8":
+        q, scale = _quantize_rows(arr)
+        return [scale.tobytes(), q.tobytes()], scale.nbytes + q.nbytes
+    raise ValueError(f"unknown leaf codec {codec!r}")
+
+
+def encoded_size(codec: str, arr) -> Optional[int]:
+    """Encoded payload size when it is knowable WITHOUT encoding (raw,
+    int8); None for compressors — their output length decides between
+    Content-Length framing and chunked transfer on the publish path."""
+    if codec == "raw":
+        return arr.nbytes
+    if codec == "int8":
+        cols = int(arr.shape[-1]) if arr.ndim else 1
+        rows = arr.size // max(1, cols)
+        return rows * 4 + arr.size
+    return None
+
+
+class QuantLeaf:
+    """An int8-coded leaf decoded to its SMALL representation: ``q``
+    (int8, leaf-shaped) + per-row ``scale`` (float32). The placement
+    pipeline device_puts these and dequantizes in a jitted kernel on
+    device, so PCIe also carries the quantized bytes; ``dequant()`` is
+    the host fallback."""
+
+    __slots__ = ("q", "scale", "shape", "dtype", "cols")
+
+    def __init__(self, q, scale, shape, dtype, cols):
+        self.q = q
+        self.scale = scale
+        self.shape = shape
+        self.dtype = dtype
+        self.cols = cols
+
+    @property
+    def nbytes(self) -> int:
+        return self.q.nbytes + self.scale.nbytes
+
+    def dequant(self):
+        np = _np()
+        f = (self.q.reshape(-1, max(1, self.cols)).astype(np.float32)
+             * self.scale[:, None])
+        return f.astype(self.dtype).reshape(self.shape)
+
+
+# -------------------------------------------------------------- decoders
+class _RawDecoder:
+    """Fills the preallocated leaf buffer in place — the V2 twin of the
+    V1 unpacker's zero-extra-copy fill."""
+
+    timed = False
+
+    def __init__(self, shape, dtype):
+        np = _np()
+        self.arr = np.empty(shape, dtype=dtype)
+        self._buf = self.arr.reshape(-1).view(np.uint8).reshape(-1)
+        self._off = 0
+        self.buffered = self.arr.nbytes
+
+    def feed(self, mv) -> None:
+        np = _np()
+        n = len(mv)
+        self._buf[self._off:self._off + n] = np.frombuffer(mv, np.uint8)
+        self._off += n
+
+    def finish(self):
+        if self._off != len(self._buf):
+            raise ValueError(
+                f"leaf payload short: {self._off}/{len(self._buf)}")
+        return self.arr
+
+
+class _InflateDecoder:
+    """Streaming decompress straight into the preallocated leaf buffer —
+    a compressed leaf never exists fully inflated anywhere but its own
+    final array."""
+
+    timed = True
+
+    def __init__(self, shape, dtype, codec: str):
+        np = _np()
+        self.arr = np.empty(shape, dtype=dtype)
+        self._buf = self.arr.reshape(-1).view(np.uint8).reshape(-1)
+        self._off = 0
+        if codec == "zstd":
+            zs = _zstd()
+            if zs is None:
+                raise ValueError(
+                    "blob is zstd-framed but the optional 'zstandard' "
+                    "module is absent — pip install kubetorch-tpu[zstd]")
+            self._z = zs.ZstdDecompressor().decompressobj()
+        else:
+            self._z = zlib.decompressobj()
+        self.buffered = self.arr.nbytes
+
+    def feed(self, mv) -> None:
+        np = _np()
+        out = self._z.decompress(bytes(mv))
+        if out:
+            n = len(out)
+            if self._off + n > len(self._buf):
+                raise ValueError("compressed leaf inflates past its shape")
+            self._buf[self._off:self._off + n] = np.frombuffer(out, np.uint8)
+            self._off += n
+
+    def finish(self):
+        self.feed(b"")  # flush any buffered tail (no-op for zlib obj)
+        if self._off != len(self._buf):
+            raise ValueError(
+                f"compressed leaf short: {self._off}/{len(self._buf)}")
+        return self.arr
+
+
+class _Int8Decoder:
+    """Accumulates the [scales][q] payload; yields a host-dequantized
+    array, or the small :class:`QuantLeaf` when the caller dequantizes on
+    device."""
+
+    timed = True
+
+    def __init__(self, shape, dtype, cols: int, device_dequant: bool):
+        np = _np()
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.cols = max(1, int(cols))
+        size = 1
+        for d in self.shape:
+            size *= d
+        rows = size // self.cols
+        self._scale = np.empty(rows, dtype=np.float32)
+        self._q = np.empty(self.shape, dtype=np.int8)
+        self._sbuf = self._scale.view(np.uint8).reshape(-1)
+        self._qbuf = self._q.reshape(-1).view(np.uint8).reshape(-1)
+        self._off = 0
+        self._device = device_dequant
+        self.buffered = self._scale.nbytes + self._q.nbytes
+
+    def feed(self, mv) -> None:
+        np = _np()
+        off = 0
+        ns = len(self._sbuf)
+        while off < len(mv):
+            if self._off < ns:
+                take = min(ns - self._off, len(mv) - off)
+                self._sbuf[self._off:self._off + take] = np.frombuffer(
+                    mv[off:off + take], np.uint8)
+            else:
+                take = len(mv) - off
+                qo = self._off - ns
+                if qo + take > len(self._qbuf):
+                    raise ValueError("int8 leaf payload overruns its shape")
+                self._qbuf[qo:qo + take] = np.frombuffer(
+                    mv[off:off + take], np.uint8)
+            self._off += take
+            off += take
+
+    def finish(self):
+        if self._off != len(self._sbuf) + len(self._qbuf):
+            raise ValueError(
+                f"int8 leaf short: {self._off}/"
+                f"{len(self._sbuf) + len(self._qbuf)}")
+        leaf = QuantLeaf(self._q, self._scale, self.shape, self.dtype,
+                         self.cols)
+        return leaf if self._device else leaf.dequant()
+
+
+def make_decoder(spec: Dict[str, Any], dtype, device_dequant: bool = False):
+    """Decoder for one V2 leaf spec (``dtype`` pre-resolved by the caller
+    — name→np.dtype lives in device_transfer, next to the V1 path)."""
+    codec = spec.get("codec", "raw")
+    shape = tuple(spec["shape"])
+    if codec == "raw":
+        return _RawDecoder(shape, dtype)
+    if codec in ("zlib", "zstd"):
+        return _InflateDecoder(shape, dtype, codec)
+    if codec == "int8":
+        return _Int8Decoder(shape, dtype, spec.get("cols", 1),
+                            device_dequant)
+    raise ValueError(f"blob carries unknown leaf codec {codec!r}")
+
+
+# ------------------------------------------------------- V2 pack / header
+def build_header(treedef_str: str, metas: List[Dict[str, Any]],
+                 codec: str, digests: Optional[List[str]] = None) -> bytes:
+    header: Dict[str, Any] = {"treedef": treedef_str, "codec": codec,
+                              "leaves": metas}
+    if digests is not None:
+        header["digests"] = digests
+    head = msgpack.packb(header)
+    return MAGIC_V2 + len(head).to_bytes(8, "little") + head
+
+
+def parse_header(data) -> Tuple[Dict[str, Any], int]:
+    """(header dict, body offset) from a V2 blob prefix."""
+    mv = memoryview(data)
+    if bytes(mv[:len(MAGIC_V2)]) != MAGIC_V2:
+        raise ValueError("not a V2 packed-array buffer")
+    base = len(MAGIC_V2) + 8
+    head_len = int.from_bytes(mv[len(MAGIC_V2):base], "little")
+    return msgpack.unpackb(mv[base:base + head_len]), base + head_len
+
+
+def pack_stream(treedef_str: str, host_leaves, codecs: List[str],
+                digests: Optional[List[str]] = None,
+                record: Optional[Dict[str, Any]] = None,
+                codec_name: str = "raw") -> Iterable[bytes]:
+    """Generator of V2 wire chunks: header, then per-leaf
+    ``u64 enc | payload`` frames. ``record`` (reset per invocation, so a
+    retried publish re-records cleanly) captures the publish manifest:
+    header bytes/digest, per-leaf (offset, framed length), encode
+    seconds, and total length — everything the NEXT delta publish needs."""
+    metas = [leaf_meta(c, a) for c, a in zip(codecs, host_leaves)]
+    header = build_header(treedef_str, metas, codec_name, digests)
+    if record is not None:
+        record.clear()
+        record.update(header=header, frames=[], encode_s=0.0,
+                      hdr_digest=hashlib.blake2b(
+                          header, digest_size=8).hexdigest())
+    yield header
+    off = len(header)
+    for codec, arr in zip(codecs, host_leaves):
+        t0 = time.perf_counter()
+        chunks, enc = encode_leaf(codec, arr)
+        enc_s = time.perf_counter() - t0
+        yield enc.to_bytes(8, "little")
+        # memoryviews pass through UNCOPIED: the known-length publish
+        # path sendall()s them straight to the socket (the same zero-copy
+        # property the V1 fast path has); bytes.join on the local backend
+        # accepts them too
+        yield from chunks
+        if record is not None:
+            record["frames"].append((off, 8 + enc))
+            if codec != "raw":
+                record["encode_s"] += enc_s
+        off += 8 + enc
+    if record is not None:
+        record["total"] = off
+
+
+def packed_size(host_leaves, codecs: List[str],
+                header_len: int) -> Optional[int]:
+    """Exact V2 blob size when every codec is size-deterministic
+    (raw/int8) — lets the publish keep the raw Content-Length sendall
+    path; None when a compressor makes the size unknowable upfront (the
+    publish must then use chunked transfer-encoding — a declared length
+    may never lie about the encoded stream)."""
+    total = header_len
+    for codec, arr in zip(codecs, host_leaves):
+        enc = encoded_size(codec, arr)
+        if enc is None:
+            return None
+        total += 8 + enc
+    return total
+
+
+# ----------------------------------------------------------------- delta
+class DeltaMismatch(ValueError):
+    """The patch's named base is not the blob we hold — splicing would
+    fabricate a chimera; callers fall back to a full publish/fetch."""
+
+
+def build_delta(prev: Dict[str, Any], treedef_str: str, host_leaves,
+                codecs: List[str], digests: List[str]
+                ) -> Optional[Tuple[bytes, Dict[str, Any], Dict[str, Any]]]:
+    """Byte-level patch re-sending only changed leaves.
+
+    ``prev`` is the manifest :func:`pack_stream` recorded for the last
+    published version (hdr_digest/frames/digests/codecs/total). Returns
+    ``(delta_bytes, new_manifest, stats)``, or None when nothing can be
+    skipped (a full publish streams cheaper than a patch that repeats
+    every byte). Unchanged leaves become copy-from-base ops over their
+    whole frame; adjacent copies merge, so a frozen backbone is one op.
+    """
+    n = len(host_leaves)
+    if (len(prev.get("digests", ())) != n
+            or len(prev.get("frames", ())) != n
+            or len(prev.get("metas", ())) != n):
+        return None
+    metas = [leaf_meta(c, a) for c, a in zip(codecs, host_leaves)]
+    # unchanged = same bytes AND same shape/dtype/codec: a reshaped leaf
+    # with identical bytes must re-send — its base frame (e.g. int8 scale
+    # rows) was laid out for the OLD shape, and a blind copy would splice
+    # an unreadable frame into the store's canonical blob
+    unchanged = [i for i in range(n)
+                 if digests[i] == prev["digests"][i]
+                 and metas[i] == prev["metas"][i]]
+    if not unchanged:
+        return None
+    # memory guard: the patch materializes its data section, so when
+    # most bytes changed anyway a full STREAMED publish is strictly
+    # better than a near-full-size in-RAM patch (the O(chunk) bound is
+    # the whole point of the streaming path)
+    changed_est = sum(
+        (encoded_size(codecs[i], host_leaves[i])
+         or host_leaves[i].nbytes)
+        for i in range(n) if i not in set(unchanged))
+    if changed_est > max(1, prev.get("total", 0)) * 0.5:
+        return None
+    header = build_header(treedef_str, metas, prev.get("codec", "raw"),
+                          digests)
+    ops: List[List[int]] = [[0, len(header)]]
+    data: List[bytes] = [header]
+    frames: List[Tuple[int, int]] = []
+    off = len(header)
+    skip = set(unchanged)
+    sent = 0
+    encode_s = 0.0
+    for i, (codec, arr) in enumerate(zip(codecs, host_leaves)):
+        if i in skip:
+            poff, plen = prev["frames"][i]
+            last = ops[-1]
+            if last[0] == 1 and last[1] + last[2] == poff:
+                last[2] += plen
+            else:
+                ops.append([1, poff, plen])
+            framed = plen
+        else:
+            t0 = time.perf_counter()
+            chunks, enc = encode_leaf(codec, arr)
+            encode_s += time.perf_counter() - t0
+            blob = enc.to_bytes(8, "little") + b"".join(
+                bytes(c) if isinstance(c, memoryview) else c
+                for c in chunks)
+            last = ops[-1]
+            if last[0] == 0:
+                last[1] += len(blob)
+            else:
+                ops.append([0, len(blob)])
+            data.append(blob)
+            sent += 1
+            framed = len(blob)
+        frames.append((off, framed))
+        off += framed
+    plan = {"base_hdr_digest": prev["hdr_digest"],
+            "base_len": prev["total"], "new_len": off, "ops": ops,
+            "leaves_total": n, "leaves_sent": sent}
+    plan_b = msgpack.packb(plan)
+    delta = (MAGIC_DELTA + len(plan_b).to_bytes(8, "little") + plan_b
+             + b"".join(data))
+    manifest = {"hdr_digest": hashlib.blake2b(
+                    header, digest_size=8).hexdigest(),
+                "total": off, "digests": digests, "codecs": codecs,
+                "metas": metas, "frames": frames,
+                "codec": prev.get("codec", "raw")}
+    stats = {"leaves_total": n, "leaves_sent": sent,
+             "leaves_skipped": n - sent, "wire_bytes": len(delta),
+             "full_bytes": off, "encode_s": encode_s}
+    return delta, manifest, stats
+
+
+def parse_delta_plan(data) -> Tuple[Dict[str, Any], int]:
+    """(plan dict, data-section offset) from a delta blob prefix."""
+    mv = memoryview(data)
+    if bytes(mv[:len(MAGIC_DELTA)]) != MAGIC_DELTA:
+        raise ValueError("not a delta patch")
+    base = len(MAGIC_DELTA) + 8
+    plan_len = int.from_bytes(mv[len(MAGIC_DELTA):base], "little")
+    return msgpack.unpackb(mv[base:base + plan_len]), base + plan_len
+
+
+def blob_header_digest(path) -> Optional[str]:
+    """Digest over a stored packed blob's header prefix (magic + length +
+    msgpack header) — the identity a delta patch names its base by. The
+    header embeds every leaf's digest when delta-tracked, so matching
+    header digests imply matching content. None for non-packed files."""
+    try:
+        with open(path, "rb") as fh:
+            magic = fh.read(8)
+            if magic not in (MAGIC_V2, b"KTARRV1\x00"):
+                return None
+            raw_len = fh.read(8)
+            head_len = int.from_bytes(raw_len, "little")
+            if len(raw_len) != 8 or head_len > (512 << 20):
+                return None
+            head = fh.read(head_len)
+            if len(head) != head_len:
+                return None
+    except OSError:
+        return None
+    return hashlib.blake2b(magic + raw_len + head,
+                           digest_size=8).hexdigest()
+
+
+def splice_delta(delta, base_path, out_path) -> Dict[str, Any]:
+    """Apply a delta patch to ``base_path``, writing the full new blob at
+    ``out_path``; returns the plan. ``delta`` is patch bytes or a path.
+    Raises :class:`DeltaMismatch` when the base on disk is not the one
+    the patch names (header-digest + length chain), ValueError on a
+    corrupt patch. Pure byte ops — no array decode, safe on the store
+    server's executor."""
+    if isinstance(delta, (str, Path)):
+        delta = Path(delta).read_bytes()
+    mv = memoryview(delta)
+    plan, data_off = parse_delta_plan(mv)
+    base_path = Path(base_path)
+    try:
+        base_len = base_path.stat().st_size
+    except OSError:
+        raise DeltaMismatch(f"delta base missing: {base_path}") from None
+    if base_len != plan["base_len"]:
+        raise DeltaMismatch(
+            f"delta base is {base_len} bytes, patch expects "
+            f"{plan['base_len']}")
+    have = blob_header_digest(base_path)
+    if have != plan["base_hdr_digest"]:
+        raise DeltaMismatch(
+            f"delta base header digest {have} != patch's "
+            f"{plan['base_hdr_digest']}")
+    pos = data_off
+    with open(base_path, "rb") as bf, open(out_path, "wb") as of:
+        for op in plan["ops"]:
+            if op[0] == 0:
+                n = op[1]
+                if pos + n > len(mv):
+                    raise ValueError("delta data section short")
+                of.write(mv[pos:pos + n])
+                pos += n
+            elif op[0] == 1:
+                off, n = op[1], op[2]
+                if off + n > base_len:
+                    raise ValueError("delta copy op past base end")
+                bf.seek(off)
+                left = n
+                while left:
+                    chunk = bf.read(min(left, default_chunk_bytes()))
+                    if not chunk:
+                        raise ValueError("short read splicing base")
+                    of.write(chunk)
+                    left -= len(chunk)
+            else:
+                raise ValueError(f"unknown delta op {op!r}")
+    out_len = Path(out_path).stat().st_size
+    if out_len != plan["new_len"]:
+        raise ValueError(
+            f"splice produced {out_len} bytes, plan says {plan['new_len']}")
+    return plan

@@ -45,6 +45,29 @@ def get(key: str, dest: Optional[Union[str, Path]] = None, **kwargs) -> Any:
     return _client().get_object(key, **kwargs)
 
 
+def put_arrays(key: str, tree: Any, codec: Optional[str] = None,
+               delta: Optional[bool] = None) -> str:
+    """Publish a pytree of arrays under ``key`` through the host-staged
+    device-transfer path. ``codec`` picks the wire codec (``raw`` |
+    ``zlib`` | ``zstd`` | ``int8`` per-row quantization; default
+    ``KT_WIRE_CODEC``); ``delta=True`` re-sends only leaves whose content
+    changed since this process's last publish of ``key`` (default
+    ``KT_WIRE_DELTA``). See ``data_store/device_transfer.put_arrays``."""
+    from kubetorch_tpu.data_store.device_transfer import put_arrays as _pa
+
+    return _pa(key, tree, codec=codec, delta=delta)
+
+
+def get_arrays(key: str, template: Any = None, **kwargs) -> Any:
+    """Fetch a published array pytree (streamed, pipelined onto devices
+    via ``shardings=``; ``delta=True`` splices unchanged leaves from the
+    local restore/peer cache). See
+    ``data_store/device_transfer.get_arrays`` for the knobs."""
+    from kubetorch_tpu.data_store.device_transfer import get_arrays as _ga
+
+    return _ga(key, template=template, **kwargs)
+
+
 def ls(prefix: str = "", **kwargs) -> List[dict]:
     return _client().list_keys(prefix, **kwargs)
 

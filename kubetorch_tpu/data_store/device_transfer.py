@@ -17,16 +17,22 @@ fetch — the async-GRPO pattern); steady-state checkpointing should prefer
 
 from __future__ import annotations
 
+import functools
 import io
+import os
 import queue
 import threading
 import time
+from pathlib import Path
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import msgpack
 import numpy as np
 
+from kubetorch_tpu.data_store import codec as codec_mod
 from kubetorch_tpu.data_store import commands as store
+from kubetorch_tpu.data_store.types import BLOB_DELTA_SUFFIX
+from kubetorch_tpu.exceptions import DataStoreError
 
 _MAGIC = b"KTARRV1\x00"
 
@@ -36,11 +42,29 @@ _MAGIC = b"KTARRV1\x00"
 # restore: the bench and the metrics push both want "the last one".
 _LAST_RESTORE: Dict[str, float] = {}
 
+# Ditto for the most recent put_arrays publish: wire vs raw bytes, encode
+# time, and the delta-skip decomposition.
+_LAST_PUBLISH: Dict[str, float] = {}
+
+# key → manifest of the last published blob (header digest, per-leaf
+# digests/codecs/frame offsets) — what a delta publish diffs against.
+# Process-local by design: the publisher of an RL weight-sync loop is one
+# long-lived process, and a manifest the STORE disagrees with just costs
+# one 409 + full re-publish (self-healing).
+_PUBLISH_MANIFESTS: Dict[str, dict] = {}
+
 
 def last_restore_stats() -> Dict[str, float]:
     """Decomposition of the most recent streamed restore: wall/fetch/place
-    seconds, bytes, leaves, and the fetch/placement overlap ratio."""
+    seconds, bytes (wire vs decoded), codec/dequant seconds, leaves, and
+    the fetch/placement overlap ratio."""
     return dict(_LAST_RESTORE)
+
+
+def last_publish_stats() -> Dict[str, float]:
+    """Decomposition of the most recent put_arrays publish: wire vs raw
+    bytes, encode seconds, and (for delta publishes) leaves skipped."""
+    return dict(_LAST_PUBLISH)
 
 
 def _dtype_from_name(name: str) -> np.dtype:
@@ -143,23 +167,39 @@ def _host_leaves(tree: Any):
     return device_get_chunked(leaves), treedef
 
 
-def pack_arrays(tree: Any) -> bytes:
-    """Pack a pytree of (jax/numpy) arrays into one buffer."""
+def pack_arrays(tree: Any, codec: Optional[str] = None) -> bytes:
+    """Pack a pytree of (jax/numpy) arrays into one buffer. ``codec``
+    (None → ``KT_WIRE_CODEC`` → ``raw``) selects the wire codec; ``raw``
+    emits the V1 format byte-identically to always, any other codec emits
+    the framed V2 format (``data_store/codec.py``)."""
+    codec = codec_mod.resolve_codec(codec)
     host_leaves, treedef = _host_leaves(tree)
-    buf = io.BytesIO()
-    buf.write(_pack_header(host_leaves, treedef))
-    for array in host_leaves:
-        buf.write(np.ascontiguousarray(array).tobytes())
-    return buf.getvalue()
+    if codec == "raw":
+        buf = io.BytesIO()
+        buf.write(_pack_header(host_leaves, treedef))
+        for array in host_leaves:
+            buf.write(np.ascontiguousarray(array).tobytes())
+        return buf.getvalue()
+    codecs = [codec_mod.leaf_codec(codec, a) for a in host_leaves]
+    return b"".join(codec_mod.pack_stream(str(treedef), host_leaves,
+                                          codecs, codec_name=codec))
 
 
-def iter_packed(tree: Any, chunk: int = 8 << 20):
+def iter_packed(tree: Any, chunk: int = 8 << 20,
+                codec: Optional[str] = None):
     """Yield the packed form in chunks without materializing one giant
-    buffer — a multi-GB param tree streams straight onto the wire."""
+    buffer — a multi-GB param tree streams straight onto the wire (peak
+    memory O(one encoded leaf) for compressing codecs)."""
+    codec = codec_mod.resolve_codec(codec)
     host_leaves, treedef = _host_leaves(tree)
-    yield _pack_header(host_leaves, treedef)
-    for block in _iter_leaf_bytes(host_leaves, chunk):
-        yield bytes(block)
+    if codec == "raw":
+        yield _pack_header(host_leaves, treedef)
+        for block in _iter_leaf_bytes(host_leaves, chunk):
+            yield bytes(block)
+        return
+    codecs = [codec_mod.leaf_codec(codec, a) for a in host_leaves]
+    yield from codec_mod.pack_stream(str(treedef), host_leaves, codecs,
+                                     codec_name=codec)
 
 
 def _iter_leaf_bytes(host_leaves, chunk: int = 32 << 20):
@@ -184,32 +224,73 @@ def unpack_arrays(data: bytes, template: Optional[Any] = None,
     each leaf into its own freshly-owned array so ``data`` is collectable
     the moment this returns — what :func:`get_arrays` uses on its blocking
     fallback (and what the streaming path gets for free, since streamed
-    leaves are assembled into owned buffers, never views)."""
+    leaves are assembled into owned buffers, never views).
+
+    Both wire formats decode: V1 (uncodec'd) and codec-framed V2, where
+    non-raw leaves always come back as owned arrays (decompressed /
+    host-dequantized) regardless of ``copy``."""
     import jax
 
-    if not bytes(data[:len(_MAGIC)]) == _MAGIC:
+    head = bytes(data[:len(_MAGIC)])
+    if head == codec_mod.MAGIC_V2:
+        leaves = _unpack_v2(data, copy)
+    elif head == _MAGIC:
+        # memoryview slices: bytes slicing would COPY each multi-GB leaf
+        mv = memoryview(data)
+        offset = len(_MAGIC)
+        head_len = int.from_bytes(mv[offset:offset + 8], "little")
+        offset += 8
+        header = msgpack.unpackb(mv[offset:offset + head_len])
+        offset += head_len
+        leaves = []
+        for spec in header["leaves"]:
+            dtype = _dtype_from_name(spec["dtype"])
+            count = int(np.prod(spec["shape"])) if spec["shape"] else 1
+            nbytes = count * dtype.itemsize
+            array = np.frombuffer(
+                mv[offset:offset + nbytes],
+                dtype=dtype).reshape(spec["shape"])
+            if copy:
+                array = np.array(array)  # owns its memory; frees the blob
+            leaves.append(array)
+            offset += nbytes
+    else:
         raise ValueError("not a packed-array buffer")
-    # memoryview slices: bytes slicing would COPY each multi-GB leaf
-    mv = memoryview(data)
-    offset = len(_MAGIC)
-    head_len = int.from_bytes(mv[offset:offset + 8], "little")
-    offset += 8
-    header = msgpack.unpackb(mv[offset:offset + head_len])
-    offset += head_len
-    leaves = []
-    for spec in header["leaves"]:
-        dtype = _dtype_from_name(spec["dtype"])
-        count = int(np.prod(spec["shape"])) if spec["shape"] else 1
-        nbytes = count * dtype.itemsize
-        array = np.frombuffer(
-            mv[offset:offset + nbytes], dtype=dtype).reshape(spec["shape"])
-        if copy:
-            array = np.array(array)  # owns its memory; releases the blob
-        leaves.append(array)
-        offset += nbytes
     if template is not None:
         treedef = jax.tree.structure(template)
         return jax.tree.unflatten(treedef, leaves)
+    return leaves
+
+
+def _unpack_v2(data, copy: bool) -> List[np.ndarray]:
+    """Decode a codec-framed V2 blob to host leaves (host dequant)."""
+    mv = memoryview(data)
+    header, offset = codec_mod.parse_header(mv)
+    leaves = []
+    for spec in header["leaves"]:
+        dtype = _dtype_from_name(spec["dtype"])
+        enc = int.from_bytes(mv[offset:offset + 8], "little")
+        offset += 8
+        name = spec.get("codec", "raw")
+        if name == "raw":
+            count = int(np.prod(spec["shape"])) if spec["shape"] else 1
+            if enc != count * dtype.itemsize:
+                raise ValueError(
+                    f"raw leaf frame {enc} bytes != shape's "
+                    f"{count * dtype.itemsize}")
+            array = np.frombuffer(
+                mv[offset:offset + enc], dtype=dtype).reshape(spec["shape"])
+            if copy:
+                array = np.array(array)
+        else:
+            dec = codec_mod.make_decoder(spec, dtype)
+            dec.feed(mv[offset:offset + enc])
+            array = dec.finish()
+        leaves.append(array)
+        offset += enc
+    if offset != len(mv):
+        raise ValueError(
+            f"blob carries {len(mv) - offset} bytes past the last leaf")
     return leaves
 
 
@@ -224,9 +305,17 @@ class StreamUnpacker:
     the only other storage is the pre-header accumulation buffer plus
     whatever tail of the current chunk hasn't been consumed yet —
     the whole blob is never materialized.
+
+    Speaks both wire formats: V1 (uncodec'd) and codec-framed V2, whose
+    leaves decode incrementally (zlib/zstd inflate straight into the leaf
+    buffer; int8 accumulates the small scales+q representation).
+    ``device_dequant=True`` hands int8 leaves back as
+    :class:`~kubetorch_tpu.data_store.codec.QuantLeaf` so the placement
+    pipeline can ship the SMALL form over PCIe and dequantize on device;
+    the default dequantizes on host and always yields ndarrays.
     """
 
-    def __init__(self):
+    def __init__(self, device_dequant: bool = False):
         self._pending = bytearray()   # unparsed bytes before the header ends
         self.header: Optional[dict] = None
         self._specs: List[Tuple[tuple, np.dtype, int]] = []
@@ -236,19 +325,38 @@ class StreamUnpacker:
         self._cur_off = 0
         self.bytes_fed = 0
         self.peak_buffered = 0  # max(pending + current-leaf allocation)
+        # V2 state
+        self._v2 = False
+        self._device_dequant = device_dequant
+        self._leafspecs: List[Tuple[dict, np.dtype]] = []
+        self._prefix = bytearray()     # partial u64 frame-length prefix
+        self._dec = None               # active leaf decoder
+        self._dec_left = 0
+        self.decode_s = 0.0            # time in non-raw codec decoders
+        self.raw_bytes = 0             # decoded (pre-codec) payload total
 
     @property
     def num_leaves(self) -> Optional[int]:
-        return len(self._specs) if self.header is not None else None
+        if self.header is None:
+            return None
+        return len(self._leafspecs) if self._v2 else len(self._specs)
 
     @property
     def complete(self) -> bool:
-        return (self.header is not None
-                and self._leaf_ix >= len(self._specs)
+        if self.header is None:
+            return False
+        if self._v2:
+            return (self._leaf_ix >= len(self._leafspecs)
+                    and self._dec is None and not self._prefix
+                    and not self._pending)
+        return (self._leaf_ix >= len(self._specs)
                 and not self._pending)
 
     def _note_buffered(self):
-        cur = self._cur.nbytes if self._cur is not None else 0
+        if self._v2:
+            cur = self._dec.buffered if self._dec is not None else 0
+        else:
+            cur = self._cur.nbytes if self._cur is not None else 0
         self.peak_buffered = max(self.peak_buffered,
                                  len(self._pending) + cur)
 
@@ -271,26 +379,37 @@ class StreamUnpacker:
 
     def _parse_header(self) -> bool:
         base = len(_MAGIC) + 8
+        if len(self._pending) < len(_MAGIC):
+            return False
+        magic = bytes(self._pending[:len(_MAGIC)])
+        if magic not in (_MAGIC, codec_mod.MAGIC_V2):
+            raise ValueError("not a packed-array stream")
         if len(self._pending) < base:
             return False
-        if bytes(self._pending[:len(_MAGIC)]) != _MAGIC:
-            raise ValueError("not a packed-array stream")
         head_len = int.from_bytes(self._pending[len(_MAGIC):base], "little")
         if len(self._pending) < base + head_len:
             return False
         self.header = msgpack.unpackb(bytes(
             self._pending[base:base + head_len]))
+        self._v2 = magic == codec_mod.MAGIC_V2
         for spec in self.header["leaves"]:
             dtype = _dtype_from_name(spec["dtype"])
             count = int(np.prod(spec["shape"])) if spec["shape"] else 1
-            self._specs.append(
-                (tuple(spec["shape"]), dtype, count * dtype.itemsize))
+            self.raw_bytes += count * dtype.itemsize
+            if self._v2:
+                self._leafspecs.append((spec, dtype))
+            else:
+                self._specs.append(
+                    (tuple(spec["shape"]), dtype, count * dtype.itemsize))
         del self._pending[:base + head_len]
         return True
 
     def feed(self, data) -> List[Tuple[int, np.ndarray]]:
         """Consume one chunk; return the ``(leaf_index, array)`` pairs that
-        completed inside it (possibly none, possibly several)."""
+        completed inside it (possibly none, possibly several). In
+        ``device_dequant`` mode int8-coded leaves arrive as
+        :class:`~kubetorch_tpu.data_store.codec.QuantLeaf` instead of
+        ndarrays."""
         mv = memoryview(data)
         if mv.ndim != 1 or mv.itemsize != 1:
             mv = mv.cast("B")
@@ -302,10 +421,14 @@ class StreamUnpacker:
             self._note_buffered()
             if not self._parse_header():
                 return out
-            out.extend(self._start_leaf())
+            if not self._v2:
+                out.extend(self._start_leaf())
             # the header tail may carry leaf bytes: drain pending below
             mv = memoryview(bytes(self._pending))
             self._pending.clear()
+        if self._v2:
+            self._feed_v2(mv, out)
+            return out
         while off < len(mv):
             if self._cur is None:
                 if self._leaf_ix >= len(self._specs):
@@ -328,10 +451,67 @@ class StreamUnpacker:
             self._note_buffered()
         return out
 
+    def _feed_v2(self, mv, out: List[Tuple[int, Any]]) -> None:
+        """Frame loop for the codec'd format: ``u64 enc | payload`` per
+        leaf, payload bytes fed straight to the leaf's decoder."""
+        off = 0
+        n = len(self._leafspecs)
+        while off < len(mv):
+            if self._dec is None:
+                if self._leaf_ix >= n:
+                    raise ValueError(
+                        f"stream carries {len(mv) - off} bytes past the "
+                        f"declared leaves")
+                take = min(8 - len(self._prefix), len(mv) - off)
+                self._prefix += mv[off:off + take]
+                off += take
+                if len(self._prefix) < 8:
+                    return
+                enc = int.from_bytes(self._prefix, "little")
+                self._prefix.clear()
+                spec, dtype = self._leafspecs[self._leaf_ix]
+                self._dec = codec_mod.make_decoder(
+                    spec, dtype, self._device_dequant)
+                self._dec_left = enc
+                self._note_buffered()
+                if enc == 0:
+                    out.append(self._finish_leaf())
+                continue
+            take = min(self._dec_left, len(mv) - off)
+            if self._dec.timed:
+                t0 = time.perf_counter()
+                self._dec.feed(mv[off:off + take])
+                self.decode_s += time.perf_counter() - t0
+            else:
+                self._dec.feed(mv[off:off + take])
+            off += take
+            self._dec_left -= take
+            if self._dec_left == 0:
+                out.append(self._finish_leaf())
+
+    def _finish_leaf(self) -> Tuple[int, Any]:
+        if self._dec.timed:
+            t0 = time.perf_counter()
+            item = self._dec.finish()
+            self.decode_s += time.perf_counter() - t0
+        else:
+            item = self._dec.finish()
+        ix = self._leaf_ix
+        self._leaf_ix += 1
+        self._dec = None
+        return ix, item
+
     def finish(self):
         """Raise unless every declared leaf arrived in full."""
         if self.header is None:
             raise ValueError("stream ended before the header completed")
+        if self._v2:
+            if (self._dec is not None or self._prefix
+                    or self._leaf_ix < len(self._leafspecs)):
+                raise ValueError(
+                    f"stream ended at leaf {self._leaf_ix}/"
+                    f"{len(self._leafspecs)} (short read)")
+            return
         if self._cur is not None or self._leaf_ix < len(self._specs):
             raise ValueError(
                 f"stream ended at leaf {self._leaf_ix}/"
@@ -350,30 +530,157 @@ def iter_unpack_arrays(chunks: Iterable) -> Iterable[Tuple[int, np.ndarray]]:
     unpacker.finish()
 
 
-def put_arrays(key: str, tree: Any) -> str:
-    """Publish a pytree of arrays (params, state dicts) under ``key``."""
+def _record_publish(stats: Dict[str, float]) -> None:
+    _LAST_PUBLISH.clear()
+    _LAST_PUBLISH.update(stats)
+    try:
+        from kubetorch_tpu.observability.prometheus import record_wire
+
+        record_wire({
+            "tx_bytes": stats.get("wire_bytes", 0),
+            "tx_raw_bytes": stats.get("raw_bytes", 0),
+            "encode_s": stats.get("encode_s", 0.0),
+            "delta_publish": stats.get("delta", 0.0),
+            "delta_leaves_skipped": stats.get("leaves_skipped", 0),
+            "delta_fallback": stats.get("delta_fallback", 0.0),
+        })
+    except Exception:
+        pass  # metrics must never fail a publish
+
+
+def put_arrays(key: str, tree: Any, codec: Optional[str] = None,
+               delta: Optional[bool] = None) -> str:
+    """Publish a pytree of arrays (params, state dicts) under ``key``.
+
+    ``codec`` (None → ``KT_WIRE_CODEC`` → ``raw``) picks the wire codec:
+    ``raw`` ships the V1 format unchanged; ``zlib``/``zstd`` compress
+    losslessly (payload size unknown upfront → the upload switches to
+    chunked transfer-encoding so Content-Length can never lie about the
+    encoded stream); ``int8`` quantizes float leaves per row (~2-4× fewer
+    bytes, everything else stays raw/bit-exact).
+
+    ``delta`` (None → ``KT_WIRE_DELTA`` → off) enables **delta publish**:
+    per-leaf content digests are kept for the last published version of
+    ``key`` and the next publish ships only changed leaves as a byte
+    patch the store splices against its current blob — a LoRA-only or
+    frozen-backbone update is kilobytes, not gigabytes. A store that no
+    longer holds the expected base (404/409) silently degrades to a full
+    publish; :func:`last_publish_stats` reports the decomposition.
+    """
     from kubetorch_tpu.data_store.client import DataStoreClient
 
+    codec = codec_mod.resolve_codec(codec)
+    delta = codec_mod.delta_enabled(delta)
     backend = DataStoreClient.default()._backend()
-    if not hasattr(backend, "put_blob_stream"):
-        return backend.put_blob(key, pack_arrays(tree))
+    t_start = time.perf_counter()
     host_leaves, treedef = _host_leaves(tree)
-    header = _pack_header(host_leaves, treedef)
-    total = len(header) + sum(a.nbytes for a in host_leaves)
+    raw_bytes = sum(a.nbytes for a in host_leaves)
+
+    if codec == "raw" and not delta:
+        # the V1 fast path, byte-identical to always; an untracked
+        # publish breaks any recorded delta chain for the key
+        _PUBLISH_MANIFESTS.pop(key, None)
+        header = _pack_header(host_leaves, treedef)
+        total = len(header) + raw_bytes
+        if not hasattr(backend, "put_blob_stream"):
+            buf = io.BytesIO()
+            buf.write(header)
+            for array in host_leaves:
+                buf.write(np.ascontiguousarray(array).tobytes())
+            backend.put_blob(key, buf.getvalue())
+        else:
+            def chunks():
+                # A GENERATOR FUNCTION, not a generator: put_blob_stream
+                # invokes the factory once per retry attempt, so every
+                # attempt re-yields the header before the leaf bytes.
+                # Handing it a single exhausted generator would make a
+                # retried publish stream leaf bytes with no header (or
+                # nothing at all) — the backend guards against that.
+                yield header
+                yield from _iter_leaf_bytes(host_leaves)
+
+            # known total length → the store's raw sendall path: leaf
+            # bytes go memoryview→socket with zero copies (publish used
+            # to trail raw blob-put by ~28% purely on pack/frame copies)
+            backend.put_blob_stream(key, chunks, length=total)
+        _record_publish({
+            "wall_s": time.perf_counter() - t_start,
+            "wire_bytes": total, "raw_bytes": raw_bytes,
+            "encode_s": 0.0, "leaves": len(host_leaves),
+            "leaves_sent": len(host_leaves), "leaves_skipped": 0,
+            "delta": 0.0, "codec": 0.0})
+        return key
+
+    codecs = [codec_mod.leaf_codec(codec, a) for a in host_leaves]
+    digests = ([codec_mod.leaf_digest(a) for a in host_leaves]
+               if delta else None)
+    treedef_str = str(treedef)
+    delta_fallback = 0.0
+    prev = _PUBLISH_MANIFESTS.get(key) if delta else None
+    if (prev is not None and prev.get("treedef") == treedef_str
+            and hasattr(backend, "put_blob_delta")):
+        built = codec_mod.build_delta(prev, treedef_str, host_leaves,
+                                      codecs, digests)
+        if built is not None:
+            delta_blob, manifest, stats = built
+            try:
+                backend.put_blob_delta(key, delta_blob)
+            except DataStoreError as exc:
+                # base drifted under us (store restart, concurrent
+                # publisher, retention sweep): full publish heals the
+                # chain. Anything else is a real error.
+                if getattr(exc, "status", None) not in (404, 409):
+                    raise
+                delta_fallback = 1.0
+            else:
+                manifest["treedef"] = treedef_str
+                _PUBLISH_MANIFESTS[key] = manifest
+                _record_publish({
+                    "wall_s": time.perf_counter() - t_start,
+                    "wire_bytes": stats["wire_bytes"],
+                    "raw_bytes": raw_bytes,
+                    "encode_s": stats["encode_s"],
+                    "leaves": stats["leaves_total"],
+                    "leaves_sent": stats["leaves_sent"],
+                    "leaves_skipped": stats["leaves_skipped"],
+                    "delta": 1.0, "codec": 1.0})
+                return key
+
+    record: Dict[str, Any] = {}
 
     def chunks():
-        # A GENERATOR FUNCTION, not a generator: put_blob_stream invokes
-        # the factory once per retry attempt, so every attempt re-yields
-        # the header before the leaf bytes. Handing it a single exhausted
-        # generator would make a retried publish stream leaf bytes with no
-        # header (or nothing at all) — the backend guards against that.
-        yield header
-        yield from _iter_leaf_bytes(host_leaves)
+        # fresh generator per retry attempt; ``record`` is reset inside
+        # pack_stream, so a retried publish re-records its manifest
+        yield from codec_mod.pack_stream(
+            treedef_str, host_leaves, codecs, digests=digests,
+            record=record, codec_name=codec)
 
-    # known total length → the store's raw sendall path: leaf bytes go
-    # memoryview→socket with zero copies (publish used to trail raw
-    # blob-put by ~28% purely on pack/frame copies)
-    return backend.put_blob_stream(key, chunks, length=total)
+    metas = [codec_mod.leaf_meta(c, a)
+             for c, a in zip(codecs, host_leaves)]
+    if hasattr(backend, "put_blob_stream"):
+        header_len = len(codec_mod.build_header(
+            treedef_str, metas, codec, digests))
+        # size-deterministic codecs (raw/int8) keep the zero-copy
+        # Content-Length sendall path; compressors MUST go chunked — a
+        # declared length may never disagree with the encoded stream
+        total = codec_mod.packed_size(host_leaves, codecs, header_len)
+        backend.put_blob_stream(key, chunks, length=total)
+    else:
+        backend.put_blob(key, b"".join(chunks()))
+    if delta:
+        _PUBLISH_MANIFESTS[key] = {
+            "hdr_digest": record["hdr_digest"], "total": record["total"],
+            "digests": digests, "codecs": codecs, "metas": metas,
+            "frames": record["frames"], "codec": codec,
+            "treedef": treedef_str}
+    _record_publish({
+        "wall_s": time.perf_counter() - t_start,
+        "wire_bytes": record.get("total", 0), "raw_bytes": raw_bytes,
+        "encode_s": record.get("encode_s", 0.0),
+        "leaves": len(host_leaves), "leaves_sent": len(host_leaves),
+        "leaves_skipped": 0, "delta": 0.0,
+        "delta_fallback": delta_fallback, "codec": 1.0})
+    return key
 
 
 class _PlacementPipeline:
@@ -393,6 +700,7 @@ class _PlacementPipeline:
         self.queue: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self.error: Optional[BaseException] = None
         self.place_s = 0.0
+        self.dequant_s = 0.0
         self.leaves_placed = 0
         self.bytes_placed = 0
         self._thread = threading.Thread(
@@ -408,16 +716,32 @@ class _PlacementPipeline:
                 return
             if self.error is not None:
                 continue  # drain so the producer never blocks forever
-            idxs, arrays, sharding = item
+            idxs, arrays, sharding, scale_sh = item
             t0 = time.perf_counter()
             try:
-                placed = jax.device_put(arrays, sharding)
-                # block HERE, on the pipeline thread: device_put returns
-                # before the copy lands, so without this the next batch's
-                # host buffers could be freed/reused mid-transfer and
-                # place_s would measure dispatch, not transfer. The main
-                # thread keeps draining the wire regardless.
-                jax.block_until_ready(placed)
+                if scale_sh is not None:
+                    # int8-coded batch: ship the SMALL representation over
+                    # the host→device link (q leaf-shaped + per-row
+                    # scales), dequantize in a jitted kernel on device —
+                    # PCIe carries ~1/4 the bytes of the bf16/f32 leaves
+                    qs = jax.device_put([l.q for l in arrays], sharding)
+                    ss = jax.device_put([l.scale for l in arrays],
+                                        scale_sh)
+                    jax.block_until_ready((qs, ss))
+                    t1 = time.perf_counter()
+                    placed = [
+                        _dequant_fn(l.dtype.name, sharding)(q, s)
+                        for l, q, s in zip(arrays, qs, ss)]
+                    jax.block_until_ready(placed)
+                    self.dequant_s += time.perf_counter() - t1
+                else:
+                    placed = jax.device_put(arrays, sharding)
+                    # block HERE, on the pipeline thread: device_put
+                    # returns before the copy lands, so without this the
+                    # next batch's host buffers could be freed/reused
+                    # mid-transfer and place_s would measure dispatch, not
+                    # transfer. The main thread keeps draining the wire.
+                    jax.block_until_ready(placed)
             except BaseException as exc:  # surfaced in close()/submit()
                 self.error = exc
                 continue
@@ -427,10 +751,11 @@ class _PlacementPipeline:
             self.leaves_placed += len(idxs)
             self.bytes_placed += sum(a.nbytes for a in arrays)
 
-    def submit(self, idxs: List[int], arrays: List[np.ndarray], sharding):
+    def submit(self, idxs: List[int], arrays: List, sharding,
+               scale_sh=None):
         if self.error is not None:
             raise self.error
-        self.queue.put((idxs, arrays, sharding))
+        self.queue.put((idxs, arrays, sharding, scale_sh))
 
     def close(self):
         self.queue.put(None)
@@ -469,45 +794,107 @@ def _sharding_group_key(dtype: np.dtype, sharding) -> tuple:
         return (dtype.name, id(sharding))
 
 
+@functools.lru_cache(maxsize=None)
+def _dequant_fn(dtype_name: str, sharding=None):
+    """Jitted on-device dequant for int8-coded leaves: q (leaf-shaped
+    int8) × per-row float32 scale → target dtype. One compile per
+    (dtype, sharding, shape) — a param tree has a handful of shapes,
+    amortized across every weight-sync round. ``out_shardings`` pins the
+    result to the CALLER'S requested layout: without it the compiler
+    picks, and a layout that differs from ``get_arrays``' contract would
+    cost a silent reshard in the consumer's jitted step every round."""
+    import jax
+    import jax.numpy as jnp
+
+    dt = _dtype_from_name(dtype_name)
+
+    def f(q, s):
+        cols = q.shape[-1] if q.ndim else 1
+        qr = q.reshape(-1, cols).astype(jnp.float32) * s[:, None]
+        return qr.astype(dt).reshape(q.shape)
+
+    if sharding is not None:
+        try:
+            return jax.jit(f, out_shardings=sharding)
+        except TypeError:  # very old jax: fall back to compiler choice
+            pass
+    return jax.jit(f)
+
+
+def _scale_sharding(sharding):
+    """Sharding for an int8 leaf's per-row scales (shape differs from the
+    leaf's): reuse a SingleDeviceSharding as-is, replicate over a
+    NamedSharding's mesh; None → the leaf host-dequantizes instead."""
+    try:
+        import jax
+
+        if isinstance(sharding, jax.sharding.SingleDeviceSharding):
+            return sharding
+        if isinstance(sharding, jax.sharding.NamedSharding):
+            return jax.sharding.NamedSharding(
+                sharding.mesh, jax.sharding.PartitionSpec())
+    except Exception:
+        pass
+    return None
+
+
 def _streamed_restore(chunks: Iterable, template: Optional[Any],
                       shardings: Optional[Any],
                       batch_bytes: int = 64 << 20,
-                      pipeline_depth: int = 2) -> Any:
+                      pipeline_depth: int = 2,
+                      wire_bytes: Optional[int] = None,
+                      pre_fetch_s: float = 0.0,
+                      delta_hit: Optional[bool] = None) -> Any:
     """Assemble leaves from a chunk stream and place them as they land.
 
     Completed leaves batch per (dtype, sharding) up to ``batch_bytes``;
     each full batch goes to the placement thread while the wire keeps
     filling the next — fetch and host→device transfer overlap instead of
-    summing. Peak host memory is O(chunk + largest leaf +
-    pipeline_depth × batch_bytes), never O(total blob).
+    summing. int8-coded leaves stay in their small (q, scale) form all
+    the way onto the device (jitted dequant there); everything else
+    arrives as decoded host arrays. Peak host memory is O(chunk + largest
+    leaf + pipeline_depth × batch_bytes), never O(total blob).
+
+    ``wire_bytes``/``pre_fetch_s``/``delta_hit``: when the chunk stream
+    reads a locally spliced/teed file rather than the wire itself, the
+    caller passes what the network actually carried so the stats stay
+    honest.
     """
     import jax
 
     t_start = time.perf_counter()
-    unpacker = StreamUnpacker()
+    unpacker = StreamUnpacker(device_dequant=shardings is not None)
     out: List[Any] = []
     flat_sh: Optional[List[Any]] = None
     pipeline: Optional[_PlacementPipeline] = None
-    # (dtype, sharding) → [indices, arrays, nbytes, sharding]
+    # group key → [indices, arrays, nbytes, sharding, scale_sharding]
     groups: Dict[tuple, list] = {}
     fetch_s = 0.0
     bytes_streamed = 0
 
-    def on_leaf(ix: int, arr: np.ndarray):
+    def on_leaf(ix: int, arr):
         nonlocal pipeline
+        quant = isinstance(arr, codec_mod.QuantLeaf)
         if flat_sh is None or flat_sh[ix] is None:
-            out[ix] = arr
+            out[ix] = arr.dequant() if quant else arr
             return
+        sharding = flat_sh[ix]
+        scale_sh = _scale_sharding(sharding) if quant else None
+        if quant and scale_sh is None:
+            # no replicable scale layout for this sharding type: host
+            # dequant, then the ordinary placement path
+            arr = arr.dequant()
+            quant = False
         if pipeline is None:
             pipeline = _PlacementPipeline(out, depth=pipeline_depth)
-        sharding = flat_sh[ix]
-        key = _sharding_group_key(arr.dtype, sharding)
-        group = groups.setdefault(key, [[], [], 0, sharding])
+        key = ((("q8",) if quant else ())
+               + _sharding_group_key(np.dtype(arr.dtype), sharding))
+        group = groups.setdefault(key, [[], [], 0, sharding, scale_sh])
         group[0].append(ix)
         group[1].append(arr)
         group[2] += arr.nbytes
         if group[2] >= batch_bytes:
-            pipeline.submit(group[0], group[1], group[3])
+            pipeline.submit(group[0], group[1], group[3], group[4])
             del groups[key]
 
     try:
@@ -534,7 +921,7 @@ def _streamed_restore(chunks: Iterable, template: Optional[Any],
             out = []
         for group in groups.values():
             assert pipeline is not None
-            pipeline.submit(group[0], group[1], group[3])
+            pipeline.submit(group[0], group[1], group[3], group[4])
         groups.clear()
     except BaseException:
         if pipeline is not None:
@@ -544,9 +931,11 @@ def _streamed_restore(chunks: Iterable, template: Optional[Any],
                 pass  # the original error is the one to surface
         raise
     place_s = 0.0
+    dequant_s = 0.0
     if pipeline is not None:
         pipeline.close()
         place_s = pipeline.place_s
+        dequant_s = pipeline.dequant_s
     wall_s = time.perf_counter() - t_start
     # Fraction of placement time hidden under the fetch: 1.0 = placement
     # fully overlapped (wall ≈ fetch), 0.0 = serial fetch-then-place.
@@ -554,23 +943,156 @@ def _streamed_restore(chunks: Iterable, template: Optional[Any],
     overlap = max(0.0, min(1.0, hidden / place_s)) if place_s > 1e-9 else 1.0
     _LAST_RESTORE.clear()
     _LAST_RESTORE.update({
-        "wall_s": wall_s, "fetch_s": fetch_s, "place_s": place_s,
+        "wall_s": wall_s + pre_fetch_s, "fetch_s": fetch_s + pre_fetch_s,
+        "place_s": place_s,
         "bytes_streamed": bytes_streamed,
+        "wire_bytes": bytes_streamed if wire_bytes is None else wire_bytes,
+        "raw_bytes": unpacker.raw_bytes,
+        "codec_decode_s": unpacker.decode_s,
+        "dequant_s": dequant_s,
         "leaves": len(out),
         "leaves_placed": pipeline.leaves_placed if pipeline else 0,
         "overlap_ratio": round(overlap, 4),
         "peak_buffered_bytes": unpacker.peak_buffered,
         "streaming": 1.0,
     })
+    if delta_hit is not None:
+        _LAST_RESTORE["delta_hit"] = 1.0 if delta_hit else 0.0
     try:
-        from kubetorch_tpu.observability.prometheus import record_restore
+        from kubetorch_tpu.observability.prometheus import (
+            record_restore,
+            record_wire,
+        )
 
         record_restore(_LAST_RESTORE)
+        record_wire({
+            "rx_bytes": _LAST_RESTORE["wire_bytes"],
+            "rx_raw_bytes": unpacker.raw_bytes,
+            "decode_s": unpacker.decode_s, "dequant_s": dequant_s,
+            "delta_fetch_hit": 1.0 if delta_hit else 0.0,
+            "delta_fetch_miss": 1.0 if delta_hit is False else 0.0,
+        })
     except Exception:
         pass  # metrics must never fail a restore
     if template is not None:
         return jax.tree.unflatten(jax.tree.structure(template), out)
     return out
+
+
+def _splice_base_candidates(key: str) -> List[Path]:
+    """Local files that might hold the previous version of ``key``'s
+    blob — the restore cache first, then the broadcast peer cache (a
+    fan-out member's last fetched copy works as a splice base too)."""
+    out = []
+    cache = codec_mod.restore_cache_root() / key
+    if cache.is_file():
+        out.append(cache)
+    try:
+        from kubetorch_tpu.data_store.broadcast import peer_cache_candidates
+
+        out.extend(peer_cache_candidates(key))
+    except Exception:
+        pass
+    return out
+
+
+def _try_delta_splice(backend, key: str):
+    """Fetch-side delta: if the store's patch sidecar names a base we
+    hold locally (restore or peer cache), pull the patch and splice the
+    full blob into the restore cache. Returns ``(cache_path,
+    wire_bytes)`` or None (no sidecar / no matching base / cache dir
+    unusable — caller full-fetches).
+
+    The patch streams: the msgpack plan sits in the first frames, so a
+    base mismatch aborts after ~one chunk instead of paying the whole
+    patch on top of the full fetch it falls back to."""
+    cache = codec_mod.restore_cache_root() / key
+    try:
+        cache.parent.mkdir(parents=True, exist_ok=True)
+    except OSError:
+        return None
+    candidates = _splice_base_candidates(key)
+    if not candidates:
+        return None
+    patch_key = key + BLOB_DELTA_SUFFIX
+    buf = bytearray()
+    base = None
+    it = None
+    try:
+        if hasattr(backend, "get_blob_stream"):
+            it = backend.get_blob_stream(patch_key, chunk_bytes=256 << 10)
+        else:
+            it = iter([backend.get_blob(patch_key)])
+        plan = None
+        for chunk in it:
+            buf += chunk
+            if plan is None and len(buf) >= 16:
+                if bytes(buf[:8]) != codec_mod.MAGIC_DELTA:
+                    return None
+                plan_len = int.from_bytes(buf[8:16], "little")
+                if len(buf) < 16 + plan_len:
+                    continue
+                plan, _ = codec_mod.parse_delta_plan(buf)
+                data_bytes = sum(op[1] for op in plan["ops"]
+                                 if op[0] == 0)
+                if data_bytes > plan["new_len"] * 0.5:
+                    # mostly-changed patch: the full STREAMED fetch is
+                    # better than buffering a near-full-size patch in RAM
+                    return None
+                base = next(
+                    (p for p in candidates
+                     if p.stat().st_size == plan["base_len"]
+                     and codec_mod.blob_header_digest(p)
+                     == plan["base_hdr_digest"]), None)
+                if base is None:
+                    return None  # wrong generation: abort the download
+        if plan is None or base is None:
+            return None
+    except (DataStoreError, OSError, ValueError):
+        return None  # no sidecar (full put / pre-delta store) or corrupt
+    finally:
+        if it is not None:
+            getattr(it, "close", lambda: None)()
+    tmp = cache.with_name(f".{cache.name}.{_tmp_tag()}.tmp")
+    try:
+        codec_mod.splice_delta(bytes(buf), base, tmp)
+        os.replace(tmp, cache)
+    except (codec_mod.DeltaMismatch, ValueError, OSError):
+        tmp.unlink(missing_ok=True)
+        return None
+    return cache, len(buf)
+
+
+def _tmp_tag() -> str:
+    """Unique per CALL, not per process: concurrent get_arrays of one
+    key in threaded workers must not interleave into a shared tmp file
+    (same rule as the store server's per-request staging names)."""
+    import uuid
+
+    return f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+
+
+def _tee_to_cache(chunks: Iterable, cache: Path):
+    """Pass wire chunks through to the streamed restore while appending
+    them to the restore cache (tmp + atomic publish on completion) — the
+    delta-miss fetch keeps PR 1's fetch/placement overlap instead of
+    downloading to disk first, and the NEXT round can splice."""
+    tmp = cache.with_name(f".{cache.name}.{_tmp_tag()}.tmp")
+    try:
+        fh = open(tmp, "wb")
+    except OSError:
+        yield from chunks  # unwritable cache: restore still works
+        return
+    try:
+        for chunk in chunks:
+            fh.write(chunk)
+            yield chunk
+    except BaseException:
+        fh.close()
+        tmp.unlink(missing_ok=True)
+        raise
+    fh.close()
+    os.replace(tmp, cache)
 
 
 def get_arrays(
@@ -580,9 +1102,10 @@ def get_arrays(
     broadcast=None,
     *,
     streaming: Optional[bool] = None,
-    chunk_bytes: int = 8 << 20,
+    chunk_bytes: Optional[int] = None,
     batch_bytes: int = 64 << 20,
     pipeline_depth: int = 2,
+    delta: Optional[bool] = None,
 ) -> Any:
     """Fetch arrays; ``shardings`` (pytree of Sharding or a single one)
     device_puts each leaf — onto a *different* mesh/layout than the publisher
@@ -600,29 +1123,79 @@ def get_arrays(
     memory stays O(chunk + largest leaf) instead of O(total blob). The
     blocking fallback fetches the whole blob, then unpacks with
     ``copy=True`` so the returned leaves never pin the fetched buffer.
+
+    ``delta`` (None → ``KT_WIRE_DELTA`` → off) enables **delta fetch**:
+    the fetcher keeps the last restored blob per key in the restore cache
+    (``KT_RESTORE_CACHE``); when the store's delta sidecar names that
+    cached blob (or a broadcast peer-cache copy) as its base, only the
+    patch crosses the wire and unchanged leaves splice from disk. The
+    codec is transparent on this side — V1 and codec-framed V2 blobs both
+    restore, int8 leaves dequantizing on device when shardings are given.
     """
     import jax
 
     from kubetorch_tpu.data_store.client import DataStoreClient
 
+    chunk_bytes = chunk_bytes or codec_mod.default_chunk_bytes(8 << 20)
+    delta = codec_mod.delta_enabled(delta) and broadcast is None
     backend = DataStoreClient.default()._backend()
+    local_path = None
+    wire_bytes: Optional[int] = None
+    delta_hit: Optional[bool] = None
+    pre_fetch_s = 0.0
+    if delta:
+        t0 = time.perf_counter()
+        spliced = _try_delta_splice(backend, key)
+        pre_fetch_s = time.perf_counter() - t0
+        if spliced is not None:
+            local_path, wire_bytes = spliced
+            delta_hit = True
+        else:
+            delta_hit = False  # miss: full fetch, teed into the cache
     if streaming is None:
-        streaming = hasattr(backend, "get_blob_stream")
-    elif streaming and not hasattr(backend, "get_blob_stream"):
-        from kubetorch_tpu.exceptions import DataStoreError
-
+        streaming = (local_path is not None
+                     or hasattr(backend, "get_blob_stream"))
+    elif streaming and local_path is None and not hasattr(
+            backend, "get_blob_stream"):
         raise DataStoreError(
             f"streaming=True but backend {type(backend).__name__} has no "
             f"get_blob_stream; use streaming=None to auto-fallback")
     if streaming:
-        chunks = backend.get_blob_stream(key, chunk_bytes=chunk_bytes,
-                                         broadcast=broadcast)
+        if local_path is not None:
+            from kubetorch_tpu.data_store.http_store import (
+                _iter_file_chunks,
+            )
+
+            chunks = _iter_file_chunks(local_path, chunk_bytes)
+        else:
+            chunks = backend.get_blob_stream(key, chunk_bytes=chunk_bytes,
+                                             broadcast=broadcast)
+            if delta:
+                # tee the wire into the cache WHILE restoring — the miss
+                # keeps fetch/placement overlapped, no fetch-then-read
+                chunks = _tee_to_cache(
+                    chunks, codec_mod.restore_cache_root() / key)
         return _streamed_restore(chunks, template, shardings,
                                  batch_bytes=batch_bytes,
-                                 pipeline_depth=pipeline_depth)
+                                 pipeline_depth=pipeline_depth,
+                                 wire_bytes=wire_bytes,
+                                 pre_fetch_s=pre_fetch_s,
+                                 delta_hit=delta_hit)
     t0 = time.perf_counter()
-    blob = backend.get_blob(key, broadcast=broadcast)
-    fetch_s = time.perf_counter() - t0
+    if local_path is not None:
+        blob = local_path.read_bytes()
+    else:
+        blob = backend.get_blob(key, broadcast=broadcast)
+        wire_bytes = len(blob)
+        if delta:
+            cache = codec_mod.restore_cache_root() / key
+            tmp = cache.with_name(f".{cache.name}.{_tmp_tag()}.tmp")
+            try:
+                tmp.write_bytes(blob)
+                os.replace(tmp, cache)
+            except OSError:
+                tmp.unlink(missing_ok=True)
+    fetch_s = pre_fetch_s + time.perf_counter() - t0
     # copy=True: frombuffer views would keep the whole multi-GB blob
     # alive for as long as ANY returned leaf survives
     tree = unpack_arrays(blob, template, copy=(shardings is None))
@@ -639,15 +1212,27 @@ def get_arrays(
     _LAST_RESTORE.update({
         "wall_s": fetch_s + place_s, "fetch_s": fetch_s,
         "place_s": place_s, "bytes_streamed": len(blob),
+        "wire_bytes": len(blob) if wire_bytes is None else wire_bytes,
         "leaves": len(jax.tree.leaves(tree)),
         "leaves_placed": (len(jax.tree.leaves(tree))
                           if shardings is not None else 0),
         "overlap_ratio": 0.0, "streaming": 0.0,
     })
+    if delta_hit is not None:
+        _LAST_RESTORE["delta_hit"] = 1.0 if delta_hit else 0.0
     try:
-        from kubetorch_tpu.observability.prometheus import record_restore
+        from kubetorch_tpu.observability.prometheus import (
+            record_restore,
+            record_wire,
+        )
 
         record_restore(_LAST_RESTORE)
+        record_wire({
+            "rx_bytes": _LAST_RESTORE["wire_bytes"],
+            "rx_raw_bytes": len(blob),
+            "delta_fetch_hit": 1.0 if delta_hit else 0.0,
+            "delta_fetch_miss": 1.0 if delta_hit is False else 0.0,
+        })
     except Exception:
         pass
     return tree

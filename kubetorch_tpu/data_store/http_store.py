@@ -14,7 +14,7 @@ import io
 import json
 import tarfile
 from pathlib import Path
-from typing import List
+from typing import List, Optional
 
 import httpx
 
@@ -24,6 +24,7 @@ from kubetorch_tpu.retry import (
     raise_if_retryable,
     with_retries,
 )
+from kubetorch_tpu.data_store.codec import default_chunk_bytes
 from kubetorch_tpu.data_store.sync import (
     DEFAULT_EXCLUDES,
     diff_manifests,
@@ -162,7 +163,8 @@ class HttpStoreBackend:
 
     # ---------------------------------------------------------- blobs
     @staticmethod
-    def _chunked(blob: bytes, n: int = 4 << 20):
+    def _chunked(blob: bytes, n: Optional[int] = None):
+        n = n or default_chunk_bytes()
         mv = memoryview(blob)
         for i in range(0, len(mv), n):
             yield bytes(mv[i:i + n])
@@ -176,7 +178,7 @@ class HttpStoreBackend:
         view = memoryview(blob)
 
         def chunks():
-            step = 4 << 20
+            step = default_chunk_bytes()
             for off in range(0, len(view), step):
                 yield view[off:off + step]
 
@@ -350,7 +352,20 @@ class HttpStoreBackend:
                 status=status)
         return body
 
-    def get_blob_stream(self, key: str, chunk_bytes: int = 4 << 20,
+    def put_blob_delta(self, key: str, delta: bytes) -> str:
+        """PUT a delta patch (``codec.build_delta``) for ``key``: the
+        server splices it against its current full blob and keeps the
+        patch as a fetch sidecar. Raises ``DataStoreError(status=409)``
+        when the server's base is not the one the patch names — callers
+        fall back to a full publish."""
+        resp = self._request(
+            "PUT", self._url(f"/blob/{key}"), content=delta,
+            headers={"X-KT-Delta": "1",
+                     "Content-Type": "application/octet-stream"})
+        self._raise_for(resp, "put-delta")
+        return key
+
+    def get_blob_stream(self, key: str, chunk_bytes: Optional[int] = None,
                         broadcast=None, **kw):
         """Generator of ``bytes`` chunks for a blob — the streaming twin of
         :meth:`get_blob`, for consumers (the pipelined array restore) that
@@ -368,6 +383,7 @@ class HttpStoreBackend:
         then stream off disk in ``chunk_bytes`` pieces — same bounded
         memory, same iterator contract.
         """
+        chunk_bytes = chunk_bytes or default_chunk_bytes()
         if broadcast is not None:
             def chunks():
                 # LAZY: the fan-out download runs on first next(), inside
@@ -546,10 +562,11 @@ class HttpStoreBackend:
         return resp.json()
 
 
-def _iter_file_chunks(path, chunk_bytes: int = 4 << 20):
+def _iter_file_chunks(path, chunk_bytes: Optional[int] = None):
     """Stream a local file as bytes chunks (broadcast peer-cache blobs and
     the local backend share this so every backend speaks the same
     ``get_blob_stream`` iterator contract)."""
+    chunk_bytes = chunk_bytes or default_chunk_bytes()
     with open(path, "rb") as fh:
         while True:
             data = fh.read(chunk_bytes)

@@ -64,7 +64,7 @@ def _norm_key(key: str) -> str:
 # known patterns only — a legitimately dot-named key (".env-snapshot")
 # stays listable (it is put/get/deletable, so hiding it was a lie).
 _INTERNAL_SUFFIXES = (".kt-stamp", ".size", ".tombstone", ".steal", ".lnk",
-                      ".pub")
+                      ".pub", ".kt-delta")
 
 
 def _is_internal(rel: Path) -> bool:
@@ -154,12 +154,23 @@ class StoreServer:
     async def h_put_blob(self, request):
         """Streamed to disk: weight blobs run to GBs — accumulating the
         body in memory is both a 2× RSS spike and superlinear slowdown
-        (measured 0.16 → 0.03 GB/s from 32 MB to 512 MB bodies)."""
+        (measured 0.16 → 0.03 GB/s from 32 MB to 512 MB bodies).
+
+        ``X-KT-Delta: 1`` marks the body as a delta patch
+        (``data_store/codec.py`` byte-level copy/data ops over the
+        currently stored blob): the server splices it into a new full
+        blob off the event loop and keeps the patch as the ``.kt-delta``
+        fetch sidecar, so fetchers holding the previous version pull
+        kilobytes instead of the full re-publish. A patch whose named
+        base is not the stored blob is refused with 409 — the client
+        falls back to a full publish."""
+        import asyncio
         import uuid
 
         key = _norm_key(request.match_info["key"])
         path = self._path(key)
         path.parent.mkdir(parents=True, exist_ok=True)
+        is_delta = request.headers.get("X-KT-Delta") == "1"
         # unique per REQUEST: two concurrent PUTs of one key must not
         # interleave into a shared tmp file (last os.replace wins whole)
         tmp = path.with_name(
@@ -183,7 +194,16 @@ class StoreServer:
                         raise web.HTTPRequestEntityTooLarge(
                             max_size=limit, actual_size=size)
                     fh.write(chunk)
-            os.replace(tmp, path)
+            if is_delta:
+                full_size = await asyncio.get_running_loop(
+                    ).run_in_executor(None, self._apply_delta, key, tmp)
+            else:
+                os.replace(tmp, path)
+                # a full put supersedes the delta chain: a stale patch
+                # would splice old-base fetchers to the PREVIOUS version
+                path.with_name(path.name + ".kt-delta").unlink(
+                    missing_ok=True)
+                full_size = size
         except BaseException:
             tmp.unlink(missing_ok=True)
             raise
@@ -196,7 +216,39 @@ class StoreServer:
         self._stamp(key)
         self.stats["puts"] += 1
         self.stats["bytes_in"] += size
-        return web.json_response({"key": key, "size": size})
+        return web.json_response({"key": key, "size": full_size,
+                                  "delta": is_delta})
+
+    def _apply_delta(self, key: str, patch_tmp: Path) -> int:
+        """Splice a staged delta patch into the stored full blob (runs on
+        an executor — multi-GB byte copies must not stall the event
+        loop). The patch itself becomes the fetch sidecar."""
+        from kubetorch_tpu.data_store import codec as codec_mod
+
+        path = self._path(key)
+        out_tmp = patch_tmp.with_name(patch_tmp.name + ".spliced")
+        try:
+            if not path.is_file():
+                raise web.HTTPConflict(
+                    text=f"no blob {key!r} to delta against")
+            try:
+                plan = codec_mod.splice_delta(patch_tmp, path, out_tmp)
+            except codec_mod.DeltaMismatch as exc:
+                raise web.HTTPConflict(text=str(exc)) from exc
+            except ValueError as exc:
+                raise web.HTTPBadRequest(
+                    text=f"corrupt delta patch: {exc}") from exc
+            # sidecar FIRST, blob second: a crash between the two leaves
+            # blob vN + patch (vN-1→vN) — fetchers just see the new
+            # version slightly early. The reverse order would pair blob
+            # vN+1 with the old patch and silently splice old-base
+            # fetchers onto a superseded version.
+            os.replace(patch_tmp, path.with_name(path.name + ".kt-delta"))
+            os.replace(out_tmp, path)
+            return int(plan["new_len"])
+        finally:
+            out_tmp.unlink(missing_ok=True)
+            patch_tmp.unlink(missing_ok=True)
 
     async def h_get_blob(self, request):
         """Blob reads, including the chunk-pipelined broadcast relay.
@@ -327,6 +379,7 @@ class StoreServer:
             path.unlink()
             count = 1
         path.with_name(path.name + ".kt-stamp").unlink(missing_ok=True)
+        path.with_name(path.name + ".kt-delta").unlink(missing_ok=True)
         self.sources.pop(key, None)
         self.versions[key] = self.versions.get(key, 0) + 1
         return web.json_response({"deleted": count})
@@ -387,6 +440,10 @@ class StoreServer:
                     elif target.is_file():
                         target.unlink(missing_ok=True)
                         deleted += 1
+                    # the delta-patch sidecar must die with its blob: an
+                    # orphaned patch could reconstruct reaped content
+                    target.with_name(target.name + ".kt-delta").unlink(
+                        missing_ok=True)
                     stamp.unlink(missing_ok=True)
                     self.sources.pop(rel, None)
                     self.versions[rel] = self.versions.get(rel, 0) + 1

@@ -218,13 +218,17 @@ def validate_adapter_targets(adapters: Dict[str, Any],
         f"layer dict (have {sorted(layers)})")
 
 
-def publish_adapters(key: str, lora: Dict[str, Any]) -> str:
+def publish_adapters(key: str, lora: Dict[str, Any],
+                     codec: str = None, delta: bool = None) -> str:
     """Trainer side of adapter weight-sync: pack the adapter pytree and
     stream it into the data store under ``key`` (the length-framed
-    zero-copy publish path — ``device_transfer.put_arrays``)."""
+    zero-copy publish path — ``device_transfer.put_arrays``).
+    ``codec``/``delta`` pass through to the wire codec layer — with
+    ``delta=True`` an update that only trained a subset of adapters
+    re-sends just those leaves."""
     from kubetorch_tpu.data_store.device_transfer import put_arrays
 
-    return put_arrays(key, lora)
+    return put_arrays(key, lora, codec=codec, delta=delta)
 
 
 def fetch_adapters(key: str, template: Any, shardings: Any = None,

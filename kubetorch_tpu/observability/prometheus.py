@@ -160,6 +160,72 @@ def restore_samples(labels: Optional[Dict[str, str]] = None):
 
 
 # ------------------------------------------------------------------
+# Wire codec / delta-publish counters (quantized delta wire codec,
+# data_store/codec.py + device_transfer put_arrays/get_arrays).
+# Process-local like the restore counters. tx_* = publish side, rx_* =
+# fetch side; *_raw_bytes_total is what an uncodec'd full transfer would
+# have shipped, so (raw - actual) is the wire bytes the codec+delta layer
+# saved. Codec/dequant seconds expose the CPU/device cost paid for those
+# savings; delta hit/miss counters show whether fetchers are actually
+# splicing from cache.
+_WIRE_LOCK = threading.Lock()
+_WIRE: Dict[str, float] = {
+    "wire_tx_bytes_total": 0.0,
+    "wire_tx_raw_bytes_total": 0.0,
+    "wire_rx_bytes_total": 0.0,
+    "wire_rx_raw_bytes_total": 0.0,
+    "wire_codec_encode_seconds_total": 0.0,
+    "wire_codec_decode_seconds_total": 0.0,
+    "wire_dequant_seconds_total": 0.0,
+    "wire_delta_publishes_total": 0.0,
+    "wire_delta_publish_fallbacks_total": 0.0,
+    "wire_delta_leaves_skipped_total": 0.0,
+    "wire_delta_fetch_hits_total": 0.0,
+    "wire_delta_fetch_misses_total": 0.0,
+}
+
+
+def record_wire(stats: Dict[str, float]) -> None:
+    """Fold one publish/fetch wire decomposition into the counters.
+    Accepted keys: tx_bytes/tx_raw_bytes (publish), rx_bytes/rx_raw_bytes
+    (fetch), encode_s/decode_s/dequant_s, delta_publish, delta_fallback,
+    delta_leaves_skipped, delta_fetch_hit, delta_fetch_miss."""
+    mapping = {
+        "tx_bytes": "wire_tx_bytes_total",
+        "tx_raw_bytes": "wire_tx_raw_bytes_total",
+        "rx_bytes": "wire_rx_bytes_total",
+        "rx_raw_bytes": "wire_rx_raw_bytes_total",
+        "encode_s": "wire_codec_encode_seconds_total",
+        "decode_s": "wire_codec_decode_seconds_total",
+        "dequant_s": "wire_dequant_seconds_total",
+        "delta_publish": "wire_delta_publishes_total",
+        "delta_fallback": "wire_delta_publish_fallbacks_total",
+        "delta_leaves_skipped": "wire_delta_leaves_skipped_total",
+        "delta_fetch_hit": "wire_delta_fetch_hits_total",
+        "delta_fetch_miss": "wire_delta_fetch_misses_total",
+    }
+    with _WIRE_LOCK:
+        for key, counter in mapping.items():
+            value = stats.get(key, 0)
+            if isinstance(value, (int, float)) and value > 0:
+                _WIRE[counter] += float(value)
+
+
+def wire_metrics() -> Dict[str, float]:
+    """Snapshot of the wire codec/delta counters."""
+    with _WIRE_LOCK:
+        return dict(_WIRE)
+
+
+def wire_samples(labels: Optional[Dict[str, str]] = None):
+    """Exposition samples for the wire counters (same ``data_store_``
+    family as the restore counters)."""
+    labels = labels or {}
+    for name, value in wire_metrics().items():
+        yield f"data_store_{name}", labels, value
+
+
+# ------------------------------------------------------------------
 # Serving call-path decomposition (persistent pipelined call channel,
 # serving/channel.py ↔ PodServer.h_channel). Process-local, like the
 # restore counters above: the pod-server process records server-side

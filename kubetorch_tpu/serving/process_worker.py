@@ -108,11 +108,15 @@ def _attach_worker_metrics(agg: Dict[str, int]) -> None:
         from kubetorch_tpu.observability.prometheus import (
             restore_metrics,
             serving_metrics,
+            wire_metrics,
         )
 
         restore = restore_metrics()
         if restore.get("restore_count_total"):
             agg["data_store_restore"] = {"pid": os.getpid(), **restore}
+        wire = wire_metrics()
+        if any(wire.values()):
+            agg["data_store"] = {"pid": os.getpid(), **wire}
         serving = {k: v for k, v in serving_metrics().items()
                    if k.startswith("serving_worker_") and v}
         if serving:

@@ -395,7 +395,8 @@ class PodServer:
         return web.json_response({"ready": True})
 
     # group name in a worker's stats dict → metric-name prefix
-    _PROC_GROUPS = {"data_store_restore": "data_store_", "serving": ""}
+    _PROC_GROUPS = {"data_store_restore": "data_store_",
+                    "data_store": "data_store_", "serving": ""}
 
     def _merge_worker_stats(self, stats: Dict[str, Any]):
         """Fold a worker's per-call stats dict into pod metrics. Plain
@@ -442,6 +443,11 @@ class PodServer:
         if restore["restore_count_total"]:
             self._merge_proc_snapshot("data_store_restore", "server",
                                       restore)
+        # Wire codec / delta-publish counters (all *_total → summed
+        # across processes exactly like the restore counters).
+        wire = prom.wire_metrics()
+        if any(wire.values()):
+            self._merge_proc_snapshot("data_store", "server", wire)
         # Serving call-path counters: the server process records channel
         # lifecycle + server-side stage totals; worker processes piggyback
         # their own serving_worker_* counters on call responses (merged

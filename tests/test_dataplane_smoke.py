@@ -31,6 +31,20 @@ EXPECTED_KEYS = {
     "restore_overlap_ratio",
     "restore_speedup",
     "restore_vs_wire_ratio",
+    # quantized delta wire codec decomposition
+    "restore_wire_bytes_raw_mb",
+    "restore_wire_bytes_int8_mb",
+    "restore_wire_reduction_int8",
+    "restore_int8_streamed_ms",
+    "codec_int8_encode_MBps",
+    "codec_int8_decode_MBps",
+    "codec_int8_dequant_ms",
+    "delta_publish_full_mb",
+    "delta_publish_update_mb",
+    "delta_publish_update_pct",
+    "delta_publish_leaves_skipped",
+    "delta_fetch_wire_mb",
+    "delta_fetch_hit",
 }
 
 
@@ -48,5 +62,12 @@ def test_dataplane_dryrun_metric_keys():
     assert out["restore_streamed_ms"] > 0
     assert out["restore_blocking_ms"] > 0
     assert 0.0 <= out["restore_overlap_ratio"] <= 1.0
+    # codec/delta acceptance floors hold even at dryrun sizes: the int8
+    # codec must at least halve the weight-sync wire bytes, and a
+    # LoRA-only delta update must ship <1% of the full blob
+    assert out["restore_wire_reduction_int8"] >= 2.0
+    assert out["delta_publish_update_pct"] < 1.0
+    assert out["delta_publish_leaves_skipped"] > 0
+    assert out["delta_fetch_hit"] == 1.0
     assert "vs_prior_round_gt20pct" not in out, (
         "dryrun toy values must never be compared against prior rounds")

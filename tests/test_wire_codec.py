@@ -1,0 +1,543 @@
+"""Quantized delta wire codec tests: lossless codec bit-exactness on
+awkward leaves (empty/0-d/int/bool), int8 error bounds on bf16/f32,
+mixed-codec manifests, V1 back-compat, the zstd→zlib import-guard
+fallback, chunked transfer for size-changing codecs, delta publish/fetch
+splicing (including after a mid-stream drop + Range resume), and the
+wire metrics."""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from kubetorch_tpu.data_store import codec as codec_mod
+from kubetorch_tpu.data_store.client import DataStoreClient
+from kubetorch_tpu.data_store.device_transfer import (
+    get_arrays,
+    iter_unpack_arrays,
+    last_publish_stats,
+    last_restore_stats,
+    pack_arrays,
+    put_arrays,
+    unpack_arrays,
+)
+from kubetorch_tpu.data_store.types import BLOB_DELTA_SUFFIX
+
+
+@pytest.fixture(autouse=True)
+def _isolated_store(tmp_path, monkeypatch):
+    monkeypatch.setenv("KT_LOCAL_STORE", str(tmp_path / "store"))
+    monkeypatch.setenv("KT_RESTORE_CACHE", str(tmp_path / "rcache"))
+    import kubetorch_tpu.data_store.client as client_mod
+    from kubetorch_tpu.data_store import device_transfer
+
+    monkeypatch.setattr(client_mod, "_LOCAL_STORE", tmp_path / "store")
+    device_transfer._PUBLISH_MANIFESTS.clear()
+    DataStoreClient._default = None
+    yield
+    DataStoreClient._default = None
+
+
+@pytest.fixture()
+def http_store_url(tmp_path):
+    root = tmp_path / "store-root"
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {**os.environ, "KT_STORE_ROOT": str(root)}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.data_store.store_server",
+         "--host", "127.0.0.1", "--port", str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    url = f"http://127.0.0.1:{port}"
+    import httpx
+
+    for _ in range(100):
+        try:
+            if httpx.get(f"{url}/health", timeout=2.0).status_code == 200:
+                break
+        except httpx.HTTPError:
+            time.sleep(0.2)
+    else:
+        proc.kill()
+        raise RuntimeError("store server did not start")
+    yield url
+    proc.terminate()
+    proc.wait(5)
+
+
+def _mixed_tree():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    return {
+        "w": jnp.asarray(rng.random((64, 32)), jnp.float32),
+        "bf16": jnp.asarray(rng.random((129,)), jnp.bfloat16),
+        "i8": jnp.asarray(rng.integers(-100, 100, (16, 4)), jnp.int8),
+        "i32": jnp.asarray(rng.integers(0, 1 << 20, (9,)), jnp.int32),
+        "bool": jnp.asarray([True, False, True]),
+        "scalar": jnp.asarray(3.5, jnp.float32),  # 0-d
+        "empty": jnp.zeros((0, 3), jnp.float32),  # zero-size leaf
+        "nested": {"b": jnp.ones((5,), jnp.float32)},
+    }
+
+
+def _leaves(tree):
+    import jax
+
+    return [np.asarray(a) for a in jax.tree.leaves(tree)]
+
+
+# ------------------------------------------------------------- lossless
+@pytest.mark.level("unit")
+@pytest.mark.parametrize("codec", ["raw", "zlib", "zstd"])
+def test_lossless_roundtrip_bit_exact(codec):
+    """Lossless codecs must round-trip EVERY leaf bit-exactly — including
+    empty, 0-d, int, and bool leaves — through both the blocking unpack
+    and the streaming unpacker at leaf-splitting chunk sizes."""
+    tree = _mixed_tree()
+    blob = pack_arrays(tree, codec=codec)
+    ref = _leaves(tree)
+    got = unpack_arrays(blob)
+    assert len(got) == len(ref)
+    for a, b in zip(got, ref):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+    for chunk in (1, 13, 4096):
+        streamed = dict(iter_unpack_arrays(
+            blob[i:i + chunk] for i in range(0, len(blob), chunk)))
+        for i, b in enumerate(ref):
+            np.testing.assert_array_equal(streamed[i], b)
+            assert streamed[i].dtype == b.dtype
+
+
+@pytest.mark.level("unit")
+def test_lossless_codecs_shrink_compressible_blob():
+    rng = np.random.default_rng(0)
+    # low-entropy payload: quantized-ish small ints in f32
+    tree = {"w": rng.integers(-3, 3, (256, 64)).astype(np.float32)}
+    raw = pack_arrays(tree, codec="raw")
+    z = pack_arrays(tree, codec="zlib")
+    assert len(z) < len(raw) / 2
+    for a, b in zip(unpack_arrays(z), _leaves(tree)):
+        np.testing.assert_array_equal(a, b)
+
+
+# ----------------------------------------------------------------- int8
+@pytest.mark.level("unit")
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_int8_error_bounded(dtype):
+    """The int8 codec's reconstruction error must stay within one
+    half-step of each row's own absmax/127 scale (plus storage rounding
+    for bf16 sources)."""
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    rng = np.random.default_rng(1)
+    src = (rng.standard_normal((32, 128)) * 3.0).astype(
+        np.float32 if dtype == "float32" else ml_dtypes.bfloat16)
+    tree = {"w": jnp.asarray(src)}
+    blob = pack_arrays(tree, codec="int8")
+    (got,) = unpack_arrays(blob)
+    assert got.dtype == src.dtype and got.shape == src.shape
+    f = np.asarray(src, np.float32)
+    scale = np.maximum(np.abs(f).max(axis=1), 1e-8) / 127.0
+    err = np.abs(np.asarray(got, np.float32) - f)
+    # half-step quantization bound; bf16 adds ~2^-8 relative storage error
+    slack = 1.02 if dtype == "float32" else 1.05
+    bound = scale[:, None] * 0.5 * slack + (
+        0.0 if dtype == "float32" else np.abs(f) * 2 ** -8)
+    assert (err <= bound + 1e-7).all(), (
+        f"max err {err.max()} exceeds per-row bound")
+
+
+@pytest.mark.level("unit")
+def test_int8_mixed_codec_manifest():
+    """Under the int8 codec, non-float leaves AND quality-sensitive
+    small shapes (1-D norm-style vectors, 0-d, empty) fall back to raw
+    and stay bit-exact — one blob, mixed per-leaf codecs, all declared
+    in the header."""
+    tree = _mixed_tree()
+    blob = pack_arrays(tree, codec="int8")
+    header, _ = codec_mod.parse_header(blob)
+    codecs = {tuple(s["shape"]): s["codec"] for s in header["leaves"]}
+    assert header["codec"] == "int8"
+    assert codecs[(64, 32)] == "int8"     # 2-D float: quantized
+    assert codecs[(129,)] == "raw"        # 1-D bf16 (norm-style): exact
+    assert codecs[(16, 4)] == "raw"       # already int8 storage
+    assert codecs[(9,)] == "raw"          # int32
+    assert codecs[(3,)] == "raw"          # bool
+    assert codecs[()] == "raw"            # 0-d
+    assert codecs[(0, 3)] == "raw"        # empty
+    got = unpack_arrays(blob)
+    for a, b in zip(got, _leaves(tree)):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        if b.dtype.kind in "ib" or b.size == 0 or b.ndim < 2:
+            np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.level("unit")
+def test_int8_device_dequant_on_restore():
+    """With shardings, int8 leaves ride to the device in their small
+    (q, scale) form and dequantize in the jitted kernel — the restore
+    stats expose the dequant time and the result carries the sharding."""
+    import jax
+
+    tree = _mixed_tree()
+    put_arrays("q/params", tree, codec="int8")
+    sh = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    out = get_arrays("q/params", template=tree, shardings=sh,
+                     streaming=True, chunk_bytes=257)
+    stats = last_restore_stats()
+    assert stats["leaves_placed"] == len(_leaves(tree))
+    assert stats["wire_bytes"] < stats["raw_bytes"]
+    assert out["w"].sharding == sh and out["w"].dtype == tree["w"].dtype
+    err = np.abs(np.asarray(out["w"], np.float32)
+                 - np.asarray(tree["w"], np.float32)).max()
+    assert err < np.abs(np.asarray(tree["w"])).max() / 100
+    np.testing.assert_array_equal(np.asarray(out["i8"]),
+                                  np.asarray(tree["i8"]))
+
+
+# ------------------------------------------------------------ back-compat
+@pytest.mark.level("unit")
+def test_old_uncodec_blob_still_restores():
+    """A V1 blob put before the codec layer existed must keep restoring
+    through both paths (header negotiation: magic picks the decoder)."""
+    tree = _mixed_tree()
+    v1 = pack_arrays(tree, codec="raw")
+    assert v1.startswith(b"KTARRV1\x00")
+    DataStoreClient.default()._backend().put_blob("old/params", v1)
+    for streaming in (True, False):
+        out = get_arrays("old/params", template=tree, streaming=streaming)
+        for a, b in zip(_leaves(out), _leaves(tree)):
+            np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.level("unit")
+def test_zstd_falls_back_to_zlib_when_absent(monkeypatch):
+    """The zstandard extra is optional: with the module absent, the
+    ``zstd`` codec must resolve to zlib and the whole round-trip still
+    pass (this is also how the suite runs in envs without the extra)."""
+    monkeypatch.setattr(codec_mod, "_zstd", lambda: None)
+    assert codec_mod.resolve_codec("zstd") == "zlib"
+    tree = _mixed_tree()
+    blob = pack_arrays(tree, codec="zstd")
+    header, _ = codec_mod.parse_header(blob)
+    assert all(s["codec"] in ("zlib", "raw") for s in header["leaves"])
+    for a, b in zip(unpack_arrays(blob), _leaves(tree)):
+        np.testing.assert_array_equal(a, b)
+
+
+# ----------------------------------------------- transfer-length framing
+@pytest.mark.level("unit")
+def test_compressed_publish_uses_chunked_transfer(monkeypatch):
+    """A codec that changes payload size must publish with length=None
+    (chunked transfer-encoding): a Content-Length computed from raw
+    sizes would lie about the encoded stream. Size-deterministic codecs
+    (raw/int8) keep the exact length for the sendall fast path."""
+    import kubetorch_tpu.data_store.client as client_mod
+
+    lengths = {}
+
+    def fake_stream(self, key, factory, length=None, **kw):
+        lengths[key] = length
+        data = b"".join(bytes(c) for c in factory())
+        if length is not None:
+            assert len(data) == length, "declared length lied"
+        return self.put_blob(key, data)
+
+    monkeypatch.setattr(client_mod.LocalStoreBackend, "put_blob_stream",
+                        fake_stream, raising=False)
+    tree = _mixed_tree()
+    put_arrays("len/raw", tree, codec="raw")
+    put_arrays("len/zlib", tree, codec="zlib")
+    put_arrays("len/int8", tree, codec="int8")
+    assert isinstance(lengths["len/raw"], int)
+    assert lengths["len/zlib"] is None
+    assert isinstance(lengths["len/int8"], int)
+    for key in ("len/raw", "len/zlib", "len/int8"):
+        out = get_arrays(key, template=tree)
+        assert np.asarray(out["i32"]).tolist() == np.asarray(
+            tree["i32"]).tolist()
+
+
+@pytest.mark.level("unit")
+def test_chunk_size_knob_is_unified(monkeypatch):
+    """KT_STREAM_CHUNK_BYTES governs every previously hard-coded 4 MB
+    chunker: the default helper, file streaming, and the HTTP chunkers
+    read the same knob."""
+    from kubetorch_tpu.data_store.http_store import _iter_file_chunks
+
+    monkeypatch.setenv("KT_STREAM_CHUNK_BYTES", str(128 << 10))
+    assert codec_mod.default_chunk_bytes() == 128 << 10
+    assert codec_mod.default_chunk_bytes(8 << 20) == 128 << 10
+    monkeypatch.delenv("KT_STREAM_CHUNK_BYTES")
+    assert codec_mod.default_chunk_bytes() == 4 << 20
+    assert codec_mod.default_chunk_bytes(8 << 20) == 8 << 20
+    monkeypatch.setenv("KT_STREAM_CHUNK_BYTES", str(64 << 10))
+    path = codec_mod.restore_cache_root()
+    path.mkdir(parents=True, exist_ok=True)
+    f = path / "chunk-probe"
+    f.write_bytes(os.urandom(200 << 10))
+    sizes = [len(c) for c in _iter_file_chunks(f)]
+    assert sizes[0] == 64 << 10 and len(sizes) == 4
+
+
+# ----------------------------------------------------------------- delta
+@pytest.mark.level("unit")
+def test_delta_publish_skips_unchanged_leaves():
+    """Delta publish ships only changed leaves; a frozen-backbone update
+    is a kilobyte-scale patch and the restored tree is the new version,
+    bit-exact under a lossless codec."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    tree = {"backbone": jnp.asarray(rng.random((256, 64)), jnp.float32),
+            "lora": jnp.asarray(rng.random((4, 8)), jnp.float32)}
+    put_arrays("d/params", tree, codec="raw", delta=True)
+    full = last_publish_stats()
+    assert full["delta"] == 0.0
+    tree2 = dict(tree)
+    tree2["lora"] = tree["lora"] + 1.0
+    put_arrays("d/params", tree2, codec="raw", delta=True)
+    upd = last_publish_stats()
+    assert upd["delta"] == 1.0
+    assert upd["leaves_skipped"] == 1 and upd["leaves_sent"] == 1
+    assert upd["wire_bytes"] < full["wire_bytes"] / 10
+    out = get_arrays("d/params", template=tree2)
+    np.testing.assert_array_equal(np.asarray(out["backbone"]),
+                                  np.asarray(tree2["backbone"]))
+    np.testing.assert_array_equal(np.asarray(out["lora"]),
+                                  np.asarray(tree2["lora"]))
+    # publishing the SAME tree again skips every leaf
+    put_arrays("d/params", tree2, codec="raw", delta=True)
+    again = last_publish_stats()
+    assert again["delta"] == 1.0 and again["leaves_sent"] == 0
+
+
+@pytest.mark.level("unit")
+def test_delta_publish_falls_back_when_base_drifted():
+    """A store whose blob is not the publisher's recorded base (another
+    writer, restart, sweep) must refuse the patch; the publisher heals
+    with a full publish, and the result is the new tree."""
+    import jax.numpy as jnp
+
+    tree = {"w": jnp.ones((4, 8), jnp.float32),
+            "backbone": jnp.zeros((256, 64), jnp.float32)}
+    put_arrays("drift/params", tree, codec="raw", delta=True)
+    # another writer replaces the blob behind the manifest's back
+    other = {"w": jnp.full((4, 8), 7.0, jnp.float32),
+             "backbone": jnp.ones((256, 64), jnp.float32)}
+    DataStoreClient.default()._backend().put_blob(
+        "drift/params", pack_arrays(other))
+    tree2 = {"w": jnp.full((4, 8), 2.0, jnp.float32),
+             "backbone": tree["backbone"]}  # big unchanged leaf → a
+    #                                         patch IS attempted
+    put_arrays("drift/params", tree2, codec="raw", delta=True)
+    stats = last_publish_stats()
+    assert stats["delta"] == 0.0 and stats["delta_fallback"] == 1.0
+    out = get_arrays("drift/params", template=tree2)
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  np.asarray(tree2["w"]))
+
+
+@pytest.mark.level("unit")
+def test_delta_fetch_splices_from_local_cache():
+    """A fetcher holding the previous version pulls only the patch
+    sidecar and splices unchanged leaves from its restore cache."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    tree = {"backbone": jnp.asarray(rng.random((512, 64)), jnp.float32),
+            "lora": jnp.asarray(rng.random((4, 8)), jnp.float32)}
+    put_arrays("df/params", tree, codec="raw", delta=True)
+    get_arrays("df/params", template=tree, delta=True)
+    assert last_restore_stats()["delta_hit"] == 0.0  # cold cache: miss
+    tree2 = dict(tree)
+    tree2["lora"] = tree["lora"] * 3.0
+    put_arrays("df/params", tree2, codec="raw", delta=True)
+    out = get_arrays("df/params", template=tree2, delta=True)
+    stats = last_restore_stats()
+    assert stats["delta_hit"] == 1.0
+    assert stats["wire_bytes"] < stats["raw_bytes"] / 10
+    np.testing.assert_array_equal(np.asarray(out["backbone"]),
+                                  np.asarray(tree2["backbone"]))
+    np.testing.assert_array_equal(np.asarray(out["lora"]),
+                                  np.asarray(tree2["lora"]))
+
+
+# ------------------------------------------------------ http + resume
+class _FlakyResponse:
+    def __init__(self, resp, fail_after_reads):
+        self._resp = resp
+        self._fail_after = fail_after_reads
+        self._reads = 0
+
+    @property
+    def status(self):
+        return self._resp.status
+
+    def getheader(self, *args, **kw):
+        return self._resp.getheader(*args, **kw)
+
+    def read(self, amt=None):
+        if self._fail_after is not None and self._reads >= self._fail_after:
+            raise OSError("injected mid-stream connection drop")
+        self._reads += 1
+        return self._resp.read(amt)
+
+
+class _FlakyConn:
+    def __init__(self, conn, state, fail_after_reads):
+        self._conn = conn
+        self._state = state
+        self._fail = fail_after_reads
+
+    def request(self, method, path, headers=None, **kw):
+        if headers and "Range" in headers:
+            self._state["ranges"].append(headers["Range"])
+        self._conn.request(method, path, headers=headers or {}, **kw)
+
+    def getresponse(self):
+        return _FlakyResponse(self._conn.getresponse(), self._fail)
+
+    def close(self):
+        self._conn.close()
+
+
+@pytest.mark.level("minimal")
+def test_delta_splice_after_midstream_drop_and_resume(
+        http_store_url, monkeypatch):
+    """The cache-teeing full fetch survives a mid-body drop via the Range
+    resume; the teed cache must be byte-correct, so the NEXT fetch delta-
+    splices off it and ships only the patch."""
+    import jax.numpy as jnp
+
+    from kubetorch_tpu.data_store import http_store
+
+    monkeypatch.setenv("KT_STORE_URL", http_store_url)
+    DataStoreClient._default = None
+    rng = np.random.default_rng(0)
+    tree = {"backbone": jnp.asarray(rng.random((2048, 64)), jnp.float32),
+            "lora": jnp.asarray(rng.random((4, 8)), jnp.float32)}
+    put_arrays("rs/params", tree, codec="raw", delta=True)
+
+    real = http_store.raw_target
+    state = {"conns": 0, "ranges": []}
+
+    def patched(url):
+        make_conn, path = real(url)
+
+        def mk():
+            state["conns"] += 1
+            fail_after = 2 if state["conns"] == 1 else None
+            return _FlakyConn(make_conn(), state, fail_after)
+
+        return mk, path
+
+    monkeypatch.setattr(http_store, "raw_target", patched)
+    out = get_arrays("rs/params", template=tree, delta=True,
+                     chunk_bytes=64 << 10)
+    assert state["ranges"], "drop did not trigger a Range resume"
+    assert last_restore_stats()["delta_hit"] == 0.0
+    np.testing.assert_array_equal(np.asarray(out["backbone"]),
+                                  np.asarray(tree["backbone"]))
+    monkeypatch.setattr(http_store, "raw_target", real)
+
+    tree2 = dict(tree)
+    tree2["lora"] = tree["lora"] + 1.0
+    put_arrays("rs/params", tree2, codec="raw", delta=True)
+    assert last_publish_stats()["delta"] == 1.0
+    out2 = get_arrays("rs/params", template=tree2, delta=True)
+    stats = last_restore_stats()
+    assert stats["delta_hit"] == 1.0, (
+        "teed cache from the resumed fetch did not match the patch base")
+    assert stats["wire_bytes"] < stats["raw_bytes"] / 10
+    np.testing.assert_array_equal(np.asarray(out2["backbone"]),
+                                  np.asarray(tree2["backbone"]))
+    np.testing.assert_array_equal(np.asarray(out2["lora"]),
+                                  np.asarray(tree2["lora"]))
+
+
+@pytest.mark.level("minimal")
+def test_http_delta_sidecar_hidden_and_cleaned(http_store_url,
+                                               monkeypatch):
+    """The .kt-delta sidecar the server keeps after a delta PUT is
+    invisible to /keys and removed by a subsequent full put (a stale
+    patch must never splice fetchers onto a superseded version)."""
+    import jax.numpy as jnp
+
+    from kubetorch_tpu.data_store.http_store import HttpStoreBackend
+
+    monkeypatch.setenv("KT_STORE_URL", http_store_url)
+    DataStoreClient._default = None
+    tree = {"w": jnp.ones((8, 8), jnp.float32),
+            "b": jnp.zeros((256, 64), jnp.float32)}
+    put_arrays("sc/params", tree, codec="raw", delta=True)
+    tree2 = {"w": jnp.full((8, 8), 2.0, jnp.float32), "b": tree["b"]}
+    put_arrays("sc/params", tree2, codec="raw", delta=True)
+    assert last_publish_stats()["delta"] == 1.0
+    be = HttpStoreBackend(http_store_url)
+    assert len(be.get_blob("sc/params" + BLOB_DELTA_SUFFIX)) > 0
+    keys = [k["key"] for k in be.list_keys("sc")]
+    assert keys == ["sc/params"], keys
+    # full (untracked) re-put supersedes the patch chain
+    put_arrays("sc/params", tree2)
+    from kubetorch_tpu.exceptions import DataStoreError
+
+    with pytest.raises(DataStoreError):
+        be.get_blob("sc/params" + BLOB_DELTA_SUFFIX)
+
+
+@pytest.mark.level("minimal")
+def test_int8_codec_over_http_streamed(http_store_url, monkeypatch):
+    """End-to-end int8 publish + streamed restore against the real
+    server: fewer wire bytes, error-bounded floats, exact ints."""
+    import jax
+
+    monkeypatch.setenv("KT_STORE_URL", http_store_url)
+    DataStoreClient._default = None
+    tree = _mixed_tree()
+    put_arrays("h/params", tree, codec="int8")
+    pub = last_publish_stats()
+    assert pub["wire_bytes"] < pub["raw_bytes"]
+    sh = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    out = get_arrays("h/params", template=tree, shardings=sh,
+                     streaming=True, chunk_bytes=1 << 10)
+    np.testing.assert_array_equal(np.asarray(out["i32"]),
+                                  np.asarray(tree["i32"]))
+    err = np.abs(np.asarray(out["w"], np.float32)
+                 - np.asarray(tree["w"], np.float32)).max()
+    assert err < 0.01
+
+
+# ---------------------------------------------------------------- metrics
+@pytest.mark.level("unit")
+def test_wire_metrics_recorded():
+    from kubetorch_tpu.observability import prometheus as prom
+
+    before = prom.wire_metrics()
+    tree = _mixed_tree()
+    put_arrays("m/params", tree, codec="int8", delta=True)
+    tree2 = dict(tree)
+    tree2["nested"] = {"b": np.full((5,), 2.0, np.float32)}
+    put_arrays("m/params", tree2, codec="int8", delta=True)
+    get_arrays("m/params", template=tree2, delta=True)
+    after = prom.wire_metrics()
+    assert after["wire_tx_bytes_total"] > before["wire_tx_bytes_total"]
+    assert (after["wire_tx_raw_bytes_total"]
+            > after["wire_tx_bytes_total"])  # codec+delta saved bytes
+    assert (after["wire_delta_publishes_total"]
+            == before["wire_delta_publishes_total"] + 1)
+    assert (after["wire_delta_leaves_skipped_total"]
+            > before["wire_delta_leaves_skipped_total"])
+    assert (after["wire_rx_bytes_total"] > before["wire_rx_bytes_total"])
+    text = prom.render(prom.wire_samples({"pod": "p0"}))
+    assert "kubetorch_data_store_wire_tx_bytes_total" in text
+    assert "kubetorch_data_store_wire_delta_fetch_misses_total" in text
+    assert 'pod="p0"' in text
