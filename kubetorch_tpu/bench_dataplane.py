@@ -555,11 +555,14 @@ def run(dryrun: bool = False) -> Dict[str, float]:
     sizes and 1 rep, emitting the same metric KEYS so a key that vanishes
     (a silently-dropped measurement) fails the smoke test, while the toy
     VALUES are never compared to prior rounds."""
+    from kubetorch_tpu.observability import tracing
+
     # RAM-backed when available: measure the data plane, not the VM disk
     base = "/dev/shm" if os.path.isdir("/dev/shm") else None
     tmp = Path(tempfile.mkdtemp(prefix="ktpu-dpbench-", dir=base))
     store = None
     reps = 1 if dryrun else REPS
+    trace_seq0 = tracing.recorder.seq
     try:
         store = _Store(tmp / "root")
         out: Dict[str, float] = {}
@@ -577,6 +580,13 @@ def run(dryrun: bool = False) -> Dict[str, float]:
         if store is not None:
             store.close()
         shutil.rmtree(tmp, ignore_errors=True)
+    # tracing cost accounting: spans the restore/publish paths recorded
+    # during the bench plus the measured per-span overhead (the smoke
+    # test key-guards both — a silently un-instrumented dataplane would
+    # otherwise look identical to a healthy one)
+    out["trace_span_count"] = tracing.recorder.seq - trace_seq0
+    out["trace_overhead_us_per_span"] = round(
+        tracing.measure_overhead_us(), 3)
     if dryrun:
         return out
     # >20% medians-vs-prior-round flags (VERDICT r4 weak #4: r4's −34%

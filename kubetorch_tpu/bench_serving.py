@@ -516,12 +516,14 @@ def bench_call_channel(device_ms: float = 3.0, batch: int = 8,
     import shutil
     import tempfile
 
+    from kubetorch_tpu.observability import tracing
     from kubetorch_tpu.serving import http_client
     from kubetorch_tpu.serving.channel import CallChannel
 
     if dryrun:
         device_ms, batch, steps_per_call = 3.0, 8, 16
         n_chunks, depth, reps = 20, 2, 3
+    trace_seq0 = tracing.recorder.seq
     root = tempfile.mkdtemp(prefix="kt-bench-chan-")
     with open(os.path.join(root, "decode_sim.py"), "w") as f:
         f.write(_DECODE_SIM)
@@ -600,6 +602,13 @@ def bench_call_channel(device_ms: float = 3.0, batch: int = 8,
         out[f"serving_tok_s_{flavor}"] = round(toks / (ms / 1e3), 1)
     out["serving_pipeline_speedup"] = round(
         out["serving_post_ms_p50"] / out["serving_chunk_ms_pipelined"], 3)
+    # tracing cost accounting (always-on spans ride every call above):
+    # client-side spans recorded during the bench, and the measured
+    # per-span overhead — the smoke test asserts a pipelined chunk pays
+    # <5% of its wall to tracing (see tests/test_serving_smoke.py)
+    out["trace_span_count"] = tracing.recorder.seq - trace_seq0
+    out["trace_overhead_us_per_span"] = round(
+        tracing.measure_overhead_us(), 3)
     return out
 
 

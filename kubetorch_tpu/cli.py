@@ -363,6 +363,88 @@ def profile(service, seconds, pod, rank, out):
 
 @main.command()
 @click.argument("service")
+@click.option("--last", type=int, default=1,
+              help="fetch the N most recent call trees per pod")
+@click.option("--trace-id", "trace_id", default=None,
+              help="fetch one specific trace (assembled across pods "
+                   "via the controller when one is configured)")
+@click.option("-o", "--out", default="trace.json",
+              help="output file (Chrome trace_event JSON — opens "
+                   "directly in ui.perfetto.dev)")
+def trace(service, last, trace_id, out):
+    """Fetch distributed-trace spans from a deployed service and write
+    a Perfetto-ready trace file, printing a per-stage summary.
+
+    Every pod keeps a ring of spans (client call → channel → pod server
+    → worker → device placement); this pulls each pod's ``GET /_trace``,
+    merges in the controller's cross-pod assembly for --trace-id, and
+    writes one file whose flow arrows stitch the hops together."""
+    import httpx
+
+    from kubetorch_tpu.observability import tracing
+    from kubetorch_tpu.provisioning.backend import get_backend
+
+    try:
+        urls = get_backend().pod_urls(service)
+    except KeyError:
+        raise click.ClickException(f"no service {service!r}")
+    if not urls:
+        raise click.ClickException(f"no pods for service {service!r}")
+    by_id = {}
+    with httpx.Client(timeout=30.0) as client:
+        for base in urls:
+            params = {"format": "spans"}
+            if trace_id:
+                params["trace_id"] = trace_id
+            else:
+                params["last"] = str(max(1, last))
+            try:
+                resp = client.get(f"{base}/_trace", params=params)
+                resp.raise_for_status()
+            except httpx.HTTPError as exc:
+                click.echo(f"# pod {base}: trace fetch failed ({exc})",
+                           err=True)
+                continue
+            for span in resp.json().get("spans", []):
+                by_id.setdefault(span.get("span_id"), span)
+    from kubetorch_tpu.controller.client import ControllerClient
+
+    controller = ControllerClient.maybe()
+    if controller is not None:
+        if trace_id:
+            # the assembled view may hold spans from pods this backend
+            # no longer lists (slow-call pushes survive pod churn)
+            try:
+                for span in controller.get_trace(trace_id):
+                    by_id.setdefault(span.get("span_id"), span)
+            except Exception:  # noqa: BLE001 — pods already answered
+                pass
+        elif by_id:
+            # re-post what we pulled so later --trace-id queries (and
+            # other operators) see the assembled view
+            try:
+                controller.push_trace(list(by_id.values()))
+            except Exception:  # noqa: BLE001
+                pass
+    spans = [s for s in by_id.values() if s]
+    if not spans:
+        raise click.ClickException(
+            "no spans found — is tracing disabled (KT_TRACE_DISABLE=1), "
+            "or has no traffic hit the service yet?")
+    Path(out).write_text(json.dumps(tracing.to_trace_events(spans)))
+    traces = {s.get("trace_id") for s in spans}
+    click.echo(f"{len(spans)} spans across {len(traces)} trace(s) → "
+               f"{out}  (open in https://ui.perfetto.dev)")
+    click.echo(f"{'stage':<28}{'count':>6}{'total ms':>12}"
+               f"{'mean ms':>10}{'max ms':>10}")
+    for row in tracing.summarize(spans):
+        click.echo(f"{row['name']:<28}{row['count']:>6}"
+                   f"{row['total_ms']:>12}{row['mean_ms']:>10}"
+                   f"{row['max_ms']:>10}")
+
+
+@main.command()
+@click.argument("service")
 @click.option("--pod", type=int, default=None,
               help="only this replica (default: all)")
 @click.option("--stop", default=None, metavar="NAME",

@@ -156,6 +156,23 @@ class ControllerClient:
             f"{self.base_url}/logs/query", params=params))
                 or {}).get("entries") or []
 
+    def push_trace(self, spans: List[Dict[str, Any]]) -> int:
+        """Ship spans into the controller's cross-pod trace assembly."""
+        return int((self._check(self.client.post(
+            f"{self.base_url}/traces", json={"spans": spans}))
+            or {}).get("ingested", 0))
+
+    def get_trace(self, trace_id: str) -> List[Dict[str, Any]]:
+        """Assembled spans for one trace (empty when unknown)."""
+        resp = self.client.get(f"{self.base_url}/traces/{trace_id}")
+        if resp.status_code == 404:
+            return []
+        return (self._check(resp) or {}).get("spans") or []
+
+    def list_traces(self) -> List[Dict[str, Any]]:
+        return (self._check(self.client.get(
+            f"{self.base_url}/traces")) or {}).get("traces") or []
+
     # ------------------------------------------------------------- k8s
     # Generic passthrough over the controller's dynamic-client proxy
     # (server.py h_k8s_*; responses wrap the op result as {"result": ...}).

@@ -43,6 +43,21 @@ def parse_ttl(ttl: Optional[str]) -> Optional[float]:
     return value * {"": 1, "s": 1, "m": 60, "h": 3600, "d": 86400}[m.group(2)]
 
 
+def _tree_names(assembled: Dict[str, Any]) -> List[dict]:
+    """Compact nested view of an assembled trace (names + ms, not the
+    full span dicts — those ride next to it in the same response)."""
+
+    def node(n):
+        s = n["span"]
+        return {"name": s.get("name"), "span_id": s.get("span_id"),
+                "proc": "/".join(p for p in (s.get("pod"),
+                                             s.get("proc")) if p),
+                "ms": round(s.get("dur", 0.0) * 1e3, 3),
+                "children": [node(c) for c in n["children"]]}
+
+    return [node(r) for r in assembled.get("roots", [])]
+
+
 class PodConnection:
     def __init__(self, ws: web.WebSocketResponse, info: Dict[str, Any]):
         self.ws = ws
@@ -177,6 +192,13 @@ class ControllerServer:
             snapshot = MetricsSnapshot(Path(obs_dir) / "metrics.json")
         self.log_sink = LogSink(persist=persist)
         self.metrics_store = MetricsStore(snapshot=snapshot)
+        # Cross-pod trace assembly: pods push span batches (slow-call
+        # auto-capture, or ktpu trace pulls + re-posts) and a
+        # multi-worker fan-out call renders as ONE tree even though no
+        # single pod ever held all of its spans.
+        from kubetorch_tpu.observability.tracing import TraceStore
+
+        self.trace_store = TraceStore()
         # cluster events → log sink (reference: event_watcher.py → Loki
         # under job="kubetorch-events"); only when k8s creds exist.
         from kubetorch_tpu.controller.event_watcher import EventWatcher
@@ -209,6 +231,9 @@ class ControllerServer:
         r.add_delete("/pool/{service}", self.h_teardown_pool)
         r.add_post("/pool/{service}/activity", self.h_activity)
         r.add_get("/ws/pods", self.h_ws_pods)
+        r.add_post("/traces", self.h_traces_push)
+        r.add_get("/traces", self.h_traces_list)
+        r.add_get("/traces/{trace_id}", self.h_trace_get)
         r.add_post("/runs", self.h_create_run)
         r.add_get("/runs", self.h_list_runs)
         r.add_get("/runs/{run_id}", self.h_get_run)
@@ -462,6 +487,39 @@ class ControllerServer:
             if conn is not None:
                 self.hub.remove(conn)
         return ws
+
+    # ---------------------------------------------------------- traces
+    async def h_traces_push(self, request):
+        """Span ingestion (``{"spans": [...]}``): pods auto-push slow
+        call trees here (KT_TRACE_SLOW_MS) and ``ktpu trace`` re-posts
+        what it pulled so later queries see the assembled view."""
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001
+            return web.json_response({"error": "bad json"}, status=400)
+        n = self.trace_store.ingest((body or {}).get("spans") or [])
+        return web.json_response({"ingested": n})
+
+    async def h_traces_list(self, request):
+        return web.json_response({"traces": self.trace_store.list()})
+
+    async def h_trace_get(self, request):
+        """One assembled trace across every pod that pushed spans for
+        it. ``?format=perfetto`` returns Chrome trace_event JSON ready
+        for ui.perfetto.dev; default is raw spans + the parent/child
+        tree."""
+        from kubetorch_tpu.observability import tracing as _tracing
+
+        trace_id = request.match_info["trace_id"]
+        spans = self.trace_store.get(trace_id)
+        if not spans:
+            raise web.HTTPNotFound(text="no such trace")
+        if request.query.get("format") == "perfetto":
+            return web.json_response(_tracing.to_trace_events(spans))
+        return web.json_response({
+            "trace_id": trace_id, "spans": spans,
+            "tree": _tree_names(_tracing.assemble(spans)),
+        })
 
     # ------------------------------------------------------------ runs
     async def h_create_run(self, request):

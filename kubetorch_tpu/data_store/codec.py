@@ -437,6 +437,7 @@ def pack_stream(treedef_str: str, host_leaves, codecs: List[str],
                           header, digest_size=8).hexdigest())
     yield header
     off = len(header)
+    encode_total = 0.0
     for codec, arr in zip(codecs, host_leaves):
         t0 = time.perf_counter()
         chunks, enc = encode_leaf(codec, arr)
@@ -447,13 +448,24 @@ def pack_stream(treedef_str: str, host_leaves, codecs: List[str],
         # property the V1 fast path has); bytes.join on the local backend
         # accepts them too
         yield from chunks
+        if codec != "raw":
+            encode_total += enc_s
+            if record is not None:
+                record["encode_s"] += enc_s
         if record is not None:
             record["frames"].append((off, 8 + enc))
-            if codec != "raw":
-                record["encode_s"] += enc_s
         off += 8 + enc
     if record is not None:
         record["total"] = off
+    if encode_total > 0.0:
+        # the publish-side codec CPU time as one span (it is interleaved
+        # with the socket writes, so per-leaf spans would be confetti)
+        from kubetorch_tpu.observability import tracing
+
+        tracing.record_span("codec.encode", encode_total,
+                            attrs={"codec": codec_name,
+                                   "leaves": len(host_leaves),
+                                   "bytes": off})
 
 
 def packed_size(host_leaves, codecs: List[str],
@@ -481,6 +493,22 @@ class DeltaMismatch(ValueError):
 def build_delta(prev: Dict[str, Any], treedef_str: str, host_leaves,
                 codecs: List[str], digests: List[str]
                 ) -> Optional[Tuple[bytes, Dict[str, Any], Dict[str, Any]]]:
+    """Span-recording wrapper over :func:`_build_delta` (the patch
+    construction is publish-path CPU the trace must show: it decides
+    whether kilobytes or gigabytes cross the wire)."""
+    t0 = time.perf_counter()
+    out = _build_delta(prev, treedef_str, host_leaves, codecs, digests)
+    from kubetorch_tpu.observability import tracing
+
+    tracing.record_span("codec.build_delta", time.perf_counter() - t0,
+                        attrs={"built": out is not None})
+    return out
+
+
+def _build_delta(prev: Dict[str, Any], treedef_str: str, host_leaves,
+                 codecs: List[str], digests: List[str]
+                 ) -> Optional[Tuple[bytes, Dict[str, Any],
+                                     Dict[str, Any]]]:
     """Byte-level patch re-sending only changed leaves.
 
     ``prev`` is the manifest :func:`pack_stream` recorded for the last

@@ -17,6 +17,7 @@ fetch — the async-GRPO pattern); steady-state checkpointing should prefer
 
 from __future__ import annotations
 
+import contextvars
 import functools
 import io
 import os
@@ -33,6 +34,7 @@ from kubetorch_tpu.data_store import codec as codec_mod
 from kubetorch_tpu.data_store import commands as store
 from kubetorch_tpu.data_store.types import BLOB_DELTA_SUFFIX
 from kubetorch_tpu.exceptions import DataStoreError
+from kubetorch_tpu.observability import tracing
 
 _MAGIC = b"KTARRV1\x00"
 
@@ -572,6 +574,14 @@ def put_arrays(key: str, tree: Any, codec: Optional[str] = None,
     codec = codec_mod.resolve_codec(codec)
     delta = codec_mod.delta_enabled(delta)
     backend = DataStoreClient.default()._backend()
+    with tracing.span("store.put_arrays",
+                      attrs={"key": key, "codec": codec,
+                             "delta": bool(delta)}):
+        return _put_arrays(key, tree, codec, delta, backend)
+
+
+def _put_arrays(key: str, tree: Any, codec: str, delta: bool,
+                backend) -> str:
     t_start = time.perf_counter()
     host_leaves, treedef = _host_leaves(tree)
     raw_bytes = sum(a.nbytes for a in host_leaves)
@@ -703,8 +713,15 @@ class _PlacementPipeline:
         self.dequant_s = 0.0
         self.leaves_placed = 0
         self.bytes_placed = 0
+        # copy_context: a bare Thread starts from an EMPTY context, so
+        # the restore's request_id_var and ambient trace span would both
+        # vanish here — restore log lines from this thread carried
+        # request_id="-", and device_put spans would start orphan traces
+        # instead of nesting under store.get_arrays.
+        ctx = contextvars.copy_context()
         self._thread = threading.Thread(
-            target=self._run, name="kt-restore-place", daemon=True)
+            target=lambda: ctx.run(self._run),
+            name="kt-restore-place", daemon=True)
         self._thread.start()
 
     def _run(self):
@@ -718,6 +735,8 @@ class _PlacementPipeline:
                 continue  # drain so the producer never blocks forever
             idxs, arrays, sharding, scale_sh = item
             t0 = time.perf_counter()
+            wall0 = time.time()
+            dequant_d = 0.0
             try:
                 if scale_sh is not None:
                     # int8-coded batch: ship the SMALL representation over
@@ -733,7 +752,8 @@ class _PlacementPipeline:
                         _dequant_fn(l.dtype.name, sharding)(q, s)
                         for l, q, s in zip(arrays, qs, ss)]
                     jax.block_until_ready(placed)
-                    self.dequant_s += time.perf_counter() - t1
+                    dequant_d = time.perf_counter() - t1
+                    self.dequant_s += dequant_d
                 else:
                     placed = jax.device_put(arrays, sharding)
                     # block HERE, on the pipeline thread: device_put
@@ -745,7 +765,19 @@ class _PlacementPipeline:
             except BaseException as exc:  # surfaced in close()/submit()
                 self.error = exc
                 continue
-            self.place_s += time.perf_counter() - t0
+            batch_s = time.perf_counter() - t0
+            self.place_s += batch_s
+            # one span per coalesced batch, timed over EXACTLY the
+            # interval summed into place_s — so a trace's device_put
+            # spans reconcile with the restore_last_place_seconds gauge
+            tracing.record_span(
+                "restore.device_put", batch_s, start=wall0,
+                attrs={"leaves": len(idxs),
+                       "bytes": sum(a.nbytes for a in arrays)})
+            if dequant_d > 0.0:
+                tracing.record_span(
+                    "restore.dequant", dequant_d,
+                    attrs={"leaves": len(idxs)})
             for i, arr in zip(idxs, placed):
                 self.out[i] = arr
             self.leaves_placed += len(idxs)
@@ -937,6 +969,18 @@ def _streamed_restore(chunks: Iterable, template: Optional[Any],
         place_s = pipeline.place_s
         dequant_s = pipeline.dequant_s
     wall_s = time.perf_counter() - t_start
+    # dataplane spans: the fetch loop (time blocked on the wire/file) and
+    # the incremental codec decode, timed from the already-instrumented
+    # accumulators — together with the pipeline thread's device_put
+    # spans these are the per-restore tree "where did it go" view
+    tracing.record_span(
+        "restore.fetch", fetch_s,
+        start=time.time() - wall_s,
+        attrs={"bytes": bytes_streamed,
+               "leaves": unpacker.num_leaves or 0})
+    if unpacker.decode_s > 0.0:
+        tracing.record_span("restore.decode", unpacker.decode_s,
+                            attrs={"raw_bytes": unpacker.raw_bytes})
     # Fraction of placement time hidden under the fetch: 1.0 = placement
     # fully overlapped (wall ≈ fetch), 0.0 = serial fetch-then-place.
     hidden = fetch_s + place_s - wall_s
@@ -1132,6 +1176,17 @@ def get_arrays(
     codec is transparent on this side — V1 and codec-framed V2 blobs both
     restore, int8 leaves dequantizing on device when shardings are given.
     """
+    with tracing.span("store.get_arrays",
+                      attrs={"key": key,
+                             "sharded": shardings is not None}):
+        return _get_arrays(key, template, shardings, broadcast,
+                           streaming=streaming, chunk_bytes=chunk_bytes,
+                           batch_bytes=batch_bytes,
+                           pipeline_depth=pipeline_depth, delta=delta)
+
+
+def _get_arrays(key, template, shardings, broadcast, *, streaming,
+                chunk_bytes, batch_bytes, pipeline_depth, delta):
     import jax
 
     from kubetorch_tpu.data_store.client import DataStoreClient

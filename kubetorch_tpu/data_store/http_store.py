@@ -19,6 +19,7 @@ from typing import List, Optional
 import httpx
 
 from kubetorch_tpu.exceptions import DataStoreError, RsyncError
+from kubetorch_tpu.observability import tracing
 from kubetorch_tpu.retry import (
     RetryableStatus,
     raise_if_retryable,
@@ -76,6 +77,10 @@ class HttpStoreBackend:
         safely re-run. Streamed bodies must come as ``content_factory``
         (a zero-arg callable): a plain generator would arrive exhausted
         on the retry and silently upload an empty body."""
+        # every store request carries the trace context: a weight-sync
+        # restore's store hops join the same tree as the serving call
+        # that triggered them
+        kw["headers"] = tracing.inject(dict(kw.get("headers") or {}))
 
         def attempt():
             kw2 = (dict(kw, content=content_factory())
@@ -203,14 +208,16 @@ class HttpStoreBackend:
         copies and none of h1-framing overhead that caps httpx uploads at
         weight scale (the GET side made the same trade; see get_blob)."""
         if length is None:
-            resp = self._request("PUT", self._url(f"/blob/{key}"),
-                                 content_factory=factory)
+            with tracing.span("store.put_blob", attrs={"key": key}):
+                resp = self._request("PUT", self._url(f"/blob/{key}"),
+                                     content_factory=factory)
             self._raise_for(resp, "put")
             return key
         import http.client as _hc
 
         make_conn, quoted_path = raw_target(self._url(f"/blob/{key}"))
         seen_iters: list = []
+        trace_hdr = tracing.format_ctx()
 
         def attempt():
             chunks = factory()
@@ -233,6 +240,8 @@ class HttpStoreBackend:
                 conn.putrequest("PUT", quoted_path)
                 conn.putheader("Content-Length", str(length))
                 conn.putheader("Content-Type", "application/octet-stream")
+                if trace_hdr:
+                    conn.putheader(tracing.HEADER, trace_hdr)
                 conn.endheaders()
                 sent = 0
                 for chunk in chunks:
@@ -250,10 +259,12 @@ class HttpStoreBackend:
                 conn.close()
 
         try:
-            status, body = with_retries(
-                attempt, retry_on=(OSError, _hc.HTTPException,
-                                   RetryableStatus),
-                max_attempts=self.retry_attempts)
+            with tracing.span("store.put_blob",
+                              attrs={"key": key, "bytes": int(length)}):
+                status, body = with_retries(
+                    attempt, retry_on=(OSError, _hc.HTTPException,
+                                       RetryableStatus),
+                    max_attempts=self.retry_attempts)
         except RetryableStatus as exc:
             raise DataStoreError(
                 f"store put {key!r} failed after retries: {exc}",
@@ -285,11 +296,14 @@ class HttpStoreBackend:
         import http.client as _hc
 
         make_conn, quoted_path = raw_target(self._url(f"/blob/{key}"))
+        trace_hdr = tracing.format_ctx()
 
         def attempt():
             conn = make_conn()
             try:
-                conn.request("GET", quoted_path)
+                conn.request("GET", quoted_path,
+                             headers=({tracing.HEADER: trace_hdr}
+                                      if trace_hdr else {}))
                 resp = conn.getresponse()
                 if resp.status in (502, 503, 504):
                     raise RetryableStatus(resp.status,
@@ -315,7 +329,17 @@ class HttpStoreBackend:
 
         import time as _time
 
+        hspan = tracing.start_span("store.get_blob",
+                                   attrs={"key": key})
         deadline = _time.time() + 120.0
+        try:
+            return self._get_blob_polled(attempt, key, deadline, _hc,
+                                         _time, hspan)
+        finally:
+            hspan.end()
+
+    def _get_blob_polled(self, attempt, key, deadline, _hc, _time,
+                         hspan):
         while True:
             try:
                 status, body = with_retries(
@@ -350,6 +374,7 @@ class HttpStoreBackend:
             raise DataStoreError(
                 f"store get failed ({status}): {body[:200]!r}",
                 status=status)
+        hspan.end({"bytes": len(body)})  # caller's finally no-ops after
         return body
 
     def put_blob_delta(self, key: str, delta: bytes) -> str:
@@ -408,6 +433,7 @@ class HttpStoreBackend:
 
         make_conn, quoted_path = raw_target(self._url(f"/blob/{key}"))
         max_attempts = self.retry_attempts or _policy_attempts()
+        trace_hdr = tracing.format_ctx()
         offset = 0
         progressed_to = 0
         total = None
@@ -421,6 +447,8 @@ class HttpStoreBackend:
             try:
                 conn = make_conn()
                 headers = ({"Range": f"bytes={offset}-"} if offset else {})
+                if trace_hdr:
+                    headers[tracing.HEADER] = trace_hdr
                 conn.request("GET", quoted_path, headers=headers)
                 resp = conn.getresponse()
                 if resp.status in (502, 503, 504):

@@ -49,24 +49,38 @@ _installed: Optional["LogCapture"] = None
 
 class _TeeStream:
     """File-like wrapper: writes pass through to the original stream and
-    complete lines are emitted to the capture."""
+    complete lines are emitted to the capture.
+
+    Re-entrancy guard: anything the capture path itself writes to
+    stdout/stderr (a log handler that prints, a labels_fn that logs, an
+    exception formatter) re-enters ``write`` THROUGH the tee — without
+    the per-thread guard that recursion is unbounded (emit → write →
+    emit → ...). Re-entered writes still pass through to the original
+    stream; they just don't re-emit."""
 
     def __init__(self, original, capture: "LogCapture", source: str):
         self.original = original
         self.capture = capture
         self.source = source
         self._buf = ""
+        self._reentry = threading.local()
 
     def write(self, s: str) -> int:
         try:
             n = self.original.write(s)
         except Exception:
             n = len(s)
-        self._buf += s
-        while "\n" in self._buf:
-            line, self._buf = self._buf.split("\n", 1)
-            if line.strip():
-                self.capture.emit(line, source=self.source)
+        if getattr(self._reentry, "active", False):
+            return n if isinstance(n, int) else len(s)
+        self._reentry.active = True
+        try:
+            self._buf += s
+            while "\n" in self._buf:
+                line, self._buf = self._buf.split("\n", 1)
+                if line.strip():
+                    self.capture.emit(line, source=self.source)
+        finally:
+            self._reentry.active = False
         return n if isinstance(n, int) else len(s)
 
     def flush(self):

@@ -29,9 +29,21 @@ _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _LABEL_ESC = str.maketrans({"\\": r"\\", '"': r"\"", "\n": r"\n"})
 
 # metric name suffix → TYPE hint (exposition metadata; scrapers work
-# without it but Grafana's rate() suggestions use it). ``_bucket``
-# samples are cumulative histogram counters.
+# without it but Grafana's rate() suggestions use it). ``_bucket``/
+# ``_sum``/``_count`` families that belong to a histogram are grouped
+# under the BASE name with one ``# TYPE <base> histogram`` header in
+# render() — required for histogram_quantile() and Grafana heatmaps to
+# recognize the series; standalone ``_sum``/``_count``/``_total`` names
+# stay counters.
 _COUNTER_SUFFIXES = ("_total", "_sum", "_count", "_bucket")
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _hist_base(name: str) -> Optional[str]:
+    for suffix in _HIST_SUFFIXES:
+        if name.endswith(suffix):
+            return name[:-len(suffix)]
+    return None
 
 
 def metric_name(raw: str, prefix: str = "kubetorch_") -> str:
@@ -59,6 +71,14 @@ def render(samples: Iterable[Tuple[str, Dict[str, str], Any]],
     Non-numeric values are skipped (the JSON snapshots carry strings like
     hostnames); bools count as 0/1. Samples are grouped by metric so the
     ``# TYPE`` header appears once per family, as the format requires.
+
+    Histogram detection: a ``<base>_sum``/``<base>_count`` family whose
+    ``<base>_bucket`` family is present in the same render belongs to a
+    histogram — all three emit together under one
+    ``# TYPE <base> histogram`` header (separate ``counter`` headers per
+    suffix made Grafana heatmaps and ``histogram_quantile`` blind to the
+    series). A bare ``_sum``/``_count`` with no sibling buckets (e.g.
+    ``http_request_duration_seconds_sum``) stays a plain counter.
     """
     families: Dict[str, list] = {}
     for raw, labels, value in samples:
@@ -68,8 +88,24 @@ def render(samples: Iterable[Tuple[str, Dict[str, str], Any]],
             continue
         families.setdefault(metric_name(raw, prefix), []).append(
             (labels, value))
+    hist_bases = {base for base in
+                  (_hist_base(name) for name in families)
+                  if base is not None and f"{base}_bucket" in families}
     lines = []
+    emitted: set = set()
     for name in sorted(families):
+        if name in emitted:
+            continue
+        base = _hist_base(name)
+        if base in hist_bases:
+            lines.append(f"# TYPE {base} histogram")
+            for suffix in _HIST_SUFFIXES:
+                family = f"{base}{suffix}"
+                for labels, value in families.get(family, []):
+                    lines.append(
+                        f"{family}{_fmt_labels(labels)} {value}")
+                emitted.add(family)
+            continue
         kind = ("counter" if name.endswith(_COUNTER_SUFFIXES)
                 else "gauge")
         lines.append(f"# TYPE {name} {kind}")

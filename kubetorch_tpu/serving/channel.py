@@ -47,6 +47,7 @@ from typing import Any, Dict, Iterable, Optional
 
 from kubetorch_tpu import serialization
 from kubetorch_tpu.exceptions import rehydrate_exception
+from kubetorch_tpu.observability import tracing
 from kubetorch_tpu.serving import frames
 
 DEFAULT_DEPTH_ENV = "KT_CHANNEL_DEPTH"
@@ -101,6 +102,10 @@ class ChannelCall:
         self._t_send = time.perf_counter()
         # decomposition (seconds); wire fills in at terminal
         self._t: Dict[str, float] = {"client_ser": client_ser_s}
+        # client-side "channel.call" span: opened by submit(), ended at
+        # the terminal frame (the ISSUE's "inflight" span — send to
+        # resolution, the client wall the decomposition splits)
+        self._span = None
 
     # ------------------------------------------------------ loop side
     def _resolve(self, header: dict, payload: bytes):
@@ -145,6 +150,13 @@ class ChannelCall:
             if isinstance(server_t.get(key), (int, float)):
                 self._t[stage] = float(server_t[key])
         self._t["wire"] = max(0.0, wall - self._t.get("server", 0.0))
+        if self._span is not None:
+            # end() is idempotent; the handle stays on the call so
+            # callers (and tests) can read the trace id afterwards
+            self._span.end({k: round(v * 1e3, 3)
+                            for k, v in self._t.items()},
+                           error=(type(self._exc).__name__
+                                  if self._exc is not None else None))
         if record:
             try:
                 from kubetorch_tpu.observability import prometheus as prom
@@ -272,6 +284,7 @@ class CallChannel:
         )
 
         t0 = time.perf_counter()
+        ser_wall0 = time.time()
         body, used = serialization.choose(
             build_call_body(args, kwargs or {}), ser or self.ser,
             self.allowed)
@@ -285,6 +298,24 @@ class CallChannel:
             (self._sem.release if self._sem is not None else None))
         with self._calls_lock:
             self._calls[cid] = call
+        # one span per call, opened at submit, closed at the terminal
+        # frame; its context rides the control header so the server (and
+        # transitively the worker) parent under it. Backdated to t0:
+        # serialization AND the pipeline-slot wait (the backpressure
+        # blocking above) are part of the user-perceived call, and the
+        # channel.send child must not precede its parent. detach() right
+        # away: pipelined submits must be siblings, not nested.
+        hspan = tracing.start_span(
+            "channel.call", started_perf=t0, attrs={
+                "cid": cid, "callable": self.callable_name,
+                "method": method or self.default_method or "",
+                "transport": "channel"})
+        trace = tracing.format_ctx(getattr(hspan, "context", None))
+        hspan.detach()
+        call._span = hspan if trace is not None else None
+        tracing.record_span("channel.send", ser_s, start=ser_wall0,
+                            parent=getattr(hspan, "context", None),
+                            attrs={"bytes": len(body)})
         header = {
             "cid": cid, "kind": "call",
             "callable": self.callable_name,
@@ -293,6 +324,8 @@ class CallChannel:
             "concurrent": bool(concurrent),
             "rid": uuid.uuid4().hex[:12],
         }
+        if trace:
+            header["trace"] = trace
         envelope = frames.pack_envelope(header, body)
         call._t_send = time.perf_counter()
         self._run_soon(self._send(cid, envelope), call)

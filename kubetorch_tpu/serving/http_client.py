@@ -15,6 +15,7 @@ import httpx
 
 from kubetorch_tpu import serialization
 from kubetorch_tpu.exceptions import rehydrate_exception
+from kubetorch_tpu.observability import tracing
 from kubetorch_tpu.retry import (
     CONNECT_ERRORS,
     with_retries,
@@ -90,7 +91,9 @@ def _prepare(
         "Content-Type": ("application/json" if used == "json"
                          else "application/octet-stream"),
     }
-    return body, headers
+    # trace propagation: the pod's server.call span parents under the
+    # caller's ambient span (client.call below, or a user-opened one)
+    return body, tracing.inject(headers)
 
 
 def _handle(resp: httpx.Response) -> Any:
@@ -129,32 +132,46 @@ def call_method(
     produced; returns an iterator of items. (A non-generator result still
     arrives as a single item.) Without it, generator results arrive as one
     list."""
-    body, headers = _prepare(args, kwargs or {}, ser, allowed)
-    url = f"{base_url.rstrip('/')}/{callable_name}"
-    if method:
-        url += f"/{method}"
-    if stream:
-        headers = {**headers, "X-KT-Stream": "request"}
-        return _stream_call(url, body, headers, query, timeout)
+    # client-side root span covering the whole round trip (unless the
+    # caller already opened one): the X-KT-Trace header _prepare injects
+    # carries its context, so the pod's server.call span parents here
+    # and GET /_trace + the controller assembly can stitch
+    # client → server → worker.
+    hspan = tracing.start_span("client.call",
+                               attrs={"callable": callable_name,
+                                      "method": method or "",
+                                      "transport": "post"})
+    try:
+        body, headers = _prepare(args, kwargs or {}, ser, allowed)
+        url = f"{base_url.rstrip('/')}/{callable_name}"
+        if method:
+            url += f"/{method}"
+        if stream:
+            headers = {**headers, "X-KT-Stream": "request"}
+            hspan.end({"stream": True})
+            return _stream_call(url, body, headers, query, timeout)
 
-    # Connect-tier retries only: a connection that never reached the pod
-    # (reset mid-deploy, pod restarting) is always safe to re-dial, while
-    # re-POSTing after a read failure could double-execute a
-    # non-idempotent user function. Reference: rsync_client.py:41 retry
-    # discipline, applied to the call path with the narrower error set.
-    # The pooled client is resolved ONCE, outside the retry closure: every
-    # attempt reuses the same keep-alive pool, so a retry re-dials only
-    # the one dead connection instead of paying a fresh client (and a
-    # fresh TCP+TLS handshake for every connection in it).
-    client = sync_client()
+        # Connect-tier retries only: a connection that never reached the
+        # pod (reset mid-deploy, pod restarting) is always safe to
+        # re-dial, while re-POSTing after a read failure could
+        # double-execute a non-idempotent user function. Reference:
+        # rsync_client.py:41 retry discipline, applied to the call path
+        # with the narrower error set. The pooled client is resolved
+        # ONCE, outside the retry closure: every attempt reuses the same
+        # keep-alive pool, so a retry re-dials only the one dead
+        # connection instead of paying a fresh client (and a fresh
+        # TCP+TLS handshake for every connection in it).
+        client = sync_client()
 
-    def attempt():
-        return client.post(
-            url, content=body, headers=headers, params=query or {},
-            timeout=timeout if timeout is not None else _TIMEOUT)
+        def attempt():
+            return client.post(
+                url, content=body, headers=headers, params=query or {},
+                timeout=timeout if timeout is not None else _TIMEOUT)
 
-    resp = with_retries(attempt, retry_on=CONNECT_ERRORS)
-    return _handle(resp)
+        resp = with_retries(attempt, retry_on=CONNECT_ERRORS)
+        return _handle(resp)
+    finally:
+        hspan.end()  # no-op when the stream branch already ended it
 
 
 def _stream_call(url, body, headers, query, timeout):

@@ -16,6 +16,7 @@ from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
 from kubetorch_tpu.exceptions import StartupError
+from kubetorch_tpu.observability import tracing
 from kubetorch_tpu.serving.process_worker import (
     SETUP,
     ProcessWorker,
@@ -156,6 +157,12 @@ class ProcessPool:
         # process boundary) — the worker differences it into the
         # per-call dispatch stage of the latency decomposition
         req["_t_submit"] = time.time()
+        # trace context crosses the process boundary next to request_id:
+        # the server handler's span is ambient here (copy_context through
+        # the executor), so the worker's spans parent under it
+        trace = tracing.format_ctx()
+        if trace:
+            req["trace"] = trace
         worker.send(req)
         return fut, chan
 

@@ -27,6 +27,7 @@ from typing import Any, Dict, Optional
 
 from kubetorch_tpu import serialization
 from kubetorch_tpu.exceptions import package_exception
+from kubetorch_tpu.observability import tracing
 
 _CTX = mp.get_context("spawn")
 
@@ -121,6 +122,9 @@ def _attach_worker_metrics(agg: Dict[str, int]) -> None:
                    if k.startswith("serving_worker_") and v}
         if serving:
             agg["serving"] = {"pid": os.getpid(), **serving}
+        trace = tracing.trace_metrics()
+        if trace.get("trace_spans_total"):
+            agg["trace"] = {"pid": os.getpid(), **trace}
     except Exception:
         pass  # metrics must never break a call response
 
@@ -216,8 +220,23 @@ class _WorkerLoop:
                     "zip_path": zip_path}
         raise ValueError(f"unknown profile action {action!r}")
 
+    @staticmethod
+    def _attach_trace(stats: Optional[Dict], seq0: int,
+                      trace_id: Optional[str]) -> Optional[Dict]:
+        """Piggyback this call's spans on the response next to the
+        device stats: the worker's ring has no HTTP surface, so spans
+        must hop to the pod server's ring to be exportable via
+        ``GET /_trace`` (dedup by span_id there makes re-sends safe)."""
+        spans = (tracing.recorder.since(seq0, trace_id=trace_id)
+                 if trace_id else None)
+        if spans:
+            stats = dict(stats or {})
+            stats["trace_spans"] = spans
+        return stats
+
     async def _execute(self, req: dict) -> dict:
         req_id = req["req_id"]
+        wspan = None
         try:
             if req["kind"] == SETUP:
                 for key, value in (req.get("env") or {}).items():
@@ -268,6 +287,24 @@ class _WorkerLoop:
             )
 
             rid_token = request_id_var.set(rid)
+            # Trace context arrives in the request dict next to
+            # request_id (the server's span, propagated by pool._submit):
+            # activate it so every span from here down — including
+            # dataplane spans from a user weight-sync restore — parents
+            # correctly across the process boundary.
+            trace_ctx = tracing.parse_ctx(req.get("trace"))
+            trace_token = tracing.activate(trace_ctx) \
+                if trace_ctx is not None else None
+            seq0 = tracing.recorder.seq
+            tracing.record_span(
+                "worker.dispatch", dispatch_s,
+                start=float(req.get("_t_submit") or t_start),
+                parent=trace_ctx, remote=trace_ctx is not None)
+            wspan = tracing.start_span(
+                "worker.execute",
+                attrs={"method": req.get("method") or "",
+                       "rank": os.environ.get("LOCAL_RANK", "0")},
+                remote=trace_ctx is not None)
             try:
                 body = serialization.loads(req["body"], req["serialization"])
                 args = body.get("args", [])
@@ -296,26 +333,46 @@ class _WorkerLoop:
                     # terminal marker. The generator body runs here, still
                     # under this request's id/env.
                     await self._stream_result(req, result)
+                    wspan.end({"stream": True})
                     return {"req_id": req_id, "ok": True,
                             "stream_end": True,
                             "timings": self._call_timings(
                                 time.perf_counter() - t_exec0, dispatch_s),
-                            "device_stats": _maybe_device_stats()}
+                            "device_stats": self._attach_trace(
+                                _maybe_device_stats(), seq0,
+                                wspan.span["trace_id"]
+                                if wspan.span else None)}
                 exec_s = time.perf_counter() - t_exec0
+                wspan.end({"exec_ms": round(exec_s * 1e3, 3)})
             finally:
                 request_id_var.reset(rid_token)
+                if trace_token is not None:
+                    tracing.deactivate(trace_token)
             payload, used = serialization.choose(
                 {"result": result}, req["serialization"],
                 req.get("allowed", serialization.METHODS))
             return {"req_id": req_id, "ok": True, "payload": payload,
                     "serialization": used,
                     "timings": self._call_timings(exec_s, dispatch_s),
-                    "device_stats": _maybe_device_stats()}
+                    "device_stats": self._attach_trace(
+                        _maybe_device_stats(), seq0,
+                        wspan.span["trace_id"] if wspan.span else None)}
         except BaseException as exc:  # noqa: BLE001 — must package everything
             if isinstance(exc, (KeyboardInterrupt, SystemExit)):
                 raise
-            return {"req_id": req_id, "ok": False,
+            resp = {"req_id": req_id, "ok": False,
                     "error": package_exception(exc)["error"]}
+            if wspan is not None:
+                wspan.end(error=f"{type(exc).__name__}: {exc}")
+                # failed calls are the PRIMARY tracing use case: their
+                # worker spans must still reach the pod's exportable
+                # ring, so piggyback them on the error response too
+                stats = self._attach_trace(
+                    None, seq0,
+                    wspan.span["trace_id"] if wspan.span else None)
+                if stats:
+                    resp["device_stats"] = stats
+            return resp
 
     def _call_timings(self, exec_s: float, dispatch_s: float) -> dict:
         """Worker-side stages of the per-call decomposition: ``exec_s``
@@ -407,6 +464,8 @@ def worker_main(request_q, response_q, env: Dict[str, str]):
     """Entrypoint of the spawned process."""
     for key, value in env.items():
         os.environ[key] = str(value)
+    tracing.set_process_label(
+        f"worker-r{os.environ.get('LOCAL_RANK', '0')}")
     # Stream this worker's stdout/stderr/logging to the log sink, labeled
     # with rank + request id (reference forwards subprocess logs over a
     # queue, serving/log_capture.py; direct push is simpler and per-rank).

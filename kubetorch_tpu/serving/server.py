@@ -26,7 +26,7 @@ import signal
 import threading
 import time
 import uuid
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from aiohttp import ClientSession, WSMsgType, web
 
@@ -35,6 +35,7 @@ from kubetorch_tpu.exceptions import (
     PodTerminatedError,
     package_exception,
 )
+from kubetorch_tpu.observability import tracing
 from kubetorch_tpu.serving.supervisor import supervisor_factory
 from kubetorch_tpu.version import __version__
 
@@ -43,7 +44,7 @@ request_id_var: contextvars.ContextVar = contextvars.ContextVar(
 
 _RESERVED = {"health", "ready", "metrics", "app", "http", "_reload",
              "_teardown", "_gpu", "_debug", "_profile", "_actors",
-             "_channel"}
+             "_channel", "_trace"}
 
 
 def metadata_from_env() -> Dict[str, Any]:
@@ -128,6 +129,7 @@ class PodServer:
         app.router.add_get("/health", self.h_health)
         app.router.add_get("/ready", self.h_ready)
         app.router.add_get("/metrics", self.h_metrics)
+        app.router.add_get("/_trace", self.h_trace)
         app.router.add_get("/app/status", self.h_app_status)
         app.router.add_get("/_channel", self.h_channel)
         app.router.add_post("/_reload", self.h_reload)
@@ -149,6 +151,7 @@ class PodServer:
     async def _on_startup(self, app):
         from kubetorch_tpu.observability.log_capture import install_from_env
 
+        tracing.set_process_label("pod-server")
         self.log_capture = install_from_env("pod")
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGTERM,):
@@ -396,13 +399,19 @@ class PodServer:
 
     # group name in a worker's stats dict → metric-name prefix
     _PROC_GROUPS = {"data_store_restore": "data_store_",
-                    "data_store": "data_store_", "serving": ""}
+                    "data_store": "data_store_", "serving": "",
+                    "trace": ""}
 
     def _merge_worker_stats(self, stats: Dict[str, Any]):
         """Fold a worker's per-call stats dict into pod metrics. Plain
         gauges (device memory) merge flat — freshest wins; pid-tagged
         snapshots (restore + serving counters) go through per-process
-        aggregation."""
+        aggregation. Worker-side trace spans piggyback here too (the
+        worker's ring is invisible to HTTP; the pod's /_trace is the
+        export surface, so spans must hop to THIS process's ring)."""
+        spans = stats.pop("trace_spans", None)
+        if spans:
+            tracing.recorder.ingest(spans)
         for group in self._PROC_GROUPS:
             entry = stats.pop(group, None)
             if entry is not None:
@@ -455,6 +464,11 @@ class PodServer:
         serving = prom.serving_metrics()
         if any(serving.values()):
             self._merge_proc_snapshot("serving", "server", serving)
+        # Tracing counters (spans recorded / dropped / slow pushes —
+        # worker processes piggyback theirs next to the device stats).
+        trace = tracing.trace_metrics()
+        if any(trace.values()):
+            self._merge_proc_snapshot("trace", "server", trace)
         data = {**self.metrics, "workers_healthy": healthy}
         if prom.wants_prometheus(request):
             # Prometheus/OpenMetrics scrapers (Accept: text/plain...) get
@@ -480,6 +494,32 @@ class PodServer:
             return web.json_response({"running": False, "reason": "no app"})
         rc = self.app_proc.returncode
         return web.json_response({"running": rc is None, "returncode": rc})
+
+    async def h_trace(self, request):
+        """Export this pod's span ring. Default: Chrome/Perfetto
+        ``trace_event`` JSON (open the body directly in
+        ``ui.perfetto.dev``) — pid/tid mapped to pod/process, flow
+        events stitching cross-process parent edges. ``?format=spans``
+        returns the raw span dicts (what ``ktpu trace`` and the
+        controller assembly consume); ``?trace_id=`` filters one trace,
+        ``?last=N`` the N most recently started ones. Worker-process
+        spans are here too — they piggyback on call responses into this
+        ring (see ``_merge_worker_stats``)."""
+        trace_id = request.query.get("trace_id")
+        last = request.query.get("last")
+        if trace_id:
+            spans = tracing.recorder.snapshot(trace_id=trace_id)
+        elif last:
+            try:
+                n = max(1, int(last))
+            except ValueError:
+                n = 1
+            spans = tracing.recorder.last_traces(n)
+        else:
+            spans = tracing.recorder.snapshot()
+        if request.query.get("format") == "spans":
+            return web.json_response({"spans": spans})
+        return web.json_response(tracing.to_trace_events(spans))
 
     async def h_reload(self, request):
         """Controller push-reload: new metadata (+ freshly synced code)."""
@@ -545,15 +585,25 @@ class PodServer:
             return web.json_response(package_exception(exc), status=400)
         except Exception as exc:
             return web.json_response(package_exception(exc), status=500)
+        # Embed the active span trace_id so the jax.profiler zip can be
+        # joined back to the spans that triggered the capture: the
+        # caller's propagated context wins, else the most recent trace
+        # in this pod's ring.
+        ctx = tracing.parse_ctx(request.headers.get(tracing.HEADER))
+        trace_id = (ctx[0] if ctx
+                    else tracing.recorder.last_trace_id()) or ""
         if action == "stop" and result.get("zip_path"):
             # worker zipped to the shared filesystem; stream it from there
             return web.FileResponse(
                 result["zip_path"],
                 headers={"Content-Type": "application/zip",
-                         "X-Trace-Dir": result.get("dir", "")})
+                         "X-Trace-Dir": result.get("dir", ""),
+                         "X-KT-Trace-Id": trace_id})
         return web.json_response(
-            {k: v for k, v in result.items()
-             if not isinstance(v, (bytes, bytearray))})
+            {**{k: v for k, v in result.items()
+                if not isinstance(v, (bytes, bytearray))},
+             "trace_id": trace_id},
+            headers={"X-KT-Trace-Id": trace_id})
 
     async def h_proxy(self, request: web.Request):
         """Reverse proxy to an App's own HTTP port (reference:
@@ -694,33 +744,61 @@ class PodServer:
             query["_stream_req"] = "1"
 
         loop = asyncio.get_running_loop()
+        # server-side span, parented to the caller's X-KT-Trace context.
+        # copy_context AFTER starting it: the executor thread (and the
+        # pool _submit that runs there) inherits the span, which is how
+        # the trace context reaches the worker next to request_id.
+        wire_ctx = tracing.parse_ctx(request.headers.get(tracing.HEADER))
+        sspan = tracing.start_span(
+            "server.call", parent=wire_ctx, remote=wire_ctx is not None,
+            started_perf=t_recv,
+            attrs={"callable": name, "method": method or "",
+                   "transport": "post"})
+        call_ctx = contextvars.copy_context()
         t_exec = time.perf_counter()
         try:
             resp = await loop.run_in_executor(
                 None,
-                lambda: self.supervisor.call(
+                lambda: call_ctx.run(
+                    self.supervisor.call,
                     body, ser, method=method,
                     distributed_subcall=distributed_subcall,
                     restart_procs=restart_procs, workers=workers,
                     query=query,
                     request_id=request_id_var.get()))
         except Exception as exc:
+            sspan.end(error=f"{type(exc).__name__}: {exc}")
             return web.json_response(package_exception(exc), status=500)
         if resp is None:
+            sspan.end(error="worker returned no response")
             return web.json_response(package_exception(
                 RuntimeError("worker returned no response")), status=500)
         if not resp.get("ok"):
+            # failed calls still export their worker spans (piggybacked
+            # on the error response) and still qualify for slow-capture
+            stats = resp.pop("device_stats", None)
+            if stats:
+                self._merge_worker_stats(stats)
+            sspan.end(error=str(resp["error"].get("type", "error")))
+            tracing.maybe_push_slow(
+                sspan.span["trace_id"] if sspan.span else None,
+                time.perf_counter() - t_recv)
             return web.json_response({"error": resp["error"]}, status=500)
         if "stream" in resp:
             if request.headers.get("X-KT-Stream") == "request":
-                return await self._respond_stream(request, resp["stream"],
-                                                  ser)
+                sspan.detach()
+                try:
+                    return await self._respond_stream(
+                        request, resp["stream"], ser)
+                finally:
+                    sspan.end()
             # plain caller: drain the generator into one list result (one
             # executor handoff for the whole drain — no progressive
             # delivery is needed here)
             resp, err = await self._drain_stream(
                 resp, ser, self.supervisor.allowed)
             if err is not None:
+                sspan.end(error="stream error")
                 return web.json_response(err, status=500)
         stats = resp.pop("device_stats", None)
         if stats:
@@ -732,6 +810,10 @@ class PodServer:
         # measured histogram on either path, and the client can read the
         # X-KT-Timing header to split wall into wire vs server time.
         t = self._call_timings(resp, t_recv, t_exec)
+        sspan.end({"queue_ms": round(t.get("queue_s", 0.0) * 1e3, 3)})
+        tracing.maybe_push_slow(sspan.span["trace_id"]
+                                if sspan.span else None,
+                                time.perf_counter() - t_recv)
         used = resp.get("serialization", ser)
         return web.Response(
             body=resp["payload"],
@@ -739,6 +821,8 @@ class PodServer:
                           else "application/octet-stream"),
             headers={serialization.HEADER: used,
                      "X-KT-Timing": json.dumps(t),
+                     **({"X-KT-Trace-Id": sspan.span["trace_id"]}
+                        if sspan.span else {}),
                      **resp.get("extra_headers", {})})
 
     def _validate_call(self, name: str, ser: str):
@@ -968,17 +1052,34 @@ class PodServer:
             async with send_lock:
                 await ws.send_bytes(frames.pack_envelope(hdr, body))
 
+        span_error: List[str] = []  # stamped on server.execute at end
+
         async def reply_error(exc_or_error, t=None):
             prom.record_channel_event("error")
             self.metrics["http_request_errors_total"] += 1
             error = (package_exception(exc_or_error)["error"]
                      if isinstance(exc_or_error, BaseException)
                      else exc_or_error)
+            span_error.append(str(error.get("type", "error"))
+                              if isinstance(error, dict)
+                              else str(error)[:120])
             hdr: Dict[str, Any] = {"kind": "error"}
             if t:
                 hdr["t"] = t
             await reply(hdr, json.dumps({"error": error}).encode())
 
+        # "server.execute" backdated to receipt so the FIFO wait shows
+        # inside it as the explicit "server.queue" child; the caller's
+        # channel.call span (header["trace"]) is the remote parent, and
+        # copy_context hands this span to the executor thread → pool
+        # _submit → worker, so worker spans parent under it.
+        wire_ctx = tracing.parse_ctx(header.get("trace"))
+        sspan = tracing.start_span(
+            "server.execute", parent=wire_ctx,
+            remote=wire_ctx is not None, started_perf=t_recv,
+            attrs={"cid": cid, "callable": header.get("callable") or "",
+                   "method": header.get("method") or "",
+                   "transport": "channel"})
         try:
             name = header.get("callable") or ""
             method = header.get("method")
@@ -987,10 +1088,15 @@ class PodServer:
             if err is not None:
                 return await reply_error(err[0])
             loop = asyncio.get_running_loop()
+            call_ctx = contextvars.copy_context()
             t_exec = time.perf_counter()
+            tracing.record_span(
+                "server.queue", max(0.0, t_exec - t_recv),
+                parent=getattr(sspan, "context", None))
             try:
                 resp = await loop.run_in_executor(
-                    None, lambda: self.supervisor.call(
+                    None, lambda: call_ctx.run(
+                        self.supervisor.call,
                         payload, ser, method=method, request_id=rid))
             except Exception as exc:  # noqa: BLE001
                 return await reply_error(exc)
@@ -998,6 +1104,12 @@ class PodServer:
                 return await reply_error(
                     RuntimeError("worker returned no response"))
             if not resp.get("ok"):
+                # error responses piggyback worker spans too — ingest
+                # them so the failed call (the one being debugged) shows
+                # its full tree in /_trace
+                stats = resp.pop("device_stats", None)
+                if stats:
+                    self._merge_worker_stats(stats)
                 return await reply_error(
                     resp["error"],
                     t=self._call_timings(resp, t_recv, t_exec))
@@ -1014,8 +1126,13 @@ class PodServer:
                 self._merge_worker_stats(stats)
             t = self._call_timings(resp, t_recv, t_exec)
             used = resp.get("serialization", ser)
+            t0_reply = time.perf_counter()
             await reply({"kind": "result", "ser": used, "t": t},
                         resp["payload"])
+            tracing.record_span(
+                "server.reply", time.perf_counter() - t0_reply,
+                parent=getattr(sspan, "context", None),
+                attrs={"bytes": len(resp["payload"] or b"")})
         except (ConnectionResetError, asyncio.CancelledError):
             raise
         except Exception as exc:  # noqa: BLE001 — a reply must always go
@@ -1024,6 +1141,12 @@ class PodServer:
             except Exception:  # noqa: BLE001 — socket already gone
                 pass
         finally:
+            # failed channel calls must read as failed in /_trace, same
+            # as the POST path's server.call span
+            sspan.end(error=(span_error[0] if span_error else None))
+            tracing.maybe_push_slow(
+                sspan.span["trace_id"] if sspan.span else None,
+                time.perf_counter() - t_recv)
             self.metrics["serving_channel_inflight"] = \
                 prom.channel_inflight(-1)
 

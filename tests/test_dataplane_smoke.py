@@ -45,6 +45,9 @@ EXPECTED_KEYS = {
     "delta_publish_leaves_skipped",
     "delta_fetch_wire_mb",
     "delta_fetch_hit",
+    # distributed tracing instruments the restore/publish paths above
+    "trace_span_count",
+    "trace_overhead_us_per_span",
 }
 
 
@@ -69,5 +72,10 @@ def test_dataplane_dryrun_metric_keys():
     assert out["delta_publish_update_pct"] < 1.0
     assert out["delta_publish_leaves_skipped"] > 0
     assert out["delta_fetch_hit"] == 1.0
+    # the dataplane paths must actually record spans (fetch/decode/
+    # device_put per restore, put/get per publish) at a sane per-span
+    # cost — a silently un-instrumented path would zero the count
+    assert out["trace_span_count"] >= 4
+    assert 0 < out["trace_overhead_us_per_span"] < 1000
     assert "vs_prior_round_gt20pct" not in out, (
         "dryrun toy values must never be compared against prior rounds")

@@ -156,6 +156,58 @@ class TestLogCapture:
         assert entry["labels"]["request_id"] == "rid-42"
         assert entry["labels"]["rank"] == "3"
 
+    def test_crash_path_still_flushes(self, fake_sink, capsys):
+        """A callable that prints and then RAISES must still deliver its
+        buffered lines: the batch sits in the queue when the exception
+        unwinds, and flush() (atexit, or the worker's error response
+        path) must push it — a crash that eats its own diagnostics is
+        the worst observability failure mode."""
+        cap = LogCapture(fake_sink.url, {"service": "s", "pod": "p"})
+        cap.install()
+        try:
+            with pytest.raises(ValueError, match="kaboom"):
+                print("pre-crash breadcrumb")
+                raise ValueError("kaboom")
+        finally:
+            cap.flush()
+            cap.uninstall()
+        lines = [e["line"] for e in fake_sink.entries]
+        assert "pre-crash breadcrumb" in lines
+
+    def test_teestream_reentrancy_does_not_recurse(self, fake_sink,
+                                                   capsys):
+        """A capture path that itself writes to stdout (a log handler
+        printing, a labels_fn logging) re-enters the tee — the
+        per-thread guard must break the emit → write → emit cycle
+        instead of recursing to death."""
+        import sys as _sys
+
+        class _LoudCapture(LogCapture):
+            def emit(self, line, source="stdout", level=None):
+                # the pathological handler: emitting writes to stdout,
+                # which IS the tee while installed
+                _sys.stdout.write(f"handler-saw: {line}\n")
+                super().emit(line, source=source, level=level)
+
+        cap = _LoudCapture(fake_sink.url, {"service": "s"})
+        cap.install()
+        try:
+            print("outer line")
+        finally:
+            cap.flush()
+            cap.uninstall()
+        out = capsys.readouterr().out
+        # tee-through still happened for both the original write and the
+        # handler's own write ...
+        assert "outer line" in out
+        assert "handler-saw: outer line" in out
+        # ... but the handler's write was NOT re-captured (one captured
+        # entry, not an emit-per-emit cascade)
+        lines = [e["line"] for e in fake_sink.entries]
+        assert lines.count("outer line") == 1
+        assert not any(line.startswith("handler-saw: handler-saw:")
+                       for line in lines)
+
 
 class TestDedup:
     def test_dedup_window(self):
@@ -775,7 +827,8 @@ def _assert_exposition_parses(text: str):
     for line in text.strip().splitlines():
         if line.startswith("# TYPE "):
             parts = line.split()
-            assert len(parts) == 4 and parts[3] in ("gauge", "counter"), line
+            assert len(parts) == 4 and parts[3] in (
+                "gauge", "counter", "histogram"), line
             continue
         assert _EXPO_LINE.match(line), f"bad exposition line: {line!r}"
         names.add(line.split("{")[0].split(" ")[0])
@@ -800,6 +853,43 @@ def test_prometheus_render_format():
     assert "hostname" not in text
     assert "# TYPE kubetorch_http_requests_total counter" in text
     assert "# TYPE kubetorch_last_activity_timestamp gauge" in text
+
+
+@pytest.mark.level("unit")
+def test_prometheus_histogram_exposition_grouping():
+    """The ``_bucket``/``_sum``/``_count`` families of one histogram must
+    render under a SINGLE ``# TYPE <base> histogram`` header — separate
+    per-suffix ``counter`` headers make Grafana heatmaps and
+    ``histogram_quantile()`` blind to the series. Plain counters (and a
+    bare ``_sum`` with no sibling buckets, like the pod's
+    ``http_request_duration_seconds_sum``) stay counters."""
+    from kubetorch_tpu.observability import prometheus as prom
+
+    prom.record_call_stages({"wire": 0.004, "device": 0.02})
+    text = prom.render([
+        *prom.serving_histogram_samples({"pod": "p0"}),
+        ("http_requests_total", {"pod": "p0"}, 3),
+        ("http_request_duration_seconds_sum", {"pod": "p0"}, 1.25),
+    ])
+    names = _assert_exposition_parses(text)
+    base = "kubetorch_serving_call_wire_seconds"
+    assert f"# TYPE {base} histogram" in text
+    # no per-suffix TYPE lines for histogram families
+    for suffix in ("_bucket", "_sum", "_count"):
+        assert f"# TYPE {base}{suffix} " not in text
+        assert f"{base}{suffix}" in names
+    # grouped: the sum/count lines sit inside the base's block (between
+    # its TYPE header and the next one)
+    blocks = text.split("# TYPE ")
+    wire_block = next(b for b in blocks
+                      if b.startswith(f"{base} histogram"))
+    assert f"{base}_sum" in wire_block
+    assert f"{base}_count" in wire_block
+    assert 'le="+Inf"' in wire_block
+    # a histogram-suffixed name WITHOUT sibling buckets stays a counter
+    assert ("# TYPE kubetorch_http_request_duration_seconds_sum counter"
+            in text)
+    assert "# TYPE kubetorch_http_requests_total counter" in text
 
 
 @pytest.mark.level("unit")

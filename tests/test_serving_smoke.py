@@ -31,6 +31,10 @@ EXPECTED_KEYS = {
     "serving_tok_s_chan",
     "serving_tok_s_pipelined",
     "serving_pipeline_speedup",
+    # distributed tracing rides every call above; its cost is a
+    # published number, not an assumption
+    "trace_span_count",
+    "trace_overhead_us_per_span",
 }
 
 
@@ -54,5 +58,20 @@ def test_serving_dryrun_metric_keys():
     # the simulated device time must show up in the measured device
     # stage (worker-side execution covers the sleep)
     assert out["serving_device_ms"] >= out["serving_device_ms_cfg"]
+    # tracing is always-on across the bench's calls (client spans at
+    # minimum), and its cost must stay invisible on the pipelined path:
+    # a pipelined channel call records 2 client-side spans
+    # (channel.call + channel.send) — budget 4 for margin and require
+    # their summed overhead under 5% of one pipelined chunk's wall.
+    # (This sandbox measures ~13-16 µs/span, so 4 spans ≈ 65 µs against
+    # a ~160 µs budget — headroom for a noisy host, while a real
+    # regression to ~50 µs/span still fails.)
+    assert out["trace_span_count"] >= 1
+    per_span_us = out["trace_overhead_us_per_span"]
+    assert per_span_us > 0
+    chunk_us = out["serving_chunk_ms_pipelined"] * 1000.0
+    assert per_span_us * 4 < 0.05 * chunk_us, (
+        f"tracing overhead {per_span_us} µs/span × 4 spans/call exceeds "
+        f"5% of the {chunk_us:.0f} µs pipelined chunk")
     # dryrun toy values must never be compared against prior rounds
     assert "rolling_tok_s_tunnel_wall" not in out

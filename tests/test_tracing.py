@@ -1,0 +1,516 @@
+"""End-to-end distributed tracing (ISSUE 4 acceptance): a pipelined
+channel call yields one assembled trace tree — client → server → worker
+spans share a trace_id with correct parent edges — exported via
+``GET /_trace`` as valid Chrome ``trace_event`` JSON; ``ktpu trace``
+writes a Perfetto-ready file; a streamed ``get_arrays`` restore's
+device_put spans reconcile with ``restore_last_place_seconds`` (±10%);
+the controller assembles cross-pod pushes; and the double-buffered
+placement thread inherits contextvars (the request-id regression)."""
+
+import json
+import os
+import time
+from pathlib import Path
+
+import pytest
+
+import kubetorch_tpu as kt
+from kubetorch_tpu.observability import tracing
+from kubetorch_tpu.resources.callables.cls import Cls
+
+ASSETS = Path(__file__).parent / "assets" / "summer"
+
+
+# ------------------------------------------------------------- unit
+@pytest.mark.level("unit")
+class TestSpans:
+    def test_nesting_and_parent_edges(self):
+        with tracing.span("outer") as outer:
+            tid = outer.span["trace_id"]
+            with tracing.span("inner") as inner:
+                assert inner.span["trace_id"] == tid
+                assert inner.span["parent_id"] == outer.span["span_id"]
+            tracing.record_span("timed", 0.005)
+        spans = tracing.recorder.snapshot(trace_id=tid)
+        names = {s["name"]: s for s in spans}
+        assert set(names) == {"outer", "inner", "timed"}
+        assert names["timed"]["parent_id"] == outer.span["span_id"]
+        assert names["outer"]["parent_id"] is None
+        assert names["timed"]["dur"] == pytest.approx(0.005)
+
+    def test_wire_format_roundtrip(self):
+        with tracing.span("root") as root:
+            tp = tracing.format_ctx()
+        assert tp == f"00-{root.span['trace_id']}-{root.span['span_id']}-01"
+        assert tracing.parse_ctx(tp) == root.context
+        # tolerant parsing: bare pair, garbage, empty
+        assert tracing.parse_ctx(
+            f"{root.span['trace_id']}-{root.span['span_id']}"
+        ) == root.context
+        assert tracing.parse_ctx("not-a-context") is None
+        assert tracing.parse_ctx("") is None
+        assert tracing.parse_ctx(None) is None
+
+    def test_disable_env(self, monkeypatch):
+        monkeypatch.setenv("KT_TRACE_DISABLE", "1")
+        before = tracing.recorder.seq
+        with tracing.span("ghost"):
+            assert tracing.format_ctx() is None
+        tracing.record_span("ghost2", 0.001)
+        assert tracing.recorder.seq == before
+
+    def test_ring_eviction_dedup_and_since(self):
+        rec = tracing.SpanRecorder(capacity=16)
+        for i in range(40):
+            rec.record({"trace_id": "t", "span_id": f"s{i}",
+                        "name": "n", "start": float(i), "dur": 0.0})
+        assert len(rec.snapshot()) == 16
+        assert rec.dropped == 24
+        # dedup: re-ingesting an existing span is a no-op
+        seq = rec.seq
+        assert rec.ingest([{"trace_id": "t", "span_id": "s39"}]) == 0
+        assert rec.seq == seq
+        assert [s["span_id"] for s in rec.since(seq - 2)] == \
+            ["s38", "s39"]
+
+    def test_trace_event_export_shape(self):
+        with tracing.span("a") as a:
+            with tracing.span("b"):
+                pass
+        spans = tracing.recorder.snapshot(trace_id=a.span["trace_id"])
+        # simulate a remote child from another process for flow arrows
+        remote = dict(spans[0], span_id="remote1",
+                      parent_id=a.span["span_id"], pid=99999,
+                      proc="worker-r0", name="worker.execute",
+                      remote=True)
+        doc = tracing.to_trace_events(spans + [remote])
+        json.dumps(doc)  # must be valid JSON
+        events = doc["traceEvents"]
+        xs = [e for e in events if e["ph"] == "X"]
+        assert {e["name"] for e in xs} == {"a", "b", "worker.execute"}
+        for e in xs:
+            assert e["ts"] > 0 and e["dur"] > 0
+            assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        metas = [e for e in events if e["ph"] == "M"]
+        assert any(e["name"] == "process_name" for e in metas)
+        # cross-process parent edge → one s/f flow pair
+        flows = [e for e in events if e["ph"] in ("s", "f")]
+        assert {e["ph"] for e in flows} == {"s", "f"}
+        assert len({e["id"] for e in flows}) == 1
+
+    def test_assemble_and_summarize(self):
+        spans = [
+            {"trace_id": "t", "span_id": "r", "parent_id": None,
+             "name": "root", "start": 1.0, "dur": 0.5},
+            {"trace_id": "t", "span_id": "c1", "parent_id": "r",
+             "name": "child", "start": 1.1, "dur": 0.2},
+            {"trace_id": "t", "span_id": "c2", "parent_id": "c1",
+             "name": "leaf", "start": 1.15, "dur": 0.1},
+        ]
+        tree = tracing.assemble(spans)
+        assert tree["span_count"] == 3
+        assert len(tree["roots"]) == 1
+        root = tree["roots"][0]
+        assert root["span"]["name"] == "root"
+        assert root["children"][0]["children"][0]["span"]["name"] == "leaf"
+        rows = tracing.summarize(spans)
+        assert rows[0]["name"] == "root" and rows[0]["total_ms"] == 500.0
+
+    def test_overhead_measurement(self):
+        seq_before = tracing.recorder.seq
+        spans_before = tracing.trace_metrics()["trace_spans_total"]
+        us = tracing.measure_overhead_us(500)
+        assert 0 < us < 1000  # sandboxed-host bound; ~µs on real metal
+        # the bench must not pollute the real ring or the counters
+        assert tracing.recorder.seq == seq_before
+        assert tracing.trace_metrics()["trace_spans_total"] == \
+            spans_before
+
+    def test_dropped_counter_reports_evictions(self, monkeypatch):
+        small = tracing.SpanRecorder(capacity=16)
+        monkeypatch.setattr(tracing, "recorder", small)
+        for _ in range(40):
+            tracing.record_span("overflow", 0.0)
+        assert small.dropped == 24
+        assert tracing.trace_metrics()[
+            "trace_spans_dropped_total"] == 24.0
+        assert tracing.trace_metrics()["trace_ring_spans"] == 16.0
+
+
+# -------------------------------------------------- service end-to-end
+@pytest.fixture(autouse=True, scope="module")
+def _local_state(tmp_path_factory):
+    state = tmp_path_factory.mktemp("ktlocal-tracing")
+    os.environ["KT_LOCAL_STATE"] = str(state)
+    import kubetorch_tpu.provisioning.backend as backend
+
+    backend._LOCAL_ROOT = state
+    yield
+    for record in backend.LocalBackend().list_services():
+        backend.LocalBackend().teardown(record["service_name"], quiet=True)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    remote = Cls(root_path=str(ASSETS), import_path="summer",
+                 callable_name="ChunkEngine", name="tracechunk")
+    remote.to(kt.Compute(cpus="0.1"))
+    yield remote
+    remote.teardown()
+
+
+def _pod_spans(url, **params):
+    import httpx
+
+    resp = httpx.get(f"{url}/_trace", params={"format": "spans",
+                                              **params}, timeout=10)
+    assert resp.status_code == 200
+    return resp.json()["spans"]
+
+
+@pytest.mark.level("minimal")
+def test_channel_call_produces_assembled_tree(engine):
+    """ISSUE 4 acceptance: pipelined channel calls against the test
+    server produce one trace tree per call — client channel.call →
+    server.execute → worker.execute share a trace_id with correct
+    parent edges, with the worker spans having crossed two process
+    boundaries (WS envelope, then mp queue) to get into the pod ring."""
+    with engine.channel(depth=2) as chan:
+        calls = [chan.submit(9100 + i, method="step") for i in range(3)]
+        for c in calls:
+            c.result(timeout=60)
+    client_spans = {c.cid: c._span for c in calls}
+    # worker spans piggyback on the NEXT response after a call ends; the
+    # last call's spans may still be in the worker — poke once more
+    with engine.channel(depth=1) as chan:
+        chan.call(9190, method="step")
+        time.sleep(0.2)
+        chan.call(9191, method="step")
+    url = engine.service_url()
+    for call in calls:
+        trace_id = call._span.span["trace_id"]
+        client_span = call._span.span
+        spans = _pod_spans(url, trace_id=trace_id)
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        assert "server.execute" in by_name, (trace_id, spans)
+        assert "worker.execute" in by_name, (trace_id, spans)
+        server = by_name["server.execute"][0]
+        worker = by_name["worker.execute"][0]
+        # shared trace, correct parent edges across both hops
+        assert server["trace_id"] == trace_id
+        assert worker["trace_id"] == trace_id
+        assert server["parent_id"] == client_span["span_id"]
+        assert worker["parent_id"] == server["span_id"]
+        assert worker["proc"].startswith("worker")
+        assert server["proc"] == "pod-server"
+        # queue + dispatch + reply stages recorded under the same trace
+        assert "server.queue" in by_name
+        assert "worker.dispatch" in by_name
+        # client-side spans live in THIS process's ring
+        local = tracing.recorder.snapshot(trace_id=trace_id)
+        assert any(s["name"] == "channel.call" for s in local)
+        assert any(s["name"] == "channel.send" for s in local)
+
+
+@pytest.mark.level("minimal")
+def test_pod_trace_endpoint_perfetto_json(engine):
+    """Default /_trace format is valid Chrome trace_event JSON that
+    Perfetto accepts: a traceEvents list of X/M(/s/f) events with
+    µs timestamps and process metadata."""
+    import httpx
+
+    with engine.channel(depth=1) as chan:
+        chan.call(9200, method="step")
+        chan.call(9201, method="step")
+    resp = httpx.get(f"{engine.service_url()}/_trace", timeout=10)
+    assert resp.status_code == 200
+    doc = resp.json()
+    events = doc["traceEvents"]
+    assert events, "pod ring exported no events"
+    assert {e["ph"] for e in events} <= {"X", "M", "s", "f"}
+    for e in events:
+        if e["ph"] == "X":
+            assert e["ts"] > 1e15  # epoch µs, not perf_counter ticks
+            assert e["dur"] > 0
+            assert "trace_id" in e["args"]
+    names = {e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert any("pod-server" in n for n in names)
+    assert any("worker" in n for n in names)
+
+
+@pytest.mark.level("minimal")
+def test_cli_trace_writes_perfetto_file(engine, tmp_path):
+    """``ktpu trace <svc>`` fetches pod spans and writes a file
+    ui.perfetto.dev opens, printing the per-stage summary table."""
+    from click.testing import CliRunner
+
+    from kubetorch_tpu.cli import main as cli_main
+
+    with engine.channel(depth=2) as chan:
+        for i in range(2):
+            chan.call(9300 + i, method="step")
+        chan.call(9310, method="step")  # flush piggybacked spans
+    out_file = tmp_path / "trace.json"
+    result = CliRunner().invoke(
+        cli_main, ["trace", engine.service_name, "--last", "5",
+                   "-o", str(out_file)])
+    assert result.exit_code == 0, result.output
+    doc = json.loads(out_file.read_text())
+    assert doc["traceEvents"]
+    assert any(e.get("ph") == "X" for e in doc["traceEvents"])
+    # summary table names real stages
+    assert "server.execute" in result.output
+    assert "worker.execute" in result.output
+    assert "perfetto" in result.output
+
+
+@pytest.mark.level("minimal")
+def test_failed_call_spans_still_exported(engine):
+    """A call whose user code RAISES — the primary tracing use case —
+    must still land its worker spans in the pod's exportable ring (they
+    piggyback on the error response)."""
+    with engine.channel(depth=1) as chan:
+        c = chan.submit(9500, method="step", kwargs={"boom": True})
+        with pytest.raises(ValueError, match="chunk 9500 blew up"):
+            c.result(timeout=60)
+        trace_id = c._span.span["trace_id"]
+    spans = _pod_spans(engine.service_url(), trace_id=trace_id)
+    worker = [s for s in spans if s["name"] == "worker.execute"]
+    assert worker, f"failed call's worker spans missing: {spans}"
+    assert "ValueError" in worker[0].get("error", "")
+
+
+@pytest.mark.level("minimal")
+def test_post_path_carries_trace_header_and_id(engine):
+    """The plain POST path propagates X-KT-Trace and answers with the
+    trace id; the server.call span parents under the client's span."""
+    import httpx
+
+    from kubetorch_tpu import serialization as ser
+    from kubetorch_tpu.serving.http_client import sync_client
+
+    with tracing.span("test.root") as root:
+        resp = sync_client().post(
+            f"{engine.service_url()}/ChunkEngine/step",
+            content=ser.dumps({"args": [9400], "kwargs": {}}, "json"),
+            headers=tracing.inject({ser.HEADER: "json"}))
+    assert resp.status_code == 200
+    assert resp.headers["X-KT-Trace-Id"] == root.span["trace_id"]
+    spans = _pod_spans(engine.service_url(),
+                       trace_id=root.span["trace_id"])
+    server = [s for s in spans if s["name"] == "server.call"]
+    assert server and server[0]["parent_id"] == root.span["span_id"]
+
+
+@pytest.mark.level("minimal")
+def test_slow_call_auto_push(monkeypatch):
+    """KT_TRACE_SLOW_MS: a trace whose root exceeds the threshold is
+    pushed to the controller's POST /traces in the background."""
+    import http.server
+    import threading
+
+    received = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            received.append(
+                (self.path, json.loads(self.rfile.read(length))))
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):
+            pass
+
+    server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{server.server_port}"
+        monkeypatch.setenv("KT_TRACE_SLOW_MS", "10")
+        with tracing.span("slow.call") as s:
+            time.sleep(0.02)
+            trace_id = s.span["trace_id"]
+        # under threshold: no push
+        assert not tracing.maybe_push_slow(trace_id, 0.005,
+                                           controller_url=url)
+        assert tracing.maybe_push_slow(trace_id, 0.02,
+                                       controller_url=url)
+        deadline = time.time() + 5
+        while not received and time.time() < deadline:
+            time.sleep(0.02)
+        assert received, "slow-call push never arrived"
+        path, body = received[0]
+        assert path == "/traces"
+        assert any(sp["span_id"] == s.span["span_id"]
+                   for sp in body["spans"])
+    finally:
+        server.shutdown()
+
+
+@pytest.mark.level("minimal")
+def test_controller_trace_assembly():
+    """POST /traces ingestion + GET /traces/<id> cross-pod assembly on a
+    live controller: span batches pushed separately (as two pods would)
+    come back as one tree."""
+    import socket
+    import subprocess
+    import sys
+
+    import httpx
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.controller.server",
+         "--host", "127.0.0.1", "--port", str(port), "--db", ":memory:"],
+        env={**os.environ}, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    url = f"http://127.0.0.1:{port}"
+    try:
+        for _ in range(100):
+            try:
+                if httpx.get(f"{url}/health",
+                             timeout=2.0).status_code == 200:
+                    break
+            except httpx.HTTPError:
+                time.sleep(0.2)
+        t0 = time.time()
+        root = {"trace_id": "t-xpod", "span_id": "root1",
+                "parent_id": None, "name": "channel.call",
+                "start": t0, "dur": 0.2, "pod": "client", "proc":
+                "client", "pid": 1, "tid": "main"}
+        pod_a = {"trace_id": "t-xpod", "span_id": "srv1",
+                 "parent_id": "root1", "name": "server.execute",
+                 "start": t0 + 0.01, "dur": 0.1, "pod": "pod-a",
+                 "proc": "pod-server", "pid": 2, "tid": "main"}
+        pod_b = {"trace_id": "t-xpod", "span_id": "wrk1",
+                 "parent_id": "srv1", "name": "worker.execute",
+                 "start": t0 + 0.02, "dur": 0.08, "pod": "pod-b",
+                 "proc": "worker-r0", "pid": 3, "tid": "main"}
+        # two separate pushes, as two pods would send
+        r1 = httpx.post(f"{url}/traces", json={"spans": [root, pod_a]},
+                        timeout=5.0)
+        assert r1.status_code == 200 and r1.json()["ingested"] == 2
+        r2 = httpx.post(f"{url}/traces", json={"spans": [pod_b]},
+                        timeout=5.0)
+        assert r2.json()["ingested"] == 1
+        got = httpx.get(f"{url}/traces/t-xpod", timeout=5.0).json()
+        assert len(got["spans"]) == 3
+        tree = got["tree"]
+        assert len(tree) == 1 and tree[0]["name"] == "channel.call"
+        child = tree[0]["children"][0]
+        assert child["name"] == "server.execute"
+        assert child["children"][0]["name"] == "worker.execute"
+        # perfetto form + listing
+        doc = httpx.get(f"{url}/traces/t-xpod?format=perfetto",
+                        timeout=5.0).json()
+        assert any(e["ph"] == "X" for e in doc["traceEvents"])
+        listing = httpx.get(f"{url}/traces", timeout=5.0).json()
+        assert any(t["trace_id"] == "t-xpod" and t["spans"] == 3
+                   for t in listing["traces"])
+        assert httpx.get(f"{url}/traces/nope",
+                         timeout=5.0).status_code == 404
+    finally:
+        proc.terminate()
+        proc.wait(5)
+
+
+# ---------------------------------------------------------- dataplane
+@pytest.mark.level("minimal")
+def test_streamed_restore_spans_match_place_gauge(tmp_path, monkeypatch):
+    """ISSUE 4 acceptance: a streamed get_arrays restore records
+    fetch/decode/place spans, and the summed restore.device_put span
+    time matches restore_last_place_seconds within 10%. Also the
+    placement-thread contextvar regression: spans (and their request_id
+    label) from the double-buffered thread must inherit the caller's
+    context instead of starting orphan traces labeled request_id='-'."""
+    import jax
+    import numpy as np
+
+    from kubetorch_tpu.data_store.client import DataStoreClient
+    from kubetorch_tpu.data_store.device_transfer import (
+        get_arrays,
+        last_restore_stats,
+        put_arrays,
+    )
+    from kubetorch_tpu.serving.server import request_id_var
+
+    monkeypatch.setenv("KT_LOCAL_STORE", str(tmp_path / "store"))
+    monkeypatch.delenv("KT_STORE_URL", raising=False)
+    prev_default = DataStoreClient._default
+    DataStoreClient._default = None
+    try:
+        tree = {"w": np.random.default_rng(0).random(
+            (2048, 64)).astype(np.float32),
+            "b": np.random.default_rng(1).random(
+            (512, 64)).astype(np.float32)}
+        put_arrays("tracing/restore", tree)
+        sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        rid_token = request_id_var.set("rid-trace-test")
+        try:
+            with tracing.span("test.restore") as root:
+                got = get_arrays("tracing/restore", template=tree,
+                                 shardings=sharding, streaming=True,
+                                 chunk_bytes=1 << 16,
+                                 batch_bytes=1 << 17)
+        finally:
+            request_id_var.reset(rid_token)
+        np.testing.assert_array_equal(np.asarray(got["w"]), tree["w"])
+        trace_id = root.span["trace_id"]
+        spans = tracing.recorder.snapshot(trace_id=trace_id)
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        assert "store.get_arrays" in by_name
+        assert "restore.fetch" in by_name
+        place_spans = by_name.get("restore.device_put", [])
+        assert place_spans, "placement thread recorded no spans"
+        # placement-thread ctx: spans parent under store.get_arrays and
+        # carry the request id (the '-' regression)
+        ga = by_name["store.get_arrays"][0]
+        for s in place_spans:
+            assert s["trace_id"] == trace_id
+            assert s["parent_id"] == ga["span_id"]
+            assert s.get("request_id") == "rid-trace-test"
+        # summed device_put span time ≈ the place_s gauge (±10%)
+        place_s = last_restore_stats()["place_s"]
+        span_sum = sum(s["dur"] for s in place_spans)
+        assert span_sum == pytest.approx(place_s, rel=0.10)
+    finally:
+        DataStoreClient._default = prev_default
+
+
+@pytest.mark.level("minimal")
+def test_placement_thread_inherits_context_directly():
+    """Narrow regression guard for the copy_context fix: a
+    _PlacementPipeline spawned while a contextvar and span are set must
+    see BOTH inside its worker thread."""
+    from kubetorch_tpu.data_store.device_transfer import (
+        _PlacementPipeline,
+    )
+    from kubetorch_tpu.observability.log_capture import request_id_var
+
+    token = request_id_var.set("rid-pipe")
+    try:
+        with tracing.span("pipe.root") as root:
+            out = [None]
+            pipe = _PlacementPipeline(out, depth=1)
+        # the thread was created INSIDE the span/rid context; its spans
+        # must inherit both even though the span has since closed
+        import numpy as np
+
+        pipe.submit([0], [np.zeros(4, np.float32)], None)
+        pipe.close()
+    finally:
+        request_id_var.reset(token)
+    spans = [s for s in tracing.recorder.snapshot(
+        trace_id=root.span["trace_id"])
+        if s["name"] == "restore.device_put"]
+    assert spans, "pipeline thread recorded nothing"
+    assert spans[0]["parent_id"] == root.span["span_id"]
+    assert spans[0].get("request_id") == "rid-pipe"
