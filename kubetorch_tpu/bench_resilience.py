@@ -1,0 +1,154 @@
+"""Recovery-path microbenchmarks: detect → emergency checkpoint → restore.
+
+The recovery pipeline has a wall-clock budget (a preempted spot slice is
+gone in seconds; a stalled gang burns the whole fleet's time), so each
+leg is measured, not asserted:
+
+- ``recovery_detect_s``    — last heartbeat → the liveness tracker marks
+  the victim dead (bounded by ``KT_DEAD_AFTER_MISSES`` beats + one sweep);
+- ``recovery_checkpoint_s``— the emergency checkpoint: blocking Orbax
+  save + delta ``put_arrays`` push of the live state to the store;
+- ``recovery_restore_s``   — ``resume_or_init`` restoring that checkpoint
+  (the restarted gang's first act);
+- ``recovery_total_s``     — the sum: preemption to training-resumed,
+  excluding backend reprovision time (cluster-dependent; the fake-K8s
+  e2e in tests/test_resilience.py covers the control flow).
+
+``KT_CHAOS`` (e.g. ``kill-worker=1,seed=42``) picks which simulated
+worker dies — the same seeded policy the tests use, so a bench run and a
+test run can reproduce each other's victim. Run directly
+(``python -m kubetorch_tpu.bench_resilience [--dryrun]``); ``--dryrun``
+is the CI smoke shape (tier-1 guard: tests/test_resilience_smoke.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from pathlib import Path
+from typing import Dict
+
+
+def _simulate_detect(dryrun: bool, chaos) -> Dict[str, float]:
+    """A simulated gang heartbeats a LivenessTracker; the chaos policy's
+    victim stops beating; measure beat-stop → dead."""
+    from kubetorch_tpu.resilience.liveness import LivenessTracker
+
+    hb = 0.02 if dryrun else 0.1
+    dead_after = 2
+    tracker = LivenessTracker(heartbeat_s=hb, dead_after_misses=dead_after)
+    pods = [f"bench-worker-{i}" for i in range(3 if dryrun else 8)]
+    for pod in pods:
+        tracker.beat("bench-gang", pod)
+    victim = chaos.pick("kill-worker", pods) or pods[0]
+    t_kill = time.perf_counter()
+    # survivors keep beating; the victim never beats again
+    deadline = t_kill + 50 * hb
+    detect_s = None
+    while time.perf_counter() < deadline:
+        time.sleep(hb / 2)
+        for pod in pods:
+            if pod != victim:
+                tracker.beat("bench-gang", pod)
+        tracker.sweep()
+        if tracker.pod_state("bench-gang", victim) == "dead":
+            detect_s = time.perf_counter() - t_kill
+            break
+    if detect_s is None:
+        raise RuntimeError("liveness tracker never detected the victim")
+    health = tracker.gang_health("bench-gang")
+    assert health["status"] == "dead", health  # gang-atomic verdict
+    return {"recovery_detect_s": round(detect_s, 4),
+            "recovery_heartbeat_s": hb,
+            "recovery_dead_after_misses": dead_after}
+
+
+def _toy_state(dryrun: bool):
+    import jax.numpy as jnp
+    import numpy as np
+
+    side = 64 if dryrun else 512
+    rng = np.random.default_rng(0)
+    return {
+        "params": {"w": jnp.asarray(rng.random((side, side)), jnp.float32),
+                   "b": jnp.asarray(rng.random((side,)), jnp.float32)},
+        "step": jnp.asarray(3, jnp.int32),
+    }
+
+
+def run(dryrun: bool = False) -> Dict[str, float]:
+    """Full recovery bench; ``dryrun=True`` is the CI smoke shape (same
+    code paths, toy sizes, stable metric keys)."""
+    from kubetorch_tpu.resilience.chaos import ChaosPolicy
+
+    chaos = ChaosPolicy.from_env() or ChaosPolicy(
+        seed=0, kill_worker=1.0, max_events=1)
+    out: Dict[str, float] = {}
+    out.update(_simulate_detect(dryrun, chaos))
+
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    tmp = Path(tempfile.mkdtemp(prefix="ktpu-resil-", dir=base))
+    import kubetorch_tpu.data_store.client as ds_client
+
+    old_store = ds_client._LOCAL_STORE
+    ds_client._LOCAL_STORE = tmp / "store"
+    try:
+        from kubetorch_tpu.training.checkpoint import (
+            CheckpointManager,
+            emergency_save,
+            resume_or_init,
+        )
+
+        state = _toy_state(dryrun)
+        ckpt_dir = tmp / "ckpt"
+        manager = CheckpointManager(str(ckpt_dir))
+        t0 = time.perf_counter()
+        saved = emergency_save(manager, state, 3,
+                               store_key="bench/resilience")
+        out["recovery_checkpoint_s"] = round(
+            time.perf_counter() - t0, 4)
+        if saved.get("push_error"):
+            raise RuntimeError(
+                f"emergency store push failed: {saved['push_error']}")
+        # the push landed in the store (what a fresh node would fetch)
+        from kubetorch_tpu.data_store.device_transfer import get_arrays
+
+        import numpy as np
+
+        fetched = get_arrays("bench/resilience/emergency",
+                             template={"step": np.asarray(0),
+                                       "state": state})
+        assert int(fetched["step"]) == 3, fetched["step"]
+
+        t0 = time.perf_counter()
+        restored, step = resume_or_init(str(ckpt_dir), lambda: state)
+        out["recovery_restore_s"] = round(time.perf_counter() - t0, 4)
+        if step != 3:
+            raise RuntimeError(
+                f"resumed at step {step}, emergency save was at 3")
+        out["recovery_total_s"] = round(
+            out["recovery_detect_s"] + out["recovery_checkpoint_s"]
+            + out["recovery_restore_s"], 4)
+        out["recovery_chaos_seed"] = chaos.seed
+    finally:
+        ds_client._LOCAL_STORE = old_store
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="kubetorch_tpu recovery-path microbenchmarks")
+    parser.add_argument(
+        "--dryrun", action="store_true",
+        help="CI smoke: same code paths at toy sizes (stable metric "
+             "keys, throwaway values)")
+    args = parser.parse_args()
+    if args.dryrun:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    print(json.dumps(run(dryrun=args.dryrun), indent=2))

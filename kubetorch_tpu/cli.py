@@ -262,6 +262,83 @@ def logs(service, pod, tail, follow, level, request_id):
 
 @main.command()
 @click.argument("service")
+@click.option("--json", "as_json", is_flag=True,
+              help="raw JSON instead of the table")
+def health(service, as_json):
+    """Gang health for a deployed service: per-pod liveness states
+    (alive/suspect/dead/preempted from the controller's heartbeat
+    tracker), the gang-atomic verdict, and restart bookkeeping. Falls
+    back to polling each pod's /health+/ready directly when no
+    controller is configured."""
+    from kubetorch_tpu.controller.client import ControllerClient
+
+    controller = ControllerClient.maybe()
+    if controller is not None:
+        import httpx as _httpx
+
+        from kubetorch_tpu.exceptions import KubetorchError
+
+        try:
+            data = controller.gang_health(service)
+        except (_httpx.HTTPError, KubetorchError):
+            # controller down/partitioned — the exact incident this
+            # command serves; fall through to polling the pods directly
+            data = None
+        if data is not None:
+            if as_json:
+                click.echo(json.dumps(data, indent=2))
+                return
+            click.echo(f"{service}: {data['status']}  "
+                       f"(heartbeat {data['heartbeat_s']}s, dead after "
+                       f"{data['dead_after_misses']} misses, restarts "
+                       f"{data.get('restarts', 0)}/"
+                       f"{data.get('max_restarts', '?')}, auto-restart "
+                       f"{'on' if data.get('auto_restart') else 'off'})")
+            if not data["pods"]:
+                click.echo("  (no heartbeats yet)")
+            for pod, info in sorted(data["pods"].items()):
+                detect = (f"  detected in {info['detect_s']}s"
+                          if info.get("detect_s") else "")
+                click.echo(f"  {pod:<32}{info['state']:<10}"
+                           f"last beat {info['age_s']}s ago  "
+                           f"beats={info['beats']}{detect}")
+            return
+    # no controller (or it never heard of the service): ask the pods
+    import httpx
+
+    from kubetorch_tpu.provisioning.backend import get_backend
+
+    try:
+        urls = get_backend().pod_urls(service)
+    except KeyError:
+        raise click.ClickException(f"no service {service!r}")
+    rows = []
+    with httpx.Client(timeout=5.0) as client:
+        for i, base in enumerate(urls):
+            try:
+                ok = client.get(f"{base}/health").status_code == 200
+                ready = client.get(f"{base}/ready").status_code == 200
+                state = "alive" if ok and ready else (
+                    "suspect" if ok else "dead")
+            except httpx.HTTPError:
+                state = "dead"
+            rows.append((f"pod-{i}", state, base))
+    if as_json:
+        click.echo(json.dumps(
+            {"service": service, "source": "direct-poll",
+             "pods": {name: {"state": state, "url": url}
+                      for name, state, url in rows}}, indent=2))
+        return
+    verdict = ("dead" if any(s == "dead" for _, s, _ in rows)
+               else "degraded" if any(s == "suspect" for _, s, _ in rows)
+               else "healthy" if rows else "unknown")
+    click.echo(f"{service}: {verdict}  (direct pod poll — no controller)")
+    for name, state, url in rows:
+        click.echo(f"  {name:<32}{state:<10}{url}")
+
+
+@main.command()
+@click.argument("service")
 def teardown(service):
     """Tear down a deployed service."""
     from kubetorch_tpu.provisioning.backend import get_backend

@@ -110,6 +110,26 @@ class ControllerClient:
     def report_activity(self, service_name: str):
         self.client.post(f"{self.base_url}/pool/{service_name}/activity")
 
+    # ------------------------------------------------------- resilience
+    def heartbeat(self, service_name: str, pod: str,
+                  state: Optional[str] = None) -> Dict[str, Any]:
+        """One liveness beat (``state="preempted"`` is the terminal
+        drain report). Pods normally piggyback beats on their controller
+        WS; this is the HTTP path (and what tests/sim harnesses use)."""
+        payload: Dict[str, Any] = {"service": service_name, "pod": pod}
+        if state:
+            payload["state"] = state
+        return self._check(self.client.post(
+            f"{self.base_url}/heartbeat", json=payload))
+
+    def gang_health(self, service_name: str) -> Optional[Dict[str, Any]]:
+        """Gang health (``GET /health/<svc>``): per-pod liveness states,
+        the gang-atomic verdict, restart bookkeeping. None if unknown."""
+        resp = self.client.get(f"{self.base_url}/health/{service_name}")
+        if resp.status_code == 404:
+            return None
+        return self._check(resp)
+
     # ------------------------------------------------------------- runs
     def create_run(self, run_id: str, **fields: Any) -> Dict[str, Any]:
         return self._check(self.client.post(

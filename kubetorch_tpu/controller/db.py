@@ -23,6 +23,7 @@ CREATE TABLE IF NOT EXISTS pools (
     backend TEXT NOT NULL DEFAULT 'local',
     launch_id TEXT,
     status TEXT NOT NULL DEFAULT 'registered',
+    restarts INTEGER NOT NULL DEFAULT 0,
     inactivity_ttl TEXT,
     last_active REAL,
     created_at REAL NOT NULL,
@@ -53,6 +54,14 @@ class Database:
         self._lock = threading.Lock()
         with self._lock:
             self._conn.executescript(_SCHEMA)
+            # migration for pre-resilience databases: gang-restart
+            # bookkeeping (CREATE IF NOT EXISTS won't add a column)
+            try:
+                self._conn.execute(
+                    "ALTER TABLE pools ADD COLUMN restarts INTEGER "
+                    "NOT NULL DEFAULT 0")
+            except sqlite3.OperationalError:
+                pass  # column already exists
             self._conn.commit()
 
     # ------------------------------------------------------------ pools
@@ -107,6 +116,20 @@ class Database:
                 "UPDATE pools SET last_active=? WHERE service_name=?",
                 (ts or time.time(), service_name))
             self._conn.commit()
+
+    def record_restart(self, service_name: str) -> int:
+        """Bump the pool's gang-restart counter; returns the new count
+        (0 when the pool is unknown)."""
+        with self._lock:
+            self._conn.execute(
+                "UPDATE pools SET restarts=restarts+1, updated_at=?, "
+                "last_active=? WHERE service_name=?",
+                (time.time(), time.time(), service_name))
+            self._conn.commit()
+            row = self._conn.execute(
+                "SELECT restarts FROM pools WHERE service_name=?",
+                (service_name,)).fetchone()
+        return int(row["restarts"]) if row else 0
 
     def delete_pool(self, service_name: str) -> bool:
         with self._lock:

@@ -83,6 +83,32 @@ def format_event(event: Dict[str, Any], service: str = "") -> Dict[str, Any]:
     }
 
 
+def resilience_event(service: str, reason: str, message: str,
+                     pod: str = "") -> Dict[str, Any]:
+    """One LogSink entry for a resilience transition (PodSuspect /
+    PodDead / PodPreempted / GangRestarted / GangRestartFailed /
+    RestartBudgetExhausted) — same ``job="kubetorch-events"`` label
+    scheme as the K8s events, so ``ktpu logs -f`` and the dashboard show
+    recoveries in the same stream clients already tail."""
+    warning = reason in ("PodDead", "GangRestartFailed",
+                         "RestartBudgetExhausted")
+    return {
+        "ts": time.time(),
+        "line": (f"[{'Warning' if warning else 'Normal'}] "
+                 f"{('Pod/' + pod) if pod else ('Service/' + service)}: "
+                 f"{reason}: {message}"),
+        "labels": {
+            "job": EVENTS_JOB,
+            "service": service,
+            "reason": reason,
+            "kind": "Pod" if pod else "Service",
+            "name": pod or service,
+            "level": "error" if warning else "info",
+            "source": "resilience",
+        },
+    }
+
+
 class EventWatcher:
     """Background poller: new K8s events → ``log_sink.push``."""
 

@@ -147,12 +147,41 @@ class PodHub:
 class ControllerServer:
     def __init__(self, db_path: str = ":memory:",
                  enable_reaper: bool = True,
-                 reaper_interval: float = 15.0):
+                 reaper_interval: float = 15.0,
+                 enable_resilience: bool = True):
         self.db = Database(db_path)
         self.hub = PodHub()
         self.enable_reaper = enable_reaper
         self.reaper_interval = reaper_interval
         self._reaper_task: Optional[asyncio.Task] = None
+        # Resilience: heartbeat-fed liveness + gang-atomic auto-restart
+        # (resilience/ subsystem; knobs KT_HEARTBEAT_S /
+        # KT_DEAD_AFTER_MISSES / KT_MAX_RESTARTS / KT_AUTO_RESTART).
+        from kubetorch_tpu.resilience.liveness import LivenessTracker
+        from kubetorch_tpu.resilience.restart import (
+            GangRestarter,
+            RestartPolicy,
+        )
+
+        self.enable_resilience = enable_resilience
+        self.liveness = LivenessTracker(
+            on_transition=self._on_liveness_transition)
+        self.restart_policy = RestartPolicy()
+        self.restarter = GangRestarter(
+            self.restart_policy, on_event=self._resilience_event)
+        self.auto_restart = os.environ.get(
+            "KT_AUTO_RESTART", "1").lower() not in ("0", "false", "no")
+        self._resilience_task: Optional[asyncio.Task] = None
+        self._restarting: set = set()
+        # strong refs to in-flight restart tasks: the loop only holds
+        # weak ones, and a GC'd restart would leave its service wedged
+        # in _restarting forever (the finally never runs)
+        self._restart_tasks: set = set()
+        self._loop_errors: set = set()  # sweep errors already reported
+        # last dead-detection per service: survives the gang restart
+        # (which forgets the per-pod liveness state) so /health can
+        # always answer "when did we last notice, and how fast"
+        self._last_detect: Dict[str, dict] = {}
         self.auth_token = os.environ.get("KT_CONTROLLER_TOKEN") or None
         # External token validation (reference: auth/middleware.py — bearer
         # validated against an endpoint, with namespace access checks).
@@ -230,6 +259,8 @@ class ControllerServer:
         r.add_get("/pools", self.h_list_pools)
         r.add_delete("/pool/{service}", self.h_teardown_pool)
         r.add_post("/pool/{service}/activity", self.h_activity)
+        r.add_post("/heartbeat", self.h_heartbeat)
+        r.add_get("/health/{service}", self.h_gang_health)
         r.add_get("/ws/pods", self.h_ws_pods)
         r.add_post("/traces", self.h_traces_push)
         r.add_get("/traces", self.h_traces_list)
@@ -255,6 +286,8 @@ class ControllerServer:
         # controller-level gauges joining the /metrics scrape (pool count,
         # pod hub occupancy, log-buffer shedding — the /health numbers,
         # now PromQL-queryable)
+        from kubetorch_tpu.observability import prometheus as _prom
+
         app._kt_prom_extra = lambda: [
             ("controller_pools", {}, len(self.db.list_pools())),
             ("controller_connected_pods", {},
@@ -262,6 +295,10 @@ class ControllerServer:
             ("controller_waiting_pods", {}, len(self.hub.waiting)),
             ("controller_log_batches_dropped_total", {},
              getattr(self.log_sink.persist, "dropped_batches", 0)),
+            # resilience_* counters (heartbeats, suspect/dead transitions,
+            # preemptions, gang restarts) join the controller scrape
+            *[(name, {}, value)
+              for name, value in _prom.resilience_metrics().items()],
         ]
         app.on_startup.append(self._on_startup)
         app.on_shutdown.append(self._on_shutdown)
@@ -273,11 +310,16 @@ class ControllerServer:
         self.log_sink.bind_loop()
         if self.enable_reaper:
             self._reaper_task = asyncio.create_task(self._reaper_loop())
+        if self.enable_resilience:
+            self._resilience_task = asyncio.create_task(
+                self._resilience_loop())
         self.event_watcher.start()
 
     async def _on_shutdown(self, app):
         if self._reaper_task:
             self._reaper_task.cancel()
+        if self._resilience_task:
+            self._resilience_task.cancel()
         self.event_watcher.stop()
         if self.log_sink.persist is not None:
             self.log_sink.persist.close()
@@ -433,6 +475,11 @@ class ControllerServer:
         deleted = self.db.delete_pool(service)
         self.log_sink.drop_stream(service)
         self.metrics_store.drop(service)
+        # a torn-down gang is not a dead gang: no liveness ghosts, no
+        # restart budget carried over to a future service of this name
+        self.liveness.forget_service(service)
+        self.restart_policy.reset(service)
+        self._last_detect.pop(service, None)
         # Cascading delete: backend resources (reference:
         # helpers/delete_helpers.py).
         try:
@@ -451,6 +498,172 @@ class ControllerServer:
     async def h_activity(self, request):
         self.db.touch_pool(request.match_info["service"])
         return web.json_response({"ok": True})
+
+    # ------------------------------------------------------- resilience
+    async def h_heartbeat(self, request):
+        """Pod liveness beat (HTTP form; WS-connected pods piggyback a
+        ``{"type": "heartbeat"}`` message instead). Body:
+        ``{"service", "pod", ["state"], ["info"]}``; ``state:
+        "preempted"`` is a draining pod's explicit terminal report. A
+        beat without identity is *corrupt* — rejected AND counted, so a
+        chaos run (or a real serialization bug) shows on /metrics."""
+        from kubetorch_tpu.observability import prometheus as prom
+
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001
+            body = None
+        service = (body or {}).get("service")
+        pod = (body or {}).get("pod")
+        if not service or not pod:
+            prom.record_resilience("corrupt_heartbeat")
+            return web.json_response(
+                {"error": "heartbeat needs service and pod"}, status=400)
+        from kubetorch_tpu.resilience.liveness import PREEMPTED
+
+        if (body or {}).get("state") == "preempted":
+            self.liveness.mark(service, pod, PREEMPTED)
+            return web.json_response({"ok": True, "state": PREEMPTED})
+        prom.record_resilience("heartbeat")
+        state = self.liveness.beat(service, pod, info=(body or {}).get("info"))
+        return web.json_response({"ok": True, "state": state})
+
+    async def h_gang_health(self, request):
+        """Gang health for one service: per-pod liveness states + the
+        gang-atomic verdict + restart bookkeeping."""
+        service = request.match_info["service"]
+        health = self.liveness.gang_health(service)
+        pool = self.db.get_pool(service)
+        if pool is None and not health["pods"]:
+            raise web.HTTPNotFound(text="no such service")
+        health["restarts"] = (pool or {}).get("restarts", 0)
+        if service in self._last_detect:
+            health["last_detect"] = self._last_detect[service]
+        health["restart_attempts"] = self.restart_policy.attempts(service)
+        health["max_restarts"] = self.restart_policy.max_restarts
+        health["auto_restart"] = self.auto_restart
+        return web.json_response(health)
+
+    def _on_liveness_transition(self, service, pod, old, new):
+        """Every liveness state change: counters + sink events."""
+        from kubetorch_tpu.observability import prometheus as prom
+        from kubetorch_tpu.resilience import liveness as lv
+
+        if new == lv.SUSPECT:
+            prom.record_resilience("suspect")
+        elif new == lv.DEAD:
+            prom.record_resilience("dead")
+            state = (self.liveness.gang_health(service)["pods"]
+                     .get(pod) or {})
+            detect = state.get("detect_s")
+            if detect:
+                prom.record_resilience("last_detect_seconds", detect)
+                self._last_detect[service] = {"pod": pod,
+                                              "detect_s": detect,
+                                              "at": time.time()}
+            self._resilience_event(
+                service, "PodDead",
+                f"missed {self.liveness.dead_after} heartbeats"
+                + (f" (detected after {detect}s)" if detect else ""),
+                pod=pod)
+        elif new == lv.PREEMPTED:
+            prom.record_resilience("preempted")
+            self._resilience_event(service, "PodPreempted",
+                                   "pod reported SIGTERM drain", pod=pod)
+
+    def _resilience_event(self, service: str, reason: str, message: str,
+                          pod: str = ""):
+        """Recovery transitions land in the log sink next to the K8s
+        events (same job label) — `ktpu logs -f` shows them live."""
+        from kubetorch_tpu.controller.event_watcher import resilience_event
+
+        try:
+            self.log_sink.push([resilience_event(service, reason, message,
+                                                 pod=pod)])
+        except Exception:  # noqa: BLE001 — events never block recovery
+            pass
+
+    async def _resilience_loop(self):
+        """Age liveness states and auto-restart dead gangs (gang-atomic:
+        the whole worker set reprovisions). Sweeps at half the heartbeat
+        interval so detection lag is bounded by beats missed, not by the
+        sweeper."""
+        interval = max(0.05, self.liveness.heartbeat_s / 2.0)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                self.liveness.sweep()
+                # budget decay: a restarted gang that stays healthy for
+                # KT_RESTART_RESET_S earns its restart budget back
+                for service in self.liveness.services():
+                    health = self.liveness.gang_health(service)
+                    if self.restart_policy.note_health(
+                            service, health["status"] == "healthy"):
+                        self._resilience_event(
+                            service, "RestartBudgetRestored",
+                            f"healthy {self.restart_policy.reset_after_s:g}s"
+                            f" after restart; budget reset")
+                if not self.auto_restart:
+                    continue
+                for service in self.liveness.dead_services():
+                    if service in self._restarting:
+                        continue
+                    pool = self.db.get_pool(service)
+                    if pool is None:
+                        # no pool to restart (torn down / never
+                        # registered): drop the stale liveness state so
+                        # the sweep stops reporting it
+                        self.liveness.forget_service(service)
+                        continue
+                    delay = self.restart_policy.next_delay(service)
+                    if delay is None:
+                        if self.restart_policy.exhausted_once(service):
+                            self._resilience_event(
+                                service, "RestartBudgetExhausted",
+                                f"gang stays down after "
+                                f"{self.restart_policy.max_restarts} "
+                                f"restarts")
+                        continue
+                    self._restarting.add(service)
+                    task = asyncio.create_task(
+                        self._restart_gang(service, pool, delay))
+                    self._restart_tasks.add(task)
+                    task.add_done_callback(self._restart_tasks.discard)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — sweep must go on
+                # a persistently-failing sweep silently disables
+                # auto-restart; surface each distinct error ONCE as a
+                # sink event so the operator sees why
+                key = f"{type(exc).__name__}: {exc}"
+                if key not in self._loop_errors:
+                    self._loop_errors.add(key)
+                    self._resilience_event(
+                        "controller", "ResilienceSweepError", key)
+                continue
+
+    async def _restart_gang(self, service, pool, delay: float):
+        try:
+            if delay:
+                await asyncio.sleep(delay)
+                if service not in self.liveness.dead_services():
+                    # the gang revived during the backoff (a transient
+                    # partition healed, beats resumed): restarting now
+                    # would delete a healthy, serving gang
+                    self.restart_policy.refund(service)
+                    self._resilience_event(
+                        service, "RestartSkipped",
+                        f"gang revived during {delay:.1f}s backoff")
+                    return
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self.restarter.restart(service, pool))
+            if result.get("ok"):
+                self.db.record_restart(service)
+                # fresh generation: liveness restarts from a clean slate
+                # (pods re-register and beat again)
+                self.liveness.forget_service(service)
+        finally:
+            self._restarting.discard(service)
 
     # ------------------------------------------------------------- WS
     async def h_ws_pods(self, request):
@@ -483,6 +696,21 @@ class ControllerServer:
                         conn.launch_id = data["launch_id"]
                 elif mtype == "activity" and conn is not None:
                     self.db.touch_pool(conn.service_name)
+                elif mtype == "heartbeat" and conn is not None:
+                    # liveness beat piggybacked on the pod WS (identity
+                    # comes from the registration, so it can't be forged
+                    # by a garbled payload — the HTTP path validates)
+                    from kubetorch_tpu.observability import (
+                        prometheus as prom,
+                    )
+
+                    prom.record_resilience("heartbeat")
+                    self.liveness.beat(conn.service_name, conn.pod_name)
+                elif mtype == "preempted" and conn is not None:
+                    from kubetorch_tpu.resilience.liveness import PREEMPTED
+
+                    self.liveness.mark(conn.service_name, conn.pod_name,
+                                       PREEMPTED)
         finally:
             if conn is not None:
                 self.hub.remove(conn)

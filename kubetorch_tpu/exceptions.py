@@ -124,6 +124,17 @@ class DataStoreError(KubetorchError):
         self.status = status
 
 
+class StoreUnconfigured(DataStoreError):
+    """Durable state was asked to land in a remote store, but no store is
+    configured (``KT_STORE_URL`` / ``config.store_url`` unset).
+
+    Raised instead of silently writing to the pod-local filesystem store:
+    a checkpoint "pushed" to a preempted pod's local disk is lost with the
+    pod — exactly the artifact the push exists to protect. Callers that
+    genuinely want the local store (laptop mode, tests) opt in with
+    ``allow_local=True``."""
+
+
 class RemoteException(KubetorchError):
     """Fallback wrapper when a remote exception type is unknown client-side.
 
@@ -158,7 +169,7 @@ for _exc in (
     KubetorchError, StartupError, PodTerminatedError, ServiceTimeoutError,
     ImagePullError, PodContainerError, VersionMismatchError, QuorumTimeoutError,
     WorkerMembershipChanged, XlaRuntimeSurfacedError, RsyncError, DataStoreError,
-    RemoteException,
+    StoreUnconfigured, RemoteException,
 ):
     register_exception(_exc)
 

@@ -393,6 +393,69 @@ def serving_samples(labels: Optional[Dict[str, str]] = None):
     yield from serving_histogram_samples(labels)
 
 
+# ------------------------------------------------------------------
+# Resilience counters (resilience/ subsystem: liveness, preemption, gang
+# restart). Process-local like the rest: the CONTROLLER process records
+# heartbeat/liveness/restart events (its /metrics joins them via
+# _kt_prom_extra); a preempted POD records its own preemption/emergency-
+# checkpoint ticks (best-effort — the process is about to exit).
+_RESIL_LOCK = threading.Lock()
+_RESIL: Dict[str, float] = {
+    "resilience_heartbeats_total": 0.0,
+    "resilience_heartbeats_corrupt_total": 0.0,
+    "resilience_suspect_transitions_total": 0.0,
+    "resilience_dead_transitions_total": 0.0,
+    "resilience_preemptions_total": 0.0,
+    "resilience_emergency_checkpoints_total": 0.0,
+    "resilience_gang_restarts_total": 0.0,
+    "resilience_gang_restart_failures_total": 0.0,
+    "resilience_last_detect_seconds": 0.0,
+    "resilience_last_restart_seconds": 0.0,
+}
+_RESIL_EVENTS = {
+    "heartbeat": "resilience_heartbeats_total",
+    "corrupt_heartbeat": "resilience_heartbeats_corrupt_total",
+    "suspect": "resilience_suspect_transitions_total",
+    "dead": "resilience_dead_transitions_total",
+    "preempted": "resilience_preemptions_total",
+    "emergency_checkpoint": "resilience_emergency_checkpoints_total",
+    "restart": "resilience_gang_restarts_total",
+    "restart_failure": "resilience_gang_restart_failures_total",
+}
+_RESIL_GAUGES = {
+    "last_detect_seconds": "resilience_last_detect_seconds",
+    "last_restart_seconds": "resilience_last_restart_seconds",
+}
+
+
+def record_resilience(event: str, value: float = 1.0) -> None:
+    """Bump a resilience counter (``heartbeat`` / ``corrupt_heartbeat`` /
+    ``suspect`` / ``dead`` / ``preempted`` / ``emergency_checkpoint`` /
+    ``restart`` / ``restart_failure``) or set a recovery gauge
+    (``last_detect_seconds`` / ``last_restart_seconds``)."""
+    with _RESIL_LOCK:
+        counter = _RESIL_EVENTS.get(event)
+        if counter is not None:
+            _RESIL[counter] += value
+            return
+        gauge = _RESIL_GAUGES.get(event)
+        if gauge is not None:
+            _RESIL[gauge] = value
+
+
+def resilience_metrics() -> Dict[str, float]:
+    """Snapshot of the resilience counters/gauges."""
+    with _RESIL_LOCK:
+        return dict(_RESIL)
+
+
+def resilience_samples(labels: Optional[Dict[str, str]] = None):
+    """Exposition samples for the resilience counters."""
+    labels = labels or {}
+    for name, value in resilience_metrics().items():
+        yield name, labels, value
+
+
 def wants_prometheus(request) -> bool:
     """Content negotiation for a shared /metrics route: Prometheus sends
     ``Accept: application/openmetrics-text, text/plain;version=0.0.4``;

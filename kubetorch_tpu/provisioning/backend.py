@@ -188,6 +188,14 @@ class LocalBackend:
             "module_meta": module_meta,
             "compute": compute_dict,
             "username": get_config().username,
+            # the controller URL the pods inherited from THIS process's
+            # env: a gang restart runs inside the controller (whose env
+            # has no KT_CONTROLLER_URL) — without re-injecting it the
+            # replacement pods come back headless: no registration, no
+            # heartbeats, invisible to the liveness tracker that just
+            # restarted them
+            "controller_url": (os.environ.get("KT_CONTROLLER_URL")
+                               or get_config().controller_url),
         })
         self._record_path(service_name).write_text(json.dumps(record, indent=2))
         # Parity with the k8s backend: when a controller is configured,
@@ -264,6 +272,36 @@ class LocalBackend:
                 from kubetorch_tpu.exceptions import rehydrate_exception
 
                 raise rehydrate_exception(resp.json())
+
+    def restart(self, service_name: str,
+                compute_dict: Optional[Dict[str, Any]] = None,
+                timeout: int = 120) -> Dict[str, Any]:
+        """Gang-atomic restart: relaunch the whole subprocess set from
+        the persisted service record (same env/meta/compute — ``launch``
+        tears the old generation down first). The resilience layer calls
+        this when liveness declares the gang dead; workers resume via
+        ``resume_or_init`` + streaming restore on their own."""
+        record = self.lookup(service_name)
+        if record is None:
+            raise KeyError(f"no local service {service_name!r}")
+        module_env = dict(record.get("module_env") or {})
+        controller_url = (record.get("controller_url")
+                          or os.environ.get("KT_CONTROLLER_URL"))
+        if controller_url:
+            # module_env overlays the launcher's env, so the replacement
+            # pods re-register and heartbeat even though the restart runs
+            # inside the controller process (no KT_CONTROLLER_URL there)
+            module_env.setdefault("KT_CONTROLLER_URL", controller_url)
+        new = self.launch(
+            service_name,
+            module_env=module_env,
+            compute_dict=compute_dict or record.get("compute") or {},
+            module_meta=record.get("module_meta") or {},
+            num_pods=len(record.get("pods") or []) or 1,
+            launch_timeout=timeout,
+            launch_id=record.get("launch_id", ""),
+        )
+        return {"restarted": len(new.get("pods") or [])}
 
     def teardown(self, service_name: str, quiet: bool = False) -> bool:
         record = self.lookup(service_name)

@@ -131,7 +131,8 @@ class K8sBackend:
                     f"--- logs ---\n{logs[-2000:]}")
 
     def _wait_ready(self, service_name: str, compute: Compute,
-                    timeout: int, launch_id: str):
+                    timeout: int, launch_id: str,
+                    exclude_terminating: bool = False):
         deadline = time.time() + timeout
         want = compute.num_pods
         controller = self._controller()
@@ -147,6 +148,12 @@ class K8sBackend:
             ready = 0
             for pod in pods:
                 self._extract_pod_failure(pod)
+                if (exclude_terminating
+                        and pod.get("metadata", {}).get("deletionTimestamp")):
+                    # a gracefully-deleted pod keeps Ready=True deep into
+                    # its termination grace period — it must not satisfy
+                    # a restart's wait for the REPLACEMENT set
+                    continue
                 conditions = pod.get("status", {}).get("conditions") or []
                 if any(c.get("type") == "Ready" and c.get("status") == "True"
                        for c in conditions):
@@ -269,6 +276,38 @@ class K8sBackend:
         for url in self.pod_urls(service_name):
             http_client.sync_client().post(
                 f"{url}/_reload", json=metadata, timeout=300.0)
+
+    def restart(self, service_name: str,
+                compute_dict: Optional[Dict[str, Any]] = None,
+                timeout: int = 300) -> Dict[str, Any]:
+        """Gang-atomic restart: delete every pod of the service so the
+        workload controller (Deployment / JobSet) recreates the whole
+        set, then re-wait readiness. Used by the resilience layer when
+        liveness declares the gang dead (a preempted spot slice's pods
+        are gone already; a wedged gang's pods need the delete)."""
+        if compute_dict is None:
+            controller = self._controller()
+            pool = (controller.get_pool(service_name)
+                    if controller is not None else None) or {}
+            compute_dict = pool.get("compute") or {}
+        compute = Compute.from_dict(compute_dict)
+        pods = self._pods(service_name, compute.namespace)
+        deleted = 0
+        for pod in pods:
+            try:
+                self.client.delete("Pod", pod["metadata"]["name"],
+                                   pod["metadata"].get("namespace"))
+                deleted += 1
+            except Exception:  # noqa: BLE001 — already gone is fine
+                pass
+        # launch-id scoping off: the replacement pods belong to the same
+        # deploy generation (the workload spec never changed). Terminating
+        # pods are excluded instead — the just-deleted set can stay
+        # Ready through its grace period and must not be mistaken for
+        # the respawned one.
+        self._wait_ready(service_name, compute, timeout, launch_id="",
+                         exclude_terminating=True)
+        return {"restarted": deleted or compute.num_pods}
 
     def teardown(self, service_name: str, quiet: bool = False) -> bool:
         found = False

@@ -1,0 +1,59 @@
+"""Resilience subsystem: preemption-aware checkpointing, liveness
+tracking, gang restart, and deterministic fault injection.
+
+GKE TPU slices are routinely preempted (spot / queued provisioning) and a
+single dead worker stalls an entire SPMD gang. This package closes the
+loop that ``retry.py`` (transport retries) and ``CheckpointManager``
+(pull-based saves) each cover only a corner of:
+
+- :mod:`~kubetorch_tpu.resilience.liveness` — pods heartbeat to the
+  controller; a :class:`LivenessTracker` marks them ``suspect``/``dead``
+  on missed beats and exposes gang health at ``GET /health/<svc>``;
+- :mod:`~kubetorch_tpu.resilience.preemption` — the pod server's SIGTERM
+  sequence: stop admitting calls, drain in-flight channel calls, run the
+  registered *emergency checkpoint* callbacks (``save(wait=True)`` plus a
+  delta ``put_arrays`` push), report ``preempted``;
+- :mod:`~kubetorch_tpu.resilience.restart` — controller-side
+  :class:`RestartPolicy` (max restarts, backoff, gang-atomic) and
+  :class:`GangRestarter` that reprovisions the worker set through the
+  provisioning backend; workers resume via ``resume_or_init`` + the
+  streaming restore path;
+- :mod:`~kubetorch_tpu.resilience.chaos` — a seedable
+  :class:`ChaosPolicy` (kill-worker, drop-connection, inject-latency,
+  corrupt-heartbeat) wired into the fake-K8s test backend and usable via
+  ``KT_CHAOS=`` in benches, so the recovery path is exercised in tier-1
+  tests rather than discovered in prod.
+
+Knobs: ``KT_HEARTBEAT_S``, ``KT_DEAD_AFTER_MISSES``, ``KT_MAX_RESTARTS``,
+``KT_RESTART_BACKOFF_S``, ``KT_AUTO_RESTART``, ``KT_DRAIN_TIMEOUT``,
+``KT_CHAOS`` — see ``docs/resilience.md``.
+"""
+
+from kubetorch_tpu.resilience.chaos import ChaosPolicy
+from kubetorch_tpu.resilience.liveness import (
+    ALIVE,
+    DEAD,
+    PREEMPTED,
+    SUSPECT,
+    LivenessTracker,
+)
+from kubetorch_tpu.resilience.preemption import (
+    PreemptionHandler,
+    register_emergency_checkpoint,
+    run_emergency_checkpoints,
+)
+from kubetorch_tpu.resilience.restart import GangRestarter, RestartPolicy
+
+__all__ = [
+    "ALIVE",
+    "SUSPECT",
+    "DEAD",
+    "PREEMPTED",
+    "LivenessTracker",
+    "PreemptionHandler",
+    "register_emergency_checkpoint",
+    "run_emergency_checkpoints",
+    "RestartPolicy",
+    "GangRestarter",
+    "ChaosPolicy",
+]

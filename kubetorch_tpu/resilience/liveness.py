@@ -1,0 +1,258 @@
+"""Controller-side liveness tracking: heartbeats in, gang health out.
+
+Pods heartbeat every ``KT_HEARTBEAT_S`` seconds — over their controller
+WebSocket when connected (a one-line ``{"type": "heartbeat"}`` message),
+else ``POST /heartbeat``. The tracker ages each pod through a small state
+machine:
+
+    alive --(1 missed beat)--> suspect --(KT_DEAD_AFTER_MISSES)--> dead
+      ^                          |                                  |
+      +------- beat -------------+            (gang restart, re-register)
+    preempted: reported explicitly by a draining pod (terminal, like dead)
+
+Gang semantics are *atomic*: one dead worker stalls an entire SPMD gang
+(the collectives hang), so ``gang_health`` reports the gang ``dead`` as
+soon as any member is — the restart layer then reprovisions the whole
+worker set, never a single pod.
+
+The tracker is transport-agnostic and clock-injectable so the state
+machine is unit-testable without sleeping.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+DEAD = "dead"
+PREEMPTED = "preempted"
+
+HEARTBEAT_ENV = "KT_HEARTBEAT_S"
+DEAD_AFTER_ENV = "KT_DEAD_AFTER_MISSES"
+DEFAULT_HEARTBEAT_S = 5.0
+DEFAULT_DEAD_AFTER_MISSES = 2
+
+
+def pod_identity() -> str:
+    """The ONE pod identity every resilience path uses — WS registration,
+    the HTTP heartbeat fallback, and the dying pod's ``preempted`` report.
+    ``KT_POD_NAME`` when set, else ``<hostname>-<replica>`` (matching the
+    controller-WS registration). A single definition matters: if a pod
+    beats over the WS as one name and falls back to HTTP as another, the
+    tracker registers a phantom second pod that ages to DEAD and triggers
+    a spurious gang restart."""
+    import socket
+
+    return (os.environ.get("KT_POD_NAME")
+            or f"{socket.gethostname()}-"
+               f"{os.environ.get('KT_REPLICA_INDEX', '0')}")
+
+
+def heartbeat_interval() -> float:
+    try:
+        return max(0.01, float(os.environ.get(HEARTBEAT_ENV,
+                                              DEFAULT_HEARTBEAT_S)))
+    except ValueError:
+        return DEFAULT_HEARTBEAT_S
+
+
+def default_dead_after_misses() -> int:
+    try:
+        return max(1, int(os.environ.get(DEAD_AFTER_ENV,
+                                         DEFAULT_DEAD_AFTER_MISSES)))
+    except ValueError:
+        return DEFAULT_DEAD_AFTER_MISSES
+
+
+class PodLiveness:
+    __slots__ = ("last_beat", "state", "beats", "info", "since",
+                 "detect_s")
+
+    def __init__(self, now: float):
+        self.last_beat = now
+        self.state = ALIVE
+        self.beats = 1
+        self.info: Optional[dict] = None
+        self.since = now          # when the current state was entered
+        self.detect_s = 0.0       # last_beat → dead transition, seconds
+
+
+class LivenessTracker:
+    """Heartbeat ledger + state machine. Thread-safe; ``sweep()`` drives
+    age-based transitions (call it at least every heartbeat interval —
+    the controller runs it at half the interval).
+
+    ``on_transition(service, pod, old_state, new_state)`` fires for every
+    state change, from whichever thread caused it (a beat reviving a
+    suspect pod, a sweep aging one out, an explicit ``preempted`` mark).
+    """
+
+    def __init__(self, heartbeat_s: Optional[float] = None,
+                 dead_after_misses: Optional[int] = None,
+                 on_transition: Optional[Callable[..., None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.heartbeat_s = (heartbeat_s if heartbeat_s is not None
+                            else heartbeat_interval())
+        self.dead_after = (dead_after_misses if dead_after_misses is not None
+                           else default_dead_after_misses())
+        self.on_transition = on_transition
+        self._clock = clock
+        self._pods: Dict[str, Dict[str, PodLiveness]] = {}
+        self._lock = threading.Lock()
+
+    # --------------------------------------------------------- updates
+    def beat(self, service: str, pod: str,
+             info: Optional[dict] = None) -> str:
+        """Record one heartbeat; returns the pod's (possibly revived)
+        state. A beat from a ``preempted`` pod does NOT revive it — the
+        pod told us it is going away; only a restart (``forget`` + fresh
+        registration) clears that."""
+        now = self._clock()
+        with self._lock:
+            pods = self._pods.setdefault(service, {})
+            entry = pods.get(pod)
+            if entry is None:
+                entry = pods[pod] = PodLiveness(now)
+                old = None
+            else:
+                old = entry.state
+                entry.last_beat = now
+                entry.beats += 1
+                if entry.state in (ALIVE, SUSPECT, DEAD):
+                    entry.state = ALIVE
+                    if old != ALIVE:
+                        entry.since = now
+            if info:
+                entry.info = info
+            new = entry.state
+        if old not in (None, new):
+            self._fire(service, pod, old, new)
+        return new
+
+    def mark(self, service: str, pod: str, state: str) -> None:
+        """Explicit state report (``preempted`` from a draining pod)."""
+        now = self._clock()
+        with self._lock:
+            entry = self._pods.setdefault(service, {}).setdefault(
+                pod, PodLiveness(now))
+            old = entry.state
+            entry.state = state
+            if old != state:
+                entry.since = now
+        if old != state:
+            self._fire(service, pod, old, state)
+
+    def forget(self, service: str, pod: str) -> None:
+        with self._lock:
+            (self._pods.get(service) or {}).pop(pod, None)
+
+    def forget_service(self, service: str) -> None:
+        """Drop all liveness state for a service (gang restart: the new
+        generation re-registers and beats fresh)."""
+        with self._lock:
+            self._pods.pop(service, None)
+
+    # ---------------------------------------------------------- aging
+    def sweep(self, now: Optional[float] = None
+              ) -> List[Tuple[str, str, str, str]]:
+        """Age pods: > 1 missed beat → suspect, > ``dead_after`` missed
+        beats → dead. Returns the transitions it caused as
+        ``(service, pod, old, new)`` tuples (also fired via callback).
+
+        Both thresholds carry a quarter-beat margin: the pod's loop
+        sleeps a full interval BEFORE each send, so steady-state beats
+        land at ``heartbeat_s + send/scheduling ε`` — without the margin
+        a sweep landing inside ε flaps a healthy pod to suspect, and one
+        transient failed POST could read as ``dead_after`` misses and
+        gang-restart a healthy job."""
+        now = self._clock() if now is None else now
+        margin = 0.25 * self.heartbeat_s
+        transitions: List[Tuple[str, str, str, str]] = []
+        with self._lock:
+            for service, pods in self._pods.items():
+                for pod, entry in pods.items():
+                    if entry.state in (DEAD, PREEMPTED):
+                        continue
+                    age = now - entry.last_beat
+                    if age > self.dead_after * self.heartbeat_s + margin:
+                        transitions.append((service, pod, entry.state, DEAD))
+                        entry.state = DEAD
+                        entry.since = now
+                        entry.detect_s = age
+                    elif (age > self.heartbeat_s + margin
+                          and entry.state == ALIVE):
+                        transitions.append(
+                            (service, pod, ALIVE, SUSPECT))
+                        entry.state = SUSPECT
+                        entry.since = now
+        for service, pod, old, new in transitions:
+            self._fire(service, pod, old, new)
+        return transitions
+
+    # --------------------------------------------------------- queries
+    def pod_state(self, service: str, pod: str) -> Optional[str]:
+        with self._lock:
+            entry = (self._pods.get(service) or {}).get(pod)
+            return entry.state if entry else None
+
+    def services(self) -> List[str]:
+        with self._lock:
+            return list(self._pods)
+
+    def dead_services(self) -> List[str]:
+        """Services whose gang is dead — gang-atomic: ANY dead or
+        preempted member means the whole gang needs a restart."""
+        with self._lock:
+            return [service for service, pods in self._pods.items()
+                    if pods and any(e.state in (DEAD, PREEMPTED)
+                                    for e in pods.values())]
+
+    def gang_health(self, service: str) -> Dict[str, Any]:
+        """The ``GET /health/<svc>`` payload: per-pod states/ages plus
+        the gang-atomic verdict (healthy / degraded / dead / unknown)."""
+        now = self._clock()
+        with self._lock:
+            pods = self._pods.get(service) or {}
+            detail = {
+                pod: {
+                    "state": e.state,
+                    "age_s": round(now - e.last_beat, 3),
+                    "beats": e.beats,
+                    **({"detect_s": round(e.detect_s, 3)}
+                       if e.state == DEAD and e.detect_s else {}),
+                }
+                for pod, e in pods.items()
+            }
+        counts: Dict[str, int] = {}
+        for entry in detail.values():
+            counts[entry["state"]] = counts.get(entry["state"], 0) + 1
+        if not detail:
+            status = "unknown"
+        elif counts.get(DEAD) or counts.get(PREEMPTED):
+            status = "dead"
+        elif counts.get(SUSPECT):
+            status = "degraded"
+        else:
+            status = "healthy"
+        return {
+            "service": service,
+            "status": status,
+            "heartbeat_s": self.heartbeat_s,
+            "dead_after_misses": self.dead_after,
+            "pods": detail,
+            "counts": counts,
+        }
+
+    # -------------------------------------------------------- internal
+    def _fire(self, service: str, pod: str, old: Optional[str],
+              new: str) -> None:
+        if self.on_transition is None:
+            return
+        try:
+            self.on_transition(service, pod, old, new)
+        except Exception:  # noqa: BLE001 — observers never break tracking
+            pass

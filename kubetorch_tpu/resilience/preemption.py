@@ -1,0 +1,229 @@
+"""Pod-side preemption handling: drain, emergency checkpoint, report.
+
+GKE gives a preempted (spot / queued-provisioning) TPU pod a SIGTERM and
+a grace window before the SIGKILL. The old behavior burned that window
+sleeping; now it is spent in three phases, each a span in the recovery
+trace tree:
+
+1. ``preempt.drain`` — stop admitting new calls (POSTs get the existing
+   503 ``PodTerminatedError``; new channel frames get an error frame) and
+   wait for in-flight POST + channel calls to finish, bounded by
+   ``KT_DRAIN_TIMEOUT`` (default 40% of ``KT_TERM_GRACE``);
+2. ``preempt.checkpoint`` — run the registered *emergency checkpoint*
+   callbacks in this process AND fan the request to every worker process
+   (they own the train state). A trainer registered via
+   ``Trainer.enable_checkpointing`` saves ``wait=True`` and pushes a
+   delta ``put_arrays`` to the store — cheap, because the digest
+   manifests mean only changed leaves ship;
+3. report ``preempted`` to the controller (over the controller WS when
+   connected, else ``POST /heartbeat``) so the liveness tracker marks the
+   gang immediately instead of waiting out the missed-beat window.
+
+The callback registry is process-local: the pod-server process registers
+nothing by default; worker processes register from user code (the
+``EMERGENCY`` pool request runs them). Callbacks must be fast — they
+share the grace window with the drain.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubetorch_tpu.observability import tracing
+
+GRACE_ENV = "KT_TERM_GRACE"
+DRAIN_ENV = "KT_DRAIN_TIMEOUT"
+DEFAULT_GRACE_S = 2.0
+
+_CALLBACKS: List[Tuple[str, Callable[[], Any]]] = []
+_CB_LOCK = threading.Lock()
+
+
+def register_emergency_checkpoint(fn: Callable[[], Any],
+                                  name: str = "") -> Callable[[], Any]:
+    """Register ``fn()`` to run at preemption (idempotent per (name, fn);
+    re-registering a name replaces it — a reloaded callable must not
+    stack stale callbacks). Usable as a decorator."""
+    label = name or getattr(fn, "__qualname__", repr(fn))
+    with _CB_LOCK:
+        _CALLBACKS[:] = [(n, f) for n, f in _CALLBACKS if n != label]
+        _CALLBACKS.append((label, fn))
+    return fn
+
+
+def unregister_emergency_checkpoint(name: str) -> bool:
+    with _CB_LOCK:
+        before = len(_CALLBACKS)
+        _CALLBACKS[:] = [(n, f) for n, f in _CALLBACKS if n != name]
+        return len(_CALLBACKS) != before
+
+
+def run_emergency_checkpoints(
+        parent: Optional[tuple] = None) -> Dict[str, Any]:
+    """Run every registered callback; one ``preempt.checkpoint`` span
+    each. Failures are captured, not raised — a broken callback must not
+    eat the grace window of the ones after it."""
+    with _CB_LOCK:
+        callbacks = list(_CALLBACKS)
+    results: Dict[str, Any] = {}
+    for name, fn in callbacks:
+        t0 = time.perf_counter()
+        wall0 = time.time()
+        try:
+            out = fn()
+            results[name] = {"ok": True, "result": out,
+                             "wall_s": round(time.perf_counter() - t0, 4)}
+            try:
+                from kubetorch_tpu.observability import prometheus as prom
+
+                prom.record_resilience("emergency_checkpoint")
+            except Exception:  # noqa: BLE001
+                pass
+        except Exception as exc:  # noqa: BLE001 — keep draining the list
+            results[name] = {"ok": False,
+                             "error": f"{type(exc).__name__}: {exc}",
+                             "wall_s": round(time.perf_counter() - t0, 4)}
+        tracing.record_span(
+            "preempt.checkpoint", time.perf_counter() - t0, start=wall0,
+            parent=parent,
+            attrs={"callback": name, "ok": results[name]["ok"]})
+    return results
+
+
+def grace_seconds() -> float:
+    try:
+        return max(0.1, float(os.environ.get(GRACE_ENV, DEFAULT_GRACE_S)))
+    except ValueError:
+        return DEFAULT_GRACE_S
+
+
+def drain_timeout(grace_s: Optional[float] = None) -> float:
+    grace_s = grace_s if grace_s is not None else grace_seconds()
+    try:
+        return max(0.0, float(os.environ.get(DRAIN_ENV, 0.4 * grace_s)))
+    except ValueError:
+        return 0.4 * grace_s
+
+
+class PreemptionHandler:
+    """Owns one pod server's SIGTERM sequence. Constructed and kicked by
+    ``PodServer._mark_terminating``; runs on the server's event loop.
+    The server's hard-exit backstop (``os._exit`` at grace end) stays in
+    place — this handler normally finishes and exits earlier."""
+
+    def __init__(self, server, grace_s: Optional[float] = None):
+        self.server = server
+        self.grace_s = grace_s if grace_s is not None else grace_seconds()
+        self.drain_s = drain_timeout(self.grace_s)
+        self.drained = False
+        self.checkpoint_results: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------
+    def _busy(self) -> bool:
+        from kubetorch_tpu.observability import prometheus as prom
+
+        inflight_posts = getattr(self.server, "_inflight_posts", 0)
+        return inflight_posts > 0 or prom.channel_inflight(0) > 0
+
+    async def run(self) -> None:
+        try:
+            from kubetorch_tpu.observability import prometheus as prom
+
+            prom.record_resilience("preempted")
+        except Exception:  # noqa: BLE001
+            pass
+        pspan = tracing.start_span(
+            "preempt", attrs={
+                "service": self.server.metadata.get("service_name", ""),
+                "pod": os.environ.get("KT_POD_NAME", ""),
+                "grace_s": self.grace_s})
+        pspan.detach()
+        parent = getattr(pspan, "context", None)
+        # 1. drain: in-flight POSTs + channel calls (queued FIFO frames
+        # included — submitted-but-unacked calls are in-flight from the
+        # client's view) finish; new admissions are already refused.
+        t0, wall0 = time.perf_counter(), time.time()
+        deadline = t0 + self.drain_s
+        while time.perf_counter() < deadline and self._busy():
+            await asyncio.sleep(0.02)
+        self.drained = not self._busy()
+        tracing.record_span(
+            "preempt.drain", time.perf_counter() - t0, start=wall0,
+            parent=parent, attrs={"drained": self.drained,
+                                  "budget_s": round(self.drain_s, 3)})
+        # 2. emergency checkpoint: worker processes first (they hold the
+        # device state), then this process's own registry (app mode /
+        # in-server states). Budget: what's left of the grace window,
+        # minus a flush margin for the report.
+        ckpt_budget = max(
+            0.2, self.grace_s - (time.perf_counter() - t0) - 0.3)
+        loop = asyncio.get_running_loop()
+        try:
+            self.checkpoint_results = await asyncio.wait_for(
+                loop.run_in_executor(
+                    None, lambda: self._checkpoint(parent, ckpt_budget)),
+                timeout=ckpt_budget)
+        except asyncio.TimeoutError:
+            self.checkpoint_results = {"_timeout": {
+                "ok": False, "budget_s": round(ckpt_budget, 3)}}
+        except Exception as exc:  # noqa: BLE001 — dying pod: report, move on
+            self.checkpoint_results = {"_error": {
+                "ok": False, "error": f"{type(exc).__name__}: {exc}"}}
+        # 3. tell the controller — liveness marks the gang immediately
+        # instead of waiting out KT_DEAD_AFTER_MISSES beats.
+        await self._report()
+        pspan.end({"drained": self.drained,
+                   "checkpoints": len(self.checkpoint_results)})
+
+    def _checkpoint(self, parent, budget_s: float) -> Dict[str, Any]:
+        results: Dict[str, Any] = {}
+        supervisor = getattr(self.server, "supervisor", None)
+        if supervisor is not None:
+            # clamp the pool fan-out INSIDE the outer budget: one hung
+            # worker timing out at the same instant as the wait_for would
+            # discard the workers that DID save and skip the registry
+            pool_timeout = max(0.2, budget_s * 0.75)
+            try:
+                worker_results = supervisor.emergency_checkpoint(
+                    timeout=pool_timeout)
+                for i, payload in enumerate(worker_results or []):
+                    results[f"worker-{i}"] = payload
+            except Exception as exc:  # noqa: BLE001
+                results["workers"] = {"ok": False, "error": str(exc)}
+        results.update(run_emergency_checkpoints(parent=parent))
+        return results
+
+    async def _report(self) -> None:
+        from kubetorch_tpu.resilience.liveness import pod_identity
+
+        service = self.server.metadata.get("service_name", "")
+        pod = pod_identity()
+        ws = getattr(self.server, "controller_ws", None)
+        if ws is not None and getattr(ws, "connected", False):
+            try:
+                ws.notify_preempted()
+                await asyncio.sleep(0.05)  # let the frame flush
+                return
+            except Exception:  # noqa: BLE001 — fall through to HTTP
+                pass
+        controller_url = os.environ.get("KT_CONTROLLER_URL")
+        if not controller_url:
+            return
+        try:
+            import aiohttp
+
+            token = os.environ.get("KT_CONTROLLER_TOKEN")
+            headers = {"Authorization": f"Bearer {token}"} if token else {}
+            async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=2.0),
+                    headers=headers) as session:
+                await session.post(
+                    f"{controller_url.rstrip('/')}/heartbeat",
+                    json={"service": service, "pod": pod,
+                          "state": "preempted"})
+        except Exception:  # noqa: BLE001 — dying pod, best effort
+            pass

@@ -1,0 +1,218 @@
+"""Gang-atomic restart: policy (budget + backoff) and the restarter.
+
+One dead worker stalls an entire SPMD gang, so recovery reprovisions the
+*whole* worker set — never a single pod — through the provisioning
+backend that launched it (``LocalBackend.restart`` relaunches the
+subprocess set from the persisted service record; ``K8sBackend.restart``
+deletes the gang's pods so the workload controller recreates them, then
+re-waits readiness). Workers come back up, ``resume_or_init`` restores
+the emergency checkpoint via the streaming restore path, and training
+continues at the saved step.
+
+``RestartPolicy`` bounds the blast radius: at most ``KT_MAX_RESTARTS``
+per service, exponential backoff from ``KT_RESTART_BACKOFF_S`` (first
+restart is immediate — a preempted spot slice should come back as fast
+as the backend allows). Every attempt is a ``restart.provision`` span
+and a ``resilience_gang_restarts_total`` counter tick; failures land in
+``resilience_gang_restart_failures_total`` so a crash-looping gang is a
+dashboard line, not a silent spin.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from kubetorch_tpu.observability import tracing
+
+MAX_RESTARTS_ENV = "KT_MAX_RESTARTS"
+BACKOFF_ENV = "KT_RESTART_BACKOFF_S"
+RESET_AFTER_ENV = "KT_RESTART_RESET_S"
+DEFAULT_MAX_RESTARTS = 3
+DEFAULT_BACKOFF_S = 1.0
+DEFAULT_RESET_AFTER_S = 300.0
+
+
+def max_restarts() -> int:
+    try:
+        return max(0, int(os.environ.get(MAX_RESTARTS_ENV,
+                                         DEFAULT_MAX_RESTARTS)))
+    except ValueError:
+        return DEFAULT_MAX_RESTARTS
+
+
+class RestartPolicy:
+    """Per-service restart budget + backoff schedule (thread-safe).
+
+    ``next_delay(service)`` consumes one attempt and returns the delay to
+    wait before provisioning (0 for the first attempt), or None when the
+    budget is exhausted — the caller then leaves the gang down and the
+    operator sees it on ``/health`` and the restart counters."""
+
+    def __init__(self, max_restarts_n: Optional[int] = None,
+                 backoff_s: Optional[float] = None,
+                 backoff_max_s: float = 60.0,
+                 reset_after_s: Optional[float] = None):
+        self.max_restarts = (max_restarts_n if max_restarts_n is not None
+                             else max_restarts())
+        if backoff_s is None:
+            try:
+                backoff_s = float(os.environ.get(BACKOFF_ENV,
+                                                 DEFAULT_BACKOFF_S))
+            except ValueError:
+                backoff_s = DEFAULT_BACKOFF_S
+        self.backoff_s = backoff_s
+        self.backoff_max_s = backoff_max_s
+        if reset_after_s is None:
+            try:
+                reset_after_s = float(os.environ.get(
+                    RESET_AFTER_ENV, DEFAULT_RESET_AFTER_S))
+            except ValueError:
+                reset_after_s = DEFAULT_RESET_AFTER_S
+        self.reset_after_s = reset_after_s
+        self._attempts: Dict[str, int] = {}
+        self._healthy_since: Dict[str, float] = {}
+        self._exhausted_reported: set = set()
+        self._lock = threading.Lock()
+
+    def next_delay(self, service: str) -> Optional[float]:
+        with self._lock:
+            n = self._attempts.get(service, 0)
+            if n >= self.max_restarts:
+                return None
+            self._attempts[service] = n + 1
+        if n == 0:
+            return 0.0
+        return min(self.backoff_s * (2 ** (n - 1)), self.backoff_max_s)
+
+    def attempts(self, service: str) -> int:
+        with self._lock:
+            return self._attempts.get(service, 0)
+
+    def exhausted(self, service: str) -> bool:
+        with self._lock:
+            return self._attempts.get(service, 0) >= self.max_restarts
+
+    def exhausted_once(self, service: str) -> bool:
+        """True exactly once per service after exhaustion — lets the
+        caller emit one "budget exhausted" event, not one per sweep."""
+        with self._lock:
+            if (self._attempts.get(service, 0) >= self.max_restarts
+                    and service not in self._exhausted_reported):
+                self._exhausted_reported.add(service)
+                return True
+            return False
+
+    def note_health(self, service: str, healthy: bool,
+                    now: Optional[float] = None) -> bool:
+        """Budget decay: a restarted gang that stays continuously healthy
+        for ``reset_after_s`` (``KT_RESTART_RESET_S``) earns its budget
+        back. Without this the cap is a *lifetime* one — spot slices are
+        preempted routinely, so after ``max_restarts`` preemptions spread
+        over days the service would permanently lose auto-restart (and
+        backoff would escalate off a weeks-old count). Call once per
+        sweep; returns True on the sweep that resets."""
+        now = time.time() if now is None else now
+        with self._lock:
+            if self._attempts.get(service, 0) == 0 or not healthy:
+                self._healthy_since.pop(service, None)
+                return False
+            since = self._healthy_since.setdefault(service, now)
+            if now - since < self.reset_after_s:
+                return False
+            self._attempts.pop(service, None)
+            self._exhausted_reported.discard(service)
+            self._healthy_since.pop(service, None)
+            return True
+
+    def refund(self, service: str) -> None:
+        """Give back one consumed attempt — a restart that was skipped
+        (the gang revived during the backoff sleep) must not burn
+        budget."""
+        with self._lock:
+            n = self._attempts.get(service, 0)
+            if n > 0:
+                self._attempts[service] = n - 1
+            self._exhausted_reported.discard(service)
+
+    def reset(self, service: str) -> None:
+        """Clear the budget (operator action / sustained health)."""
+        with self._lock:
+            self._attempts.pop(service, None)
+            self._healthy_since.pop(service, None)
+            self._exhausted_reported.discard(service)
+
+
+class GangRestarter:
+    """Reprovision one service's gang through its provisioning backend.
+
+    ``on_event(service, reason, message)`` is the controller's event hook
+    (lands in the log sink under ``job="kubetorch-events"``)."""
+
+    def __init__(self, policy: Optional[RestartPolicy] = None,
+                 backend_for: Optional[Callable[[Optional[str]], Any]] = None,
+                 on_event: Optional[Callable[[str, str, str], None]] = None):
+        self.policy = policy or RestartPolicy()
+        self._backend_for = backend_for
+        self.on_event = on_event
+
+    def _backend(self, name: Optional[str]):
+        if self._backend_for is not None:
+            return self._backend_for(name)
+        from kubetorch_tpu.provisioning.backend import get_backend
+
+        return get_backend(name)
+
+    def _event(self, service: str, reason: str, message: str) -> None:
+        if self.on_event is None:
+            return
+        try:
+            self.on_event(service, reason, message)
+        except Exception:  # noqa: BLE001 — events never break a restart
+            pass
+
+    def restart(self, service: str,
+                pool: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """One restart attempt (call after waiting the policy's delay).
+        Returns ``{"ok", "attempt", "wall_s", ["error"]}``."""
+        from kubetorch_tpu.observability import prometheus as prom
+
+        pool = pool or {}
+        attempt = self.policy.attempts(service)
+        t0, wall0 = time.perf_counter(), time.time()
+        try:
+            backend = self._backend(pool.get("backend") or None)
+            restart_fn = getattr(backend, "restart", None)
+            if restart_fn is None:
+                raise RuntimeError(
+                    f"backend {getattr(backend, 'name', backend)!r} does "
+                    f"not support gang restart")
+            result = restart_fn(service,
+                                compute_dict=pool.get("compute") or None)
+            wall = time.perf_counter() - t0
+            prom.record_resilience("restart")
+            prom.record_resilience("last_restart_seconds", wall)
+            tracing.record_span(
+                "restart.provision", wall, start=wall0,
+                attrs={"service": service, "attempt": attempt, "ok": True})
+            self._event(service, "GangRestarted",
+                        f"gang restarted (attempt {attempt}/"
+                        f"{self.policy.max_restarts}, "
+                        f"{wall:.2f}s): {result}")
+            return {"ok": True, "attempt": attempt,
+                    "wall_s": round(wall, 4), "result": result}
+        except Exception as exc:  # noqa: BLE001 — report, don't crash
+            wall = time.perf_counter() - t0
+            prom.record_resilience("restart_failure")
+            tracing.record_span(
+                "restart.provision", wall, start=wall0,
+                attrs={"service": service, "attempt": attempt, "ok": False,
+                       "error": f"{type(exc).__name__}"})
+            self._event(service, "GangRestartFailed",
+                        f"gang restart attempt {attempt} failed: "
+                        f"{type(exc).__name__}: {exc}")
+            return {"ok": False, "attempt": attempt,
+                    "wall_s": round(wall, 4),
+                    "error": f"{type(exc).__name__}: {exc}"}

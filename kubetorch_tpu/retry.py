@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import random
 import time
-from typing import Callable, Tuple, Type
+from typing import Callable, Optional, Tuple, Type
 
 import httpx
 
@@ -27,11 +27,17 @@ DEFAULT_ATTEMPTS = 3  # override via KT_RETRY_ATTEMPTS
 
 
 class RetryableStatus(Exception):
-    """Internal marker: an idempotent call got a 5xx worth re-trying."""
+    """Internal marker: an idempotent call got a 5xx worth re-trying.
 
-    def __init__(self, status: int, text: str = ""):
+    ``retry_after`` carries a parsed ``Retry-After`` header (seconds) when
+    the server sent one — an overloaded store/controller saying exactly
+    when to come back beats guessing with exponential backoff."""
+
+    def __init__(self, status: int, text: str = "",
+                 retry_after: Optional[float] = None):
         super().__init__(f"HTTP {status}: {text[:200]}")
         self.status = status
+        self.retry_after = retry_after
 
 
 CONNECT_ERRORS: Tuple[Type[BaseException], ...] = (
@@ -50,6 +56,26 @@ def attempts() -> int:
         return DEFAULT_ATTEMPTS
 
 
+def backoff_sleep_s(exc: BaseException, delay: float,
+                    max_delay: float) -> float:
+    """The one sleep rule both retry loops share.
+
+    - A server-stated ``Retry-After`` wins (capped at the policy's
+      ``max_delay`` — a server asking for 10 minutes does not get to pin
+      a deploy that long), taken verbatim: the server named a time, and
+      jittering it would land *before* the stated recovery.
+    - Otherwise **full jitter** over the exponential window
+      (``uniform(0, delay)``): under a thundering herd (a gang of pods
+      re-dialing one recovering store), equal-phase retries re-collide
+      every round; full jitter spreads them across the whole window
+      (the AWS-style decorrelation result).
+    """
+    retry_after = getattr(exc, "retry_after", None)
+    if isinstance(retry_after, (int, float)) and retry_after >= 0:
+        return min(float(retry_after), max_delay)
+    return random.uniform(0, delay)
+
+
 def with_retries(
     fn: Callable,
     *,
@@ -58,26 +84,50 @@ def with_retries(
     base_delay: float = 0.25,
     max_delay: float = 4.0,
 ):
-    """Run ``fn()``; on a retryable error, back off exponentially (with
-    jitter) and re-run, raising the last error after ``max_attempts``."""
+    """Run ``fn()``; on a retryable error, back off exponentially (full
+    jitter, ``Retry-After``-aware) and re-run, raising the last error
+    after ``max_attempts``."""
     n = max_attempts or attempts()
     delay = base_delay
     for attempt in range(1, n + 1):
         try:
             return fn()
-        except retry_on:
+        except retry_on as exc:
             if attempt == n:
                 raise
-            time.sleep(delay * (0.7 + 0.6 * random.random()))
+            time.sleep(backoff_sleep_s(exc, delay, max_delay))
             delay = min(delay * 2, max_delay)
+
+
+def parse_retry_after(value: Optional[str]) -> Optional[float]:
+    """``Retry-After`` header → seconds. Accepts delta-seconds and
+    HTTP-date forms; None for absent/garbage (caller falls back to
+    exponential backoff)."""
+    if not value:
+        return None
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        pass
+    try:
+        from email.utils import parsedate_to_datetime
+
+        when = parsedate_to_datetime(value)
+        return max(0.0, when.timestamp() - time.time())
+    except Exception:  # noqa: BLE001 — malformed date: not a signal
+        return None
 
 
 def raise_if_retryable(resp: "httpx.Response"):
     """Map gateway-transient responses (502/503/504) to
-    :class:`RetryableStatus`. Plain 500s and all 4xx are the caller's
-    problem — a 500 usually means a server bug, not a transient."""
+    :class:`RetryableStatus`, carrying a parsed ``Retry-After`` when the
+    server sent one (503 load-shedding states exactly when to return).
+    Plain 500s and all 4xx are the caller's problem — a 500 usually
+    means a server bug, not a transient."""
     if resp.status_code in (502, 503, 504):
-        raise RetryableStatus(resp.status_code, resp.text)
+        raise RetryableStatus(
+            resp.status_code, resp.text,
+            retry_after=parse_retry_after(resp.headers.get("Retry-After")))
 
 
 async def with_retries_async(
@@ -96,8 +146,8 @@ async def with_retries_async(
     for attempt in range(1, n + 1):
         try:
             return await fn()
-        except retry_on:
+        except retry_on as exc:
             if attempt == n:
                 raise
-            await asyncio.sleep(delay * (0.7 + 0.6 * random.random()))
+            await asyncio.sleep(backoff_sleep_s(exc, delay, max_delay))
             delay = min(delay * 2, max_delay)

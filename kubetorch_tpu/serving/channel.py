@@ -79,10 +79,39 @@ def default_depth() -> int:
         return 2
 
 
+def _chaos_policy():
+    """Active fault-injection policy, or None (the overwhelmingly common
+    case — one env-string compare per send)."""
+    try:
+        from kubetorch_tpu.resilience import chaos
+
+        return chaos.active()
+    except Exception:  # noqa: BLE001 — chaos must never break serving
+        return None
+
+
 class ChannelClosedError(ConnectionError):
     """The channel dropped with this call unresolved. The call may or may
     not have executed — resubmitting a non-idempotent call is on the
     caller (same contract as the POST path's read-failure case)."""
+
+
+class ChannelInterrupted(ChannelClosedError):
+    """The connection dropped with these calls submitted but
+    unacknowledged. Before this type, they vanished into a generic
+    connection error; now the handle carries the ``call_ids`` so a caller
+    replaying idempotent work knows exactly WHICH submissions to re-issue
+    (and a stateful-engine caller knows which chunks are in doubt)."""
+
+    def __init__(self, message: str, call_ids=()):
+        super().__init__(message)
+        self.call_ids = tuple(call_ids)
+
+    def __str__(self) -> str:
+        base = super().__str__()
+        if self.call_ids:
+            return f"{base} (unacknowledged call ids: {list(self.call_ids)})"
+        return base
 
 
 class ChannelCall:
@@ -460,6 +489,20 @@ class CallChannel:
         if not self._call_alive(cid):
             return
         ws = await self._ensure_ws()
+        policy = _chaos_policy()
+        if policy is not None:
+            # fault injection (KT_CHAOS / installed policy) happens
+            # BEFORE the final aliveness check so the no-await contract
+            # between that check and the write still holds
+            from kubetorch_tpu.resilience import chaos as chaos_mod
+
+            if policy.decide(chaos_mod.DROP_CONNECTION, f"cid-{cid}"):
+                await ws.close()  # reader fails pending: ChannelInterrupted
+                return
+            if policy.decide(chaos_mod.INJECT_LATENCY, f"cid-{cid}"):
+                import asyncio
+
+                await asyncio.sleep(policy.latency())
         if not self._call_alive(cid):
             return
         await ws.send_bytes(envelope)
@@ -476,10 +519,11 @@ class CallChannel:
                     break
         finally:
             # A dropped socket fails every unresolved call: the channel
-            # cannot know whether they executed (ChannelClosedError says
-            # so). The next submit() re-dials and counts a reconnect.
-            self._fail_pending(ChannelClosedError(
-                "call channel connection lost"))
+            # cannot know whether they executed. ChannelInterrupted names
+            # the unacknowledged call ids so idempotent callers can
+            # replay exactly those. The next submit() re-dials and
+            # counts a reconnect.
+            self._fail_pending(reason="call channel connection lost")
 
     async def _shutdown(self):
         if self._reader is not None:
@@ -506,8 +550,14 @@ class CallChannel:
         with self._calls_lock:
             self._calls.pop(cid, None)
 
-    def _fail_pending(self, exc: BaseException):
+    def _fail_pending(self, exc: Optional[BaseException] = None,
+                      reason: str = "call channel interrupted"):
         with self._calls_lock:
             pending, self._calls = list(self._calls.values()), {}
+        if not pending:
+            return
+        if exc is None:
+            exc = ChannelInterrupted(
+                reason, call_ids=[call.cid for call in pending])
         for call in pending:
             call._fail(exc)

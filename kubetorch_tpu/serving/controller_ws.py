@@ -29,9 +29,9 @@ class ControllerWebSocket:
         self.ws_url = (ws_scheme
                        + self.controller_url[self.controller_url.index("://"):]
                        + "/ws/pods")
-        self.pod_name = (os.environ.get("KT_POD_NAME")
-                         or f"{socket.gethostname()}-"
-                            f"{os.environ.get('KT_REPLICA_INDEX', '0')}")
+        from kubetorch_tpu.resilience.liveness import pod_identity
+
+        self.pod_name = pod_identity()
         self._task: Optional[asyncio.Task] = None
         self._stop = asyncio.Event()
         self.connected = False
@@ -148,6 +148,35 @@ class ControllerWebSocket:
             await ws.send_json({"type": "activity"})
         except (ConnectionError, RuntimeError):
             pass
+
+    def _notify(self, payload: dict):
+        """Fire-and-forget one message on the live socket (no-op when
+        disconnected — HTTP fallbacks cover that)."""
+        ws = self._ws
+        if ws is None or ws.closed:
+            return
+
+        async def _send():
+            try:
+                await ws.send_json(payload)
+            except Exception:
+                pass
+
+        try:
+            asyncio.get_running_loop().create_task(_send())
+        except RuntimeError:  # called from a worker thread
+            asyncio.run_coroutine_threadsafe(_send(), self._loop)
+
+    def notify_heartbeat(self):
+        """Liveness beat piggybacked on this WS (resilience/liveness.py:
+        the controller resolves service/pod from the registration)."""
+        self._notify({"type": "heartbeat"})
+
+    def notify_preempted(self):
+        """Tell the controller this pod is draining after SIGTERM — the
+        liveness tracker marks it ``preempted`` immediately instead of
+        waiting out the missed-beat window."""
+        self._notify({"type": "preempted"})
 
     def notify_status(self):
         """Push the pod's current ready/setup_error to the controller

@@ -244,6 +244,38 @@ class ProcessPool:
         return {"ok": True,
                 "stream": StreamResult(chan, first, timeout, _cancel)}
 
+    def emergency_checkpoint(self, timeout: float = 5.0) -> List[Any]:
+        """Fan the preemption emergency-checkpoint request to every
+        worker (they own the device state) and collect what each saved.
+        A worker that can't answer inside the grace-window budget yields
+        None — the drain must not block the report to the controller."""
+        from kubetorch_tpu.serving.process_worker import EMERGENCY
+
+        futures = []
+        for worker in self.workers:
+            req = {"kind": EMERGENCY,
+                   "req_id": f"{EMERGENCY}-{uuid.uuid4().hex}"}
+            try:
+                futures.append(self._submit(worker, req)[0])
+            except Exception:  # noqa: BLE001 — dead worker: skip
+                futures.append(None)
+        # ONE deadline across the whole collection: the budget is the
+        # grace window's, not per-worker — a hung worker must not eat
+        # the other workers' (already-submitted) answers
+        deadline = time.time() + timeout
+        results: List[Any] = []
+        for fut in futures:
+            if fut is None:
+                results.append(None)
+                continue
+            try:
+                resp = fut.result(max(0.05, deadline - time.time()))
+                results.append(resp.get("payload")
+                               if resp.get("ok") else None)
+            except Exception:  # noqa: BLE001
+                results.append(None)
+        return results
+
     def profile(self, action: str, directory: str = "",
                 local_rank: int = 0, timeout: float = 300.0) -> dict:
         """Start/stop a jax.profiler trace inside a worker process."""

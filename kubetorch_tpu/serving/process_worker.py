@@ -36,6 +36,7 @@ SETUP = "__setup__"
 SHUTDOWN = "__shutdown__"
 PROFILE = "__profile__"
 CANCEL = "__cancel__"
+EMERGENCY = "__emergency__"
 
 
 def get_distributed_env_vars(
@@ -255,6 +256,20 @@ class _WorkerLoop:
                     req.get("root_path", ""), req["import_path"],
                     req["name"], self.callable_type, req.get("init_args"))
                 return {"req_id": req_id, "ok": True, "payload": None}
+
+            if req["kind"] == EMERGENCY:
+                # Preemption: the pod server is inside its SIGTERM grace
+                # window and THIS process owns the device state — run the
+                # registered emergency-checkpoint callbacks (a trainer's
+                # save(wait=True) + delta store push) in the executor so
+                # an in-flight call keeps dispatching while we save.
+                from kubetorch_tpu.resilience.preemption import (
+                    run_emergency_checkpoints,
+                )
+
+                payload = await asyncio.get_running_loop().run_in_executor(
+                    self.executor, run_emergency_checkpoints)
+                return {"req_id": req_id, "ok": True, "payload": payload}
 
             if req["kind"] == PROFILE:
                 # jax.profiler runs HERE, in the process that owns the TPU

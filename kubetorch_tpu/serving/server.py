@@ -103,6 +103,10 @@ class PodServer:
         self.setup_error: Optional[str] = None
         self.controller_ws = None
         self._activity_task = None
+        self._heartbeat_task = None
+        # in-flight POST calls (the channel's in-flight depth lives in the
+        # prometheus gauge): the preemption drain waits on both
+        self._inflight_posts = 0
         self._actor_host = None
         self._actor_host_lock = threading.Lock()
 
@@ -167,6 +171,8 @@ class PodServer:
             self.controller_ws.start()
             self._activity_task = asyncio.create_task(
                 self._activity_loop(controller_url))
+            self._heartbeat_task = asyncio.create_task(
+                self._heartbeat_loop(controller_url))
         if self.metadata.get("callable_type") == "app":
             await self._start_app_cmd()
             if (self.metadata.get("app_health_path")
@@ -239,6 +245,8 @@ class PodServer:
             await self.controller_ws.stop()
         if getattr(self, "_activity_task", None) is not None:
             self._activity_task.cancel()
+        if getattr(self, "_heartbeat_task", None) is not None:
+            self._heartbeat_task.cancel()
         if getattr(self, "_app_ready_task", None) is not None:
             self._app_ready_task.cancel()
         if self.supervisor is not None:
@@ -281,15 +289,80 @@ class PodServer:
             except Exception:
                 pass
 
+    async def _heartbeat_loop(self, controller_url: str):
+        """Liveness heartbeats to the controller every ``KT_HEARTBEAT_S``
+        seconds — piggybacked on the controller WS when connected (one
+        tiny text frame), else ``POST /heartbeat``. Stops once the pod is
+        terminating: a draining pod must not look alive (the preemption
+        handler reports ``preempted`` explicitly instead)."""
+        import aiohttp as _aiohttp
+
+        from kubetorch_tpu.resilience import chaos as chaos_mod
+        from kubetorch_tpu.resilience.liveness import (
+            heartbeat_interval,
+            pod_identity,
+        )
+
+        service = self.metadata.get("service_name", "")
+        pod = pod_identity()
+        token = os.environ.get("KT_CONTROLLER_TOKEN")
+        headers = {"Authorization": f"Bearer {token}"} if token else {}
+        url = f"{controller_url.rstrip('/')}/heartbeat"
+        # ONE session for the life of the loop: a beat is a one-line POST
+        # every few seconds for the pod's whole life — per-beat session +
+        # TCP churn across a fleet is sustained load on the controller
+        session = _aiohttp.ClientSession(
+            timeout=_aiohttp.ClientTimeout(total=5.0), headers=headers)
+        try:
+            while not self.terminating:
+                await asyncio.sleep(heartbeat_interval())
+                if self.terminating:
+                    return
+                corrupt = chaos_mod.maybe(chaos_mod.CORRUPT_HEARTBEAT, pod)
+                ws = self.controller_ws
+                if (not corrupt and ws is not None
+                        and getattr(ws, "connected", False)):
+                    ws.notify_heartbeat()
+                    continue
+                # a corrupted beat (chaos) ships a payload with no
+                # identity — the controller must reject it AND count it
+                payload = ({"garbage": True} if corrupt
+                           else {"service": service, "pod": pod})
+                try:
+                    # release the response or the pooled connection never
+                    # returns to the session (per-beat TCP churn is what
+                    # the single session exists to avoid)
+                    async with session.post(url, json=payload) as resp:
+                        await resp.read()
+                except Exception:  # noqa: BLE001 — next beat retries
+                    pass
+        finally:
+            await session.close()
+
     def _mark_terminating(self):
-        """SIGTERM: flag so in-flight requests get PodTerminatedError, then
-        exit after a short drain window (K8s will SIGKILL at grace-period end
-        regardless; reference: TerminationCheckMiddleware http_server.py:1184).
-        """
+        """SIGTERM: stop admitting new calls, then run the preemption
+        sequence (drain in-flight calls → emergency checkpoint → report
+        ``preempted`` to the controller) inside the grace window. The
+        hard ``os._exit`` at grace end stays as the backstop — K8s will
+        SIGKILL then regardless (reference: TerminationCheckMiddleware
+        http_server.py:1184; sequence: resilience/preemption.py)."""
+        if self.terminating:
+            return
         self.terminating = True
         loop = asyncio.get_event_loop()
-        loop.call_later(float(os.environ.get("KT_TERM_GRACE", "2.0")),
-                        os._exit, 0)
+        from kubetorch_tpu.resilience.preemption import PreemptionHandler
+
+        handler = PreemptionHandler(self)
+
+        async def _preempt_then_exit():
+            try:
+                await handler.run()
+            except Exception:  # noqa: BLE001 — never block the exit
+                pass
+            loop.call_later(0.1, os._exit, 0)  # let the report flush
+
+        loop.create_task(_preempt_then_exit())
+        loop.call_later(handler.grace_s, os._exit, 0)
 
     async def _start_app_cmd(self):
         cmd = self.metadata.get("app_cmd")
@@ -354,6 +427,13 @@ class PodServer:
         start = time.perf_counter()
         self.metrics["http_requests_total"] += 1
         self.metrics["last_activity_timestamp"] = time.time()
+        # user-callable POSTs only (reserved routes include long-lived
+        # WS/debug connections that would pin the preemption drain open)
+        is_call = (request.method == "POST"
+                   and request.path.lstrip("/").split("/")[0]
+                   not in _RESERVED)
+        if is_call:
+            self._inflight_posts += 1
         try:
             resp = await handler(request)
             if resp.status >= 500:
@@ -363,6 +443,8 @@ class PodServer:
             self.metrics["http_request_errors_total"] += 1
             raise
         finally:
+            if is_call:
+                self._inflight_posts -= 1
             self.metrics["http_request_duration_seconds_sum"] += (
                 time.perf_counter() - start)
 
@@ -469,6 +551,12 @@ class PodServer:
         trace = tracing.trace_metrics()
         if any(trace.values()):
             self._merge_proc_snapshot("trace", "server", trace)
+        # Pod-side resilience ticks (preemption drain started, emergency
+        # checkpoints run in this process) — best-effort: a preempted pod
+        # only surfaces these to a scrape landing inside its grace window.
+        resil = prom.resilience_metrics()
+        if any(resil.values()):
+            self._merge_proc_snapshot("resilience", "server", resil)
         data = {**self.metrics, "workers_healthy": healthy}
         if prom.wants_prometheus(request):
             # Prometheus/OpenMetrics scrapers (Accept: text/plain...) get
@@ -1005,6 +1093,19 @@ class PodServer:
                 except Exception:  # noqa: BLE001
                     continue  # garbled envelope: no cid to answer to
                 if header.get("kind") != "call":
+                    continue
+                if self.terminating:
+                    # preemption: stop ADMITTING — calls already queued on
+                    # the FIFO keep executing (they are in-flight from the
+                    # client's view and the drain waits for them), but a
+                    # frame arriving after SIGTERM gets the same typed
+                    # refusal the POST path's middleware gives
+                    error = package_exception(PodTerminatedError(
+                        "pod received SIGTERM"))["error"]
+                    async with send_lock:
+                        await ws.send_bytes(frames.pack_envelope(
+                            {"kind": "error", "cid": header.get("cid")},
+                            json.dumps({"error": error}).encode()))
                     continue
                 # in-flight counts from RECEIPT, not execution start: a
                 # depth-2 pipeline with chunk N executing and N+1 queued

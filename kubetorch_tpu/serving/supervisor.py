@@ -96,6 +96,15 @@ class ExecutionSupervisor:
         return self.pool.profile(action, directory, local_rank=local_rank,
                                  timeout=timeout)
 
+    def emergency_checkpoint(self, timeout: float = 5.0) -> list:
+        """Preemption path: fan the emergency-checkpoint request to the
+        worker pool (subclasses without a local pool — ray head proxies,
+        actor hosts — inherit the no-op empty list)."""
+        pool = getattr(self, "pool", None)
+        if pool is None:
+            return []
+        return pool.emergency_checkpoint(timeout=timeout)
+
     def healthy(self) -> bool:
         return self.pool is not None and self.pool.healthy
 

@@ -1,5 +1,7 @@
 from kubetorch_tpu.training.checkpoint import (
     CheckpointManager,
+    emergency_save,
+    resume_or_init,
     save_for_resume,
 )
 from kubetorch_tpu.training.data import (
@@ -16,6 +18,8 @@ from kubetorch_tpu.training.trainer import (
 
 __all__ = [
     "CheckpointManager",
+    "emergency_save",
+    "resume_or_init",
     "save_for_resume",
     "Trainer",
     "cross_entropy_loss",

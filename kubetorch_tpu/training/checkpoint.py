@@ -10,6 +10,7 @@ round-trip through the data store as a directory tree.
 
 from __future__ import annotations
 
+import os
 import time
 from pathlib import Path
 from typing import Any, Optional
@@ -84,10 +85,25 @@ class CheckpointManager:
         self._manager.wait_until_finished()
 
     # ------------------------------------------------- store round-trip
-    def push_to_store(self, key: str, step: Optional[int] = None):
-        """Upload a checkpoint dir to the data store (delta-synced)."""
-        from kubetorch_tpu.data_store import commands as store
+    def push_to_store(self, key: str, step: Optional[int] = None,
+                      allow_local: bool = False):
+        """Upload a checkpoint dir to the data store (delta-synced).
 
+        Raises :class:`StoreUnconfigured` when no remote store is
+        configured — a silent fallback to the pod-local filesystem store
+        would "succeed" while leaving the checkpoint on the disk of the
+        very pod whose preemption the push exists to survive. Laptop
+        mode / tests opt into the local store with ``allow_local=True``.
+        """
+        from kubetorch_tpu.data_store import commands as store
+        from kubetorch_tpu.data_store.client import DataStoreClient
+        from kubetorch_tpu.exceptions import StoreUnconfigured
+
+        if not allow_local and not DataStoreClient.default().store_url:
+            raise StoreUnconfigured(
+                f"push_to_store({key!r}) needs a remote data store "
+                f"(KT_STORE_URL / config.store_url); pass "
+                f"allow_local=True to use the pod-local store")
         self.wait()
         step = step if step is not None else self.latest_step()
         if step is None:
@@ -106,6 +122,67 @@ class CheckpointManager:
         return cls(directory)
 
 
+def emergency_save(manager: "CheckpointManager", state: Any, step: int,
+                   store_key: Optional[str] = None,
+                   delta: bool = True,
+                   allow_local: Optional[bool] = None) -> dict:
+    """Preemption-path checkpoint: ``save(wait=True)`` (the blocking save
+    MUST finish inside the grace window — an async save races the
+    SIGKILL) plus an optional delta ``put_arrays`` push of the live state
+    to the data store under ``<store_key>/emergency``.
+
+    The push is the cheap half: the publish path keeps per-leaf digest
+    manifests, so between two emergency saves (or an emergency save after
+    a routine publish) only changed leaves ship. Returns
+    ``{"step", "wall_s", "save_s", "push_s", "pushed"}``; push failures
+    are reported in ``"push_error"`` rather than raised — the local save
+    already landed, and the grace window is still ticking.
+
+    Same store discipline as :meth:`CheckpointManager.push_to_store`:
+    with no remote store configured, the "push" would land on the dying
+    pod's local filesystem and be lost with it — inside a pod
+    (``KT_POD_NAME`` set) that is recorded as a ``push_error`` instead of
+    fake success. Outside a pod (laptop mode, tests — where the local
+    store outlives the process) the local store is allowed; override
+    either way with ``allow_local``.
+    """
+    t0 = time.perf_counter()
+    manager.save(step, state, wait=True)
+    save_s = time.perf_counter() - t0
+    pushed, push_error = "", None
+    t1 = time.perf_counter()
+    if store_key:
+        try:
+            from kubetorch_tpu.data_store.client import DataStoreClient
+            from kubetorch_tpu.data_store.device_transfer import put_arrays
+            from kubetorch_tpu.exceptions import StoreUnconfigured
+
+            import numpy as np
+
+            if allow_local is None:
+                allow_local = not os.environ.get("KT_POD_NAME")
+            if not allow_local and not DataStoreClient.default().store_url:
+                raise StoreUnconfigured(
+                    f"emergency push of {store_key!r} needs a remote data "
+                    f"store (KT_STORE_URL / config.store_url): the "
+                    f"pod-local store dies with this pod")
+            pushed = put_arrays(
+                f"{store_key}/emergency",
+                {"step": np.asarray(step), "state": state}, delta=delta)
+        except Exception as exc:  # noqa: BLE001 — save landed; report
+            push_error = f"{type(exc).__name__}: {exc}"
+    out = {
+        "step": step,
+        "save_s": round(save_s, 4),
+        "push_s": round(time.perf_counter() - t1, 4),
+        "wall_s": round(time.perf_counter() - t0, 4),
+        "pushed": pushed,
+    }
+    if push_error:
+        out["push_error"] = push_error
+    return out
+
+
 def save_for_resume(directory: str, state: Any, step: int):
     """One-shot save (preemption-recovery pattern,
     reference: examples/tutorials/fault_tolerance/preemption_recovery.py)."""
@@ -116,10 +193,20 @@ def save_for_resume(directory: str, state: Any, step: int):
 
 def resume_or_init(directory: str, init_fn, *init_args) -> tuple:
     """Return (state, step): restore the newest checkpoint if one exists,
-    else initialize fresh."""
+    else initialize fresh. The restore leg records a ``restart.restore``
+    span — in a gang restart it is the last edge of the recovery trace
+    tree (preempt.drain → preempt.checkpoint → restart.provision →
+    restart.restore)."""
+    from kubetorch_tpu.observability import tracing
+
     manager = CheckpointManager(directory)
     latest = manager.latest_step()
     state = init_fn(*init_args)
     if latest is None:
         return state, 0
-    return manager.restore(state), latest
+    t0, wall0 = time.perf_counter(), time.time()
+    restored = manager.restore(state)
+    tracing.record_span(
+        "restart.restore", time.perf_counter() - t0, start=wall0,
+        attrs={"step": int(latest), "directory": str(directory)})
+    return restored, latest

@@ -219,6 +219,12 @@ class Trainer:
         self.rules = rules or ShardingRules.default()
         self.optimizer = optimizer or optax.adamw(
             3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+        # resilience hooks (enable_checkpointing): periodic saves + the
+        # preemption-path emergency save
+        self.checkpoint = None
+        self._store_key: Optional[str] = None
+        self._ckpt_every = 0
+        self._step_count = 0
         with use_mesh(self.mesh):
             self.state = init_train_state(
                 jax.random.key(seed), cfg, mesh, self.optimizer, self.rules,
@@ -268,9 +274,120 @@ class Trainer:
             loss_fn=loss, accum_steps=accum_steps,
             init_fn=lambda key: lora_mod.init(key, base_params, lora_cfg))
 
+    # ------------------------------------------------------ resilience
+    def enable_checkpointing(self, directory, store_key: Optional[str] = None,
+                             every: int = 0) -> "Trainer":
+        """Arm this trainer for preemption: a :class:`CheckpointManager`
+        under ``directory``, optional periodic saves every ``every``
+        steps (async — Orbax writes in the background), and an
+        *emergency checkpoint* registered with the preemption handler:
+        on SIGTERM the state saves with ``wait=True`` and (when
+        ``store_key`` is set) delta-pushes to the data store, so the
+        restarted gang resumes at the step the preemption interrupted.
+        Returns self (chainable)."""
+        from kubetorch_tpu.resilience.preemption import (
+            register_emergency_checkpoint,
+        )
+        from kubetorch_tpu.training.checkpoint import CheckpointManager
+
+        self.checkpoint = CheckpointManager(directory)
+        self._store_key = store_key
+        self._ckpt_every = int(every)
+        register_emergency_checkpoint(self.emergency_checkpoint,
+                                      name="trainer")
+        return self
+
+    def resume(self) -> int:
+        """Restore the newest checkpoint (if any) onto the current mesh
+        and return the resumed step (0 = fresh). Prefers the local
+        checkpoint directory; when it is empty — a replacement pod on a
+        fresh node, the directory died with the preempted pod — and a
+        ``store_key`` is armed, restores the store's emergency copy that
+        the preempted generation pushed. The restore leg records the
+        ``restart.restore`` recovery span."""
+        if self.checkpoint is None:
+            raise RuntimeError("call enable_checkpointing() first")
+        from kubetorch_tpu.observability import tracing
+
+        latest = self.checkpoint.latest_step()
+        t0, wall0 = time.perf_counter(), time.time()
+        if latest is not None:
+            # the local dir survived: the emergency path writes the
+            # blocking local save at the same step it pushes, so a
+            # surviving dir is never behind the store copy
+            with use_mesh(self.mesh):
+                self.state = self.checkpoint.restore(self.state)
+            step, source = int(latest), "local"
+        else:
+            store_step = self._restore_from_store()
+            if store_step is None:
+                return 0
+            step, source = store_step, "store"
+        tracing.record_span(
+            "restart.restore", time.perf_counter() - t0, start=wall0,
+            attrs={"step": step, "source": source})
+        self._step_count = step
+        return step
+
+    def _restore_from_store(self) -> Optional[int]:
+        """Fetch ``<store_key>/emergency`` (the preempted generation's
+        delta push) and place it onto this trainer's mesh. Returns the
+        resumed step, or None when no store copy is reachable."""
+        if not self._store_key:
+            return None
+        import numpy as np
+
+        from kubetorch_tpu.data_store.device_transfer import get_arrays
+
+        try:
+            fetched = get_arrays(
+                f"{self._store_key}/emergency",
+                template={"step": np.asarray(0), "state": self.state})
+        except Exception:  # noqa: BLE001 — no copy / store down: fresh
+            return None
+
+        def _placement(cur):
+            sharding = cur.sharding
+            if isinstance(sharding, NamedSharding):
+                return sharding
+            # uncommitted init leftovers (optax step counts): replicate
+            # on the mesh — committing them to their incidental single
+            # device would conflict with the mesh-sharded params in the
+            # next jitted step
+            return NamedSharding(self.mesh, PartitionSpec())
+
+        with use_mesh(self.mesh):
+            self.state = jax.tree.map(
+                lambda cur, new: jax.device_put(new, _placement(cur)),
+                self.state, fetched["state"])
+        return int(np.asarray(fetched["step"]))
+
+    def save_checkpoint(self, wait: bool = False) -> int:
+        if self.checkpoint is None:
+            raise RuntimeError("call enable_checkpointing() first")
+        self.checkpoint.save(self._step_count, self.state, wait=wait)
+        return self._step_count
+
+    def emergency_checkpoint(self) -> dict:
+        """The preemption-path save: blocking (must land inside the
+        SIGTERM grace window) + delta store push. Registered by
+        :meth:`enable_checkpointing`; callable directly in tests."""
+        if self.checkpoint is None:
+            raise RuntimeError("call enable_checkpointing() first")
+        from kubetorch_tpu.training.checkpoint import emergency_save
+
+        return emergency_save(self.checkpoint, self.state,
+                              self._step_count, store_key=self._store_key)
+
     def step(self, batch: Dict[str, jax.Array]):
         with use_mesh(self.mesh):
             self.state, metrics = self._step(self.state, batch)
+        self._step_count += 1
+        if (self.checkpoint is not None and self._ckpt_every
+                and self._step_count % self._ckpt_every == 0):
+            # async save: Orbax writes in the background; the emergency
+            # path and explicit save_checkpoint(wait=True) block instead
+            self.checkpoint.save(self._step_count, self.state)
         return metrics
 
     def benchmark(self, batch: Dict[str, jax.Array], n_steps: int = 10,

@@ -1,0 +1,29 @@
+"""Test asset: a slow stateful service that registers an emergency
+checkpoint — the worker-process half of the preemption drain test."""
+
+import json
+import os
+import time
+
+
+class SlowSvc:
+    def __init__(self):
+        self.calls = 0
+        from kubetorch_tpu.resilience.preemption import (
+            register_emergency_checkpoint,
+        )
+
+        register_emergency_checkpoint(self._emergency, name="slowsvc")
+
+    def _emergency(self):
+        path = os.environ.get("KT_EMERGENCY_PATH", "")
+        if path:
+            with open(path, "w") as f:
+                json.dump({"calls": self.calls, "pid": os.getpid()}, f)
+        return {"calls": self.calls}
+
+    def step(self, delay: float = 0.0):
+        if delay:
+            time.sleep(delay)
+        self.calls += 1
+        return self.calls

@@ -56,6 +56,12 @@ class FakeK8s:
         self._admission_deny: Dict[str, str] = {}  # name -> message
         self._watch_log: List[dict] = []  # {rv, plural, type, object}
         self._watch_expired_once = False
+        # Fault injection (resilience/chaos.py): a seeded ChaosPolicy
+        # assigned here fails Running pods it selects — deterministic
+        # spot preemption without a cluster. Killed pod names accumulate
+        # in chaos_killed for assertions.
+        self.chaos = None
+        self.chaos_killed: List[str] = []
 
         fake = self
 
@@ -207,6 +213,31 @@ class FakeK8s:
                 "_created": time.time(),
             }
 
+    def _respawn_pod(self, ns: str, old_pod: dict):
+        """Replace one deleted pod of a still-live workload (the fake's
+        Deployment-controller reconcile)."""
+        owner = old_pod.get("_owner")
+        if not any(k[1] in WORKLOAD_PLURALS and k[2] == owner
+                   for k in self.objects):
+            return
+        index = len([1 for k, v in self.objects.items()
+                     if k[1] == "pods" and v.get("_owner") == owner])
+        pod_name = f"{owner}-{uuid.uuid4().hex[:5]}-r{index}"
+        self.objects[(ns, "pods", pod_name)] = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": pod_name, "namespace": ns,
+                         "labels": dict(old_pod["metadata"].get(
+                             "labels", {})),
+                         "creationTimestamp": time.time()},
+            "spec": {"nodeName": old_pod.get("spec", {}).get(
+                "nodeName", "node-r")},
+            "status": {"phase": "Pending",
+                       "podIP": old_pod.get("status", {}).get(
+                           "podIP", "10.0.0.99")},
+            "_owner": owner,
+            "_created": time.time(),
+        }
+
     def _tick(self):
         """Advance simulated pod + knative-service statuses."""
         for key, obj in self.objects.items():
@@ -246,6 +277,35 @@ class FakeK8s:
                 pod["status"]["phase"] = "Running"
                 pod["status"]["conditions"] = [
                     {"type": "Ready", "status": "True"}]
+        if self.chaos is not None:
+            self._tick_chaos()
+
+    def _tick_chaos(self):
+        """Seeded preemption: the policy's deterministic victim (``pick``
+        over the live pod set) fails when its kill draw fires — phase
+        Failed, Ready gone, like a real kubelet reporting a reclaimed
+        node's pods. Which pod dies is a pure function of the seed and
+        the pod-name set, never of dict iteration order."""
+        candidates = {
+            pod["metadata"]["name"]: pod
+            for key, pod in self.objects.items()
+            if (key[1] == "pods" and not pod.get("_static")
+                and not pod.get("_chaos_killed")
+                and pod["status"].get("phase") == "Running")}
+        victim = self.chaos.pick("kill-worker", list(candidates))
+        if victim is None or not self.chaos.decide("kill-worker", victim):
+            return
+        pod = candidates[victim]
+        pod["status"]["phase"] = "Failed"
+        pod["status"]["conditions"] = [
+            {"type": "Ready", "status": "False"}]
+        pod["status"]["containerStatuses"] = [{
+            "state": {"terminated": {
+                "reason": "Preempted",
+                "message": "node was reclaimed (chaos)",
+            }}}]
+        pod["_chaos_killed"] = True
+        self.chaos_killed.append(victim)
 
     # ------------------------------------------------------------ routing
     def handle(self, verb: str, path: str, body):
@@ -352,6 +412,12 @@ class FakeK8s:
                 for key in [k for k, v in self.objects.items()
                             if k[1] == "pods" and v.get("_owner") == name]:
                     del self.objects[key]
+            elif plural == "pods" and obj.get("_owner"):
+                # workload-controller semantics: deleting a pod whose
+                # owner still exists gets a fresh replacement (what a
+                # real Deployment/JobSet does — and what gang restart
+                # leans on: delete the pods, the set comes back)
+                self._respawn_pod(ns, obj)
             return 200, {"status": "Success"}
 
         return 405, {"message": f"unhandled {verb} {path}"}

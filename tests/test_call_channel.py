@@ -184,6 +184,37 @@ def test_channel_reconnects_after_drop(engine):
 
 
 @pytest.mark.level("minimal")
+def test_channel_interrupted_carries_call_ids(engine):
+    """Satellite (ISSUE 5): calls submitted-but-unacknowledged when the
+    socket drops must fail fast with the typed ChannelInterrupted whose
+    ``call_ids`` name exactly the in-doubt submissions — so a caller
+    replaying idempotent work knows what to re-issue."""
+    import asyncio
+
+    from kubetorch_tpu.serving.channel import ChannelInterrupted
+
+    with engine.channel(depth=3) as chan:
+        assert chan.call(6101, method="step")["i"] == 6101
+        # two calls in flight when the socket dies
+        c1 = chan.submit(6102, method="step", kwargs={"delay": 3.0})
+        c2 = chan.submit(6103, method="step")
+        time.sleep(0.2)
+        asyncio.run_coroutine_threadsafe(
+            chan._ws.close(), chan._loop).result(5.0)
+        errors = []
+        for call in (c1, c2):
+            with pytest.raises(ChannelInterrupted) as err:
+                call.result(timeout=30)
+            errors.append(err.value)
+        # both handles got the SAME interruption, naming BOTH cids
+        assert set(errors[0].call_ids) == {c1.cid, c2.cid}
+        assert errors[0].call_ids == errors[1].call_ids
+        assert str(c1.cid) in str(errors[0])
+        # the channel still works after the interruption
+        assert chan.call(6104, method="step")["i"] == 6104
+
+
+@pytest.mark.level("minimal")
 def test_channel_metrics_surface_on_pod(engine):
     """Satellite: channel lifecycle counters + in-flight gauge + worker
     call counters (summed across worker processes like the restore

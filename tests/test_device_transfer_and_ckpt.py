@@ -90,6 +90,59 @@ def test_checkpoint_save_restore_sharded(tmp_path):
     assert bool(jnp.isfinite(metrics["loss"]))
 
 
+@pytest.mark.level("unit")
+def test_save_wait_true_is_durable_on_return(tmp_path):
+    """Satellite (ISSUE 5): ``save(wait=True)`` must leave the step
+    finalized and restorable the moment it returns — the preemption
+    grace window depends on it (an async save races the SIGKILL). A
+    FRESH manager (a restarted pod) must see and restore it with no
+    ``wait_until_finished`` help from the saving process."""
+    from kubetorch_tpu.training.checkpoint import CheckpointManager
+
+    state = {"w": jnp.arange(8, dtype=jnp.float32),
+             "step": jnp.asarray(5, jnp.int32)}
+    manager = CheckpointManager(tmp_path / "ck")
+    manager.save(5, state, wait=True)
+    assert manager.latest_step() == 5  # visible immediately
+
+    fresh = CheckpointManager(tmp_path / "ck")
+    assert fresh.latest_step() == 5
+    out = fresh.restore({"w": jnp.zeros(8, jnp.float32),
+                         "step": jnp.asarray(0, jnp.int32)})
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  np.arange(8, dtype=np.float32))
+    assert int(out["step"]) == 5
+
+
+@pytest.mark.level("unit")
+def test_push_to_store_unconfigured_raises(tmp_path, monkeypatch):
+    """Satellite (ISSUE 5): with no remote store configured,
+    ``push_to_store`` used to silently land the checkpoint on the
+    pod-local filesystem — lost with the very pod whose preemption the
+    push exists to survive. Now it raises the typed StoreUnconfigured;
+    laptop mode / tests opt back in with ``allow_local=True``."""
+    from kubetorch_tpu.exceptions import StoreUnconfigured
+    from kubetorch_tpu.training.checkpoint import CheckpointManager
+
+    monkeypatch.delenv("KT_STORE_URL", raising=False)
+    DataStoreClient._default = None
+    manager = CheckpointManager(tmp_path / "ck")
+    manager.save(1, {"w": jnp.ones(4, jnp.float32)}, wait=True)
+
+    with pytest.raises(StoreUnconfigured) as err:
+        manager.push_to_store("ckpts/svc")
+    assert "allow_local=True" in str(err.value)
+
+    # explicit opt-in still lands in the (isolated) local store
+    pushed = manager.push_to_store("ckpts/svc", allow_local=True)
+    assert pushed == "ckpts/svc/1"
+    from kubetorch_tpu.training.checkpoint import CheckpointManager as CM
+
+    pulled = CM.pull_from_store("ckpts/svc", tmp_path / "pulled", 1)
+    out = pulled.restore({"w": jnp.zeros(4, jnp.float32)})
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.ones(4))
+
+
 def test_resume_or_init(tmp_path):
     from kubetorch_tpu.training.checkpoint import (
         resume_or_init,

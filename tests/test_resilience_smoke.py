@@ -1,0 +1,44 @@
+"""Tier-1-safe resilience smoke: ``bench_resilience.run(dryrun=True)``
+exercises the whole recovery pipeline (liveness detect → emergency
+checkpoint + store push → resume) at toy sizes on CPU, and this test
+fails if any recovery metric KEY disappears — a silently-dropped
+measurement is how a recovery regression hides (same pattern as
+tests/test_dataplane_smoke.py / test_serving_smoke.py)."""
+
+import pytest
+
+# The bench's stable contract (charted by BENCH_r* rounds). Values are
+# environment-dependent; keys are not.
+EXPECTED_KEYS = {
+    "recovery_detect_s",
+    "recovery_checkpoint_s",
+    "recovery_restore_s",
+    "recovery_total_s",
+    "recovery_heartbeat_s",
+    "recovery_dead_after_misses",
+    "recovery_chaos_seed",
+}
+
+
+@pytest.mark.level("minimal")
+def test_resilience_dryrun_metric_keys():
+    from kubetorch_tpu import bench_resilience
+
+    out = bench_resilience.run(dryrun=True)
+    missing = EXPECTED_KEYS - set(out)
+    assert not missing, (
+        f"resilience bench dropped metric keys: {sorted(missing)} — a "
+        f"recovery measurement went silent; restore it (or update "
+        f"EXPECTED_KEYS if the rename is deliberate)")
+    # every leg carries a real measurement
+    assert out["recovery_detect_s"] > 0
+    assert out["recovery_checkpoint_s"] > 0
+    assert out["recovery_restore_s"] > 0
+    assert out["recovery_total_s"] >= (
+        out["recovery_detect_s"] + out["recovery_checkpoint_s"])
+    # the acceptance bound the e2e test also asserts: detection within
+    # ~2 heartbeat intervals (absolute slack absorbs CI scheduler jitter
+    # at the smoke's tiny 20 ms interval)
+    hb = out["recovery_heartbeat_s"]
+    assert out["recovery_detect_s"] <= (
+        out["recovery_dead_after_misses"] * hb + max(2 * hb, 0.25)), out

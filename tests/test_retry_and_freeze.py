@@ -55,6 +55,73 @@ def test_with_retries_does_not_retry_app_errors():
     assert calls["n"] == 1  # non-transport errors surface immediately
 
 
+@pytest.mark.level("unit")
+def test_retry_after_honored_and_capped(monkeypatch):
+    """Satellite (ISSUE 5): a 503's ``Retry-After`` wins over the
+    exponential guess — taken verbatim (jittering it would land before
+    the server's stated recovery) but capped at the policy's max backoff
+    so a server cannot pin a client arbitrarily long."""
+    import kubetorch_tpu.retry as retry_mod
+    from kubetorch_tpu.retry import (
+        backoff_sleep_s,
+        parse_retry_after,
+        raise_if_retryable,
+    )
+
+    # header parsing: delta-seconds, HTTP-date, absent, garbage
+    assert parse_retry_after("2.5") == 2.5
+    assert parse_retry_after(None) is None
+    assert parse_retry_after("soon") is None
+    from email.utils import formatdate
+
+    parsed = parse_retry_after(formatdate(time.time() + 5, usegmt=True))
+    assert parsed is not None and 3.0 <= parsed <= 6.0
+    # a date in the past clamps to 0 (retry immediately), not negative
+    past = parse_retry_after(formatdate(time.time() - 30, usegmt=True))
+    assert past == 0.0
+
+    # raise_if_retryable carries the parsed header on the marker
+    resp = httpx.Response(503, headers={"Retry-After": "1.5"},
+                          content=b"overloaded")
+    with pytest.raises(RetryableStatus) as err:
+        raise_if_retryable(resp)
+    assert err.value.retry_after == 1.5
+
+    # the sleep rule: server-stated beats exponential, capped at max
+    assert backoff_sleep_s(
+        RetryableStatus(503, "", retry_after=2.0), 0.25, 4.0) == 2.0
+    assert backoff_sleep_s(
+        RetryableStatus(503, "", retry_after=600.0), 0.25, 4.0) == 4.0
+
+    # end to end: with_retries sleeps exactly what the server asked
+    sleeps = []
+    monkeypatch.setattr(retry_mod.time, "sleep", sleeps.append)
+
+    def always():
+        raise RetryableStatus(503, "busy", retry_after=1.25)
+
+    with pytest.raises(RetryableStatus):
+        with_retries(always, max_attempts=3, base_delay=0.25,
+                     max_delay=4.0)
+    assert sleeps == [1.25, 1.25]
+
+
+@pytest.mark.level("unit")
+def test_backoff_uses_full_jitter():
+    """Satellite (ISSUE 5): without a ``Retry-After``, the sleep is full
+    jitter over the exponential window — uniform(0, delay), not the old
+    equal-phase 0.7·d..1.3·d band that re-collides a thundering herd."""
+    from kubetorch_tpu.retry import backoff_sleep_s
+
+    exc = RetryableStatus(503, "no header")
+    draws = [backoff_sleep_s(exc, 1.0, 4.0) for _ in range(200)]
+    assert all(0.0 <= d <= 1.0 for d in draws)
+    # spread across the WHOLE window: the old band never went below
+    # 0.7·delay; full jitter must (P[miss] = .7^200 ≈ 0)
+    assert min(draws) < 0.3
+    assert max(draws) - min(draws) > 0.5
+
+
 @pytest.mark.level("minimal")
 def test_store_transfer_survives_one_transient_failure(tmp_path):
     """A store that 503s exactly once mid-deploy must not fail the
