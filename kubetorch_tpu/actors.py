@@ -28,7 +28,6 @@ exception rehydration — the same wire as ordinary ``kt.fn`` calls.
 
 from __future__ import annotations
 
-import os
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
@@ -165,7 +164,9 @@ class ActorMesh:
                  spawn_timeout: float = 300.0,
                  call_timeout: Optional[float] = None):
         if hosts is None:
-            raw = os.environ.get("KT_ACTOR_HOSTS", "")
+            from kubetorch_tpu.config import env_str
+
+            raw = env_str("KT_ACTOR_HOSTS")
             hosts = [h for h in raw.split(",") if h]
         if not hosts:
             raise StartupError(

@@ -1,0 +1,28 @@
+"""ktlint — project-invariant static analysis for kubetorch_tpu.
+
+An AST-based lint engine (stdlib only) enforcing conventions the type
+system cannot see, distilled from this repo's actual bug history:
+
+- **KT001** blocking calls inside ``async def`` bodies on the event loop
+- **KT002** thread spawns / executor submits that drop contextvars
+  (the PR-4 placement-thread trace-loss bug class)
+- **KT003** ad-hoc ``os.environ`` reads of ``KT_*`` outside the typed
+  registry in :mod:`kubetorch_tpu.config`
+- **KT004** silently swallowed exceptions on control-plane paths
+- **KT005** writes to lock-guarded attributes outside ``with self._lock``
+- **KT006** JAX tracer hazards inside jitted functions
+
+Run it via ``ktpu lint`` or the tier-1 test ``tests/test_lint.py``.
+Suppress a finding inline with ``# ktlint: disable=KT00x -- reason`` or
+grandfather it in the checked-in baseline (``.ktlint-baseline.json``).
+Configuration lives in ``[tool.ktlint]`` in ``pyproject.toml``.
+"""
+
+from kubetorch_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    LintConfig,
+    LintResult,
+    load_lint_config,
+    run_lint,
+)
+from kubetorch_tpu.analysis.rules import ALL_RULES, RULE_DOCS  # noqa: F401

@@ -1,0 +1,65 @@
+"""Baseline file: grandfathered findings the gate tolerates.
+
+Keys are ``(rule, path, whitespace-normalized source line)`` with a count,
+NOT line numbers — edits elsewhere in a file must not invalidate the
+baseline, and deleting an offending line must surface any remaining twin.
+Regenerate with ``ktpu lint --baseline`` after deliberate changes.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+from kubetorch_tpu.analysis.engine import Finding
+
+Key = Tuple[str, str, str]
+
+
+def normalize(snippet: str) -> str:
+    return " ".join(snippet.split())
+
+
+def finding_key(f: Finding) -> Key:
+    return (f.rule, f.path, normalize(f.snippet))
+
+
+def load(path: Path) -> Dict[Key, int]:
+    if not Path(path).exists():
+        return {}
+    data = json.loads(Path(path).read_text())
+    out: Dict[Key, int] = {}
+    for row in data.get("findings", []):
+        key = (row["rule"], row["path"], normalize(row["snippet"]))
+        out[key] = out.get(key, 0) + int(row.get("count", 1))
+    return out
+
+
+def split(findings: List[Finding],
+          baseline: Dict[Key, int]) -> Tuple[List[Finding], List[Finding]]:
+    """Partition findings into (new, baselined), consuming baseline counts
+    so N grandfathered copies of a line admit exactly N findings."""
+    remaining = dict(baseline)
+    new: List[Finding] = []
+    matched: List[Finding] = []
+    for f in findings:
+        key = finding_key(f)
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+            matched.append(f)
+        else:
+            new.append(f)
+    return new, matched
+
+
+def dump(findings: List[Finding], path: Path) -> None:
+    counts: Dict[Key, int] = {}
+    for f in findings:
+        key = finding_key(f)
+        counts[key] = counts.get(key, 0) + 1
+    rows = [{"rule": rule, "path": rel, "snippet": snippet, "count": n}
+            for (rule, rel, snippet), n in sorted(counts.items())]
+    payload = {"version": 1, "findings": rows}
+    Path(path).write_text(json.dumps(payload, indent=2, sort_keys=True)
+                          + "\n")

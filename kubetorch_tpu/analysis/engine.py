@@ -1,0 +1,361 @@
+"""ktlint engine: file walking, suppression parsing, config, rule driving.
+
+Deliberately stdlib-only (``ast`` + ``re`` + ``json``): the linter gates
+tier-1 and must run anywhere the package imports, including images without
+dev extras. Python 3.10 has no ``tomllib``, so ``[tool.ktlint]`` is read
+with a minimal TOML-subset parser (strings, ints, floats, booleans, and
+string arrays — exactly what the config needs).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+# --------------------------------------------------------------------------
+# findings
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Finding:
+    rule: str          # "KT001".."KT006"
+    path: str          # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    snippet: str       # stripped source line — baseline key, survives shifts
+
+    def sort_key(self) -> Tuple[str, int, int, str]:
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet}
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+# --------------------------------------------------------------------------
+# suppression comments
+# --------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*ktlint:\s*disable=([A-Z0-9*,\s]+?)(?:\s*--.*)?$")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*ktlint:\s*disable-file=([A-Z0-9*,\s]+?)(?:\s*--.*)?$")
+
+
+def _parse_codes(raw: str) -> Set[str]:
+    return {c.strip() for c in raw.split(",") if c.strip()}
+
+
+def parse_suppressions(lines: Sequence[str]) -> Tuple[Dict[int, Set[str]],
+                                                      Set[str]]:
+    """Return (per-line suppressions, whole-file suppressions).
+
+    ``# ktlint: disable=KT001[,KT002][ -- reason]`` suppresses matching
+    findings on its own line and, when the comment stands alone, on the
+    next line. ``# ktlint: disable-file=KT003`` suppresses for the whole
+    file. ``*`` matches every rule.
+    """
+    per_line: Dict[int, Set[str]] = {}
+    whole_file: Set[str] = set()
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_FILE_RE.search(text)
+        if m:
+            whole_file |= _parse_codes(m.group(1))
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        codes = _parse_codes(m.group(1))
+        per_line.setdefault(i, set()).update(codes)
+        if text.lstrip().startswith("#"):  # standalone comment → next line
+            per_line.setdefault(i + 1, set()).update(codes)
+    return per_line, whole_file
+
+
+# --------------------------------------------------------------------------
+# per-file context handed to rules
+# --------------------------------------------------------------------------
+
+
+class FileContext:
+    def __init__(self, path: Path, relpath: str, source: str,
+                 config: "LintConfig"):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.config = config
+        self.tree = ast.parse(source, filename=str(path))
+        self.suppressions, self.file_suppressions = parse_suppressions(
+            self.lines)
+        # module-level `NAME = "literal"` constants, so rules can resolve
+        # idioms like `HEARTBEAT_ENV = "KT_HEARTBEAT_S"` used indirectly
+        self.module_consts: Dict[str, str] = {}
+        for node in self.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.module_consts[tgt.id] = node.value.value
+        # shared across rules: one ast.walk / import-map per file, not
+        # one per rule (the 10 s tier-1 budget is measured on a loaded
+        # 1-CPU box)
+        self._nodes: Optional[list] = None
+        self._imports: Optional[Dict[str, str]] = None
+
+    def walk(self) -> list:
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
+
+    def import_map(self) -> Dict[str, str]:
+        if self._imports is None:
+            from kubetorch_tpu.analysis.rules import build_import_map
+
+            self._imports = build_import_map(self.tree)
+        return self._imports
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        if rule in self.file_suppressions or "*" in self.file_suppressions:
+            return True
+        codes = self.suppressions.get(lineno, ())
+        return rule in codes or "*" in codes
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        lineno = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule, path=self.relpath, line=lineno, col=col,
+                       message=message, snippet=self.line_text(lineno))
+
+
+class Rule:
+    """Base class: subclasses set ``code``/``name``/``doc`` and yield
+    findings from :meth:`check`. Suppression filtering happens in the
+    engine, not in rules."""
+
+    code = "KT000"
+    name = "base"
+    doc = ""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------------
+# configuration ([tool.ktlint] in pyproject.toml)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class LintConfig:
+    root: Path = field(default_factory=Path.cwd)
+    paths: List[str] = field(default_factory=lambda: ["kubetorch_tpu"])
+    exclude: List[str] = field(default_factory=list)
+    enable: List[str] = field(default_factory=list)    # empty → all rules
+    disable: List[str] = field(default_factory=list)
+    baseline: str = ".ktlint-baseline.json"
+    # KT003: files allowed to read KT_* env vars directly
+    kt003_exempt: List[str] = field(
+        default_factory=lambda: ["kubetorch_tpu/config.py"])
+    # KT004 applies only under these path prefixes (control plane)
+    kt004_paths: List[str] = field(default_factory=lambda: [
+        "kubetorch_tpu/serving", "kubetorch_tpu/controller",
+        "kubetorch_tpu/observability", "kubetorch_tpu/resilience",
+        "kubetorch_tpu/data_store", "kubetorch_tpu/provisioning"])
+
+    def baseline_path(self) -> Path:
+        p = Path(self.baseline)
+        return p if p.is_absolute() else self.root / p
+
+    def rule_enabled(self, code: str) -> bool:
+        if code in self.disable:
+            return False
+        return not self.enable or code in self.enable
+
+
+def _strip_toml_comment(line: str) -> str:
+    out, in_str, quote = [], False, ""
+    for ch in line:
+        if in_str:
+            out.append(ch)
+            if ch == quote:
+                in_str = False
+        elif ch in ("\"", "'"):
+            in_str, quote = True, ch
+            out.append(ch)
+        elif ch == "#":
+            break
+        else:
+            out.append(ch)
+    return "".join(out).strip()
+
+
+def _parse_toml_value(raw: str):
+    raw = raw.strip()
+    if raw.startswith("[") and raw.endswith("]"):
+        inner = raw[1:-1].strip()
+        if not inner:
+            return []
+        return [_parse_toml_value(item)
+                for item in re.findall(r"\"[^\"]*\"|'[^']*'|[^,\s]+", inner)]
+    if (raw.startswith("\"") and raw.endswith("\"")) or (
+            raw.startswith("'") and raw.endswith("'")):
+        return raw[1:-1]
+    if raw in ("true", "false"):
+        return raw == "true"
+    try:
+        return int(raw)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        pass
+    return raw
+
+
+def parse_toml_section(text: str, section: str) -> Dict[str, object]:
+    """Extract one ``[section]`` table from TOML text (subset parser:
+    scalar values and single-level arrays, arrays may span lines)."""
+    values: Dict[str, object] = {}
+    current = None
+    pending_key, pending_buf = None, ""
+    for raw_line in text.splitlines():
+        line = _strip_toml_comment(raw_line)
+        if not line:
+            continue
+        if pending_key is not None:
+            pending_buf += " " + line
+            if pending_buf.count("[") == pending_buf.count("]"):
+                values[pending_key] = _parse_toml_value(pending_buf)
+                pending_key, pending_buf = None, ""
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            current = line[1:-1].strip()
+            continue
+        if current != section or "=" not in line:
+            continue
+        key, _, val = line.partition("=")
+        key, val = key.strip(), val.strip()
+        if val.startswith("[") and val.count("[") != val.count("]"):
+            pending_key, pending_buf = key, val
+            continue
+        values[key] = _parse_toml_value(val)
+    return values
+
+
+def load_lint_config(root: Optional[Path] = None) -> LintConfig:
+    """Build a :class:`LintConfig` from ``<root>/pyproject.toml``'s
+    ``[tool.ktlint]`` table (absent file/table → defaults)."""
+    root = Path(root) if root else _find_root()
+    cfg = LintConfig(root=root)
+    pyproject = root / "pyproject.toml"
+    if not pyproject.exists():
+        return cfg
+    table = parse_toml_section(pyproject.read_text(), "tool.ktlint")
+    for key in ("paths", "exclude", "enable", "disable",
+                "kt003_exempt", "kt004_paths"):
+        if key in table and isinstance(table[key], list):
+            setattr(cfg, key, [str(v) for v in table[key]])
+    if "baseline" in table:
+        cfg.baseline = str(table["baseline"])
+    return cfg
+
+
+def _find_root(start: Optional[Path] = None) -> Path:
+    """Walk up from the package to the directory holding pyproject.toml."""
+    here = start or Path(__file__).resolve().parent
+    for cand in (here, *here.parents):
+        if (cand / "pyproject.toml").exists():
+            return cand
+    return Path.cwd()
+
+
+# --------------------------------------------------------------------------
+# runner
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]            # non-baselined (these fail the gate)
+    baselined: List[Finding]
+    errors: List[str]                  # unparseable files etc.
+
+    @property
+    def all_findings(self) -> List[Finding]:
+        return sorted(self.findings + self.baselined,
+                      key=Finding.sort_key)
+
+
+def iter_py_files(config: LintConfig,
+                  paths: Optional[Sequence[str]] = None) -> Iterator[Path]:
+    seen = set()
+    for entry in (paths or config.paths):
+        p = Path(entry)
+        if not p.is_absolute():
+            p = config.root / p
+        candidates = [p] if p.is_file() else sorted(p.rglob("*.py"))
+        for f in candidates:
+            rel = _relpath(f, config.root)
+            if any(part == "__pycache__" for part in f.parts):
+                continue
+            if any(rel.startswith(ex) or ex in rel for ex in config.exclude):
+                continue
+            if rel not in seen:
+                seen.add(rel)
+                yield f
+
+
+def _relpath(path: Path, root: Path) -> str:
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def run_lint(config: Optional[LintConfig] = None,
+             paths: Optional[Sequence[str]] = None,
+             apply_baseline: bool = True) -> LintResult:
+    from kubetorch_tpu.analysis import baseline as baseline_mod
+    from kubetorch_tpu.analysis.rules import ALL_RULES
+
+    config = config or load_lint_config()
+    rules = [cls() for cls in ALL_RULES if config.rule_enabled(cls.code)]
+    findings: List[Finding] = []
+    errors: List[str] = []
+    for path in iter_py_files(config, paths):
+        rel = _relpath(path, config.root)
+        try:
+            source = path.read_text(encoding="utf-8")
+            ctx = FileContext(path, rel, source, config)
+        except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+            errors.append(f"{rel}: {type(exc).__name__}: {exc}")
+            continue
+        for rule in rules:
+            for finding in rule.check(ctx):
+                if not ctx.suppressed(finding.rule, finding.line):
+                    findings.append(finding)
+    findings.sort(key=Finding.sort_key)
+    if apply_baseline:
+        base = baseline_mod.load(config.baseline_path())
+        new, matched = baseline_mod.split(findings, base)
+    else:
+        new, matched = findings, []
+    return LintResult(findings=new, baselined=matched, errors=errors)

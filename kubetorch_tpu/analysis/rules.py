@@ -1,0 +1,703 @@
+"""The six project-invariant rules, each distilled from a real bug class.
+
+All rules are heuristic AST matchers: they prefer false negatives over
+noise, and every escape hatch (``# ktlint: disable=…`` with a reason, or
+the checked-in baseline) is visible in review. See each rule's ``doc``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from kubetorch_tpu.analysis.engine import FileContext, Finding, Rule
+
+# --------------------------------------------------------------------------
+# shared helpers
+# --------------------------------------------------------------------------
+
+
+def build_import_map(tree: ast.AST) -> Dict[str, str]:
+    """Map local names to canonical dotted prefixes:
+    ``import threading as t`` → {"t": "threading"},
+    ``from time import sleep`` → {"sleep": "time.sleep"}."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                out[alias.asname or alias.name] = (
+                    f"{node.module}.{alias.name}")
+    return out
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_qualname(node: ast.AST, imports: Dict[str, str]) -> Optional[str]:
+    """Resolve a call target through the import map: with
+    ``from time import sleep``, a ``sleep(...)`` call resolves to
+    ``time.sleep``."""
+    name = dotted_name(node)
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    base = imports.get(head, head)
+    return f"{base}.{rest}" if rest else base
+
+
+def walk_skipping_functions(body: List[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function/lambda
+    bodies (they may legitimately run elsewhere, e.g. in an executor)."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+# --------------------------------------------------------------------------
+# KT001 — blocking calls inside async def
+# --------------------------------------------------------------------------
+
+_KT001_BLOCKING = {
+    "time.sleep",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output",
+    "httpx.get", "httpx.post", "httpx.put", "httpx.patch", "httpx.delete",
+    "httpx.head", "httpx.options", "httpx.request", "httpx.stream",
+    "requests.get", "requests.post", "requests.put", "requests.patch",
+    "requests.delete", "requests.head", "requests.request",
+    "urllib.request.urlopen",
+    "socket.create_connection", "socket.getaddrinfo",
+}
+
+_KT001_SUGGEST = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "open": "read the file in `loop.run_in_executor(...)`",
+}
+
+
+class KT001BlockingInAsync(Rule):
+    code = "KT001"
+    name = "blocking-call-in-async"
+    doc = ("Blocking call (`time.sleep`, sync httpx/requests, "
+           "`subprocess.run`, `open`) inside an `async def` body stalls "
+           "the aiohttp event loop for every other request on the pod. "
+           "Use the async equivalent or `loop.run_in_executor`.")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        imports = ctx.import_map()
+        for fn in ctx.walk():
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in walk_skipping_functions(fn.body):
+                if not isinstance(node, ast.Call):
+                    continue
+                qual = resolve_qualname(node.func, imports)
+                if qual == "open" or (
+                        isinstance(node.func, ast.Name)
+                        and node.func.id == "open"):
+                    yield ctx.finding(
+                        self.code, node,
+                        f"blocking `open(...)` on the event loop in "
+                        f"`async def {fn.name}` — "
+                        f"{_KT001_SUGGEST['open']}")
+                elif qual in _KT001_BLOCKING:
+                    hint = _KT001_SUGGEST.get(
+                        qual, "run it in `loop.run_in_executor(...)` or "
+                              "use the async client")
+                    yield ctx.finding(
+                        self.code, node,
+                        f"blocking `{qual}(...)` on the event loop in "
+                        f"`async def {fn.name}` — {hint}")
+
+
+# --------------------------------------------------------------------------
+# KT002 — thread spawn / executor submit dropping contextvars
+# --------------------------------------------------------------------------
+
+_THREAD_QUALNAMES = {"threading.Thread", "_threading.Thread"}
+_EXECUTOR_FACTORIES = ("ThreadPoolExecutor", "ProcessPoolExecutor")
+_PARTIAL_QUALNAMES = {"functools.partial", "partial"}
+
+
+def _is_ctx_run(node: Optional[ast.AST]) -> bool:
+    """True for targets that carry context: ``ctx.run``,
+    ``contextvars.copy_context().run``, ``partial(ctx.run, fn)``, or a
+    ``lambda: ctx.run(fn)`` wrapper."""
+    if node is None:
+        return False
+    if isinstance(node, ast.Attribute) and node.attr == "run":
+        return True
+    if isinstance(node, ast.Lambda):
+        body = node.body
+        return (isinstance(body, ast.Call)
+                and isinstance(body.func, ast.Attribute)
+                and body.func.attr == "run")
+    if isinstance(node, ast.Call):
+        qual = dotted_name(node.func)
+        if qual and qual.split(".")[-1] == "partial" and node.args:
+            return _is_ctx_run(node.args[0])
+    return False
+
+
+class KT002ThreadContext(Rule):
+    code = "KT002"
+    name = "thread-drops-contextvars"
+    doc = ("`threading.Thread(target=fn)` / `executor.submit(fn)` starts "
+           "from an EMPTY contextvars context: the trace span and "
+           "request-id vanish from every log line and span the thread "
+           "emits (the PR-4 placement-thread bug). Wrap the target: "
+           "`ctx = contextvars.copy_context(); "
+           "Thread(target=ctx.run, args=(fn, ...))`.")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        imports = ctx.import_map()
+        executor_names = self._executor_receivers(ctx.walk(), imports)
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            qual = resolve_qualname(node.func, imports)
+            if qual in _THREAD_QUALNAMES:
+                target = None
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+                if target is None and len(node.args) >= 2:
+                    target = node.args[1]  # Thread(group, target, ...)
+                if target is not None and not _is_ctx_run(target):
+                    yield ctx.finding(
+                        self.code, node,
+                        "bare `Thread(target=...)` starts from an empty "
+                        "contextvars context (trace/request-id loss) — "
+                        "pass `target=contextvars.copy_context().run`")
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "submit"):
+                recv = self._receiver_key(node.func.value)
+                if recv in executor_names and node.args \
+                        and not _is_ctx_run(node.args[0]):
+                    yield ctx.finding(
+                        self.code, node,
+                        f"`{recv}.submit(fn)` runs fn in a pool thread "
+                        "with an empty contextvars context — submit "
+                        "`contextvars.copy_context().run` instead")
+
+    @staticmethod
+    def _receiver_key(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                          ast.Name):
+            if node.value.id in ("self", "cls"):
+                return f"self.{node.attr}"
+            return node.attr
+        return None
+
+    def _executor_receivers(self, nodes,
+                            imports: Dict[str, str]) -> Set[str]:
+        """Names assigned from ThreadPoolExecutor()/ProcessPoolExecutor()
+        — only `.submit` on these is in scope (a `channel.submit` or
+        `engine.submit` is a different protocol entirely)."""
+        out: Set[str] = set()
+        for node in nodes:
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            qual = resolve_qualname(node.value.func, imports) or ""
+            if not qual.split(".")[-1].endswith(_EXECUTOR_FACTORIES):
+                continue
+            for tgt in node.targets:
+                key = self._receiver_key(tgt)
+                if key:
+                    out.add(key)
+                    if key.startswith("self."):
+                        out.add(key[len("self."):])
+        return out
+
+
+# --------------------------------------------------------------------------
+# KT003 — KT_* env reads outside the typed registry
+# --------------------------------------------------------------------------
+
+
+class KT003EnvOutsideRegistry(Rule):
+    code = "KT003"
+    name = "env-read-outside-registry"
+    doc = ("`os.environ`/`os.getenv` reads of `KT_*` outside "
+           "`kubetorch_tpu/config.py` bypass the typed knob registry: no "
+           "declared type, no documented default, and malformed values "
+           "explode as bare ValueErrors. Use "
+           "`config.env_str/int/float/bool/json(\"KT_X\")` and declare "
+           "the knob.")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if any(ctx.relpath == ex or ctx.relpath.endswith("/" + ex)
+               for ex in ctx.config.kt003_exempt):
+            return
+        imports = ctx.import_map()
+        for node in ctx.walk():
+            key_node = None
+            how = None
+            if isinstance(node, ast.Call):
+                qual = resolve_qualname(node.func, imports) or ""
+                if qual in ("os.getenv", "os.environ.get",
+                            "os.environ.setdefault") and node.args:
+                    key_node, how = node.args[0], qual
+            elif isinstance(node, ast.Subscript) and isinstance(
+                    node.ctx, ast.Load):
+                if (resolve_qualname(node.value, imports) == "os.environ"):
+                    key_node, how = node.slice, "os.environ[...]"
+            elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                    and isinstance(node.ops[0], (ast.In, ast.NotIn)):
+                if (resolve_qualname(node.comparators[0], imports)
+                        == "os.environ"):
+                    key_node, how = node.left, "... in os.environ"
+            if key_node is None:
+                continue
+            key = self._resolve_key(key_node, ctx)
+            if key and key.startswith("KT_"):
+                yield ctx.finding(
+                    self.code, node,
+                    f"`{how}` read of {key} outside the registry — use "
+                    f"the typed accessor "
+                    f"`config.{self._suggest(key)}(\"{key}\")`")
+
+    @staticmethod
+    def _resolve_key(node: ast.AST, ctx: FileContext) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            return ctx.module_consts.get(node.id)
+        return None
+
+    @staticmethod
+    def _suggest(key: str) -> str:
+        try:
+            from kubetorch_tpu.config import KNOBS
+            knob = KNOBS.get(key)
+            if knob is not None:
+                return f"env_{knob.type}"
+        except Exception:  # ktlint: disable=KT004 -- best-effort hint only
+            pass
+        return "env_str"
+
+
+# --------------------------------------------------------------------------
+# KT004 — silently swallowed exceptions on control-plane paths
+# --------------------------------------------------------------------------
+
+_OBS_CALL_NAMES = {
+    "print", "log", "debug", "info", "warning", "warn", "error",
+    "exception", "critical", "record", "observe", "inc", "incr",
+    "increment", "count", "labels", "emit", "push", "publish",
+    "add_event", "record_event", "counter",
+}
+_BROAD_EXC = {"Exception", "BaseException"}
+
+
+class KT004SilentExcept(Rule):
+    code = "KT004"
+    name = "silent-exception-swallow"
+    doc = ("`except Exception: pass` (or a bare `except:`) on a "
+           "control-plane path hides real failures — heartbeats stop, "
+           "restarts misfire, and nothing is logged or counted. Log at "
+           "debug with the swallowed exception or increment a metric; "
+           "genuinely-intentional swallows get "
+           "`# ktlint: disable=KT004 -- <why>`.")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        paths = ctx.config.kt004_paths
+        if paths and not any(ctx.relpath.startswith(p) for p in paths):
+            return
+        for node in ctx.walk():
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            bare = node.type is None
+            broad = self._is_broad(node.type)
+            if not bare and not broad:
+                continue
+            has_raise = any(isinstance(n, ast.Raise)
+                            for n in ast.walk(node))
+            if has_raise:
+                continue
+            if bare:
+                yield ctx.finding(
+                    self.code, node,
+                    "bare `except:` swallows even KeyboardInterrupt/"
+                    "SystemExit — catch `Exception` and log or count it")
+                continue
+            if self._has_observability(node) or not self._is_trivial(node):
+                continue
+            yield ctx.finding(
+                self.code, node,
+                "`except Exception` swallowed silently — log at debug "
+                "with the exception or increment a metric")
+
+    @staticmethod
+    def _is_broad(type_node: Optional[ast.AST]) -> bool:
+        def one(n: ast.AST) -> bool:
+            return (isinstance(n, ast.Name) and n.id in _BROAD_EXC)
+        if type_node is None:
+            return False
+        if isinstance(type_node, ast.Tuple):
+            return any(one(e) for e in type_node.elts)
+        return one(type_node)
+
+    @staticmethod
+    def _has_observability(handler: ast.ExceptHandler) -> bool:
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Call):
+                fn = n.func
+                name = (fn.attr if isinstance(fn, ast.Attribute)
+                        else fn.id if isinstance(fn, ast.Name) else "")
+                if name in _OBS_CALL_NAMES:
+                    return True
+        return False
+
+    @staticmethod
+    def _is_trivial(handler: ast.ExceptHandler) -> bool:
+        """pass / continue / break / `...` / constant return only —
+        a handler that assigns a fallback or calls anything is doing
+        real work, not swallowing."""
+        for stmt in handler.body:
+            if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+                continue
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                         ast.Constant):
+                continue
+            if isinstance(stmt, ast.Return) and (
+                    stmt.value is None
+                    or isinstance(stmt.value, ast.Constant)):
+                continue
+            return False
+        return True
+
+
+# --------------------------------------------------------------------------
+# KT005 — writes to lock-guarded attributes outside the lock
+# --------------------------------------------------------------------------
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_KT005_SKIP_METHODS = {"__init__", "__new__", "__del__", "__enter__",
+                       "__exit__", "__post_init__"}
+
+
+class KT005LockDiscipline(Rule):
+    code = "KT005"
+    name = "unlocked-shared-write"
+    doc = ("A class that guards an attribute with `with self._lock:` in "
+           "one method has declared it shared; writing the same "
+           "attribute elsewhere without the lock is a data race the "
+           "type system can't see. Take the lock, or rename the method "
+           "`*_locked` if callers already hold it.")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        imports = ctx.import_map()
+        for cls in ctx.walk():
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(ctx, cls, imports)
+
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef,
+                     imports: Dict[str, str]) -> Iterator[Finding]:
+        lock_attrs = self._lock_attrs(cls, imports)
+        if not lock_attrs:
+            return
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        guarded: Set[str] = set()
+        for m in methods:
+            if m.name in _KT005_SKIP_METHODS:
+                continue
+            for attr, _node, locked in self._attr_writes(m, lock_attrs):
+                if locked:
+                    guarded.add(attr)
+        guarded -= lock_attrs
+        if not guarded:
+            return
+        for m in methods:
+            if (m.name in _KT005_SKIP_METHODS
+                    or m.name.endswith("_locked")
+                    or m.name.endswith("_unsafe")):
+                continue
+            for attr, node, locked in self._attr_writes(m, lock_attrs):
+                if attr in guarded and not locked:
+                    yield ctx.finding(
+                        self.code, node,
+                        f"`self.{attr}` is written under `self."
+                        f"{next(iter(lock_attrs))}` elsewhere in "
+                        f"`{cls.name}` but `{m.name}` writes it without "
+                        f"the lock")
+
+    @staticmethod
+    def _lock_attrs(cls: ast.ClassDef,
+                    imports: Dict[str, str]) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            qual = resolve_qualname(node.value.func, imports) or ""
+            if qual.split(".")[-1] not in _LOCK_FACTORIES:
+                continue
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    out.add(tgt.attr)
+        return out
+
+    def _attr_writes(self, method: ast.AST, lock_attrs: Set[str]):
+        """Yield (attr_name, node, under_lock) for every `self.X = …` /
+        `self.X += …` in the method, tracking `with self.<lock>:` depth."""
+        results = []
+
+        def is_lock_item(item: ast.withitem) -> bool:
+            e = item.context_expr
+            return (isinstance(e, ast.Attribute)
+                    and isinstance(e.value, ast.Name)
+                    and e.value.id == "self" and e.attr in lock_attrs)
+
+        def visit(node: ast.AST, depth: int) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                d = depth + (1 if any(is_lock_item(i)
+                                      for i in node.items) else 0)
+                for child in node.body:
+                    visit(child, d)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not method:
+                return
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for tgt in targets:
+                for el in ast.walk(tgt):
+                    if (isinstance(el, ast.Attribute)
+                            and isinstance(el.value, ast.Name)
+                            and el.value.id == "self"):
+                        results.append((el.attr, node, depth > 0))
+            for child in ast.iter_child_nodes(node):
+                visit(child, depth)
+
+        visit(method, 0)
+        return results
+
+
+# --------------------------------------------------------------------------
+# KT006 — JAX tracer hazards inside jitted functions
+# --------------------------------------------------------------------------
+
+_JIT_QUALNAMES = {"jax.jit", "jax.pjit", "jit", "pjit",
+                  "jax.experimental.pjit.pjit"}
+_SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+_SAFE_CALLS = {"len", "isinstance", "hasattr", "getattr", "type"}
+_CONCRETIZERS = {"float", "int", "bool", "complex"}
+_NP_MATERIALIZE = {"numpy.asarray", "numpy.array", "np.asarray",
+                   "np.array"}
+
+
+class KT006TracerHazards(Rule):
+    code = "KT006"
+    name = "jax-tracer-hazard"
+    doc = ("Inside a function under `jax.jit`/`pjit`, Python `if`/`while` "
+           "on a traced value raises TracerBoolConversionError at trace "
+           "time (or silently bakes in one branch), and `.item()`/"
+           "`float()`/`np.asarray()`/`jax.device_get()` force a blocking "
+           "device sync per call. Use `jax.lax.cond/while_loop` or hoist "
+           "the concretization out of the jitted region.")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        imports = ctx.import_map()
+        jitted = self._jitted_functions(ctx.walk(), imports)
+        for fn, static in jitted:
+            params = self._params(fn)
+            traced = [p for p in params if p not in static
+                      and p not in ("self", "cls")]
+            yield from self._check_body(ctx, fn, set(traced), imports)
+
+    # -- discovery ---------------------------------------------------------
+
+    def _jitted_functions(self, nodes, imports: Dict[str, str]):
+        """(FunctionDef, static_argnames) pairs: decorated with jit, or
+        named as the first argument of a `jax.jit(...)` call in this
+        module (covers the `self._step = jax.jit(self._step_impl)`
+        idiom)."""
+        jit_called: Dict[str, Set[str]] = {}
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            qual = resolve_qualname(node.func, imports)
+            if qual not in _JIT_QUALNAMES or not node.args:
+                continue
+            name = self._callable_name(node.args[0])
+            if name:
+                static = self._static_names(node)
+                # jit(partial(fn, x=…)): partial-bound kwargs are baked
+                # into the traced callable as Python values — static
+                static |= self._partial_bound_names(node.args[0])
+                jit_called.setdefault(name, set()).update(static)
+        out = []
+        for node in nodes:
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            static: Optional[Set[str]] = None
+            for dec in node.decorator_list:
+                static = self._decorator_static(dec, imports)
+                if static is not None:
+                    break
+            if static is None and node.name in jit_called:
+                static = jit_called[node.name]
+            if static is not None:
+                out.append((node, static))
+        return out
+
+    def _decorator_static(self, dec: ast.AST,
+                          imports: Dict[str, str]) -> Optional[Set[str]]:
+        """static_argnames for a jit-ish decorator, None if not jit."""
+        if resolve_qualname(dec, imports) in _JIT_QUALNAMES:
+            return set()
+        if isinstance(dec, ast.Call):
+            qual = resolve_qualname(dec.func, imports)
+            if qual in _JIT_QUALNAMES:
+                return self._static_names(dec)
+            if qual in ("functools.partial", "partial") and dec.args \
+                    and resolve_qualname(dec.args[0],
+                                         imports) in _JIT_QUALNAMES:
+                return self._static_names(dec)
+        return None
+
+    @staticmethod
+    def _callable_name(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Call):  # jax.jit(partial(fn, ...))
+            qual = dotted_name(node.func) or ""
+            if qual.split(".")[-1] == "partial" and node.args:
+                node = node.args[0]
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        return None
+
+    @staticmethod
+    def _partial_bound_names(node: ast.AST) -> Set[str]:
+        if isinstance(node, ast.Call):
+            qual = dotted_name(node.func) or ""
+            if qual.split(".")[-1] == "partial":
+                return {kw.arg for kw in node.keywords if kw.arg}
+        return set()
+
+    @staticmethod
+    def _static_names(call: ast.Call) -> Set[str]:
+        names: Set[str] = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) and isinstance(
+                            el.value, str):
+                        names.add(el.value)
+        return names
+
+    @staticmethod
+    def _params(fn: ast.AST) -> List[str]:
+        a = fn.args
+        return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+    # -- hazard matching ---------------------------------------------------
+
+    def _check_body(self, ctx: FileContext, fn: ast.AST,
+                    traced: Set[str],
+                    imports: Dict[str, str]) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                qual = resolve_qualname(node.func, imports) or ""
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item"):
+                    yield ctx.finding(
+                        self.code, node,
+                        f"`.item()` inside jitted `{fn.name}` forces a "
+                        "host sync / concretization")
+                elif qual == "jax.device_get":
+                    yield ctx.finding(
+                        self.code, node,
+                        f"`jax.device_get` inside jitted `{fn.name}` — "
+                        "move it outside the jitted region")
+                elif (qual in _CONCRETIZERS and len(node.args) == 1
+                        and self._mentions_traced(node.args[0], traced)):
+                    yield ctx.finding(
+                        self.code, node,
+                        f"`{qual}()` on a traced value inside jitted "
+                        f"`{fn.name}` raises at trace time — use jnp ops")
+                elif (qual in _NP_MATERIALIZE and node.args
+                        and self._mentions_traced(node.args[0], traced)):
+                    yield ctx.finding(
+                        self.code, node,
+                        f"`{qual}` materializes a traced array inside "
+                        f"jitted `{fn.name}`")
+            elif isinstance(node, (ast.If, ast.While)):
+                if self._mentions_traced(node.test, traced):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield ctx.finding(
+                        self.code, node,
+                        f"Python `{kind}` on a traced value inside "
+                        f"jitted `{fn.name}` — use `jax.lax.cond` / "
+                        f"`jax.lax.while_loop` (or mark the arg static)")
+
+    def _mentions_traced(self, expr: ast.AST, traced: Set[str]) -> bool:
+        """A traced param used *as a value* — `.shape`/`.ndim`/`.dtype`,
+        `len(x)`, `isinstance(x, …)`, and `x is None` are trace-static
+        and don't count."""
+        hazardous = False
+
+        def visit(node: ast.AST) -> None:
+            nonlocal hazardous
+            if hazardous:
+                return
+            if isinstance(node, ast.Attribute) and node.attr in _SAFE_ATTRS:
+                return
+            if isinstance(node, ast.Call):
+                name = (node.func.id if isinstance(node.func, ast.Name)
+                        else None)
+                if name in _SAFE_CALLS:
+                    return
+            if isinstance(node, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in node.ops):
+                return
+            if (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in traced):
+                hazardous = True
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(expr)
+        return hazardous
+
+
+ALL_RULES = [KT001BlockingInAsync, KT002ThreadContext,
+             KT003EnvOutsideRegistry, KT004SilentExcept,
+             KT005LockDiscipline, KT006TracerHazards]
+
+RULE_DOCS = {cls.code: (cls.name, cls.doc) for cls in ALL_RULES}

@@ -188,6 +188,7 @@ def bench_broadcast(store: "_Store", world: int = 8,
             except Exception as exc:  # pragma: no cover
                 errors.append(exc)
 
+        # ktlint: disable=KT002 -- bench load generator: no ambient ctx
         threads = [threading.Thread(target=worker, args=(i,), daemon=True)
                    for i in range(world)]  # daemon: a hung fetch must not
         #                                    block interpreter shutdown
@@ -268,6 +269,7 @@ def bench_broadcast(store: "_Store", world: int = 8,
             except Exception as exc:  # pragma: no cover
                 errors.append(exc)
 
+        # ktlint: disable=KT002 -- bench load generator: no ambient ctx
         threads = [threading.Thread(target=worker, args=(i,), daemon=True)
                    for i in range(2)]
         t0 = time.perf_counter()
@@ -345,7 +347,7 @@ def bench_restore(store: "_Store", total_mb: float = 64.0,
 
     tree = _restore_tree(total_mb)
     total_bytes = sum(a.nbytes for a in jax.tree.leaves(tree))
-    prev_url, prev_default = (os.environ.get("KT_STORE_URL"),
+    prev_url, prev_default = (os.environ.get("KT_STORE_URL"),  # ktlint: disable=KT003 -- save/restore of raw env state, not a config read
                               DataStoreClient._default)
     os.environ["KT_STORE_URL"] = store.url
     DataStoreClient._default = None

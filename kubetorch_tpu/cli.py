@@ -37,7 +37,9 @@ def check():
 
         ok = K8sClient.has_credentials()
         click.echo(f"  k8s credentials: {'ok' if ok else 'MISSING'}")
-    controller_url = os.environ.get("KT_CONTROLLER_URL") or cfg.controller_url
+    from kubetorch_tpu.config import env_str
+
+    controller_url = env_str("KT_CONTROLLER_URL") or cfg.controller_url
     if controller_url:
         try:
             from kubetorch_tpu.controller.client import ControllerClient
@@ -47,7 +49,9 @@ def check():
                        f"{health['pools']} pools)")
         except Exception as exc:
             click.echo(f"  controller: ERROR {exc}")
-    store_url = os.environ.get("KT_STORE_URL") or cfg.store_url
+    from kubetorch_tpu.config import env_str
+
+    store_url = env_str("KT_STORE_URL") or cfg.store_url
     click.echo(f"  store: {store_url or 'local (~/.ktpu/store)'}")
     try:
         import jax
@@ -565,6 +569,74 @@ def actors(service, pod, stop):
                     f"pod {idx}: {a['name']}  class={a['class_name']}  "
                     f"procs={a['num_procs']}  "
                     f"{'healthy' if a.get('healthy') else 'DEAD'}")
+
+
+# ---------------------------------------------------------------- lint
+@main.command()
+@click.argument("paths", nargs=-1)
+@click.option("--json", "as_json", is_flag=True,
+              help="Machine-readable findings on stdout.")
+@click.option("--baseline", "update_baseline", is_flag=True,
+              help="Rewrite the baseline file with the current findings "
+                   "(grandfather everything currently flagged).")
+@click.option("--no-baseline", is_flag=True,
+              help="Ignore the baseline: report every finding.")
+@click.option("--gen-config-docs", is_flag=True,
+              help="Regenerate docs/configuration.md from the KT_* knob "
+                   "registry and exit.")
+@click.option("--list-rules", is_flag=True,
+              help="Describe the rules and exit.")
+def lint(paths, as_json, update_baseline, no_baseline, gen_config_docs,
+         list_rules):
+    """Project-invariant static analysis (rules KT001-KT006).
+
+    Scans kubetorch_tpu/ (or PATHS) for concurrency, config, trace-context,
+    exception-swallowing, lock-discipline, and JAX-tracer violations.
+    Configure via [tool.ktlint] in pyproject.toml; suppress inline with
+    `# ktlint: disable=KT00x -- reason`. Exit 1 on non-baselined findings.
+    """
+    from kubetorch_tpu.analysis import (RULE_DOCS, load_lint_config,
+                                        run_lint)
+    from kubetorch_tpu.analysis import baseline as baseline_mod
+
+    if list_rules:
+        for code, (name, doc) in sorted(RULE_DOCS.items()):
+            click.echo(f"{code} [{name}]")
+            click.echo(f"    {doc}\n")
+        return
+    if gen_config_docs:
+        from kubetorch_tpu.analysis.docgen import write_config_docs
+
+        out = write_config_docs()
+        click.echo(f"wrote {out}")
+        return
+
+    config = load_lint_config()
+    result = run_lint(config, paths=paths or None,
+                      apply_baseline=not (no_baseline or update_baseline))
+    if update_baseline:
+        baseline_mod.dump(result.findings, config.baseline_path())
+        click.echo(f"baseline: {len(result.findings)} finding(s) written "
+                   f"to {config.baseline_path()}")
+        return
+
+    if as_json:
+        click.echo(json.dumps({
+            "findings": [f.to_dict() for f in result.findings],
+            "baselined": len(result.baselined),
+            "errors": result.errors,
+        }, indent=2))
+    else:
+        for f in result.findings:
+            click.echo(str(f))
+        for err in result.errors:
+            click.echo(f"ERROR {err}", err=True)
+        click.echo(f"{len(result.findings)} finding(s), "
+                   f"{len(result.baselined)} baselined")
+    if result.errors:
+        sys.exit(2)
+    if result.findings:
+        sys.exit(1)
 
 
 # ---------------------------------------------------------------- runs

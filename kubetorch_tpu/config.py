@@ -9,11 +9,12 @@ controller (ConfigMap-backed) and merged lowest-precedence.
 from __future__ import annotations
 
 import getpass
+import json
 import os
 import threading
 from dataclasses import dataclass, field, fields
 from pathlib import Path
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Iterator, Optional
 
 import yaml
 
@@ -136,3 +137,353 @@ def configure(**updates: Any) -> KubetorchConfig:
             raise AttributeError(f"unknown config key: {key}")
         setattr(cfg, key, value)
     return cfg
+
+
+# ---------------------------------------------------------------------------
+# Typed KT_* knob registry
+#
+# Every ``KT_*`` environment variable the project reads is declared here —
+# name, type, default, and a doc string — and read through the ``env_*``
+# accessors below. This is the single source the generated
+# ``docs/configuration.md`` table and the KT003 lint rule
+# (``kubetorch_tpu/analysis``) are built from: ad-hoc ``os.environ`` reads
+# of ``KT_*`` names anywhere else in the package are a lint error.
+#
+# Semantics shared by all accessors:
+#   - an UNSET or EMPTY-STRING variable means "use the declared default"
+#     (matching the historical ``os.environ.get(k) or default`` idiom);
+#   - a set-but-malformed value raises :class:`ConfigError` naming the
+#     variable, instead of an opaque ``ValueError`` from deep inside a
+#     heartbeat loop or an import;
+#   - reading an UNDECLARED name raises :class:`ConfigError` — declare the
+#     knob first, that is the point of the registry.
+# ---------------------------------------------------------------------------
+
+
+class ConfigError(Exception):
+    """A ``KT_*`` environment variable is undeclared or holds a value that
+    cannot be parsed as its declared type."""
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    type: str          # "str" | "int" | "float" | "bool" | "json"
+    default: Any
+    doc: str
+    section: str = "general"
+
+
+KNOBS: Dict[str, Knob] = {}
+
+
+def _knob(name: str, type_: str, default: Any, doc: str,
+          section: str = "general") -> None:
+    KNOBS[name] = Knob(name=name, type=type_, default=default, doc=doc,
+                       section=section)
+
+
+# --- client -----------------------------------------------------------------
+_knob("KT_CONFIG_PATH", "str", "~/.ktpu/config",
+      "Path of the local YAML config cache layered under env vars.", "client")
+_knob("KT_USERNAME", "str", None,
+      "Username used to prefix service names (defaults to $USER).", "client")
+_knob("KT_NAMESPACE", "str", "default",
+      "Kubernetes namespace for deploys and controller queries.", "client")
+_knob("KT_INSTALL_NAMESPACE", "str", "kubetorch",
+      "Namespace the kubetorch control plane is installed in.", "client")
+_knob("KT_INSTALL_URL", "str", None,
+      "Override URL for the control-plane install manifest.", "client")
+_knob("KT_PREFIX_USERNAME", "bool", True,
+      "Prefix service names with the username (root-greet).", "client")
+_knob("KT_STREAM_LOGS", "bool", True,
+      "Stream pod logs back to the client during calls.", "client")
+_knob("KT_STREAM_METRICS", "bool", False,
+      "Stream pod metrics back to the client during calls.", "client")
+_knob("KT_BACKEND", "str", "local",
+      "Provisioning backend: 'local' (subprocess pods) or 'k8s'.", "client")
+_knob("KT_SERIALIZATION", "str", "json",
+      "Default wire format for call payloads ('json' or 'pickle').", "client")
+_knob("KT_LAUNCH_TIMEOUT", "int", 600,
+      "Seconds to wait for a deployed service to become ready.", "client")
+_knob("KT_INACTIVITY_TTL", "str", None,
+      "Idle TTL after which a service is scaled down (e.g. '2h').", "client")
+_knob("KT_LOG_LEVEL", "str", "INFO",
+      "Client-side log level.", "client")
+_knob("KT_STORE_URL", "str", None,
+      "Base URL of the data store server (weight sync, code sync).", "client")
+_knob("KT_CONTROLLER_URL", "str", None,
+      "Base URL of the controller (registry, log sink, liveness).", "client")
+_knob("KT_CONTROLLER_TOKEN", "str", None,
+      "Bearer token sent to the controller when auth is enabled.", "client")
+_knob("KT_RETRY_ATTEMPTS", "int", 3,
+      "Max attempts for retryable transport errors (retry.py).", "client")
+_knob("KT_CODE_SYNC", "str", "auto",
+      "Code-sync mode for deploys: auto, store, rsync, or off.", "client")
+_knob("KT_RUN_ID", "str", None,
+      "Ambient run id propagated to subprocess runs (runs/api.py).", "client")
+
+# --- pod identity / bootstrap (set by provisioning, read by the pod) --------
+_knob("KT_SERVICE_NAME", "str", "", "Service this pod belongs to.", "pod")
+_knob("KT_POD_NAME", "str", None,
+      "Pod name; falls back to the hostname when unset.", "pod")
+_knob("KT_POD_IP", "str", None,
+      "Pod IP used for registration and distributed rendezvous.", "pod")
+_knob("KT_REPLICA_INDEX", "int", 0, "Replica index within the gang.", "pod")
+_knob("KT_SERVER_PORT", "int", 32300, "Pod HTTP server port.", "pod")
+_knob("KT_LAUNCH_ID", "str", "",
+      "Launch generation id; stale-pod reports are fenced on it.", "pod")
+_knob("KT_CLS_OR_FN_NAME", "str", "",
+      "Name of the deployed callable (class or function).", "pod")
+_knob("KT_CALLABLE_TYPE", "str", "fn",
+      "Kind of deployed callable: fn, cls, or app.", "pod")
+_knob("KT_CALLABLE_NAME", "str", "",
+      "Instance name for the deployed callable.", "pod")
+_knob("KT_ROOT_PATH", "str", "",
+      "Client project root the synced code tree is relative to.", "pod")
+_knob("KT_IMPORT_PATH", "str", "",
+      "Module path to import the callable from.", "pod")
+_knob("KT_NUM_PROCS", "int", 1, "Worker processes per pod.", "pod")
+_knob("KT_FRAMEWORK", "str", None,
+      "Distributed framework to initialize: jax, ray, or unset.", "pod")
+_knob("KT_INIT_ARGS", "json", None,
+      "JSON [args, kwargs] used to construct a deployed class.", "pod")
+_knob("KT_DISTRIBUTED", "json", None,
+      "JSON distributed topology spec (workers, framework).", "pod")
+_knob("KT_ALLOWED_SERIALIZATION", "str", None,
+      "Comma-separated wire formats the pod accepts.", "pod")
+_knob("KT_APP_CMD", "str", None,
+      "Shell command for app pods (uvicorn, etc.).", "pod")
+_knob("KT_APP_PORT", "int", 0, "Port the app command listens on.", "pod")
+_knob("KT_APP_HEALTH_PATH", "str", "",
+      "HTTP path polled for app readiness.", "pod")
+_knob("KT_APP_HEALTH_INTERVAL", "float", 0.5,
+      "Seconds between app readiness polls.", "pod")
+_knob("KT_CODE_KEY", "str", None,
+      "Store key of the synced code tarball.", "pod")
+_knob("KT_CODE_DEST", "str", "~/.ktpu/code",
+      "Directory synced code trees are unpacked into.", "pod")
+
+# --- serving ----------------------------------------------------------------
+_knob("KT_CHANNEL_DEPTH", "int", 2,
+      "Default pipeline depth (calls in flight) per CallChannel.", "serving")
+_knob("KT_WORKER_THREADS", "int", 8,
+      "Threads per worker process for concurrent calls.", "serving")
+_knob("KT_PROXY_TIMEOUT", "float", 600.0,
+      "Client HTTP timeout for proxied calls (seconds).", "serving")
+_knob("KT_METRICS_INTERVAL", "float", 15.0,
+      "Seconds between pod metrics pushes to the controller.", "serving")
+_knob("KT_DEBUG_PORT", "int", 5678,
+      "Base port for the remote debugger (plus LOCAL_RANK).", "serving")
+_knob("KT_JAX_COORD_PORT", "int", 8476,
+      "Port of the JAX distributed coordinator.", "serving")
+_knob("KT_JAX_CACHE_DIR", "str", "/tmp/kt-jax-cache",
+      "Persistent JAX compilation cache dir (mount a volume to "
+      "survive pod reschedules).", "serving")
+_knob("KT_TPU_HOSTNAME_PATTERN", "str", None,
+      "Format string for TPU worker hostnames ({slice}, {host}).", "serving")
+_knob("KT_TPU_HOSTS_PER_SLICE", "int", None,
+      "Hosts per TPU slice; inferred from topology when unset.", "serving")
+_knob("KT_TREE_MINIMUM", "int", 100,
+      "Gang size at which SPMD supervisor switches to tree fanout.", "serving")
+_knob("KT_FANOUT", "int", 50,
+      "Branching factor of the SPMD supervisor tree.", "serving")
+_knob("KT_ACTOR_HOSTS", "str", "",
+      "Comma-separated host list for actor meshes.", "serving")
+
+# --- distributed ------------------------------------------------------------
+_knob("KT_POD_IPS", "str", None,
+      "Comma-separated pod IPs for the gang (rendezvous).", "distributed")
+_knob("KT_POD_IPS_FILE", "str", None,
+      "File containing one pod IP per line (preferred over "
+      "KT_POD_IPS when both are set).", "distributed")
+
+# --- controller -------------------------------------------------------------
+_knob("KT_CONTROLLER_PORT", "int", 32320,
+      "Controller listen port.", "controller")
+_knob("KT_CONTROLLER_DB", "str", "~/.ktpu/controller.db",
+      "SQLite path backing the controller registry.", "controller")
+_knob("KT_REAPER_INTERVAL", "float", 15.0,
+      "Seconds between controller TTL-reaper sweeps.", "controller")
+_knob("KT_AUTH_VALIDATE_URL", "str", None,
+      "External token-validation endpoint for controller auth.", "controller")
+_knob("KT_AUTH_CACHE_TTL", "float", 60.0,
+      "Seconds a validated token is cached by the controller.", "controller")
+_knob("KT_AUTO_RESTART", "bool", True,
+      "Gang-restart dead/preempted services automatically.", "controller")
+
+# --- observability ----------------------------------------------------------
+_knob("KT_OBS_DIR", "str", None,
+      "Directory for controller log/metric persistence "
+      "(defaults next to the --db path).", "observability")
+_knob("KT_LOG_RETAIN_MB", "float", 256.0,
+      "Log-sink size cap before old segments are dropped.", "observability")
+_knob("KT_LOG_RETAIN_HOURS", "float", 72.0,
+      "Log-sink age cap in hours.", "observability")
+_knob("KT_LOG_MAX_PENDING", "int", 512,
+      "Max queued log batches before the sink sheds load.", "observability")
+_knob("KT_LOG_SINK_URL", "str", None,
+      "Log-sink URL override (defaults to the controller).", "observability")
+_knob("KT_DISABLE_LOG_STREAMING", "bool", False,
+      "Disable pod->sink log streaming entirely.", "observability")
+_knob("KT_REQUEST_ID", "str", None,
+      "Ambient request id for log lines outside a call context.",
+      "observability")
+_knob("KT_TRACE_DISABLE", "bool", False,
+      "Disable span recording entirely.", "observability")
+_knob("KT_TRACE_RING", "int", 4096,
+      "Capacity of the in-process span ring buffer.", "observability")
+_knob("KT_TRACE_SLOW_MS", "float", None,
+      "Auto-push call trees slower than this to the controller.",
+      "observability")
+_knob("KT_TRACE_PROC", "str", "client",
+      "Process label stamped on spans (client/server/worker).",
+      "observability")
+
+# --- data store -------------------------------------------------------------
+_knob("KT_STORE_PORT", "int", 32310,
+      "Store server listen port.", "data-store")
+_knob("KT_STORE_ROOT", "str", "~/.ktpu/store_server",
+      "Filesystem root of the store server.", "data-store")
+_knob("KT_LOCAL_STORE", "str", "~/.ktpu/store",
+      "Root of the local (no-server) store backend.", "data-store")
+_knob("KT_STREAM_CHUNK_BYTES", "int", 4 << 20,
+      "Chunk size for streaming puts/gets (min 64 KiB).", "data-store")
+_knob("KT_WIRE_CODEC", "str", "raw",
+      "Default wire codec for put_arrays: raw, zlib, zstd, or int8.",
+      "data-store")
+_knob("KT_WIRE_DELTA", "bool", False,
+      "Publish byte-level delta patches when a base exists.", "data-store")
+_knob("KT_RESTORE_CACHE", "str", "~/.ktpu/restore_cache",
+      "Directory full fetches are teed into as delta bases.", "data-store")
+_knob("KT_PEER_CACHE", "str", "~/.ktpu/peer_cache",
+      "Directory of the broadcast peer cache.", "data-store")
+
+# --- resilience -------------------------------------------------------------
+_knob("KT_HEARTBEAT_S", "float", 5.0,
+      "Pod liveness heartbeat interval (min 0.01).", "resilience")
+_knob("KT_DEAD_AFTER_MISSES", "int", 2,
+      "Missed beats before a suspect pod is declared dead.", "resilience")
+_knob("KT_TERM_GRACE", "float", 2.0,
+      "Total SIGTERM grace budget in seconds.", "resilience")
+_knob("KT_DRAIN_TIMEOUT", "float", None,
+      "In-flight drain budget; defaults to 40% of KT_TERM_GRACE.",
+      "resilience")
+_knob("KT_MAX_RESTARTS", "int", 3,
+      "Restart budget per service before giving up.", "resilience")
+_knob("KT_RESTART_BACKOFF_S", "float", 1.0,
+      "Base of the exponential restart backoff.", "resilience")
+_knob("KT_RESTART_RESET_S", "float", 300.0,
+      "Healthy seconds after which the restart budget resets.", "resilience")
+_knob("KT_CHAOS", "str", "",
+      "Chaos-injection spec, e.g. 'seed=7,kill-worker=0.1'.", "resilience")
+
+# --- provisioning -----------------------------------------------------------
+_knob("KT_LOCAL_STATE", "str", "~/.ktpu/local",
+      "State root of the local (subprocess) backend.", "provisioning")
+_knob("KT_READY_POLL", "float", 2.0,
+      "Seconds between pod-readiness polls in the K8s backend.",
+      "provisioning")
+_knob("KT_IMAGE_REGISTRY", "str", "ghcr.io/kubetorch-tpu",
+      "Container registry for built images.", "provisioning")
+_knob("KT_IMAGE_TAG", "str", "latest",
+      "Default image tag.", "provisioning")
+
+# --- kernels ----------------------------------------------------------------
+_knob("KT_QMM_DECODE", "bool", False,
+      "Enable the fused quantized-matmul decode path.", "kernels")
+
+
+def _raw(name: str) -> Optional[str]:
+    """Registered-knob env read; unset and empty both mean 'default'."""
+    knob = KNOBS.get(name)
+    if knob is None:
+        raise ConfigError(
+            f"{name} is not a registered KT_* knob; declare it in "
+            f"kubetorch_tpu/config.py before reading it")
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return None
+    return raw
+
+
+def env_str(name: str) -> Optional[str]:
+    raw = _raw(name)
+    return KNOBS[name].default if raw is None else raw
+
+
+def env_int(name: str) -> Optional[int]:
+    raw = _raw(name)
+    if raw is None:
+        return KNOBS[name].default
+    try:
+        return int(raw.strip())
+    except ValueError:
+        raise ConfigError(
+            f"{name}={raw!r} is not a valid integer "
+            f"(default: {KNOBS[name].default!r})") from None
+
+
+def env_float(name: str) -> Optional[float]:
+    raw = _raw(name)
+    if raw is None:
+        return KNOBS[name].default
+    try:
+        return float(raw.strip())
+    except ValueError:
+        raise ConfigError(
+            f"{name}={raw!r} is not a valid number "
+            f"(default: {KNOBS[name].default!r})") from None
+
+
+_TRUTHY = ("1", "true", "yes", "on")
+_FALSY = ("0", "false", "no", "off")
+
+
+def env_bool(name: str) -> Optional[bool]:
+    raw = _raw(name)
+    if raw is None:
+        return KNOBS[name].default
+    low = raw.strip().lower()
+    if low in _TRUTHY:
+        return True
+    if low in _FALSY:
+        return False
+    raise ConfigError(
+        f"{name}={raw!r} is not a valid boolean "
+        f"(use one of {_TRUTHY + _FALSY})")
+
+
+def env_json(name: str) -> Any:
+    raw = _raw(name)
+    if raw is None:
+        return KNOBS[name].default
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError as exc:
+        raise ConfigError(f"{name} is not valid JSON: {exc}") from None
+
+
+def env_path(name: str) -> Optional[Path]:
+    """``env_str`` + ``Path(...).expanduser()`` (None stays None)."""
+    value = env_str(name)
+    return None if value is None else Path(value).expanduser()
+
+
+def env_set(name: str) -> bool:
+    """True when the (registered) variable is set to a non-empty value."""
+    return _raw(name) is not None
+
+
+_ACCESSORS = {"str": env_str, "int": env_int, "float": env_float,
+              "bool": env_bool, "json": env_json}
+
+
+def env_value(name: str) -> Any:
+    """Read a knob with the accessor matching its declared type."""
+    return _ACCESSORS[KNOBS[name].type](name)
+
+
+def iter_knobs() -> Iterator[Knob]:
+    """All declared knobs, sorted by (section, name) — docgen order."""
+    return iter(sorted(KNOBS.values(), key=lambda k: (k.section, k.name)))

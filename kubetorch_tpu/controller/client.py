@@ -6,12 +6,11 @@ typed methods for pools/runs/teardown/apply + version check).
 
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, List, Optional
 
 import httpx
 
-from kubetorch_tpu.config import get_config
+from kubetorch_tpu.config import env_str, get_config
 from kubetorch_tpu.exceptions import KubetorchError, VersionMismatchError
 from kubetorch_tpu.version import __version__
 
@@ -21,7 +20,7 @@ _TIMEOUT = httpx.Timeout(connect=10.0, read=300.0, write=60.0, pool=10.0)
 class ControllerClient:
     def __init__(self, base_url: Optional[str] = None,
                  token: Optional[str] = None):
-        self.base_url = (base_url or os.environ.get("KT_CONTROLLER_URL")
+        self.base_url = (base_url or env_str("KT_CONTROLLER_URL")
                          or get_config().controller_url)
         if not self.base_url:
             raise KubetorchError(
@@ -29,7 +28,7 @@ class ControllerClient:
                 "config.controller_url)")
         self.base_url = self.base_url.rstrip("/")
         headers = {}
-        token = token or os.environ.get("KT_CONTROLLER_TOKEN")
+        token = token or env_str("KT_CONTROLLER_TOKEN")
         if token:
             headers["Authorization"] = f"Bearer {token}"
         from kubetorch_tpu.retry import attempts

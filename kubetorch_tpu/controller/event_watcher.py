@@ -20,6 +20,7 @@ its own events.
 
 from __future__ import annotations
 
+import contextvars
 import logging
 import time
 from typing import Any, Dict, List, Optional, Set
@@ -154,8 +155,9 @@ class EventWatcher:
             self._stop_event.set()
         stop = threading.Event()
         self._stop_event = stop
+        ctx = contextvars.copy_context()
         self._thread = threading.Thread(
-            target=self._loop, args=(stop,), daemon=True,
+            target=ctx.run, args=(self._loop, stop), daemon=True,
             name="kt-event-watch")
         self._thread.start()
 

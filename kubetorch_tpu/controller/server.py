@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import os
 import re
 import time
@@ -28,8 +29,11 @@ from typing import Any, Dict, List, Optional
 import aiohttp
 from aiohttp import ClientSession, WSMsgType, web
 
+from kubetorch_tpu.config import env_bool, env_float, env_int, env_str
 from kubetorch_tpu.controller.db import Database
 from kubetorch_tpu.version import __version__, compatible
+
+logger = logging.getLogger(__name__)
 
 
 def parse_ttl(ttl: Optional[str]) -> Optional[float]:
@@ -169,8 +173,7 @@ class ControllerServer:
         self.restart_policy = RestartPolicy()
         self.restarter = GangRestarter(
             self.restart_policy, on_event=self._resilience_event)
-        self.auto_restart = os.environ.get(
-            "KT_AUTO_RESTART", "1").lower() not in ("0", "false", "no")
+        self.auto_restart = env_bool("KT_AUTO_RESTART")
         self._resilience_task: Optional[asyncio.Task] = None
         self._restarting: set = set()
         # strong refs to in-flight restart tasks: the loop only holds
@@ -182,14 +185,13 @@ class ControllerServer:
         # (which forgets the per-pod liveness state) so /health can
         # always answer "when did we last notice, and how fast"
         self._last_detect: Dict[str, dict] = {}
-        self.auth_token = os.environ.get("KT_CONTROLLER_TOKEN") or None
+        self.auth_token = env_str("KT_CONTROLLER_TOKEN")
         # External token validation (reference: auth/middleware.py — bearer
         # validated against an endpoint, with namespace access checks).
-        self.auth_validate_url = os.environ.get("KT_AUTH_VALIDATE_URL") or None
+        self.auth_validate_url = env_str("KT_AUTH_VALIDATE_URL")
         self._auth_cache: Dict[str, Any] = {}   # token -> (exp_ts, info|None)
         self._auth_session = None
-        self.auth_cache_ttl = float(
-            os.environ.get("KT_AUTH_CACHE_TTL", "60"))
+        self.auth_cache_ttl = env_float("KT_AUTH_CACHE_TTL")
         self.cluster_config: Dict[str, Any] = {}
         # Controller-hosted observability sinks (SURVEY.md §5.5; reference
         # deploys Loki + Prometheus as separate components, both durable —
@@ -199,7 +201,7 @@ class ControllerServer:
         # sinks, e.g. tests).
         from kubetorch_tpu.observability.log_sink import LogSink, MetricsStore
 
-        obs_dir = os.environ.get("KT_OBS_DIR") or (
+        obs_dir = env_str("KT_OBS_DIR") or (
             f"{db_path}.obs" if db_path != ":memory:" else None)
         persist = snapshot = None
         if obs_dir:
@@ -210,14 +212,13 @@ class ControllerServer:
                 MetricsSnapshot,
             )
 
-            retain_mb = float(os.environ.get("KT_LOG_RETAIN_MB", "256"))
-            retain_h = float(os.environ.get("KT_LOG_RETAIN_HOURS", "72"))
+            retain_mb = env_float("KT_LOG_RETAIN_MB")
+            retain_h = env_float("KT_LOG_RETAIN_HOURS")
             persist = LogPersistence(
                 Path(obs_dir) / "logs",
                 retain_bytes=int(retain_mb * 1024 * 1024),
                 retain_secs=retain_h * 3600.0,
-                max_pending_batches=int(
-                    os.environ.get("KT_LOG_MAX_PENDING", "512")))
+                max_pending_batches=env_int("KT_LOG_MAX_PENDING"))
             snapshot = MetricsSnapshot(Path(obs_dir) / "metrics.json")
         self.log_sink = LogSink(persist=persist)
         self.metrics_store = MetricsStore(snapshot=snapshot)
@@ -486,8 +487,9 @@ class ControllerServer:
             from kubetorch_tpu.provisioning.backend import get_backend
 
             get_backend().teardown(service, quiet=True)
-        except Exception:
-            pass
+        except Exception as exc:
+            logger.debug("backend teardown during delete of %s failed: %r",
+                         service, exc)
         for conn in self.hub.pods_of(service):
             try:
                 await conn.ws.send_json({"type": "teardown"})
@@ -580,8 +582,9 @@ class ControllerServer:
         try:
             self.log_sink.push([resilience_event(service, reason, message,
                                                  pod=pod)])
-        except Exception:  # noqa: BLE001 — events never block recovery
-            pass
+        except Exception as exc:  # noqa: BLE001 — events never block recovery
+            logger.debug("resilience event push for %s failed: %r",
+                         service, exc)
 
     async def _resilience_loop(self):
         """Age liveness states and auto-restart dead gangs (gang-atomic:
@@ -809,7 +812,7 @@ class ControllerServer:
             denied = self._ns_denied(
                 request,
                 (manifest.get("metadata") or {}).get("namespace")
-                or os.environ.get("KT_NAMESPACE", "default"))
+                or env_str("KT_NAMESPACE"))
             if denied is not None:
                 return denied
             if body.get("patch") == "merge":
@@ -859,8 +862,7 @@ class ControllerServer:
     def _k8s_ns(self, request):
         """Effective namespace for proxy ops (query param or the
         controller's default), for both the op and the scope check."""
-        return request.query.get("namespace") or os.environ.get(
-            "KT_NAMESPACE", "default")
+        return request.query.get("namespace") or env_str("KT_NAMESPACE")
 
     async def h_k8s_list(self, request):
         kind = self._k8s_kind(request)
@@ -921,11 +923,14 @@ class ControllerServer:
                             )
 
                             get_backend().teardown(service, quiet=True)
-                        except Exception:
-                            pass
+                        except Exception as exc:
+                            logger.debug(
+                                "reaper teardown of %s failed: %r",
+                                service, exc)
             except asyncio.CancelledError:
                 raise
-            except Exception:
+            except Exception as exc:
+                logger.debug("reaper sweep error: %r", exc)
                 continue
 
 
@@ -934,12 +939,12 @@ def main():
 
     parser = argparse.ArgumentParser(description="kubetorch_tpu controller")
     parser.add_argument("--host", default="0.0.0.0")
-    parser.add_argument("--port", type=int, default=int(
-        os.environ.get("KT_CONTROLLER_PORT", "32320")))
-    parser.add_argument("--db", default=os.environ.get(
-        "KT_CONTROLLER_DB", str(os.path.expanduser("~/.ktpu/controller.db"))))
-    parser.add_argument("--reaper-interval", type=float, default=float(
-        os.environ.get("KT_REAPER_INTERVAL", "15")))
+    parser.add_argument("--port", type=int,
+                        default=env_int("KT_CONTROLLER_PORT"))
+    parser.add_argument("--db", default=str(
+        os.path.expanduser(env_str("KT_CONTROLLER_DB"))))
+    parser.add_argument("--reaper-interval", type=float,
+                        default=env_float("KT_REAPER_INTERVAL"))
     args = parser.parse_args()
     server = ControllerServer(args.db, reaper_interval=args.reaper_interval)
     web.run_app(server.build_app(), host=args.host, port=args.port,

@@ -24,13 +24,14 @@ from typing import Optional, Tuple
 from kubetorch_tpu.exceptions import DataStoreError
 from kubetorch_tpu.data_store.types import BroadcastWindow
 
-_CACHE_ROOT = Path(os.environ.get(
-    "KT_PEER_CACHE", "~/.ktpu/peer_cache")).expanduser()
+from kubetorch_tpu.config import env_path, env_str
+
+_CACHE_ROOT = env_path("KT_PEER_CACHE")
 
 
 def _advertise_ip() -> str:
     """IP peers can reach us on: pod IP in-cluster, else a local route."""
-    ip = os.environ.get("KT_POD_IP")
+    ip = env_str("KT_POD_IP")
     if ip:
         return ip
     try:
@@ -64,8 +65,11 @@ class PeerServer:
         self.port = None
         self._web = web
         self._started = threading.Event()
+        import contextvars
+
         self._thread = threading.Thread(
-            target=self._run, name="kt-peer-server", daemon=True)
+            target=contextvars.copy_context().run, args=(self._run,),
+            name="kt-peer-server", daemon=True)
 
     def _run(self):
         import asyncio

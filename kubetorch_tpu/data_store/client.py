@@ -19,7 +19,6 @@ replacement; this environment has no rsync binary).
 from __future__ import annotations
 
 import json
-import os
 import shutil
 import time
 from pathlib import Path
@@ -30,8 +29,9 @@ import cloudpickle
 from kubetorch_tpu.config import get_config
 from kubetorch_tpu.exceptions import DataStoreError
 
-_LOCAL_STORE = Path(os.environ.get("KT_LOCAL_STORE",
-                                   "~/.ktpu/store")).expanduser()
+from kubetorch_tpu.config import env_path, env_str
+
+_LOCAL_STORE = env_path("KT_LOCAL_STORE")
 
 
 def _safe_key(key: str) -> str:
@@ -51,7 +51,7 @@ class DataStoreClient:
 
     @classmethod
     def default(cls) -> "DataStoreClient":
-        url = os.environ.get("KT_STORE_URL") or get_config().store_url
+        url = env_str("KT_STORE_URL") or get_config().store_url
         if cls._default is None or cls._default.store_url != url:
             cls._default = cls(store_url=url)
         return cls._default

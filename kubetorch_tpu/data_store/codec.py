@@ -35,7 +35,6 @@ the store server (which only needs :func:`splice_delta`) stays light.
 from __future__ import annotations
 
 import hashlib
-import os
 import time
 import zlib
 from pathlib import Path
@@ -67,16 +66,19 @@ def default_chunk_bytes(fallback: int = 4 << 20) -> int:
     """The one stream-granularity knob (``KT_STREAM_CHUNK_BYTES``) shared
     by the HTTP blob chunkers, file streamers, and the pipelined restore's
     ``chunk_bytes`` default — previously three hard-coded ``4 << 20``."""
-    try:
-        return max(1 << 16, int(os.environ["KT_STREAM_CHUNK_BYTES"]))
-    except (KeyError, ValueError):
-        return fallback
+    from kubetorch_tpu.config import env_int, env_set
+
+    if env_set("KT_STREAM_CHUNK_BYTES"):
+        return max(1 << 16, env_int("KT_STREAM_CHUNK_BYTES"))
+    return fallback
 
 
 def default_codec() -> str:
     """Wire codec when the caller doesn't pick one (``KT_WIRE_CODEC``).
     ``raw`` keeps publishes byte-identical to the V1 format."""
-    return os.environ.get("KT_WIRE_CODEC", "raw").strip().lower() or "raw"
+    from kubetorch_tpu.config import env_str
+
+    return (env_str("KT_WIRE_CODEC") or "raw").strip().lower() or "raw"
 
 
 def delta_enabled(explicit: Optional[bool] = None) -> bool:
@@ -84,15 +86,17 @@ def delta_enabled(explicit: Optional[bool] = None) -> bool:
     delta tracking hashes every leaf, which full-raw publishes skip."""
     if explicit is not None:
         return explicit
-    return os.environ.get("KT_WIRE_DELTA", "").strip().lower() in (
-        "1", "true", "yes", "on")
+    from kubetorch_tpu.config import env_bool
+
+    return bool(env_bool("KT_WIRE_DELTA"))
 
 
 def restore_cache_root() -> Path:
     """Where fetchers keep the last restored blob per key — the local
     splice base for delta fetches (``KT_RESTORE_CACHE``)."""
-    return Path(os.environ.get(
-        "KT_RESTORE_CACHE", "~/.ktpu/restore_cache")).expanduser()
+    from kubetorch_tpu.config import env_path
+
+    return env_path("KT_RESTORE_CACHE")
 
 
 def have_zstd() -> bool:

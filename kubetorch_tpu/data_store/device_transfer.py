@@ -546,8 +546,9 @@ def _record_publish(stats: Dict[str, float]) -> None:
             "delta_leaves_skipped": stats.get("leaves_skipped", 0),
             "delta_fallback": stats.get("delta_fallback", 0.0),
         })
+    # ktlint: disable=KT004 -- metrics must never fail a publish
     except Exception:
-        pass  # metrics must never fail a publish
+        pass
 
 
 def put_arrays(key: str, tree: Any, codec: Optional[str] = None,
@@ -865,6 +866,7 @@ def _scale_sharding(sharding):
         if isinstance(sharding, jax.sharding.NamedSharding):
             return jax.sharding.NamedSharding(
                 sharding.mesh, jax.sharding.PartitionSpec())
+    # ktlint: disable=KT004 -- probe: caller handles the None fallback
     except Exception:
         pass
     return None
@@ -959,8 +961,9 @@ def _streamed_restore(chunks: Iterable, template: Optional[Any],
         if pipeline is not None:
             try:
                 pipeline.close()
+            # ktlint: disable=KT004 -- the original error is the one to surface
             except BaseException:
-                pass  # the original error is the one to surface
+                pass
         raise
     place_s = 0.0
     dequant_s = 0.0
@@ -1016,8 +1019,9 @@ def _streamed_restore(chunks: Iterable, template: Optional[Any],
             "delta_fetch_hit": 1.0 if delta_hit else 0.0,
             "delta_fetch_miss": 1.0 if delta_hit is False else 0.0,
         })
+    # ktlint: disable=KT004 -- metrics must never fail a restore
     except Exception:
-        pass  # metrics must never fail a restore
+        pass
     if template is not None:
         return jax.tree.unflatten(jax.tree.structure(template), out)
     return out
@@ -1035,6 +1039,7 @@ def _splice_base_candidates(key: str) -> List[Path]:
         from kubetorch_tpu.data_store.broadcast import peer_cache_candidates
 
         out.extend(peer_cache_candidates(key))
+    # ktlint: disable=KT004 -- optional peer cache: base list may be empty
     except Exception:
         pass
     return out
@@ -1288,6 +1293,7 @@ def _get_arrays(key, template, shardings, broadcast, *, streaming,
             "delta_fetch_hit": 1.0 if delta_hit else 0.0,
             "delta_fetch_miss": 1.0 if delta_hit is False else 0.0,
         })
+    # ktlint: disable=KT004 -- metrics must never fail a restore
     except Exception:
         pass
     return tree

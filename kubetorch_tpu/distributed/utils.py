@@ -20,6 +20,7 @@ import socket
 import time
 from typing import List, Optional
 
+from kubetorch_tpu.config import env_int, env_set, env_str
 from kubetorch_tpu.exceptions import QuorumTimeoutError
 
 
@@ -66,13 +67,13 @@ def self_entry(members: List[str]) -> tuple:
     ``TPU_WORKER_HOSTNAMES`` path). Falls back to index 0 (a pod not in the
     list, e.g. an Endpoint-routed coordinator, acts as rank 0).
     """
-    my_port = os.environ.get("KT_SERVER_PORT")
+    my_port = env_set("KT_SERVER_PORT") and str(env_int("KT_SERVER_PORT"))
     if my_port:
         for i, entry in enumerate(members):
             if entry.endswith(f":{my_port}"):
                 return i, entry
     hostname = socket.gethostname()
-    my_ip = os.environ.get("KT_POD_IP")
+    my_ip = env_str("KT_POD_IP")
     if not my_ip:
         try:
             my_ip = socket.gethostbyname(hostname)
@@ -101,7 +102,7 @@ def pod_ips(
     3. ``TPU_WORKER_HOSTNAMES`` (slice gang membership, already complete),
     4. DNS A records of ``<service_name>-headless``.
     """
-    ips_file = os.environ.get("KT_POD_IPS_FILE")
+    ips_file = env_str("KT_POD_IPS_FILE")
     if ips_file:
         def read_file() -> List[str]:
             try:
@@ -128,7 +129,7 @@ def pod_ips(
                     f"quorum={quorum_workers} (after {quorum_timeout}s)")
         if ips:
             return ips
-    local = os.environ.get("LOCAL_IPS") or os.environ.get("KT_POD_IPS")
+    local = os.environ.get("LOCAL_IPS") or env_str("KT_POD_IPS")
     if local:
         ips = [x.strip() for x in local.split(",") if x.strip()]
         if quorum_workers and len(ips) < quorum_workers:
@@ -140,7 +141,7 @@ def pod_ips(
     if info is not None:
         return list(info.hostnames)
 
-    service_name = service_name or os.environ.get("KT_SERVICE_NAME")
+    service_name = service_name or env_str("KT_SERVICE_NAME")
     if not service_name:
         raise ValueError("service_name required outside local/TPU-slice mode")
     headless = (service_name if service_name.endswith("-headless")

@@ -1053,8 +1053,12 @@ class RollingService:
         self._results: Dict[int, List[int]] = {}
         self._done: Dict[int, bool] = {}
         self._live: Dict[int, Any] = {}  # rid -> token queue (generate_iter)
+        import contextvars
+
+        # copy_context: driver-thread log lines keep the submitter's ids
         self._driver = threading.Thread(
-            target=self._drive, name="kt-rolling-driver", daemon=True)
+            target=contextvars.copy_context().run, args=(self._drive,),
+            name="kt-rolling-driver", daemon=True)
         self._driver.start()
 
     def generate(self, prompt, max_new_tokens: int = 128,

@@ -34,6 +34,8 @@ import time
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional
 
+from kubetorch_tpu.config import env_bool, env_str
+
 # Per-call request id inside worker processes. A contextvar (not env): env is
 # process-global, so concurrent calls in one worker would cross-contaminate
 # each other's labels. process_worker sets it around each call and propagates
@@ -86,6 +88,7 @@ class _TeeStream:
     def flush(self):
         try:
             self.original.flush()
+        # ktlint: disable=KT004 -- log pipeline itself: logging here recurses
         except Exception:
             pass
 
@@ -110,6 +113,7 @@ class _CaptureHandler(logging.Handler):
             self.capture.emit(
                 self.format(record), source="logging",
                 level=record.levelname.lower())
+        # ktlint: disable=KT004 -- log pipeline itself: logging here recurses
         except Exception:
             pass
 
@@ -147,6 +151,7 @@ class LogCapture:
             dynamic = self.labels_fn()
             if dynamic:
                 labels.update({k: v for k, v in dynamic.items() if v})
+        # ktlint: disable=KT004 -- per-line label hook; the line still ships
         except Exception:
             pass
         entry = {"ts": time.time(), "line": line[:16384], "labels": labels}
@@ -169,8 +174,11 @@ class LogCapture:
         self._handler = _CaptureHandler(self)
         self._handler.setFormatter(logging.Formatter("%(name)s: %(message)s"))
         logging.getLogger().addHandler(self._handler)
+        # copy_context: keep the installer's ambient request/trace ids on
+        # any line the pusher thread itself emits (KT002)
         self._thread = threading.Thread(
-            target=self._pusher, daemon=True, name="kt-log-push")
+            target=contextvars.copy_context().run, args=(self._pusher,),
+            daemon=True, name="kt-log-push")
         self._thread.start()
         atexit.register(self.flush)
         _installed = self
@@ -223,20 +231,21 @@ class LogCapture:
     def _post(self, batch: List[dict]):
         data = json.dumps({"entries": batch}).encode()
         headers = {"Content-Type": "application/json"}
-        token = os.environ.get("KT_CONTROLLER_TOKEN")
+        token = env_str("KT_CONTROLLER_TOKEN")
         if token:
             headers["Authorization"] = f"Bearer {token}"
         req = urllib.request.Request(
             f"{self.sink_url}/logs/push", data=data, headers=headers)
         try:
             urllib.request.urlopen(req, timeout=5.0).read()
+        # ktlint: disable=KT004 -- sink unreachable: lines still reached the real stream
         except Exception:
-            pass  # sink unreachable: lines still reached the real stream
+            pass
 
 
 def _default_dynamic_labels() -> Dict[str, str]:
     labels = {}
-    rid = request_id_var.get() or os.environ.get("KT_REQUEST_ID")
+    rid = request_id_var.get() or env_str("KT_REQUEST_ID")
     if rid:
         labels["request_id"] = rid
     rank = os.environ.get("RANK")
@@ -248,16 +257,15 @@ def _default_dynamic_labels() -> Dict[str, str]:
 def install_from_env(source_hint: str = "pod") -> Optional[LogCapture]:
     """Install capture if a sink is configured (both pod server and worker
     subprocesses call this; env is inherited through spawn)."""
-    if os.environ.get("KT_DISABLE_LOG_STREAMING") == "1":
+    if env_bool("KT_DISABLE_LOG_STREAMING"):
         return None
-    sink = (os.environ.get("KT_LOG_SINK_URL")
-            or os.environ.get("KT_CONTROLLER_URL"))
+    sink = env_str("KT_LOG_SINK_URL") or env_str("KT_CONTROLLER_URL")
     if not sink:
         return None
     labels = {
-        "service": os.environ.get("KT_SERVICE_NAME", "unknown"),
-        "pod": os.environ.get("KT_POD_NAME", socket.gethostname()),
-        "namespace": os.environ.get("KT_NAMESPACE", ""),
+        "service": env_str("KT_SERVICE_NAME") or "unknown",
+        "pod": env_str("KT_POD_NAME") or socket.gethostname(),
+        "namespace": env_str("KT_NAMESPACE"),
         "level": "info",
     }
     if source_hint == "worker":

@@ -20,12 +20,14 @@ so durability is this module's job:
 
 from __future__ import annotations
 
+import contextvars
 import json
 import os
 import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
+from functools import partial
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional
 
@@ -99,7 +101,7 @@ class LogPersistence:
             if self._draining:
                 return  # the live drain will pick this batch up
             self._draining = True
-        self._io.submit(self._drain)
+        self._io.submit(contextvars.copy_context().run, self._drain)
 
     def _drain(self):
         while True:
@@ -115,7 +117,8 @@ class LogPersistence:
                 # lost, but the pump must survive — a raised exception
                 # here would leave _draining wedged True and stop ALL
                 # future persistence until restart
-                self.dropped_batches += 1
+                with self._buf_lock:
+                    self.dropped_batches += 1
 
     def append_drop(self, service: str):
         self.append([{"_drop": service, "ts": time.time()}])
@@ -200,7 +203,8 @@ class MetricsSnapshot:
         if not force and now - self._last_write < self.interval:
             return
         self._last_write = now
-        self._io.submit(self._write_sync, data)
+        self._io.submit(contextvars.copy_context().run,
+                        partial(self._write_sync, data))
 
     def close(self):
         self._io.shutdown(wait=True)

@@ -10,20 +10,22 @@ while pods come up (``module.py:1028``).
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import hashlib
 import json
-import os
 import threading
 import time
 from typing import Callable, Dict, Iterator, List, Optional
 
 import httpx
 
+from kubetorch_tpu.config import env_str
+
 
 def _auth_headers() -> Dict[str, str]:
     """Bearer token for a token-guarded controller (matches
     ``ControllerClient``'s auth)."""
-    token = os.environ.get("KT_CONTROLLER_TOKEN")
+    token = env_str("KT_CONTROLLER_TOKEN")
     return {"Authorization": f"Bearer {token}"} if token else {}
 
 
@@ -121,7 +123,9 @@ def iter_logs(
         finally:
             done.set()
 
-    thread = threading.Thread(target=lambda: asyncio.run(pump()),
+    ctx = contextvars.copy_context()
+    thread = threading.Thread(target=ctx.run,
+                              args=(lambda: asyncio.run(pump()),),
                               daemon=True, name="kt-log-tail")
     thread.start()
     try:
@@ -190,16 +194,19 @@ class LogStreamer:
                     if self.dedup is None or self.dedup.admit(entry):
                         try:
                             self.printer(format_entry(entry))
+                        # ktlint: disable=KT004 -- printer is user code (broken pipe): stream must live on
                         except Exception:
                             pass
             except ConnectionError as exc:
                 try:
                     self.printer(f"[kt] log streaming unavailable: {exc}")
+                # ktlint: disable=KT004 -- the notice itself is best-effort
                 except Exception:
                     pass
 
-        self._thread = threading.Thread(target=run, daemon=True,
-                                        name="kt-log-stream")
+        self._thread = threading.Thread(
+            target=contextvars.copy_context().run, args=(run,),
+            daemon=True, name="kt-log-stream")
         self._thread.start()
         return self
 

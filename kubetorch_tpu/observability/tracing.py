@@ -44,6 +44,9 @@ import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
+from kubetorch_tpu.config import (ConfigError, env_bool, env_float, env_int,
+                                  env_str)
+
 DISABLE_ENV = "KT_TRACE_DISABLE"
 RING_ENV = "KT_TRACE_RING"
 SLOW_MS_ENV = "KT_TRACE_SLOW_MS"
@@ -54,11 +57,11 @@ HEADER = "X-KT-Trace"
 _ctx_var: contextvars.ContextVar = contextvars.ContextVar(
     "kt_trace_ctx", default=None)
 
-_proc_label: str = os.environ.get("KT_TRACE_PROC", "client")
+_proc_label: str = env_str("KT_TRACE_PROC")
 
 
 def enabled() -> bool:
-    return os.environ.get(DISABLE_ENV) != "1"
+    return not env_bool(DISABLE_ENV)
 
 
 def set_process_label(label: str) -> None:
@@ -80,8 +83,8 @@ _IDENTITY: Dict[str, str] = {}
 
 def _refresh_identity() -> Dict[str, str]:
     _IDENTITY.clear()
-    _IDENTITY["service"] = os.environ.get("KT_SERVICE_NAME", "")
-    _IDENTITY["pod"] = os.environ.get("KT_POD_NAME", "")
+    _IDENTITY["service"] = env_str("KT_SERVICE_NAME")
+    _IDENTITY["pod"] = env_str("KT_POD_NAME") or ""
     return _IDENTITY
 
 
@@ -128,6 +131,7 @@ def _request_id() -> str:
         rid = request_id_var.get()
         if rid:
             return rid
+    # ktlint: disable=KT004 -- span labeling is best-effort by contract
     except Exception:  # noqa: BLE001
         pass
     srv = sys.modules.get("kubetorch_tpu.serving.server")
@@ -136,6 +140,7 @@ def _request_id() -> str:
             rid = srv.request_id_var.get()
             if rid and rid != "-":
                 return rid
+        # ktlint: disable=KT004 -- span labeling is best-effort by contract
         except Exception:  # noqa: BLE001
             pass
     return ""
@@ -153,10 +158,7 @@ class SpanRecorder:
 
     def __init__(self, capacity: Optional[int] = None):
         if capacity is None:
-            try:
-                capacity = int(os.environ.get(RING_ENV, "4096"))
-            except ValueError:
-                capacity = 4096
+            capacity = env_int(RING_ENV)
         self.capacity = max(16, capacity)
         self._lock = threading.Lock()
         self._ring: "collections.deque" = collections.deque()
@@ -592,13 +594,20 @@ def summarize(spans: Iterable[dict]) -> List[dict]:
 
 
 # ---------------------------------------------------- slow-call capture
+_warned_bad_slow = False
+
+
 def slow_threshold_ms() -> Optional[float]:
-    raw = os.environ.get(SLOW_MS_ENV)
-    if not raw:
-        return None
+    global _warned_bad_slow
     try:
-        return float(raw)
-    except ValueError:
+        return env_float(SLOW_MS_ENV)
+    except ConfigError as exc:
+        # called from `finally` on the serving path — a malformed knob
+        # must not fail every call, but it must be said once, clearly
+        if not _warned_bad_slow:
+            _warned_bad_slow = True
+            print(f"[tracing] {exc}; slow-call capture disabled",
+                  file=sys.stderr)
         return None
 
 
@@ -611,7 +620,7 @@ def maybe_push_slow(trace_id: Optional[str], dur_s: float,
     thr = slow_threshold_ms()
     if thr is None or trace_id is None or dur_s * 1e3 < thr:
         return False
-    url = controller_url or os.environ.get("KT_CONTROLLER_URL")
+    url = controller_url or env_str("KT_CONTROLLER_URL")
     if not url:
         return False
     spans = recorder.snapshot(trace_id=trace_id)
@@ -623,7 +632,7 @@ def maybe_push_slow(trace_id: Optional[str], dur_s: float,
 
         data = json.dumps({"spans": spans}).encode()
         headers = {"Content-Type": "application/json"}
-        token = os.environ.get("KT_CONTROLLER_TOKEN")
+        token = env_str("KT_CONTROLLER_TOKEN")
         if token:
             headers["Authorization"] = f"Bearer {token}"
         req = urllib.request.Request(
@@ -632,10 +641,13 @@ def maybe_push_slow(trace_id: Optional[str], dur_s: float,
             urllib.request.urlopen(req, timeout=5.0).read()
             _bump("trace_slow_pushes_total")
         except Exception:  # noqa: BLE001 — capture is best-effort
-            pass
+            _bump("trace_slow_push_errors_total")
 
-    threading.Thread(target=_post, daemon=True,
-                     name="kt-trace-push").start()
+    # copy_context: the push thread's own log lines / nested spans keep
+    # the request that triggered them (KT002 — same class as the PR-4
+    # placement-thread fix)
+    threading.Thread(target=contextvars.copy_context().run, args=(_post,),
+                     daemon=True, name="kt-trace-push").start()
     return True
 
 

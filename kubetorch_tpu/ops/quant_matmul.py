@@ -31,7 +31,6 @@ No reference analogue (the reference ships no serving compute, SURVEY.md
 
 from __future__ import annotations
 
-import os
 from typing import Optional
 
 import jax
@@ -102,7 +101,9 @@ def decode_matmul_viable(x: jax.Array, w: jax.Array, scale) -> bool:
     (few-token) activation, a real TPU backend, and no live multi-device
     mesh (under GSPMD an unpartitioned pallas call would force operand
     all-gathers — the einsum path stays sharding-transparent)."""
-    if os.environ.get("KT_QMM_DECODE") != "1":
+    from kubetorch_tpu.config import env_bool
+
+    if not env_bool("KT_QMM_DECODE"):
         return False
     if scale is None or w.dtype != jnp.int8:
         return False

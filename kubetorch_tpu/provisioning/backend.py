@@ -28,8 +28,9 @@ from kubetorch_tpu.config import get_config
 from kubetorch_tpu.exceptions import ServiceTimeoutError, StartupError
 from kubetorch_tpu.serving import http_client
 
-_LOCAL_ROOT = Path(os.environ.get("KT_LOCAL_STATE",
-                                  "~/.ktpu/local")).expanduser()
+from kubetorch_tpu.config import env_path, env_str
+
+_LOCAL_ROOT = env_path("KT_LOCAL_STATE")
 
 
 def free_port() -> int:
@@ -70,6 +71,7 @@ class LocalBackend:
         for path in sorted(_LOCAL_ROOT.glob("*/service.json")):
             try:
                 out.append(ServiceRecord(json.loads(path.read_text())))
+            # ktlint: disable=KT004 -- a corrupt record must not hide the rest
             except Exception:
                 continue
         return out
@@ -194,7 +196,7 @@ class LocalBackend:
             # replacement pods come back headless: no registration, no
             # heartbeats, invisible to the liveness tracker that just
             # restarted them
-            "controller_url": (os.environ.get("KT_CONTROLLER_URL")
+            "controller_url": (env_str("KT_CONTROLLER_URL")
                                or get_config().controller_url),
         })
         self._record_path(service_name).write_text(json.dumps(record, indent=2))
@@ -211,6 +213,7 @@ class LocalBackend:
                 controller.register_pool(
                     service_name, module_meta, compute=compute_dict,
                     launch_id=launch_id, broadcast=False)
+        # ktlint: disable=KT004 -- a missing controller never blocks local
         except Exception:
             pass
         self._wait_ready(record, launch_timeout, launch_id)
@@ -286,7 +289,7 @@ class LocalBackend:
             raise KeyError(f"no local service {service_name!r}")
         module_env = dict(record.get("module_env") or {})
         controller_url = (record.get("controller_url")
-                          or os.environ.get("KT_CONTROLLER_URL"))
+                          or env_str("KT_CONTROLLER_URL"))
         if controller_url:
             # module_env overlays the launcher's env, so the replacement
             # pods re-register and heartbeat even though the restart runs

@@ -17,7 +17,6 @@ supported remote path).
 from __future__ import annotations
 
 import json
-import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -136,7 +135,9 @@ class K8sBackend:
         deadline = time.time() + timeout
         want = compute.num_pods
         controller = self._controller()
-        poll = float(os.environ.get("KT_READY_POLL", "2.0"))
+        from kubetorch_tpu.config import env_float
+
+        poll = env_float("KT_READY_POLL")
         # BYO pods (selector mode) are not launched by us and carry no
         # launch-id label; generation-scoping only applies to pods our own
         # manifests created.
@@ -298,7 +299,8 @@ class K8sBackend:
                 self.client.delete("Pod", pod["metadata"]["name"],
                                    pod["metadata"].get("namespace"))
                 deleted += 1
-            except Exception:  # noqa: BLE001 — already gone is fine
+            # ktlint: disable=KT004 -- already-gone pod is the desired state
+            except Exception:  # noqa: BLE001
                 pass
         # launch-id scoping off: the replacement pods belong to the same
         # deploy generation (the workload spec never changed). Terminating
@@ -321,17 +323,20 @@ class K8sBackend:
                         "kind": kind, "metadata": {"name": service_name}}
             try:
                 found |= self.client.delete(manifest, service_name)
+            # ktlint: disable=KT004 -- probing workload kinds: misses expected
             except Exception:
                 pass
         for svc in (service_name, f"{service_name}-headless"):
             try:
                 found |= self.client.delete("Service", svc)
+            # ktlint: disable=KT004 -- probing service names: misses expected
             except Exception:
                 pass
         controller = self._controller()
         if controller is not None:
             try:
                 controller.teardown(service_name)
+            # ktlint: disable=KT004 -- best-effort controller cleanup
             except Exception:
                 pass
         if not found and not quiet:

@@ -30,10 +30,11 @@ Injection points:
 from __future__ import annotations
 
 import hashlib
-import os
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
+
+from kubetorch_tpu.config import env_str
 
 ENV = "KT_CHAOS"
 
@@ -120,7 +121,7 @@ class ChaosPolicy:
         """Parse ``KT_CHAOS`` (or an explicit string):
         ``"kill-worker=1,drop-connection=0.3,seed=42,latency=0.01,max=3"``.
         A bare kind name means rate 1.0. Returns None when unset/empty."""
-        raw = value if value is not None else os.environ.get(ENV, "")
+        raw = value if value is not None else env_str(ENV)
         raw = (raw or "").strip()
         if not raw:
             return None
@@ -160,7 +161,7 @@ def install(policy: Optional[ChaosPolicy]) -> Optional[ChaosPolicy]:
     global _active, _parsed_env
     with _lock:
         _active = policy
-        _parsed_env = os.environ.get(ENV, "")
+        _parsed_env = env_str(ENV)
     return policy
 
 
@@ -169,7 +170,7 @@ def active() -> Optional[ChaosPolicy]:
     from ``KT_CHAOS`` (re-parsed when the env var changes, so tests can
     monkeypatch it)."""
     global _active, _parsed_env
-    env = os.environ.get(ENV, "")
+    env = env_str(ENV)
     with _lock:
         if env != _parsed_env:
             _active = ChaosPolicy.from_env(env)

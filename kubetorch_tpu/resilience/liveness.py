@@ -21,10 +21,11 @@ machine is unit-testable without sleeping.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubetorch_tpu.config import env_float, env_int, env_str
 
 ALIVE = "alive"
 SUSPECT = "suspect"
@@ -47,25 +48,20 @@ def pod_identity() -> str:
     a spurious gang restart."""
     import socket
 
-    return (os.environ.get("KT_POD_NAME")
-            or f"{socket.gethostname()}-"
-               f"{os.environ.get('KT_REPLICA_INDEX', '0')}")
+    return (env_str("KT_POD_NAME")
+            or f"{socket.gethostname()}-{env_int('KT_REPLICA_INDEX')}")
 
 
 def heartbeat_interval() -> float:
-    try:
-        return max(0.01, float(os.environ.get(HEARTBEAT_ENV,
-                                              DEFAULT_HEARTBEAT_S)))
-    except ValueError:
-        return DEFAULT_HEARTBEAT_S
+    # typed accessor: a malformed KT_HEARTBEAT_S used to silently fall
+    # back to the default (a mistyped "0,5" beat 10× slower than asked,
+    # widening dead-detection unnoticed) — now it's a ConfigError naming
+    # the variable, at the first read
+    return max(0.01, env_float(HEARTBEAT_ENV))
 
 
 def default_dead_after_misses() -> int:
-    try:
-        return max(1, int(os.environ.get(DEAD_AFTER_ENV,
-                                         DEFAULT_DEAD_AFTER_MISSES)))
-    except ValueError:
-        return DEFAULT_DEAD_AFTER_MISSES
+    return max(1, env_int(DEAD_AFTER_ENV))
 
 
 class PodLiveness:
@@ -254,5 +250,6 @@ class LivenessTracker:
             return
         try:
             self.on_transition(service, pod, old, new)
-        except Exception:  # noqa: BLE001 — observers never break tracking
+        # ktlint: disable=KT004 -- observer contract: never break tracking
+        except Exception:  # noqa: BLE001
             pass

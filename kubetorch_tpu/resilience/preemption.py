@@ -28,11 +28,11 @@ share the grace window with the drain.
 from __future__ import annotations
 
 import asyncio
-import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from kubetorch_tpu.config import env_float, env_str
 from kubetorch_tpu.observability import tracing
 
 GRACE_ENV = "KT_TERM_GRACE"
@@ -81,6 +81,7 @@ def run_emergency_checkpoints(
                 from kubetorch_tpu.observability import prometheus as prom
 
                 prom.record_resilience("emergency_checkpoint")
+            # ktlint: disable=KT004 -- metrics never gate a checkpoint
             except Exception:  # noqa: BLE001
                 pass
         except Exception as exc:  # noqa: BLE001 — keep draining the list
@@ -95,18 +96,13 @@ def run_emergency_checkpoints(
 
 
 def grace_seconds() -> float:
-    try:
-        return max(0.1, float(os.environ.get(GRACE_ENV, DEFAULT_GRACE_S)))
-    except ValueError:
-        return DEFAULT_GRACE_S
+    return max(0.1, env_float(GRACE_ENV))
 
 
 def drain_timeout(grace_s: Optional[float] = None) -> float:
     grace_s = grace_s if grace_s is not None else grace_seconds()
-    try:
-        return max(0.0, float(os.environ.get(DRAIN_ENV, 0.4 * grace_s)))
-    except ValueError:
-        return 0.4 * grace_s
+    explicit = env_float(DRAIN_ENV)
+    return max(0.0, explicit if explicit is not None else 0.4 * grace_s)
 
 
 class PreemptionHandler:
@@ -134,12 +130,13 @@ class PreemptionHandler:
             from kubetorch_tpu.observability import prometheus as prom
 
             prom.record_resilience("preempted")
+        # ktlint: disable=KT004 -- metrics never gate the drain sequence
         except Exception:  # noqa: BLE001
             pass
         pspan = tracing.start_span(
             "preempt", attrs={
                 "service": self.server.metadata.get("service_name", ""),
-                "pod": os.environ.get("KT_POD_NAME", ""),
+                "pod": env_str("KT_POD_NAME") or "",
                 "grace_s": self.grace_s})
         pspan.detach()
         parent = getattr(pspan, "context", None)
@@ -208,15 +205,16 @@ class PreemptionHandler:
                 ws.notify_preempted()
                 await asyncio.sleep(0.05)  # let the frame flush
                 return
-            except Exception:  # noqa: BLE001 — fall through to HTTP
+            # ktlint: disable=KT004 -- WS gone: HTTP fallback below reports
+            except Exception:  # noqa: BLE001
                 pass
-        controller_url = os.environ.get("KT_CONTROLLER_URL")
+        controller_url = env_str("KT_CONTROLLER_URL")
         if not controller_url:
             return
         try:
             import aiohttp
 
-            token = os.environ.get("KT_CONTROLLER_TOKEN")
+            token = env_str("KT_CONTROLLER_TOKEN")
             headers = {"Authorization": f"Bearer {token}"} if token else {}
             async with aiohttp.ClientSession(
                     timeout=aiohttp.ClientTimeout(total=2.0),
@@ -225,5 +223,6 @@ class PreemptionHandler:
                     f"{controller_url.rstrip('/')}/heartbeat",
                     json={"service": service, "pod": pod,
                           "state": "preempted"})
-        except Exception:  # noqa: BLE001 — dying pod, best effort
+        # ktlint: disable=KT004 -- dying pod: liveness catches the silence
+        except Exception:  # noqa: BLE001
             pass

@@ -20,11 +20,11 @@ dashboard line, not a silent spin.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
+from kubetorch_tpu.config import env_float, env_int
 from kubetorch_tpu.observability import tracing
 
 MAX_RESTARTS_ENV = "KT_MAX_RESTARTS"
@@ -36,11 +36,7 @@ DEFAULT_RESET_AFTER_S = 300.0
 
 
 def max_restarts() -> int:
-    try:
-        return max(0, int(os.environ.get(MAX_RESTARTS_ENV,
-                                         DEFAULT_MAX_RESTARTS)))
-    except ValueError:
-        return DEFAULT_MAX_RESTARTS
+    return max(0, env_int(MAX_RESTARTS_ENV))
 
 
 class RestartPolicy:
@@ -58,19 +54,11 @@ class RestartPolicy:
         self.max_restarts = (max_restarts_n if max_restarts_n is not None
                              else max_restarts())
         if backoff_s is None:
-            try:
-                backoff_s = float(os.environ.get(BACKOFF_ENV,
-                                                 DEFAULT_BACKOFF_S))
-            except ValueError:
-                backoff_s = DEFAULT_BACKOFF_S
+            backoff_s = env_float(BACKOFF_ENV)
         self.backoff_s = backoff_s
         self.backoff_max_s = backoff_max_s
         if reset_after_s is None:
-            try:
-                reset_after_s = float(os.environ.get(
-                    RESET_AFTER_ENV, DEFAULT_RESET_AFTER_S))
-            except ValueError:
-                reset_after_s = DEFAULT_RESET_AFTER_S
+            reset_after_s = env_float(RESET_AFTER_ENV)
         self.reset_after_s = reset_after_s
         self._attempts: Dict[str, int] = {}
         self._healthy_since: Dict[str, float] = {}
@@ -170,7 +158,8 @@ class GangRestarter:
             return
         try:
             self.on_event(service, reason, message)
-        except Exception:  # noqa: BLE001 — events never break a restart
+        # ktlint: disable=KT004 -- event sink contract: never break a restart
+        except Exception:  # noqa: BLE001
             pass
 
     def restart(self, service: str,

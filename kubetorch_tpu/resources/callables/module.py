@@ -12,7 +12,6 @@ with the username so shared clusters don't collide.
 from __future__ import annotations
 
 import json
-import os
 import re
 import uuid
 from pathlib import Path
@@ -118,7 +117,9 @@ class Module:
         share the client's filesystem; ``always``/``never`` force it.
         """
         self._code_store_url = None  # never report a previous deploy's URL
-        mode = os.environ.get("KT_CODE_SYNC", "auto")
+        from kubetorch_tpu.config import env_str
+
+        mode = env_str("KT_CODE_SYNC")
         if compute.freeze or not self.root_path or mode == "never":
             return None
         from kubetorch_tpu.provisioning.k8s_backend import K8sBackend

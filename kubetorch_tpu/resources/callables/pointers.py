@@ -9,7 +9,6 @@ Reference: ``resources/callables/utils.py:53`` (extract_pointers),
 from __future__ import annotations
 
 import inspect
-import os
 import sys
 import textwrap
 from pathlib import Path
@@ -87,7 +86,9 @@ def reload_fallback_names(name: str, username: Optional[str] = None) -> list:
     candidates = []
     if username:
         candidates.append(f"{username}-{name}")
-    env_user = os.environ.get("KT_USERNAME")
+    from kubetorch_tpu.config import env_str
+
+    env_user = env_str("KT_USERNAME")
     if env_user and f"{env_user}-{name}" not in candidates:
         candidates.append(f"{env_user}-{name}")
     candidates.append(name)

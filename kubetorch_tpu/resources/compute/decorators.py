@@ -10,7 +10,6 @@ callable so the deployed code doesn't recursively redeploy itself.
 from __future__ import annotations
 
 import inspect
-import os
 from typing import Any, Callable, Optional
 
 from kubetorch_tpu.resources.compute.compute import Compute
@@ -50,7 +49,9 @@ class PartialModule:
 
 
 def _server_side_noop(obj: Callable) -> bool:
-    target = os.environ.get("KT_CLS_OR_FN_NAME")
+    from kubetorch_tpu.config import env_str
+
+    target = env_str("KT_CLS_OR_FN_NAME")
     return bool(target) and getattr(obj, "__qualname__", "") == target
 
 

@@ -4,7 +4,6 @@ The ``server*``/``ubuntu_base`` factories point at the published default
 image matrix (release/default_images/ — base, TPU, OTel-traced, Ubuntu
 variants, mirroring the reference's 5-image set)."""
 
-import os
 
 from kubetorch_tpu.resources.images.image import Image
 
@@ -12,8 +11,10 @@ from kubetorch_tpu.resources.images.image import Image
 def _published(name: str) -> Image:
     # env read at call time like every other KT_* knob — mirrored-registry
     # users set KT_IMAGE_REGISTRY after import
-    registry = os.environ.get("KT_IMAGE_REGISTRY", "ghcr.io/kubetorch-tpu")
-    tag = os.environ.get("KT_IMAGE_TAG", "latest")
+    from kubetorch_tpu.config import env_str
+
+    registry = env_str("KT_IMAGE_REGISTRY")
+    tag = env_str("KT_IMAGE_TAG")
     return Image(f"{registry}/{name}:{tag}")
 
 

@@ -47,13 +47,11 @@ TRANSPORT_ERRORS: Tuple[Type[BaseException], ...] = (
 
 
 def attempts() -> int:
-    import os
+    # typed accessor: a malformed KT_RETRY_ATTEMPTS used to silently fall
+    # back to the default — now it raises ConfigError naming the variable
+    from kubetorch_tpu.config import env_int
 
-    try:
-        return max(1, int(os.environ.get("KT_RETRY_ATTEMPTS",
-                                         DEFAULT_ATTEMPTS)))
-    except ValueError:
-        return DEFAULT_ATTEMPTS
+    return max(1, env_int("KT_RETRY_ATTEMPTS"))
 
 
 def backoff_sleep_s(exc: BaseException, delay: float,

@@ -43,7 +43,9 @@ def sanitize_env(env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
 
 def run_id() -> Optional[str]:
     """The current run's ID when executing inside ``kt run``."""
-    return os.environ.get(RUN_ID_ENV)
+    from kubetorch_tpu.config import env_str
+
+    return env_str(RUN_ID_ENV)
 
 
 def _require_run() -> str:

@@ -36,9 +36,9 @@ message per call/response, ``frames.pack_envelope`` layout.
 
 from __future__ import annotations
 
+import contextvars
 import itertools
 import json
-import os
 import queue
 import threading
 import time
@@ -46,6 +46,7 @@ import uuid
 from typing import Any, Dict, Iterable, Optional
 
 from kubetorch_tpu import serialization
+from kubetorch_tpu.config import env_int
 from kubetorch_tpu.exceptions import rehydrate_exception
 from kubetorch_tpu.observability import tracing
 from kubetorch_tpu.serving import frames
@@ -68,15 +69,13 @@ def _set_nodelay(conn) -> None:
             from aiohttp.tcp_helpers import tcp_nodelay
 
             tcp_nodelay(transport, True)
-    except Exception:  # noqa: BLE001 — an exotic transport still works
+    # ktlint: disable=KT004 -- an exotic transport without TCP still works
+    except Exception:  # noqa: BLE001
         pass
 
 
 def default_depth() -> int:
-    try:
-        return max(1, int(os.environ.get(DEFAULT_DEPTH_ENV, "2")))
-    except ValueError:
-        return 2
+    return max(1, env_int(DEFAULT_DEPTH_ENV))
 
 
 def _chaos_policy():
@@ -86,7 +85,8 @@ def _chaos_policy():
         from kubetorch_tpu.resilience import chaos
 
         return chaos.active()
-    except Exception:  # noqa: BLE001 — chaos must never break serving
+    # ktlint: disable=KT004 -- chaos injection must never break serving
+    except Exception:  # noqa: BLE001
         return None
 
 
@@ -193,7 +193,8 @@ class ChannelCall:
                 prom.record_call_stages(
                     {"client_ser": self._t["client_ser"],
                      "wire": self._t["wire"]})
-            except Exception:  # noqa: BLE001 — metrics never break a call
+            # ktlint: disable=KT004 -- metrics must never break a call
+            except Exception:  # noqa: BLE001
                 pass
         self._items.put(None)  # unblock a stream iterator
         cb, self._on_terminal = self._on_terminal, None
@@ -381,7 +382,8 @@ class CallChannel:
             try:
                 asyncio.run_coroutine_threadsafe(
                     self._shutdown(), self._loop).result(5.0)
-            except Exception:  # noqa: BLE001 — best-effort teardown
+            # ktlint: disable=KT004 -- best-effort teardown on close
+            except Exception:  # noqa: BLE001
                 pass
             self._loop.call_soon_threadsafe(self._loop.stop)
             if self._thread is not None:
@@ -416,7 +418,8 @@ class CallChannel:
                         loop.close()
 
                 self._thread = threading.Thread(
-                    target=_run, name="kt-channel", daemon=True)
+                    target=contextvars.copy_context().run, args=(_run,),
+                    name="kt-channel", daemon=True)
                 self._thread.start()
         self._loop_ready.wait(10.0)
         return self._loop
@@ -464,6 +467,7 @@ class CallChannel:
 
             prom.record_channel_event(
                 "reconnect" if self._ever_connected else "connect")
+        # ktlint: disable=KT004 -- metrics must never break a (re)connect
         except Exception:  # noqa: BLE001
             pass
         self._ever_connected = True
@@ -537,6 +541,9 @@ class CallChannel:
         try:
             header, payload = frames.unpack_envelope(data)
         except Exception:  # noqa: BLE001 — a garbled frame kills nothing
+            from kubetorch_tpu.observability import prometheus as prom
+
+            prom.record_channel_event("error")
             return
         cid = header.get("cid")
         with self._calls_lock:

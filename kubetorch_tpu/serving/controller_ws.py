@@ -11,14 +11,19 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import os
 import socket
 from typing import TYPE_CHECKING, Optional
 
 import aiohttp
 
+from kubetorch_tpu.config import env_int, env_str
+
 if TYPE_CHECKING:
     from kubetorch_tpu.serving.server import PodServer
+
+logger = logging.getLogger(__name__)
 
 
 class ControllerWebSocket:
@@ -48,19 +53,19 @@ class ControllerWebSocket:
 
     # ------------------------------------------------------------------
     def _self_url(self) -> str:
-        host = os.environ.get("KT_POD_IP")
+        host = env_str("KT_POD_IP")
         if not host:
             try:
                 host = socket.gethostbyname(socket.gethostname())
             except socket.gaierror:
                 host = "127.0.0.1"
-        port = os.environ.get("KT_SERVER_PORT", "32300")
+        port = env_int("KT_SERVER_PORT")
         return f"http://{host}:{port}"
 
     async def _run(self):
         """Reconnect loop (reference: _run:411)."""
         backoff = 1.0
-        token = os.environ.get("KT_CONTROLLER_TOKEN")
+        token = env_str("KT_CONTROLLER_TOKEN")
         headers = {"Authorization": f"Bearer {token}"} if token else {}
         while not self._stop.is_set():
             try:
@@ -85,8 +90,10 @@ class ControllerWebSocket:
                         await self._listen(ws)
             except asyncio.CancelledError:
                 return
-            except Exception:
-                pass
+            except Exception as exc:
+                # the reconnect loop below retries with backoff; a debug
+                # line keeps repeated connect failures diagnosable
+                logger.debug("controller WS connect/listen failed: %r", exc)
             finally:
                 self.connected = False
                 self._ws = None
@@ -159,8 +166,10 @@ class ControllerWebSocket:
         async def _send():
             try:
                 await ws.send_json(payload)
-            except Exception:
-                pass
+            except Exception as exc:
+                # fire-and-forget by design: the socket can close between
+                # the `ws.closed` check and the send; HTTP fallbacks cover
+                logger.debug("controller WS notify failed: %r", exc)
 
         try:
             asyncio.get_running_loop().create_task(_send())
@@ -193,8 +202,8 @@ class ControllerWebSocket:
                     "setup_error": self.pod_server.setup_error,
                     "launch_id": self.pod_server.launch_id,
                 })
-            except Exception:
-                pass
+            except Exception as exc:
+                logger.debug("controller WS status push failed: %r", exc)
 
         try:
             asyncio.get_running_loop().create_task(_send())

@@ -61,7 +61,9 @@ class _SocketIO:
 
 
 def debug_port(local_rank: Optional[int] = None) -> int:
-    base = int(os.environ.get("KT_DEBUG_PORT", str(DEFAULT_DEBUG_PORT)))
+    from kubetorch_tpu.config import env_int
+
+    base = env_int("KT_DEBUG_PORT")
     rank = (local_rank if local_rank is not None
             else int(os.environ.get("LOCAL_RANK", "0") or 0))
     return base + rank
@@ -104,6 +106,7 @@ class _KtPdb:
                     # output pump so its thread exits with the session
                     try:
                         fd.close() if hasattr(fd, "close") else os.close(fd)
+                    # ktlint: disable=KT004 -- double-close during pty teardown
                     except Exception:
                         pass
 
@@ -229,8 +232,10 @@ def _pty_session(conn: socket.socket, listener: socket.socket, port: int):
         finally:
             os.close(out_fd)
 
+    # ktlint: disable=KT002 -- interactive pty pumps: no ambient request ctx
     threading.Thread(target=conn_to_master, daemon=True,
                      name="kt-pdb-pty-in").start()
+    # ktlint: disable=KT002 -- interactive pty pumps: no ambient request ctx
     threading.Thread(target=master_to_conn, daemon=True,
                      name="kt-pdb-pty-out").start()
     fin = os.fdopen(os.dup(slave), "r", encoding="utf-8", newline="\n")
@@ -269,7 +274,9 @@ def deep_breakpoint(port: Optional[int] = None, timeout: float = 600.0,
         return  # port taken outside this process: skip, don't crash user code
     listener.listen(1)
     listener.settimeout(timeout)
-    service = os.environ.get("KT_SERVICE_NAME", "")
+    from kubetorch_tpu.config import env_str
+
+    service = env_str("KT_SERVICE_NAME")
     print(f"[kt] deep_breakpoint waiting for debugger on port {port} "
           f"(attach: ktpu debug {service or '<service>'} --port {port})",
           flush=True)
@@ -406,6 +413,7 @@ def attach(pod_url: str, port: Optional[int] = None,
 
                 import threading as _threading
 
+                # ktlint: disable=KT002 -- interactive stdin pump: no request ctx
                 _threading.Thread(target=read_stdin, daemon=True,
                                   name="kt-debug-stdin").start()
 
@@ -502,6 +510,7 @@ def _attach_pty(pod_url: str, params: dict, stdin, stdout) -> int:
                         if not data:
                             return
 
+                # ktlint: disable=KT002 -- interactive stdin pump: no request ctx
                 threading.Thread(target=read_stdin, daemon=True,
                                  name="kt-debug-stdin").start()
 

@@ -14,6 +14,8 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional
 
+from kubetorch_tpu.config import env_int, env_str
+
 
 class FrameworkProcess:
     """Computes per-rank env for one framework; subclass per framework."""
@@ -75,7 +77,7 @@ class JaxProcess(FrameworkProcess):
         # jax.distributed default coordinator port; override when several
         # independent quorums share a network namespace (local backend,
         # tests, sidecar jobs on one host).
-        return int(os.environ.get("KT_JAX_COORD_PORT", "8476"))
+        return env_int("KT_JAX_COORD_PORT")
 
     def framework_env(self, *, rank, world_size, local_rank, node_rank,
                       pod_ips) -> Dict[str, str]:
@@ -125,8 +127,7 @@ class JaxProcess(FrameworkProcess):
         # seconds to ~none. Point KT_JAX_CACHE_DIR at a mounted volume to
         # survive pod reschedules.
         if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
-            env["JAX_COMPILATION_CACHE_DIR"] = os.environ.get(
-                "KT_JAX_CACHE_DIR", "/tmp/kt-jax-cache")
+            env["JAX_COMPILATION_CACHE_DIR"] = env_str("KT_JAX_CACHE_DIR")
         return env
 
     @staticmethod
@@ -135,11 +136,10 @@ class JaxProcess(FrameworkProcess):
         """Expand this slice's TPU_WORKER_HOSTNAMES from the provisioning
         pattern (multi-slice: each slice's list differs, so it cannot be a
         static env var — manifests.py sets the pattern instead)."""
-        pattern = os.environ.get("KT_TPU_HOSTNAME_PATTERN")
+        pattern = env_str("KT_TPU_HOSTNAME_PATTERN")
         if not pattern:
             return None
-        hosts = int(os.environ.get("KT_TPU_HOSTS_PER_SLICE",
-                                   str(hosts_per_slice)) or hosts_per_slice)
+        hosts = env_int("KT_TPU_HOSTS_PER_SLICE") or hosts_per_slice
         return [pattern.format(slice=int(slice_id), host=i)
                 for i in range(hosts)]
 

@@ -45,10 +45,10 @@ def proxy_timeout(timeout: Optional[float] = None) -> httpx.Timeout:
     timeout error (raised at ~timeout by the peer's pool) wins the race
     against this transport-level ReadTimeout and the error payload
     survives the hop."""
-    import os
-
     if timeout is None:
-        timeout = float(os.environ.get("KT_PROXY_TIMEOUT", "600"))
+        from kubetorch_tpu.config import env_float
+
+        timeout = env_float("KT_PROXY_TIMEOUT")
     return httpx.Timeout(connect=10.0, read=timeout + 30.0, write=60.0,
                          pool=10.0)
 

@@ -7,6 +7,7 @@ every local rank with rank-specific env.
 
 from __future__ import annotations
 
+import contextvars
 import itertools
 import queue
 import threading
@@ -87,8 +88,11 @@ class ProcessPool:
             worker = ProcessWorker(local_rank, env)
             worker.start()
             self.workers.append(worker)
+            # copy_context (the PR-4 placement-thread fix class): response
+            # routing logs/spans keep the deploying request's ids
             router = threading.Thread(
-                target=self._route, args=(worker,), daemon=True,
+                target=contextvars.copy_context().run,
+                args=(self._route, worker), daemon=True,
                 name=f"kt-router-{local_rank}")
             router.start()
             self._routers.append(router)
@@ -337,6 +341,7 @@ class ProcessPool:
         for worker in self.workers:
             try:
                 worker.stop()
+            # ktlint: disable=KT004 -- best-effort teardown of a dead worker
             except Exception:
                 pass
         self.workers = []

@@ -26,6 +26,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
 
 from kubetorch_tpu import serialization
+from kubetorch_tpu.config import env_int
 from kubetorch_tpu.exceptions import package_exception
 from kubetorch_tpu.observability import tracing
 
@@ -126,8 +127,9 @@ def _attach_worker_metrics(agg: Dict[str, int]) -> None:
         trace = tracing.trace_metrics()
         if trace.get("trace_spans_total"):
             agg["trace"] = {"pid": os.getpid(), **trace}
+    # ktlint: disable=KT004 -- metrics piggyback must never break a call
     except Exception:
-        pass  # metrics must never break a call response
+        pass
 
 
 def _load_target(root_path: str, import_path: str, name: str,
@@ -168,7 +170,7 @@ class _WorkerLoop:
         self.target = None
         self.callable_type = "fn"
         self.executor = ThreadPoolExecutor(
-            max_workers=int(os.environ.get("KT_WORKER_THREADS", "8")))
+            max_workers=env_int("KT_WORKER_THREADS"))
         # req_ids whose streams the client abandoned (see _stream_result)
         self._cancelled: set = set()
         self._inflight: set = set()
@@ -402,7 +404,8 @@ class _WorkerLoop:
             )
 
             record_worker_call(exec_s, dispatch_s)
-        except Exception:  # noqa: BLE001 — metrics never break a call
+        # ktlint: disable=KT004 -- metrics recording must never break a call
+        except Exception:  # noqa: BLE001
             pass
         return {"exec_s": round(exec_s, 6), "dispatch_s": round(
             dispatch_s, 6)}
@@ -488,6 +491,7 @@ def worker_main(request_q, response_q, env: Dict[str, str]):
         from kubetorch_tpu.observability.log_capture import install_from_env
 
         install_from_env("worker")
+    # ktlint: disable=KT004 -- log streaming is optional; stdout still works
     except Exception:
         pass
     try:

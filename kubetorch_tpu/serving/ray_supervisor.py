@@ -53,8 +53,10 @@ class RaySupervisor(ExecutionSupervisor):
     # ------------------------------------------------------------------
     def setup(self):
         ray_bin = _require_ray()
+        from kubetorch_tpu.config import env_str
+
         ips = pod_ips(
-            os.environ.get("KT_SERVICE_NAME", ""),
+            env_str("KT_SERVICE_NAME"),
             quorum_workers=self.workers_expected,
             quorum_timeout=self.quorum_timeout)
         members = sorted(ips)

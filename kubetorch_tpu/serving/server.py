@@ -31,6 +31,8 @@ from typing import Any, Dict, List, Optional
 from aiohttp import ClientSession, WSMsgType, web
 
 from kubetorch_tpu import serialization
+from kubetorch_tpu.config import (env_float, env_int, env_json, env_path,
+                                  env_set, env_str)
 from kubetorch_tpu.exceptions import (
     PodTerminatedError,
     package_exception,
@@ -51,30 +53,32 @@ def metadata_from_env() -> Dict[str, Any]:
     """Module metadata contract (mirrors reference env application at
     ``http_server.py:254 _apply_metadata``)."""
     meta: Dict[str, Any] = {
-        "service_name": os.environ.get("KT_SERVICE_NAME", "unknown"),
-        "callable_name": os.environ.get("KT_CLS_OR_FN_NAME", ""),
-        "callable_type": os.environ.get("KT_CALLABLE_TYPE", "fn"),
-        "root_path": os.environ.get("KT_ROOT_PATH", ""),
-        "import_path": os.environ.get("KT_IMPORT_PATH", ""),
-        "name": os.environ.get("KT_CALLABLE_NAME", ""),
-        "num_procs": int(os.environ.get("KT_NUM_PROCS", "1")),
-        "framework": os.environ.get("KT_FRAMEWORK") or None,
-        "replica_index": int(os.environ.get("KT_REPLICA_INDEX", "0")),
+        "service_name": env_str("KT_SERVICE_NAME") or "unknown",
+        "callable_name": env_str("KT_CLS_OR_FN_NAME"),
+        "callable_type": env_str("KT_CALLABLE_TYPE"),
+        "root_path": env_str("KT_ROOT_PATH"),
+        "import_path": env_str("KT_IMPORT_PATH"),
+        "name": env_str("KT_CALLABLE_NAME"),
+        "num_procs": env_int("KT_NUM_PROCS"),
+        "framework": env_str("KT_FRAMEWORK"),
+        "replica_index": env_int("KT_REPLICA_INDEX"),
     }
-    if os.environ.get("KT_INIT_ARGS"):
-        meta["init_args"] = json.loads(os.environ["KT_INIT_ARGS"])
-    if os.environ.get("KT_DISTRIBUTED"):
-        meta["distributed"] = json.loads(os.environ["KT_DISTRIBUTED"])
-    if os.environ.get("KT_ALLOWED_SERIALIZATION"):
-        meta["allowed_serialization"] = tuple(
-            os.environ["KT_ALLOWED_SERIALIZATION"].split(","))
-    if os.environ.get("KT_APP_CMD"):
-        meta["app_cmd"] = os.environ["KT_APP_CMD"]
-        meta["app_port"] = int(os.environ.get("KT_APP_PORT", "0") or 0)
-        meta["app_health_path"] = os.environ.get("KT_APP_HEALTH_PATH", "")
-    if os.environ.get("KT_CODE_KEY"):
-        meta["code_key"] = os.environ["KT_CODE_KEY"]
-        meta["code_store_url"] = os.environ.get("KT_STORE_URL")
+    if env_set("KT_INIT_ARGS"):
+        meta["init_args"] = env_json("KT_INIT_ARGS")
+    if env_set("KT_DISTRIBUTED"):
+        meta["distributed"] = env_json("KT_DISTRIBUTED")
+    allowed = env_str("KT_ALLOWED_SERIALIZATION")
+    if allowed:
+        meta["allowed_serialization"] = tuple(allowed.split(","))
+    app_cmd = env_str("KT_APP_CMD")
+    if app_cmd:
+        meta["app_cmd"] = app_cmd
+        meta["app_port"] = env_int("KT_APP_PORT")
+        meta["app_health_path"] = env_str("KT_APP_HEALTH_PATH")
+    code_key = env_str("KT_CODE_KEY")
+    if code_key:
+        meta["code_key"] = code_key
+        meta["code_store_url"] = env_str("KT_STORE_URL")
     return meta
 
 
@@ -84,7 +88,7 @@ class PodServer:
         self.supervisor = None
         self.app_proc: Optional[asyncio.subprocess.Process] = None
         self.terminating = False
-        self.launch_id = os.environ.get("KT_LAUNCH_ID", "")
+        self.launch_id = env_str("KT_LAUNCH_ID")
         self.started_at = time.time()
         self.metrics: Dict[str, Any] = {
             "http_requests_total": 0,
@@ -163,7 +167,7 @@ class PodServer:
                 loop.add_signal_handler(sig, self._mark_terminating)
             except NotImplementedError:
                 pass
-        controller_url = os.environ.get("KT_CONTROLLER_URL")
+        controller_url = env_str("KT_CONTROLLER_URL")
         if controller_url:
             from kubetorch_tpu.serving.controller_ws import ControllerWebSocket
 
@@ -201,24 +205,20 @@ class PodServer:
         key = self.metadata.get("code_key")
         if not key:
             return
-        from pathlib import Path
-
         from kubetorch_tpu.data_store.commands import workdir_sync
 
         # Per-pod dir: local-backend pods (and k8s pods on a shared
         # volume) would otherwise extract into one directory concurrently
         # and import half-written modules.
-        pod = os.environ.get("KT_POD_NAME") or os.environ.get(
-            "KT_REPLICA_INDEX", "0")
-        dest = (Path(os.environ.get("KT_CODE_DEST",
-                                    "~/.ktpu/code")).expanduser()
+        pod = env_str("KT_POD_NAME") or str(env_int("KT_REPLICA_INDEX"))
+        dest = (env_path("KT_CODE_DEST")
                 / f"{self.metadata.get('service_name', 'svc')}-{pod}")
         # Prefer the store the CLIENT synced to (rides in the metadata and
         # push-reloads); env KT_STORE_URL is the fallback for pods whose
         # metadata predates the field.
         workdir_sync(key, dest,
                      store_url=self.metadata.get("code_store_url")
-                     or os.environ.get("KT_STORE_URL"))
+                     or env_str("KT_STORE_URL"))
         self.metadata["root_path"] = str(dest)
 
     def _setup_supervisor(self):
@@ -265,11 +265,11 @@ class PodServer:
         import aiohttp as _aiohttp
 
         service = self.metadata.get("service_name", "")
-        pod = os.environ.get("KT_POD_NAME", _socket.gethostname())
-        token = os.environ.get("KT_CONTROLLER_TOKEN")
+        pod = env_str("KT_POD_NAME") or _socket.gethostname()
+        token = env_str("KT_CONTROLLER_TOKEN")
         headers = {"Authorization": f"Bearer {token}"} if token else {}
         last_reported = 0.0
-        interval = float(os.environ.get("KT_METRICS_INTERVAL", "15.0"))
+        interval = env_float("KT_METRICS_INTERVAL")
         while True:
             await asyncio.sleep(interval)
             ts = self.metrics["last_activity_timestamp"]
@@ -287,7 +287,10 @@ class PodServer:
                             f"/activity")
                         last_reported = ts
             except Exception:
-                pass
+                # unreachable controller: the next interval retries, but
+                # the gap must be countable from the pod side
+                self.metrics["controller_push_errors_total"] = (
+                    self.metrics.get("controller_push_errors_total", 0) + 1)
 
     async def _heartbeat_loop(self, controller_url: str):
         """Liveness heartbeats to the controller every ``KT_HEARTBEAT_S``
@@ -305,7 +308,7 @@ class PodServer:
 
         service = self.metadata.get("service_name", "")
         pod = pod_identity()
-        token = os.environ.get("KT_CONTROLLER_TOKEN")
+        token = env_str("KT_CONTROLLER_TOKEN")
         headers = {"Authorization": f"Bearer {token}"} if token else {}
         url = f"{controller_url.rstrip('/')}/heartbeat"
         # ONE session for the life of the loop: a beat is a one-line POST
@@ -335,7 +338,9 @@ class PodServer:
                     async with session.post(url, json=payload) as resp:
                         await resp.read()
                 except Exception:  # noqa: BLE001 — next beat retries
-                    pass
+                    self.metrics["heartbeat_send_errors_total"] = (
+                        self.metrics.get("heartbeat_send_errors_total", 0)
+                        + 1)
         finally:
             await session.close()
 
@@ -357,6 +362,7 @@ class PodServer:
         async def _preempt_then_exit():
             try:
                 await handler.run()
+            # ktlint: disable=KT004 -- dying pod: the backstop exit fires regardless
             except Exception:  # noqa: BLE001 — never block the exit
                 pass
             loop.call_later(0.1, os._exit, 0)  # let the report flush
@@ -380,7 +386,7 @@ class PodServer:
         port = self.metadata["app_port"]
         path = "/" + self.metadata["app_health_path"].lstrip("/")
         url = f"http://127.0.0.1:{port}{path}"
-        interval = float(os.environ.get("KT_APP_HEALTH_INTERVAL", "0.5"))
+        interval = env_float("KT_APP_HEALTH_INTERVAL")
         async with ClientSession(
                 timeout=_aiohttp.ClientTimeout(total=5.0)) as s:
             while True:
@@ -399,6 +405,7 @@ class PodServer:
                             self.ready = True
                             self._notify_status()
                             return
+                # ktlint: disable=KT004 -- refused is expected while the app boots
                 except Exception:
                     pass
                 await asyncio.sleep(interval)
@@ -565,7 +572,7 @@ class PodServer:
             # scrape aggregates cleanly.
             labels = {
                 "service": self.metadata.get("service_name", ""),
-                "pod": os.environ.get("KT_POD_NAME", ""),
+                "pod": env_str("KT_POD_NAME") or "",
             }
             return web.Response(
                 text=prom.render([
@@ -1065,6 +1072,7 @@ class PodServer:
 
             if request.transport is not None:
                 tcp_nodelay(request.transport, True)
+        # ktlint: disable=KT004 -- an exotic transport without TCP still works
         except Exception:  # noqa: BLE001
             pass
         prom.record_channel_event("connect")
@@ -1091,7 +1099,10 @@ class PodServer:
                 try:
                     header, payload = frames.unpack_envelope(msg.data)
                 except Exception:  # noqa: BLE001
-                    continue  # garbled envelope: no cid to answer to
+                    # garbled envelope: no cid to answer to — count it so
+                    # a misbehaving client shows up in /metrics
+                    prom.record_channel_event("error")
+                    continue
                 if header.get("kind") != "call":
                     continue
                 if self.terminating:
@@ -1239,7 +1250,8 @@ class PodServer:
         except Exception as exc:  # noqa: BLE001 — a reply must always go
             try:
                 await reply_error(exc)
-            except Exception:  # noqa: BLE001 — socket already gone
+            # ktlint: disable=KT004 -- socket already gone; client sees the drop
+            except Exception:  # noqa: BLE001
                 pass
         finally:
             # failed channel calls must read as failed in /_trace, same
@@ -1290,7 +1302,7 @@ def main():
     parser = argparse.ArgumentParser(description="kubetorch_tpu pod server")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int,
-                        default=int(os.environ.get("KT_SERVER_PORT", "32300")))
+                        default=env_int("KT_SERVER_PORT"))
     args = parser.parse_args()
     server = PodServer()
     web.run_app(server.build_app(), host=args.host, port=args.port,

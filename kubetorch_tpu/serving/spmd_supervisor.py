@@ -21,7 +21,7 @@ Design ported (not code) from the reference (SURVEY.md §3.3 / hard-part 4):
 
 from __future__ import annotations
 
-import os
+import contextvars
 import socket
 import threading
 import time
@@ -29,6 +29,7 @@ from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
 from typing import Any, Dict, List, Optional, Tuple
 
 from kubetorch_tpu import serialization
+from kubetorch_tpu.config import env_int
 from kubetorch_tpu.distributed.utils import pod_ips
 from kubetorch_tpu.exceptions import (
     WorkerMembershipChanged,
@@ -41,8 +42,8 @@ from kubetorch_tpu.serving.supervisor import ExecutionSupervisor
 # path (production: tree only above 100 pods, fanout 50 — reference
 # thresholds; tests: KT_TREE_MINIMUM=4 KT_FANOUT=2 drives a 3-level tree
 # with 6 subprocess pods).
-TREE_MINIMUM = int(os.environ.get("KT_TREE_MINIMUM", "100"))
-FANOUT = int(os.environ.get("KT_FANOUT", "50"))
+TREE_MINIMUM = env_int("KT_TREE_MINIMUM")
+FANOUT = env_int("KT_FANOUT")
 DEFAULT_POD_PORT = 32300
 
 
@@ -111,7 +112,9 @@ class RemoteWorkerPool:
                 timeout=None)
             return resp
 
-        return self.executor.submit(do_post)
+        # copy_context: the fanout POST runs on a pool thread; its log
+        # lines/spans keep the originating call's ids (KT002)
+        return self.executor.submit(contextvars.copy_context().run, do_post)
 
 
 class DistributedSupervisor(ExecutionSupervisor):
@@ -161,6 +164,7 @@ class DistributedSupervisor(ExecutionSupervisor):
                     current = sorted(pod_ips(
                         service_name=self.metadata.get("service_name"),
                         quorum_workers=None, quorum_timeout=5.0))
+                # ktlint: disable=KT004 -- discovery flaps during restarts; next poll retries
                 except Exception:
                     continue
                 old = set(self._members)
@@ -172,7 +176,8 @@ class DistributedSupervisor(ExecutionSupervisor):
                     self._member_event.set()
 
         self._monitor_thread = threading.Thread(
-            target=monitor, daemon=True, name="kt-member-monitor")
+            target=contextvars.copy_context().run, args=(monitor,),
+            daemon=True, name="kt-member-monitor")
         self._monitor_thread.start()
 
     def stop_monitoring(self):

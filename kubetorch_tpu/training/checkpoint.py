@@ -10,7 +10,6 @@ round-trip through the data store as a directory tree.
 
 from __future__ import annotations
 
-import os
 import time
 from pathlib import Path
 from typing import Any, Optional
@@ -160,7 +159,9 @@ def emergency_save(manager: "CheckpointManager", state: Any, step: int,
             import numpy as np
 
             if allow_local is None:
-                allow_local = not os.environ.get("KT_POD_NAME")
+                from kubetorch_tpu.config import env_str
+
+                allow_local = not env_str("KT_POD_NAME")
             if not allow_local and not DataStoreClient.default().store_url:
                 raise StoreUnconfigured(
                     f"emergency push of {store_key!r} needs a remote data "

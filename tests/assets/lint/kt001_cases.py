@@ -1,0 +1,47 @@
+"""KT001 fixtures: blocking calls inside async def. Never imported —
+parsed by the lint engine in tests/test_lint.py."""
+import asyncio
+import subprocess
+import time
+from time import sleep
+
+import httpx
+
+
+async def tp_sleep():
+    time.sleep(1)  # TP: blocks the loop
+
+
+async def tp_sleep_from_import():
+    sleep(1)  # TP: resolved through `from time import sleep`
+
+
+async def tp_httpx():
+    return httpx.get("http://x")  # TP: sync client on the loop
+
+
+async def tp_subprocess():
+    subprocess.run(["ls"])  # TP
+
+
+async def tp_open():
+    with open("/tmp/f") as fh:  # TP: blocking file read
+        return fh.read()
+
+
+async def tp_suppressed():
+    time.sleep(1)  # ktlint: disable=KT001 -- fixture: deliberate
+
+
+async def fp_asyncio_sleep():
+    await asyncio.sleep(1)  # FP shape: async sleep is fine
+
+
+async def fp_executor_reference():
+    loop = asyncio.get_running_loop()
+    # FP shape: time.sleep is an argument, not a call — runs off-loop
+    await loop.run_in_executor(None, time.sleep, 1)
+
+
+def fp_sync_function():
+    time.sleep(1)  # FP shape: not an async def

@@ -1,0 +1,57 @@
+"""KT002 fixtures: thread spawns / executor submits dropping contextvars."""
+import contextvars
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from contextvars import copy_context
+from functools import partial
+
+
+def work():
+    pass
+
+
+def tp_bare_thread():
+    threading.Thread(target=work).start()  # TP: empty context
+
+
+def tp_executor_submit():
+    executor = ThreadPoolExecutor(max_workers=2)
+    executor.submit(work)  # TP: pool thread loses context
+
+
+def tp_suppressed():
+    # ktlint: disable=KT002 -- fixture: deliberately context-free
+    threading.Thread(target=work).start()
+
+
+def fp_copy_context_direct():
+    # FP shape: explicit copy_context().run target
+    threading.Thread(target=contextvars.copy_context().run,
+                     args=(work,)).start()
+
+
+def fp_ctx_alias():
+    # FP shape: ctx.run aliasing through a local
+    ctx = copy_context()
+    threading.Thread(target=ctx.run, args=(work,)).start()
+
+
+def fp_ctx_lambda():
+    # FP shape: lambda wrapper around ctx.run (device_transfer idiom)
+    ctx = copy_context()
+    threading.Thread(target=lambda: ctx.run(work)).start()
+
+
+def fp_partial_ctx():
+    ctx = copy_context()
+    threading.Thread(target=partial(ctx.run, work)).start()
+
+
+def fp_non_executor_submit(channel):
+    # FP shape: CallChannel.submit is a wire protocol, not an executor
+    return channel.submit(1, method="step")
+
+
+def fp_executor_ctx_submit():
+    executor = ThreadPoolExecutor(max_workers=2)
+    executor.submit(contextvars.copy_context().run, work)

@@ -1,0 +1,36 @@
+"""KT003 fixtures: KT_* env reads outside the typed registry."""
+import os
+
+INDIRECT_ENV = "KT_INDIRECT_KNOB"
+
+
+def tp_environ_get():
+    return os.environ.get("KT_FOO")  # TP
+
+
+def tp_getenv():
+    return os.getenv("KT_BAR", "x")  # TP
+
+
+def tp_subscript():
+    return os.environ["KT_BAZ"]  # TP
+
+
+def tp_indirect_constant():
+    return os.environ.get(INDIRECT_ENV)  # TP: resolved module constant
+
+
+def tp_contains():
+    return "KT_FOO" in os.environ  # TP: config-shaped membership test
+
+
+def tp_suppressed():
+    return os.environ.get("KT_FOO")  # ktlint: disable=KT003 -- fixture
+
+
+def fp_non_kt_read():
+    return os.environ.get("HOME")  # FP shape: not a KT_* knob
+
+
+def fp_write():
+    os.environ["KT_FOO"] = "1"  # FP shape: a write, not a read

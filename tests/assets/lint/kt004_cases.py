@@ -1,0 +1,69 @@
+"""KT004 fixtures: silently swallowed exceptions."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def tp_silent_pass():
+    try:
+        risky()
+    except Exception:
+        pass  # TP: swallowed
+
+
+def tp_bare_except():
+    try:
+        risky()
+    except:  # noqa: E722  TP: bare except
+        pass
+
+
+def tp_suppressed():
+    try:
+        risky()
+    # ktlint: disable=KT004 -- fixture: deliberate swallow with a reason
+    except Exception:
+        pass
+
+
+def fp_narrow_type():
+    try:
+        risky()
+    except ValueError:
+        pass  # FP shape: a narrow except is a decision, not a swallow
+
+
+def fp_logged():
+    try:
+        risky()
+    except Exception as exc:
+        logger.debug("risky failed: %r", exc)  # FP shape: logged
+
+
+def fp_counted(metrics):
+    try:
+        risky()
+    except Exception:
+        metrics.inc("errors")  # FP shape: counted
+
+
+def fp_reraise():
+    try:
+        risky()
+    except Exception:
+        raise  # FP shape: re-raised
+
+
+def fp_fallback_work():
+    try:
+        return risky()
+    except Exception:
+        return compute_fallback()  # FP shape: real fallback work
+
+
+def risky():
+    raise RuntimeError
+
+
+def compute_fallback():
+    return 0

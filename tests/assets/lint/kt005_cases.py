@@ -1,0 +1,30 @@
+"""KT005 fixtures: writes to lock-guarded attributes outside the lock."""
+import threading
+
+
+class TpUnlockedWrite:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0  # FP shape: __init__ predates sharing
+
+    def guarded(self):
+        with self._lock:
+            self.count += 1  # declares `count` shared
+
+    def tp_unguarded(self):
+        self.count = 0  # TP: same field, no lock
+
+    def fp_reset_locked(self):
+        # FP shape: *_locked naming convention = caller holds the lock
+        self.count = 0
+
+    def fp_other_field(self):
+        self.unrelated = 1  # FP shape: never written under the lock
+
+
+class FpNoLock:
+    def __init__(self):
+        self.count = 0
+
+    def bump(self):
+        self.count += 1  # FP shape: class has no lock at all

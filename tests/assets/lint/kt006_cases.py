@@ -1,0 +1,84 @@
+"""KT006 fixtures: JAX tracer hazards inside jitted functions."""
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def tp_branch_on_traced(x):
+    if x > 0:  # TP: traced bool conversion
+        return x
+    return -x
+
+
+@jax.jit
+def tp_item(x):
+    return x.sum().item()  # TP: host sync
+
+
+@jax.jit
+def tp_float_cast(x):
+    return float(x)  # TP: concretization
+
+
+@jax.jit
+def tp_np_materialize(x):
+    return np.asarray(x)  # TP
+
+
+@jax.jit
+def tp_device_get(x):
+    return jax.device_get(x)  # TP
+
+
+@jax.jit
+def tp_suppressed(x):
+    if x > 0:  # ktlint: disable=KT006 -- fixture
+        return x
+    return -x
+
+
+@jax.jit
+def fp_shape_branch(x):
+    if x.ndim == 2:  # FP shape: shapes are static under tracing
+        return x
+    if len(x.shape) > 3:
+        return x
+    return x
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def fp_static_argname(x, mode):
+    if mode == "fast":  # FP shape: declared static
+        return x
+    return x * 2
+
+
+@jax.jit
+def fp_none_check(x, bias=None):
+    if bias is not None:  # FP shape: identity check is trace-static
+        return x + bias
+    return x
+
+
+def fp_not_jitted(x):
+    if x > 0:  # FP shape: plain python function
+        return float(x)
+    return -x
+
+
+def _impl(x, *, normalize):
+    if normalize:  # FP shape: partial-bound kwarg is static under jit
+        return x / 2
+    return x
+
+
+_jitted = jax.jit(partial(_impl, normalize=True))
+
+
+def _method_impl(x):
+    return x.item()  # TP: jitted via jax.jit(_method_impl) below
+
+
+_fn = jax.jit(_method_impl)

@@ -1,0 +1,135 @@
+"""Typed KT_* knob registry (kubetorch_tpu/config.py).
+
+Covers the accessor semantics every migrated call site now depends on —
+unset/empty → declared default, malformed → ConfigError naming the
+variable — plus the two satellite bug sites (retry.py attempts and
+resilience/liveness.py heartbeat knobs) that used to crash with an
+opaque ValueError or silently fall back.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubetorch_tpu.config import (
+    KNOBS,
+    ConfigError,
+    env_bool,
+    env_float,
+    env_int,
+    env_json,
+    env_path,
+    env_set,
+    env_str,
+    env_value,
+    iter_knobs,
+)
+
+pytestmark = pytest.mark.level("unit")
+
+
+def test_every_knob_is_documented_and_typed():
+    assert len(KNOBS) >= 90
+    for knob in iter_knobs():
+        assert knob.name.startswith("KT_")
+        assert knob.type in ("str", "int", "float", "bool", "json")
+        assert knob.doc and len(knob.doc) >= 10, knob.name
+        assert knob.section
+
+
+def test_defaults_when_unset(monkeypatch):
+    monkeypatch.delenv("KT_CHANNEL_DEPTH", raising=False)
+    monkeypatch.delenv("KT_HEARTBEAT_S", raising=False)
+    assert env_int("KT_CHANNEL_DEPTH") == 2
+    assert env_float("KT_HEARTBEAT_S") == 5.0
+    assert env_str("KT_CONTROLLER_URL") is None or isinstance(
+        env_str("KT_CONTROLLER_URL"), str)
+
+
+def test_empty_string_means_default(monkeypatch):
+    monkeypatch.setenv("KT_CHANNEL_DEPTH", "")
+    assert env_int("KT_CHANNEL_DEPTH") == 2
+    assert not env_set("KT_CHANNEL_DEPTH")
+
+
+def test_typed_parsing(monkeypatch):
+    monkeypatch.setenv("KT_CHANNEL_DEPTH", " 8 ")
+    monkeypatch.setenv("KT_HEARTBEAT_S", "0.25")
+    monkeypatch.setenv("KT_WIRE_DELTA", "Yes")
+    monkeypatch.setenv("KT_AUTO_RESTART", "0")
+    monkeypatch.setenv("KT_INIT_ARGS", '[[1, 2], {"a": 3}]')
+    assert env_int("KT_CHANNEL_DEPTH") == 8
+    assert env_float("KT_HEARTBEAT_S") == 0.25
+    assert env_bool("KT_WIRE_DELTA") is True
+    assert env_bool("KT_AUTO_RESTART") is False
+    assert env_json("KT_INIT_ARGS") == [[1, 2], {"a": 3}]
+    assert env_value("KT_CHANNEL_DEPTH") == 8
+
+
+def test_env_path_expands_user(monkeypatch):
+    monkeypatch.delenv("KT_PEER_CACHE", raising=False)
+    p = env_path("KT_PEER_CACHE")
+    assert "~" not in str(p) and str(p).endswith("peer_cache")
+
+
+@pytest.mark.parametrize("name,value,accessor", [
+    ("KT_CHANNEL_DEPTH", "two", env_int),
+    ("KT_HEARTBEAT_S", "0,5", env_float),
+    ("KT_WIRE_DELTA", "maybe", env_bool),
+    ("KT_INIT_ARGS", "{not json", env_json),
+])
+def test_malformed_value_raises_naming_the_variable(monkeypatch, name,
+                                                    value, accessor):
+    monkeypatch.setenv(name, value)
+    with pytest.raises(ConfigError) as exc:
+        accessor(name)
+    msg = str(exc.value)
+    assert name in msg, "error must name the variable"
+    assert value in msg or "JSON" in msg
+
+
+def test_unregistered_name_raises():
+    with pytest.raises(ConfigError, match="KT_NOT_A_KNOB"):
+        env_str("KT_NOT_A_KNOB")
+
+
+# --------------------------------------------------- satellite bug sites
+def test_retry_attempts_clear_error_on_garbage(monkeypatch):
+    """retry.attempts(): malformed KT_RETRY_ATTEMPTS used to silently use
+    the default; now it names the variable."""
+    from kubetorch_tpu import retry
+
+    monkeypatch.setenv("KT_RETRY_ATTEMPTS", "5")
+    assert retry.attempts() == 5
+    monkeypatch.setenv("KT_RETRY_ATTEMPTS", "three")
+    with pytest.raises(ConfigError, match="KT_RETRY_ATTEMPTS"):
+        retry.attempts()
+    monkeypatch.delenv("KT_RETRY_ATTEMPTS")
+    assert retry.attempts() == 3
+
+
+def test_liveness_knobs_clear_error_on_garbage(monkeypatch):
+    """liveness heartbeat knobs: an int()/float() of garbage used to be
+    an opaque ValueError from inside the heartbeat machinery."""
+    from kubetorch_tpu.resilience import liveness
+
+    monkeypatch.setenv("KT_HEARTBEAT_S", "0.5")
+    assert liveness.heartbeat_interval() == 0.5
+    monkeypatch.setenv("KT_HEARTBEAT_S", "half-a-second")
+    with pytest.raises(ConfigError, match="KT_HEARTBEAT_S"):
+        liveness.heartbeat_interval()
+    monkeypatch.setenv("KT_DEAD_AFTER_MISSES", "2.5")
+    with pytest.raises(ConfigError, match="KT_DEAD_AFTER_MISSES"):
+        liveness.default_dead_after_misses()
+    monkeypatch.setenv("KT_DEAD_AFTER_MISSES", "4")
+    assert liveness.default_dead_after_misses() == 4
+
+
+def test_clamps_still_apply(monkeypatch):
+    from kubetorch_tpu.resilience import liveness
+    from kubetorch_tpu.serving.channel import default_depth
+
+    monkeypatch.setenv("KT_HEARTBEAT_S", "0.000001")
+    assert liveness.heartbeat_interval() == 0.01
+    monkeypatch.setenv("KT_CHANNEL_DEPTH", "0")
+    assert default_depth() == 1

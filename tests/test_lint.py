@@ -1,0 +1,286 @@
+"""ktlint: the project-invariant static-analysis gate (tier-1).
+
+Three layers:
+
+1. **Analyzer unit tests** over fixture snippets in ``tests/assets/lint/``
+   — true positives, suppression comments, baseline matching, and the
+   known false-positive shapes each rule must NOT flag.
+2. **Regression canary** — textually re-introducing the PR-4 placement
+   thread bug (bare ``Thread(target=...)`` in ``device_transfer.py``)
+   must make KT002 fire.
+3. **The gate itself** — all six rules over the full ``kubetorch_tpu``
+   package finish in under 10 s with zero non-baselined findings.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from kubetorch_tpu.analysis import baseline as baseline_mod
+from kubetorch_tpu.analysis.engine import (
+    FileContext,
+    LintConfig,
+    load_lint_config,
+    parse_suppressions,
+    parse_toml_section,
+    run_lint,
+)
+from kubetorch_tpu.analysis.rules import ALL_RULES, RULE_DOCS
+
+REPO = Path(__file__).resolve().parent.parent
+ASSETS = Path(__file__).resolve().parent / "assets" / "lint"
+
+pytestmark = pytest.mark.level("unit")
+
+
+def lint_path(path: Path, **config_kw) -> list:
+    """Run all rules over one file/dir with a fixture-friendly config
+    (KT004 everywhere, no baseline)."""
+    cfg = LintConfig(root=REPO, paths=[str(path)], kt004_paths=[],
+                     baseline="_no_such_baseline.json", **config_kw)
+    return run_lint(cfg, apply_baseline=False).findings
+
+
+def by_rule(findings, code):
+    return [f for f in findings if f.rule == code]
+
+
+def names_on_lines(path: Path, findings):
+    """Map each finding to the enclosing fixture function name."""
+    src = path.read_text().splitlines()
+    out = set()
+    for f in findings:
+        for i in range(f.line - 1, -1, -1):
+            line = src[i]
+            if line.startswith("def ") or line.startswith("async def "):
+                out.add(line.split("(")[0].split()[-1])
+                break
+            if line.startswith("    def ") or line.startswith(
+                    "    async def "):
+                out.add(line.strip().split("(")[0].split()[-1])
+                break
+    return out
+
+
+# ---------------------------------------------------------------- fixtures
+@pytest.mark.parametrize("fixture,rule,expected_tp,forbidden_fp", [
+    ("kt001_cases.py", "KT001",
+     {"tp_sleep", "tp_sleep_from_import", "tp_httpx", "tp_subprocess",
+      "tp_open"},
+     {"fp_asyncio_sleep", "fp_executor_reference", "fp_sync_function",
+      "tp_suppressed"}),
+    ("kt002_cases.py", "KT002",
+     {"tp_bare_thread", "tp_executor_submit"},
+     {"fp_copy_context_direct", "fp_ctx_alias", "fp_ctx_lambda",
+      "fp_partial_ctx", "fp_non_executor_submit", "fp_executor_ctx_submit",
+      "tp_suppressed"}),
+    ("kt003_cases.py", "KT003",
+     {"tp_environ_get", "tp_getenv", "tp_subscript",
+      "tp_indirect_constant", "tp_contains"},
+     {"fp_non_kt_read", "fp_write", "tp_suppressed"}),
+    ("kt004_cases.py", "KT004",
+     {"tp_silent_pass", "tp_bare_except"},
+     {"fp_narrow_type", "fp_logged", "fp_counted", "fp_reraise",
+      "fp_fallback_work", "tp_suppressed"}),
+    ("kt005_cases.py", "KT005",
+     {"tp_unguarded"},
+     {"fp_reset_locked", "fp_other_field", "bump", "__init__"}),
+    ("kt006_cases.py", "KT006",
+     {"tp_branch_on_traced", "tp_item", "tp_float_cast",
+      "tp_np_materialize", "tp_device_get", "_method_impl"},
+     {"fp_shape_branch", "fp_static_argname", "fp_none_check",
+      "fp_not_jitted", "_impl", "tp_suppressed"}),
+])
+def test_rule_fixtures(fixture, rule, expected_tp, forbidden_fp):
+    path = ASSETS / fixture
+    findings = by_rule(lint_path(path), rule)
+    hit = names_on_lines(path, findings)
+    missing = expected_tp - hit
+    assert not missing, f"{rule} missed true positives: {missing}"
+    false_pos = hit & forbidden_fp
+    assert not false_pos, f"{rule} false positives: {false_pos}"
+
+
+def test_fixtures_trigger_only_their_rule_where_sensible():
+    # kt002 fixture must not trip KT003/KT006 etc. (cross-noise check)
+    findings = lint_path(ASSETS / "kt002_cases.py")
+    assert {f.rule for f in findings} == {"KT002"}
+
+
+# ------------------------------------------------------------ suppressions
+def test_suppression_same_line_and_preceding_comment():
+    per_line, whole = parse_suppressions([
+        "x = 1  # ktlint: disable=KT001",
+        "# ktlint: disable=KT002,KT003 -- reason here",
+        "y = 2",
+    ])
+    assert per_line[1] == {"KT001"}
+    assert per_line[3] == {"KT002", "KT003"}  # standalone → next line
+    assert not whole
+
+
+def test_suppression_whole_file(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text("# ktlint: disable-file=KT003\n"
+                 "import os\n"
+                 "V = os.environ.get('KT_FOO')\n")
+    assert lint_path(f) == []
+
+
+def test_unsuppressed_twin_still_fires(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text("import os\n"
+                 "A = os.environ.get('KT_FOO')  # ktlint: disable=KT003\n"
+                 "B = os.environ.get('KT_FOO')\n")
+    findings = lint_path(f)
+    assert len(findings) == 1 and findings[0].line == 3
+
+
+# --------------------------------------------------------------- baseline
+def test_baseline_roundtrip_and_count_semantics(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text("import os\n"
+                   "A = os.environ.get('KT_FOO')\n"
+                   "B = os.environ.get('KT_FOO')\n")
+    findings = lint_path(src)
+    assert len(findings) == 2
+    base_path = tmp_path / "base.json"
+    baseline_mod.dump(findings[:1], base_path)          # grandfather ONE
+    base = baseline_mod.load(base_path)
+    new, matched = baseline_mod.split(findings, base)
+    assert len(matched) == 1 and len(new) == 1          # the twin still fires
+
+
+def test_baseline_survives_line_shifts(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text("import os\nA = os.environ.get('KT_FOO')\n")
+    base_path = tmp_path / "base.json"
+    baseline_mod.dump(lint_path(src), base_path)
+    # shift the offending line down 5 lines: baseline still matches
+    src.write_text("import os\n" + "# pad\n" * 5
+                   + "A = os.environ.get('KT_FOO')\n")
+    new, matched = baseline_mod.split(lint_path(src),
+                                      baseline_mod.load(base_path))
+    assert new == [] and len(matched) == 1
+
+
+# ------------------------------------------------------- pyproject config
+def test_toml_section_parser():
+    text = (
+        "[tool.other]\nname = \"x\"\n\n"
+        "[tool.ktlint]\n"
+        "baseline = \".ktlint-baseline.json\"  # comment\n"
+        "enable = []\n"
+        "disable = [\"KT005\"]\n"
+        "kt004_paths = [\n    \"a/b\",\n    \"c/d\",\n]\n"
+        "flag = true\n"
+        "[tool.after]\nz = 1\n")
+    table = parse_toml_section(text, "tool.ktlint")
+    assert table["baseline"] == ".ktlint-baseline.json"
+    assert table["enable"] == []
+    assert table["disable"] == ["KT005"]
+    assert table["kt004_paths"] == ["a/b", "c/d"]
+    assert table["flag"] is True
+
+
+def test_repo_config_loads_and_disable_works():
+    cfg = load_lint_config(REPO)
+    assert cfg.baseline == ".ktlint-baseline.json"
+    assert "kubetorch_tpu/config.py" in cfg.kt003_exempt
+    assert cfg.rule_enabled("KT001")
+    cfg.disable = ["KT003"]
+    assert not cfg.rule_enabled("KT003")
+
+
+# ------------------------------------------------------------ PR-4 canary
+def test_kt002_canary_reintroduced_placement_bug(tmp_path):
+    """Deliberately re-introducing the PR-4 bug shape — a bare
+    ``Thread(target=...)`` for the placement pipeline thread in
+    ``device_transfer.py`` — must make KT002 fail the suite."""
+    real = REPO / "kubetorch_tpu" / "data_store" / "device_transfer.py"
+    source = real.read_text()
+    fixed = "target=lambda: ctx.run(self._run),"
+    assert fixed in source, (
+        "device_transfer.py no longer contains the copy_context placement "
+        "thread — update this canary alongside the code")
+    # the real file is clean...
+    assert by_rule(lint_path(real), "KT002") == []
+    # ...and the regressed copy is not
+    broken = tmp_path / "device_transfer_regressed.py"
+    broken.write_text(source.replace(fixed, "target=self._run,"))
+    findings = by_rule(lint_path(broken), "KT002")
+    assert findings, "KT002 must catch the PR-4 placement-thread bug shape"
+
+
+# ------------------------------------------------------------------ gate
+def test_gate_package_clean_under_10s():
+    t0 = time.perf_counter()
+    cfg = load_lint_config(REPO)
+    result = run_lint(cfg)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 10.0, f"lint took {elapsed:.1f}s (budget 10s)"
+    assert not result.errors, result.errors
+    assert result.findings == [], (
+        "non-baselined lint findings:\n"
+        + "\n".join(str(f) for f in result.findings))
+    assert len({r.code for r in ALL_RULES}) == 6  # all six rules ran
+
+
+def test_kt003_strictly_clean_in_control_plane_dirs():
+    """Acceptance: zero KT_* env reads outside config.py in serving/,
+    controller/, observability/ — clean WITHOUT baseline entries."""
+    cfg = load_lint_config(REPO)
+    result = run_lint(cfg, paths=["kubetorch_tpu/serving",
+                                  "kubetorch_tpu/controller",
+                                  "kubetorch_tpu/observability"],
+                      apply_baseline=False)
+    kt003 = by_rule(result.findings, "KT003")
+    assert kt003 == [], "\n".join(str(f) for f in kt003)
+
+
+def test_rule_docs_cover_all_rules():
+    assert set(RULE_DOCS) == {"KT001", "KT002", "KT003", "KT004",
+                              "KT005", "KT006"}
+    for code, (name, doc) in RULE_DOCS.items():
+        assert name and len(doc) > 40, f"{code} needs a real doc string"
+
+
+# ------------------------------------------------------------------- CLI
+def test_cli_json_output(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\nX = os.environ.get('KT_FOO')\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubetorch_tpu.cli", "lint", "--json",
+         "--no-baseline", str(bad)],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 1, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"][0]["rule"] == "KT003"
+    assert payload["baselined"] == 0
+
+
+def test_cli_list_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubetorch_tpu.cli", "lint", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 0
+    for code in ("KT001", "KT002", "KT003", "KT004", "KT005", "KT006"):
+        assert code in proc.stdout
+
+
+# ------------------------------------------------------------- doc drift
+def test_configuration_docs_not_drifted():
+    """docs/configuration.md is generated from the knob registry; a
+    registry edit without `ktpu lint --gen-config-docs` fails here."""
+    from kubetorch_tpu.analysis.docgen import render_config_docs
+
+    on_disk = (REPO / "docs" / "configuration.md").read_text()
+    assert on_disk == render_config_docs(), (
+        "docs/configuration.md is stale — regenerate with "
+        "`ktpu lint --gen-config-docs`")
