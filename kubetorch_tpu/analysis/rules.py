@@ -696,8 +696,66 @@ class KT006TracerHazards(Rule):
         return hazardous
 
 
+# --------------------------------------------------------------------------
+# KT007 — httpx/aiohttp calls without an explicit timeout
+# --------------------------------------------------------------------------
+
+# module-level request functions: each opens its own connection, so a
+# missing timeout hangs THIS call forever on a stuck peer
+_KT007_REQUEST_FUNCS = {
+    "httpx.get", "httpx.post", "httpx.put", "httpx.patch", "httpx.delete",
+    "httpx.head", "httpx.options", "httpx.request", "httpx.stream",
+}
+# client constructors: the configured timeout governs every request made
+# through the client, so an unconfigured constructor is the single point
+# where the whole pool goes unbounded
+_KT007_CLIENT_FACTORIES = {
+    "httpx.Client", "httpx.AsyncClient", "aiohttp.ClientSession",
+}
+
+
+class KT007HttpTimeout(Rule):
+    code = "KT007"
+    name = "http-call-without-timeout"
+    doc = ("A module-level httpx request (`httpx.get/post/...`) or an "
+           "HTTP client construction (`httpx.Client`, "
+           "`httpx.AsyncClient`, `aiohttp.ClientSession`) without an "
+           "explicit `timeout=` waits forever on a hung peer — a hung "
+           "controller can hold a pod's SIGTERM drain open past "
+           "`KT_DRAIN_TIMEOUT` exactly this way (found via the slow-pod "
+           "chaos kind). Pass `timeout=`; for long-lived WebSocket "
+           "sessions use `aiohttp.ClientTimeout(total=None, "
+           "sock_connect=...)` so the dial is bounded but the stream "
+           "is not. Method calls on an already-configured client "
+           "(`client.get(...)`) are exempt — their client's timeout "
+           "governs them.")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        imports = ctx.import_map()
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            qual = resolve_qualname(node.func, imports)
+            if qual not in _KT007_REQUEST_FUNCS \
+                    and qual not in _KT007_CLIENT_FACTORIES:
+                continue
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                continue
+            if any(kw.arg is None for kw in node.keywords):
+                # a **kwargs spread may carry the timeout — FP-safe skip
+                continue
+            what = ("request" if qual in _KT007_REQUEST_FUNCS
+                    else "client construction")
+            yield ctx.finding(
+                self.code, node,
+                f"`{qual}(...)` {what} without an explicit `timeout=` "
+                f"hangs forever on a stuck peer — pass one (aiohttp "
+                f"long-lived WS: `ClientTimeout(total=None, "
+                f"sock_connect=...)`)")
+
+
 ALL_RULES = [KT001BlockingInAsync, KT002ThreadContext,
              KT003EnvOutsideRegistry, KT004SilentExcept,
-             KT005LockDiscipline, KT006TracerHazards]
+             KT005LockDiscipline, KT006TracerHazards, KT007HttpTimeout]
 
 RULE_DOCS = {cls.code: (cls.name, cls.doc) for cls in ALL_RULES}

@@ -1,4 +1,6 @@
-"""Recovery-path microbenchmarks: detect → emergency checkpoint → restore.
+"""Recovery-path microbenchmarks: detect → emergency checkpoint → restore
+— plus the serving-path reliability legs (ISSUE 9): replay recovery and
+admission-control goodput.
 
 The recovery pipeline has a wall-clock budget (a preempted spot slice is
 gone in seconds; a stalled gang burns the whole fleet's time), so each
@@ -12,7 +14,16 @@ leg is measured, not asserted:
   (the restarted gang's first act);
 - ``recovery_total_s``     — the sum: preemption to training-resumed,
   excluding backend reprovision time (cluster-dependent; the fake-K8s
-  e2e in tests/test_resilience.py covers the control flow).
+  e2e in tests/test_resilience.py covers the control flow);
+- ``replay_recovery_s``    — mid-stream partition to stream-resumed
+  through the real :class:`~kubetorch_tpu.serving.replay.ChannelSession`
+  retention/replay path (re-attach + frames replayed from the cursor);
+- ``admission_shed_goodput_ratio`` — completed-call goodput of
+  429-shedding (computed ``Retry-After`` via the server's real
+  :func:`~kubetorch_tpu.serving.replay.retry_after_estimate`) over the
+  no-admission baseline that collapses into deadline timeouts, in a
+  deterministic virtual-time overload model at 2× queue capacity (the
+  live-system twin is tests/test_call_reliability.py's overload test).
 
 ``KT_CHAOS`` (e.g. ``kill-worker=1,seed=42``) picks which simulated
 worker dies — the same seeded policy the tests use, so a bench run and a
@@ -66,6 +77,129 @@ def _simulate_detect(dryrun: bool, chaos) -> Dict[str, float]:
             "recovery_dead_after_misses": dead_after}
 
 
+def _simulate_replay(dryrun: bool) -> Dict[str, float]:
+    """Drive the real server-side replay path without a socket: stream
+    frames into a ChannelSession, sever the sink mid-stream (the chaos
+    ``partition`` shape), re-attach, and measure partition → resumed.
+    Asserts the resumed delivery is byte-identical from the cursor."""
+    import asyncio
+
+    from kubetorch_tpu.serving import frames as frames_mod
+    from kubetorch_tpu.serving.replay import ChannelSession
+
+    n_frames = 64 if dryrun else 512
+    cut_at = n_frames // 3
+
+    class Sink:
+        closed = False
+
+        def __init__(self):
+            self.frames = []
+
+        async def send_bytes(self, data):
+            self.frames.append(frames_mod.unpack_envelope(data))
+            # yield like a real socket write does — without this the
+            # whole stream delivers in one scheduling slice and the
+            # "partition" would land after the end frame
+            import asyncio as _asyncio
+
+            await _asyncio.sleep(0)
+
+    async def main() -> Dict[str, float]:
+        async def execute(session, entry, header, payload, t_recv):
+            for i in range(n_frames):
+                await session.send(entry, {"kind": "item", "ser": "json"},
+                                   b"tok-%06d" % i)
+            await session.send(entry, {"kind": "end"})
+
+        session = ChannelSession("bench-epoch", execute)
+        first = Sink()
+        session.attach(first)
+        await session.submit({"cid": 1, "kind": "call"}, b"", 0.0)
+        while len(first.frames) < cut_at:  # stream in flight
+            await asyncio.sleep(0)
+        session.detach(first)              # partition mid-stream
+        # wait for the (detached) execution to finish retaining frames
+        while not session.calls[1].done:
+            await asyncio.sleep(0)
+        cursor = len(first.frames)         # client acked this many
+        assert cursor < n_frames, "partition landed after the stream end"
+        second = Sink()
+        t0 = time.perf_counter()
+        session.attach(second)             # reconnect
+        await session.submit({"cid": 1, "kind": "call", "replay": True,
+                              "resume_from": cursor}, b"", 0.0)
+        recovery_s = time.perf_counter() - t0
+        # byte-identical resume: cursor..n, then the terminal — no gap,
+        # no duplicate
+        bodies = [b for h, b in second.frames if h["kind"] == "item"]
+        assert bodies == [b"tok-%06d" % i for i in range(cursor, n_frames)]
+        assert second.frames[-1][0]["kind"] == "end"
+        session.expire()
+        return {"replay_recovery_s": round(recovery_s, 5),
+                "replay_frames_resent": len(second.frames)}
+
+    return asyncio.run(main())
+
+
+def _simulate_admission(dryrun: bool) -> Dict[str, float]:
+    """Virtual-time overload model at 2× queue capacity, using the
+    server's real Retry-After arithmetic. Baseline: every call queues on
+    one serial executor and dies at the queue head when its deadline
+    passes. Shedding: calls past the depth bound are rejected instantly
+    with ``retry_after_estimate`` and re-arrive then — each retry with a
+    fresh deadline, exactly like retry.py's Retry-After handling."""
+    import heapq
+
+    from kubetorch_tpu.serving.replay import retry_after_estimate
+
+    exec_s = 0.05
+    deadline_s = 4 * exec_s          # 2× capacity: 8 arrivals, 4 fit
+    n = 8 if dryrun else 64
+    max_depth = 2
+
+    # --- baseline: unbounded FIFO, deadline enforced at the queue head
+    free_at, base_done = 0.0, 0
+    for k in range(n):               # all arrive at t=0, in order
+        start = free_at
+        if start <= deadline_s:      # within THIS call's deadline
+            base_done += 1
+            free_at = start + exec_s
+        # else: rejected at the queue head — the slot is not consumed,
+        # but the call is dead (no retry: nothing told it when to return)
+
+    # --- shedding: bounded queue + Retry-After retries
+    shed_done, shed_events = 0, 0
+    queue_free_at = [0.0]            # one serial executor
+    heap = [(0.0, k) for k in range(n)]
+    heapq.heapify(heap)
+    attempts = {k: 0 for k in range(n)}
+    while heap:
+        t, k = heapq.heappop(heap)
+        depth = 1 if queue_free_at[0] > t else 0
+        est_wait = max(0.0, queue_free_at[0] - t)
+        if depth >= max_depth or est_wait > deadline_s:
+            shed_events += 1
+            attempts[k] += 1
+            if attempts[k] > 16:
+                continue             # give up (never hit in practice)
+            retry_after = retry_after_estimate(
+                depth + 1, max_depth, exec_s, cap_s=30.0)
+            heapq.heappush(heap, (t + retry_after, k))
+            continue
+        start = max(t, queue_free_at[0])
+        if start - t > deadline_s:   # queue-head deadline check
+            continue
+        queue_free_at[0] = start + exec_s
+        shed_done += 1
+
+    ratio = shed_done / max(1, base_done)
+    return {"admission_baseline_goodput": base_done,
+            "admission_shed_goodput": shed_done,
+            "admission_shed_events": shed_events,
+            "admission_shed_goodput_ratio": round(ratio, 3)}
+
+
 def _toy_state(dryrun: bool):
     import jax.numpy as jnp
     import numpy as np
@@ -88,6 +222,8 @@ def run(dryrun: bool = False) -> Dict[str, float]:
         seed=0, kill_worker=1.0, max_events=1)
     out: Dict[str, float] = {}
     out.update(_simulate_detect(dryrun, chaos))
+    out.update(_simulate_replay(dryrun))
+    out.update(_simulate_admission(dryrun))
 
     base = "/dev/shm" if os.path.isdir("/dev/shm") else None
     tmp = Path(tempfile.mkdtemp(prefix="ktpu-resil-", dir=base))

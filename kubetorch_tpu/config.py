@@ -291,6 +291,35 @@ _knob("KT_FANOUT", "int", 50,
 _knob("KT_ACTOR_HOSTS", "str", "",
       "Comma-separated host list for actor meshes.", "serving")
 
+# --- serving reliability (exactly-once replay / deadlines / admission) ------
+_knob("KT_RESULT_RETAIN", "int", 256,
+      "Completed channel-call results retained per channel session for "
+      "idempotent replay after a reconnect (ring; oldest evicted).",
+      "serving-reliability")
+_knob("KT_RESULT_RETAIN_BYTES", "int", 64 << 20,
+      "Byte backstop on one session's retention ring — oldest retained "
+      "results are evicted past it (count bound notwithstanding).",
+      "serving-reliability")
+_knob("KT_RESULT_RETAIN_S", "float", 300.0,
+      "Seconds a detached channel session (its retention ring and any "
+      "still-running calls) survives before the server expires it.",
+      "serving-reliability")
+_knob("KT_REPLAY_ATTEMPTS", "int", 3,
+      "Client reconnect+replay attempts per call before a disconnect "
+      "surfaces as ChannelInterrupted.", "serving-reliability")
+_knob("KT_MAX_QUEUE_DEPTH", "int", 256,
+      "Admission bound on calls queued+executing per pod; excess is shed "
+      "with 429 + Retry-After (0 disables).", "serving-reliability")
+_knob("KT_MAX_QUEUE_DELAY_S", "float", 30.0,
+      "Shed when the estimated queue delay exceeds this; also caps the "
+      "computed Retry-After.", "serving-reliability")
+_knob("KT_CB_FAILURES", "int", 5,
+      "Consecutive transport failures that open the client circuit "
+      "breaker for an endpoint (0 disables).", "serving-reliability")
+_knob("KT_CB_RESET_S", "float", 10.0,
+      "Seconds an open circuit breaker waits before half-opening to let "
+      "one probe call through.", "serving-reliability")
+
 # --- distributed ------------------------------------------------------------
 _knob("KT_POD_IPS", "str", None,
       "Comma-separated pod IPs for the gang (rendezvous).", "distributed")
@@ -339,6 +368,10 @@ _knob("KT_TRACE_SLOW_MS", "float", None,
 _knob("KT_TRACE_PROC", "str", "client",
       "Process label stamped on spans (client/server/worker).",
       "observability")
+_knob("KT_PUSH_TIMEOUT", "float", 5.0,
+      "Bound on background pushes to the controller (trace slow-push, "
+      "heartbeat POST fallback) so a hung controller cannot delay the "
+      "SIGTERM drain.", "observability")
 
 # --- data store -------------------------------------------------------------
 _knob("KT_STORE_PORT", "int", 32310,

@@ -135,6 +135,55 @@ class StoreUnconfigured(DataStoreError):
     ``allow_local=True``."""
 
 
+class DeadlineExceeded(KubetorchError):
+    """The call's propagated deadline passed before (or while) the work
+    ran. Raised server-side at the queue head — expired work is rejected
+    instead of executed uselessly — and between decode chunks of a
+    streamed call; rehydrates client-side as this same type so callers
+    can distinguish "too late" from "failed". ``deadline`` is the unix
+    timestamp that passed."""
+
+    def __init__(self, message: str = "call deadline exceeded",
+                 deadline: Optional[float] = None):
+        super().__init__(message)
+        self.deadline = deadline
+
+
+class ServerOverloaded(KubetorchError):
+    """Admission control shed this call: the pod's queue is past
+    ``KT_MAX_QUEUE_DEPTH`` (or the estimated queue delay is past
+    ``KT_MAX_QUEUE_DELAY_S``). Carries the server-computed
+    ``retry_after`` seconds — a fast, *retryable* rejection (the call
+    never executed), which is the whole point: under overload a typed
+    429 beats a timeout that wasted a queue slot."""
+
+    def __init__(self, message: str = "server overloaded",
+                 retry_after: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
+class ReplayExpired(KubetorchError):
+    """An idempotent replay named a call the server once saw but whose
+    retained result has been evicted (``KT_RESULT_RETAIN`` ring) or
+    whose channel session expired. The server refuses to re-execute —
+    that could double-run non-idempotent work — and the client surfaces
+    :class:`~kubetorch_tpu.serving.channel.ChannelInterrupted` for
+    exactly these calls."""
+
+
+class CircuitOpenError(KubetorchError):
+    """The client-side circuit breaker for this endpoint is open after
+    consecutive failures: calls fail fast instead of piling onto a dead
+    or drowning pod. ``retry_in`` is the cooldown remaining before the
+    breaker half-opens and lets a probe through."""
+
+    def __init__(self, message: str = "circuit breaker open",
+                 retry_in: Optional[float] = None):
+        super().__init__(message)
+        self.retry_in = retry_in
+
+
 class RemoteException(KubetorchError):
     """Fallback wrapper when a remote exception type is unknown client-side.
 
@@ -169,7 +218,8 @@ for _exc in (
     KubetorchError, StartupError, PodTerminatedError, ServiceTimeoutError,
     ImagePullError, PodContainerError, VersionMismatchError, QuorumTimeoutError,
     WorkerMembershipChanged, XlaRuntimeSurfacedError, RsyncError, DataStoreError,
-    StoreUnconfigured, RemoteException,
+    StoreUnconfigured, RemoteException, DeadlineExceeded, ServerOverloaded,
+    ReplayExpired, CircuitOpenError,
 ):
     register_exception(_exc)
 
@@ -199,6 +249,10 @@ def package_exception(exc: BaseException) -> Dict[str, Any]:
         extra = {"added": exc.added, "removed": exc.removed, "current": exc.current}
     if isinstance(exc, PodTerminatedError):
         extra = {"events": exc.events}
+    if isinstance(exc, ServerOverloaded):
+        extra = {"retry_after": exc.retry_after}
+    if isinstance(exc, DeadlineExceeded):
+        extra = {"deadline": exc.deadline}
     return {
         "error": {
             "type": exc_type,
@@ -230,6 +284,11 @@ def rehydrate_exception(payload: Dict[str, Any]) -> BaseException:
             return PodTerminatedError(message, events=extra.get("events"))
         if klass is XlaRuntimeSurfacedError:
             return XlaRuntimeSurfacedError(message, origin=extra.get("origin", ""))
+        if klass is ServerOverloaded:
+            return ServerOverloaded(message,
+                                    retry_after=extra.get("retry_after"))
+        if klass is DeadlineExceeded:
+            return DeadlineExceeded(message, deadline=extra.get("deadline"))
         if klass is not None and issubclass(klass, RemoteException):
             return klass(message, remote_type=name, remote_traceback=tb)
         if klass is not None:

@@ -394,6 +394,74 @@ def serving_samples(labels: Optional[Dict[str, str]] = None):
 
 
 # ------------------------------------------------------------------
+# Call-reliability counters (exactly-once replay + admission control on
+# the serving path, serving/replay.py ↔ PodServer.h_channel/h_call).
+# Process-local like the serving counters; the pod server's /metrics
+# folds them in next to the serving snapshot. replay_* tells operators
+# whether reconnecting clients are being served from retention (hit),
+# re-attached to still-running work (attach), run fresh because the
+# original submission never arrived (fresh), or refused because the
+# retention window expired (expired — the only case that surfaces
+# ChannelInterrupted). admission_* counts shed work: every rejection
+# here is a call that did NOT waste a queue slot.
+_RELI_LOCK = threading.Lock()
+_RELI: Dict[str, float] = {
+    "replay_hits_total": 0.0,
+    "replay_attaches_total": 0.0,
+    "replay_fresh_total": 0.0,
+    "replay_expired_total": 0.0,
+    "replay_frames_resent_total": 0.0,
+    "replay_requeues_total": 0.0,
+    "admission_shed_total": 0.0,
+    "admission_deadline_rejected_total": 0.0,
+    "admission_last_retry_after_seconds": 0.0,
+    "admission_queue_depth": 0.0,
+}
+_RELI_EVENTS = {
+    "hit": "replay_hits_total",
+    "attach": "replay_attaches_total",
+    "fresh": "replay_fresh_total",
+    "expired": "replay_expired_total",
+    "frames_resent": "replay_frames_resent_total",
+    "requeue": "replay_requeues_total",
+    "shed": "admission_shed_total",
+    "deadline_rejected": "admission_deadline_rejected_total",
+}
+_RELI_GAUGES = {
+    "last_retry_after": "admission_last_retry_after_seconds",
+    "queue_depth": "admission_queue_depth",
+}
+
+
+def record_reliability(event: str, value: float = 1.0) -> None:
+    """Bump a replay/admission counter (``hit`` / ``attach`` / ``fresh``
+    / ``expired`` / ``frames_resent`` / ``requeue`` / ``shed`` /
+    ``deadline_rejected``) or set a gauge (``last_retry_after`` /
+    ``queue_depth``)."""
+    with _RELI_LOCK:
+        counter = _RELI_EVENTS.get(event)
+        if counter is not None:
+            _RELI[counter] += value
+            return
+        gauge = _RELI_GAUGES.get(event)
+        if gauge is not None:
+            _RELI[gauge] = value
+
+
+def reliability_metrics() -> Dict[str, float]:
+    """Snapshot of the replay/admission counters."""
+    with _RELI_LOCK:
+        return dict(_RELI)
+
+
+def reliability_samples(labels: Optional[Dict[str, str]] = None):
+    """Exposition samples for the replay/admission counters."""
+    labels = labels or {}
+    for name, value in reliability_metrics().items():
+        yield name, labels, value
+
+
+# ------------------------------------------------------------------
 # Resilience counters (resilience/ subsystem: liveness, preemption, gang
 # restart). Process-local like the rest: the CONTROLLER process records
 # heartbeat/liveness/restart events (its /metrics joins them via

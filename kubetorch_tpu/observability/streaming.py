@@ -101,7 +101,10 @@ def iter_logs(
                   {"service": service, "since": since or None,
                    **filters}.items() if v}
         try:
-            async with aiohttp.ClientSession() as session:
+            # bound the dial; the tail itself is deliberately unbounded
+            async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(
+                        total=None, sock_connect=10.0)) as session:
                 async with session.ws_connect(
                         f"{sink_url.rstrip('/')}/logs/tail",
                         params=params, headers=_auth_headers(),

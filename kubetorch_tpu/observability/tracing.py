@@ -638,7 +638,11 @@ def maybe_push_slow(trace_id: Optional[str], dur_s: float,
         req = urllib.request.Request(
             f"{url.rstrip('/')}/traces", data=data, headers=headers)
         try:
-            urllib.request.urlopen(req, timeout=5.0).read()
+            # KT_PUSH_TIMEOUT bounds the whole background-push family
+            # (this, the heartbeat POST fallback): a hung controller
+            # must not hold sockets open into the SIGTERM drain window
+            urllib.request.urlopen(
+                req, timeout=max(0.1, env_float("KT_PUSH_TIMEOUT"))).read()
             _bump("trace_slow_pushes_total")
         except Exception:  # noqa: BLE001 — capture is best-effort
             _bump("trace_slow_push_errors_total")

@@ -43,7 +43,17 @@ KILL_WORKER = "kill-worker"
 DROP_CONNECTION = "drop-connection"
 INJECT_LATENCY = "inject-latency"
 CORRUPT_HEARTBEAT = "corrupt-heartbeat"
-KINDS = (KILL_WORKER, DROP_CONNECTION, INJECT_LATENCY, CORRUPT_HEARTBEAT)
+# partition: sever the call-channel WebSocket mid-stream (the frame being
+# delivered is lost WITH the connection — the replay path must resume
+# from the client's ack cursor, not token zero). Injected in the channel
+# client's frame-receive path; ``max_events=N`` makes it "N partitions".
+PARTITION = "partition"
+# slow-pod: inject queue delay on the pod server before dispatch —
+# drives admission control (queue-delay shedding) and the drain-timeout
+# bound under a pod that is alive but drowning.
+SLOW_POD = "slow-pod"
+KINDS = (KILL_WORKER, DROP_CONNECTION, INJECT_LATENCY, CORRUPT_HEARTBEAT,
+         PARTITION, SLOW_POD)
 
 
 class ChaosPolicy:
@@ -58,7 +68,8 @@ class ChaosPolicy:
 
     def __init__(self, seed: int = 0, *, kill_worker: float = 0.0,
                  drop_connection: float = 0.0, inject_latency: float = 0.0,
-                 corrupt_heartbeat: float = 0.0, latency_s: float = 0.05,
+                 corrupt_heartbeat: float = 0.0, partition: float = 0.0,
+                 slow_pod: float = 0.0, latency_s: float = 0.05,
                  max_events: Optional[int] = None):
         self.seed = int(seed)
         self.rates: Dict[str, float] = {
@@ -66,6 +77,8 @@ class ChaosPolicy:
             DROP_CONNECTION: float(drop_connection),
             INJECT_LATENCY: float(inject_latency),
             CORRUPT_HEARTBEAT: float(corrupt_heartbeat),
+            PARTITION: float(partition),
+            SLOW_POD: float(slow_pod),
         }
         self.latency_s = float(latency_s)
         self.max_events = max_events

@@ -216,8 +216,14 @@ class PreemptionHandler:
 
             token = env_str("KT_CONTROLLER_TOKEN")
             headers = {"Authorization": f"Bearer {token}"} if token else {}
+            # the report shares the grace window with everything else:
+            # clamp the push bound to a fraction of it so a hung
+            # controller cannot eat the drain budget (KT_PUSH_TIMEOUT
+            # is the steady-state bound; SIGTERM gets the tighter one)
+            report_s = min(env_float("KT_PUSH_TIMEOUT"),
+                           max(0.2, 0.3 * self.grace_s))
             async with aiohttp.ClientSession(
-                    timeout=aiohttp.ClientTimeout(total=2.0),
+                    timeout=aiohttp.ClientTimeout(total=report_s),
                     headers=headers) as session:
                 await session.post(
                     f"{controller_url.rstrip('/')}/heartbeat",

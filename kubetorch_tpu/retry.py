@@ -117,12 +117,15 @@ def parse_retry_after(value: Optional[str]) -> Optional[float]:
 
 
 def raise_if_retryable(resp: "httpx.Response"):
-    """Map gateway-transient responses (502/503/504) to
-    :class:`RetryableStatus`, carrying a parsed ``Retry-After`` when the
-    server sent one (503 load-shedding states exactly when to return).
-    Plain 500s and all 4xx are the caller's problem — a 500 usually
-    means a server bug, not a transient."""
-    if resp.status_code in (502, 503, 504):
+    """Map retryable-by-contract responses to :class:`RetryableStatus`,
+    carrying a parsed ``Retry-After`` when the server sent one (503
+    load-shedding / 429 admission control state exactly when to return).
+    502/503/504 are gateway transients; 429 is the pod's own admission
+    control shedding load — in both cases the request was NOT executed,
+    so re-issuing is safe even for non-idempotent calls. Plain 500s and
+    other 4xx are the caller's problem — a 500 usually means a server
+    bug, not a transient."""
+    if resp.status_code in (429, 502, 503, 504):
         raise RetryableStatus(
             resp.status_code, resp.text,
             retry_after=parse_retry_after(resp.headers.get("Retry-After")))

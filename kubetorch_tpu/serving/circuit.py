@@ -1,0 +1,136 @@
+"""Client-side circuit breaker, shared by the POST path and the channel.
+
+Retries and replay make individual failures survivable; the breaker
+handles the other failure shape — an endpoint that is *down or drowning*,
+where every retry adds load and every caller burns its own timeout
+discovering the same fact. One breaker per endpoint (base URL), shared
+process-wide so the POST client (``http_client``) and every
+``CallChannel`` to the same pod agree on its state:
+
+- **closed** — normal operation; failures are counted, any success
+  resets the count.
+- **open** — after ``KT_CB_FAILURES`` *consecutive* transport failures:
+  calls fail fast with :class:`CircuitOpenError` (carrying the cooldown
+  remaining) instead of dialing a dead pod. The 429 shed path does NOT
+  count — an overloaded-but-alive server answering quickly is exactly
+  the opposite of what the breaker protects against.
+- **half-open** — after ``KT_CB_RESET_S``: ONE probe call is let
+  through; success closes the breaker, failure re-opens it for another
+  cooldown.
+
+Only transport-tier outcomes feed the breaker. A response that carries a
+user exception is a *successful* round trip — the pod is fine, the
+user's code raised — and must close, not open, the circuit.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+from kubetorch_tpu.config import env_float, env_int
+from kubetorch_tpu.exceptions import CircuitOpenError
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+
+class CircuitBreaker:
+    """One endpoint's breaker. Thread-safe; clock-injectable for tests."""
+
+    def __init__(self, endpoint: str = "", failures: int = None,
+                 reset_s: float = None, clock=time.monotonic):
+        self.endpoint = endpoint
+        self.failures = (failures if failures is not None
+                         else env_int("KT_CB_FAILURES"))
+        self.reset_s = (reset_s if reset_s is not None
+                        else env_float("KT_CB_RESET_S"))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._state = CLOSED
+        self._opened_at = 0.0
+        self._probing = False  # a half-open probe is in flight
+        self.opens = 0  # lifetime open transitions (observability)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        if (self._state == OPEN
+                and self._clock() - self._opened_at >= self.reset_s):
+            self._state = HALF_OPEN
+            self._probing = False
+        elif (self._state == HALF_OPEN and self._probing
+                and self._clock() - self._opened_at >= 2 * self.reset_s):
+            # the probe died without recording an outcome (crashed
+            # before the transport layer): presume it lost and let a
+            # new caller probe, else the breaker wedges open forever
+            self._probing = False
+        return self._state
+
+    def check(self) -> None:
+        """Gate one call. Raises :class:`CircuitOpenError` when open (or
+        when half-open and another probe already went through — exactly
+        one caller gets to be the probe)."""
+        if self.failures <= 0:  # disabled
+            return
+        with self._lock:
+            state = self._state_locked()
+            if state == CLOSED:
+                return
+            if state == HALF_OPEN and not self._probing:
+                self._probing = True
+                return
+            retry_in = max(
+                0.0, self.reset_s - (self._clock() - self._opened_at))
+            raise CircuitOpenError(
+                f"circuit breaker open for {self.endpoint or 'endpoint'} "
+                f"after {self._consecutive} consecutive failures",
+                retry_in=retry_in)
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._state = CLOSED
+            self._probing = False
+
+    def record_failure(self) -> None:
+        """One transport-tier failure (connect error, read failure,
+        gateway 5xx after retries). NOT for 429 sheds or rehydrated user
+        exceptions."""
+        if self.failures <= 0:
+            return
+        with self._lock:
+            self._consecutive += 1
+            state = self._state_locked()
+            if state == HALF_OPEN or (state == CLOSED
+                                      and self._consecutive >= self.failures):
+                self._state = OPEN
+                self._opened_at = self._clock()
+                self._probing = False
+                self.opens += 1
+
+
+_breakers: Dict[str, CircuitBreaker] = {}
+_registry_lock = threading.Lock()
+
+
+def breaker_for(base_url: str) -> CircuitBreaker:
+    """The process-wide breaker for one endpoint — ``http_client`` and
+    ``CallChannel`` calls to the same pod share it, so a pod discovered
+    dead on one transport fails fast on the other too."""
+    key = (base_url or "").rstrip("/")
+    with _registry_lock:
+        breaker = _breakers.get(key)
+        if breaker is None:
+            breaker = _breakers[key] = CircuitBreaker(endpoint=key)
+        return breaker
+
+
+def reset_all() -> None:
+    """Forget every breaker (tests; a deploy teardown reuses ports)."""
+    with _registry_lock:
+        _breakers.clear()

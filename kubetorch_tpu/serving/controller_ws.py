@@ -69,7 +69,14 @@ class ControllerWebSocket:
         headers = {"Authorization": f"Bearer {token}"} if token else {}
         while not self._stop.is_set():
             try:
-                async with aiohttp.ClientSession(headers=headers) as session:
+                # explicit bound on the DIAL only (total=None: the WS
+                # itself lives for the pod's whole life): a hung
+                # controller must not pin this task through a SIGTERM
+                # drain (KT007)
+                async with aiohttp.ClientSession(
+                        headers=headers,
+                        timeout=aiohttp.ClientTimeout(
+                            total=None, sock_connect=10.0)) as session:
                     async with session.ws_connect(
                             self.ws_url, heartbeat=30.0) as ws:
                         self.connected = True

@@ -389,7 +389,10 @@ def attach(pod_url: str, port: Optional[int] = None,
         return _attach_pty(pod_url, params, stdin, stdout)
 
     async def run() -> int:
-        async with aiohttp.ClientSession() as session:
+        # dial bounded, session unbounded (an attached pdb is interactive)
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(
+                    total=None, sock_connect=10.0)) as session:
             async with session.ws_connect(
                     f"{pod_url.rstrip('/')}/_debug/ws", params=params,
                     heartbeat=30.0) as ws:
@@ -476,7 +479,10 @@ def _attach_pty(pod_url: str, params: dict, stdin, stdout) -> int:
         _tty.setraw(in_fd)
 
     async def run() -> int:
-        async with aiohttp.ClientSession() as session:
+        # dial bounded, session unbounded (an attached pdb is interactive)
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(
+                    total=None, sock_connect=10.0)) as session:
             async with session.ws_connect(
                     f"{pod_url.rstrip('/')}/_debug/ws", params=params,
                     heartbeat=30.0) as ws:

@@ -18,9 +18,12 @@ from kubetorch_tpu.exceptions import rehydrate_exception
 from kubetorch_tpu.observability import tracing
 from kubetorch_tpu.retry import (
     CONNECT_ERRORS,
+    RetryableStatus,
+    parse_retry_after,
     with_retries,
     with_retries_async,
 )
+from kubetorch_tpu.serving.circuit import breaker_for
 
 _TIMEOUT = httpx.Timeout(connect=10.0, read=None, write=60.0, pool=10.0)
 # Explicit keep-alive pool: every call/retry to the same pod must ride an
@@ -156,22 +159,63 @@ def call_method(
         # re-dial, while re-POSTing after a read failure could
         # double-execute a non-idempotent user function. Reference:
         # rsync_client.py:41 retry discipline, applied to the call path
-        # with the narrower error set. The pooled client is resolved
-        # ONCE, outside the retry closure: every attempt reuses the same
-        # keep-alive pool, so a retry re-dials only the one dead
-        # connection instead of paying a fresh client (and a fresh
+        # with the narrower error set. One addition rides the same loop:
+        # a 429 from the pod's admission control means the call was NOT
+        # executed — shed work is as safe to re-issue as a failed
+        # connect, and the server's computed Retry-After (honored by
+        # backoff_sleep_s) says exactly when. The pooled client is
+        # resolved ONCE, outside the retry closure: every attempt reuses
+        # the same keep-alive pool, so a retry re-dials only the one
+        # dead connection instead of paying a fresh client (and a fresh
         # TCP+TLS handshake for every connection in it).
+        breaker = breaker_for(base_url)
+        breaker.check()
         client = sync_client()
 
         def attempt():
-            return client.post(
-                url, content=body, headers=headers, params=query or {},
+            resp = client.post(
+                url, content=body,
+                headers=_with_deadline(headers, timeout),
+                params=query or {},
                 timeout=timeout if timeout is not None else _TIMEOUT)
+            if resp.status_code == 429:
+                err = RetryableStatus(
+                    429, resp.text, retry_after=parse_retry_after(
+                        resp.headers.get("Retry-After")))
+                err.response = resp
+                raise err
+            return resp
 
-        resp = with_retries(attempt, retry_on=CONNECT_ERRORS)
+        try:
+            resp = with_retries(
+                attempt, retry_on=(*CONNECT_ERRORS, RetryableStatus))
+        except RetryableStatus as exc:
+            # still shedding after every retry: surface the server's
+            # typed ServerOverloaded (the 429 body), not a bare status.
+            # An overloaded-but-answering pod is a LIVE pod — this must
+            # count as breaker success (it also releases a half-open
+            # probe; leaving it unrecorded would wedge the breaker).
+            breaker.record_success()
+            return _handle(exc.response)
+        except httpx.TransportError:
+            breaker.record_failure()
+            raise
+        breaker.record_success()
         return _handle(resp)
     finally:
         hspan.end()  # no-op when the stream branch already ended it
+
+
+def _with_deadline(headers: dict, timeout: Optional[float]) -> dict:
+    """Stamp the propagated deadline budget (``X-KT-Timeout``, RELATIVE
+    seconds — the pod converts to an absolute deadline on its own clock
+    at receipt, so client↔pod clock skew cannot silently expire or
+    un-expire calls). Stamped per attempt, not per call: a retry that
+    waited out a Retry-After gets a fresh budget — the old deadline
+    described a wait that already happened."""
+    if timeout is None or not isinstance(timeout, (int, float)):
+        return headers
+    return {**headers, "X-KT-Timeout": f"{float(timeout)}"}
 
 
 def _stream_call(url, body, headers, query, timeout):
@@ -210,16 +254,37 @@ async def call_method_async(
     if method:
         url += f"/{method}"
 
-    # same connect-tier-only retry discipline (and same single pooled
-    # client across attempts) as call_method
+    # same connect-tier + 429-shed retry discipline (and same single
+    # pooled client across attempts) as call_method
+    breaker = breaker_for(base_url)
+    breaker.check()
     client = async_client()
 
     async def attempt():
-        return await client.post(
-            url, content=body, headers=headers, params=query or {},
+        resp = await client.post(
+            url, content=body, headers=_with_deadline(headers, timeout),
+            params=query or {},
             timeout=timeout if timeout is not None else _TIMEOUT)
+        if resp.status_code == 429:
+            err = RetryableStatus(
+                429, resp.text, retry_after=parse_retry_after(
+                    resp.headers.get("Retry-After")))
+            err.response = resp
+            raise err
+        return resp
 
-    resp = await with_retries_async(attempt, retry_on=CONNECT_ERRORS)
+    try:
+        resp = await with_retries_async(
+            attempt, retry_on=(*CONNECT_ERRORS, RetryableStatus))
+    except RetryableStatus as exc:
+        # overloaded-but-answering is alive: breaker success (and the
+        # half-open probe slot is released), typed error to the caller
+        breaker.record_success()
+        return _handle(exc.response)
+    except httpx.TransportError:
+        breaker.record_failure()
+        raise
+    breaker.record_success()
     return _handle(resp)
 
 

@@ -209,8 +209,12 @@ class ProcessPool:
         local_rank: Optional[int] = None,
         timeout: Optional[float] = None,
         env: Optional[Dict[str, str]] = None,
+        deadline: Optional[float] = None,
     ) -> dict:
-        """Send one call to one worker (round-robin by default)."""
+        """Send one call to one worker (round-robin by default).
+        ``deadline`` (unix seconds) rides the request dict — the worker
+        rejects it at dispatch if expired, and checks again between
+        streamed chunks, instead of executing work nobody can use."""
         if local_rank is None:
             local_rank = next(self._round_robin) % len(self.workers)
         worker = self.workers[local_rank]
@@ -220,6 +224,8 @@ class ProcessPool:
             "allowed": list(allowed or ("json", "pickle")),
             "env": env or {},
         }
+        if deadline is not None:
+            req["deadline"] = float(deadline)
         fut, chan = self._submit(worker, req)
         try:
             first = chan.get(timeout=timeout)

@@ -27,7 +27,7 @@ from typing import Any, Dict, Optional
 
 from kubetorch_tpu import serialization
 from kubetorch_tpu.config import env_int
-from kubetorch_tpu.exceptions import package_exception
+from kubetorch_tpu.exceptions import DeadlineExceeded, package_exception
 from kubetorch_tpu.observability import tracing
 
 _CTX = mp.get_context("spawn")
@@ -54,6 +54,17 @@ def get_distributed_env_vars(
     if pod_ips:
         env["POD_IPS"] = ",".join(pod_ips)
     return env
+
+
+def _deadline_check(deadline) -> None:
+    """Raise :class:`DeadlineExceeded` when the propagated deadline
+    (unix seconds, or None) has passed — the shared guard for the
+    dispatch queue head, the executor queue head, and between streamed
+    chunks."""
+    if isinstance(deadline, (int, float)) and time.time() > float(deadline):
+        raise DeadlineExceeded(
+            f"deadline passed {time.time() - float(deadline):.2f}s before "
+            f"execution", deadline=float(deadline))
 
 
 def _maybe_device_stats() -> Optional[Dict[str, int]]:
@@ -290,6 +301,12 @@ class _WorkerLoop:
             t_start = time.time()
             dispatch_s = max(0.0, t_start - float(
                 req.get("_t_submit") or t_start))
+            # Queue-head deadline check: the client's propagated deadline
+            # (req["deadline"], unix seconds) passed while this request
+            # transited the pool — executing it now is pure waste, and on
+            # a loaded pod it would also delay every call queued behind
+            deadline = req.get("deadline")
+            _deadline_check(deadline)
             # Per-call env (distributed rank assignment happens at call time,
             # after quorum — reference: process_pool.call_all per-rank env).
             # KT_REQUEST_ID goes into a contextvar instead: env is
@@ -334,6 +351,7 @@ class _WorkerLoop:
                 # time exactly where it matters (multi-MB pickled args)
                 t_exec0 = time.perf_counter()
                 if inspect.iscoroutinefunction(fn):
+                    _deadline_check(deadline)
                     result = await fn(*args, **kwargs)
                 else:
                     # copy_context propagates the request-id contextvar into
@@ -341,15 +359,30 @@ class _WorkerLoop:
                     import contextvars as _cv
 
                     ctx = _cv.copy_context()
+
+                    def _run_sync():
+                        # re-check at the REAL queue head: sync callables
+                        # queue in this worker's thread executor
+                        # (KT_WORKER_THREADS), and that wait — not the mp
+                        # transit — is where a loaded pod's deadline dies
+                        _deadline_check(deadline)
+                        return ctx.run(fn, *args, **kwargs)
+
                     result = await asyncio.get_running_loop().run_in_executor(
-                        self.executor,
-                        lambda: ctx.run(fn, *args, **kwargs))
+                        self.executor, _run_sync)
                 if inspect.isgenerator(result) or inspect.isasyncgen(result):
                     # Stream: push one response per yielded item (the pool
                     # routes them to the caller as they land), then a
                     # terminal marker. The generator body runs here, still
                     # under this request's id/env.
-                    await self._stream_result(req, result)
+                    if await self._stream_result(req, result):
+                        # deadline passed between chunks: the items
+                        # already shipped are the checkpoint; the
+                        # terminal is a typed refusal, not a silent
+                        # truncation
+                        raise DeadlineExceeded(
+                            "deadline passed between streamed chunks",
+                            deadline=float(req["deadline"]))
                     wspan.end({"stream": True})
                     return {"req_id": req_id, "ok": True,
                             "stream_end": True,
@@ -410,14 +443,21 @@ class _WorkerLoop:
         return {"exec_s": round(exec_s, 6), "dispatch_s": round(
             dispatch_s, 6)}
 
-    async def _stream_result(self, req: dict, gen):
+    async def _stream_result(self, req: dict, gen) -> bool:
         """Drain a (sync or async) generator result, pushing each item as
         its own response message (``stream: True``, ordered ``seq``). A
         ``cancel`` control message (client disconnected) closes the
-        generator between items so it doesn't hold an executor thread."""
+        generator between items so it doesn't hold an executor thread.
+        The propagated deadline is re-checked between chunks — each
+        yielded item is a natural checkpoint; past the deadline the
+        generator is closed and ``True`` is returned so the caller ends
+        the stream with a typed ``DeadlineExceeded`` terminal."""
         req_id = req["req_id"]
         ser = req["serialization"]
         allowed = req.get("allowed", serialization.METHODS)
+        deadline = req.get("deadline")
+        deadline = (float(deadline)
+                    if isinstance(deadline, (int, float)) else None)
 
         def _chunk(item, seq):
             payload, used = serialization.choose(
@@ -425,19 +465,28 @@ class _WorkerLoop:
             return {"req_id": req_id, "ok": True, "stream": True,
                     "seq": seq, "payload": payload, "serialization": used}
 
+        deadline_hit = False
         if inspect.isasyncgen(gen):
             seq = 0
             async for item in gen:
                 if req_id in self._cancelled:
                     await gen.aclose()
                     break
+                if deadline is not None and time.time() > deadline:
+                    deadline_hit = True
+                    await gen.aclose()
+                    break
                 self.response_q.put(_chunk(item, seq))
                 seq += 1
         else:
             def _pump():
+                nonlocal deadline_hit
                 try:
                     for seq, item in enumerate(gen):
                         if req_id in self._cancelled:
+                            break
+                        if deadline is not None and time.time() > deadline:
+                            deadline_hit = True
                             break
                         self.response_q.put(_chunk(item, seq))
                 finally:
@@ -450,6 +499,7 @@ class _WorkerLoop:
             await asyncio.get_running_loop().run_in_executor(
                 self.executor, lambda: ctx.run(_pump))
         self._cancelled.discard(req_id)
+        return deadline_hit
 
     async def run(self):
         loop = asyncio.get_running_loop()

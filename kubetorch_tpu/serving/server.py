@@ -34,10 +34,13 @@ from kubetorch_tpu import serialization
 from kubetorch_tpu.config import (env_float, env_int, env_json, env_path,
                                   env_set, env_str)
 from kubetorch_tpu.exceptions import (
+    DeadlineExceeded,
     PodTerminatedError,
+    ServerOverloaded,
     package_exception,
 )
 from kubetorch_tpu.observability import tracing
+from kubetorch_tpu.serving.replay import SessionRegistry, retry_after_estimate
 from kubetorch_tpu.serving.supervisor import supervisor_factory
 from kubetorch_tpu.version import __version__
 
@@ -111,6 +114,16 @@ class PodServer:
         # in-flight POST calls (the channel's in-flight depth lives in the
         # prometheus gauge): the preemption drain waits on both
         self._inflight_posts = 0
+        # durable channel sessions (epoch → session): the FIFO queue,
+        # in-flight executions, and result-retention ring survive a
+        # dropped WebSocket so a reconnecting client can replay
+        # (serving/replay.py). Event-loop-confined — no lock.
+        self._channel_sessions = SessionRegistry(
+            self._channel_execute,
+            extra_depth=lambda: self._inflight_posts)
+        # recent per-POST in-server seconds (EMA) — feeds the computed
+        # Retry-After when admission control sheds a POST
+        self._ema_server_s = 0.05
         self._actor_host = None
         self._actor_host_lock = threading.Lock()
 
@@ -241,6 +254,7 @@ class PodServer:
             ws.notify_status()
 
     async def _on_shutdown(self, app):
+        self._channel_sessions.expire_all()
         if getattr(self, "controller_ws", None) is not None:
             await self.controller_ws.stop()
         if getattr(self, "_activity_task", None) is not None:
@@ -313,9 +327,13 @@ class PodServer:
         url = f"{controller_url.rstrip('/')}/heartbeat"
         # ONE session for the life of the loop: a beat is a one-line POST
         # every few seconds for the pod's whole life — per-beat session +
-        # TCP churn across a fleet is sustained load on the controller
+        # TCP churn across a fleet is sustained load on the controller.
+        # The POST fallback is bounded by KT_PUSH_TIMEOUT: a hung
+        # controller holding a beat open must not outlive the SIGTERM
+        # drain window (found via the slow-pod chaos kind).
         session = _aiohttp.ClientSession(
-            timeout=_aiohttp.ClientTimeout(total=5.0), headers=headers)
+            timeout=_aiohttp.ClientTimeout(
+                total=env_float("KT_PUSH_TIMEOUT")), headers=headers)
         try:
             while not self.terminating:
                 await asyncio.sleep(heartbeat_interval())
@@ -489,7 +507,7 @@ class PodServer:
     # group name in a worker's stats dict → metric-name prefix
     _PROC_GROUPS = {"data_store_restore": "data_store_",
                     "data_store": "data_store_", "serving": "",
-                    "trace": ""}
+                    "trace": "", "reliability": ""}
 
     def _merge_worker_stats(self, stats: Dict[str, Any]):
         """Fold a worker's per-call stats dict into pod metrics. Plain
@@ -532,6 +550,11 @@ class PodServer:
                    if self.supervisor is not None else True)
         from kubetorch_tpu.observability import prometheus as prom
 
+        # lazy session GC rides the scrape cadence too — a pod whose
+        # clients vanished without a bye (and that never sees another
+        # connect) must still release detached sessions' retention
+        self._channel_sessions.sweep()
+
         # Weight-sync restore decomposition. Worker processes report their
         # counters on the call-response channel (process_worker attaches a
         # pid-tagged snapshot next to device_stats; _merge_worker_stats
@@ -553,6 +576,12 @@ class PodServer:
         serving = prom.serving_metrics()
         if any(serving.values()):
             self._merge_proc_snapshot("serving", "server", serving)
+        # Call-reliability counters (idempotent replay + admission
+        # control) — recorded in this process by the channel sessions
+        # and the POST admission gate.
+        reli = prom.reliability_metrics()
+        if any(reli.values()):
+            self._merge_proc_snapshot("reliability", "server", reli)
         # Tracing counters (spans recorded / dropped / slow pushes —
         # worker processes piggyback theirs next to the device stats).
         trace = tracing.trace_metrics()
@@ -713,7 +742,11 @@ class PodServer:
         if request.query_string:
             url += f"?{request.query_string}"
         body = await request.read()
-        async with ClientSession() as session:
+        import aiohttp as _aiohttp
+
+        # bound the dial to the local app; the request itself may be long
+        async with ClientSession(timeout=_aiohttp.ClientTimeout(
+                total=None, sock_connect=10.0)) as session:
             async with session.request(
                 request.method, url, data=body,
                 headers={k: v for k, v in request.headers.items()
@@ -819,6 +852,57 @@ class PodServer:
         if err is not None:
             exc, status = err
             return web.json_response(package_exception(exc), status=status)
+        # Admission control (the POST-path twin of the channel session's
+        # gate): past KT_MAX_QUEUE_DEPTH queued+executing calls on this
+        # POD — channels and POSTs combined — shed with a fast 429 + a
+        # computed Retry-After instead of letting the call queue into a
+        # timeout. The middleware already counted THIS request into
+        # _inflight_posts, hence the strict >.
+        max_depth = env_int("KT_MAX_QUEUE_DEPTH")
+        pod_depth = self._channel_sessions.total_depth()
+        if max_depth and pod_depth > max_depth:
+            retry_after = retry_after_estimate(
+                pod_depth, max_depth, self._ema_server_s)
+            from kubetorch_tpu.observability import prometheus as prom
+
+            prom.record_reliability("shed")
+            prom.record_reliability("last_retry_after", retry_after)
+            tracing.record_span(
+                "server.shed", 0.0,
+                attrs={"transport": "post",
+                       "queue_depth": pod_depth,
+                       "retry_after_s": retry_after})
+            return web.json_response(
+                package_exception(ServerOverloaded(
+                    f"{pod_depth} calls in flight at/over "
+                    f"KT_MAX_QUEUE_DEPTH={max_depth}",
+                    retry_after=retry_after)),
+                status=429, headers={"Retry-After": str(retry_after)})
+        # Propagated client deadline budget (X-KT-Timeout, RELATIVE
+        # seconds — converted to an absolute deadline on THIS clock, so
+        # client↔pod skew cannot expire or un-expire calls): a
+        # non-positive budget is rejected before the body is even
+        # dispatched; the worker re-checks at its queue head and between
+        # streamed chunks.
+        deadline = None
+        raw_budget = request.headers.get("X-KT-Timeout")
+        if raw_budget:
+            try:
+                budget = float(raw_budget)
+            except ValueError:
+                budget = None
+            if budget is not None:
+                if budget <= 0:
+                    from kubetorch_tpu.observability import (
+                        prometheus as prom,
+                    )
+
+                    prom.record_reliability("deadline_rejected")
+                    return web.json_response(
+                        package_exception(DeadlineExceeded(
+                            "non-positive deadline budget",
+                            deadline=time.time())), status=408)
+                deadline = time.time() + budget
         body = await request.read()
         # t_recv AFTER the body upload: a slow client link's upload time
         # is wire, not server queue — stamping at handler entry would
@@ -860,7 +944,8 @@ class PodServer:
                     distributed_subcall=distributed_subcall,
                     restart_procs=restart_procs, workers=workers,
                     query=query,
-                    request_id=request_id_var.get()))
+                    request_id=request_id_var.get(),
+                    deadline=deadline))
         except Exception as exc:
             sspan.end(error=f"{type(exc).__name__}: {exc}")
             return web.json_response(package_exception(exc), status=500)
@@ -955,6 +1040,8 @@ class PodServer:
         now = time.perf_counter()
         worker_t = resp.pop("timings", None) or {}
         t = {"server_s": now - t_recv, "queue_s": t_exec - t_recv}
+        # feed the admission gate's Retry-After estimate
+        self._ema_server_s = 0.8 * self._ema_server_s + 0.2 * t["server_s"]
         for key in ("dispatch_s", "exec_s"):
             if isinstance(worker_t.get(key), (int, float)):
                 t[key] = float(worker_t[key])
@@ -1051,12 +1138,20 @@ class PodServer:
         parsed here: it passes straight through supervisor → ProcessPool
         → ProcessWorker, so the pod hop costs zero re-serialization.
 
-        Calls execute FIFO in arrival order per channel — a stateful
+        The durable object is the :class:`ChannelSession`
+        (``serving/replay.py``), keyed by the client's channel epoch
+        (``X-KT-Channel-Epoch``): the FIFO queue, in-flight executions,
+        and result-retention ring all live on the session, so a dropped
+        socket loses nothing — a reconnecting client re-attaches and
+        replays unacknowledged calls by ``(epoch, cid)`` idempotency
+        key instead of re-executing them.
+
+        Calls execute FIFO in arrival order per *session* — a stateful
         engine (``RollingDecoder``) driven pipelined must never see
-        chunk N+1 start before chunk N finishes; a call whose header
-        sets ``concurrent`` opts out and runs out-of-band. Responses
-        carry the server-side latency decomposition
-        (queue/dispatch/device) in the reply header."""
+        chunk N+1 start before chunk N finishes, reconnects included; a
+        call whose header sets ``concurrent`` opts out and runs
+        out-of-band. Responses carry the server-side latency
+        decomposition (queue/dispatch/device) in the reply header."""
         from kubetorch_tpu.observability import prometheus as prom
         from kubetorch_tpu.serving import frames
 
@@ -1080,17 +1175,9 @@ class PodServer:
             # the client re-dialed after a drop: count it HERE too —
             # operators alert on the pod's counters, not the client's
             prom.record_channel_event("reconnect")
-        send_lock = asyncio.Lock()
-        fifo: asyncio.Queue = asyncio.Queue()
-        side_tasks: set = set()
-
-        async def _fifo_worker():
-            while True:
-                header, payload, t_recv = await fifo.get()
-                await self._channel_execute(ws, send_lock, header,
-                                            payload, t_recv)
-
-        dispatcher = asyncio.create_task(_fifo_worker())
+        session, _resumed = self._channel_sessions.attach(
+            request.headers.get("X-KT-Channel-Epoch"), ws,
+            reconnect=request.headers.get("X-KT-Channel-Reconnect") == "1")
         try:
             async for msg in ws:
                 if msg.type != WSMsgType.BINARY:
@@ -1103,66 +1190,58 @@ class PodServer:
                     # a misbehaving client shows up in /metrics
                     prom.record_channel_event("error")
                     continue
-                if header.get("kind") != "call":
+                kind = header.get("kind")
+                if kind == "bye":
+                    # clean client close: drop the session now instead of
+                    # holding retention for a client that said goodbye
+                    self._channel_sessions.drop(session)
+                    break
+                if kind != "call":
                     continue
-                if self.terminating:
-                    # preemption: stop ADMITTING — calls already queued on
-                    # the FIFO keep executing (they are in-flight from the
-                    # client's view and the drain waits for them), but a
-                    # frame arriving after SIGTERM gets the same typed
-                    # refusal the POST path's middleware gives
+                self.metrics["http_requests_total"] += 1
+                self.metrics["last_activity_timestamp"] = time.time()
+                if self.terminating \
+                        and header.get("cid") not in session.calls:
+                    # preemption: stop ADMITTING — queued/running calls
+                    # keep executing (they are in-flight from the
+                    # client's view and the drain waits for them), and a
+                    # REPLAY of an already-seen cid is still answered
+                    # from retention, but a fresh frame after SIGTERM
+                    # gets the same typed refusal the POST path gives
                     error = package_exception(PodTerminatedError(
                         "pod received SIGTERM"))["error"]
-                    async with send_lock:
+                    async with session.send_lock:
                         await ws.send_bytes(frames.pack_envelope(
                             {"kind": "error", "cid": header.get("cid")},
                             json.dumps({"error": error}).encode()))
                     continue
-                # in-flight counts from RECEIPT, not execution start: a
-                # depth-2 pipeline with chunk N executing and N+1 queued
-                # must read 2 (the documented health check), not 1
-                prom.record_channel_event("call")
+                # admission, replay dedup, FIFO/concurrent routing — and
+                # the in-flight gauge, counted from RECEIPT — all live on
+                # the session (serving/replay.py)
+                await session.submit(header, payload, t_recv)
                 self.metrics["serving_channel_inflight"] = \
-                    prom.channel_inflight(+1)
-                self.metrics["http_requests_total"] += 1
-                self.metrics["last_activity_timestamp"] = time.time()
-                if header.get("concurrent"):
-                    task = asyncio.create_task(self._channel_execute(
-                        ws, send_lock, header, payload, t_recv))
-                    side_tasks.add(task)
-                    task.add_done_callback(side_tasks.discard)
-                else:
-                    fifo.put_nowait((header, payload, t_recv))
+                    prom.channel_inflight(0)
         finally:
-            # client went away: stop executing its queue; in-flight
-            # worker calls finish on their own (same as a POST client
-            # disconnect), streamed generators are cancelled in
-            # _channel_stream's CancelledError path
-            dispatcher.cancel()
-            for task in side_tasks:
-                task.cancel()
-            # queued-but-never-executed calls would otherwise pin the
-            # inflight gauge forever (their _channel_execute finally
-            # never runs)
-            while not fifo.empty():
-                fifo.get_nowait()
-                self.metrics["serving_channel_inflight"] = \
-                    prom.channel_inflight(-1)
+            # transport gone ≠ work gone: detach the socket, keep the
+            # session (dispatcher, executions, retention) alive for
+            # KT_RESULT_RETAIN_S so a reconnect can resume. Ephemeral
+            # (no-epoch) sessions die with their socket.
+            self._channel_sessions.detach(session, ws)
         return ws
 
-    async def _channel_execute(self, ws, send_lock, header, payload,
+    async def _channel_execute(self, session, entry, header, payload,
                                t_recv):
-        """Run one channel call and write its response frame(s)."""
+        """Run one channel call and write its response frame(s) — every
+        frame is recorded into the session's retention ring *before* it
+        is delivered, so a mid-stream partition loses the socket but
+        never the frames (replay re-delivers from the client's cursor)."""
         from kubetorch_tpu.observability import prometheus as prom
-        from kubetorch_tpu.serving import frames
 
-        cid = header.get("cid")
+        cid = entry.cid
         rid = header.get("rid") or uuid.uuid4().hex[:12]
 
         async def reply(hdr: dict, body: bytes = b""):
-            hdr["cid"] = cid
-            async with send_lock:
-                await ws.send_bytes(frames.pack_envelope(hdr, body))
+            await session.send(entry, hdr, body)
 
         span_error: List[str] = []  # stamped on server.execute at end
 
@@ -1199,6 +1278,9 @@ class PodServer:
                 name, header.get("ser", serialization.DEFAULT))
             if err is not None:
                 return await reply_error(err[0])
+            deadline = header.get("deadline")
+            deadline = (float(deadline)
+                        if isinstance(deadline, (int, float)) else None)
             loop = asyncio.get_running_loop()
             call_ctx = contextvars.copy_context()
             t_exec = time.perf_counter()
@@ -1209,7 +1291,8 @@ class PodServer:
                 resp = await loop.run_in_executor(
                     None, lambda: call_ctx.run(
                         self.supervisor.call,
-                        payload, ser, method=method, request_id=rid))
+                        payload, ser, method=method, request_id=rid,
+                        deadline=deadline))
             except Exception as exc:  # noqa: BLE001
                 return await reply_error(exc)
             if resp is None:
@@ -1228,7 +1311,8 @@ class PodServer:
             if "stream" in resp:
                 if header.get("stream"):
                     return await self._channel_stream(
-                        reply, reply_error, resp["stream"], t_recv, t_exec)
+                        session, entry, reply, reply_error,
+                        resp["stream"], t_recv, t_exec)
                 resp, err = await self._drain_stream(
                     resp, ser, self.supervisor.allowed)
                 if err is not None:
@@ -1237,6 +1321,7 @@ class PodServer:
             if stats:
                 self._merge_worker_stats(stats)
             t = self._call_timings(resp, t_recv, t_exec)
+            session.note_exec(t.get("server_s", 0.0))
             used = resp.get("serialization", ser)
             t0_reply = time.perf_counter()
             await reply({"kind": "result", "ser": used, "t": t},
@@ -1245,30 +1330,40 @@ class PodServer:
                 "server.reply", time.perf_counter() - t0_reply,
                 parent=getattr(sspan, "context", None),
                 attrs={"bytes": len(resp["payload"] or b"")})
-        except (ConnectionResetError, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            # session expiry cancelled this execution mid-flight
             raise
         except Exception as exc:  # noqa: BLE001 — a reply must always go
             try:
                 await reply_error(exc)
-            # ktlint: disable=KT004 -- socket already gone; client sees the drop
+            # ktlint: disable=KT004 -- retention full / teardown races only
             except Exception:  # noqa: BLE001
                 pass
         finally:
             # failed channel calls must read as failed in /_trace, same
-            # as the POST path's server.call span
+            # as the POST path's server.call span. The in-flight gauge is
+            # owned by the session (released at the terminal frame —
+            # including terminals written while no socket is attached);
+            # here we only mirror it into the JSON metrics dict.
             sspan.end(error=(span_error[0] if span_error else None))
             tracing.maybe_push_slow(
                 sspan.span["trace_id"] if sspan.span else None,
                 time.perf_counter() - t_recv)
             self.metrics["serving_channel_inflight"] = \
-                prom.channel_inflight(-1)
+                prom.channel_inflight(0)
 
-    async def _channel_stream(self, reply, reply_error, stream, t_recv,
-                              t_exec):
+    async def _channel_stream(self, session, entry, reply, reply_error,
+                              stream, t_recv, t_exec):
         """Forward a generator result over the channel: one 'item' frame
-        per yielded chunk (opaque payload + per-item serialization in
-        the header), then 'end' with the timing decomposition — the
-        channel twin of :meth:`_respond_stream`."""
+        per yielded chunk (opaque payload + per-item serialization +
+        monotonic ``seq`` in the header), then 'end' with the timing
+        decomposition — the channel twin of :meth:`_respond_stream`.
+        Frames are retained on the session entry, so a partition
+        mid-stream costs nothing: the client replays with a resume
+        cursor and delivery restarts at cursor+1, not token zero."""
+        from kubetorch_tpu.exceptions import ReplayExpired
+        from kubetorch_tpu.serving.replay import DETACHED_FRAME_CAP
+
         loop = asyncio.get_running_loop()
         it = iter(stream)
         try:
@@ -1276,12 +1371,29 @@ class PodServer:
                 chunk = await loop.run_in_executor(None, next, it, None)
                 if chunk is None:
                     break
+                if session.ws is None and (
+                        len(entry.frames) > DETACHED_FRAME_CAP
+                        or entry.lost_detached):
+                    # nobody is connected and either thousands of frames
+                    # piled up or the byte cap already trimmed frames the
+                    # absent client never received (large chunks keep the
+                    # frame COUNT low while making the stream unresumable
+                    # for any cursor the client could hold): stop burning
+                    # the worker and turn the entry into a typed refusal
+                    cancel = getattr(stream, "cancel", None)
+                    if cancel is not None:
+                        cancel()
+                    return await reply_error(ReplayExpired(
+                        f"stream abandoned: {len(entry.frames)} frames "
+                        f"({entry.frames_bytes} B, low_seq "
+                        f"{entry.low_seq}) retained with no client "
+                        f"attached"))
                 await reply({"kind": "item",
                              "ser": chunk["serialization"]},
                             chunk["payload"])
         except TimeoutError as exc:
             return await reply_error(exc)
-        except (ConnectionResetError, asyncio.CancelledError):
+        except asyncio.CancelledError:
             cancel = getattr(stream, "cancel", None)
             if cancel is not None:
                 cancel()
@@ -1293,6 +1405,7 @@ class PodServer:
         if stats:
             self._merge_worker_stats(stats)
         t = self._call_timings(dict(terminal), t_recv, t_exec)
+        session.note_exec(t.get("server_s", 0.0))
         await reply({"kind": "end", "t": t})
 
 

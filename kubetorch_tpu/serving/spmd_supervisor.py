@@ -212,7 +212,12 @@ class SPMDDistributedSupervisor(DistributedSupervisor):
         workers: str = "all",
         query: Optional[Dict[str, str]] = None,
         request_id: Optional[str] = None,
+        deadline: Optional[float] = None,
     ) -> dict:
+        # ``deadline`` is accepted for transport parity but not threaded
+        # into the distributed fan-out: an SPMD gang call is gang-atomic
+        # (quorum + membership timeouts govern it), and rejecting one
+        # rank's slice mid-gang would poison the collective.
         query = query or {}
         # Request-ID log spine: the coordinator's id wins; subcalls inherit
         # it via the forwarded query string and stamp it into worker env.

@@ -73,16 +73,20 @@ class ExecutionSupervisor:
         workers: str = "all",
         query: Optional[Dict[str, str]] = None,
         request_id: Optional[str] = None,
+        deadline: Optional[float] = None,
     ) -> dict:
         """Execute one request; returns the worker response dict
-        {ok, payload|error, serialization}."""
+        {ok, payload|error, serialization}. ``deadline`` (unix seconds,
+        propagated from the client) rides the request into the worker,
+        which rejects expired work instead of executing it."""
         if restart_procs:
             self.pool.restart(self._per_rank_env())
             self._setup_callable()
         env = {"KT_REQUEST_ID": request_id} if request_id else {}
         return self.pool.call(
             body, serialization_method, method=method,
-            allowed=self.allowed, timeout=timeout, env=env)
+            allowed=self.allowed, timeout=timeout, env=env,
+            deadline=deadline)
 
     # ------------------------------------------------------------------
     def profile(self, action: str, directory: str = "",

@@ -1,0 +1,61 @@
+"""KT007 fixtures: httpx/aiohttp calls without an explicit timeout.
+
+True positives (tp_*) must fire; the fp_* shapes are the documented
+false-positive guards — method calls on an already-configured client,
+explicit timeouts, and a **kwargs spread that may carry one.
+"""
+
+import aiohttp
+import httpx
+from httpx import AsyncClient
+
+
+def tp_module_get():
+    return httpx.get("http://controller/health")
+
+
+def tp_module_stream():
+    with httpx.stream("GET", "http://store/blob") as resp:
+        return resp.read()
+
+
+def tp_client_session():
+    return aiohttp.ClientSession()
+
+
+def tp_client_ctor():
+    return AsyncClient()
+
+
+def tp_suppressed():
+    return httpx.get("http://x")  # ktlint: disable=KT007 -- fixture
+
+
+def fp_explicit_timeout():
+    return httpx.get("http://controller/health", timeout=5.0)
+
+
+def fp_session_with_timeout():
+    # the long-lived-WS shape: dial bounded, stream deliberately not
+    return aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=None, sock_connect=10.0))
+
+
+def fp_configured_client_method():
+    # the pooled-client idiom: the CLIENT carries the timeout; calls on
+    # it are governed by it and must not be flagged
+    client = httpx.Client(timeout=5.0)
+    return client.get("http://pod/ready")
+
+
+def fp_kwargs_spread():
+    kw = {"timeout": 2.0}
+    return httpx.get("http://pod/metrics", **kw)
+
+
+def fp_unrelated_get():
+    # a local callable named `get` is not an HTTP request
+    def get(url):
+        return url
+
+    return get("http://nothing")

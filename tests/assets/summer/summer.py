@@ -129,6 +129,17 @@ def env_values(keys):
     return {k: os.environ.get(k) for k in keys}
 
 
+def stamped_sleep(seconds=0.0):
+    """Sleep with wall stamps — the overload tests assert that every
+    ACCEPTED call started before its propagated deadline."""
+    import time
+
+    t0 = time.time()
+    if seconds:
+        time.sleep(float(seconds))
+    return {"started": t0, "finished": time.time()}
+
+
 def slow_whoami(seconds=8.0):
     import time
 
@@ -191,3 +202,27 @@ class ChunkEngine:
         if seconds:
             time.sleep(seconds)
         return os.getpid()
+
+    def decode(self, tag, n, delay=0.0):
+        """Rolling-decode stand-in for the replay tests: a deterministic
+        token stream (byte-identical across runs) whose per-tag
+        execution count is server-observable — the exactly-once
+        assertion reads it back via :meth:`exec_count`."""
+        import hashlib
+        import time
+
+        counts = getattr(self, "exec_counts", None)
+        if counts is None:
+            counts = self.exec_counts = {}
+        counts[tag] = counts.get(tag, 0) + 1
+        for i in range(n):
+            if delay:
+                time.sleep(delay)
+            tok = hashlib.sha256(f"{tag}:{i}".encode()).hexdigest()[:8]
+            yield {"tag": tag, "i": i, "tok": tok}
+
+    def exec_count(self, tag):
+        return getattr(self, "exec_counts", {}).get(tag, 0)
+
+    def stamped_sleep(self, seconds=0.0):
+        return stamped_sleep(seconds)

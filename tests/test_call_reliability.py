@@ -1,0 +1,497 @@
+"""ISSUE 9 e2e: exactly-once call recovery on the serving path.
+
+Four layers, matching the tentpole:
+
+1. **Idempotent replay** — a pipelined rolling-decode stream survives
+   two seeded mid-stream partitions (chaos kind ``partition``) with
+   byte-identical output and a server-side execution count of exactly 1.
+2. **Written vs queued** (satellite) — at disconnect, only calls that
+   were actually written to the socket replay by idempotency key;
+   queued-but-unwritten calls are requeued verbatim. Either way every
+   call executes exactly once, in submission order.
+3. **Deadline propagation** — expired work is rejected typed
+   (``DeadlineExceeded``) at the queue head and between streamed chunks
+   instead of executing uselessly.
+4. **Admission control** — at 2× queue capacity, 429 + Retry-After
+   shedding (which ``retry.py`` honors) yields strictly more completed
+   calls than the no-admission baseline that collapses into timeouts,
+   and no accepted call starts after its propagated deadline.
+
+Plus unit coverage for the server session (retention eviction →
+``ReplayExpired``), the shared circuit breaker, and the new chaos kinds.
+"""
+
+import os
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+import kubetorch_tpu as kt
+from kubetorch_tpu.resources.callables.cls import Cls
+from kubetorch_tpu.resilience import chaos
+from kubetorch_tpu.serving import circuit
+
+ASSETS = Path(__file__).parent / "assets" / "summer"
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _local_state(tmp_path_factory):
+    state = tmp_path_factory.mktemp("ktlocal-reliability")
+    os.environ["KT_LOCAL_STATE"] = str(state)
+    import kubetorch_tpu.provisioning.backend as backend
+
+    backend._LOCAL_ROOT = state
+    yield
+    for record in backend.LocalBackend().list_services():
+        backend.LocalBackend().teardown(record["service_name"], quiet=True)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_chaos():
+    yield
+    chaos.install(None)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    remote = Cls(root_path=str(ASSETS), import_path="summer",
+                 callable_name="ChunkEngine", name="reliabilityengine")
+    remote.to(kt.Compute(cpus="0.1"))
+    yield remote
+    remote.teardown()
+
+
+# ---------------------------------------------------------------- replay
+@pytest.mark.level("minimal")
+def test_stream_survives_two_partitions_byte_identical(engine):
+    """Acceptance: a pipelined rolling-decode stream completes with
+    byte-identical output across two injected partitions, with zero
+    duplicate executions (server-side counter asserted)."""
+    import hashlib
+
+    n = 30
+    # ground truth, computed exactly as the engine does: byte-identical
+    # means THESE tokens in THIS order
+    expected_toks = [hashlib.sha256(f"hot:{i}".encode()).hexdigest()[:8]
+                     for i in range(n)]
+    with engine.channel(depth=2) as chan:
+        # chaos-free control stream (also pins exec_count bookkeeping)
+        base = list(chan.submit("base", n, method="decode",
+                                stream=True).result(timeout=60))
+        assert len(base) == n
+        policy = chaos.ChaosPolicy(seed=7, partition=1.0, max_events=2)
+        chaos.install(policy)
+        # pipelined: a step call rides behind the stream in the FIFO
+        c_stream = chan.submit("hot", n, method="decode",
+                               kwargs={"delay": 0.01}, stream=True)
+        c_step = chan.submit(4242, method="step")
+        items = list(c_stream.result(timeout=120))
+        chaos.install(None)
+        assert len(policy.events) == 2, policy.events
+        assert [e[0] for e in policy.events] == ["partition", "partition"]
+        # byte-identical: the exact token sequence, no gap, no dup
+        assert [i["tok"] for i in items] == expected_toks
+        assert [i["i"] for i in items] == list(range(n))
+        # the pipelined neighbor also completed, in FIFO order
+        assert c_step.result(timeout=60)["i"] == 4242
+        # two partitions → two reconnects on top of the first dial
+        assert chan.connects == 3, chan.connects
+        assert chan.replays >= 1
+        # exactly once: the engine ran each decode a single time
+        assert chan.call("hot", method="exec_count") == 1
+        assert chan.call("base", method="exec_count") == 1
+
+
+@pytest.mark.level("minimal")
+def test_written_replay_queued_requeue(engine):
+    """Satellite regression: kill the socket with 2 calls written (in
+    doubt → replay by idempotency key) and 2 still queued client-side
+    (never written → plain requeue, no idempotency needed). All four
+    execute exactly once, in submission order."""
+
+    class DropThird(chaos.ChaosPolicy):
+        """Deterministically sever the connection when the writer is
+        about to ship the 3rd call of this channel."""
+
+        def __init__(self):
+            super().__init__(seed=0, drop_connection=1.0, max_events=1)
+
+        def decide(self, kind, context=""):
+            # the warm-up call took cid 1, so the four calls under test
+            # are cids 2-5; severing on cid 4's send leaves 2 and 3
+            # written (in doubt) and 4, 5 queued-unwritten
+            if kind != chaos.DROP_CONNECTION or context != "cid-4":
+                return False
+            return super().decide(kind, context)
+
+    with engine.channel(depth=4) as chan:
+        marker = int(time.time()) % 100000 * 10
+        warm = chan.call(marker + 0, method="step")  # dial outside chaos
+        assert warm["i"] == marker + 0
+        chaos.install(DropThird())
+        calls = [chan.submit(marker + k, method="step",
+                             kwargs={"delay": 0.15 if k == 1 else 0.0})
+                 for k in (1, 2, 3, 4)]
+        results = [c.result(timeout=60) for c in calls]
+        chaos.install(None)
+        # every call executed exactly once, in submission order
+        assert [r["i"] for r in results] == [marker + k for k in (1, 2, 3, 4)]
+        assert results[-1]["seq"][-5:] == [marker + k for k in range(5)]
+        # the two written calls — and ONLY those — replayed by
+        # idempotency key; the call dropped pre-write requeued verbatim
+        # (the 4th may race disconnect-vs-registration and go out fresh
+        # after recovery instead — also a plain send, never a replay)
+        assert chan.replays == 2, (chan.replays, chan.requeues)
+        assert chan.requeues >= 1, (chan.replays, chan.requeues)
+        assert chan.connects == 2
+
+
+# -------------------------------------------------------------- deadline
+@pytest.mark.level("minimal")
+def test_deadline_rejects_queued_and_streamed_work(engine):
+    """Expired work is rejected with the typed DeadlineExceeded — at the
+    worker's queue head (a call that waited out its budget behind a slow
+    neighbor) and between decode chunks of a stream."""
+    from kubetorch_tpu.exceptions import DeadlineExceeded
+
+    with engine.channel(depth=3) as chan:
+        chan.call(7001, method="step")  # warm connection + worker
+        # FIFO: a 1.2 s call ahead burns the 0.4 s budget of the next
+        slow = chan.submit(7002, method="step", kwargs={"delay": 1.2})
+        doomed = chan.submit(7003, method="step", timeout=0.4)
+        with pytest.raises((DeadlineExceeded, TimeoutError)):
+            doomed.result(timeout=10)
+        assert slow.result(timeout=30)["i"] == 7002
+        # the handle resolved with the typed rejection, not a timeout
+        assert isinstance(doomed._exc, DeadlineExceeded), doomed._exc
+        # streamed: a stream's `timeout` stays a per-item stall bound
+        # (a healthy long stream must not be clock-killed); an explicit
+        # deadline_s gives the whole call a budget, enforced between
+        # chunks — items already shipped arrive, then the typed refusal.
+        # Never a silent truncation masquerading as a complete stream.
+        stream = chan.submit("dl", 200, method="decode",
+                             kwargs={"delay": 0.01}, stream=True,
+                             timeout=10.0, deadline_s=0.5)
+        got = []
+        with pytest.raises(DeadlineExceeded):
+            # iterate the handle directly: items delivered before the
+            # deadline arrive, then the typed refusal raises (result()
+            # would raise at the error terminal without yielding)
+            for item in stream:
+                got.append(item)
+        assert 0 < len(got) < 200
+
+
+# ------------------------------------------------------------- admission
+def _fire(url, n, timeout_s, results):
+    from kubetorch_tpu.serving import http_client
+
+    def one(k):
+        t0 = time.perf_counter()
+        try:
+            out = http_client.call_method(
+                url, "ChunkEngine", method="stamped_sleep",
+                kwargs={"seconds": 0.15}, timeout=timeout_s)
+            results.append(("ok", out, time.perf_counter() - t0))
+        except Exception as exc:  # noqa: BLE001 — the point is counting
+            results.append(("err", exc, time.perf_counter() - t0))
+
+    threads = [threading.Thread(target=one, args=(k,)) for k in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+
+
+@pytest.mark.level("minimal")
+def test_overload_shedding_beats_timeout_collapse(monkeypatch):
+    """Acceptance: at 2× queue capacity, 429 + Retry-After shedding
+    yields higher completed-call goodput than the no-admission baseline
+    (whose tail collapses into deadline rejections/timeouts), and no
+    accepted call starts after its propagated deadline."""
+    monkeypatch.setenv("KT_CB_FAILURES", "0")      # breaker off: we WANT
+    monkeypatch.setenv("KT_RETRY_ATTEMPTS", "8")   # the raw comparison
+    monkeypatch.setenv("KT_WORKER_THREADS", "1")   # a real serial queue
+    circuit.reset_all()
+    n, timeout_s = 10, 0.6
+
+    def deploy(name, max_depth):
+        monkeypatch.setenv("KT_MAX_QUEUE_DEPTH", str(max_depth))
+        remote = Cls(root_path=str(ASSETS), import_path="summer",
+                     callable_name="ChunkEngine", name=name)
+        remote.to(kt.Compute(cpus="0.1"))
+        return remote
+
+    baseline = deploy("overloadbase", 0)     # no admission control
+    try:
+        base_results = []
+        _fire(baseline.service_url(), n, timeout_s, base_results)
+    finally:
+        baseline.teardown()
+    shed = deploy("overloadshed", 2)         # n = 2× (depth + exec slots)
+    try:
+        shed_results = []
+        _fire(shed.service_url(), n, timeout_s, shed_results)
+    finally:
+        shed.teardown()
+
+    base_ok = [r for r in base_results if r[0] == "ok"]
+    shed_ok = [r for r in shed_results if r[0] == "ok"]
+    # the baseline MUST collapse (that's what admission control fixes):
+    # with one worker thread, 10 × 0.15 s of work cannot all finish
+    # inside a 0.6 s deadline
+    assert len(base_ok) < n, base_results
+    # shedding + Retry-After retries beat the timeout collapse
+    assert len(shed_ok) > len(base_ok), (
+        f"shed goodput {len(shed_ok)}/{n} vs baseline "
+        f"{len(base_ok)}/{n}")
+    # no accepted call ran past its budget: every success both started
+    # AND finished within one attempt's deadline window (0.15 s exec
+    # inside a 0.6 s budget — a start past the deadline is impossible
+    # by the worker's queue-head check, so durations stay bounded)
+    for _, out, _wall in shed_ok:
+        assert out["finished"] - out["started"] < timeout_s
+    # the shed pod actually shed (it didn't just have spare capacity)
+    import httpx
+
+    # counters survive teardown? no — assert via the error mix instead:
+    # failures on the shed pod, if any, are typed ServerOverloaded, not
+    # raw timeouts
+    from kubetorch_tpu.exceptions import ServerOverloaded
+
+    for kind, exc, _wall in shed_results:
+        if kind == "err":
+            assert isinstance(exc, (ServerOverloaded, httpx.HTTPError)), exc
+
+
+# ------------------------------------------------- session unit coverage
+@pytest.mark.level("unit")
+def test_session_retention_eviction_and_expired_replay():
+    """ChannelSession semantics without a socket: retention ring evicts
+    oldest done entries at KT_RESULT_RETAIN; a replay of an evicted cid
+    is refused typed (ReplayExpired), a replay of an unseen cid runs
+    fresh, a replay of a retained cid re-delivers its frames."""
+    import asyncio
+    import json as _json
+
+    from kubetorch_tpu.serving.replay import ChannelSession
+
+    executed = []
+
+    async def execute(session, entry, header, payload, t_recv):
+        executed.append(entry.cid)
+        await session.send(entry, {"kind": "result", "ser": "json"},
+                           b'{"result": %d}' % entry.cid)
+
+    async def main(monkey_retain):
+        os.environ["KT_RESULT_RETAIN"] = str(monkey_retain)
+        session = ChannelSession("epoch-x", execute)
+
+        class FakeWS:
+            closed = False
+
+            def __init__(self):
+                self.sent = []
+
+            async def send_bytes(self, data):
+                self.sent.append(data)
+
+        ws = FakeWS()
+        session.attach(ws)
+        for cid in (1, 2, 3):
+            await session.submit({"cid": cid, "kind": "call"}, b"", 0.0)
+        await asyncio.sleep(0.05)  # let the dispatcher drain
+        assert executed == [1, 2, 3]
+        # ring is 2: cid 1 evicted
+        assert 1 not in session.calls and 2 in session.calls
+        # replay of retained cid 3: frames re-delivered, NOT re-executed
+        before = len(ws.sent)
+        await session.submit({"cid": 3, "kind": "call", "replay": True,
+                              "resume_from": 0}, b"", 0.0)
+        assert len(ws.sent) == before + 1 and executed == [1, 2, 3]
+        # replay of evicted cid 1: typed refusal, NOT re-execution
+        await session.submit({"cid": 1, "kind": "call", "replay": True},
+                             b"", 0.0)
+        assert executed == [1, 2, 3]
+        from kubetorch_tpu.serving import frames as frames_mod
+
+        hdr, body = frames_mod.unpack_envelope(ws.sent[-1])
+        assert hdr["kind"] == "error"
+        assert _json.loads(body)["error"]["type"] == "ReplayExpired"
+        # replay of an unseen cid (write lost with the connection): fresh
+        await session.submit({"cid": 9, "kind": "call", "replay": True},
+                             b"", 0.0)
+        await asyncio.sleep(0.05)
+        assert executed == [1, 2, 3, 9]
+        session.expire()
+
+    try:
+        asyncio.run(main(2))
+    finally:
+        os.environ.pop("KT_RESULT_RETAIN", None)
+
+
+@pytest.mark.level("unit")
+def test_session_reattach_during_running_stream_keeps_order():
+    """Re-attaching mid-execution must not interleave live frames with
+    the replay catch-up: while a replay pass owns delivery, live frames
+    are retained-only and the pass re-reads the list — the new socket
+    sees every frame from the cursor on, in seq order, exactly once."""
+    import asyncio
+
+    from kubetorch_tpu.serving import frames as frames_mod
+    from kubetorch_tpu.serving.replay import ChannelSession
+
+    n = 40
+
+    class Sink:
+        closed = False
+
+        def __init__(self):
+            self.frames = []
+
+        async def send_bytes(self, data):
+            self.frames.append(frames_mod.unpack_envelope(data))
+            await asyncio.sleep(0)
+
+    async def main():
+        async def execute(session, entry, header, payload, t_recv):
+            for i in range(n):
+                await session.send(entry, {"kind": "item", "ser": "json"},
+                                   b"%d" % i)
+                await asyncio.sleep(0)
+            await session.send(entry, {"kind": "end"})
+
+        session = ChannelSession("epoch-r", execute)
+        first = Sink()
+        session.attach(first)
+        await session.submit({"cid": 1, "kind": "call"}, b"", 0.0)
+        while len(first.frames) < 7:
+            await asyncio.sleep(0)
+        session.detach(first)              # partition while RUNNING
+        cursor = len(first.frames)
+        second = Sink()
+        session.attach(second)             # re-attach while RUNNING
+        await session.submit({"cid": 1, "kind": "call", "replay": True,
+                              "resume_from": cursor}, b"", 0.0)
+        while not session.calls[1].done:
+            await asyncio.sleep(0)
+        await asyncio.sleep(0.02)          # drain trailing deliveries
+        seqs = [h["seq"] for h, _ in second.frames if h["kind"] == "item"]
+        # gap-free, in order, no duplicates, from the cursor on
+        assert seqs == list(range(cursor, n)), (cursor, seqs[:10], seqs[-3:])
+        assert second.frames[-1][0]["kind"] == "end"
+        session.expire()
+
+    asyncio.run(main())
+
+
+@pytest.mark.level("unit")
+def test_session_expired_reconnect_refuses_replays():
+    """A re-dial (X-KT-Channel-Reconnect) landing on a FRESH session
+    means the predecessor expired — every replay must get the typed
+    ReplayExpired (surfaced client-side as ChannelInterrupted), never a
+    silent re-execution; plain (requeued) calls still run."""
+    import asyncio
+    import json as _json
+
+    from kubetorch_tpu.serving import frames as frames_mod
+    from kubetorch_tpu.serving.replay import SessionRegistry
+
+    executed = []
+
+    async def execute(session, entry, header, payload, t_recv):
+        executed.append(entry.cid)
+        await session.send(entry, {"kind": "result", "ser": "json"},
+                           b'{"result": 1}')
+
+    async def main():
+        registry = SessionRegistry(execute)
+
+        class FakeWS:
+            closed = False
+            sent = []
+
+            async def send_bytes(self, data):
+                self.sent.append(data)
+
+        ws = FakeWS()
+        session, resumed = registry.attach("gone-epoch", ws,
+                                           reconnect=True)
+        assert not resumed and session.lost_history
+        # a replayed (written-in-doubt) call: refused typed
+        await session.submit({"cid": 5, "kind": "call", "replay": True},
+                             b"", 0.0)
+        hdr, body = frames_mod.unpack_envelope(ws.sent[-1])
+        assert hdr["kind"] == "error"
+        assert _json.loads(body)["error"]["type"] == "ReplayExpired"
+        assert executed == []
+        # a requeued (never-written) call: runs — it cannot have executed
+        await session.submit({"cid": 6, "kind": "call"}, b"", 0.0)
+        await asyncio.sleep(0.05)
+        assert executed == [6]
+        registry.expire_all()
+
+    asyncio.run(main())
+
+
+@pytest.mark.level("unit")
+def test_retry_after_estimate_bounds():
+    from kubetorch_tpu.serving.replay import retry_after_estimate
+
+    # floor: never tell a client to come back in 0 s
+    assert retry_after_estimate(3, 2, 0.0, cap_s=30.0) >= 0.05
+    # proportional to excess × EMA
+    assert retry_after_estimate(10, 2, 0.5, cap_s=30.0) == pytest.approx(
+        4.5, abs=0.01)
+    # capped: an overload estimate is not an outage announcement
+    assert retry_after_estimate(1000, 2, 1.0, cap_s=30.0) == 30.0
+
+
+@pytest.mark.level("unit")
+def test_circuit_breaker_states():
+    """closed → open on consecutive failures → half-open after the
+    cooldown → one probe; probe success closes, probe failure re-opens."""
+    from kubetorch_tpu.exceptions import CircuitOpenError
+    from kubetorch_tpu.serving.circuit import CircuitBreaker
+
+    now = [0.0]
+    cb = CircuitBreaker("http://pod", failures=3, reset_s=10.0,
+                        clock=lambda: now[0])
+    for _ in range(2):
+        cb.record_failure()
+    cb.check()  # still closed
+    cb.record_failure()  # 3rd consecutive → open
+    with pytest.raises(CircuitOpenError) as err:
+        cb.check()
+    assert err.value.retry_in == pytest.approx(10.0)
+    # a success elsewhere? no — time passes instead
+    now[0] = 10.1
+    cb.check()  # half-open: this caller is the probe
+    with pytest.raises(CircuitOpenError):
+        cb.check()  # second caller is NOT
+    cb.record_failure()  # probe failed → re-open
+    with pytest.raises(CircuitOpenError):
+        cb.check()
+    now[0] = 20.3
+    cb.check()
+    cb.record_success()  # probe succeeded → closed
+    cb.check()
+    assert cb.state == "closed"
+    # consecutive-failure count reset by the success
+    cb.record_failure()
+    cb.check()
+
+
+@pytest.mark.level("unit")
+def test_new_chaos_kinds_parse_and_draw():
+    policy = chaos.ChaosPolicy.from_env("partition=1,slow-pod=0.5,seed=3,"
+                                        "max=2")
+    assert policy.rates[chaos.PARTITION] == 1.0
+    assert policy.rates[chaos.SLOW_POD] == 0.5
+    assert policy.decide(chaos.PARTITION, "cid-1-0")
+    assert policy.decide(chaos.PARTITION, "cid-1-1")
+    # max_events=2 caps injection
+    assert not policy.decide(chaos.PARTITION, "cid-1-2")

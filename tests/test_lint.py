@@ -96,6 +96,12 @@ def names_on_lines(path: Path, findings):
       "tp_np_materialize", "tp_device_get", "_method_impl"},
      {"fp_shape_branch", "fp_static_argname", "fp_none_check",
       "fp_not_jitted", "_impl", "tp_suppressed"}),
+    ("kt007_cases.py", "KT007",
+     {"tp_module_get", "tp_module_stream", "tp_client_session",
+      "tp_client_ctor"},
+     {"fp_explicit_timeout", "fp_session_with_timeout",
+      "fp_configured_client_method", "fp_kwargs_spread",
+      "fp_unrelated_get", "tp_suppressed"}),
 ])
 def test_rule_fixtures(fixture, rule, expected_tp, forbidden_fp):
     path = ASSETS / fixture
@@ -229,7 +235,7 @@ def test_gate_package_clean_under_10s():
     assert result.findings == [], (
         "non-baselined lint findings:\n"
         + "\n".join(str(f) for f in result.findings))
-    assert len({r.code for r in ALL_RULES}) == 6  # all six rules ran
+    assert len({r.code for r in ALL_RULES}) == 7  # all seven rules ran
 
 
 def test_kt003_strictly_clean_in_control_plane_dirs():
@@ -246,7 +252,7 @@ def test_kt003_strictly_clean_in_control_plane_dirs():
 
 def test_rule_docs_cover_all_rules():
     assert set(RULE_DOCS) == {"KT001", "KT002", "KT003", "KT004",
-                              "KT005", "KT006"}
+                              "KT005", "KT006", "KT007"}
     for code, (name, doc) in RULE_DOCS.items():
         assert name and len(doc) > 40, f"{code} needs a real doc string"
 

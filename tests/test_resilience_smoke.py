@@ -17,6 +17,12 @@ EXPECTED_KEYS = {
     "recovery_heartbeat_s",
     "recovery_dead_after_misses",
     "recovery_chaos_seed",
+    # ISSUE 9 serving-path reliability legs
+    "replay_recovery_s",
+    "replay_frames_resent",
+    "admission_shed_goodput_ratio",
+    "admission_baseline_goodput",
+    "admission_shed_goodput",
 }
 
 
@@ -42,3 +48,10 @@ def test_resilience_dryrun_metric_keys():
     hb = out["recovery_heartbeat_s"]
     assert out["recovery_detect_s"] <= (
         out["recovery_dead_after_misses"] * hb + max(2 * hb, 0.25)), out
+    # replay: partition → resumed must be measured and fast (pure
+    # retention replay, no re-execution)
+    assert 0 < out["replay_recovery_s"] < 5.0, out
+    assert out["replay_frames_resent"] > 0
+    # admission acceptance: 429-shedding goodput strictly beats the
+    # timeout-collapse baseline at 2× queue capacity
+    assert out["admission_shed_goodput_ratio"] > 1.0, out
