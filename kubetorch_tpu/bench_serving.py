@@ -452,11 +452,15 @@ class DecodeSim:
 
 
 class _PodServer:
-    """A throwaway pod-server subprocess serving DecodeSim (the same
-    shape bench_dataplane uses for its store server)."""
+    """A throwaway pod-server subprocess serving a bench callable (the
+    same shape bench_dataplane uses for its store server). Defaults to
+    DecodeSim; the engine phase points it at EngineHost."""
 
     def __init__(self, root: str, device_ms: float, batch: int,
-                 steps: int):
+                 steps: int, name: str = "DecodeSim",
+                 import_path: str = "decode_sim",
+                 init_kwargs: Optional[dict] = None,
+                 extra_env: Optional[dict] = None):
         import json as _json
         import os
         import subprocess
@@ -468,15 +472,17 @@ class _PodServer:
         env = {
             **os.environ,
             "KT_SERVICE_NAME": "bench-decode",
-            "KT_CLS_OR_FN_NAME": "DecodeSim",
-            "KT_CALLABLE_NAME": "DecodeSim",
+            "KT_CLS_OR_FN_NAME": name,
+            "KT_CALLABLE_NAME": name,
             "KT_CALLABLE_TYPE": "cls",
             "KT_ROOT_PATH": root,
-            "KT_IMPORT_PATH": "decode_sim",
+            "KT_IMPORT_PATH": import_path,
             "KT_NUM_PROCS": "1",
             "KT_ALLOWED_SERIALIZATION": "json,pickle",
-            "KT_INIT_ARGS": _json.dumps({"kwargs": {
+            "KT_INIT_ARGS": _json.dumps({"kwargs": init_kwargs if
+                                         init_kwargs is not None else {
                 "device_ms": device_ms, "batch": batch, "steps": steps}}),
+            **(extra_env or {}),
         }
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "kubetorch_tpu.serving.server",
@@ -612,6 +618,274 @@ def bench_call_channel(device_ms: float = 3.0, batch: int = 8,
     return out
 
 
+# ---------------------------------------------------------------------
+# Engine phase (ISSUE 10): the SERVER-RESIDENT generation loop vs the
+# client-driven chunk loop. BENCH_r05's two headline serving gaps —
+# 144 ms/chunk dispatch tax (client drives every chunk) and 182 ms
+# admission-swap overhead with 561 ms TTFT p50 (admission swaps whole
+# batches) — both disappear when the loop lives where the batch lives:
+# the client submits ONE generation program as a streamed channel call
+# and serving/engine.py runs rolling steps back-to-back, admitting
+# per-row and interleaving chunked prefill between decode chunks.
+#
+# Keys (asserted by tests/test_serving_smoke.py):
+# - engine_tok_s_tunnel_wall     delivered tok/s through the tunnel with
+#                                the engine loop server-side
+# - engine_device_tok_s          the same window's device-side rate
+# - engine_tunnel_ratio          tunnel/device — the acceptance number
+#                                (full run asserts >= 0.9 vs BENCH_r05's
+#                                0.61)
+# - engine_dispatch_ms_per_chunk amortized fixed cost per decode chunk
+#                                (wall minus device over the chunk count)
+# - engine_ttft_ms_p50/p99       Poisson-phase first-token latency with
+#                                per-row admission
+# - engine_poisson_goodput_ratio delivered / offered under open-loop load
+# - engine_prefill_interleave_ok scheduler invariant: decode never
+#                                stalled while a long prompt prefilled
+# - engine_admit_to_first_token_chunks  ticks from admission to first
+#                                token for a chunked-prefill prompt
+#
+# The pod hosts DecodeEngine over the host-only SimRollingEngine (the
+# scheduler cannot tell it from the real thing), so the phase runs on
+# CPU CI; the full bench re-runs it with step_ms set to phase 1's
+# differenced device time, composing device truth with loop overhead.
+
+_ENGINE_HOST = '''\
+"""Engine host served by the engine bench (written to a temp dir; the
+pod worker imports it by path): DecodeEngine over SimRollingEngine."""
+from kubetorch_tpu.serving.engine import DecodeEngine, SimRollingEngine
+
+
+class EngineHost:
+    def __init__(self, max_slots=8, steps_per_call=16, step_ms=20.0,
+                 prefill_chunk=32):
+        self.engine = DecodeEngine(SimRollingEngine(
+            max_slots=int(max_slots), steps_per_call=int(steps_per_call),
+            prefill_chunk=int(prefill_chunk),
+            step_s=float(step_ms) / 1e3))
+
+    def generate(self, program):
+        yield from self.engine.generate(program)
+
+    def stats(self):
+        return self.engine.stats()
+
+    def ping(self):
+        return "pong"
+'''
+
+
+def _bench_engine_scheduler() -> dict:
+    """In-process scheduler invariants (no pod, no model): chunked
+    prefill must interleave — the live stream keeps emitting while a
+    long prompt fills — and admit-to-first-token must be bounded by the
+    prompt's chunk count."""
+    import threading
+
+    from kubetorch_tpu.serving.engine import DecodeEngine, SimRollingEngine
+
+    out: dict = {}
+    long_p = list(range(10, 74))                    # 64 tokens = 8 chunks
+    eng = DecodeEngine(
+        SimRollingEngine(max_slots=4, steps_per_call=8, prefill_chunk=8,
+                         step_s=0.002), poll_s=0.001)
+    stamps: dict = {"short": [], "long": []}
+
+    def drain(name, prog):
+        for f in eng.generate(prog):
+            stamps[name].append(time.perf_counter())
+
+    import contextvars
+
+    try:
+        ts = threading.Thread(
+            target=contextvars.copy_context().run, args=(
+                drain, "short",
+                {"prompt": [1, 2, 3], "max_new_tokens": 400}))
+        ts.start()
+        wait_deadline = time.time() + 30
+        while not stamps["short"]:
+            if time.time() > wait_deadline or not ts.is_alive():
+                raise RuntimeError(
+                    "engine scheduler bench: the short stream never "
+                    "produced a frame (engine loop broken?)")
+            time.sleep(0.001)
+        t_submit = time.perf_counter()
+        tl = threading.Thread(
+            target=contextvars.copy_context().run, args=(
+                drain, "long",
+                {"prompt": long_p, "max_new_tokens": 16}))
+        tl.start()
+        ts.join(60)
+        tl.join(60)
+        t_first_long = stamps["long"][0]
+        short_during = [t for t in stamps["short"]
+                        if t_submit <= t < t_first_long]
+        out["engine_prefill_interleave_ok"] = float(
+            len(short_during) >= 3)
+    finally:
+        eng.close()
+
+    # admit-to-first-token in TICKS, hand-driven (wall-free — CI-safe):
+    # a 64-token prompt at chunk 8 needs 8 prefill ticks + its first
+    # decode tick, decode running the whole way
+    sim = SimRollingEngine(max_slots=2, steps_per_call=4,
+                           prefill_chunk=8, step_s=0.0)
+    bg = sim.submit([1], max_new_tokens=10 ** 6)
+    sim.step()
+    r_long = sim.submit(long_p, max_new_tokens=8)
+    ticks = 0
+    while ticks < 100:
+        ticks += 1
+        events = sim.step()
+        assert any(r == bg and toks for r, toks, _ in events), \
+            "decode stalled during chunked prefill"
+        if any(r == r_long and toks for r, toks, _ in events):
+            break
+    sim.evict(bg)
+    out["engine_admit_to_first_token_chunks"] = ticks
+    return out
+
+
+def bench_engine(step_ms: float = 20.0, batch: int = 8,
+                 steps_per_call: int = 16, n_tokens: int = 320,
+                 poisson_programs: int = 24, load: float = 0.6,
+                 dryrun: bool = False) -> dict:
+    """Measure the server-resident engine loop end-to-end: a real pod
+    server + worker hosting DecodeEngine, driven by generation programs
+    over the channel. ``step_ms`` is the simulated per-decode-chunk
+    device time (the full bench passes phase 1's differenced number);
+    ``load`` the Poisson phase's offered fraction of device capacity."""
+    import os
+    import random
+    import shutil
+    import tempfile
+    import threading
+
+    from kubetorch_tpu.serving.channel import CallChannel
+    from kubetorch_tpu.serving.engine import SimRollingEngine
+
+    if dryrun:
+        step_ms, batch, steps_per_call = 20.0, 8, 16
+        n_tokens, poisson_programs, load = 320, 24, 0.6
+    out = _bench_engine_scheduler()
+    out["engine_step_ms_cfg"] = step_ms
+    out["engine_chunk_tokens"] = batch * steps_per_call
+
+    root = tempfile.mkdtemp(prefix="kt-bench-engine-")
+    with open(os.path.join(root, "engine_host.py"), "w") as f:
+        f.write(_ENGINE_HOST)
+    server = _PodServer(
+        root, step_ms, batch, steps_per_call, name="EngineHost",
+        import_path="engine_host",
+        init_kwargs={"max_slots": batch, "steps_per_call": steps_per_call,
+                     "step_ms": step_ms, "prefill_chunk": 32},
+        extra_env={"KT_WORKER_THREADS": str(max(32, 2 * batch)),
+                   "KT_ENGINE_POLL_S": "0.002"})
+    try:
+        # ---- tunnel wall: fill every row, one program per row --------
+        with CallChannel(server.url, "EngineHost", depth=batch) as chan:
+            chan.call(method="ping")       # connect + import, off-clock
+            st0 = chan.call(method="stats")
+            prompts = [[i + 1, i + 2, i + 3] for i in range(batch)]
+            calls = []
+            t0 = time.perf_counter()
+            for p in prompts:
+                calls.append(chan.submit(
+                    {"prompt": p, "max_new_tokens": n_tokens},
+                    method="generate", stream=True, concurrent=True,
+                    timeout=120.0))
+            total = 0
+            for call, p in zip(calls, prompts):
+                toks = [t for f in call.result(timeout=300)
+                        for t in f["tokens"]]
+                assert toks == SimRollingEngine.expected_tokens(
+                    p, n_tokens), "engine stream tokens diverged"
+                total += len(toks)
+            wall = time.perf_counter() - t0
+            st1 = chan.call(method="stats")
+        steps = max(1, st1["steps"] - st0["steps"])
+        dev_s = max(1e-9, st1["device_s"] - st0["device_s"])
+        out["engine_tok_s_tunnel_wall"] = round(total / wall, 1)
+        out["engine_device_tok_s"] = round(total / dev_s, 1)
+        out["engine_tunnel_ratio"] = round(
+            out["engine_tok_s_tunnel_wall"]
+            / out["engine_device_tok_s"], 4)
+        out["engine_dispatch_ms_per_chunk"] = round(
+            max(0.0, wall - dev_s) / steps * 1e3, 2)
+        if not dryrun and out["engine_tunnel_ratio"] < 0.9:
+            # the acceptance bar: with the loop server-side the tunnel
+            # rate sits within 10% of device-side (BENCH_r05's
+            # client-driven loop managed 61%)
+            raise RuntimeError(
+                f"engine tunnel ratio {out['engine_tunnel_ratio']} "
+                f"below the 0.9 acceptance floor")
+
+        # ---- Poisson arrivals: per-row admission TTFT + goodput ------
+        rnd = random.Random(0)
+        lens = [rnd.randrange(2 * steps_per_call, 8 * steps_per_call + 1)
+                for _ in range(poisson_programs)]
+        capacity = batch * steps_per_call / (step_ms / 1e3)
+        offered = load * capacity
+        lam_req = offered / (sum(lens) / len(lens))
+        arrive, acc = [], 0.0
+        for _ in lens:
+            acc += rnd.expovariate(lam_req)
+            arrive.append(acc)
+        results: list = []
+        threads = []
+        with CallChannel(server.url, "EngineHost", depth=batch) as chan:
+            chan.call(method="ping")
+            t_start = time.perf_counter()
+            for i, n_i in enumerate(lens):
+                lag = arrive[i] - (time.perf_counter() - t_start)
+                if lag > 0:
+                    time.sleep(lag)
+                call = chan.submit(
+                    {"prompt": [i + 1, 7], "max_new_tokens": n_i},
+                    method="generate", stream=True, concurrent=True,
+                    timeout=120.0)
+                t_sub = time.perf_counter()
+
+                def drain_one(call=call, t_sub=t_sub):
+                    first = None
+                    count = 0
+                    for frame in call:
+                        if first is None and frame["tokens"]:
+                            first = time.perf_counter()
+                        count += len(frame["tokens"])
+                    results.append((t_sub, first, time.perf_counter(),
+                                    count))
+
+                import contextvars as _cv
+
+                th = threading.Thread(
+                    target=_cv.copy_context().run, args=(drain_one,),
+                    daemon=True)
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join(300)
+        assert len(results) == poisson_programs, \
+            f"{len(results)}/{poisson_programs} programs completed"
+        ttft = [(first - t_sub) * 1e3 for t_sub, first, _, _ in results
+                if first is not None]
+        done_wall = max(t_done for _, _, t_done, _ in results) - t_start
+        delivered = sum(c for _, _, _, c in results) / done_wall
+        out.update({
+            "engine_poisson_programs": poisson_programs,
+            "engine_poisson_offered_tok_s": round(offered, 1),
+            "engine_poisson_tok_s": round(delivered, 1),
+            "engine_poisson_goodput_ratio": round(delivered / offered, 4),
+            "engine_ttft_ms_p50": round(_pct(ttft, 50), 1),
+            "engine_ttft_ms_p99": round(_pct(ttft, 99), 1),
+        })
+    finally:
+        server.stop()
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def run(dryrun: bool = False, static_tok_s: float = 5673.0) -> dict:
     """Full serving bench. ``dryrun`` (CI smoke) runs only the
     call-tunnel phase at toy sizes — the model phases need a chip-scale
@@ -620,7 +894,9 @@ def run(dryrun: bool = False, static_tok_s: float = 5673.0) -> dict:
     ``rolling_tok_s_tunnel_wall_pipelined`` composes phase-1 device
     truth with the measured channel overhead."""
     if dryrun:
-        return bench_call_channel(dryrun=True)
+        out = bench_call_channel(dryrun=True)
+        out.update(bench_engine(dryrun=True))
+        return out
     out = bench_8b_rolling(static_tok_s=static_tok_s) or {}
     if out:
         chan = bench_call_channel(
@@ -633,6 +909,13 @@ def run(dryrun: bool = False, static_tok_s: float = 5673.0) -> dict:
         # rolling_tok_s_tunnel_wall for cross-round comparability
         out["rolling_tok_s_tunnel_wall_pipelined"] = \
             chan["serving_tok_s_pipelined"]
+        # engine phase at phase 1's measured per-chunk device time: the
+        # server-resident loop's tunnel rate composes device truth with
+        # loop overhead — and asserts the 10% acceptance bar
+        out.update(bench_engine(
+            step_ms=out["ms_per_step_device"] * out["steps_per_call"],
+            batch=min(out["batch"], 16),
+            steps_per_call=out["steps_per_call"]))
     return out
 
 

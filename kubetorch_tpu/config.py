@@ -320,6 +320,27 @@ _knob("KT_CB_RESET_S", "float", 10.0,
       "Seconds an open circuit breaker waits before half-opening to let "
       "one probe call through.", "serving-reliability")
 
+# --- serving engine (server-resident continuous-batching decode loop) -------
+_knob("KT_ENGINE_PREFILL_CHUNK", "int", 64,
+      "Tokens per interleaved prefill chunk: prompts longer than this "
+      "prefill into the live grid one chunk per decode step instead of "
+      "one monolithic admission, so long prompts never stall token "
+      "emission.", "engine")
+_knob("KT_ENGINE_ADMIT_ROWS", "int", 0,
+      "Max rows admitted into the live batch per engine tick "
+      "(0 = every free row).", "engine")
+_knob("KT_ENGINE_MAX_WAITING", "int", 512,
+      "Hard cap on generation requests queued ahead of admission; past "
+      "it new programs are shed typed (ServerOverloaded / 429) "
+      "(0 disables).", "engine")
+_knob("KT_ENGINE_POLL_S", "float", 0.02,
+      "Idle wait of the engine driver thread between work checks.",
+      "engine")
+_knob("KT_ENGINE_STALL_S", "float", 120.0,
+      "Seconds a generation stream waits for the next engine frame "
+      "before its rows are evicted and the stream fails typed.",
+      "engine")
+
 # --- distributed ------------------------------------------------------------
 _knob("KT_POD_IPS", "str", None,
       "Comma-separated pod IPs for the gang (rendezvous).", "distributed")

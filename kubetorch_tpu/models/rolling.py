@@ -54,7 +54,7 @@ def _bucket(n: int, lo: int = 16) -> int:
 class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature",
                  "tokens", "done", "slot", "prefix_id", "stop",
-                 "repetition_penalty", "adapter_id")
+                 "repetition_penalty", "adapter_id", "consumed")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature):
         self.rid = rid
@@ -68,6 +68,7 @@ class Request:
         self.stop: List[List[int]] = []
         self.repetition_penalty: float = 1.0
         self.adapter_id: int = -1
+        self.consumed = 0  # prompt tokens already prefilled (chunked path)
 
     def match_stop(self) -> Optional[int]:
         """Earliest index (exclusive) at which a stop sequence completes in
@@ -101,7 +102,8 @@ class RollingGenerator:
                  steps_per_call: int = 8, admit_width: int = 0,
                  adapters=None, adapter_scale: Optional[float] = None,
                  kv_dtype: str = "bf16", spec_k: int = 0,
-                 spec_ngram: int = 3):
+                 spec_ngram: int = 3,
+                 prefill_chunk: Optional[int] = None):
         """``kv_dtype="int8"``: per-vector-quantized grid — halves the
         serving cache's stream and residency, moving the slot ceiling the
         same way it moved the static Generator's batch ceiling (112 → 192
@@ -127,7 +129,17 @@ class RollingGenerator:
         under the filtered distribution; rejections draw from the
         residual — the emitted stream is distributed exactly as
         non-speculative sampling); ``repetition_penalty != 1`` is
-        rejected, matching the static ``SpeculativeGenerator``."""
+        rejected, matching the static ``SpeculativeGenerator``.
+
+        ``prefill_chunk``: prompts longer than this prefill in
+        ``prefill_chunk``-token chunks written STRAIGHT INTO the shared
+        grid at the row's current depth (one ``_prefill_extend`` dispatch
+        per chunk, interleaved between decode chunks by the serving
+        engine) instead of one monolithic private-cache prefill — a long
+        prompt never stalls token emission for the live rows. ``None``
+        (default) keeps the one-shot bucketed admission path everywhere;
+        requests with ``prefix_id`` (their context is mostly
+        pre-computed) and speculative engines keep it regardless."""
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
@@ -170,6 +182,15 @@ class RollingGenerator:
                              f"got {kv_dtype!r}")
         if spec_k < 0 or spec_k == 1:
             raise ValueError("spec_k must be 0 (off) or >= 2")
+        if prefill_chunk is not None and spec_k > 1:
+            # the spec engine seeds a device-resident draft context at
+            # admission; feeding it incrementally is future work
+            raise ValueError("prefill_chunk is not supported with "
+                             "speculative decoding (spec_k > 1)")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, "
+                             f"got {prefill_chunk}")
+        self.prefill_chunk = prefill_chunk
         self.kv_quantized = kv_dtype == "int8"
         self.spec_k = spec_k
         self.spec_ngram = spec_ngram
@@ -204,6 +225,10 @@ class RollingGenerator:
         self._free = list(range(max_slots))
         self._slots: Dict[int, Request] = {}
         self._queue: List[Request] = []
+        # slot -> Request mid-chunked-prefill: the row is OWNED (not in
+        # _free) but not decoding yet (_dactive False); prefill_step()
+        # advances these one chunk per dispatch
+        self._prefilling: Dict[int, Request] = {}
         self._next_rid = 0
         self._temps = np.zeros(max_slots, np.float32)
         self._penalties = np.ones(max_slots, np.float32)
@@ -230,6 +255,9 @@ class RollingGenerator:
         self._prefill_px = jax.jit(
             partial(self._prefill_px_impl, cfg=cfg, rules=self.rules),
             static_argnames=("p_pad",), donate_argnums=(1, 2, 3, 4))
+        self._prefill_ext = jax.jit(
+            partial(self._prefill_extend_impl, cfg=cfg, rules=self.rules),
+            static_argnames=("C",), donate_argnums=(1, 2, 3, 4))
         if self.spec:
             self._decode_sp = jax.jit(
                 partial(self._decode_spec_impl, cfg=cfg, rules=self.rules),
@@ -255,7 +283,25 @@ class RollingGenerator:
     # ------------------------------------------------------------ public
     @property
     def pending(self) -> int:
-        return len(self._queue) + len(self._slots)
+        return (len(self._queue) + len(self._slots)
+                + len(self._prefilling))
+
+    @property
+    def queued(self) -> int:
+        """Requests waiting for a row (not yet admitted)."""
+        return len(self._queue)
+
+    @property
+    def free_rows(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_rows(self) -> int:
+        return len(self._slots)
+
+    @property
+    def prefilling_rows(self) -> int:
+        return len(self._prefilling)
 
     @property
     def spec_stats(self) -> Dict[str, float]:
@@ -327,28 +373,130 @@ class RollingGenerator:
         return rid
 
     def step(self) -> List[Tuple[int, List[int], bool]]:
-        """Admit queued requests into free slots, run one decode chunk
-        (``steps_per_call`` tokens). Returns ``(rid, new_tokens,
-        finished)`` per active request."""
-        # Batched admission: all same-(bucket, prefix) arrivals prefill in
-        # ONE call (a per-call dispatch costs more than the prefill compute
-        # for short prompts; grouping cuts admission dispatches
-        # ~max_slots×).
+        """Admit queued requests into free slots, advance any chunked
+        prefills by one chunk, run one decode chunk (``steps_per_call``
+        tokens). Returns ``(rid, new_tokens, finished)`` per active
+        request. The serving engine drives :meth:`admit` /
+        :meth:`prefill_step` / :meth:`decode_step` individually (for
+        per-phase spans and scheduling control); ``step()`` composes
+        them for hand-driven use."""
+        self.admit()
+        self.prefill_step()
+        return self.decode_step()
+
+    def admit(self, max_rows: Optional[int] = None) -> int:
+        """Row-granular admission: move queued requests into free rows of
+        the LIVE batch (at most ``max_rows`` this wave). Short prompts
+        take the grouped private-cache prefill + splice path
+        (:meth:`_admit_group`/:meth:`_finish_admit`); prompts longer than
+        ``prefill_chunk`` enter CHUNKED prefill — their row is claimed
+        now but fills one :meth:`prefill_step` chunk at a time, so a long
+        prompt never blocks the decode cadence of the rows around it.
+        Returns the number of rows claimed.
+
+        Batched admission: all same-(bucket, prefix) arrivals prefill in
+        ONE call (a per-call dispatch costs more than the prefill compute
+        for short prompts; grouping cuts admission dispatches
+        ~max_slots×)."""
+        admitted = 0
         by_key: Dict[tuple, List[Request]] = {}
-        while self._free and self._queue:
+        while self._free and self._queue and (
+                max_rows is None or admitted < max_rows):
             req = self._queue.pop(0)
             req.slot = self._free.pop(0)
+            admitted += 1
+            if (self.prefill_chunk is not None
+                    and req.prefix_id is None
+                    and len(req.prompt) > self.prefill_chunk):
+                self._start_chunked(req)
+                continue
             key = (_bucket(len(req.prompt)), req.prefix_id)
             by_key.setdefault(key, []).append(req)
         for (p_pad, prefix_id), group in by_key.items():
             for i in range(0, len(group), self.admit_width):
                 self._admit_group(group[i:i + self.admit_width], p_pad,
                                   prefix_id)
+        return admitted
+
+    def decode_step(self) -> List[Tuple[int, List[int], bool]]:
+        """One decode chunk over the active rows (no admission)."""
         if not self._slots:
             return []
         if self.spec:
             return self._decode_spec_chunk()
         return self._decode_chunk()
+
+    def prefill_step(self) -> List[int]:
+        """Advance every mid-chunked-prefill row by one
+        ``prefill_chunk``-token chunk — ONE dispatch for all of them,
+        written straight into the shared grid at each row's depth —
+        activating rows whose prompt completes. Returns the rids that
+        became decode-active this call."""
+        if not self._prefilling:
+            return []
+        C = self.prefill_chunk
+        B = self.max_slots
+        feed = np.zeros((B, C), np.int32)
+        counts = np.zeros(B, np.int32)
+        finals = np.zeros(B, bool)
+        done_reqs: List[Request] = []
+        for slot, req in self._prefilling.items():
+            rem = req.prompt[req.consumed:req.consumed + C]
+            feed[slot, :len(rem)] = rem
+            counts[slot] = len(rem)
+            req.consumed += len(rem)
+            if req.consumed >= len(req.prompt):
+                finals[slot] = True
+                done_reqs.append(req)
+        with self._mesh_ctx():
+            (self.cache, self._logits, self._dpos,
+             self._dactive) = self._prefill_ext(
+                self.params, self.cache, self._logits, self._dpos,
+                self._dactive, jnp.asarray(feed), jnp.asarray(counts),
+                jnp.asarray(finals), self._lora(self._slot_onehot), C=C)
+        activated: List[int] = []
+        for req in done_reqs:
+            del self._prefilling[req.slot]
+            # the host half _admit_group does for one-shot admissions
+            self._temps[req.slot] = req.temperature
+            self._penalties[req.slot] = req.repetition_penalty
+            W = self._win.shape[1]
+            tail = req.prompt[-W:]
+            self._win[req.slot] = -1
+            if req.repetition_penalty != 1.0 and tail:
+                self._win[req.slot, -len(tail):] = tail
+            self._slots[req.slot] = req
+            activated.append(req.rid)
+        return activated
+
+    def evict(self, rid: int) -> bool:
+        """Row-granular eviction: cancel a queued, mid-prefill, or
+        decoding request and free its row immediately. The freed row's
+        cache plane is reusable as-is — attention is masked to rows
+        below each slot's depth (and a fresh admission rewrites from
+        row 0), so stale K/V is never read. Returns whether the rid was
+        found."""
+        for i, req in enumerate(self._queue):
+            if req.rid == rid:
+                self._queue.pop(i)
+                return True
+        slot = None
+        for s, req in self._prefilling.items():
+            if req.rid == rid:
+                slot = s
+                break
+        if slot is not None:
+            del self._prefilling[slot]
+        else:
+            for s, req in self._slots.items():
+                if req.rid == rid:
+                    slot = s
+                    break
+            if slot is None:
+                return False
+            del self._slots[slot]
+        self._free_rows([slot])
+        return True
 
     def run(self) -> Dict[int, List[int]]:
         """Drain everything; → {rid: generated tokens}."""
@@ -418,6 +566,18 @@ class RollingGenerator:
                 self.run()
 
     # ----------------------------------------------------------- interns
+    def _start_chunked(self, req: Request) -> None:
+        """Claim the row for a chunked prefill. No dispatch here: the
+        row's ``dpos`` is already 0 (rows reset on free/evict) and its
+        grid rows are rewritten from position 0 by the chunk forwards.
+        Only the lora one-hot must be live during prefill — the chunk
+        forwards run under it."""
+        req.consumed = 0
+        self._slot_onehot[req.slot] = 0.0
+        if req.adapter_id >= 0:
+            self._slot_onehot[req.slot, req.adapter_id] = 1.0
+        self._prefilling[req.slot] = req
+
     def _admit_group(self, group: List[Request], p_pad: int,
                      prefix_id: Optional[int] = None):
         """Prefill N same-(bucket, prefix) requests in one call. N pads
@@ -593,23 +753,28 @@ class RollingGenerator:
                 del self._slots[slot]
                 freed.append(slot)
         if freed:
-            # FIXED-shape mask update, never a variable-length index
-            # scatter: `.at[freed].set` compiles a fresh executable per
-            # distinct len(freed), and on a remote-dispatch link each of
-            # those tiny compiles costs seconds — speculative drains
-            # (scattered finish times) measured 7-14 s spikes per new
-            # freed-count until this was masked
-            mask = np.zeros(self.max_slots, bool)
-            mask[freed] = True
-            mask = jnp.asarray(mask)
-            self._dactive = jnp.where(mask, False, self._dactive)
-            self._dpos = jnp.where(mask, 0, self._dpos)
-            self._slot_onehot[freed] = 0.0
-            for slot in freed:
-                self._win[slot] = -1
-                self._penalties[slot] = 1.0
-            self._free.extend(freed)
+            self._free_rows(freed)
         return events
+
+    def _free_rows(self, freed: List[int]) -> None:
+        """Release rows back to the free pool (finish or evict).
+
+        FIXED-shape mask update, never a variable-length index
+        scatter: `.at[freed].set` compiles a fresh executable per
+        distinct len(freed), and on a remote-dispatch link each of
+        those tiny compiles costs seconds — speculative drains
+        (scattered finish times) measured 7-14 s spikes per new
+        freed-count until this was masked."""
+        mask = np.zeros(self.max_slots, bool)
+        mask[freed] = True
+        mask = jnp.asarray(mask)
+        self._dactive = jnp.where(mask, False, self._dactive)
+        self._dpos = jnp.where(mask, 0, self._dpos)
+        self._slot_onehot[freed] = 0.0
+        for slot in freed:
+            self._win[slot] = -1
+            self._penalties[slot] = 1.0
+        self._free.extend(freed)
 
     # ------------------------------------------------------------- jitted
     @staticmethod
@@ -743,6 +908,55 @@ class RollingGenerator:
             prefix_len + prompt_lens)
 
     @staticmethod
+    def _prefill_extend_impl(params, cache, logits, dpos, dactive, feed,
+                             counts, finals, lora, *, C, cfg, rules):
+        """Advance N in-progress chunked prefills by ≤ ``C`` tokens each,
+        GRID-RESIDENT: the chunk forward runs at full grid width (rows
+        with ``counts == 0`` are masked out and merge nothing), attends
+        over each row's already-written grid rows plus the causal chunk,
+        and merges the new K/V at each row's depth via the shared
+        one-hot einsum (``llama.merge_chunk_into_grid``) — the exact
+        write path decode chunks use, so ONE compiled executable per
+        ``C`` covers every chunk of every prompt length.
+
+        ``finals`` marks rows whose prompt completes in this chunk:
+        their last real token's logits (``unembed_positions`` keeps the
+        unembed at one position per row — [B, C, V] float32 would be
+        multi-GB at serving scale) seed the decode loop and the row
+        activates. Rows mid-prompt keep ``dactive`` False — decode
+        chunks skip them (zero merge count, no depth advance) while
+        this path fills them, which is what lets the serving engine
+        interleave prefill chunks between decode chunks without ever
+        stalling token emission."""
+        M = cache["k"].shape[2]
+        B = feed.shape[0]
+        L, _, _, Hkv, D = cache["k"].shape
+        cdt = jnp.bfloat16 if "ks" in cache else cache["k"].dtype
+        live = counts > 0
+        positions = dpos[:, None] + jnp.arange(C)[None, :]
+        gmask = jnp.broadcast_to(
+            (jnp.arange(M)[None, None, :] < dpos[:, None, None])
+            & live[:, None, None], (B, C, M))
+        # causal within the chunk, clipped to each row's real tokens;
+        # queries past count attend only real columns (their outputs are
+        # discarded — unembed reads count-1 — and their chunk-cache
+        # writes land at columns >= count, which the merge drops)
+        emask = ((jnp.arange(C)[None, None, :]
+                  <= jnp.arange(C)[None, :, None])
+                 & (jnp.arange(C)[None, None, :]
+                    < counts[:, None, None]))
+        chunk = {"k": jnp.zeros((L, B, C, Hkv, D), cdt),
+                 "v": jnp.zeros((L, B, C, Hkv, D), cdt)}
+        out, chunk = llama.forward_cached(
+            params, feed, positions, cache, None, gmask, cfg, rules,
+            chunk=chunk, chunk_col=0, chunk_mask=emask,
+            unembed_positions=jnp.maximum(counts - 1, 0), lora=lora)
+        cache = llama.merge_chunk_into_grid(cache, chunk, dpos, counts)
+        fin = finals & live
+        logits = jnp.where(fin[:, None], out[:, 0], logits)
+        return cache, logits, dpos + counts, dactive | fin
+
+    @staticmethod
     def _decode_impl(params, cache, last_logits, pos, active, temps,
                      penalties, window, key, lora, *,
                      top_k, top_p, n_steps, cfg, rules):
@@ -822,10 +1036,13 @@ class RollingGenerator:
         # Merge the chunk into the grid at each slot's offset — shared
         # one-hot einsum select (llama.merge_chunk_into_grid; see its
         # docstring for why never take_along_axis/scatter). Inactive
-        # slots merge nothing: count 0.
+        # slots merge nothing: count 0 — and their depth must not
+        # advance either: a row mid-CHUNKED-PREFILL (owned but not yet
+        # decoding) rides through decode chunks, and a drifting dpos
+        # would land its next prefill chunk past the real prompt.
         new_cache = llama.merge_chunk_into_grid(
             cache, chunk, pos0, jnp.where(active, n_steps, 0))
-        return new_cache, logits, pos, toks
+        return new_cache, logits, jnp.where(active, pos, pos0), toks
 
     @staticmethod
     def _decode_spec_impl(params, cache, last_logits, pos, active, ctx,
@@ -1019,6 +1236,12 @@ class RollingDecoder:
         }
 
     def pending(self) -> int:
+        """Host bookkeeping only — no device sync. Prefer
+        ``chan.control("stats")`` for polling: a control frame is
+        answered by the pod server out-of-band (it never queues behind
+        pipelined ``step()`` calls in the channel FIFO and never pays a
+        worker hop), from the engine snapshot the worker piggybacks on
+        call responses."""
         return self.engine.pending
 
     def warmup(self, prompt_buckets=(16, 64, 128)) -> bool:
@@ -1026,11 +1249,14 @@ class RollingDecoder:
         return True
 
     def stats(self) -> Dict[str, Any]:
+        """Host bookkeeping only (no device sync) — see :meth:`pending`
+        for the cheaper control-frame polling path."""
         eng = self.engine
         return {"max_slots": eng.max_slots, "max_len": eng.max_len,
                 "steps_per_call": eng.steps_per_call,
                 "free_slots": len(eng._free), "queued": len(eng._queue),
                 "active": len(eng._slots),
+                "prefilling": len(eng._prefilling),
                 **({"spec": eng.spec_stats} if eng.spec else {})}
 
 

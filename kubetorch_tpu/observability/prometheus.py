@@ -462,6 +462,77 @@ def reliability_samples(labels: Optional[Dict[str, str]] = None):
 
 
 # ------------------------------------------------------------------
+# Serving-engine counters (serving/engine.py — the server-resident
+# continuous-batching decode loop). Recorded in the WORKER process that
+# hosts the engine; they piggyback on call responses next to the device
+# stats (process_worker._attach_worker_metrics) and merge pid-tagged
+# into the pod's /metrics, where the control-frame path and (later) the
+# autoscaler read the queue-depth/occupancy gauges.
+_ENGINE_LOCK = threading.Lock()
+_ENGINE: Dict[str, float] = {
+    "engine_generations_total": 0.0,
+    "engine_steps_total": 0.0,
+    "engine_tokens_total": 0.0,
+    "engine_admitted_rows_total": 0.0,
+    "engine_prefill_chunks_total": 0.0,
+    "engine_evictions_total": 0.0,
+    "engine_sheds_total": 0.0,
+    "engine_tick_errors_total": 0.0,
+    "engine_device_seconds_total": 0.0,
+    "engine_queue_depth": 0.0,
+    "engine_active_rows": 0.0,
+    "engine_free_rows": 0.0,
+    "engine_prefilling_rows": 0.0,
+}
+_ENGINE_EVENTS = {
+    "generation": "engine_generations_total",
+    "step": "engine_steps_total",
+    "tokens": "engine_tokens_total",
+    "admit": "engine_admitted_rows_total",
+    "prefill_chunk": "engine_prefill_chunks_total",
+    "evict": "engine_evictions_total",
+    "shed": "engine_sheds_total",
+    "tick_error": "engine_tick_errors_total",
+    "device_seconds": "engine_device_seconds_total",
+}
+_ENGINE_GAUGES = {
+    "queue_depth": "engine_queue_depth",
+    "active_rows": "engine_active_rows",
+    "free_rows": "engine_free_rows",
+    "prefilling_rows": "engine_prefilling_rows",
+}
+
+
+def record_engine(event: str, value: float = 1.0) -> None:
+    """Bump a serving-engine counter (``generation`` / ``step`` /
+    ``tokens`` / ``admit`` / ``prefill_chunk`` / ``evict`` / ``shed`` /
+    ``tick_error`` / ``device_seconds``) or set an occupancy gauge
+    (``queue_depth`` / ``active_rows`` / ``free_rows`` /
+    ``prefilling_rows``)."""
+    with _ENGINE_LOCK:
+        counter = _ENGINE_EVENTS.get(event)
+        if counter is not None:
+            _ENGINE[counter] += value
+            return
+        gauge = _ENGINE_GAUGES.get(event)
+        if gauge is not None:
+            _ENGINE[gauge] = value
+
+
+def engine_metrics() -> Dict[str, float]:
+    """Snapshot of the serving-engine counters/gauges."""
+    with _ENGINE_LOCK:
+        return dict(_ENGINE)
+
+
+def engine_samples(labels: Optional[Dict[str, str]] = None):
+    """Exposition samples for the serving-engine counters."""
+    labels = labels or {}
+    for name, value in engine_metrics().items():
+        yield name, labels, value
+
+
+# ------------------------------------------------------------------
 # Resilience counters (resilience/ subsystem: liveness, preemption, gang
 # restart). Process-local like the rest: the CONTROLLER process records
 # heartbeat/liveness/restart events (its /metrics joins them via

@@ -482,6 +482,31 @@ class CallChannel:
         channel (pipelining needs :meth:`submit`)."""
         return self.submit(*args, **kwargs).result()
 
+    def control(self, op: str = "stats",
+                timeout: Optional[float] = 10.0) -> Dict[str, Any]:
+        """Out-of-band control round-trip (``kind: ctl`` frame): the pod
+        server answers DIRECTLY from pod/session state plus the last
+        worker-piggybacked ``engine_*`` snapshot — the frame never joins
+        the session FIFO (it cannot queue behind pipelined decode
+        chunks) and never costs a worker or device hop. The cheap way to
+        poll queue depth / engine occupancy while a stream is live.
+
+        Control frames don't consume a pipeline-depth slot (they are not
+        calls) and are idempotent: a reconnect simply re-asks."""
+        if self._closed:
+            raise ChannelClosedError("channel is closed")
+        self._breaker.check()
+        with self._submit_lock:
+            cid = next(self._cids)
+            call = ChannelCall(cid, 0.0, False, timeout, None)
+            call._header = {"cid": cid, "kind": "ctl", "op": op}
+            call._body = b""
+            call._t_send = time.perf_counter()
+            with self._calls_lock:
+                self._calls[cid] = call
+            self._enqueue(cid)
+        return call.result(timeout)
+
     @property
     def inflight(self) -> int:
         with self._calls_lock:

@@ -1,0 +1,625 @@
+"""Server-resident continuous-batching decode engine.
+
+BENCH_r05 measured rolling decode at 6,850 tok/s device-side but only
+4,168 tok/s through the tunnel: the client still *drove* every 8-step
+chunk over the channel, paying ~144 ms of dispatch per chunk, and the
+Poisson phase lost another 182 ms per admission because admission
+swapped whole rolling batches. Both taxes have the same root cause —
+the generation loop lived on the wrong side of the wire. This module
+moves it server-side:
+
+- the client submits ONE **generation program** — prompt(s), stopping
+  criteria, sampling params, an optional deadline — as a single
+  streamed channel call (``submit(program, method="generate",
+  stream=True, concurrent=True)``);
+- :class:`DecodeEngine`'s driver thread (inside the pod WORKER, the
+  process that owns the TPU) runs rolling-engine steps back-to-back,
+  device-resident, and routes each chunk's tokens into the program's
+  stream as a frame — the per-chunk client round trip disappears from
+  the steady state entirely;
+- frames ride the PR-2 channel with per-frame ``seq``s recorded in the
+  PR-8 result-retention ring, so replay/deadline semantics apply **per
+  generation**: a mid-stream partition resumes the token stream
+  byte-identical from the client's ack cursor, with the program having
+  executed exactly once.
+
+On top of the loop sits a **per-row admission scheduler**:
+
+- new requests are admitted into free rows of the LIVE batch
+  (``RollingGenerator.admit`` → the existing ``_admit_group`` /
+  ``_finish_admit`` splice path) — never by swapping whole batches;
+- long prompts prefill in ``KT_ENGINE_PREFILL_CHUNK``-token chunks
+  *interleaved between decode chunks* (``prefill_step``), so a long
+  prompt never stalls token emission for the rows around it;
+- rows are EVICTED on stop-match (the rolling engine's own finish
+  path), on deadline (the program's ``deadline_s``, enforced
+  row-granular here on top of PR 8's between-chunk checks), and on
+  client abandonment;
+- when no row is expected to free within ``KT_MAX_QUEUE_DELAY_S``, new
+  programs are shed with a typed
+  :class:`~kubetorch_tpu.exceptions.ServerOverloaded` carrying a
+  computed ``retry_after`` — the same PR-8 admission contract the POST
+  path has, so ``retry.py`` retries sheds safely.
+
+The engine publishes ``engine_*`` Prometheus counters/gauges (queue
+depth, active/free rows, steps, sheds — the signal the autoscaler will
+consume) and ``engine.step`` / ``engine.admit`` / ``engine.prefill``
+spans into the worker's trace ring. Clients poll the snapshot without
+touching the device via a channel **control frame**
+(``CallChannel.control("stats")`` — answered by the pod server
+out-of-band, no worker hop).
+
+This module must stay importable without jax: the real engine
+(:class:`~kubetorch_tpu.models.rolling.RollingGenerator`) is
+constructed by user code and passed in; :class:`SimRollingEngine` is
+the host-only twin the CPU bench/tests drive the scheduler with.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import hashlib
+import queue as _queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from kubetorch_tpu.config import env_float, env_int
+from kubetorch_tpu.exceptions import DeadlineExceeded, ServerOverloaded
+from kubetorch_tpu.observability import tracing
+from kubetorch_tpu.serving.replay import retry_after_estimate
+
+
+def _record_engine(event: str, value: float = 1.0) -> None:
+    """``prometheus.record_engine`` behind the call path's
+    must-never-raise guard."""
+    try:
+        from kubetorch_tpu.observability import prometheus as prom
+
+        prom.record_engine(event, value)
+    # ktlint: disable=KT004 -- metrics must never break the decode loop
+    except Exception:  # noqa: BLE001
+        pass
+
+
+class GenerationProgram:
+    """Validated form of the JSON generation program a client submits.
+
+    Wire shape (all JSON-able)::
+
+        {"prompt": [1, 2, 3],          # or "prompts": [[...], [...]]
+         "max_new_tokens": 128,
+         "temperature": 0.0,
+         "stop": [[13, 10]],           # optional stop token sequences
+         "repetition_penalty": 1.0,
+         "adapter_id": -1,
+         "prefix_id": None,
+         "deadline_s": 30.0,           # optional whole-program budget
+         "tag": "req-abc"}             # optional idempotency/debug tag
+
+    ``deadline_s`` is RELATIVE (seconds from receipt) for the same
+    reason the channel's ``timeout_s`` is: an absolute client timestamp
+    would break under clock skew. The engine stamps the absolute
+    deadline on its own clock at submit.
+    """
+
+    def __init__(self, prompts: List[List[int]], max_new_tokens: int,
+                 temperature: float, stop, repetition_penalty: float,
+                 adapter_id: int, prefix_id: Optional[int],
+                 deadline_s: Optional[float], tag: Optional[str]):
+        self.prompts = prompts
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.stop = stop
+        self.repetition_penalty = repetition_penalty
+        self.adapter_id = adapter_id
+        self.prefix_id = prefix_id
+        self.deadline_s = deadline_s
+        self.tag = tag
+
+    @classmethod
+    def from_wire(cls, obj: Any) -> "GenerationProgram":
+        if not isinstance(obj, dict):
+            raise ValueError(
+                f"generation program must be a dict, got {type(obj).__name__}")
+        if "prompts" in obj:
+            prompts = obj["prompts"]
+        elif "prompt" in obj:
+            prompts = [obj["prompt"]]
+        else:
+            raise ValueError("generation program needs 'prompt' or 'prompts'")
+        if (not isinstance(prompts, list) or not prompts
+                or not all(isinstance(p, list) and p for p in prompts)):
+            raise ValueError("prompts must be a non-empty list of "
+                             "non-empty token lists")
+        prompts = [[int(t) for t in p] for p in prompts]
+        deadline_s = obj.get("deadline_s")
+        if deadline_s is not None:
+            deadline_s = float(deadline_s)
+            if deadline_s <= 0:
+                raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        return cls(
+            prompts=prompts,
+            max_new_tokens=int(obj.get("max_new_tokens", 128)),
+            temperature=float(obj.get("temperature", 0.0)),
+            stop=obj.get("stop"),
+            repetition_penalty=float(obj.get("repetition_penalty", 1.0)),
+            adapter_id=int(obj.get("adapter_id", -1)),
+            prefix_id=obj.get("prefix_id"),
+            deadline_s=deadline_s,
+            tag=obj.get("tag"))
+
+    def submit_kwargs(self) -> Dict[str, Any]:
+        return {"max_new_tokens": self.max_new_tokens,
+                "temperature": self.temperature, "stop": self.stop,
+                "repetition_penalty": self.repetition_penalty,
+                "adapter_id": self.adapter_id, "prefix_id": self.prefix_id}
+
+
+class DecodeEngine:
+    """Hosts a rolling engine inside the pod worker and runs the
+    generation loop server-side.
+
+    Deploy as a ``kt.cls`` whose ``__init__`` builds the rolling engine
+    (the worker process owns the TPU), then drive it over the channel::
+
+        chan = remote.channel(depth=2)
+        frames = chan.submit({"prompt": toks, "max_new_tokens": 256},
+                             method="generate", stream=True,
+                             concurrent=True)
+        for frame in frames.result():
+            ...  # {"i": 0, "seq": k, "tokens": [...], "done": False}
+
+    ``concurrent=True`` matters: ``generate`` streams for the life of
+    the program, and the channel's FIFO lane would serialize everything
+    behind it. Generations are independent by construction — the FIFO
+    ordering contract protects hand-driven ``step()`` engines, not this
+    one (the scheduler owns interleaving now).
+
+    The wrapped ``engine`` needs the :class:`RollingGenerator` driving
+    surface: ``submit/admit/prefill_step/decode_step/evict`` plus the
+    ``queued/free_rows/active_rows/prefilling_rows/pending`` counts.
+    """
+
+    def __init__(self, engine, poll_s: Optional[float] = None,
+                 admit_rows: Optional[int] = None,
+                 max_waiting: Optional[int] = None,
+                 stall_s: Optional[float] = None):
+        self.engine = engine
+        self._poll_s = (poll_s if poll_s is not None
+                        else env_float("KT_ENGINE_POLL_S"))
+        self._admit_rows = (admit_rows if admit_rows is not None
+                            else env_int("KT_ENGINE_ADMIT_ROWS"))
+        self._max_waiting = (max_waiting if max_waiting is not None
+                             else env_int("KT_ENGINE_MAX_WAITING"))
+        self._stall_s = (stall_s if stall_s is not None
+                         else env_float("KT_ENGINE_STALL_S"))
+        self._wake = threading.Condition()
+        self._sinks: Dict[int, "_queue.SimpleQueue"] = {}
+        self._deadlines: Dict[int, float] = {}
+        self._submit_t: Dict[int, float] = {}   # rid -> submit stamp,
+        #                           popped at first token (feeds the
+        #                           TTFT EMA below)
+        self._exec_counts: Dict[str, int] = {}
+        # seconds-per-row-freed EMA — the admission estimate's clock
+        # (same role the session's ema_exec_s plays for call shedding)
+        self._ema_row_s = 0.05
+        self._ema_ttft_s = 0.0
+        self._last_free_t: Optional[float] = None
+        self._steps = 0
+        self._tokens = 0
+        self._device_s = 0.0
+        self._prefill_chunks = 0
+        self._admitted = 0
+        self._stop = False
+        # copy_context: driver-thread spans/log lines keep the ids of
+        # whatever context built the engine
+        self._driver = threading.Thread(
+            target=contextvars.copy_context().run, args=(self._drive,),
+            name="kt-engine-driver", daemon=True)
+        self._driver.start()
+
+    # ------------------------------------------------------------ public
+    def generate(self, program):
+        """Run one generation program; a GENERATOR of token frames —
+        the channel streams each as an 'item' frame with a retained
+        ``seq``, so a reconnect resumes mid-stream (PR 8 replay) and
+        the program executes exactly once.
+
+        Frames: ``{"i": prompt-index, "rid": engine-rid, "seq": n,
+        "tokens": [...], "done": bool}``; the stream ends when every
+        prompt in the program is done."""
+        prog = GenerationProgram.from_wire(program)
+        sink: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        with self._wake:
+            self._shed_check_locked(len(prog.prompts))
+            deadline = (time.time() + prog.deadline_s
+                        if prog.deadline_s is not None else None)
+            rids: List[int] = []
+            now = time.perf_counter()
+            try:
+                for p in prog.prompts:
+                    rid = self.engine.submit(p, **prog.submit_kwargs())
+                    rids.append(rid)
+                    self._sinks[rid] = sink
+                    self._submit_t[rid] = now
+                    if deadline is not None:
+                        self._deadlines[rid] = deadline
+            except BaseException:
+                # a later prompt failed validation (too long, bad
+                # adapter/prefix): the earlier prompts are already
+                # queued — release them NOW or they burn rows streaming
+                # into a sink nobody will ever read (and a client retry
+                # of the whole program would re-run their work)
+                for rid in rids:
+                    self.engine.evict(rid)
+                    self._forget_locked(rid)
+                raise
+            if prog.tag:
+                # bounded: one entry per tag would be a slow leak on a
+                # long-lived pod tagging every request
+                if (prog.tag not in self._exec_counts
+                        and len(self._exec_counts) >= 4096):
+                    self._exec_counts.pop(next(iter(self._exec_counts)))
+                self._exec_counts[prog.tag] = (
+                    self._exec_counts.get(prog.tag, 0) + 1)
+            index_of = {rid: i for i, rid in enumerate(rids)}
+            _record_engine("generation")
+            self._wake.notify_all()
+        live = set(rids)
+        seq = 0
+        try:
+            while live:
+                try:
+                    item = sink.get(timeout=self._stall_s)
+                except _queue.Empty:
+                    raise TimeoutError(
+                        f"engine produced no frame in {self._stall_s}s "
+                        f"(KT_ENGINE_STALL_S) — driver stalled?") from None
+                rid, payload = item
+                if isinstance(payload, BaseException):
+                    live.discard(rid)
+                    raise payload
+                toks, done = payload
+                if done:
+                    live.discard(rid)
+                frame = {"i": index_of[rid], "rid": rid, "seq": seq,
+                         "tokens": toks, "done": bool(done)}
+                seq += 1
+                yield frame
+        finally:
+            # ANY early exit — stall, deadline raise, or the worker
+            # closing the generator because the client abandoned the
+            # stream / the wire deadline passed (gen.close() →
+            # GeneratorExit at the yield) — must release the rows, or
+            # an abandoned program keeps burning device chunks to its
+            # token budget while new programs queue behind it
+            if live:
+                with self._wake:
+                    for rid in live:
+                        self.engine.evict(rid)
+                        self._forget_locked(rid)
+                        _record_engine("evict")
+
+    def pending(self) -> int:
+        """Engine-wide pending count — host bookkeeping, no device
+        sync. Channel clients should poll via ``chan.control('stats')``
+        (out-of-band, no worker hop) instead of calling this."""
+        return int(self.engine.pending)
+
+    def stats(self) -> Dict[str, Any]:
+        """Scheduler snapshot (host-only). Also the source of the
+        ``engine_*`` gauges the pod server's control frames answer
+        from."""
+        eng = self.engine
+        out = {
+            "queued": int(eng.queued),
+            "free_rows": int(eng.free_rows),
+            "active_rows": int(eng.active_rows),
+            "prefilling_rows": int(eng.prefilling_rows),
+            "pending": int(eng.pending),
+            "steps": self._steps,
+            "tokens": self._tokens,
+            "device_s": round(self._device_s, 6),
+            "prefill_chunks": self._prefill_chunks,
+            "admitted_rows": self._admitted,
+            "ema_row_free_s": round(self._ema_row_s, 4),
+            "ema_ttft_s": round(self._ema_ttft_s, 4),
+        }
+        return out
+
+    def exec_count(self, tag: str) -> int:
+        """How many times a tagged program was EXECUTED (not replayed)
+        — the e2e exactly-once assertion reads this back."""
+        return self._exec_counts.get(tag, 0)
+
+    def warmup(self, *args, **kwargs):
+        warm = getattr(self.engine, "warmup", None)
+        if warm is None:
+            return False
+        with self._wake:
+            warm(*args, **kwargs)
+        return True
+
+    def close(self) -> None:
+        with self._wake:
+            self._stop = True
+            self._wake.notify_all()
+        self._driver.join(timeout=5.0)
+
+    # ------------------------------------------------------------ driver
+    def _forget_locked(self, rid: int) -> None:
+        self._sinks.pop(rid, None)
+        self._deadlines.pop(rid, None)
+        self._submit_t.pop(rid, None)
+
+    def _shed_check_locked(self, n_new: int) -> None:
+        """PR-8 admission control at the ROW level: when no row is
+        expected to free inside ``KT_MAX_QUEUE_DELAY_S`` (queued-ahead ×
+        the row-free EMA), shed with the computed Retry-After instead of
+        letting the program queue into a timeout. ``KT_ENGINE_MAX_WAITING``
+        is the hard queue-length backstop."""
+        eng = self.engine
+        waiting = int(eng.queued)
+        max_delay = env_float("KT_MAX_QUEUE_DELAY_S")
+        hard_cap = self._max_waiting and (
+            waiting + n_new > self._max_waiting)
+        est_delay = 0.0
+        if eng.free_rows < n_new:
+            est_delay = (waiting + n_new) * max(0.01, self._ema_row_s)
+        if hard_cap or est_delay > max_delay:
+            retry_after = retry_after_estimate(
+                waiting + n_new, 1, self._ema_row_s, cap_s=max_delay)
+            _record_engine("shed")
+            tracing.record_span(
+                "server.shed", 0.0,
+                attrs={"transport": "engine", "queue_depth": waiting,
+                       "retry_after_s": retry_after})
+            raise ServerOverloaded(
+                f"engine queue {waiting} deep, no row expected free "
+                f"within {max_delay}s (est. {est_delay:.2f}s)",
+                retry_after=retry_after)
+
+    def _work_pending_locked(self) -> bool:
+        return bool(self.engine.pending)
+
+    def _drive(self) -> None:
+        while True:
+            with self._wake:
+                while not self._stop and not self._work_pending_locked():
+                    self._wake.wait(timeout=self._poll_s)
+                if self._stop:
+                    return
+                try:
+                    self._tick_locked()
+                # ktlint: disable=KT004 -- counted + reported per-sink; the loop must survive one bad tick
+                except Exception as exc:  # noqa: BLE001
+                    _record_engine("tick_error")
+                    # a broken device step poisons every live program:
+                    # fail their streams typed rather than hang them.
+                    # Deliver to EVERY sink before any engine cleanup —
+                    # evict() touches the same (possibly broken) device
+                    # state that just raised, and a second raise here
+                    # would kill the driver thread for good
+                    for rid, sink in list(self._sinks.items()):
+                        sink.put((rid, exc))
+                    for rid in list(self._sinks):
+                        try:
+                            self.engine.evict(rid)
+                        # ktlint: disable=KT004 -- device already faulted; the stream was failed above
+                        except Exception:  # noqa: BLE001
+                            pass
+                        self._forget_locked(rid)
+
+    def _tick_locked(self) -> None:
+        eng = self.engine
+        now = time.time()
+        # ---- deadline eviction (row-granular) ------------------------
+        for rid, dl in list(self._deadlines.items()):
+            if now > dl:
+                eng.evict(rid)
+                sink = self._sinks.get(rid)
+                self._forget_locked(rid)
+                _record_engine("evict")
+                if sink is not None:
+                    sink.put((rid, DeadlineExceeded(
+                        f"generation {rid} passed its deadline "
+                        f"mid-stream", deadline=dl)))
+        # ---- per-row admission into the live batch -------------------
+        t0 = time.perf_counter()
+        admitted = eng.admit(self._admit_rows or None)
+        if admitted:
+            self._admitted += admitted
+            _record_engine("admit", admitted)
+            tracing.record_span(
+                "engine.admit", time.perf_counter() - t0,
+                attrs={"rows": admitted})
+        # ---- one chunked-prefill dispatch, interleaved ---------------
+        t0 = time.perf_counter()
+        if eng.prefilling_rows:
+            eng.prefill_step()
+            self._prefill_chunks += 1
+            _record_engine("prefill_chunk")
+            tracing.record_span(
+                "engine.prefill", time.perf_counter() - t0,
+                attrs={"rows": eng.prefilling_rows})
+        # ---- one decode chunk ----------------------------------------
+        t0 = time.perf_counter()
+        events = eng.decode_step()
+        dt = time.perf_counter() - t0
+        if events:
+            self._steps += 1
+            self._device_s += dt
+            _record_engine("step")
+            _record_engine("device_seconds", dt)
+            tracing.record_span(
+                "engine.step", dt,
+                attrs={"rows": len(events),
+                       "tokens": sum(len(t) for _, t, _ in events)})
+        # ---- route frames + row-free accounting ----------------------
+        freed = 0
+        tnow = time.perf_counter()
+        for rid, toks, done in events:
+            self._tokens += len(toks)
+            if toks:
+                _record_engine("tokens", len(toks))
+                t_sub = self._submit_t.pop(rid, None)
+                if t_sub is not None:  # this rid's FIRST tokens
+                    self._ema_ttft_s = (0.8 * self._ema_ttft_s
+                                        + 0.2 * (tnow - t_sub))
+            sink = self._sinks.get(rid)
+            if sink is not None:
+                sink.put((rid, ([int(t) for t in toks], bool(done))))
+            if done:
+                freed += 1
+                self._forget_locked(rid)
+        if freed:
+            t_free = time.time()
+            if self._last_free_t is not None:
+                gap = max(1e-4, (t_free - self._last_free_t) / freed)
+                self._ema_row_s = 0.8 * self._ema_row_s + 0.2 * gap
+            self._last_free_t = t_free
+        if not eng.pending:
+            # going idle: the NEXT free event's gap would include the
+            # whole idle stretch and poison the row-free EMA (one long
+            # lull measured as a minutes-long est_delay → spurious
+            # sheds on the next burst)
+            self._last_free_t = None
+        self._publish_gauges()
+
+    def _publish_gauges(self) -> None:
+        eng = self.engine
+        _record_engine("queue_depth", float(eng.queued))
+        _record_engine("active_rows", float(eng.active_rows))
+        _record_engine("free_rows", float(eng.free_rows))
+        _record_engine("prefilling_rows", float(eng.prefilling_rows))
+
+
+class SimRollingEngine:
+    """Host-only twin of :class:`RollingGenerator`'s driving surface.
+
+    Token emission is a pure function of (prompt, index) — see
+    :meth:`expected_tokens` — so byte-identity across PR-8 replay is
+    assertable from the client side without a model; ``step_s`` models
+    the per-decode-chunk device time (one sleep per chunk regardless of
+    occupancy, like a real batched step). Used by the CPU ``--dryrun``
+    bench and the engine e2e tests; the scheduler above cannot tell it
+    from the real thing.
+    """
+
+    def __init__(self, max_slots: int = 8, steps_per_call: int = 8,
+                 prefill_chunk: Optional[int] = None,
+                 step_s: float = 0.0, prefill_s: Optional[float] = None):
+        self.max_slots = max_slots
+        self.steps_per_call = steps_per_call
+        self.prefill_chunk = prefill_chunk
+        self.step_s = step_s
+        self.prefill_s = prefill_s if prefill_s is not None else step_s
+        self._queue: List[dict] = []
+        self._rows: Dict[int, dict] = {}        # rid -> active request
+        self._prefilling: Dict[int, dict] = {}  # rid -> request
+        self._free = list(range(max_slots))
+        self._next_rid = 0
+
+    # -------------------------------------------------------- interface
+    @staticmethod
+    def expected_tokens(prompt: List[int], n: int) -> List[int]:
+        """Ground truth for byte-identity assertions: the exact token
+        stream a request with this prompt emits."""
+        seed = ",".join(str(int(t)) for t in prompt)
+        return [int.from_bytes(
+            hashlib.sha256(f"{seed}:{i}".encode()).digest()[:4],
+            "little") % 32000 for i in range(n)]
+
+    def submit(self, prompt, max_new_tokens: int = 128, **_ignored) -> int:
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append({"rid": rid, "prompt": [int(t) for t in prompt],
+                            "n": int(max_new_tokens), "emitted": 0,
+                            "consumed": 0, "slot": None})
+        return rid
+
+    def admit(self, max_rows: Optional[int] = None) -> int:
+        admitted = 0
+        while self._free and self._queue and (
+                max_rows is None or admitted < max_rows):
+            req = self._queue.pop(0)
+            req["slot"] = self._free.pop(0)
+            admitted += 1
+            if (self.prefill_chunk is not None
+                    and len(req["prompt"]) > self.prefill_chunk):
+                self._prefilling[req["rid"]] = req
+            else:
+                req["consumed"] = len(req["prompt"])
+                self._rows[req["rid"]] = req
+        return admitted
+
+    def prefill_step(self) -> List[int]:
+        if not self._prefilling:
+            return []
+        if self.prefill_s:
+            time.sleep(self.prefill_s)
+        activated = []
+        for rid, req in list(self._prefilling.items()):
+            req["consumed"] = min(len(req["prompt"]),
+                                  req["consumed"] + self.prefill_chunk)
+            if req["consumed"] >= len(req["prompt"]):
+                del self._prefilling[rid]
+                self._rows[rid] = req
+                activated.append(rid)
+        return activated
+
+    def decode_step(self):
+        if not self._rows:
+            return []
+        if self.step_s:
+            time.sleep(self.step_s)
+        events = []
+        for rid, req in list(self._rows.items()):
+            k = min(self.steps_per_call, req["n"] - req["emitted"])
+            toks = self.expected_tokens(
+                req["prompt"], req["emitted"] + k)[req["emitted"]:]
+            req["emitted"] += k
+            done = req["emitted"] >= req["n"]
+            events.append((rid, toks, done))
+            if done:
+                self._free.append(req["slot"])
+                del self._rows[rid]
+        return events
+
+    def step(self):
+        self.admit()
+        self.prefill_step()
+        return self.decode_step()
+
+    def evict(self, rid: int) -> bool:
+        for i, req in enumerate(self._queue):
+            if req["rid"] == rid:
+                self._queue.pop(i)
+                return True
+        req = self._prefilling.pop(rid, None) or self._rows.pop(rid, None)
+        if req is None:
+            return False
+        self._free.append(req["slot"])
+        return True
+
+    # ------------------------------------------------------------ state
+    @property
+    def pending(self) -> int:
+        return len(self._queue) + len(self._rows) + len(self._prefilling)
+
+    @property
+    def queued(self) -> int:
+        return len(self._queue)
+
+    @property
+    def free_rows(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_rows(self) -> int:
+        return len(self._rows)
+
+    @property
+    def prefilling_rows(self) -> int:
+        return len(self._prefilling)

@@ -22,6 +22,14 @@ serialized call body, or the serialized result) passes through
 PodServer → ProcessPool → ProcessWorker untouched, so the pod hop costs
 zero re-serialization.
 
+Channel header ``kind``s: ``call`` (a client call — FIFO unless the
+header sets ``concurrent``), ``bye`` (clean client close: the server
+drops the session and its retention immediately), ``ctl`` (an
+out-of-band control read — queue depth / engine snapshot — answered by
+the pod server directly, never queued or retained; idempotent by
+contract), and the reply kinds ``item`` / ``result`` / ``error`` /
+``end``.
+
 Everything here is transport-agnostic bytes-in/bytes-out so the exact
 same parser is unit-testable against adversarial chunkings (partial
 reads, frame boundaries split mid-length) without a socket.

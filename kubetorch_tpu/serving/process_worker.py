@@ -120,6 +120,7 @@ def _attach_worker_metrics(agg: Dict[str, int]) -> None:
     (a zero here would clobber them in the non-``_total`` merge)."""
     try:
         from kubetorch_tpu.observability.prometheus import (
+            engine_metrics,
             restore_metrics,
             serving_metrics,
             wire_metrics,
@@ -135,6 +136,14 @@ def _attach_worker_metrics(agg: Dict[str, int]) -> None:
                    if k.startswith("serving_worker_") and v}
         if serving:
             agg["serving"] = {"pid": os.getpid(), **serving}
+        # serving-engine counters/gauges: the engine loop runs in THIS
+        # process (it owns the device); the snapshot rides to the pod so
+        # control frames and /metrics answer queue depth without a
+        # worker (let alone device) hop
+        engine = engine_metrics()
+        if engine.get("engine_generations_total") or \
+                engine.get("engine_steps_total"):
+            agg["engine"] = {"pid": os.getpid(), **engine}
         trace = tracing.trace_metrics()
         if trace.get("trace_spans_total"):
             agg["trace"] = {"pid": os.getpid(), **trace}

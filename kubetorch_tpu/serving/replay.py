@@ -215,6 +215,15 @@ class ChannelSession:
         """Calls admitted but not yet terminal (queued + executing)."""
         return sum(1 for e in self.calls.values() if e.counted)
 
+    def describe(self) -> Dict[str, Any]:
+        """Session-level half of a control-frame answer (the pod server
+        adds pod-wide depth and the engine snapshot). Cheap by
+        construction: counters only, no retention walk."""
+        return {"session_queue_depth": self.queue_depth,
+                "session_ema_exec_s": round(self.ema_exec_s, 4),
+                "session_retained": len(self.calls),
+                "session_max_seen_cid": self.max_seen_cid}
+
     # ------------------------------------------------------------- send
     async def send(self, entry: RetainedCall, hdr: dict,
                    body: bytes = b"") -> bool:

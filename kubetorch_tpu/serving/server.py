@@ -507,7 +507,7 @@ class PodServer:
     # group name in a worker's stats dict → metric-name prefix
     _PROC_GROUPS = {"data_store_restore": "data_store_",
                     "data_store": "data_store_", "serving": "",
-                    "trace": "", "reliability": ""}
+                    "trace": "", "reliability": "", "engine": ""}
 
     def _merge_worker_stats(self, stats: Dict[str, Any]):
         """Fold a worker's per-call stats dict into pod metrics. Plain
@@ -1196,6 +1196,16 @@ class PodServer:
                     # holding retention for a client that said goodbye
                     self._channel_sessions.drop(session)
                     break
+                if kind == "ctl":
+                    # control frame: answered OUT-OF-BAND right here,
+                    # from pod/session state plus the last engine
+                    # snapshot the workers piggybacked — it never joins
+                    # the session FIFO (no wait behind pipelined decode
+                    # chunks) and never pays a worker or device hop.
+                    # Reads are idempotent, so no retention either: a
+                    # replayed ctl just re-answers.
+                    await self._answer_ctl(session, ws, header)
+                    continue
                 if kind != "call":
                     continue
                 self.metrics["http_requests_total"] += 1
@@ -1228,6 +1238,34 @@ class PodServer:
             # (no-epoch) sessions die with their socket.
             self._channel_sessions.detach(session, ws)
         return ws
+
+    async def _answer_ctl(self, session, ws, header):
+        """Answer a channel control frame (``kind: ctl``) from server
+        state: pod-wide queue depth (channels + POSTs), this session's
+        depth/EMA, and the last ``engine_*`` snapshot merged from the
+        workers' call-response piggybacks. The whole point is cost —
+        clients (and, later, the autoscaler's probes) poll queue depth
+        at heartbeat cadence, and a full call round-trip would queue
+        behind the very decode chunks being polled."""
+        from kubetorch_tpu.serving import frames
+
+        info = {
+            "op": header.get("op") or "stats",
+            "pod_queue_depth": self._channel_sessions.total_depth(),
+            "inflight_posts": self._inflight_posts,
+            "terminating": self.terminating,
+            "ready": self.ready,
+            **session.describe(),
+        }
+        engine = {k: v for k, v in self.metrics.items()
+                  if k.startswith("engine_")}
+        if engine:
+            info["engine"] = engine
+        async with session.send_lock:
+            await ws.send_bytes(frames.pack_envelope(
+                {"kind": "result", "ser": "json",
+                 "cid": header.get("cid"), "ctl": True},
+                json.dumps({"result": info}).encode()))
 
     async def _channel_execute(self, session, entry, header, payload,
                                t_recv):

@@ -168,6 +168,45 @@ def ray_probe():
             "pod": os.environ.get("KT_REPLICA_INDEX")}
 
 
+class EngineHost:
+    """Server-resident decode engine over the host-only sim rolling
+    engine — the e2e surface for generation programs: ``generate`` is a
+    streamed channel call whose frames ride PR-8 retention (partition →
+    byte-identical resume, exec-count 1), ``exec_count``/``stats`` are
+    the observability hooks the tests assert against."""
+
+    def __init__(self, max_slots=4, steps_per_call=8, step_ms=2.0,
+                 prefill_chunk=16, max_waiting=64):
+        from kubetorch_tpu.serving.engine import (
+            DecodeEngine,
+            SimRollingEngine,
+        )
+
+        self._engine = DecodeEngine(
+            SimRollingEngine(max_slots=int(max_slots),
+                             steps_per_call=int(steps_per_call),
+                             prefill_chunk=int(prefill_chunk),
+                             step_s=float(step_ms) / 1e3),
+            max_waiting=int(max_waiting))
+
+    def generate(self, program, delay_ms=0.0):
+        for frame in self._engine.generate(program):
+            if delay_ms:
+                import time
+
+                time.sleep(float(delay_ms) / 1e3)
+            yield frame
+
+    def pending(self):
+        return self._engine.pending()
+
+    def stats(self):
+        return self._engine.stats()
+
+    def exec_count(self, tag):
+        return self._engine.exec_count(tag)
+
+
 class ChunkEngine:
     """Stateful decode-chunk simulator for call-channel tests: step order
     is observable (seq), chunks can blow up on demand, and device time is

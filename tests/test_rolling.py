@@ -797,3 +797,140 @@ def test_rolling_decoder_remote_facing_driver(model):
                           temperature=0.0, seed=0)[0]
     assert got == expect
     assert dec.stats()["free_slots"] == 4
+
+
+# ---------------------------------------------------------------------
+# ISSUE 10: row-granular admission (splice correctness), eviction, and
+# chunked grid-resident prefill — the model-level half of the serving
+# engine's scheduler.
+
+
+@pytest.mark.level("minimal")
+def test_admit_into_live_batch_splices_identically(model):
+    """_admit_group/_finish_admit splice correctness: a row admitted
+    into a LIVE batch (neighbor rows mid-decode at depth) decodes
+    token-identically to a fresh-batch run of the same prompt."""
+    params, cfg = model
+    p_bg, p_new = [1, 2, 3, 4], [42, 17, 9]
+    gen = Generator(params, cfg)
+    iso_new = gen.generate([p_new], max_new_tokens=8, temperature=0.0)[0]
+
+    eng = RollingGenerator(params, cfg, max_slots=3)
+    eng.submit(p_bg, max_new_tokens=24)
+    eng.step()
+    eng.step()                       # background row is deep in decode
+    rid = eng.submit(p_new, max_new_tokens=8)
+    got = []
+    while eng.pending:
+        for r, toks, done in eng.step():
+            if r == rid:
+                got.extend(toks)
+    assert got == iso_new, (got, iso_new)
+
+
+@pytest.mark.level("minimal")
+def test_evicted_row_cache_plane_is_reusable(model):
+    """evict() frees the row immediately and a new request admitted
+    into the SAME slot decodes identically to a fresh-batch run — the
+    stale K/V beyond the new depth is never attended."""
+    params, cfg = model
+    gen = Generator(params, cfg)
+    iso = gen.generate([[9, 8, 7]], max_new_tokens=6, temperature=0.0)[0]
+
+    eng = RollingGenerator(params, cfg, max_slots=1)   # one row only
+    ra = eng.submit([1, 2, 3, 4, 5, 6], max_new_tokens=40)
+    eng.step()
+    eng.step()                                # row holds deep stale K/V
+    assert eng.evict(ra)
+    assert not eng.evict(ra)                  # second evict: gone
+    assert eng.free_rows == 1
+    rc = eng.submit([9, 8, 7], max_new_tokens=6)
+    out = []
+    while eng.pending:
+        for r, toks, done in eng.step():
+            assert r == rc, "evicted rid must never emit again"
+            out.extend(toks)
+    assert out == iso, (out, iso)
+
+
+@pytest.mark.level("minimal")
+def test_evict_queued_and_prefilling(model):
+    params, cfg = model
+    eng = RollingGenerator(params, cfg, max_slots=1, prefill_chunk=8)
+    ra = eng.submit([1, 2], max_new_tokens=30)
+    rb = eng.submit([3, 4], max_new_tokens=4)          # queued behind a
+    assert eng.evict(rb)                               # queued evict
+    assert eng.queued == 1                             # only ra remains
+    eng.step()
+    # long prompt enters chunked prefill once the row frees
+    eng.evict(ra)
+    rc = eng.submit(list(range(1, 25)), max_new_tokens=4)
+    eng.admit()
+    assert eng.prefilling_rows == 1
+    assert eng.evict(rc)                               # mid-prefill evict
+    assert eng.prefilling_rows == 0 and eng.free_rows == 1
+    assert eng.pending == 0
+
+
+@pytest.mark.level("minimal")
+def test_chunked_prefill_token_identity_and_no_stall(model):
+    """A long prompt prefilled in chunks interleaved with decode steps
+    yields byte-identical tokens to its isolated run, and the live
+    neighbor row emits on EVERY step of the prefill window (no decode
+    stall)."""
+    params, cfg = model
+    gen = Generator(params, cfg)
+    long_p = list(range(1, 25))                        # 24 toks, chunk 8
+    short_p = [5, 6, 7]
+    iso_long = gen.generate([long_p], max_new_tokens=10,
+                            temperature=0.0)[0]
+    iso_short = gen.generate([short_p], max_new_tokens=40,
+                             temperature=0.0)[0]
+
+    eng = RollingGenerator(params, cfg, max_slots=4, prefill_chunk=8)
+    rs = eng.submit(short_p, max_new_tokens=40)
+    seen = {rs: []}
+    for _, toks, _ in eng.step():                      # short is live
+        seen[rs].extend(toks)
+    rl = eng.submit(long_p, max_new_tokens=10)
+    seen[rl] = []
+    prefill_window_emits = []
+    while eng.pending:
+        prefilling = eng.prefilling_rows > 0 or eng.queued > 0
+        events = eng.step()
+        if prefilling:
+            prefill_window_emits.append(
+                any(r == rs and toks for r, toks, _ in events))
+        for r, toks, done in events:
+            seen[r].extend(toks)
+    assert seen[rl] == iso_long, (seen[rl], iso_long)
+    assert seen[rs] == iso_short, (seen[rs], iso_short)
+    # every step of the prefill window also emitted live tokens
+    assert prefill_window_emits and all(prefill_window_emits), \
+        prefill_window_emits
+
+
+@pytest.mark.level("minimal")
+def test_chunked_prefill_matches_oneshot_admission(model):
+    """The chunked grid-resident prefill and the one-shot private-cache
+    admission are the same function of the prompt: identical greedy
+    tokens from either path."""
+    params, cfg = model
+    prompt = list(range(7, 47))                        # 40 tokens
+    eng_a = RollingGenerator(params, cfg, max_slots=2)
+    ra = eng_a.submit(prompt, max_new_tokens=12)
+    out_a = eng_a.run()[ra]
+    eng_b = RollingGenerator(params, cfg, max_slots=2, prefill_chunk=16)
+    rb = eng_b.submit(prompt, max_new_tokens=12)
+    out_b = eng_b.run()[rb]
+    assert out_a == out_b, (out_a, out_b)
+
+
+@pytest.mark.level("minimal")
+def test_chunked_prefill_rejects_spec_and_bad_chunk(model):
+    params, cfg = model
+    with pytest.raises(ValueError):
+        RollingGenerator(params, cfg, max_slots=2, prefill_chunk=8,
+                         spec_k=4)
+    with pytest.raises(ValueError):
+        RollingGenerator(params, cfg, max_slots=2, prefill_chunk=0)

@@ -35,6 +35,22 @@ EXPECTED_KEYS = {
     # published number, not an assumption
     "trace_span_count",
     "trace_overhead_us_per_span",
+    # engine mode (ISSUE 10): the server-resident generation loop —
+    # tunnel-vs-device ratio, amortized per-chunk cost, per-row
+    # admission TTFT/goodput, and the scheduler invariants
+    "engine_step_ms_cfg",
+    "engine_chunk_tokens",
+    "engine_tok_s_tunnel_wall",
+    "engine_device_tok_s",
+    "engine_tunnel_ratio",
+    "engine_dispatch_ms_per_chunk",
+    "engine_ttft_ms_p50",
+    "engine_ttft_ms_p99",
+    "engine_poisson_offered_tok_s",
+    "engine_poisson_tok_s",
+    "engine_poisson_goodput_ratio",
+    "engine_prefill_interleave_ok",
+    "engine_admit_to_first_token_chunks",
 }
 
 
@@ -73,5 +89,22 @@ def test_serving_dryrun_metric_keys():
     assert per_span_us * 4 < 0.05 * chunk_us, (
         f"tracing overhead {per_span_us} µs/span × 4 spans/call exceeds "
         f"5% of the {chunk_us:.0f} µs pipelined chunk")
+    # engine mode: the scheduler invariants hold on the CPU path, and
+    # the server-resident loop's overhead is AMORTIZED fixed cost —
+    # per-chunk dispatch well under one chunk's device time (the
+    # client-driven loop paid ~144 ms/chunk, ~5x device, in BENCH_r05)
+    assert out["engine_prefill_interleave_ok"] == 1.0, (
+        "decode stalled during chunked prefill")
+    assert out["engine_admit_to_first_token_chunks"] <= 9, (
+        "admit-to-first-token unbounded: "
+        f"{out['engine_admit_to_first_token_chunks']} ticks for an "
+        f"8-chunk prompt")
+    assert out["engine_dispatch_ms_per_chunk"] < out["engine_step_ms_cfg"]
+    # CI floor (the full bench asserts the 0.9 acceptance bar itself;
+    # a loaded CI host gets headroom)
+    assert out["engine_tunnel_ratio"] > 0.5, out["engine_tunnel_ratio"]
+    assert out["engine_poisson_goodput_ratio"] > 0.4
+    assert out["engine_ttft_ms_p50"] > 0
+    assert out["engine_ttft_ms_p99"] >= out["engine_ttft_ms_p50"]
     # dryrun toy values must never be compared against prior rounds
     assert "rolling_tok_s_tunnel_wall" not in out
