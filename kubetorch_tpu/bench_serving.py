@@ -886,6 +886,170 @@ def bench_engine(step_ms: float = 20.0, batch: int = 8,
     return out
 
 
+# ---------------------------------------------------------------------
+# Paged-KV / prefix-cache phase (ISSUE 11): the two multi-tenant wins
+# the Gemma-on-TPU serving paper attributes real throughput to —
+# prefix sharing (N same-system-prompt programs prefill the prefix
+# once) and idle-session KV park/restore (a returning user resumes
+# mid-conversation at ~one decode chunk instead of a full prefill).
+# Runs the DecodeEngine scheduler in-process over SimRollingEngine
+# (dryrun-capable: pure CPU, wall-free arithmetic where possible).
+#
+# Keys (asserted by tests/test_serving_smoke.py):
+# - prefix_prefill_tokens_saved_ratio   1 − executed/naive prefill
+#     tokens across an N-way shared-prefix run; the acceptance floor is
+#     ≥ 0.5·(N−1)/N (perfect sharing approaches (N−1)/N as suffix→0)
+# - prefix_kv_hits/misses               cache behavior (N−1 hits, 1 miss)
+# - kv_resume_ttft_ms/_chunks           park→resume first-token latency,
+#     in ms and in units of one decode chunk — the "≈ one decode chunk"
+#     acceptance number
+# - kv_unparked_ttft_ms                 the same prompt's cold TTFT (it
+#     pays the full chunked prefill) — the contrast that makes the
+#     resume number meaningful
+
+
+def bench_prefix_kv(n_programs: int = 8, prefix_len: int = 64,
+                    suffix_len: int = 8, max_new: int = 32,
+                    step_ms: float = 4.0, park_step_ms: float = 20.0,
+                    dryrun: bool = False) -> dict:
+    import tempfile
+    import threading
+
+    from kubetorch_tpu.data_store import client as client_mod
+    from kubetorch_tpu.serving.engine import (
+        DecodeEngine,
+        SimRollingEngine,
+    )
+
+    if dryrun:
+        n_programs, prefix_len, suffix_len = 8, 64, 8
+        max_new, step_ms, park_step_ms = 32, 4.0, 20.0
+    out: dict = {"prefix_kv_programs": n_programs}
+
+    # ---- phase 1: N-way shared prefix --------------------------------
+    sim = SimRollingEngine(max_slots=n_programs, steps_per_call=8,
+                           step_s=step_ms / 1e3)
+    eng = DecodeEngine(sim, poll_s=0.002,
+                       prefix_split=f"len:{prefix_len}",
+                       kv_block_tokens=16)
+    prefix = list(range(100, 100 + prefix_len))
+    results: dict = {}
+
+    def drain(i):
+        suffix = [1000 + i] * suffix_len
+        frames = list(eng.generate({"prompt": prefix + suffix,
+                                    "max_new_tokens": max_new}))
+        results[i] = [t for f in frames for t in f["tokens"]]
+
+    import contextvars as _cv
+
+    try:
+        threads = [threading.Thread(
+            target=_cv.copy_context().run, args=(drain, i))
+            for i in range(n_programs)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(60)
+        for i in range(n_programs):
+            expect = SimRollingEngine.expected_tokens(
+                prefix + [1000 + i] * suffix_len, max_new)
+            assert results.get(i) == expect, \
+                f"shared-prefix stream {i} diverged"
+        st = eng.stats()
+    finally:
+        eng.close()
+    naive = st["prefill_tokens_naive"]
+    executed = st["prefill_tokens_executed"]
+    saved = 1.0 - executed / naive
+    misses = st["prefixes"]       # each distinct prefix registered once
+    out.update({
+        "prefix_prefill_tokens_naive": naive,
+        "prefix_prefill_tokens_executed": executed,
+        "prefix_prefill_tokens_saved_ratio": round(saved, 4),
+        "prefix_kv_hits": n_programs - misses,
+        "prefix_kv_misses": misses,
+    })
+    floor = 0.5 * (n_programs - 1) / n_programs
+    assert saved >= floor, (
+        f"prefill tokens saved {saved:.3f} below the "
+        f"0.5*(N-1)/N = {floor:.3f} acceptance floor — prefix sharing "
+        f"is not actually sharing")
+
+    # ---- phase 2: park → resume TTFT ---------------------------------
+    # The store is process-default; point the local backend at a temp
+    # root for the bench's session blobs and restore it after.
+    tmp = tempfile.mkdtemp(prefix="kt-bench-kv-")
+    saved_root = client_mod._LOCAL_STORE
+    saved_default = client_mod.DataStoreClient._default
+    client_mod._LOCAL_STORE = __import__("pathlib").Path(tmp)
+    client_mod.DataStoreClient._default = None
+    prompt = list(range(7, 71))                 # 64 tokens = 8 chunks
+    sim2 = SimRollingEngine(max_slots=2, steps_per_call=8,
+                            prefill_chunk=8, step_s=park_step_ms / 1e3)
+    eng2 = DecodeEngine(sim2, poll_s=0.002)
+    try:
+        # cold TTFT: the same prompt pays its full chunked prefill
+        t0 = time.perf_counter()
+        for f in eng2.generate({"prompt": prompt, "max_new_tokens": 8}):
+            if f["tokens"]:
+                out["kv_unparked_ttft_ms"] = round(
+                    (time.perf_counter() - t0) * 1e3, 1)
+                break
+
+        got: list = []
+        parked = threading.Event()
+
+        def run_session():
+            for f in eng2.generate({"prompt": prompt,
+                                    "max_new_tokens": 512,
+                                    "session_id": "bench-sess"}):
+                if f.get("parked"):
+                    parked.set()
+                    return
+                got.extend(f["tokens"])
+
+        th = threading.Thread(target=_cv.copy_context().run,
+                              args=(run_session,))
+        th.start()
+        deadline = time.time() + 30
+        while len(got) < 8 and time.time() < deadline:
+            time.sleep(0.002)
+        t0 = time.perf_counter()
+        n_parked = eng2.park("bench-sess")
+        out["kv_park_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        th.join(10)
+        assert n_parked == 1 and parked.is_set(), "park never landed"
+
+        t0 = time.perf_counter()
+        ttft = None
+        rest: list = []
+        for f in eng2.generate({"prompt": prompt, "max_new_tokens": 512,
+                                "session_id": "bench-sess"}):
+            if f["tokens"] and ttft is None:
+                ttft = time.perf_counter() - t0
+            rest.extend(f["tokens"])
+            if len(rest) >= 16:
+                break
+        expect = SimRollingEngine.expected_tokens(
+            prompt, len(got) + len(rest))
+        assert got + rest == expect, "resumed stream diverged"
+        out["kv_resume_ttft_ms"] = round(ttft * 1e3, 1)
+        out["kv_resume_ttft_chunks"] = round(ttft * 1e3 / park_step_ms, 2)
+        # the acceptance contrast: a resume costs ~one decode chunk, not
+        # the prompt's 8-chunk prefill
+        assert out["kv_resume_ttft_ms"] < 0.5 * out["kv_unparked_ttft_ms"], (
+            out["kv_resume_ttft_ms"], out["kv_unparked_ttft_ms"])
+    finally:
+        eng2.close()
+        client_mod._LOCAL_STORE = saved_root
+        client_mod.DataStoreClient._default = saved_default
+        import shutil as _sh
+
+        _sh.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def run(dryrun: bool = False, static_tok_s: float = 5673.0) -> dict:
     """Full serving bench. ``dryrun`` (CI smoke) runs only the
     call-tunnel phase at toy sizes — the model phases need a chip-scale
@@ -896,6 +1060,7 @@ def run(dryrun: bool = False, static_tok_s: float = 5673.0) -> dict:
     if dryrun:
         out = bench_call_channel(dryrun=True)
         out.update(bench_engine(dryrun=True))
+        out.update(bench_prefix_kv(dryrun=True))
         return out
     out = bench_8b_rolling(static_tok_s=static_tok_s) or {}
     if out:
@@ -916,6 +1081,13 @@ def run(dryrun: bool = False, static_tok_s: float = 5673.0) -> dict:
             step_ms=out["ms_per_step_device"] * out["steps_per_call"],
             batch=min(out["batch"], 16),
             steps_per_call=out["steps_per_call"]))
+        # paged-KV phase at the measured per-chunk device time: the
+        # prefix-sharing and park/resume numbers compose with phase 1's
+        # device truth the same way the engine phase does
+        out.update(bench_prefix_kv(
+            step_ms=out["ms_per_step_device"] * out["steps_per_call"],
+            park_step_ms=out["ms_per_step_device"]
+            * out["steps_per_call"]))
     return out
 
 

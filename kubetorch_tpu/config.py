@@ -341,6 +341,34 @@ _knob("KT_ENGINE_STALL_S", "float", 120.0,
       "before its rows are evicted and the stream fails typed.",
       "engine")
 
+# --- engine KV manager (paged KV blocks, prefix cache, session offload) -----
+_knob("KT_KV_BLOCK_TOKENS", "int", 16,
+      "Tokens per KV block in the engine's HBM ledger — the accounting "
+      "(and session-export leaf) granularity for rows, shared prefixes, "
+      "and admission costs.", "engine-kv")
+_knob("KT_KV_HBM_BUDGET", "int", 0,
+      "Engine HBM budget in KV blocks shared by row planes and cached "
+      "prefix blocks; past it cold prefixes LRU-evict and new programs "
+      "shed typed (0 = 2x the decode grid's block count).", "engine-kv")
+_knob("KT_KV_PREFIX_SPLIT", "str", "off",
+      "Automatic prefix-sharing split rule applied to every submitted "
+      "prompt: 'off', 'len:N' (first N tokens are the shared prefix), or "
+      "'token:ID' (split after the last occurrence of token ID, e.g. a "
+      "system-prompt terminator).", "engine-kv")
+_knob("KT_KV_OFFLOAD_CODEC", "str", "auto",
+      "Wire codec for parked-session KV offload. 'auto' = raw (exact "
+      "resume for every grid; int8 grids' (q, scale) pairs are already "
+      "half-size). 'int8' halves a bf16 grid's wire bytes at the cost "
+      "of token-exact resume; zlib/zstd compress losslessly.",
+      "engine-kv")
+_knob("KT_KV_SESSION_PREFIX", "str", "kv/sessions",
+      "Store key prefix parked-session KV blobs are published under.",
+      "engine-kv")
+_knob("KT_KV_SESSION_DELTA", "bool", True,
+      "Delta-manifest publish for session KV re-parks: a grown cache "
+      "ships only its new blocks (per-block leaves + PR-3 delta).",
+      "engine-kv")
+
 # --- distributed ------------------------------------------------------------
 _knob("KT_POD_IPS", "str", None,
       "Comma-separated pod IPs for the gang (rendezvous).", "distributed")

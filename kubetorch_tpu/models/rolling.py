@@ -235,8 +235,17 @@ class RollingGenerator:
         # recent-token window per slot for repetition penalty (−1 = empty)
         self._win = np.full((max_slots, 64), -1, np.int32)
         # prefix_id -> {k, v, len, logits} (device KV blocks, see
-        # register_prefix)
+        # register_prefix). Ids come from a counter, NOT len(_prefixes):
+        # drop_prefix (the KV pool's LRU eviction) punches holes, and a
+        # reused id would silently serve the wrong prefix to an old
+        # submitter.
         self._prefixes: Dict[int, dict] = {}
+        self._next_prefix_id = 0
+        # prompt tokens actually run through a prefill forward (suffix
+        # only for prefixed admissions; each shared prefix counts once
+        # at register_prefix) — the numerator of the serving engine's
+        # prefix-sharing savings ratio
+        self.prefill_tokens = 0
 
         # Donation matters doubly here: the cache grid is the largest
         # buffer in the server and every call rewrites it — aliasing
@@ -538,12 +547,185 @@ class RollingGenerator:
             planes, logits = self._prefix_fill(
                 self.params, jnp.asarray(toks),
                 jnp.int32(len(tokens)), self._lora(oh), p_pad=p_pad)
-        pid = len(self._prefixes)
+        pid = self._next_prefix_id
+        self._next_prefix_id += 1
         self._prefixes[pid] = {
             "planes": planes, "len": len(tokens), "logits": logits,
             "tokens": tokens, "adapter_id": adapter_id,
         }
+        self.prefill_tokens += len(tokens)
         return pid
+
+    def drop_prefix(self, prefix_id: int) -> bool:
+        """Release a registered prefix's device KV block (the KV pool's
+        LRU eviction hook). Rows already spliced keep their copy — the
+        splice is a value copy, not a reference — so dropping is safe at
+        any time; only FUTURE submits with this id fail."""
+        return self._prefixes.pop(prefix_id, None) is not None
+
+    def prefix_len(self, prefix_id: int) -> int:
+        return self._prefixes[prefix_id]["len"]
+
+    def export_row(self, rid: int, block_tokens: int = 16
+                   ) -> Dict[str, Any]:
+        """Export a decode-active row as a host pytree — its grid KV up
+        to the row's depth plus everything needed to resume the request
+        elsewhere/later (sampler params, penalty window, emitted tokens,
+        stop sequences). The serving engine's session-park path publishes
+        this tree through the store codec (``serving/kvpool.py``).
+
+        KV ships as PER-BLOCK leaves (``block_tokens`` positions each,
+        depth padded up to a block boundary): under a delta-manifest
+        publish a RE-park of a grown conversation ships only its new
+        blocks, and the block-rounded depth keeps :meth:`import_row`'s
+        splice to O(few) compiled shapes. On the int8 grid the exported
+        planes are the grid's ``(q, scale)`` pairs verbatim — restoring
+        them is bit-exact. A prefixed row exports its SPLICED prefix
+        rows too (depth includes the prefix), so the state is
+        self-contained: restore needs no prefix registered.
+
+        Deliberately scoped: queued / mid-chunked-prefill rows raise
+        (their logits aren't seeded yet — park after the first chunk),
+        and speculative engines raise (their device draft context is
+        round-carried state this export does not capture)."""
+        if self.spec:
+            raise ValueError("speculative engines (spec_k > 1) carry "
+                             "device draft context; row export is not "
+                             "supported")
+        slot = None
+        for s, req in self._slots.items():
+            if req.rid == rid:
+                slot = s
+                break
+        if slot is None:
+            raise KeyError(
+                f"rid {rid} is not decode-active (queued and "
+                f"mid-prefill rows cannot export)")
+        from kubetorch_tpu.serving.kvpool import padded_blocks
+
+        req = self._slots[slot]
+        bt = max(1, int(block_tokens))
+        dpos = int(np.asarray(self._dpos[slot]))
+        dend = padded_blocks(dpos, bt, self.max_len) * bt
+        if dend > self.max_len:
+            # the grid tail is not block-aligned: fall back to whole
+            # blocks only, which must still cover the row's depth
+            dend = (self.max_len // bt) * bt
+            if dpos > dend:
+                raise ValueError(
+                    f"cannot export a depth-{dpos} row in {bt}-token "
+                    f"blocks on a max_len-{self.max_len} grid — pick a "
+                    f"KT_KV_BLOCK_TOKENS that divides max_len")
+        kv: Dict[str, Dict[str, np.ndarray]] = {}
+        for kk in self.cache:
+            plane = np.array(self.cache[kk][:, slot, :dend])
+            # ZERO the block-padded tail beyond the row's depth: freed
+            # rows never clear their cache planes (attention masks them
+            # out), so positions >= dpos still hold the slot's PREVIOUS
+            # occupant's K/V — exporting them would publish another
+            # session's data to the store. Zeroing also keeps the pad
+            # blocks byte-stable for the delta manifest.
+            plane[:, dpos:] = 0
+            kv[kk] = {f"{b:05d}": plane[:, b * bt:(b + 1) * bt]
+                      for b in range(dend // bt)}
+        stop_flat = [t for seq in req.stop for t in seq]
+        return {
+            "kv": kv,
+            "logits": np.asarray(self._logits[slot]),
+            "win": np.asarray(self._win[slot]),
+            "sampler": np.asarray(
+                [req.temperature, req.repetition_penalty], np.float32),
+            "prompt": np.asarray(req.prompt, np.int64),
+            "tokens": np.asarray(req.tokens, np.int64),
+            "stop_flat": np.asarray(stop_flat, np.int64),
+            "stop_lens": np.asarray([len(s) for s in req.stop],
+                                    np.int64),
+            # [ctx_tokens, emitted, max_new_tokens, ...] — the first
+            # three are the engine-agnostic header kvpool.state_summary
+            # reads; the rest are this engine's own
+            "scalars": np.asarray(
+                [dpos, len(req.tokens), req.max_new_tokens,
+                 req.adapter_id, int(self.kv_quantized), bt],
+                np.int64),
+        }
+
+    def import_row(self, state: Dict[str, Any]) -> int:
+        """Splice an exported row into a free slot of THIS engine and
+        resume decoding it — the restore half of :meth:`export_row`
+        (same grid geometry required: layer/head/dim AND ``kv_dtype``
+        must match, depth must fit ``max_len``).
+
+        The splice writes the row's KV at positions ``[0, depth)`` with
+        one ``.at[].set`` per cache plane — a fresh compile per distinct
+        block-rounded depth, which the block rounding keeps to a handful
+        of shapes. Returns the NEW rid (rids are engine-local). Sampler
+        RNG is engine-global and not part of the export: greedy resumes
+        are token-identical to an uninterrupted run; sampled resumes are
+        distribution-correct but draw a fresh key sequence."""
+        if self.spec:
+            raise ValueError("speculative engines (spec_k > 1) do not "
+                             "support row import")
+        if not self._free:
+            raise RuntimeError("no free row to import into")
+        if set(state["kv"]) != set(self.cache):
+            raise ValueError(
+                f"KV planes {sorted(state['kv'])} do not match this "
+                f"grid's {sorted(self.cache)} — kv_dtype mismatch "
+                f"between export and import engines")
+        scalars = [int(x) for x in np.asarray(state["scalars"])]
+        dpos, n_emitted, max_new = scalars[0], scalars[1], scalars[2]
+        adapter_id = scalars[3] if len(scalars) > 3 else -1
+        self._check_adapter_id(adapter_id)
+        planes = {
+            kk: np.concatenate(
+                [np.asarray(blocks[b]) for b in sorted(blocks)], axis=1)
+            for kk, blocks in state["kv"].items()}
+        dend = planes["k"].shape[1]
+        if dend > self.max_len or planes["k"].shape[0] != \
+                self.cache["k"].shape[0] or \
+                planes["k"].shape[2:] != self.cache["k"].shape[3:]:
+            raise ValueError(
+                f"imported KV shape {planes['k'].shape} does not fit "
+                f"grid {self.cache['k'].shape} (max_len {self.max_len})")
+        margin = self.steps_per_call
+        if dpos + (max_new - n_emitted) + margin > self.max_len:
+            raise ValueError(
+                f"restored depth {dpos} + remaining budget "
+                f"{max_new - n_emitted} + chunk margin {margin} exceeds "
+                f"max_len {self.max_len}")
+        slot = self._free.pop(0)
+        with self._mesh_ctx():
+            for kk in self.cache:
+                self.cache[kk] = self.cache[kk].at[:, slot, :dend].set(
+                    jnp.asarray(planes[kk]).astype(self.cache[kk].dtype))
+            self._logits = self._logits.at[slot].set(
+                jnp.asarray(np.asarray(state["logits"], np.float32)))
+            self._dpos = self._dpos.at[slot].set(dpos)
+            self._dactive = self._dactive.at[slot].set(True)
+        temp, penalty = (float(x) for x in np.asarray(state["sampler"]))
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(rid, [int(t) for t in np.asarray(state["prompt"])],
+                      max_new, temp)
+        req.tokens = [int(t) for t in np.asarray(state["tokens"])]
+        req.consumed = len(req.prompt)
+        req.repetition_penalty = penalty
+        req.adapter_id = adapter_id
+        stop_flat = [int(t) for t in np.asarray(state["stop_flat"])]
+        stops, at = [], 0
+        for n in (int(x) for x in np.asarray(state["stop_lens"])):
+            stops.append(stop_flat[at:at + n])
+            at += n
+        req.stop = stops
+        req.slot = slot
+        self._temps[slot] = temp
+        self._penalties[slot] = penalty
+        self._win[slot] = np.asarray(state["win"], np.int32)
+        self._slot_onehot[slot] = 0.0
+        if adapter_id >= 0:
+            self._slot_onehot[slot, adapter_id] = 1.0
+        self._slots[slot] = req
+        return rid
 
     def warmup(self, prompt_buckets=(16, 64, 128),
                sampling: bool = False) -> None:
@@ -577,6 +759,7 @@ class RollingGenerator:
         if req.adapter_id >= 0:
             self._slot_onehot[req.slot, req.adapter_id] = 1.0
         self._prefilling[req.slot] = req
+        self.prefill_tokens += len(req.prompt)
 
     def _admit_group(self, group: List[Request], p_pad: int,
                      prefix_id: Optional[int] = None):
@@ -608,6 +791,7 @@ class RollingGenerator:
             if req.repetition_penalty != 1.0 and tail:
                 self._win[req.slot, -len(tail):] = tail
             self._slots[req.slot] = req
+            self.prefill_tokens += len(req.prompt)
         with self._mesh_ctx():
             if prefix_id is None:
                 (self.cache, self._logits, self._dpos,
@@ -1216,6 +1400,18 @@ class RollingDecoder:
             [int(t) for t in prompt], max_new_tokens=max_new_tokens,
             temperature=temperature, prefix_id=prefix_id, stop=stop,
             repetition_penalty=repetition_penalty, adapter_id=adapter_id)
+
+    def register_prefix(self, tokens, adapter_id: int = -1) -> int:
+        """Prefill a shared prefix once, server-side; the returned id
+        goes back into :meth:`submit`'s ``prefix_id`` (JSON-able both
+        ways — this is the client surface the wire field was waiting
+        for). Per-adapter prefixes are separate registrations, matching
+        the engine's weight-dependence rule."""
+        return int(self.engine.register_prefix(
+            [int(t) for t in tokens], adapter_id=int(adapter_id)))
+
+    def drop_prefix(self, prefix_id: int) -> bool:
+        return bool(self.engine.drop_prefix(int(prefix_id)))
 
     def step(self) -> Dict[str, Any]:
         """One decode chunk. Returns ``{"events": [[rid, tokens, done],

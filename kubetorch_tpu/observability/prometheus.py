@@ -483,6 +483,21 @@ _ENGINE: Dict[str, float] = {
     "engine_active_rows": 0.0,
     "engine_free_rows": 0.0,
     "engine_prefilling_rows": 0.0,
+    # paged-KV manager (serving/kvpool.py): HBM-block occupancy, prefix
+    # cache hit rate, and session offload/restore traffic — same ride
+    # (worker piggyback -> pod /metrics + control frames) as the engine
+    # counters above, because the KV pool lives inside the engine
+    "kv_blocks_used": 0.0,
+    # kv_blocks_free is deliberately NOT pre-seeded: it is only
+    # meaningful (and only recorded) when a KV budget is set — a 0.0
+    # seed would scrape as "zero headroom" on unbounded pods
+    "prefix_hits_total": 0.0,
+    "prefix_misses_total": 0.0,
+    "prefix_evictions_total": 0.0,
+    "kv_offloads_total": 0.0,
+    "kv_restores_total": 0.0,
+    "kv_offload_bytes_total": 0.0,
+    "kv_restore_bytes_total": 0.0,
 }
 _ENGINE_EVENTS = {
     "generation": "engine_generations_total",
@@ -494,21 +509,32 @@ _ENGINE_EVENTS = {
     "shed": "engine_sheds_total",
     "tick_error": "engine_tick_errors_total",
     "device_seconds": "engine_device_seconds_total",
+    "prefix_hit": "prefix_hits_total",
+    "prefix_miss": "prefix_misses_total",
+    "prefix_evict": "prefix_evictions_total",
+    "kv_offload": "kv_offloads_total",
+    "kv_restore": "kv_restores_total",
+    "kv_offload_bytes": "kv_offload_bytes_total",
+    "kv_restore_bytes": "kv_restore_bytes_total",
 }
 _ENGINE_GAUGES = {
     "queue_depth": "engine_queue_depth",
     "active_rows": "engine_active_rows",
     "free_rows": "engine_free_rows",
     "prefilling_rows": "engine_prefilling_rows",
+    "kv_blocks_used": "kv_blocks_used",
+    "kv_blocks_free": "kv_blocks_free",
 }
 
 
 def record_engine(event: str, value: float = 1.0) -> None:
     """Bump a serving-engine counter (``generation`` / ``step`` /
     ``tokens`` / ``admit`` / ``prefill_chunk`` / ``evict`` / ``shed`` /
-    ``tick_error`` / ``device_seconds``) or set an occupancy gauge
-    (``queue_depth`` / ``active_rows`` / ``free_rows`` /
-    ``prefilling_rows``)."""
+    ``tick_error`` / ``device_seconds``, plus the KV-pool events
+    ``prefix_hit`` / ``prefix_miss`` / ``prefix_evict`` /
+    ``kv_offload[_bytes]`` / ``kv_restore[_bytes]``) or set an occupancy
+    gauge (``queue_depth`` / ``active_rows`` / ``free_rows`` /
+    ``prefilling_rows`` / ``kv_blocks_used`` / ``kv_blocks_free``)."""
     with _ENGINE_LOCK:
         counter = _ENGINE_EVENTS.get(event)
         if counter is not None:

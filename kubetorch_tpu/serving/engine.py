@@ -41,11 +41,30 @@ On top of the loop sits a **per-row admission scheduler**:
   computed ``retry_after`` — the same PR-8 admission contract the POST
   path has, so ``retry.py`` retries sheds safely.
 
+Under the scheduler sits the **paged-KV manager** (ISSUE 11,
+``serving/kvpool.py``) — HBM treated as the multi-tenant resource:
+
+- prompts split by ``KT_KV_PREFIX_SPLIT`` are content-hashed per
+  adapter against a refcounted prefix cache — N programs with one
+  system prompt prefill it ONCE (hit → reuse the registered device
+  block, miss → register for everyone after); cold prefixes LRU-evict
+  under ``KT_KV_HBM_BUDGET``;
+- admission is priced in KV BLOCKS (``KT_KV_BLOCK_TOKENS``), one budget
+  over row planes + prefix blocks; a prefix-hit program costs only its
+  suffix, and budget exhaustion sheds typed instead of OOMing the grid;
+- ``session_id`` programs can PARK (explicit :meth:`DecodeEngine.park`
+  or deadline eviction): the row's KV + sampler state offloads through
+  the PR-1/3 store path (int8 grids ship (q, scale) raw, re-parks ride
+  the delta manifest) and a later same-session program restores into a
+  free row and resumes mid-generation without re-prefill.
+
 The engine publishes ``engine_*`` Prometheus counters/gauges (queue
 depth, active/free rows, steps, sheds — the signal the autoscaler will
-consume) and ``engine.step`` / ``engine.admit`` / ``engine.prefill``
-spans into the worker's trace ring. Clients poll the snapshot without
-touching the device via a channel **control frame**
+consume) plus the KV manager's ``kv_*``/``prefix_*`` set, and
+``engine.step`` / ``engine.admit`` / ``engine.prefill`` /
+``engine.prefix_fill`` / ``kv.offload`` / ``kv.restore`` spans into the
+worker's trace ring. Clients poll the snapshot without touching the
+device via a channel **control frame**
 (``CallChannel.control("stats")`` — answered by the pod server
 out-of-band, no worker hop).
 
@@ -67,19 +86,15 @@ from typing import Any, Dict, List, Optional
 from kubetorch_tpu.config import env_float, env_int
 from kubetorch_tpu.exceptions import DeadlineExceeded, ServerOverloaded
 from kubetorch_tpu.observability import tracing
+from kubetorch_tpu.serving import kvpool
 from kubetorch_tpu.serving.replay import retry_after_estimate
 
 
 def _record_engine(event: str, value: float = 1.0) -> None:
     """``prometheus.record_engine`` behind the call path's
-    must-never-raise guard."""
-    try:
-        from kubetorch_tpu.observability import prometheus as prom
-
-        prom.record_engine(event, value)
-    # ktlint: disable=KT004 -- metrics must never break the decode loop
-    except Exception:  # noqa: BLE001
-        pass
+    must-never-raise guard (one shared implementation —
+    ``kvpool._record``)."""
+    kvpool._record(event, value)
 
 
 class GenerationProgram:
@@ -106,7 +121,8 @@ class GenerationProgram:
     def __init__(self, prompts: List[List[int]], max_new_tokens: int,
                  temperature: float, stop, repetition_penalty: float,
                  adapter_id: int, prefix_id: Optional[int],
-                 deadline_s: Optional[float], tag: Optional[str]):
+                 deadline_s: Optional[float], tag: Optional[str],
+                 session_id: Optional[str] = None):
         self.prompts = prompts
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
@@ -116,6 +132,7 @@ class GenerationProgram:
         self.prefix_id = prefix_id
         self.deadline_s = deadline_s
         self.tag = tag
+        self.session_id = session_id
 
     @classmethod
     def from_wire(cls, obj: Any) -> "GenerationProgram":
@@ -138,6 +155,14 @@ class GenerationProgram:
             deadline_s = float(deadline_s)
             if deadline_s <= 0:
                 raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        session_id = obj.get("session_id")
+        if session_id is not None:
+            kvpool.check_session_id(session_id)
+            if len(prompts) != 1:
+                # a session parks/restores ONE row's KV; a multi-prompt
+                # program has no well-defined park state
+                raise ValueError("session_id programs must carry exactly "
+                                 "one prompt")
         return cls(
             prompts=prompts,
             max_new_tokens=int(obj.get("max_new_tokens", 128)),
@@ -147,13 +172,57 @@ class GenerationProgram:
             adapter_id=int(obj.get("adapter_id", -1)),
             prefix_id=obj.get("prefix_id"),
             deadline_s=deadline_s,
-            tag=obj.get("tag"))
+            tag=obj.get("tag"),
+            session_id=session_id)
 
     def submit_kwargs(self) -> Dict[str, Any]:
         return {"max_new_tokens": self.max_new_tokens,
                 "temperature": self.temperature, "stop": self.stop,
                 "repetition_penalty": self.repetition_penalty,
                 "adapter_id": self.adapter_id, "prefix_id": self.prefix_id}
+
+
+def program(prompt: Optional[List[int]] = None, *,
+            prompts: Optional[List[List[int]]] = None,
+            max_new_tokens: int = 128, temperature: float = 0.0,
+            stop: Optional[List[List[int]]] = None,
+            repetition_penalty: float = 1.0, adapter_id: int = -1,
+            prefix_id: Optional[int] = None,
+            session_id: Optional[str] = None,
+            deadline_s: Optional[float] = None,
+            tag: Optional[str] = None) -> Dict[str, Any]:
+    """Client-side builder for the ``generate`` wire dict — the API that
+    actually SETS ``prefix_id`` / ``session_id`` (the wire fields
+    existed; nothing on the client wrote them)::
+
+        chan.submit(program(toks, session_id="user-42", max_new_tokens=256),
+                    method="generate", stream=True, concurrent=True)
+
+    Validates eagerly (the same :class:`GenerationProgram` parse the
+    server runs) so a bad program fails at the call site, not as a
+    rehydrated server error."""
+    obj: Dict[str, Any] = {"max_new_tokens": int(max_new_tokens),
+                           "temperature": float(temperature),
+                           "repetition_penalty": float(repetition_penalty),
+                           "adapter_id": int(adapter_id)}
+    if (prompt is None) == (prompts is None):
+        raise ValueError("pass exactly one of prompt= or prompts=")
+    if prompt is not None:
+        obj["prompt"] = [int(t) for t in prompt]
+    else:
+        obj["prompts"] = [[int(t) for t in p] for p in prompts]
+    if stop is not None:
+        obj["stop"] = [[int(t) for t in s] for s in stop]
+    if prefix_id is not None:
+        obj["prefix_id"] = int(prefix_id)
+    if session_id is not None:
+        obj["session_id"] = session_id
+    if deadline_s is not None:
+        obj["deadline_s"] = float(deadline_s)
+    if tag is not None:
+        obj["tag"] = str(tag)
+    GenerationProgram.from_wire(obj)
+    return obj
 
 
 class DecodeEngine:
@@ -179,12 +248,19 @@ class DecodeEngine:
     The wrapped ``engine`` needs the :class:`RollingGenerator` driving
     surface: ``submit/admit/prefill_step/decode_step/evict`` plus the
     ``queued/free_rows/active_rows/prefilling_rows/pending`` counts.
+    Prefix sharing additionally uses ``register_prefix/drop_prefix`` and
+    the ``prefill_tokens`` counter; session park/restore uses
+    ``export_row/import_row`` (all optional — an engine without them
+    simply serves unshared, unparked).
     """
 
     def __init__(self, engine, poll_s: Optional[float] = None,
                  admit_rows: Optional[int] = None,
                  max_waiting: Optional[int] = None,
-                 stall_s: Optional[float] = None):
+                 stall_s: Optional[float] = None,
+                 kv_block_tokens: Optional[int] = None,
+                 kv_budget_blocks: Optional[int] = None,
+                 prefix_split: Optional[str] = None):
         self.engine = engine
         self._poll_s = (poll_s if poll_s is not None
                         else env_float("KT_ENGINE_POLL_S"))
@@ -194,6 +270,64 @@ class DecodeEngine:
                              else env_int("KT_ENGINE_MAX_WAITING"))
         self._stall_s = (stall_s if stall_s is not None
                          else env_float("KT_ENGINE_STALL_S"))
+        # Paged-KV manager (serving/kvpool.py): block ledger + prefix
+        # cache + session offload. Budget default: 2x the decode grid in
+        # blocks — the grid itself plus as much again for shared prefix
+        # blocks before cold ones LRU-evict.
+        bt = (kv_block_tokens if kv_block_tokens is not None
+              else env_int("KT_KV_BLOCK_TOKENS"))
+        budget = (kv_budget_blocks if kv_budget_blocks is not None
+                  else env_int("KT_KV_HBM_BUDGET"))
+        # a row's plane is physically bounded by the grid depth — price
+        # admission at min(context + budget, max_len) blocks, exactly
+        # what the row can occupy
+        self._row_cap_tokens = int(getattr(engine, "max_len", 2048))
+        if not budget:
+            grid_blocks = (int(getattr(engine, "max_slots", 8))
+                           * kvpool.blocks_for(self._row_cap_tokens, bt))
+            budget = 2 * grid_blocks
+        self._kv = kvpool.PagedKVPool(budget, bt, prefix_split)
+        # rid -> {"blocks", "session", "prefix_pid"} — the release-side
+        # bookkeeping of the ledger reservations made at submit
+        self._rid_meta: Dict[int, Dict[str, Any]] = {}
+        # single-flight per session: a session_id owns at most ONE live
+        # row — a client retry racing its own in-flight program must not
+        # restore (or decode) the same session twice
+        self._live_sessions: set = set()
+        # per-session activity sequence: bumped every time a program
+        # claims the session (fresh submit or restore). Background
+        # offloads capture it at export and refuse to publish a blob a
+        # NEWER program has since superseded (a late-landing deadline
+        # park must not shadow the session's next generation). Values
+        # come from one GLOBAL monotonic counter: an entry evicted from
+        # the bounded dict and later recreated can then never land on a
+        # value an in-flight offload captured.
+        self._session_seq: Dict[str, int] = {}
+        self._seq_counter = 0
+        # sessions that may have a blob in the store (parked or
+        # restored): the completion-drop only pays its store round-trips
+        # for these. LRU-bounded dict; ABSENCE must mean "no blob", so
+        # evicting a tracking entry also drops its blob (the evicted
+        # session loses its resume — a bounded-resource policy, like
+        # prefix LRU — rather than silently keeping a stale blob its
+        # completion would never clean).
+        self._parked_sessions: Dict[str, bool] = {}
+        # serializes park PUBLISHES (explicit + background): a stale
+        # deadline-offload's check+publish must be atomic w.r.t. a
+        # newer explicit park's, or the stale publish can land OVER the
+        # newer blob after its durability sentinel was delivered.
+        # Ordering: _offload_lock is always taken OUTSIDE _wake.
+        self._offload_lock = threading.Lock()
+        # seconds-per-KV-block-freed EMA: the block-admission estimate's
+        # clock (rows free whole reservations at once; per-block keeps
+        # the estimate size-aware)
+        self._ema_block_s = 0.01
+        # prefix-sharing savings accounting — BOTH sides counted HERE
+        # (engine.prefill_tokens also moves on warmup()/direct submits
+        # that never pass through generate(), which would skew the
+        # ratio negative after a standard warm-then-serve startup)
+        self._prefill_naive = 0       # sum(len(full prompt)) submitted
+        self._prefill_executed = 0    # suffixes + once-per-prefix fills
         self._wake = threading.Condition()
         self._sinks: Dict[int, "_queue.SimpleQueue"] = {}
         self._deadlines: Dict[int, float] = {}
@@ -211,6 +345,8 @@ class DecodeEngine:
         self._device_s = 0.0
         self._prefill_chunks = 0
         self._admitted = 0
+        self._parks = 0
+        self._restores = 0
         self._stop = False
         # copy_context: driver-thread spans/log lines keep the ids of
         # whatever context built the engine
@@ -228,33 +364,140 @@ class DecodeEngine:
 
         Frames: ``{"i": prompt-index, "rid": engine-rid, "seq": n,
         "tokens": [...], "done": bool}``; the stream ends when every
-        prompt in the program is done."""
+        prompt in the program is done. A parked program (see
+        :meth:`park`) ends with one ``{"parked": True, "done": False}``
+        frame instead.
+
+        **Prefix sharing**: with ``KT_KV_PREFIX_SPLIT`` active, each
+        prompt is split into (prefix, suffix); the prefix half is
+        content-hashed per adapter against the pool — a hit reuses the
+        already-registered device KV block and only the suffix
+        prefills; a miss registers the prefix ONCE for every later
+        same-hash program. **Sessions**: a program with ``session_id``
+        whose id has parked KV in the store restores it through the
+        streaming path into a free row and resumes mid-generation —
+        its ``prompt`` is ignored (the parked state is the program)."""
         prog = GenerationProgram.from_wire(program)
         sink: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        restored = None
+        if prog.session_id is not None:
+            with self._wake:
+                self._check_session_free_locked(prog.session_id)
+            # store fetch OUTSIDE the scheduler lock: a slow restore
+            # must not stall the decode loop (re-checked under the lock
+            # before the import — two racing fetches, one winner). The
+            # session seq is bumped only when this program actually
+            # TAKES a row (submit/import): a program that sheds or fails
+            # validation must not supersede an in-flight park publish —
+            # that publish may hold the only copy of the state.
+            if hasattr(self.engine, "import_row"):
+                restored = kvpool.restore_session(prog.session_id)
         with self._wake:
-            self._shed_check_locked(len(prog.prompts))
             deadline = (time.time() + prog.deadline_s
                         if prog.deadline_s is not None else None)
             rids: List[int] = []
             now = time.perf_counter()
-            try:
-                for p in prog.prompts:
-                    rid = self.engine.submit(p, **prog.submit_kwargs())
-                    rids.append(rid)
-                    self._sinks[rid] = sink
-                    self._submit_t[rid] = now
-                    if deadline is not None:
-                        self._deadlines[rid] = deadline
-            except BaseException:
-                # a later prompt failed validation (too long, bad
-                # adapter/prefix): the earlier prompts are already
-                # queued — release them NOW or they burn rows streaming
-                # into a sink nobody will ever read (and a client retry
-                # of the whole program would re-run their work)
-                for rid in rids:
-                    self.engine.evict(rid)
-                    self._forget_locked(rid)
-                raise
+            if restored is not None:
+                rid = self._restore_locked(prog, restored)
+                rids.append(rid)
+                self._sinks[rid] = sink
+                self._submit_t[rid] = now
+                if deadline is not None:
+                    self._deadlines[rid] = deadline
+                self._restores += 1
+                # the blob is still in the store: completion must drop it
+                self._note_parked_locked(prog.session_id)
+            else:
+                if prog.session_id is not None:
+                    # re-check under THIS lock hold: a racing retry may
+                    # have registered the session since the pre-fetch
+                    # check released the lock
+                    self._check_session_free_locked(prog.session_id)
+                plan = self._plan_locked(prog)
+                self._shed_check_locked(prog, plan)
+                # protect the WHOLE plan's prefixes from make-room
+                # eviction for the span of this submit loop: item 1's
+                # row make-room must not evict item 2's (still
+                # refcount-0) hit entry, or item 2's submit would hit a
+                # dangling prefix_id
+                protect = {item["entry"].pid for item in plan
+                           if item["entry"] is not None}
+                try:
+                    for item in plan:
+                        pid = prog.prefix_id
+                        if item["prefix"]:
+                            pid, registered = self._ensure_prefix_locked(
+                                item["prefix"], prog.adapter_id,
+                                item["key"], frozenset(protect))
+                            if registered:
+                                # this program's miss ran the prefix
+                                # fill — count it against ITS naive
+                                # tokens (an explicit register_prefix
+                                # is deliberately uncounted: it has no
+                                # naive side and would skew the
+                                # savings ratio negative)
+                                self._prefill_executed += len(
+                                    item["prefix"])
+                        if pid is not None:
+                            protect.add(pid)
+                        suffix = (item["suffix"] if pid is not None
+                                  or not item["prefix"]
+                                  else item["prefix"] + item["suffix"])
+                        kwargs = dict(prog.submit_kwargs())
+                        kwargs["prefix_id"] = pid
+                        row_tokens = min(
+                            len(suffix) + prog.max_new_tokens,
+                            self._row_cap_tokens)
+                        # the shed check priced the program, but an
+                        # unshared fallback (pid None on a planned
+                        # prefix) costs more than priced — enforce the
+                        # budget here rather than silently oversubscribe
+                        # (raising rolls back this program's earlier
+                        # rows below)
+                        if not self._make_room_locked(
+                                self._kv.row_cost(row_tokens),
+                                protect=frozenset(protect)):
+                            max_delay = env_float("KT_MAX_QUEUE_DELAY_S")
+                            raise ServerOverloaded(
+                                f"KV budget exhausted mid-admission "
+                                f"({self._kv.row_cost(row_tokens)} "
+                                f"blocks needed, "
+                                f"{self._kv.free_blocks} free)",
+                                retry_after=retry_after_estimate(
+                                    self._kv.row_cost(row_tokens), 1,
+                                    self._ema_block_s, cap_s=max_delay))
+                        rid = self.engine.submit(suffix, **kwargs)
+                        rids.append(rid)
+                        self._sinks[rid] = sink
+                        self._submit_t[rid] = now
+                        if deadline is not None:
+                            self._deadlines[rid] = deadline
+                        # prefix_pid=pid covers explicit prefix_ids too:
+                        # if the pool knows the pid it refcounts it (an
+                        # unknown/engine-only pid is a no-op)
+                        blocks = self._kv.reserve_row(
+                            rid, row_tokens, prefix_pid=pid)
+                        self._rid_meta[rid] = {
+                            "blocks": blocks,
+                            "session": prog.session_id}
+                        if prog.session_id is not None:
+                            self._live_sessions.add(prog.session_id)
+                            self._bump_session_seq_locked(
+                                prog.session_id)
+                        self._prefill_naive += (len(item["prefix"])
+                                                + len(item["suffix"]))
+                        self._prefill_executed += len(suffix)
+                except BaseException:
+                    # a later prompt failed validation (too long, bad
+                    # adapter/prefix): the earlier prompts are already
+                    # queued — release them NOW or they burn rows
+                    # streaming into a sink nobody will ever read (and a
+                    # client retry of the whole program would re-run
+                    # their work)
+                    for rid in rids:
+                        self.engine.evict(rid)
+                        self._release_locked(rid)
+                    raise
             if prog.tag:
                 # bounded: one entry per tag would be a slow leak on a
                 # long-lived pod tagging every request
@@ -280,6 +523,17 @@ class DecodeEngine:
                 if isinstance(payload, BaseException):
                     live.discard(rid)
                     raise payload
+                if payload is None:
+                    # the row was PARKED (explicit park): its KV is on
+                    # its way to the store; the stream ends cleanly and
+                    # a later same-session_id program resumes it
+                    live.discard(rid)
+                    frame = {"i": index_of[rid], "rid": rid, "seq": seq,
+                             "tokens": [], "done": False, "parked": True,
+                             "session_id": prog.session_id}
+                    seq += 1
+                    yield frame
+                    continue
                 toks, done = payload
                 if done:
                     live.discard(rid)
@@ -298,8 +552,51 @@ class DecodeEngine:
                 with self._wake:
                     for rid in live:
                         self.engine.evict(rid)
-                        self._forget_locked(rid)
+                        self._release_locked(rid)
                         _record_engine("evict")
+
+    def register_prefix(self, tokens, adapter_id: int = -1) -> int:
+        """Explicit client-facing prefix registration, BUDGET-ACCOUNTED:
+        the block ledger charges it, cold prefixes make way for it, and
+        it is LRU-evictable like an auto-split registration — an
+        explicit surface that bypassed the pool would grow device prefix
+        planes the shed check can't see and reintroduce the HBM OOM the
+        budget exists to prevent. Content-deduplicated: re-registering
+        the same tokens+adapter returns the cached pid."""
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            raise ValueError("prefix needs >= 1 token")
+        if not hasattr(self.engine, "register_prefix"):
+            raise ValueError(
+                f"{type(self.engine).__name__} does not support "
+                f"prefix registration")
+        key = kvpool.prefix_key(tokens, adapter_id)
+        with self._wake:
+            need = self._kv.row_cost(len(tokens))
+            if self._kv.ledger.budget and need > self._kv.ledger.budget:
+                raise ValueError(
+                    f"a {len(tokens)}-token prefix needs {need} KV "
+                    f"blocks — more than the whole "
+                    f"{self._kv.ledger.budget}-block budget "
+                    f"(KT_KV_HBM_BUDGET); not retryable")
+            pid, _registered = self._ensure_prefix_locked(
+                tokens, int(adapter_id), key)
+            if pid is None:
+                max_delay = env_float("KT_MAX_QUEUE_DELAY_S")
+                raise ServerOverloaded(
+                    f"no KV-block headroom to register a "
+                    f"{len(tokens)}-token prefix "
+                    f"(KT_KV_HBM_BUDGET={self._kv.ledger.budget})",
+                    retry_after=retry_after_estimate(
+                        need, 1, self._ema_block_s, cap_s=max_delay))
+            return pid
+
+    def drop_prefix(self, prefix_id: int) -> bool:
+        """Explicitly release a registered prefix (ledger + device)."""
+        with self._wake:
+            self._kv.prefixes.remove(int(prefix_id))
+            return bool(getattr(self.engine, "drop_prefix",
+                                lambda _pid: False)(int(prefix_id)))
 
     def pending(self) -> int:
         """Engine-wide pending count — host bookkeeping, no device
@@ -312,6 +609,7 @@ class DecodeEngine:
         ``engine_*`` gauges the pod server's control frames answer
         from."""
         eng = self.engine
+        executed = self._prefill_executed
         out = {
             "queued": int(eng.queued),
             "free_rows": int(eng.free_rows),
@@ -325,6 +623,22 @@ class DecodeEngine:
             "admitted_rows": self._admitted,
             "ema_row_free_s": round(self._ema_row_s, 4),
             "ema_ttft_s": round(self._ema_ttft_s, 4),
+            # paged-KV manager: block occupancy, prefix-cache state, and
+            # the prefix-sharing savings ratio (prompt tokens that never
+            # ran a prefill forward because their prefix was cached)
+            "ema_block_free_s": round(self._ema_block_s, 5),
+            "prefill_tokens_executed": executed,
+            "prefill_tokens_naive": self._prefill_naive,
+            "prefill_tokens_saved_ratio": round(
+                1.0 - executed / self._prefill_naive, 4)
+            if self._prefill_naive else 0.0,
+            "parks": self._parks,
+            "restores": self._restores,
+            **self._kv.stats(),
+            # one source of truth for the offload/restore counts (the
+            # pool carries no counters of its own)
+            "kv_offloads": self._parks,
+            "kv_restores": self._restores,
         }
         return out
 
@@ -344,8 +658,99 @@ class DecodeEngine:
     def close(self) -> None:
         with self._wake:
             self._stop = True
+            # fail live streams NOW: a sink left dangling would block
+            # its generate() thread for the full KT_ENGINE_STALL_S
+            for rid, sink in list(self._sinks.items()):
+                sink.put((rid, RuntimeError(
+                    "engine closed with the generation live")))
+                self._release_locked(rid)
             self._wake.notify_all()
         self._driver.join(timeout=5.0)
+
+    def park(self, session_id: str) -> int:
+        """Explicitly park a live session: export its row's KV + sampler
+        state, publish it to the store (synchronously — when this
+        returns, the state is durable and survives a pod kill), evict
+        the row, and end the program's stream with a ``parked`` frame.
+        A later ``generate`` with the same ``session_id`` resumes
+        mid-generation without re-prefill. Returns rows parked (0 when
+        the session has no exportable row — unknown id, or still
+        mid-prefill)."""
+        kvpool.check_session_id(session_id)
+        if not hasattr(self.engine, "export_row"):
+            return 0                  # engine serves unparked (docstring)
+        quantized = bool(getattr(self.engine, "kv_quantized", False))
+        exported: List[tuple] = []              # (rid, sink, state)
+        with self._wake:
+            seq0 = self._session_seq.get(session_id, 0)
+            rids = [rid for rid, meta in list(self._rid_meta.items())
+                    if meta.get("session") == session_id]
+            for rid in rids:
+                try:
+                    state = self.engine.export_row(
+                        rid, block_tokens=self._kv.block_tokens)
+                except (KeyError, ValueError):
+                    continue          # queued / mid-prefill / exported
+                self.engine.evict(rid)
+                sink = self._sinks.get(rid)
+                self._release_locked(rid)
+                exported.append((rid, sink, state))
+        parked = 0
+        for rid, sink, state in exported:       # store I/O off the lock
+            # _offload_lock makes check+publish atomic w.r.t. any other
+            # session publish (a stale background deadline-offload must
+            # not interleave with — and land over — this durable park)
+            with self._offload_lock:
+                with self._wake:
+                    # absent = evicted-from-tracking, NOT superseded
+                    # (see _offload_async) — a durable explicit park
+                    # must not be falsely failed
+                    superseded = self._session_seq.get(
+                        session_id, seq0) != seq0
+                if superseded:
+                    # a new program claimed the session between the
+                    # export and this publish (the single-flight slot
+                    # freed with the row): landing our blob now would
+                    # shadow it — fail the parked stream typed instead
+                    if sink is not None:
+                        sink.put((rid, RuntimeError(
+                            f"park of session {session_id} superseded "
+                            f"by a newer program before its state was "
+                            f"published")))
+                    continue
+                try:
+                    kvpool.offload_session(session_id, state, quantized)
+                except BaseException as exc:
+                    # the row is gone but the state never landed: the
+                    # client must NOT be told it can resume — fail the
+                    # stream typed instead of the parked sentinel
+                    if sink is not None:
+                        sink.put((rid, RuntimeError(
+                            f"park of session {session_id} failed to "
+                            f"publish: {exc}")))
+                    raise
+                with self._wake:
+                    landed_superseded = self._session_seq.get(
+                        session_id, seq0) != seq0
+                    if not landed_superseded:
+                        self._parks += 1
+                        self._note_parked_locked(session_id)
+                if landed_superseded:
+                    # claimed while we published (see _offload_async):
+                    # the blob is stale the moment it landed — remove
+                    # it and fail the parked stream typed
+                    kvpool.drop_session(session_id)
+                    if sink is not None:
+                        sink.put((rid, RuntimeError(
+                            f"park of session {session_id} superseded "
+                            f"by a newer program while publishing")))
+                    continue
+            parked += 1
+            if sink is not None:
+                # sentinel only AFTER the blob is durable: when the
+                # client sees {'parked': True}, resume cannot lose state
+                sink.put((rid, None))
+        return parked
 
     # ------------------------------------------------------------ driver
     def _forget_locked(self, rid: int) -> None:
@@ -353,28 +758,229 @@ class DecodeEngine:
         self._deadlines.pop(rid, None)
         self._submit_t.pop(rid, None)
 
-    def _shed_check_locked(self, n_new: int) -> None:
-        """PR-8 admission control at the ROW level: when no row is
-        expected to free inside ``KT_MAX_QUEUE_DELAY_S`` (queued-ahead ×
-        the row-free EMA), shed with the computed Retry-After instead of
-        letting the program queue into a timeout. ``KT_ENGINE_MAX_WAITING``
-        is the hard queue-length backstop."""
+    def _check_session_free_locked(self, session_id: str) -> None:
+        if session_id in self._live_sessions:
+            raise ValueError(
+                f"session {session_id} already has a live generation on "
+                f"this engine — one row per session (a racing retry must "
+                f"not decode the same session twice)")
+
+    def _note_parked_locked(self, session_id: str) -> None:
+        """Track a session as having a store blob. One bounded LRU site
+        for every producer (park / deadline-offload / restore); an
+        entry evicted to keep the bound takes its blob with it."""
+        self._parked_sessions.pop(session_id, None)
+        if len(self._parked_sessions) >= 8192:
+            victim = next(iter(self._parked_sessions))
+            del self._parked_sessions[victim]
+            self._drop_session_async(victim)
+        self._parked_sessions[session_id] = True
+
+    def _bump_session_seq_locked(self, session_id: str) -> None:
+        """Advance the session's activity sequence (supersedes any
+        in-flight background offload). Bounded like ``_exec_counts``:
+        at 'millions of users' scale an unbounded per-session dict is a
+        slow OOM. Re-bumps re-insert the key (LRU, not FIFO — a hot
+        session is never the eviction victim), and values come from the
+        global counter so recreation can't collide with a captured one."""
+        self._session_seq.pop(session_id, None)
+        if len(self._session_seq) >= 4096:
+            self._session_seq.pop(next(iter(self._session_seq)))
+        self._seq_counter += 1
+        self._session_seq[session_id] = self._seq_counter
+
+    def _release_locked(self, rid: int) -> None:
+        """Forget a rid AND release its KV-pool holdings (ledger blocks
+        + prefix refcount + session single-flight slot) — every path
+        that frees a row goes through here so the accounting can never
+        leak."""
+        self._forget_locked(rid)
+        meta = self._rid_meta.pop(rid, None)
+        if meta and meta.get("session"):
+            self._live_sessions.discard(meta["session"])
+        self._kv.release_row(rid)
+
+    def _plan_locked(self, prog: GenerationProgram) -> List[Dict[str, Any]]:
+        """Split each prompt by the pool's prefix rule and annotate with
+        the cache state — the shed check prices the program from this
+        (prefix hits cost only their suffix) before anything is
+        submitted or registered."""
+        rule = self._kv.split
+        auto = (rule is not None and prog.prefix_id is None
+                and hasattr(self.engine, "register_prefix")
+                and not getattr(self.engine, "spec", False))
+        plan: List[Dict[str, Any]] = []
+        for p in prog.prompts:
+            # (naive-token accounting happens at SUBMIT, not here — a
+            # shed-and-retried program must not count twice)
+            prefix, suffix = (kvpool.split_prompt(p, rule) if auto
+                              else ([], list(p)))
+            key = (kvpool.prefix_key(prefix, prog.adapter_id)
+                   if prefix else None)
+            # peek, not lookup: planning must not bump the hit count or
+            # LRU position — only the admission path's lookup does
+            entry = self._kv.prefixes.peek(key) if key else None
+            plan.append({"prefix": prefix, "suffix": suffix,
+                         "key": key, "entry": entry})
+        return plan
+
+    def _make_room_locked(self, blocks: int,
+                          protect: frozenset = frozenset()) -> bool:
+        """LRU-evict cold (refcount-0) prefixes until ``blocks`` fit the
+        budget, freeing their device KV on the engine (never the pids in
+        ``protect``). → whether the room exists now. A STRUCTURAL
+        impossibility (blocks > the whole budget) returns False without
+        evicting anything — flushing the entire cache for a request that
+        can never fit would be pure thrash."""
+        if (self._kv.ledger.budget
+                and blocks > self._kv.ledger.budget):
+            return False
+        for victim in self._kv.prefixes.evict_for(blocks, protect):
+            try:
+                self.engine.drop_prefix(victim.pid)
+            # ktlint: disable=KT004 -- ledger already dropped it; a failed device free must not block admission
+            except Exception:  # noqa: BLE001
+                pass
+        return (not self._kv.ledger.budget
+                or self._kv.free_blocks >= blocks)
+
+    def _ensure_prefix_locked(self, prefix: List[int], adapter_id: int,
+                              key: str,
+                              protect: frozenset = frozenset()
+                              ) -> tuple:
+        """Hit → ``(pid, False)``. Miss → LRU-evict cold prefixes
+        (never ``protect``) to make room under the budget, prefill the
+        prefix ONCE (``engine.prefix_fill`` span), register it in the
+        pool → ``(pid, True)``. ``(None, False)`` when the budget cannot
+        fit it even after eviction — the caller serves the prompt
+        unshared rather than shedding. The explicit registered flag is
+        the caller's accounting signal (inferring it from cache size
+        breaks when the insert itself LRU-evicted an entry)."""
+        entry = self._kv.prefixes.lookup(key)
+        if entry is not None:
+            _record_engine("prefix_hit")
+            return entry.pid, False
+        need = kvpool.blocks_for(len(prefix), self._kv.block_tokens)
+        if not self._make_room_locked(need, protect):
+            return None, False
+        t0 = time.perf_counter()
+        pid = self.engine.register_prefix(prefix, adapter_id=adapter_id)
+        tracing.record_span(
+            "engine.prefix_fill", time.perf_counter() - t0,
+            attrs={"tokens": len(prefix), "adapter_id": adapter_id})
+        _record_engine("prefix_miss")
+        self._kv.prefixes.insert(key, pid, len(prefix), adapter_id)
+        return pid, True
+
+    def _restore_locked(self, prog: GenerationProgram,
+                        state: Dict[str, Any]) -> int:
+        """Splice a parked session's fetched state into a free row. No
+        free row / no block headroom → typed ``ServerOverloaded`` (the
+        parked blob stays put; the client retries after ``retry_after``)
+        — a restore must never evict a LIVE row to make room."""
+        ctx, emitted, max_new = kvpool.state_summary(state)
+        need = self._kv.row_cost(min(ctx + (max_new - emitted),
+                                     self._row_cap_tokens))
+        if self._kv.ledger.budget and need > self._kv.ledger.budget:
+            # structural: no amount of waiting frees enough blocks — a
+            # retryable shed here would loop forever
+            raise ValueError(
+                f"restored session {prog.session_id} needs {need} KV "
+                f"blocks — more than the whole {self._kv.ledger.budget}-"
+                f"block budget (KT_KV_HBM_BUDGET)")
+        max_delay = env_float("KT_MAX_QUEUE_DELAY_S")
+        if (self.engine.free_rows < 1
+                or not self._make_room_locked(need)):
+            retry_after = retry_after_estimate(
+                max(1, need), 1,
+                max(self._ema_block_s, self._ema_row_s),
+                cap_s=max_delay)
+            _record_engine("shed")
+            raise ServerOverloaded(
+                f"no free row/blocks to restore session "
+                f"{prog.session_id} into ({need} blocks needed)",
+                retry_after=retry_after)
+        self._check_session_free_locked(prog.session_id)
+        rid = self.engine.import_row(state)
+        blocks = self._kv.reserve_row(
+            rid, min(ctx + (max_new - emitted), self._row_cap_tokens))
+        self._rid_meta[rid] = {"blocks": blocks,
+                               "session": prog.session_id}
+        self._live_sessions.add(prog.session_id)
+        self._bump_session_seq_locked(prog.session_id)
+        return rid
+
+    def _shed_check_locked(self, prog: GenerationProgram,
+                           plan: List[Dict[str, Any]]) -> None:
+        """Admission control in KV BLOCKS (with PR 9's row estimate and
+        queue-length backstop retained): every program is priced at its
+        worst-case block footprint — suffix + token budget, plus its
+        prefix block when the prefix is not already cached — against
+        the ledger's free blocks (cold refcount-0 prefixes count as
+        reclaimable). The budget is a hard bound: HBM does not
+        oversubscribe, it OOMs — so exceeding it sheds typed with a
+        Retry-After computed from the block-free-rate EMA instead of
+        letting the grid fall over. A prefix-HIT program costs only its
+        suffix, which is what lets N same-prefix programs through a
+        budget a row-accounted scheduler would have shed them under."""
         eng = self.engine
         waiting = int(eng.queued)
+        n_new = len(plan)
         max_delay = env_float("KT_MAX_QUEUE_DELAY_S")
         hard_cap = self._max_waiting and (
             waiting + n_new > self._max_waiting)
+        # PR 9's row-free estimate — still the binding constraint when
+        # rows, not HBM, are scarce (short contexts, deep queue)
         est_delay = 0.0
         if eng.free_rows < n_new:
             est_delay = (waiting + n_new) * max(0.01, self._ema_row_s)
-        if hard_cap or est_delay > max_delay:
+        # KV-block pricing
+        need = 0
+        new_pfx: Dict[str, int] = {}
+        for item in plan:
+            need += self._kv.row_cost(min(
+                len(item["suffix"]) + prog.max_new_tokens,
+                self._row_cap_tokens))
+            if item["prefix"] and item["entry"] is None:
+                new_pfx[item["key"]] = kvpool.blocks_for(
+                    len(item["prefix"]), self._kv.block_tokens)
+        need += sum(new_pfx.values())
+        short = 0
+        if self._kv.ledger.budget:
+            if need > self._kv.ledger.budget:
+                # structural: the program can NEVER fit — reject
+                # non-retryable instead of a Retry-After loop
+                raise ValueError(
+                    f"program needs {need} KV blocks — more than the "
+                    f"whole {self._kv.ledger.budget}-block budget "
+                    f"(KT_KV_HBM_BUDGET); shrink the prompt/token "
+                    f"budget or raise the budget")
+            # refcount-0 prefixes count as reclaimable — EXCEPT the ones
+            # this very program is about to decode under (evicting a
+            # plan's own hit to admit its row would turn the hit into a
+            # dangling prefix_id)
+            hit_pids = {item["entry"].pid for item in plan
+                        if item["entry"] is not None}
+            cold = sum(e.blocks
+                       for e in self._kv.prefixes._entries.values()
+                       if e.refs == 0 and e.pid not in hit_pids)
+            short = max(0, need - (self._kv.free_blocks + cold))
+        if hard_cap or est_delay > max_delay or short:
+            ema = self._ema_block_s if short else self._ema_row_s
             retry_after = retry_after_estimate(
-                waiting + n_new, 1, self._ema_row_s, cap_s=max_delay)
+                max(short, waiting + n_new), 1, ema, cap_s=max_delay)
             _record_engine("shed")
             tracing.record_span(
                 "server.shed", 0.0,
                 attrs={"transport": "engine", "queue_depth": waiting,
+                       "kv_blocks_short": short,
                        "retry_after_s": retry_after})
+            if short:
+                raise ServerOverloaded(
+                    f"KV budget exhausted: program needs {need} blocks, "
+                    f"{short} short of the {self._kv.ledger.budget}-block "
+                    f"HBM budget (KT_KV_HBM_BUDGET)",
+                    retry_after=retry_after)
             raise ServerOverloaded(
                 f"engine queue {waiting} deep, no row expected free "
                 f"within {max_delay}s (est. {est_delay:.2f}s)",
@@ -409,7 +1015,7 @@ class DecodeEngine:
                         # ktlint: disable=KT004 -- device already faulted; the stream was failed above
                         except Exception:  # noqa: BLE001
                             pass
-                        self._forget_locked(rid)
+                        self._release_locked(rid)
 
     def _tick_locked(self) -> None:
         eng = self.engine
@@ -417,14 +1023,37 @@ class DecodeEngine:
         # ---- deadline eviction (row-granular) ------------------------
         for rid, dl in list(self._deadlines.items()):
             if now > dl:
+                session = (self._rid_meta.get(rid) or {}).get("session")
+                state = None
+                if session is not None and hasattr(eng, "export_row"):
+                    # a deadlined SESSION row parks instead of burning:
+                    # export now (cheap device→host slice), offload in
+                    # the background — the loop must not block on store
+                    # I/O — and the stream still fails typed so the
+                    # client knows the budget passed; a resume with the
+                    # same session_id picks up where the deadline hit
+                    try:
+                        state = eng.export_row(
+                            rid, block_tokens=self._kv.block_tokens)
+                    except (KeyError, ValueError):
+                        state = None
                 eng.evict(rid)
                 sink = self._sinks.get(rid)
-                self._forget_locked(rid)
+                self._release_locked(rid)
                 _record_engine("evict")
+                if state is not None:
+                    self._offload_async(session, state)
                 if sink is not None:
+                    # "parking", not "parked": the offload runs in the
+                    # background off the driver tick — an IMMEDIATE
+                    # resume may race it and fall back to a re-prefill
+                    # (the explicit park() path is the durable one)
                     sink.put((rid, DeadlineExceeded(
                         f"generation {rid} passed its deadline "
-                        f"mid-stream", deadline=dl)))
+                        f"mid-stream"
+                        + (f" (session {session} parking in background)"
+                           if state is not None else ""),
+                        deadline=dl)))
         # ---- per-row admission into the live batch -------------------
         t0 = time.perf_counter()
         admitted = eng.admit(self._admit_rows or None)
@@ -458,6 +1087,7 @@ class DecodeEngine:
                        "tokens": sum(len(t) for _, t, _ in events)})
         # ---- route frames + row-free accounting ----------------------
         freed = 0
+        blocks_freed = 0
         tnow = time.perf_counter()
         for rid, toks, done in events:
             self._tokens += len(toks)
@@ -472,12 +1102,31 @@ class DecodeEngine:
                 sink.put((rid, ([int(t) for t in toks], bool(done))))
             if done:
                 freed += 1
-                self._forget_locked(rid)
+                meta = self._rid_meta.get(rid) or {}
+                blocks_freed += meta.get("blocks", 0)
+                if (meta.get("session")
+                        and meta["session"] in self._parked_sessions):
+                    # the session ran to completion: its parked blob is
+                    # now STALE — drop it, or the next program with this
+                    # session_id would restore a finished row instead of
+                    # prefilling its new prompt. (Only sessions that
+                    # actually parked/restored pay the store round-trips
+                    # — most sessions never have a blob.)
+                    self._parked_sessions.pop(meta["session"], None)
+                    self._drop_session_async(meta["session"])
+                self._release_locked(rid)
         if freed:
             t_free = time.time()
             if self._last_free_t is not None:
                 gap = max(1e-4, (t_free - self._last_free_t) / freed)
                 self._ema_row_s = 0.8 * self._ema_row_s + 0.2 * gap
+                if blocks_freed:
+                    # the block-admission clock: seconds per KV block
+                    # returned to the ledger
+                    bgap = max(1e-5, (t_free - self._last_free_t)
+                               / blocks_freed)
+                    self._ema_block_s = (0.8 * self._ema_block_s
+                                         + 0.2 * bgap)
             self._last_free_t = t_free
         if not eng.pending:
             # going idle: the NEXT free event's gap would include the
@@ -487,12 +1136,94 @@ class DecodeEngine:
             self._last_free_t = None
         self._publish_gauges()
 
+    def _offload_async(self, session_id: str,
+                       state: Dict[str, Any]) -> None:
+        """Background session offload (deadline parks): the driver tick
+        must not block on store I/O. One short-lived thread per park —
+        deadline parks are rare by construction. Guarded by the session
+        sequence: if a NEWER program claims the session while the
+        publish is in flight, the stale blob is refused (or dropped
+        right after landing) instead of shadowing the new generation."""
+        quantized = bool(getattr(self.engine, "kv_quantized", False))
+        seq0 = self._session_seq.get(session_id, 0)
+
+        def _superseded() -> bool:
+            # ABSENT is not superseded: the bounded seq dict may have
+            # LRU-evicted an idle session's entry while this offload was
+            # in flight — refusing then would silently lose the ONLY
+            # copy of the state (the row is already evicted). A genuine
+            # supersession re-inserts the key with a newer value.
+            with self._wake:
+                return self._session_seq.get(session_id, seq0) != seq0
+
+        def _push():
+            try:
+                # _offload_lock: this check+publish(+drop) must not
+                # interleave with an explicit park()'s — a stale
+                # background publish landing OVER a newer durable park
+                # (then dropping it) would break the parked sentinel's
+                # promise
+                with self._offload_lock:
+                    if _superseded():
+                        # a resubmit claimed the session while we
+                        # queued: refuse to publish state it has moved
+                        # past. Observable: a span, not a silent return.
+                        tracing.record_span(
+                            "kv.park_superseded", 0.0,
+                            attrs={"session": session_id})
+                        return
+                    kvpool.offload_session(session_id, state, quantized)
+                    if _superseded():
+                        # a newer program claimed the session WHILE we
+                        # published — and may already have completed,
+                        # so its completion-drop cannot have seen our
+                        # blob. The claim means the client moved past
+                        # the parked state (it restored nothing — the
+                        # blob wasn't there yet): drop it rather than
+                        # let it shadow the session's next program.
+                        kvpool.drop_session(session_id)
+                        tracing.record_span(
+                            "kv.park_superseded", 0.0,
+                            attrs={"session": session_id,
+                                   "at": "landed"})
+                        return
+                    with self._wake:  # counters share the scheduler lock
+                        self._parks += 1
+                        self._note_parked_locked(session_id)
+            # ktlint: disable=KT004 -- counted; a failed park only costs
+            # the session its resume (the client re-prefills)
+            except Exception:  # noqa: BLE001
+                _record_engine("tick_error")
+
+        threading.Thread(
+            target=contextvars.copy_context().run, args=(_push,),
+            name="kt-kv-offload", daemon=True).start()
+
+    def _drop_session_async(self, session_id: str) -> None:
+        """Invalidate a completed session's parked blob (store I/O off
+        the driver tick; best-effort — a failed delete only means one
+        stale restore, which the single-flight check keeps coherent)."""
+
+        def _drop():
+            try:
+                kvpool.drop_session(session_id)
+            # ktlint: disable=KT004 -- best-effort invalidation
+            except Exception:  # noqa: BLE001
+                pass
+
+        threading.Thread(
+            target=contextvars.copy_context().run, args=(_drop,),
+            name="kt-kv-drop", daemon=True).start()
+
     def _publish_gauges(self) -> None:
         eng = self.engine
         _record_engine("queue_depth", float(eng.queued))
         _record_engine("active_rows", float(eng.active_rows))
         _record_engine("free_rows", float(eng.free_rows))
         _record_engine("prefilling_rows", float(eng.prefilling_rows))
+        _record_engine("kv_blocks_used", float(self._kv.used_blocks))
+        if self._kv.ledger.budget:
+            _record_engine("kv_blocks_free", float(self._kv.free_blocks))
 
 
 class SimRollingEngine:
@@ -507,10 +1238,14 @@ class SimRollingEngine:
     from the real thing.
     """
 
+    kv_quantized = False
+
     def __init__(self, max_slots: int = 8, steps_per_call: int = 8,
                  prefill_chunk: Optional[int] = None,
-                 step_s: float = 0.0, prefill_s: Optional[float] = None):
+                 step_s: float = 0.0, prefill_s: Optional[float] = None,
+                 max_len: int = 2048):
         self.max_slots = max_slots
+        self.max_len = max_len
         self.steps_per_call = steps_per_call
         self.prefill_chunk = prefill_chunk
         self.step_s = step_s
@@ -520,23 +1255,63 @@ class SimRollingEngine:
         self._prefilling: Dict[int, dict] = {}  # rid -> request
         self._free = list(range(max_slots))
         self._next_rid = 0
+        # mirrors RollingGenerator's prefix surface: pid -> tokens;
+        # emission stays a pure function of (prefix + suffix, index) so
+        # shared-prefix streams are byte-assertable too
+        self._prefixes: Dict[int, dict] = {}
+        self._next_prefix_id = 0
+        # prompt tokens run through a "prefill" (suffix only for
+        # prefixed submits; a registered prefix counts once)
+        self.prefill_tokens = 0
 
     # -------------------------------------------------------- interface
     @staticmethod
     def expected_tokens(prompt: List[int], n: int) -> List[int]:
         """Ground truth for byte-identity assertions: the exact token
-        stream a request with this prompt emits."""
+        stream a request with this prompt emits (``prompt`` includes any
+        shared prefix — prefixed submits emit as if the full
+        prefix+suffix prompt had been submitted plain)."""
         seed = ",".join(str(int(t)) for t in prompt)
         return [int.from_bytes(
             hashlib.sha256(f"{seed}:{i}".encode()).digest()[:4],
             "little") % 32000 for i in range(n)]
 
-    def submit(self, prompt, max_new_tokens: int = 128, **_ignored) -> int:
+    def register_prefix(self, tokens, adapter_id: int = -1) -> int:
+        pid = self._next_prefix_id
+        self._next_prefix_id += 1
+        self._prefixes[pid] = {"tokens": [int(t) for t in tokens],
+                               "adapter_id": int(adapter_id)}
+        self.prefill_tokens += len(tokens)
+        return pid
+
+    def drop_prefix(self, prefix_id: int) -> bool:
+        return self._prefixes.pop(prefix_id, None) is not None
+
+    def prefix_len(self, prefix_id: int) -> int:
+        return len(self._prefixes[prefix_id]["tokens"])
+
+    def submit(self, prompt, max_new_tokens: int = 128,
+               prefix_id: Optional[int] = None, adapter_id: int = -1,
+               **_ignored) -> int:
+        head: List[int] = []
+        if prefix_id is not None:
+            if prefix_id not in self._prefixes:
+                raise KeyError(f"unknown prefix_id {prefix_id}")
+            entry = self._prefixes[prefix_id]
+            if entry["adapter_id"] != int(adapter_id):
+                raise ValueError(
+                    f"prefix {prefix_id} was registered with adapter "
+                    f"{entry['adapter_id']}; submit passed {adapter_id}")
+            if not prompt:
+                raise ValueError("prefixed submit needs >= 1 suffix token")
+            head = entry["tokens"]
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append({"rid": rid, "prompt": [int(t) for t in prompt],
+        self._queue.append({"rid": rid,
+                            "prompt": head + [int(t) for t in prompt],
                             "n": int(max_new_tokens), "emitted": 0,
-                            "consumed": 0, "slot": None})
+                            "consumed": 0, "head": len(head),
+                            "suffix": len(prompt), "slot": None})
         return rid
 
     def admit(self, max_rows: Optional[int] = None) -> int:
@@ -546,8 +1321,13 @@ class SimRollingEngine:
             req = self._queue.pop(0)
             req["slot"] = self._free.pop(0)
             admitted += 1
+            self.prefill_tokens += req.get("suffix", len(req["prompt"]))
+            # a prefixed row's head is already "computed" — only the
+            # suffix consumes prefill chunks
+            req["consumed"] = req.get("head", 0)
             if (self.prefill_chunk is not None
-                    and len(req["prompt"]) > self.prefill_chunk):
+                    and len(req["prompt"]) - req["consumed"]
+                    > self.prefill_chunk):
                 self._prefilling[req["rid"]] = req
             else:
                 req["consumed"] = len(req["prompt"])
@@ -591,6 +1371,46 @@ class SimRollingEngine:
         self.admit()
         self.prefill_step()
         return self.decode_step()
+
+    def export_row(self, rid: int, block_tokens: int = 16) -> dict:
+        """Host-only twin of ``RollingGenerator.export_row``: the same
+        tree shape (per-block ``kv`` leaves + the ``scalars`` header
+        ``[ctx, emitted, max_new]``), with KV block content a pure
+        function of (prompt, block index) — byte-STABLE across re-parks,
+        so the delta-manifest skip path is exercised for real."""
+        import numpy as np
+
+        req = self._rows.get(rid)
+        if req is None:
+            raise KeyError(f"rid {rid} is not decode-active")
+        bt = max(1, int(block_tokens))
+        ctx = len(req["prompt"]) + req["emitted"]
+        nblocks = kvpool.padded_blocks(ctx, bt, self.max_len)
+        seed = ",".join(str(t) for t in req["prompt"])
+        kv = {f"{b:05d}": np.frombuffer(
+            hashlib.sha256(f"kv:{seed}:{b}".encode()).digest(),
+            np.uint8).reshape(4, 8).copy() for b in range(nblocks)}
+        return {
+            "kv": {"k": kv},
+            "prompt": np.asarray(req["prompt"], np.int64),
+            "scalars": np.asarray(
+                [ctx, req["emitted"], req["n"]], np.int64),
+        }
+
+    def import_row(self, state: dict) -> int:
+        import numpy as np
+
+        if not self._free:
+            raise RuntimeError("no free row to import into")
+        scalars = [int(x) for x in np.asarray(state["scalars"])]
+        prompt = [int(t) for t in np.asarray(state["prompt"])]
+        rid = self._next_rid
+        self._next_rid += 1
+        self._rows[rid] = {"rid": rid, "prompt": prompt,
+                           "n": scalars[2], "emitted": scalars[1],
+                           "consumed": len(prompt), "head": 0,
+                           "suffix": 0, "slot": self._free.pop(0)}
+        return rid
 
     def evict(self, rid: int) -> bool:
         for i, req in enumerate(self._queue):

@@ -1258,7 +1258,7 @@ class PodServer:
             **session.describe(),
         }
         engine = {k: v for k, v in self.metrics.items()
-                  if k.startswith("engine_")}
+                  if k.startswith(("engine_", "kv_", "prefix_"))}
         if engine:
             info["engine"] = engine
         async with session.send_lock:

@@ -176,7 +176,8 @@ class EngineHost:
     the observability hooks the tests assert against."""
 
     def __init__(self, max_slots=4, steps_per_call=8, step_ms=2.0,
-                 prefill_chunk=16, max_waiting=64):
+                 prefill_chunk=16, max_waiting=64, prefix_split=None,
+                 kv_block_tokens=None, kv_budget_blocks=None):
         from kubetorch_tpu.serving.engine import (
             DecodeEngine,
             SimRollingEngine,
@@ -187,7 +188,11 @@ class EngineHost:
                              steps_per_call=int(steps_per_call),
                              prefill_chunk=int(prefill_chunk),
                              step_s=float(step_ms) / 1e3),
-            max_waiting=int(max_waiting))
+            max_waiting=int(max_waiting), prefix_split=prefix_split,
+            kv_block_tokens=(int(kv_block_tokens)
+                             if kv_block_tokens is not None else None),
+            kv_budget_blocks=(int(kv_budget_blocks)
+                              if kv_budget_blocks is not None else None))
 
     def generate(self, program, delay_ms=0.0):
         for frame in self._engine.generate(program):
@@ -205,6 +210,15 @@ class EngineHost:
 
     def exec_count(self, tag):
         return self._engine.exec_count(tag)
+
+    def register_prefix(self, tokens, adapter_id=-1):
+        """Client surface for explicit prefix ids over the wire —
+        through the DecodeEngine so the KV ledger accounts the block."""
+        return int(self._engine.register_prefix(
+            [int(t) for t in tokens], adapter_id=int(adapter_id)))
+
+    def park(self, session_id):
+        return self._engine.park(session_id)
 
 
 class ChunkEngine:

@@ -216,7 +216,9 @@ def test_overload_sheds_typed_with_retry_after():
         def run(k):
             try:
                 list(eng.generate({"prompt": [k], "max_new_tokens": 400}))
-            except ServerOverloaded:
+            # the teardown close() fails still-queued streams typed;
+            # either way the thread must exit quietly
+            except Exception:  # noqa: BLE001
                 pass
 
         threads = [threading.Thread(target=run, args=(k,), daemon=True)
@@ -306,6 +308,85 @@ def test_control_frame_answers_out_of_band(enginehost):
         assert info2["pod_queue_depth"] >= 1
         assert ctl_s < 5.0
         assert list(slow.result(timeout=120))[-1]["done"]
+
+
+@pytest.mark.level("minimal")
+def test_prefix_id_round_trips_over_wire(enginehost):
+    """Satellite (ISSUE 11): the client can REGISTER a prefix and
+    submit against it — ``register_prefix`` over the channel returns
+    the id, ``program(prefix_id=...)`` carries it, and the stream
+    equals the full-prompt ground truth."""
+    from kubetorch_tpu.serving.engine import program
+
+    with enginehost.channel(depth=2) as chan:
+        prefix = list(range(40, 56))
+        pid = chan.call(prefix, method="register_prefix")
+        assert isinstance(pid, int)
+        frames = list(chan.submit(
+            program([7, 8], prefix_id=pid, max_new_tokens=16),
+            method="generate", stream=True, concurrent=True,
+        ).result(timeout=60))
+        toks = [t for f in frames for t in f["tokens"]]
+        assert toks == SimRollingEngine.expected_tokens(prefix + [7, 8], 16)
+
+
+@pytest.mark.level("minimal")
+def test_session_park_resume_over_wire(enginehost):
+    """ISSUE 11 acceptance at the wire level: a session program parks
+    mid-stream (explicit ``park`` call — answered while the stream is
+    live, ``concurrent=True``), its stream ends with a ``parked``
+    frame, and a resubmit with the same ``session_id`` continues the
+    token stream exactly where it stopped — no re-prefill."""
+    import uuid
+
+    from kubetorch_tpu.serving.engine import program
+
+    sid = f"wire-{uuid.uuid4().hex[:8]}"
+    prompt = [5, 6]
+    n = 400
+    with enginehost.channel(depth=2) as chan:
+        stream = chan.submit(
+            program(prompt, session_id=sid, max_new_tokens=n),
+            kwargs={"delay_ms": 5.0}, method="generate", stream=True,
+            concurrent=True, timeout=60.0)
+        got, saw_parked = [], False
+        parked_rows = None
+        for frame in stream:
+            if frame.get("parked"):
+                saw_parked = True
+                assert frame["session_id"] == sid
+                break
+            got.extend(frame["tokens"])
+            if parked_rows is None and len(got) >= 8:
+                parked_rows = chan.call(sid, method="park")
+        assert parked_rows == 1
+        assert saw_parked and 0 < len(got) < n
+        st_before = chan.call(method="stats")
+        frames = list(chan.submit(
+            program(prompt, session_id=sid, max_new_tokens=n),
+            method="generate", stream=True, concurrent=True,
+        ).result(timeout=120))
+        rest = [t for f in frames for t in f["tokens"]]
+        assert frames[-1]["done"]
+        assert got + rest == SimRollingEngine.expected_tokens(prompt, n)
+        st = chan.call(method="stats")
+        assert st["restores"] == st_before["restores"] + 1
+        # resume never re-ran the prompt prefill
+        assert st["prefill_tokens_executed"] == \
+            st_before["prefill_tokens_executed"]
+
+
+@pytest.mark.level("minimal")
+def test_control_stats_surface_kv_metrics(enginehost):
+    """Satellite observability: the kv_/prefix_ counters ride the
+    worker piggyback into the pod snapshot and come back on the
+    out-of-band control frame."""
+    with enginehost.channel(depth=2) as chan:
+        list(chan.submit({"prompt": [4, 2], "max_new_tokens": 16},
+                         method="generate", stream=True,
+                         concurrent=True).result(timeout=60))
+        info = chan.control("stats")
+        assert "kv_blocks_used" in info["engine"], sorted(info["engine"])
 
 
 @pytest.mark.level("minimal")

@@ -51,6 +51,18 @@ EXPECTED_KEYS = {
     "engine_poisson_goodput_ratio",
     "engine_prefill_interleave_ok",
     "engine_admit_to_first_token_chunks",
+    # paged KV + prefix cache (ISSUE 11): prefill tokens saved by
+    # automatic prefix sharing, and park→resume TTFT in decode chunks
+    "prefix_kv_programs",
+    "prefix_prefill_tokens_naive",
+    "prefix_prefill_tokens_executed",
+    "prefix_prefill_tokens_saved_ratio",
+    "prefix_kv_hits",
+    "prefix_kv_misses",
+    "kv_unparked_ttft_ms",
+    "kv_park_ms",
+    "kv_resume_ttft_ms",
+    "kv_resume_ttft_chunks",
 }
 
 
@@ -106,5 +118,19 @@ def test_serving_dryrun_metric_keys():
     assert out["engine_poisson_goodput_ratio"] > 0.4
     assert out["engine_ttft_ms_p50"] > 0
     assert out["engine_ttft_ms_p99"] >= out["engine_ttft_ms_p50"]
+    # paged KV + prefix cache: with an N-way shared prefix, prefill
+    # tokens executed grow O(suffix), not O(N·prompt) — the acceptance
+    # floor is half of perfect sharing's (N−1)/N
+    n = out["prefix_kv_programs"]
+    assert out["prefix_prefill_tokens_saved_ratio"] >= \
+        0.5 * (n - 1) / n, out["prefix_prefill_tokens_saved_ratio"]
+    assert out["prefix_kv_hits"] == n - 1
+    assert out["prefix_kv_misses"] == 1
+    assert out["prefix_prefill_tokens_executed"] < \
+        out["prefix_prefill_tokens_naive"]
+    # park → resume: the resumed session's first token costs ~one decode
+    # chunk (CI headroom: 4), not the prompt's full chunked prefill
+    assert out["kv_resume_ttft_chunks"] <= 4.0, out["kv_resume_ttft_chunks"]
+    assert out["kv_resume_ttft_ms"] < 0.5 * out["kv_unparked_ttft_ms"]
     # dryrun toy values must never be compared against prior rounds
     assert "rolling_tok_s_tunnel_wall" not in out
