@@ -12,9 +12,19 @@ system cannot see, distilled from this repo's actual bug history:
 - **KT005** writes to lock-guarded attributes outside ``with self._lock``
 - **KT006** JAX tracer hazards inside jitted functions
 
+and **ktsan** — the two-sided concurrency sanitizer (``san.py`` +
+``lockgraph.py``, run via ``ktpu san`` / ``KT_SAN=1`` / the tier-1 test
+``tests/test_san.py``):
+
+- **KT008** ``await``/blocking call while holding a sync lock
+- **KT009** double-acquire of a non-reentrant lock through a callee
+- **KT010** cycles in the global lock-acquisition-order graph
+  (static ``with`` nesting ∪ KT_SAN=1 runtime edges)
+
 Run it via ``ktpu lint`` or the tier-1 test ``tests/test_lint.py``.
 Suppress a finding inline with ``# ktlint: disable=KT00x -- reason`` or
-grandfather it in the checked-in baseline (``.ktlint-baseline.json``).
+grandfather it in the checked-in baseline (``.ktlint-baseline.json``;
+ktsan findings baseline into ``.ktsan-baseline.json``).
 Configuration lives in ``[tool.ktlint]`` in ``pyproject.toml``.
 """
 
@@ -26,3 +36,8 @@ from kubetorch_tpu.analysis.engine import (  # noqa: F401
     run_lint,
 )
 from kubetorch_tpu.analysis.rules import ALL_RULES, RULE_DOCS  # noqa: F401
+from kubetorch_tpu.analysis.san import (  # noqa: F401
+    SAN_RULE_DOCS,
+    SanResult,
+    run_san,
+)

@@ -1,0 +1,299 @@
+"""Lock-acquisition-order graph: the data model ktsan's two sides share.
+
+The sanitizer (``kubetorch_tpu/analysis/san.py``) reasons about
+*lock classes*, not lock instances — the lockdep idea: every
+``threading.Lock``/``RLock``/``Condition``/``asyncio.Lock`` attribute is
+resolved to a stable identity (``<relpath>::<Class>.<attr>`` for
+instance/class attributes, ``<relpath>::<name>`` for module-level
+locks), and an edge ``A -> B`` means "B was acquired while A was held"
+— observed either statically (a ``with self._b:`` nested under
+``with self._a:``, following direct ``self._method()`` calls one level
+deep) or dynamically (the ``KT_SAN=1`` instrumentation recorded a real
+thread doing it). A cycle in the union graph is a potential deadlock:
+two threads walking the cycle from different entry points can each hold
+the lock the other needs.
+
+Identities are *class-granular* on purpose: two instances of the same
+class share one node, exactly like kernel lockdep's lock classes. The
+known blind spot (also lockdep's): an edge between two instances of the
+SAME class is not recorded — ordering within a class needs an
+instance-level discipline (e.g. ordering by id) no static identity can
+check.
+
+Everything here is deterministic: nodes, edges, witnesses, and cycles
+are sorted, and cycle paths are rotated to start at the smallest
+identity, so two runs over the same inputs serialize byte-identically
+(``tests/test_san.py`` pins this).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# Witness kinds — where an edge (or lock) was observed.
+STATIC = "static"
+DYNAMIC = "dynamic"
+
+# How many distinct witnesses an edge retains (the first ones win; one
+# witness proves the edge, a handful shows the breadth).
+MAX_WITNESSES = 4
+
+
+@dataclass(frozen=True)
+class Witness:
+    """One observation of an edge: the acquisition site of the *target*
+    lock while the source was held."""
+
+    path: str              # repo-relative posix path of the acquire site
+    line: int
+    func: str              # enclosing function (static) / thread (dynamic)
+    kind: str = STATIC     # STATIC | DYNAMIC
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "func": self.func,
+                "kind": self.kind}
+
+    @staticmethod
+    def from_dict(d: dict) -> "Witness":
+        return Witness(path=d["path"], line=int(d["line"]),
+                       func=d.get("func", ""), kind=d.get("kind", STATIC))
+
+    def sort_key(self):
+        return (self.kind, self.path, self.line, self.func)
+
+
+@dataclass
+class LockInfo:
+    """A lock class: where it is created and what it is."""
+
+    ident: str             # "<relpath>::<Class>.<attr>" / "<relpath>::<name>"
+    kind: str              # "Lock" | "RLock" | "Condition" | "AsyncLock"
+    path: str              # relpath of the creation/assignment site
+    line: int
+    alias_of: Optional[str] = None   # Condition(self._lock) shares the lock
+
+    def to_dict(self) -> dict:
+        d = {"kind": self.kind, "path": self.path, "line": self.line}
+        if self.alias_of:
+            d["alias_of"] = self.alias_of
+        return d
+
+
+class LockGraph:
+    """Directed lock-order graph with witness-carrying edges."""
+
+    def __init__(self) -> None:
+        self.locks: Dict[str, LockInfo] = {}
+        self.edges: Dict[Tuple[str, str], List[Witness]] = {}
+
+    # ---------------------------------------------------------- building
+    def add_lock(self, info: LockInfo) -> None:
+        # first definition wins (re-registration from a merged report
+        # must not clobber the richer static record)
+        self.locks.setdefault(info.ident, info)
+
+    def add_edge(self, src: str, dst: str, witness: Witness) -> None:
+        if src == dst:
+            # same lock class: double-acquire is KT009's (static) and the
+            # reentrancy check's (dynamic) job, not the order graph's
+            return
+        wits = self.edges.setdefault((src, dst), [])
+        if len(wits) < MAX_WITNESSES and witness not in wits:
+            wits.append(witness)
+
+    def merge(self, other: "LockGraph") -> None:
+        for info in other.locks.values():
+            self.add_lock(info)
+        for (src, dst), wits in other.edges.items():
+            for w in wits:
+                self.add_edge(src, dst, w)
+
+    # ------------------------------------------------------------- serde
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "locks": {ident: info.to_dict()
+                      for ident, info in sorted(self.locks.items())},
+            "edges": [
+                {"src": src, "dst": dst,
+                 "witnesses": [w.to_dict() for w in
+                               sorted(wits, key=Witness.sort_key)]}
+                for (src, dst), wits in sorted(self.edges.items())
+            ],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @staticmethod
+    def from_dict(data: dict) -> "LockGraph":
+        g = LockGraph()
+        for ident, d in (data.get("locks") or {}).items():
+            g.add_lock(LockInfo(ident=ident, kind=d.get("kind", "Lock"),
+                                path=d.get("path", ""),
+                                line=int(d.get("line", 0)),
+                                alias_of=d.get("alias_of")))
+        for e in data.get("edges") or []:
+            for w in e.get("witnesses") or []:
+                g.add_edge(e["src"], e["dst"], Witness.from_dict(w))
+        return g
+
+    @staticmethod
+    def load(path: Path) -> "LockGraph":
+        return LockGraph.from_dict(json.loads(Path(path).read_text()))
+
+    def dump(self, path: Path) -> None:
+        Path(path).write_text(self.to_json() + "\n")
+
+    # ------------------------------------------------------------ cycles
+    def cycles(self) -> List[List[str]]:
+        """All simple cycles' canonical node sequences, one per strongly
+        connected component: for each SCC with a cycle, the
+        lexicographically-smallest simple cycle through its smallest
+        node. Returned sorted, each path rotated so the smallest
+        identity leads (``[A, B]`` means A -> B -> A)."""
+        adj: Dict[str, List[str]] = {}
+        for (src, dst) in self.edges:
+            adj.setdefault(src, []).append(dst)
+        for dsts in adj.values():
+            dsts.sort()
+        sccs = _tarjan_sccs(adj)
+        out: List[List[str]] = []
+        for scc in sccs:
+            scc_set = set(scc)
+            if len(scc) == 1 and scc[0] not in (adj.get(scc[0]) or []):
+                continue  # trivial SCC, no self-loop (self-loops dropped)
+            cyc = _smallest_cycle(sorted(scc)[0], adj, scc_set)
+            if cyc:
+                out.append(_canonical(cyc))
+        out.sort()
+        return out
+
+    def cycle_edges(self, cycle: List[str]) -> List[Tuple[str, str,
+                                                          List[Witness]]]:
+        """The edge list (with witnesses) realizing a cycle path."""
+        out = []
+        for i, src in enumerate(cycle):
+            dst = cycle[(i + 1) % len(cycle)]
+            out.append((src, dst, sorted(self.edges.get((src, dst), []),
+                                         key=Witness.sort_key)))
+        return out
+
+    def render_cycle(self, cycle: List[str]) -> str:
+        """Human-readable deadlock report naming files/lines:
+
+            lock-order cycle: A -> B -> A
+              A -> B at serving/engine.py:703 in DecodeEngine.park [static]
+              B -> A at ... [dynamic thread=kt-kv-offload]
+        """
+        header = "lock-order cycle: " + " -> ".join(
+            [*cycle, cycle[0]])
+        lines = [header]
+        for src, dst, wits in self.cycle_edges(cycle):
+            w = wits[0] if wits else None
+            if w is None:
+                lines.append(f"  {src} -> {dst} (witness lost in merge)")
+                continue
+            where = (f"at {w.path}:{w.line} in {w.func}" if w.func
+                     else f"at {w.path}:{w.line}")
+            tag = (f"[dynamic thread={w.func}]" if w.kind == DYNAMIC
+                   else f"[{w.kind}]")
+            lines.append(f"  {src} -> {dst} {where} {tag}")
+            for extra in wits[1:]:
+                lines.append(
+                    f"      also at {extra.path}:{extra.line} "
+                    f"in {extra.func} [{extra.kind}]")
+        return "\n".join(lines)
+
+    def cycle_signature(self, cycle: List[str]) -> str:
+        """Stable content key for baselining a cycle (no line numbers —
+        survives shifts the way ktlint baseline snippets do)."""
+        return " -> ".join([*cycle, cycle[0]])
+
+
+def _canonical(cycle: List[str]) -> List[str]:
+    """Rotate a cycle path so the smallest identity leads."""
+    i = cycle.index(min(cycle))
+    return cycle[i:] + cycle[:i]
+
+
+def _tarjan_sccs(adj: Dict[str, List[str]]) -> List[List[str]]:
+    """Iterative Tarjan over the adjacency map (nodes = keys U targets)."""
+    nodes: List[str] = sorted(
+        set(adj) | {d for dsts in adj.values() for d in dsts})
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in nodes:
+        if root in index:
+            continue
+        work: List[Tuple[str, int]] = [(root, 0)]
+        while work:
+            node, ei = work[-1]
+            if ei == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            neighbors = adj.get(node, [])
+            advanced = False
+            while ei < len(neighbors):
+                nxt = neighbors[ei]
+                ei += 1
+                if nxt not in index:
+                    work[-1] = (node, ei)
+                    work.append((nxt, 0))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    top = stack.pop()
+                    on_stack.discard(top)
+                    scc.append(top)
+                    if top == node:
+                        break
+                sccs.append(sorted(scc))
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+    return sccs
+
+
+def _smallest_cycle(start: str, adj: Dict[str, List[str]],
+                    scc: Set[str]) -> Optional[List[str]]:
+    """Lexicographically-first simple cycle from ``start`` back to
+    ``start`` staying inside one SCC (DFS over sorted neighbors)."""
+    path: List[str] = [start]
+    seen: Set[str] = {start}
+
+    def dfs(node: str) -> Optional[List[str]]:
+        for nxt in adj.get(node, []):
+            if nxt not in scc:
+                continue
+            if nxt == start and len(path) > 1:
+                return list(path)
+            if nxt in seen:
+                continue
+            seen.add(nxt)
+            path.append(nxt)
+            found = dfs(nxt)
+            if found is not None:
+                return found
+            path.pop()
+            seen.discard(nxt)
+        return None
+
+    return dfs(start)

@@ -373,9 +373,15 @@ class KT004SilentExcept(Rule):
 
     @staticmethod
     def _is_trivial(handler: ast.ExceptHandler) -> bool:
-        """pass / continue / break / `...` / constant return only —
-        a handler that assigns a fallback or calls anything is doing
-        real work, not swallowing."""
+        """pass / continue / break / `...` / constant-ish return only —
+        a handler that assigns a fallback or calls real code is doing
+        work, not swallowing. Constant-ish returns include `return
+        None`/`return 0`/`return -1` AND empty-container fallbacks
+        (`return []`/`{}`/`()`/`set()`/`list()`/`dict()`): on a
+        control-plane path "give the caller an empty answer" hides the
+        failure exactly like `return None` does (the shape the original
+        heuristic missed). Non-empty literals and computed fallbacks
+        stay exempt — they are a decision, not a swallow."""
         for stmt in handler.body:
             if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
                 continue
@@ -384,10 +390,27 @@ class KT004SilentExcept(Rule):
                 continue
             if isinstance(stmt, ast.Return) and (
                     stmt.value is None
-                    or isinstance(stmt.value, ast.Constant)):
+                    or KT004SilentExcept._is_constant_ish(stmt.value)):
                 continue
             return False
         return True
+
+    @staticmethod
+    def _is_constant_ish(expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Constant):
+            return True
+        if isinstance(expr, ast.UnaryOp) and isinstance(expr.operand,
+                                                        ast.Constant):
+            return True                          # return -1
+        if isinstance(expr, (ast.List, ast.Tuple, ast.Set)):
+            return not expr.elts                 # return [] / () / set-lit
+        if isinstance(expr, ast.Dict):
+            return not expr.keys                 # return {}
+        if isinstance(expr, ast.Call) and not expr.args \
+                and not expr.keywords and isinstance(expr.func, ast.Name):
+            # return list() / dict() / set() / tuple()
+            return expr.func.id in ("list", "dict", "set", "tuple")
+        return False
 
 
 # --------------------------------------------------------------------------

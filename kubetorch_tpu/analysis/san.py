@@ -1,0 +1,1207 @@
+"""ktsan — a two-sided concurrency sanitizer (lockdep/TSan at the Python
+layer).
+
+**Static side** (``run_san`` / ``ktpu san --static-only``): an
+interprocedural pass over the same per-file AST contexts ktlint uses.
+It resolves every ``threading.Lock/RLock/Condition`` and ``asyncio.Lock``
+attribute to a stable *lock class* identity, walks ``with``/``async
+with`` nesting — following direct ``self._method()`` and same-file
+function calls one level deep — into a global lock-acquisition-order
+graph (:mod:`kubetorch_tpu.analysis.lockgraph`), and reports:
+
+- **KT010** — a cycle in the lock-order graph (potential deadlock),
+- **KT008** — ``await`` or a known-blocking call while holding a *sync*
+  (threading) lock: every other thread contending that lock stalls for
+  the full await/IO, and on the event loop it stalls every task,
+- **KT009** — a lock acquired both by a method and by a callee it
+  invokes while already holding it (double-acquire; instant deadlock on
+  non-reentrant ``Lock``/``Condition``). The ``*_locked`` suffix
+  convention means "caller holds the lock" — a ``*_locked`` callee that
+  re-acquires is exactly this bug.
+
+**Dynamic side** (``KT_SAN=1``): :func:`install` wraps the lock
+factories so every lock *created in this repo's code* records per-thread
+(and per-asyncio-task) held-sets; each acquisition while other locks are
+held adds a dynamic edge with the real acquire site and thread name.
+A process dumps its graph as JSON into ``KT_SAN_DIR`` at exit (pod
+subprocesses inherit the env, so a whole local-backend test session
+lands in one directory); the tests' session plugin merges every report,
+unions the dynamic edges with the static graph, and fails the run on
+any cycle with a rendered path naming files/lines. The runtime also
+carries an event-loop stall detector (any loop callback longer than
+``KT_SAN_STALL_MS``) and a thread tracker (non-daemon threads alive at
+dump time).
+
+Suppression and baselining reuse ktlint's machinery verbatim:
+``# ktlint: disable=KT008 -- reason`` inline, and
+``.ktsan-baseline.json`` (content-keyed, line-shift-proof) for
+grandfathered findings — kept EMPTY unless individually justified.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from kubetorch_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    LintConfig,
+    iter_py_files,
+    load_lint_config,
+    _relpath,
+)
+from kubetorch_tpu.analysis.lockgraph import (
+    DYNAMIC,
+    STATIC,
+    LockGraph,
+    LockInfo,
+    Witness,
+)
+from kubetorch_tpu.analysis.rules import (
+    dotted_name,
+    resolve_qualname,
+)
+
+SAN_BASELINE = ".ktsan-baseline.json"
+
+SAN_RULE_DOCS: Dict[str, Tuple[str, str]] = {
+    "KT008": (
+        "blocking-under-sync-lock",
+        "An `await` (or a known-blocking call: time.sleep, sync "
+        "httpx/requests, subprocess, urlopen, socket dial) while holding "
+        "a `threading.Lock/RLock/Condition` serializes every contending "
+        "thread behind the IO — and on the event loop it stalls every "
+        "task on the pod. Move the blocking work outside the `with`, or "
+        "snapshot under the lock and act after releasing it."),
+    "KT009": (
+        "double-acquire",
+        "A method holding a non-reentrant lock calls a function that "
+        "acquires the same lock — instant self-deadlock on "
+        "`threading.Lock`/`Condition`. The `*_locked` suffix convention "
+        "means the CALLER holds the lock; a `*_locked` callee (or any "
+        "callee reached with the lock held) must not re-acquire it."),
+    "KT010": (
+        "lock-order-cycle",
+        "The global lock-acquisition-order graph (static `with` nesting "
+        "plus one-level call follow, unioned with KT_SAN=1 runtime "
+        "edges) contains a cycle: two threads entering it from "
+        "different points can each hold a lock the other needs. Fix by "
+        "making every path acquire the cycle's locks in one documented "
+        "order."),
+}
+
+# threading factories whose products are SYNC locks (held across the
+# GIL: blocking under them stalls real threads)
+_SYNC_FACTORIES = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+}
+_ASYNC_FACTORIES = {
+    "asyncio.Lock": "AsyncLock",
+    "asyncio.locks.Lock": "AsyncLock",
+}
+
+# KT008's curated blocking-call list (prefers false negatives: store/
+# device calls under a scheduler lock can be deliberate — the curated
+# set is calls that are *never* correct under a contended sync lock)
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output",
+    "httpx.get", "httpx.post", "httpx.put", "httpx.patch", "httpx.delete",
+    "httpx.head", "httpx.options", "httpx.request", "httpx.stream",
+    "requests.get", "requests.post", "requests.put", "requests.patch",
+    "requests.delete", "requests.head", "requests.request",
+    "urllib.request.urlopen",
+    "socket.create_connection", "socket.getaddrinfo",
+}
+
+_NON_REENTRANT = {"Lock", "Condition", "AsyncLock"}
+
+
+# ---------------------------------------------------------------------------
+# static side: lock identity resolution
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ModuleLocks:
+    """Per-file lock-definition facts."""
+
+    # ("ClassName", "attr") -> ident ; class-level and instance attrs
+    class_attrs: Dict[Tuple[str, str], str] = field(default_factory=dict)
+    module_names: Dict[str, str] = field(default_factory=dict)
+    infos: Dict[str, LockInfo] = field(default_factory=dict)
+    aliases: Dict[str, str] = field(default_factory=dict)  # cond -> lock
+
+
+def _lock_kind(call: ast.AST, imports: Dict[str, str]) -> Optional[str]:
+    if not isinstance(call, ast.Call):
+        return None
+    qual = resolve_qualname(call.func, imports)
+    if qual in _SYNC_FACTORIES:
+        return _SYNC_FACTORIES[qual]
+    if qual in _ASYNC_FACTORIES:
+        return _ASYNC_FACTORIES[qual]
+    return None
+
+
+def collect_lock_defs(ctx: FileContext) -> ModuleLocks:
+    """Resolve every lock construction in a file to a stable identity:
+    ``<relpath>::<Class>.<attr>`` for class/instance attributes,
+    ``<relpath>::<name>`` for module-level locks. ``Condition(self._x)``
+    aliases to the wrapped lock's identity (entering the condition
+    acquires that lock)."""
+    imports = ctx.import_map()
+    out = ModuleLocks()
+
+    def note(ident: str, kind: str, node: ast.AST,
+             alias_of: Optional[str] = None) -> None:
+        out.infos.setdefault(ident, LockInfo(
+            ident=ident, kind=kind, path=ctx.relpath,
+            line=getattr(node, "lineno", 0), alias_of=alias_of))
+        if alias_of:
+            out.aliases[ident] = alias_of
+
+    def alias_target(call: ast.Call, cls: Optional[str]) -> Optional[str]:
+        # Condition(self._lock) / Condition(NAME): share the wrapped lock
+        if not call.args:
+            return None
+        arg = call.args[0]
+        if (cls and isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)
+                and arg.value.id in ("self", "cls")):
+            return out.class_attrs.get((cls, arg.attr))
+        if isinstance(arg, ast.Name):
+            return out.module_names.get(arg.id)
+        return None
+
+    # module-level: NAME = threading.Lock()
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            kind = _lock_kind(node.value, imports)
+            if kind is None:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    ident = f"{ctx.relpath}::{tgt.id}"
+                    out.module_names[tgt.id] = ident
+                    alias = (alias_target(node.value, None)
+                             if kind == "Condition" else None)
+                    note(ident, kind, node, alias)
+
+    # class-level and self.X = ... assignments (any method, any depth)
+    for cls in ctx.walk():
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            kind = _lock_kind(node.value, imports)
+            if kind is None:
+                continue
+            for tgt in node.targets:
+                attr = None
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id in ("self", "cls")):
+                    attr = tgt.attr
+                elif isinstance(tgt, ast.Name) and node in cls.body:
+                    attr = tgt.id     # class-level `_lock = Lock()`
+                if attr is None:
+                    continue
+                ident = f"{ctx.relpath}::{cls.name}.{attr}"
+                out.class_attrs[(cls.name, attr)] = ident
+                alias = (alias_target(node.value, cls.name)
+                         if kind == "Condition" else None)
+                note(ident, kind, node, alias)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# static side: the interprocedural pass
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Held:
+    ident: str
+    kind: str          # resolved (post-alias) lock kind
+    name: str          # source spelling, for messages
+
+
+class StaticLockPass:
+    """Walk every function in a file with a held-lock stack, emitting
+    order-graph edges and KT008/KT009 findings. Direct ``self._m()``
+    and same-file function calls are followed ONE level deep (their
+    direct acquisitions count as acquired at the call site)."""
+
+    def __init__(self, graph: LockGraph) -> None:
+        self.graph = graph
+        self.findings: List[Finding] = []
+
+    # -- public ------------------------------------------------------------
+    def run_file(self, ctx: FileContext) -> None:
+        locks = collect_lock_defs(ctx)
+        for info in locks.infos.values():
+            self.graph.add_lock(info)
+        if not locks.infos:
+            return
+        functions = self._functions(ctx)
+        classes = {n.name for n in ctx.walk()
+                   if isinstance(n, ast.ClassDef)}
+        for qualname, (fn, cls_name) in functions.items():
+            self._analyze(ctx, locks, functions, classes, fn, qualname,
+                          cls_name)
+
+    # -- discovery ---------------------------------------------------------
+    @staticmethod
+    def _functions(ctx: FileContext) -> Dict[str, Tuple[ast.AST,
+                                                        Optional[str]]]:
+        """Every function in the file (methods, module functions, nested
+        closures), keyed by a dotted qualname. Each is analyzed as its
+        own entry point with an empty held stack — nested defs run on
+        other threads/later, never inline."""
+        out: Dict[str, Tuple[ast.AST, Optional[str]]] = {}
+
+        def visit(node: ast.AST, prefix: str, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}{child.name}.", child.name)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    qn = f"{prefix}{child.name}"
+                    out.setdefault(qn, (child, cls))
+                    visit(child, f"{qn}.", cls)
+                else:
+                    visit(child, prefix, cls)
+
+        visit(ctx.tree, "", None)
+        return out
+
+    # -- resolution --------------------------------------------------------
+    @staticmethod
+    def _resolve_lock(expr: ast.AST, locks: ModuleLocks,
+                      cls_name: Optional[str]) -> Optional[str]:
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)):
+            owner = expr.value.id
+            if owner in ("self", "cls") and cls_name:
+                return locks.class_attrs.get((cls_name, expr.attr))
+            # ClassName.X (class-level lock accessed by name)
+            return locks.class_attrs.get((owner, expr.attr))
+        if isinstance(expr, ast.Name):
+            return locks.module_names.get(expr.id)
+        return None
+
+    def _canonical(self, ident: str, locks: ModuleLocks) -> str:
+        seen = set()
+        while ident in locks.aliases and ident not in seen:
+            seen.add(ident)
+            ident = locks.aliases[ident]
+        return ident
+
+    @staticmethod
+    def _callee(call: ast.Call,
+                functions: Dict[str, Tuple[ast.AST, Optional[str]]],
+                classes: Set[str],
+                cls_name: Optional[str],
+                caller_qn: str) -> Optional[str]:
+        """One-level follow targets: ``self._m()`` -> this class's method,
+        bare ``f()`` -> a same-file function (closures resolve to the
+        nearest enclosing FUNCTION scope's def — bare names never
+        resolve through class scope in Python, so a builtin like
+        ``list(...)`` inside ``TraceStore.list`` stays the builtin)."""
+        f = call.func
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id in ("self", "cls") and cls_name):
+            qn = f"{cls_name}.{f.attr}"
+            return qn if qn in functions else None
+        if isinstance(f, ast.Name):
+            prefix = caller_qn
+            while prefix:
+                if prefix.rpartition(".")[2] in classes \
+                        or prefix in classes:
+                    prefix = prefix.rpartition(".")[0]
+                    continue
+                qn = f"{prefix}.{f.id}"
+                if qn in functions:
+                    return qn
+                prefix = prefix.rpartition(".")[0]
+            return f.id if f.id in functions and f.id not in classes \
+                else None
+        return None
+
+    # -- analysis ----------------------------------------------------------
+    def _direct_acquires(self, fn: ast.AST, locks: ModuleLocks,
+                         cls_name: Optional[str]) -> List[Tuple[str, str,
+                                                                ast.AST]]:
+        """(canonical ident, kind, node) for every lock the function
+        acquires directly in its own body (nested defs excluded)."""
+        out: List[Tuple[str, str, ast.AST]] = []
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    ident = self._resolve_lock(item.context_expr, locks,
+                                               cls_name)
+                    if ident is not None:
+                        canon = self._canonical(ident, locks)
+                        kind = locks.infos[canon].kind \
+                            if canon in locks.infos else "Lock"
+                        out.append((canon, kind, item.context_expr))
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def _direct_blocking(self, fn: ast.AST,
+                         imports: Dict[str, str]) -> List[Tuple[str,
+                                                                ast.AST]]:
+        """(qualname, node) for every curated blocking call made
+        directly in the function body (nested defs excluded)."""
+        out: List[Tuple[str, ast.AST]] = []
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                qual = resolve_qualname(node.func, imports) or ""
+                if qual in _BLOCKING_CALLS:
+                    out.append((qual, node))
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def _analyze(self, ctx: FileContext, locks: ModuleLocks,
+                 functions: Dict[str, Tuple[ast.AST, Optional[str]]],
+                 classes: Set[str], fn: ast.AST, qualname: str,
+                 cls_name: Optional[str]) -> None:
+        held: List[_Held] = []
+        imports = ctx.import_map()
+
+        def sync_held() -> List[_Held]:
+            return [h for h in held
+                    if h.kind in ("Lock", "RLock", "Condition")]
+
+        def on_acquire(ident: str, node: ast.AST, name: str) -> _Held:
+            canon = self._canonical(ident, locks)
+            info = locks.infos.get(canon) or locks.infos.get(ident)
+            kind = info.kind if info else "Lock"
+            if canon in locks.aliases.values() and ident != canon:
+                # a Condition over a lock: acquiring it takes the LOCK
+                kind = (locks.infos[canon].kind
+                        if canon in locks.infos else "Lock")
+            for h in held:
+                self.graph.add_edge(h.ident, canon, Witness(
+                    path=ctx.relpath, line=getattr(node, "lineno", 0),
+                    func=qualname, kind=STATIC))
+            if (canon in {h.ident for h in held}
+                    and kind in _NON_REENTRANT):
+                self.findings.append(ctx.finding(
+                    "KT009", node,
+                    f"`{name}` re-acquired in `{qualname}` while already "
+                    f"held (non-reentrant {kind}) — self-deadlock"))
+            return _Held(ident=canon, kind=kind, name=name)
+
+        def on_call(node: ast.Call) -> None:
+            if not held:
+                return
+            qual = resolve_qualname(node.func, imports) or ""
+            if qual in _BLOCKING_CALLS and sync_held():
+                locks_held = ", ".join(
+                    f"`{h.name}`" for h in sync_held())
+                self.findings.append(ctx.finding(
+                    "KT008", node,
+                    f"blocking `{qual}(...)` in `{qualname}` while "
+                    f"holding {locks_held} — every contending thread "
+                    f"stalls for the full call; move it outside the "
+                    f"`with`"))
+            # one-level interprocedural follow
+            callee_qn = self._callee(node, functions, classes, cls_name,
+                                     qualname)
+            if callee_qn is None:
+                return
+            callee_fn, callee_cls = functions[callee_qn]
+            held_idents = {h.ident for h in held}
+            if sync_held():
+                for bqual, bnode in self._direct_blocking(callee_fn,
+                                                          imports):
+                    locks_held = ", ".join(
+                        f"`{h.name}`" for h in sync_held())
+                    self.findings.append(ctx.finding(
+                        "KT008", node,
+                        f"`{qualname}` holds {locks_held} and calls "
+                        f"`{callee_qn}()` which blocks on "
+                        f"`{bqual}(...)` (line {bnode.lineno}) — every "
+                        f"contending thread stalls for the full call"))
+            for canon, kind, acq_node in self._direct_acquires(
+                    callee_fn, locks, callee_cls):
+                for h in held:
+                    self.graph.add_edge(h.ident, canon, Witness(
+                        path=ctx.relpath,
+                        line=getattr(acq_node, "lineno", 0),
+                        func=f"{qualname} -> {callee_qn}", kind=STATIC))
+                if canon in held_idents and kind in _NON_REENTRANT:
+                    self.findings.append(ctx.finding(
+                        "KT009", node,
+                        f"`{qualname}` holds the lock and calls "
+                        f"`{callee_qn}()` which re-acquires it "
+                        f"(non-reentrant {kind}) — self-deadlock; "
+                        f"`*_locked` callees must rely on the caller's "
+                        f"hold"))
+
+        def is_wait_call(node: ast.AST) -> bool:
+            # cond.wait()/wait_for() RELEASES the lock it guards — never
+            # a blocking-under-lock finding for its own condition. ONLY
+            # for a receiver that resolves to a lock currently held:
+            # `await event.wait()` / `proc.wait()` release nothing and
+            # must not ride the name-based exemption
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("wait", "wait_for")):
+                return False
+            recv = self._resolve_lock(node.func.value, locks, cls_name)
+            if recv is None:
+                return False
+            canon = self._canonical(recv, locks)
+            return canon in {h.ident for h in held}
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                pushed = 0
+                for item in node.items:
+                    ident = self._resolve_lock(item.context_expr, locks,
+                                               cls_name)
+                    if ident is not None:
+                        name = (dotted_name(item.context_expr)
+                                or "<lock>")
+                        held.append(on_acquire(ident, item.context_expr,
+                                               name))
+                        pushed += 1
+                for child in node.body:
+                    visit(child)
+                for _ in range(pushed):
+                    held.pop()
+                return
+            if isinstance(node, ast.Await) and sync_held():
+                inner = node.value
+                if not is_wait_call(inner):
+                    locks_held = ", ".join(
+                        f"`{h.name}`" for h in sync_held())
+                    self.findings.append(ctx.finding(
+                        "KT008", node,
+                        f"`await` in `async def "
+                        f"{qualname.rpartition('.')[2]}` while holding "
+                        f"{locks_held} (a sync lock) — the lock is held "
+                        f"across the suspension; every thread AND task "
+                        f"contending it stalls"))
+            if isinstance(node, ast.Call) and not is_wait_call(node):
+                on_call(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in fn.body:
+            visit(stmt)
+
+
+# ---------------------------------------------------------------------------
+# static entry point + cycle findings
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SanResult:
+    findings: List[Finding]
+    baselined: List[Finding]
+    errors: List[str]
+    graph: LockGraph
+    cycles: List[List[str]]
+    dynamic_reports: int = 0
+
+    @property
+    def all_findings(self) -> List[Finding]:
+        return sorted(self.findings + self.baselined, key=Finding.sort_key)
+
+
+def build_static(config: Optional[LintConfig] = None,
+                 paths: Optional[Sequence[str]] = None,
+                 ) -> Tuple[LockGraph, List[Finding], List[str],
+                            Dict[str, FileContext]]:
+    """Run the static pass over the package: returns (graph, per-line
+    findings with suppressions applied, errors, relpath->ctx map)."""
+    config = config or load_lint_config()
+    graph = LockGraph()
+    spass = StaticLockPass(graph)
+    errors: List[str] = []
+    ctxs: Dict[str, FileContext] = {}
+    for path in iter_py_files(config, paths):
+        rel = _relpath(path, config.root)
+        try:
+            source = path.read_text(encoding="utf-8")
+            ctx = FileContext(path, rel, source, config)
+        except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+            errors.append(f"{rel}: {type(exc).__name__}: {exc}")
+            continue
+        ctxs[rel] = ctx
+        spass.run_file(ctx)
+    findings = [f for f in spass.findings
+                if not ctxs[f.path].suppressed(f.rule, f.line)]
+    return graph, findings, errors, ctxs
+
+
+def cycle_findings(graph: LockGraph) -> List[Finding]:
+    """One KT010 finding per lock-order cycle, anchored at the first
+    edge's first witness; the snippet is the cycle signature (stable
+    under line shifts, so the baseline machinery keys on it)."""
+    out: List[Finding] = []
+    for cyc in graph.cycles():
+        edges = graph.cycle_edges(cyc)
+        wit = next((w[0] for _, _, w in edges if w), None)
+        out.append(Finding(
+            rule="KT010",
+            path=wit.path if wit else "<merged>",
+            line=wit.line if wit else 0,
+            col=0,
+            message=graph.render_cycle(cyc),
+            snippet=graph.cycle_signature(cyc)))
+    return out
+
+
+def run_san(config: Optional[LintConfig] = None,
+            paths: Optional[Sequence[str]] = None,
+            static_only: bool = False,
+            reports_dir: Optional[str] = None,
+            apply_baseline: bool = True) -> SanResult:
+    """The ``ktpu san`` engine: static pass, optional dynamic-report
+    union, cycle detection, ktlint-style baseline split."""
+    from kubetorch_tpu.analysis import baseline as baseline_mod
+
+    config = config or load_lint_config()
+    graph, findings, errors, _ctxs = build_static(config, paths)
+    dynamic_reports = 0
+    if not static_only:
+        rdir = reports_dir or _default_reports_dir()
+        if rdir and Path(rdir).is_dir():
+            merged, dynamic_reports = merge_reports(rdir)
+            remap_dynamic(merged, graph)
+            graph.merge(merged)
+    cycles = graph.cycles()
+    findings = sorted(findings + cycle_findings(graph),
+                      key=Finding.sort_key)
+    if apply_baseline:
+        base = baseline_mod.load(config.root / SAN_BASELINE)
+        new, matched = baseline_mod.split(findings, base)
+    else:
+        new, matched = findings, []
+    return SanResult(findings=new, baselined=matched, errors=errors,
+                     graph=graph, cycles=cycles,
+                     dynamic_reports=dynamic_reports)
+
+
+def _default_reports_dir() -> Optional[str]:
+    from kubetorch_tpu.config import env_str
+
+    return env_str("KT_SAN_DIR")
+
+
+# ---------------------------------------------------------------------------
+# dynamic side: lock instrumentation (KT_SAN=1)
+# ---------------------------------------------------------------------------
+
+
+class _Runtime:
+    """Process-local dynamic state. All mutation funnels through
+    :meth:`note_acquire`; the graph lock is a RAW lock (created from the
+    saved original factory) so the recorder can never recurse into
+    itself."""
+
+    def __init__(self, raw_lock_factory, stall_ms: float,
+                 max_edges: int) -> None:
+        import threading
+
+        self.graph = LockGraph()
+        self.lock = raw_lock_factory()
+        self.stall_ms = stall_ms
+        self.max_edges = max_edges
+        self.acquires = 0
+        self.stalls: List[Dict[str, Any]] = []
+        self.stall_count = 0
+        self.local = threading.local()      # .held: list[(ident, oid)]
+        self.baseline_threads = {id(t) for t in threading.enumerate()}
+        self.repo_root = str(_repo_root())
+        self._last_snapshot: Optional[Tuple[int, int]] = None
+
+    # -- held-set bookkeeping (sync/thread side) ---------------------------
+    def held_list(self) -> list:
+        lst = getattr(self.local, "held", None)
+        if lst is None:
+            lst = []
+            self.local.held = lst
+        return lst
+
+    def record_edges(self, held, ident: str, oid: int,
+                     func: str) -> None:
+        """The ONE recorder hot path (sync threads and asyncio tasks
+        both funnel here): an edge from every held lock to the newly
+        acquired one, witness at the real acquire site.
+
+        NO synchronous prometheus bump in here: the prometheus group
+        lock is itself an instrumented lock when prometheus imports
+        after install(), and recording from inside an acquire would
+        re-acquire it mid-``__enter__`` (self-deadlock). Totals flush
+        lazily via :func:`flush_metrics` when ``san_metrics()`` is
+        scraped."""
+        site = _caller_site(self.repo_root)
+        with self.lock:
+            self.acquires += 1
+            if len(self.graph.edges) < self.max_edges:
+                for h_ident, h_oid in held:
+                    if h_oid == oid or h_ident == ident:
+                        # same object (a real double-acquire would have
+                        # deadlocked before reaching here) or same lock
+                        # class on another instance: the lockdep
+                        # blind spot — skip, FP-safe
+                        continue
+                    self.graph.add_edge(h_ident, ident, Witness(
+                        path=site[0] if site else "<unknown>",
+                        line=site[1] if site else 0,
+                        func=func, kind=DYNAMIC))
+
+    def note_acquire(self, ident: str, oid: int, reentrant: bool,
+                     thread_name: str) -> None:
+        held = self.held_list()
+        if reentrant and any(h_oid == oid for _, h_oid in held):
+            # RLock re-hold: one held entry per outermost hold — no new
+            # edges, and release() pops only on the final release
+            return
+        self.record_edges(held, ident, oid, thread_name)
+        held.append((ident, oid))
+
+    def note_release(self, oid: int) -> None:
+        held = self.held_list()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][1] == oid:
+                del held[i]
+                return
+
+    # -- async task side ---------------------------------------------------
+    # (contextvar lives at module scope; tasks copy context at creation)
+
+    # -- stalls ------------------------------------------------------------
+    def note_stall(self, callback: str, ms: float) -> None:
+        with self.lock:
+            self.stall_count += 1
+            if len(self.stalls) < 200:
+                self.stalls.append({"callback": callback[:200],
+                                    "ms": round(ms, 2)})
+
+    # -- report ------------------------------------------------------------
+    def report(self) -> dict:
+        import threading
+
+        leaked = sorted(
+            t.name for t in threading.enumerate()
+            if t.is_alive() and not t.daemon
+            and id(t) not in self.baseline_threads
+            and t is not threading.main_thread())
+        with self.lock:
+            return {
+                "version": 1,
+                "pid": os.getpid(),
+                "acquires": self.acquires,
+                "graph": self.graph.to_dict(),
+                "stall_count": self.stall_count,
+                "stalls": list(self.stalls),
+                "leaked_threads": leaked,
+            }
+
+
+_rt: Optional[_Runtime] = None
+_orig: Dict[str, Any] = {}
+
+# per-asyncio-task held stack (tuple of (ident, oid)); ContextVar
+# mutations are task-local, giving the per-task semantics for free
+import contextvars as _contextvars  # noqa: E402
+
+_task_held: "_contextvars.ContextVar[tuple]" = _contextvars.ContextVar(
+    "kt_san_task_held", default=())
+
+
+def _repo_root() -> Path:
+    from kubetorch_tpu.analysis.engine import _find_root
+
+    return _find_root()
+
+
+def _record_san_safe(event: str, value: float = 1.0) -> None:
+    """Bump a san_* counter from a context that is NOT inside a lock
+    acquire (session checks, the leak guard) — never from the recorder
+    hot path (see the note in ``note_acquire``)."""
+    try:
+        from kubetorch_tpu.observability import prometheus as prom
+
+        prom.record_san(event, value)
+    except Exception:  # ktlint: disable=KT004 -- metrics best-effort
+        pass
+
+
+def flush_metrics() -> None:
+    """Copy the runtime's totals into the ``san_*`` prometheus group.
+    Called lazily by ``prometheus.san_metrics()`` at scrape time — the
+    recorder hot path cannot touch the (itself instrumented) group
+    lock."""
+    rt = _rt
+    if rt is None:
+        return
+    with rt.lock:
+        totals = {
+            "san_locks_tracked_total": float(len(rt.graph.locks)),
+            "san_edges_total": float(len(rt.graph.edges)),
+            "san_stalls_total": float(rt.stall_count),
+        }
+    try:
+        from kubetorch_tpu.observability import prometheus as prom
+
+        prom.record_san_absolute(totals)
+    except Exception:  # ktlint: disable=KT004 -- metrics best-effort
+        pass
+
+
+def _site_from_frame(frame, root: str) -> Optional[Tuple[str, int, str]]:
+    fname = frame.f_code.co_filename
+    if "kubetorch_tpu/analysis/san" in fname.replace("\\", "/"):
+        return None
+    try:
+        rel = str(Path(fname).resolve().relative_to(root))
+    except ValueError:
+        return None
+    rel = rel.replace(os.sep, "/")
+    if not (rel.startswith("kubetorch_tpu/") or rel.startswith("tests/")):
+        return None
+    return (rel, frame.f_lineno, frame.f_code.co_name)
+
+
+def _caller_site(root: str) -> Optional[Tuple[str, int, str]]:
+    frame = sys._getframe(2)
+    for _ in range(12):
+        if frame is None:
+            return None
+        site = _site_from_frame(frame, root)
+        if site is not None:
+            return site
+        frame = frame.f_back
+    return None
+
+
+def _creation_ident(root: str) -> Optional[str]:
+    """Identity for a dynamically-created lock: its creation site
+    ``<relpath>:<line>``. The merger remaps this to the static
+    ``<relpath>::<Class>.<attr>`` identity when the static pass saw a
+    lock assignment on that exact line.
+
+    IMMEDIATE-caller semantics (unlike acquire-site resolution, which
+    walks up): only locks whose direct creator is repo code are
+    instrumented — a stdlib-internal lock (``Thread.start``'s Event
+    condition, an executor's queue lock) stays raw instead of being
+    blamed on whatever repo line called into the stdlib."""
+    frame = sys._getframe(2)
+    for _ in range(8):
+        if frame is None:
+            return None
+        fname = frame.f_code.co_filename.replace("\\", "/")
+        if "kubetorch_tpu/analysis/san" in fname:
+            frame = frame.f_back       # our factory nesting (Condition
+            continue                   # -> RLock) is transparent
+        site = _site_from_frame(frame, root)
+        return f"{site[0]}:{site[1]}" if site else None
+    return None
+
+
+class _SanLockBase:
+    """Proxy around a real lock primitive; records acquire/release into
+    the runtime. ``__getattr__`` forwards everything else (Condition
+    integration: ``_release_save``/``_acquire_restore``/``_is_owned``
+    resolve on the inner object when it has them)."""
+
+    __slots__ = ("_inner", "_kt_ident")
+    _kt_reentrant = False
+    _kt_kind = "Lock"
+
+    def __init__(self, inner, ident: str) -> None:
+        self._inner = inner
+        self._kt_ident = ident
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok and _rt is not None:
+            import threading
+
+            _rt.note_acquire(self._kt_ident, id(self),
+                             self._kt_reentrant,
+                             threading.current_thread().name)
+        return ok
+
+    def release(self):
+        if _rt is not None:
+            _rt.note_release(id(self))
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class _SanLock(_SanLockBase):
+    __slots__ = ()
+
+
+class _SanRLock(_SanLockBase):
+    __slots__ = ()
+    _kt_reentrant = True
+    _kt_kind = "RLock"
+
+    def release(self):
+        # pop only the OUTERMOST hold's entry: inner releases of a
+        # reentrant hold leave the held-set entry in place
+        self._inner.release()
+        if _rt is not None and not self._is_owned():
+            _rt.note_release(id(self))
+
+    def _is_owned(self):
+        try:
+            return self._inner._is_owned()
+        except AttributeError:
+            return False
+
+
+def _register_lock(ident: str, kind: str, root: str) -> None:
+    if _rt is None:
+        return
+    path, _, line = ident.rpartition(":")
+    with _rt.lock:
+        _rt.graph.add_lock(LockInfo(
+            ident=ident, kind=kind, path=path,
+            line=int(line) if line.isdigit() else 0))
+
+
+def _make_lock_factory(orig_factory, wrapper_cls, kind: str):
+    def factory(*args, **kwargs):
+        inner = orig_factory(*args, **kwargs)
+        rt = _rt
+        if rt is None:
+            return inner
+        ident = _creation_ident(rt.repo_root)
+        if ident is None:
+            return inner
+        _register_lock(ident, kind, rt.repo_root)
+        return wrapper_cls(inner, ident)
+
+    factory.__name__ = kind
+    return factory
+
+
+def _make_condition_factory(orig_condition, rlock_factory):
+    """``threading.Condition(lock=None)`` -> a REAL Condition wrapping a
+    sanitized lock: every ``with cond:`` acquire flows through the
+    wrapper (recording), and ``wait()``'s release/re-acquire round-trips
+    through it too, so even the wait-wakeup ordering is tracked."""
+
+    def Condition(lock=None):
+        rt = _rt
+        if rt is None:
+            return orig_condition(lock)
+        if lock is None:
+            ident = _creation_ident(rt.repo_root)
+            if ident is None:
+                return orig_condition()
+            lock = rlock_factory()
+            if not isinstance(lock, _SanLockBase):
+                # creation site visible but factory declined (shouldn't
+                # happen — same site) — fall back uninstrumented
+                return orig_condition(lock)
+        return orig_condition(lock)
+
+    return Condition
+
+
+def install() -> bool:
+    """Instrument the lock factories + event loop. Idempotent; returns
+    True when the runtime is (already) active. Call :func:`uninstall`
+    to restore the originals (tests)."""
+    global _rt
+    if _rt is not None:
+        return True
+    import asyncio.events
+    import threading
+
+    from kubetorch_tpu.config import env_float, env_int
+
+    _orig["Lock"] = threading.Lock
+    _orig["RLock"] = threading.RLock
+    _orig["Condition"] = threading.Condition
+    _orig["Handle._run"] = asyncio.events.Handle._run
+    _orig["AsyncLock.acquire"] = asyncio.locks.Lock.acquire
+    _orig["AsyncLock.release"] = asyncio.locks.Lock.release
+    _orig["AsyncLock.__init__"] = asyncio.locks.Lock.__init__
+
+    _rt = _Runtime(raw_lock_factory=_orig["Lock"],
+                   stall_ms=float(env_float("KT_SAN_STALL_MS") or 100.0),
+                   max_edges=int(env_int("KT_SAN_MAX_EDGES") or 20000))
+
+    threading.Lock = _make_lock_factory(_orig["Lock"], _SanLock, "Lock")
+    threading.RLock = _make_lock_factory(_orig["RLock"], _SanRLock,
+                                         "RLock")
+    threading.Condition = _make_condition_factory(_orig["Condition"],
+                                                  threading.RLock)
+
+    # --- asyncio.Lock: per-task held set via contextvar -------------------
+    orig_init = _orig["AsyncLock.__init__"]
+    orig_acquire = _orig["AsyncLock.acquire"]
+    orig_release = _orig["AsyncLock.release"]
+
+    def san_init(self, *a, **k):
+        orig_init(self, *a, **k)
+        rt = _rt
+        if rt is not None:
+            ident = _creation_ident(rt.repo_root)
+            if ident is not None:
+                self._kt_san_ident = ident
+                _register_lock(ident, "AsyncLock", rt.repo_root)
+
+    async def san_acquire(self):
+        ok = await orig_acquire(self)
+        rt = _rt
+        ident = getattr(self, "_kt_san_ident", None)
+        if ok and rt is not None and ident is not None:
+            # held = this thread's sync locks + this TASK's async locks
+            held = list(rt.held_list()) + list(_task_held.get())
+            rt.record_edges(held, ident, id(self), "task")
+            _task_held.set(_task_held.get() + ((ident, id(self)),))
+        return ok
+
+    def san_release(self):
+        ident = getattr(self, "_kt_san_ident", None)
+        if ident is not None:
+            cur = _task_held.get()
+            for i in range(len(cur) - 1, -1, -1):
+                if cur[i][1] == id(self):
+                    _task_held.set(cur[:i] + cur[i + 1:])
+                    break
+        orig_release(self)
+
+    asyncio.locks.Lock.__init__ = san_init
+    asyncio.locks.Lock.acquire = san_acquire
+    asyncio.locks.Lock.release = san_release
+
+    # --- event-loop stall detector ----------------------------------------
+    orig_run = _orig["Handle._run"]
+    stall_s = _rt.stall_ms / 1000.0
+
+    def san_run(self):
+        t0 = time.perf_counter()
+        try:
+            return orig_run(self)
+        finally:
+            dt = time.perf_counter() - t0
+            rt = _rt
+            if rt is not None and dt > stall_s:
+                cb = getattr(self, "_callback", None)
+                rt.note_stall(repr(cb), dt * 1000.0)
+
+    asyncio.events.Handle._run = san_run
+
+    # --- dump on exit ------------------------------------------------------
+    from kubetorch_tpu.config import env_str
+
+    out_dir = env_str("KT_SAN_DIR")
+    if out_dir:
+        import atexit
+
+        atexit.register(dump_report, out_dir)
+    return True
+
+
+def install_from_env() -> bool:
+    """Install when ``KT_SAN=1`` (pod-server and worker entrypoints call
+    this first thing, so subprocesses of an instrumented test session
+    record and dump their own graphs into the inherited KT_SAN_DIR)."""
+    from kubetorch_tpu.config import env_bool
+
+    if not env_bool("KT_SAN"):
+        return False
+    return install()
+
+
+def uninstall() -> None:
+    """Restore the original factories (the graph survives for reading)."""
+    global _rt
+    if _rt is None:
+        return
+    import asyncio.events
+    import threading
+
+    threading.Lock = _orig["Lock"]
+    threading.RLock = _orig["RLock"]
+    threading.Condition = _orig["Condition"]
+    asyncio.events.Handle._run = _orig["Handle._run"]
+    asyncio.locks.Lock.acquire = _orig["AsyncLock.acquire"]
+    asyncio.locks.Lock.release = _orig["AsyncLock.release"]
+    asyncio.locks.Lock.__init__ = _orig["AsyncLock.__init__"]
+    _rt = None
+
+
+def active() -> bool:
+    return _rt is not None
+
+
+def runtime_graph() -> Optional[LockGraph]:
+    return _rt.graph if _rt is not None else None
+
+
+def snapshot_graph_if_changed() -> Optional[dict]:
+    """The worker piggyback: this process's graph as a dict, or None
+    when no new lock/edge appeared since the last snapshot (new
+    witnesses on a known edge don't change cycle detection, so they
+    don't force a re-ship)."""
+    rt = _rt
+    if rt is None:
+        return None
+    with rt.lock:
+        marker = (len(rt.graph.locks), len(rt.graph.edges))
+        if marker == getattr(rt, "_last_snapshot", None):
+            return None
+        rt._last_snapshot = marker
+        return rt.graph.to_dict()
+
+
+def ingest_graph(data: dict) -> bool:
+    """Merge a piggybacked graph (a worker's) into this process's
+    runtime graph, so the pod server's dump covers worker-side edges —
+    workers die with the pod's ``os._exit`` and cannot reliably dump
+    their own report."""
+    rt = _rt
+    if rt is None:
+        return False
+    incoming = LockGraph.from_dict(data)
+    with rt.lock:
+        rt.graph.merge(incoming)
+    return True
+
+
+def dump_report(out_dir: str) -> Optional[Path]:
+    """Write this process's dynamic report (graph + stalls + leaked
+    threads) as ``san-<pid>.json`` into ``out_dir``. Best-effort: a
+    dying process must never fail its exit path on the sanitizer."""
+    rt = _rt
+    if rt is None:
+        return None
+    try:
+        out = Path(out_dir)
+        out.mkdir(parents=True, exist_ok=True)
+        path = out / f"san-{os.getpid()}.json"
+        path.write_text(json.dumps(rt.report(), indent=2, sort_keys=True)
+                        + "\n")
+        return path
+    except Exception:  # ktlint: disable=KT004 -- exit path, best-effort
+        return None
+
+
+def merge_reports(reports_dir: str) -> Tuple[LockGraph, int]:
+    """Union every ``san-*.json`` in a directory into one graph."""
+    graph = LockGraph()
+    count = 0
+    for path in sorted(Path(reports_dir).glob("san-*.json")):
+        try:
+            data = json.loads(path.read_text())
+        except (json.JSONDecodeError, OSError):
+            continue
+        graph.merge(LockGraph.from_dict(data.get("graph") or {}))
+        count += 1
+    return graph, count
+
+
+def remap_dynamic(dynamic: LockGraph, static: LockGraph) -> None:
+    """Rewrite dynamic creation-site identities (``path:line``) to the
+    static ``path::Class.attr`` identities where the static pass saw a
+    lock defined on that exact line — so static and dynamic edges about
+    the same lock land on the same graph node."""
+    site_to_ident = {
+        (info.path, info.line): ident
+        for ident, info in static.locks.items()}
+    alias = {}
+    for ident, info in list(dynamic.locks.items()):
+        mapped = site_to_ident.get((info.path, info.line))
+        if mapped and mapped != ident:
+            alias[ident] = mapped
+    if not alias:
+        return
+    # also collapse through static Condition aliases (Condition(self._x)
+    # dynamically records the wrapped lock already — static side aliases)
+    for ident, info in static.locks.items():
+        if info.alias_of:
+            alias.setdefault(ident, info.alias_of)
+    new_edges: Dict[Tuple[str, str], List[Witness]] = {}
+    for (src, dst), wits in dynamic.edges.items():
+        key = (alias.get(src, src), alias.get(dst, dst))
+        if key[0] == key[1]:
+            continue
+        new_edges.setdefault(key, []).extend(wits)
+    dynamic.edges = {k: v[:4] for k, v in new_edges.items()}
+    for ident, mapped in alias.items():
+        dynamic.locks.pop(ident, None)
+
+
+# ---------------------------------------------------------------------------
+# session check (the pytest plugin's hook)
+# ---------------------------------------------------------------------------
+
+
+def session_check(reports_dir: str,
+                  include_static: bool = True) -> Optional[str]:
+    """Merge per-process dynamic reports (dumping this process's own
+    first), union with the static graph, run cycle detection, and
+    return a rendered report when cycles exist (None = clean). Also
+    bumps ``san_cycles_total``."""
+    dump_report(reports_dir)
+    dynamic, nreports = merge_reports(reports_dir)
+    if include_static:
+        static, _findings, _errors, _ctxs = build_static()
+        remap_dynamic(dynamic, static)
+        static.merge(dynamic)
+        graph = static
+    else:
+        graph = dynamic
+    cycles = graph.cycles()
+    if not cycles:
+        return None
+    _record_san_safe("cycle", len(cycles))
+    parts = [f"ktsan: {len(cycles)} lock-order cycle(s) over "
+             f"{nreports} dynamic report(s) + static graph:"]
+    parts.extend(graph.render_cycle(c) for c in cycles)
+    return "\n\n".join(parts)

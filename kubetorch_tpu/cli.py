@@ -639,6 +639,98 @@ def lint(paths, as_json, update_baseline, no_baseline, gen_config_docs,
         sys.exit(1)
 
 
+# ----------------------------------------------------------------- san
+@main.command()
+@click.argument("paths", nargs=-1)
+@click.option("--json", "as_json", is_flag=True,
+              help="Machine-readable findings + graph stats on stdout.")
+@click.option("--static-only", is_flag=True,
+              help="Skip merging KT_SAN_DIR dynamic reports.")
+@click.option("--baseline", "update_baseline", is_flag=True,
+              help="Rewrite .ktsan-baseline.json with the current "
+                   "findings (grandfather everything currently flagged).")
+@click.option("--no-baseline", is_flag=True,
+              help="Ignore the baseline: report every finding.")
+@click.option("--reports", "reports_dir", default=None,
+              help="Directory of san-<pid>.json dynamic reports to merge "
+                   "(default: $KT_SAN_DIR).")
+@click.option("--graph", "dump_graph", is_flag=True,
+              help="Print the merged lock-order graph edges and exit.")
+@click.option("--list-rules", is_flag=True,
+              help="Describe the sanitizer rules and exit.")
+def san(paths, as_json, static_only, update_baseline, no_baseline,
+        reports_dir, dump_graph, list_rules):
+    """Concurrency sanitizer (rules KT008-KT010).
+
+    Builds the global lock-acquisition-order graph statically (with
+    nesting + one-level call follow), optionally unions KT_SAN=1 runtime
+    reports, and reports await/blocking-under-sync-lock (KT008),
+    double-acquire (KT009), and lock-order cycles (KT010). Suppress
+    inline with `# ktlint: disable=KT00x -- reason`; baseline lives in
+    .ktsan-baseline.json. Exit 1 on non-baselined findings.
+    """
+    from kubetorch_tpu.analysis import baseline as baseline_mod
+    from kubetorch_tpu.analysis.engine import load_lint_config
+    from kubetorch_tpu.analysis.san import (SAN_BASELINE, SAN_RULE_DOCS,
+                                            run_san)
+
+    if list_rules:
+        for code, (name, doc) in sorted(SAN_RULE_DOCS.items()):
+            click.echo(f"{code} [{name}]")
+            click.echo(f"    {doc}\n")
+        return
+
+    config = load_lint_config()
+    result = run_san(config, paths=paths or None,
+                     static_only=static_only, reports_dir=reports_dir,
+                     apply_baseline=not (no_baseline or update_baseline))
+    if dump_graph:
+        for (src, dst), wits in sorted(result.graph.edges.items()):
+            w = sorted(wits, key=lambda x: x.sort_key())[0]
+            click.echo(f"{src} -> {dst}  [{w.kind} {w.path}:{w.line}]")
+        click.echo(f"{len(result.graph.locks)} lock(s), "
+                   f"{len(result.graph.edges)} edge(s), "
+                   f"{len(result.cycles)} cycle(s), "
+                   f"{result.dynamic_reports} dynamic report(s)")
+        return
+    if update_baseline:
+        base_path = config.root / SAN_BASELINE
+        baseline_mod.dump(result.findings, base_path)
+        click.echo(f"baseline: {len(result.findings)} finding(s) written "
+                   f"to {base_path}")
+        return
+
+    if as_json:
+        click.echo(json.dumps({
+            "findings": [f.to_dict() for f in result.findings],
+            "baselined": len(result.baselined),
+            "errors": result.errors,
+            "locks": len(result.graph.locks),
+            "edges": len(result.graph.edges),
+            "cycles": [result.graph.cycle_signature(c)
+                       for c in result.cycles],
+            "dynamic_reports": result.dynamic_reports,
+        }, indent=2))
+    else:
+        for f in result.findings:
+            if f.rule == "KT010":
+                click.echo(f"{f.path}:{f.line}: KT010\n{f.message}")
+            else:
+                click.echo(str(f))
+        for err in result.errors:
+            click.echo(f"ERROR {err}", err=True)
+        click.echo(f"{len(result.findings)} finding(s), "
+                   f"{len(result.baselined)} baselined; "
+                   f"{len(result.graph.locks)} lock(s), "
+                   f"{len(result.graph.edges)} order edge(s)"
+                   + (f", {result.dynamic_reports} dynamic report(s)"
+                      if result.dynamic_reports else ""))
+    if result.errors:
+        sys.exit(2)
+    if result.findings:
+        sys.exit(1)
+
+
 # ---------------------------------------------------------------- runs
 @main.command(context_settings={"ignore_unknown_options": True})
 @click.option("--name", default=None, help="run name prefix")

@@ -369,6 +369,27 @@ _knob("KT_KV_SESSION_DELTA", "bool", True,
       "ships only its new blocks (per-block leaves + PR-3 delta).",
       "engine-kv")
 
+# --- concurrency sanitizer (kubetorch_tpu/analysis/san.py, `ktpu san`) ------
+_knob("KT_SAN", "bool", False,
+      "Enable the runtime concurrency sanitizer: instrument lock "
+      "factories to record per-thread acquisition order, detect "
+      "event-loop stalls, and dump a per-process report at exit.",
+      "sanitizer")
+_knob("KT_SAN_DIR", "str", None,
+      "Directory the sanitizer dumps per-process reports "
+      "(san-<pid>.json) into; subprocess pods inherit it so one test "
+      "session's reports land together. Unset = no dump.", "sanitizer")
+_knob("KT_SAN_STALL_MS", "float", 100.0,
+      "Event-loop stall threshold: any asyncio callback running longer "
+      "than this is recorded as a stall in the sanitizer report.",
+      "sanitizer")
+_knob("KT_SAN_MAX_EDGES", "int", 20000,
+      "Cap on distinct lock-order edges the runtime records (runaway "
+      "guard; far above any real lock population).", "sanitizer")
+_knob("KT_SAN_LEAKS", "bool", True,
+      "Thread-leak guard in the test suite: assert no non-daemon "
+      "threads survive a test module (0 = off).", "sanitizer")
+
 # --- distributed ------------------------------------------------------------
 _knob("KT_POD_IPS", "str", None,
       "Comma-separated pod IPs for the gang (rendezvous).", "distributed")

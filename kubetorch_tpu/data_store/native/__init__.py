@@ -30,6 +30,7 @@ def _ensure_lib() -> ctypes.CDLL:
             raise RuntimeError("native hasher build previously failed")
         if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
             try:
+                # ktlint: disable=KT008 -- build-once barrier: the lock exists precisely so every contender waits for the one g++ build; nothing can use the lib before it exists
                 subprocess.run(
                     ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
                      str(_SRC), "-o", str(_LIB)],

@@ -621,6 +621,72 @@ def resilience_samples(labels: Optional[Dict[str, str]] = None):
         yield name, labels, value
 
 
+# ------------------------------------------------------------------
+# Concurrency-sanitizer counters (analysis/san.py, KT_SAN=1). Recorded
+# in whichever process runs instrumented — a pod worker's snapshot
+# piggybacks on call responses like the engine counters; the pod server
+# process's own snapshot merges in h_metrics. All zero (and absent from
+# any alerting concern) unless the sanitizer is installed.
+_SAN_LOCK = threading.Lock()
+_SAN: Dict[str, float] = {
+    "san_locks_tracked_total": 0.0,
+    "san_edges_total": 0.0,
+    "san_cycles_total": 0.0,
+    "san_stalls_total": 0.0,
+    "san_thread_leaks_total": 0.0,
+}
+_SAN_EVENTS = {
+    "lock": "san_locks_tracked_total",
+    "edge": "san_edges_total",
+    "cycle": "san_cycles_total",
+    "stall": "san_stalls_total",
+    "thread_leak": "san_thread_leaks_total",
+}
+
+
+def record_san(event: str, value: float = 1.0) -> None:
+    """Bump a sanitizer counter (``lock`` / ``edge`` / ``cycle`` /
+    ``stall`` / ``thread_leak``)."""
+    with _SAN_LOCK:
+        counter = _SAN_EVENTS.get(event)
+        if counter is not None:
+            _SAN[counter] += value
+
+
+def record_san_absolute(values: Dict[str, float]) -> None:
+    """Set sanitizer totals wholesale (the runtime flushes its graph
+    sizes at scrape time — the recorder hot path can't bump through
+    this module's lock, which may itself be instrumented)."""
+    with _SAN_LOCK:
+        for name, value in values.items():
+            if name in _SAN:
+                _SAN[name] = float(value)
+
+
+def san_metrics() -> Dict[str, float]:
+    """Snapshot of the concurrency-sanitizer counters (pulls the live
+    runtime totals first when the sanitizer is installed). sys.modules
+    lookup, not an import: an uninstrumented pod's first scrape must
+    not pay the analysis-package import for an all-zero group."""
+    import sys as _sys
+
+    _san = _sys.modules.get("kubetorch_tpu.analysis.san")
+    if _san is not None:
+        try:
+            _san.flush_metrics()
+        except Exception:  # ktlint: disable=KT004 -- scrape must not fail on the sanitizer
+            pass
+    with _SAN_LOCK:
+        return dict(_SAN)
+
+
+def san_samples(labels: Optional[Dict[str, str]] = None):
+    """Exposition samples for the sanitizer counters."""
+    labels = labels or {}
+    for name, value in san_metrics().items():
+        yield name, labels, value
+
+
 def wants_prometheus(request) -> bool:
     """Content negotiation for a shared /metrics route: Prometheus sends
     ``Accept: application/openmetrics-text, text/plain;version=0.0.4``;

@@ -354,6 +354,11 @@ class CallChannel:
         self._submit_lock = threading.Lock()
         self._cids = itertools.count(1)
         self._calls: Dict[int, ChannelCall] = {}
+        # Lock order (ktsan-audited): _submit_lock is always taken
+        # OUTSIDE _calls_lock (submit/control register under both);
+        # _calls_lock blocks are snapshot-only — never an await, never
+        # a callback — so the loop thread and submitter threads can
+        # both take it without ordering against the asyncio side.
         self._calls_lock = threading.Lock()
         self._loop = None
         self._thread: Optional[threading.Thread] = None

@@ -147,6 +147,17 @@ def _attach_worker_metrics(agg: Dict[str, int]) -> None:
         trace = tracing.trace_metrics()
         if trace.get("trace_spans_total"):
             agg["trace"] = {"pid": os.getpid(), **trace}
+        # KT_SAN=1: ship this worker's lock-order graph whenever it grew
+        # — the worker dies with the pod's os._exit and cannot reliably
+        # dump its own report, so the pod server merges worker graphs
+        # into its OWN runtime graph and its dump covers both.
+        # sys.modules lookup, not an import: an uninstrumented worker
+        # must not pay the analysis-package import on its first call
+        san = sys.modules.get("kubetorch_tpu.analysis.san")
+        if san is not None and san.active():
+            graph = san.snapshot_graph_if_changed()
+            if graph is not None:
+                agg["san_graph"] = graph
     # ktlint: disable=KT004 -- metrics piggyback must never break a call
     except Exception:
         pass
@@ -541,6 +552,19 @@ def worker_main(request_q, response_q, env: Dict[str, str]):
     """Entrypoint of the spawned process."""
     for key, value in env.items():
         os.environ[key] = str(value)
+    # before any lock is created: a KT_SAN=1 session instruments the
+    # worker too (engine scheduler locks live HERE) — its graph
+    # piggybacks to the pod on call responses (_attach_worker_metrics)
+    # and also dumps via atexit on the graceful-shutdown path. Knob-
+    # gated BEFORE the import: the analysis package costs ~86 ms, which
+    # every uninstrumented worker spawn (incl. restart paths) must not
+    # pay
+    from kubetorch_tpu.config import env_bool
+
+    if env_bool("KT_SAN"):
+        from kubetorch_tpu.analysis import san
+
+        san.install_from_env()
     tracing.set_process_label(
         f"worker-r{os.environ.get('LOCAL_RANK', '0')}")
     # Stream this worker's stdout/stderr/logging to the log sink, labeled

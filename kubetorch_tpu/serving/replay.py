@@ -145,6 +145,12 @@ class ChannelSession:
         # direct/unit construction working)
         self._depth_fn = depth_fn
         self.ws = None
+        # The session's ONLY lock (ktsan-audited): everything else in
+        # this module runs on the single server loop, so mutual
+        # exclusion is the event loop itself; send_lock serializes
+        # whole-frame writes between a live delivery and a replay pass
+        # (asyncio.Lock — holding it across the send await is the
+        # point, and never wraps a sync lock).
         self.send_lock = asyncio.Lock()
         self.fifo: asyncio.Queue = asyncio.Queue()
         self.dispatcher: Optional[asyncio.Task] = None

@@ -31,8 +31,8 @@ from typing import Any, Dict, List, Optional
 from aiohttp import ClientSession, WSMsgType, web
 
 from kubetorch_tpu import serialization
-from kubetorch_tpu.config import (env_float, env_int, env_json, env_path,
-                                  env_set, env_str)
+from kubetorch_tpu.config import (env_bool, env_float, env_int, env_json,
+                                  env_path, env_set, env_str)
 from kubetorch_tpu.exceptions import (
     DeadlineExceeded,
     PodTerminatedError,
@@ -372,6 +372,10 @@ class PodServer:
         if self.terminating:
             return
         self.terminating = True
+        # dump the sanitizer graph NOW, not after the drain: the grace
+        # backstop may os._exit mid-drain and the graph is already
+        # complete at SIGTERM time (the write is milliseconds)
+        self._dump_san_report()
         loop = asyncio.get_event_loop()
         from kubetorch_tpu.resilience.preemption import PreemptionHandler
 
@@ -387,6 +391,22 @@ class PodServer:
 
         loop.create_task(_preempt_then_exit())
         loop.call_later(handler.grace_s, os._exit, 0)
+
+    @staticmethod
+    def _dump_san_report():
+        """KT_SAN=1 pods exit through ``os._exit`` (atexit never runs):
+        flush the sanitizer's lock-order graph explicitly on every
+        deliberate exit path so the session merge sees pod-side edges."""
+        try:
+            from kubetorch_tpu.analysis import san
+            from kubetorch_tpu.config import env_str
+
+            out = env_str("KT_SAN_DIR")
+            if out and san.active():
+                san.dump_report(out)
+        # ktlint: disable=KT004 -- exit path: the dump is best-effort
+        except Exception:  # noqa: BLE001
+            pass
 
     async def _start_app_cmd(self):
         cmd = self.metadata.get("app_cmd")
@@ -507,7 +527,13 @@ class PodServer:
     # group name in a worker's stats dict → metric-name prefix
     _PROC_GROUPS = {"data_store_restore": "data_store_",
                     "data_store": "data_store_", "serving": "",
-                    "trace": "", "reliability": "", "engine": ""}
+                    "trace": "", "reliability": "", "engine": "",
+                    # "resilience" was merged by h_metrics but never
+                    # registered: a pod recording its first preemption/
+                    # emergency-checkpoint tick turned every /metrics
+                    # scrape into a 500 (KeyError) for the rest of the
+                    # drain window — exactly when operators look
+                    "resilience": "", "san": ""}
 
     def _merge_worker_stats(self, stats: Dict[str, Any]):
         """Fold a worker's per-call stats dict into pod metrics. Plain
@@ -519,6 +545,18 @@ class PodServer:
         spans = stats.pop("trace_spans", None)
         if spans:
             tracing.recorder.ingest(spans)
+        san_graph = stats.pop("san_graph", None)
+        if san_graph:
+            # KT_SAN=1: fold the worker's lock-order graph into THIS
+            # process's runtime graph — the pod's exit dump then covers
+            # worker-side edges (workers die with the pod's os._exit)
+            try:
+                from kubetorch_tpu.analysis import san
+
+                san.ingest_graph(san_graph)
+            # ktlint: disable=KT004 -- sanitizer piggyback must never break a call
+            except Exception:  # noqa: BLE001
+                pass
         for group in self._PROC_GROUPS:
             entry = stats.pop(group, None)
             if entry is not None:
@@ -593,6 +631,11 @@ class PodServer:
         resil = prom.resilience_metrics()
         if any(resil.values()):
             self._merge_proc_snapshot("resilience", "server", resil)
+        # Concurrency-sanitizer counters (KT_SAN=1 sessions only): lock
+        # classes tracked, order edges observed, event-loop stalls.
+        san = prom.san_metrics()
+        if any(san.values()):
+            self._merge_proc_snapshot("san", "server", san)
         data = {**self.metrics, "workers_healthy": healthy}
         if prom.wants_prometheus(request):
             # Prometheus/OpenMetrics scrapers (Accept: text/plain...) get
@@ -670,6 +713,7 @@ class PodServer:
         return web.json_response({"reloaded": True, "ready": self.ready})
 
     async def h_teardown(self, request):
+        self._dump_san_report()
         asyncio.get_event_loop().call_later(0.2, os._exit, 0)
         return web.json_response({"terminating": True})
 
@@ -1449,6 +1493,16 @@ class PodServer:
 
 def main():
     import argparse
+
+    # first thing, before the app builds its locks: a KT_SAN=1 session
+    # wants every lock in this pod instrumented and a report dumped to
+    # the inherited KT_SAN_DIR at exit. Knob-gated BEFORE the import:
+    # the analysis package costs ~86 ms, which an uninstrumented pod
+    # (including KT_SAN=0) must not pay at boot
+    if env_bool("KT_SAN"):
+        from kubetorch_tpu.analysis import san
+
+        san.install_from_env()
 
     parser = argparse.ArgumentParser(description="kubetorch_tpu pod server")
     parser.add_argument("--host", default="0.0.0.0")

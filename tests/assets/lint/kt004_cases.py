@@ -54,11 +54,53 @@ def fp_reraise():
         raise  # FP shape: re-raised
 
 
+def tp_return_none():
+    try:
+        return risky()
+    except Exception:
+        return None  # TP: "no answer" hides the failure
+
+
+def tp_return_empty_list():
+    try:
+        return risky()
+    except Exception:
+        return []  # TP: empty-container fallback — the missed shape
+
+
+def tp_return_empty_dict():
+    try:
+        return risky()
+    except Exception:
+        return {}  # TP: ditto
+
+
+def tp_return_empty_ctor():
+    try:
+        return risky()
+    except Exception:
+        return dict()  # TP: spelled as a constructor, same swallow
+
+
 def fp_fallback_work():
     try:
         return risky()
     except Exception:
         return compute_fallback()  # FP shape: real fallback work
+
+
+def fp_nonempty_literal():
+    try:
+        return risky()
+    except Exception:
+        return {"status": "degraded"}  # FP shape: a deliberate answer
+
+
+def fp_fallback_attr(self_obj):
+    try:
+        return risky()
+    except Exception:
+        return self_obj.cached  # FP shape: precomputed fallback
 
 
 def risky():

@@ -1,0 +1,58 @@
+"""ktsan fixture: KT008 — await / blocking call while holding a SYNC lock.
+
+True positives: ``tp_await_under_lock``, ``tp_sleep_under_lock``,
+``tp_blocking_via_callee``. False-positive shapes the rule must NOT
+flag: awaiting with no sync lock held, holding only an ``asyncio.Lock``
+across an await (normal), and ``Condition.wait`` (releases its lock).
+"""
+
+import asyncio
+import threading
+import time
+
+
+class Mixed:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._alock = asyncio.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    async def tp_await_under_lock(self):
+        with self._lock:
+            await asyncio.sleep(0.01)     # KT008: loop stalls on a sync lock
+
+    def tp_sleep_under_lock(self):
+        with self._lock:
+            time.sleep(0.1)               # KT008: contenders stall
+
+    def tp_blocking_via_callee(self):
+        with self._lock:
+            self._sleep_inside()          # KT008 via one-level follow
+
+    def _sleep_inside(self):
+        time.sleep(0.1)
+
+    async def tp_event_wait_under_lock(self):
+        evt = asyncio.Event()
+        with self._lock:
+            await evt.wait()              # KT008: Event.wait releases
+            #                               NOTHING — only a held
+            #                               Condition's wait is exempt
+
+    async def fp_await_no_lock(self):
+        with self._lock:
+            x = 1
+        await asyncio.sleep(x)            # lock released before the await
+
+    async def fp_async_lock_across_await(self):
+        async with self._alock:
+            await asyncio.sleep(0.01)     # asyncio lock: awaiting is normal
+
+    def fp_condition_wait(self):
+        with self._cv:
+            self._cv.wait(timeout=0.1)    # wait() releases the lock
+
+    def fp_suppressed(self):
+        with self._lock:
+            # ktlint: disable=KT008 -- fixture: deliberate, suppressed
+            time.sleep(0.1)

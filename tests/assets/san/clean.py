@@ -1,0 +1,38 @@
+"""ktsan fixture: a disciplined module producing ZERO findings.
+
+One documented lock order (``_meta`` before ``_data``), ``*_locked``
+callees that rely on the caller's hold, blocking work snapshot-then-act
+outside the lock.
+"""
+
+import threading
+import time
+
+
+class Disciplined:
+    def __init__(self):
+        self._meta = threading.Lock()
+        self._data = threading.Lock()
+        self._wake = threading.Condition(self._data)
+        self.rows = {}
+        self.stats = {}
+
+    def update(self, key, value):
+        with self._meta:
+            with self._data:
+                self.rows[key] = value
+                self._bump_locked(key)
+
+    def _bump_locked(self, key):
+        self.stats[key] = self.stats.get(key, 0) + 1
+
+    def snapshot_then_work(self):
+        with self._data:
+            rows = dict(self.rows)
+        time.sleep(0.001)       # blocking AFTER the lock released
+        return rows
+
+    def wait_for_rows(self, timeout=0.1):
+        with self._wake:
+            self._wake.wait(timeout=timeout)
+            return len(self.rows)

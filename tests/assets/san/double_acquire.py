@@ -1,0 +1,42 @@
+"""ktsan fixture: KT009 — double-acquire of a non-reentrant lock.
+
+``tp_via_locked_callee``: a ``*_locked`` callee that RE-ACQUIRES the
+lock its caller holds (the convention says callers hold it).
+``tp_direct_nest``: direct ``with self._lock:`` twice.
+FP shapes: a well-behaved ``*_locked`` callee (no acquire), and RLock
+re-entry (legal).
+"""
+
+import threading
+
+
+class Doubled:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rlock = threading.RLock()
+        self.items = []
+
+    def tp_via_locked_callee(self):
+        with self._lock:
+            self._drain_locked()          # KT009: callee re-acquires
+
+    def _drain_locked(self):
+        with self._lock:                  # WRONG: caller already holds it
+            self.items.clear()
+
+    def tp_direct_nest(self):
+        with self._lock:
+            with self._lock:              # KT009: instant self-deadlock
+                return len(self.items)
+
+    def fp_good_locked_callee(self):
+        with self._lock:
+            self._append_locked(1)        # fine: relies on caller's hold
+
+    def _append_locked(self, x):
+        self.items.append(x)
+
+    def fp_rlock_reentry(self):
+        with self._rlock:
+            with self._rlock:             # RLock: re-entry is the point
+                return len(self.items)

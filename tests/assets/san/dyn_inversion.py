@@ -1,0 +1,36 @@
+"""ktsan fixture: a DYNAMIC-ONLY lock-order cycle.
+
+The locks hide behind dict indirection, so the static resolver sees no
+``with self._x:`` it can name — the static graph has no edges here (a
+deliberate blind spot: prefer false negatives). Under ``KT_SAN=1`` the
+instrumented locks record the real acquisition order, and ``drive()``
+takes them in opposite orders from two threads — the merged graph gets
+the cycle only the runtime can see.
+"""
+
+import threading
+
+
+class HiddenPair:
+    def __init__(self):
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        self.locks = {"a": lock_a, "b": lock_b}
+
+    def take(self, first, second):
+        with self.locks[first]:
+            with self.locks[second]:
+                return f"{first}->{second}"
+
+
+def drive():
+    """Sequentially exercise both orders (two threads, joined — the
+    inversion is observed, never actually deadlocked)."""
+    pair = HiddenPair()
+    t1 = threading.Thread(target=pair.take, args=("a", "b"))
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=pair.take, args=("b", "a"))
+    t2.start()
+    t2.join()
+    return pair

@@ -1,0 +1,41 @@
+"""ktsan fixture: a seeded two-lock inversion the STATIC side must flag.
+
+``fwd`` nests a -> b, ``rev`` nests b -> a: the global order graph has
+the cycle ``A._a -> A._b -> A._a`` (KT010). Nothing here runs.
+"""
+
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def rev(self):
+        with self._b:
+            with self._a:
+                return 2
+
+
+class ConsistentPair:
+    """Same shape, one order everywhere — must NOT be flagged."""
+
+    def __init__(self):
+        self._x = threading.Lock()
+        self._y = threading.Lock()
+
+    def one(self):
+        with self._x:
+            with self._y:
+                return 1
+
+    def two(self):
+        with self._x:
+            with self._y:
+                return 2

@@ -30,6 +30,26 @@ if not _TPU_TIER:
 # Keep test pods/processes off any real TPU tunnel.
 os.environ.setdefault("KT_BACKEND", "local")
 
+# --- concurrency sanitizer (ktsan) -----------------------------------------
+# KT_SAN=1 instruments every repo-created lock in THIS process and — via
+# the inherited env — in every pod/worker subprocess the tests spawn.
+# Each process dumps its lock-order graph into KT_SAN_DIR at exit; the
+# session fixture below merges them, unions the static graph, and fails
+# the run on any lock-order cycle with a rendered path.
+# same truthy set as config.env_bool: pods/workers gate on the typed
+# accessor, and a KT_SAN=true session must not end up with instrumented
+# subprocesses but no test-process install / no session cycle check
+_SAN_ENABLED = os.environ.get("KT_SAN", "").strip().lower() in (
+    "1", "true", "yes", "on")
+if _SAN_ENABLED:
+    import tempfile
+
+    os.environ.setdefault("KT_SAN_DIR",
+                          tempfile.mkdtemp(prefix="ktsan-"))
+    from kubetorch_tpu.analysis import san as _san_mod
+
+    _san_mod.install()
+
 # A sitecustomize may already have imported jax and pointed it at a TPU
 # plugin before this conftest runs; override via the live config too.
 import jax  # noqa: E402
@@ -85,6 +105,81 @@ def _reset_singletons():
     _drop()
     yield
     _drop()
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _san_session_check():
+    """KT_SAN=1: at session end, merge every process's dynamic report
+    with the static lock graph and fail the run on any lock-order
+    cycle. Session-fixture teardown (not sessionfinish) so the failure
+    carries a normal pytest error + nonzero exit."""
+    yield
+    if not _SAN_ENABLED:
+        return
+    from kubetorch_tpu.analysis import san as san_mod
+
+    report = san_mod.session_check(os.environ["KT_SAN_DIR"])
+    assert report is None, "\n" + report
+
+
+# Long-lived singletons a module may legitimately leave behind: the
+# shared actor-mesh fan-out pool (one per process by design) and the
+# jax compilation-cache writer threads.
+_LEAK_ALLOW = ("kt-actor-mesh",)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _thread_leak_guard(request):
+    """No non-daemon thread may survive a test module (KT_SAN_LEAKS=0
+    to disable). Catches the leaked-driver/leaked-pusher bug class:
+    a forgotten engine driver or log-push executor keeps the whole
+    pytest process alive at exit and bleeds CPU into every later
+    module. Daemon threads are exempt (they can't hang exit); known
+    long-lived singletons are allowlisted by name."""
+    from kubetorch_tpu.config import env_bool
+
+    # the typed accessor: KT_SAN_LEAKS is a registered bool knob, so
+    # every documented spelling (0/false/no/off) disables the guard
+    if not env_bool("KT_SAN_LEAKS"):
+        yield
+        return
+    import threading
+    import time as _time
+
+    # hold the Thread OBJECTS, not ids: a pre-existing thread's object
+    # can be garbage-collected mid-module and a leaked thread allocated
+    # at the recycled address would slip the guard
+    before = set(threading.enumerate())
+    yield
+
+    def leaked():
+        return [t for t in threading.enumerate()
+                if t.is_alive() and not t.daemon
+                and t not in before
+                and t is not threading.main_thread()
+                and not any(t.name.startswith(p) for p in _LEAK_ALLOW)]
+
+    # teardown grace: executors and drivers that were just shut down may
+    # need a beat to exit
+    deadline = _time.time() + 2.0
+    cur = leaked()
+    while cur and _time.time() < deadline:
+        _time.sleep(0.05)
+        cur = leaked()
+    if cur:
+        try:
+            from kubetorch_tpu.observability import prometheus as prom
+
+            prom.record_san("thread_leak", len(cur))
+        except Exception:
+            pass
+        names = sorted(t.name for t in cur)
+        raise AssertionError(
+            f"non-daemon thread(s) leaked by {request.module.__name__}: "
+            f"{names} — join/shutdown them in teardown, mark them "
+            f"daemon if they are best-effort, or allowlist a known "
+            f"singleton in conftest._LEAK_ALLOW (KT_SAN_LEAKS=0 "
+            f"disables this guard)")
 
 
 def pytest_addoption(parser):

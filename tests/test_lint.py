@@ -85,9 +85,12 @@ def names_on_lines(path: Path, findings):
       "tp_indirect_constant", "tp_contains"},
      {"fp_non_kt_read", "fp_write", "tp_suppressed"}),
     ("kt004_cases.py", "KT004",
-     {"tp_silent_pass", "tp_bare_except"},
+     {"tp_silent_pass", "tp_bare_except", "tp_return_none",
+      "tp_return_empty_list", "tp_return_empty_dict",
+      "tp_return_empty_ctor"},
      {"fp_narrow_type", "fp_logged", "fp_counted", "fp_reraise",
-      "fp_fallback_work", "tp_suppressed"}),
+      "fp_fallback_work", "fp_nonempty_literal", "fp_fallback_attr",
+      "tp_suppressed"}),
     ("kt005_cases.py", "KT005",
      {"tp_unguarded"},
      {"fp_reset_locked", "fp_other_field", "bump", "__init__"}),

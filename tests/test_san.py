@@ -1,0 +1,456 @@
+"""ktsan: the concurrency-sanitizer gate (tier-1).
+
+Four layers:
+
+1. **Static fixtures** (``tests/assets/san/``): a seeded two-lock
+   inversion the static side must flag (KT010), await/blocking-under-
+   sync-lock shapes (KT008), double-acquire shapes (KT009), a clean
+   module producing zero findings, and a dynamic-only inversion the
+   static side must NOT flag.
+2. **Dynamic runtime**: in-process install/uninstall with held-set and
+   edge recording; a subprocess driving the hidden inversion under
+   ``KT_SAN=1`` whose atexit report the merger unions into a detected
+   cycle; the event-loop stall detector.
+3. **The gate**: the whole package analyzes in <10 s with zero
+   non-baselined findings and no lock-order cycles, twice, emitting
+   byte-identical JSON (determinism).
+4. **Dynamic smoke**: a server-heavy test subset runs green under
+   ``KT_SAN=1`` with pod + test-process reports dumped and merged, and
+   the thread-leak guard (a subprocess pytest with a deliberately
+   leaked non-daemon thread) fails with the rendered message.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import pytest
+
+from kubetorch_tpu.analysis import san
+from kubetorch_tpu.analysis import baseline as baseline_mod
+from kubetorch_tpu.analysis.engine import LintConfig, load_lint_config
+from kubetorch_tpu.analysis.lockgraph import (
+    DYNAMIC,
+    LockGraph,
+    LockInfo,
+    Witness,
+)
+from kubetorch_tpu.analysis.san import (
+    SAN_RULE_DOCS,
+    build_static,
+    collect_lock_defs,
+    cycle_findings,
+    run_san,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+ASSETS = Path(__file__).resolve().parent / "assets" / "san"
+
+pytestmark = pytest.mark.level("unit")
+
+
+def san_path(path: Path):
+    cfg = LintConfig(root=REPO, paths=[str(path)])
+    return run_san(cfg, static_only=True, apply_baseline=False)
+
+
+def names_on_lines(path: Path, findings):
+    src = path.read_text().splitlines()
+    out = set()
+    for f in findings:
+        for i in range(f.line - 1, -1, -1):
+            line = src[i]
+            stripped = line.strip()
+            if stripped.startswith(("def ", "async def ")) and (
+                    line.startswith(("def ", "async def ", "    def ",
+                                     "    async def "))):
+                out.add(stripped.split("(")[0].split()[-1])
+                break
+    return out
+
+
+# ------------------------------------------------------------ lock model
+def test_lock_identity_resolution(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+        import asyncio
+
+        GLOBAL_LOCK = threading.Lock()
+
+        class C:
+            _class_lock = threading.Lock()
+
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._wake = threading.Condition(self._lock)
+                self._alock = asyncio.Lock()
+    """))
+    cfg = LintConfig(root=tmp_path, paths=[str(mod)])
+    from kubetorch_tpu.analysis.engine import FileContext
+
+    ctx = FileContext(mod, "m.py", mod.read_text(), cfg)
+    locks = collect_lock_defs(ctx)
+    assert locks.module_names["GLOBAL_LOCK"] == "m.py::GLOBAL_LOCK"
+    assert ("C", "_class_lock") in locks.class_attrs
+    assert locks.infos["m.py::C._lock"].kind == "RLock"
+    assert locks.infos["m.py::C._alock"].kind == "AsyncLock"
+    # Condition(self._lock) aliases to the wrapped lock's identity
+    assert locks.aliases["m.py::C._wake"] == "m.py::C._lock"
+
+
+# --------------------------------------------------------- static: KT010
+def test_static_catches_seeded_inversion():
+    result = san_path(ASSETS / "inversion_static.py")
+    kt010 = [f for f in result.findings if f.rule == "KT010"]
+    assert len(kt010) == 1, [str(f) for f in result.findings]
+    f = kt010[0]
+    assert "Inverted._a" in f.snippet and "Inverted._b" in f.snippet
+    # the rendered path names both witnessing sites
+    assert "in Inverted.fwd [static]" in f.message
+    assert "in Inverted.rev [static]" in f.message
+    # the consistently-ordered pair is NOT in any cycle
+    assert "ConsistentPair" not in f.message
+    assert not any("ConsistentPair" in f.snippet for f in result.findings)
+
+
+def test_static_silent_on_dynamic_only_fixture():
+    result = san_path(ASSETS / "dyn_inversion.py")
+    assert result.findings == [], [str(f) for f in result.findings]
+
+
+# --------------------------------------------------------- static: KT008
+def test_kt008_fixture_shapes():
+    path = ASSETS / "await_under_lock.py"
+    result = san_path(path)
+    kt008 = [f for f in result.findings if f.rule == "KT008"]
+    hit = names_on_lines(path, kt008)
+    expected = {"tp_await_under_lock", "tp_sleep_under_lock",
+                "tp_blocking_via_callee", "tp_event_wait_under_lock"}
+    assert expected <= hit, f"KT008 missed: {expected - hit}"
+    forbidden = {"fp_await_no_lock", "fp_async_lock_across_await",
+                 "fp_condition_wait", "fp_suppressed", "_sleep_inside"}
+    assert not (hit & forbidden), f"KT008 false positives: {hit & forbidden}"
+    assert {f.rule for f in result.findings} == {"KT008"}
+
+
+# --------------------------------------------------------- static: KT009
+def test_kt009_fixture_shapes():
+    path = ASSETS / "double_acquire.py"
+    result = san_path(path)
+    kt009 = [f for f in result.findings if f.rule == "KT009"]
+    hit = names_on_lines(path, kt009)
+    assert {"tp_via_locked_callee", "tp_direct_nest"} <= hit
+    forbidden = {"fp_good_locked_callee", "fp_rlock_reentry",
+                 "_append_locked"}
+    assert not (hit & forbidden), f"KT009 false positives: {hit & forbidden}"
+
+
+def test_clean_fixture_zero_findings():
+    result = san_path(ASSETS / "clean.py")
+    assert result.findings == [], [str(f) for f in result.findings]
+
+
+# ----------------------------------------------------- cycles + baseline
+def test_cycle_finding_baseline_is_line_shift_proof():
+    g = LockGraph()
+    g.add_lock(LockInfo("m.py::A._a", "Lock", "m.py", 3))
+    g.add_lock(LockInfo("m.py::A._b", "Lock", "m.py", 4))
+    g.add_edge("m.py::A._a", "m.py::A._b", Witness("m.py", 10, "fwd"))
+    g.add_edge("m.py::A._b", "m.py::A._a", Witness("m.py", 20, "rev"))
+    findings = cycle_findings(g)
+    assert len(findings) == 1
+    base = {baseline_mod.finding_key(findings[0]): 1}
+    # shift every line: the signature snippet (no line numbers) matches
+    g2 = LockGraph()
+    g2.add_lock(LockInfo("m.py::A._a", "Lock", "m.py", 30))
+    g2.add_lock(LockInfo("m.py::A._b", "Lock", "m.py", 40))
+    g2.add_edge("m.py::A._a", "m.py::A._b", Witness("m.py", 100, "fwd"))
+    g2.add_edge("m.py::A._b", "m.py::A._a", Witness("m.py", 200, "rev"))
+    new, matched = baseline_mod.split(cycle_findings(g2), base)
+    assert new == [] and len(matched) == 1
+
+
+def test_cycle_canonicalization_and_merge():
+    g = LockGraph()
+    g.add_edge("b", "a", Witness("x.py", 1, "f"))
+    g.add_edge("a", "b", Witness("x.py", 2, "g"))
+    assert g.cycles() == [["a", "b"]]          # rotated: smallest first
+    other = LockGraph()
+    other.add_edge("b", "c", Witness("y.py", 3, "h", DYNAMIC))
+    g.merge(other)
+    assert ("b", "c") in g.edges
+    # self-edges are dropped at the graph layer (KT009's job)
+    g.add_edge("a", "a", Witness("x.py", 9, "z"))
+    assert ("a", "a") not in g.edges
+
+
+# ----------------------------------------------------------- determinism
+def test_two_static_runs_emit_identical_json():
+    cfg = load_lint_config(REPO)
+    r1 = run_san(cfg, static_only=True, apply_baseline=False)
+    r2 = run_san(cfg, static_only=True, apply_baseline=False)
+    j1 = json.dumps({"findings": [f.to_dict() for f in r1.findings],
+                     "graph": r1.graph.to_dict()}, sort_keys=True)
+    j2 = json.dumps({"findings": [f.to_dict() for f in r2.findings],
+                     "graph": r2.graph.to_dict()}, sort_keys=True)
+    assert j1 == j2
+
+
+# ------------------------------------------------------------------ gate
+def test_gate_package_clean_under_10s():
+    t0 = time.perf_counter()
+    cfg = load_lint_config(REPO)
+    result = run_san(cfg, static_only=True)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 10.0, f"san took {elapsed:.1f}s (budget 10s)"
+    assert not result.errors, result.errors
+    assert result.cycles == [], (
+        "lock-order cycle(s) in the package:\n"
+        + "\n".join(result.graph.render_cycle(c) for c in result.cycles))
+    assert result.findings == [], (
+        "non-baselined san findings:\n"
+        + "\n".join(str(f) for f in result.findings))
+    # the two audited orderings stay on the graph as documentation
+    edges = set(result.graph.edges)
+    assert ("kubetorch_tpu/serving/engine.py::DecodeEngine._offload_lock",
+            "kubetorch_tpu/serving/engine.py::DecodeEngine._wake") in edges
+
+
+def test_rule_docs_cover_san_rules():
+    assert set(SAN_RULE_DOCS) == {"KT008", "KT009", "KT010"}
+    for code, (name, doc) in SAN_RULE_DOCS.items():
+        assert name and len(doc) > 40
+
+
+# ------------------------------------------------------- dynamic runtime
+@pytest.fixture
+def san_runtime(monkeypatch):
+    """In-process install with guaranteed uninstall. Skips (rather than
+    double-installs) when the session itself runs under KT_SAN=1."""
+    if san.active():
+        yield san
+        return
+    assert san.install()
+    try:
+        yield san
+    finally:
+        san.uninstall()
+
+
+def test_dynamic_records_inversion_in_process(san_runtime):
+    sys.path.insert(0, str(ASSETS))
+    try:
+        import dyn_inversion
+        dyn_inversion.drive()
+    finally:
+        sys.path.remove(str(ASSETS))
+    g = san.runtime_graph()
+    ab = ("tests/assets/san/dyn_inversion.py:16",
+          "tests/assets/san/dyn_inversion.py:17")
+    assert ab in g.edges and (ab[1], ab[0]) in g.edges
+    wit = g.edges[ab][0]
+    assert wit.kind == DYNAMIC and wit.path.endswith("dyn_inversion.py")
+    assert [c for c in g.cycles()
+            if "dyn_inversion" in c[0]], "runtime cycle not detected"
+
+
+def test_dynamic_rlock_reentry_no_false_edge(san_runtime):
+    # exercise the repo's own Condition-over-Lock idiom via the fixture
+    sys.path.insert(0, str(ASSETS))
+    try:
+        import clean
+        d = clean.Disciplined()
+        d.update("k", 1)
+        d.wait_for_rows(timeout=0.01)
+        d.snapshot_then_work()
+    finally:
+        sys.path.remove(str(ASSETS))
+    g = san.runtime_graph()
+    # meta->data observed; data->meta never
+    meta = "tests/assets/san/clean.py:14"
+    data = "tests/assets/san/clean.py:15"
+    assert (meta, data) in g.edges
+    assert (data, meta) not in g.edges
+
+
+def test_worker_graph_piggyback_roundtrip(san_runtime):
+    """Workers can't dump on the pod's os._exit: their graph ships on
+    call responses and merges into the pod's runtime graph. Pin the
+    snapshot-if-changed contract (None when nothing grew) and the
+    ingest merge."""
+    sys.path.insert(0, str(ASSETS))
+    try:
+        import dyn_inversion
+        dyn_inversion.drive()
+    finally:
+        sys.path.remove(str(ASSETS))
+    snap = san.snapshot_graph_if_changed()
+    assert snap is not None and snap["edges"], "graph snapshot empty"
+    assert san.snapshot_graph_if_changed() is None  # unchanged → no ship
+    before = len(san.runtime_graph().edges)
+    assert san.ingest_graph(snap)                   # pod-side merge
+    assert len(san.runtime_graph().edges) >= before
+
+
+def test_stall_detector(san_runtime):
+    import asyncio
+
+    async def main():
+        time.sleep((san._rt.stall_ms + 60) / 1000.0)
+
+    before = san._rt.stall_count
+    asyncio.run(main())
+    assert san._rt.stall_count > before
+
+
+def test_subprocess_report_merge_detects_planted_cycle(tmp_path):
+    """The full dynamic pipeline: a subprocess drives the hidden
+    inversion under KT_SAN=1, its atexit hook dumps the report, the
+    merger unions it with the static graph, cycle detection fires."""
+    env = dict(os.environ, KT_SAN="1", KT_SAN_DIR=str(tmp_path),
+               PYTHONPATH=str(REPO))
+    code = textwrap.dedent(f"""
+        import sys
+        from kubetorch_tpu.analysis import san
+        assert san.install_from_env()
+        sys.path.insert(0, {str(ASSETS)!r})
+        import dyn_inversion
+        dyn_inversion.drive()
+    """)
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    reports = list(tmp_path.glob("san-*.json"))
+    assert len(reports) == 1, "atexit dump missing"
+    data = json.loads(reports[0].read_text())
+    assert data["acquires"] >= 4
+    report = san.session_check(str(tmp_path), include_static=False)
+    assert report is not None and "lock-order cycle" in report
+    assert "dyn_inversion.py" in report
+
+
+# --------------------------------------------------------- dynamic smoke
+def test_dynamic_smoke_server_heavy_under_san(tmp_path):
+    """A server-heavy test subset (real pod subprocess + channel) runs
+    green under KT_SAN=1: the instrumented session must not deadlock,
+    must dump reports from the test process AND the pod, and the merged
+    session graph must be cycle-free."""
+    env = dict(os.environ, KT_SAN="1", KT_SAN_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    env.pop("KT_SAN_LEAKS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         "tests/test_call_channel.py", "-q", "-p", "no:cacheprovider",
+         "-k", "basic or fifo or concurrent or reconnects"],
+        cwd=str(REPO), env=env, capture_output=True, text=True,
+        timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    reports = [json.loads(p.read_text())
+               for p in tmp_path.glob("san-*.json")]
+    assert reports, "no dynamic reports dumped"
+    total_locks = sum(len(r["graph"]["locks"]) for r in reports)
+    assert total_locks > 0, "instrumented session tracked no repo locks"
+    # the channel client's documented submit->calls lock order shows up
+    merged, n = san.merge_reports(str(tmp_path))
+    assert n == len(reports)
+    assert merged.cycles() == [], "\n".join(
+        merged.render_cycle(c) for c in merged.cycles())
+
+
+def test_thread_leak_guard_catches_leak(tmp_path):
+    """The conftest module-scoped guard fails a module that leaves a
+    non-daemon thread behind, naming the thread."""
+    conftest = tmp_path / "conftest.py"
+    conftest.write_text(textwrap.dedent(f"""
+        import importlib.util
+
+        _spec = importlib.util.spec_from_file_location(
+            "repo_conftest", {str(REPO / 'tests' / 'conftest.py')!r})
+        _repo_conftest = importlib.util.module_from_spec(_spec)
+        _spec.loader.exec_module(_repo_conftest)
+        _thread_leak_guard = _repo_conftest._thread_leak_guard
+    """))
+    leaky = tmp_path / "test_leaky.py"
+    leaky.write_text(textwrap.dedent("""
+        import threading
+        import time
+
+        def test_leaves_thread():
+            threading.Thread(target=time.sleep, args=(5.0,),
+                             name="kt-leaky-driver").start()
+    """))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("KT_SAN_LEAKS", None)
+    env["PYTHONPATH"] = str(REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", str(leaky), "-q",
+         "-p", "no:cacheprovider"],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=180)
+    assert proc.returncode != 0, "leak guard did not fail the module"
+    assert "kt-leaky-driver" in proc.stdout
+    assert "non-daemon thread(s) leaked" in proc.stdout
+
+
+# ------------------------------------------------- surfaced-defect fixes
+def test_every_merged_metric_group_is_registered():
+    """Regression for the defect the instrumented session surfaced: pod
+    `/metrics` merged the "resilience" (and now "san") group without
+    registering it in ``_PROC_GROUPS`` — the first recorded tick turned
+    every scrape into a 500 KeyError, exactly during a preemption drain.
+    Statically pin that every literal group name passed to
+    ``_merge_proc_snapshot`` is registered."""
+    import ast as ast_mod
+
+    src = (REPO / "kubetorch_tpu" / "serving" / "server.py").read_text()
+    tree = ast_mod.parse(src)
+    groups, used = set(), set()
+    for node in ast_mod.walk(tree):
+        if isinstance(node, ast_mod.Assign):
+            for tgt in node.targets:
+                if getattr(tgt, "id", "") == "_PROC_GROUPS" and \
+                        isinstance(node.value, ast_mod.Dict):
+                    groups = {k.value for k in node.value.keys
+                              if isinstance(k, ast_mod.Constant)}
+        if isinstance(node, ast_mod.Call) and isinstance(
+                node.func, ast_mod.Attribute) and \
+                node.func.attr == "_merge_proc_snapshot" and node.args:
+            first = node.args[0]
+            if isinstance(first, ast_mod.Constant):
+                used.add(first.value)
+    assert groups, "_PROC_GROUPS not found"
+    missing = used - groups
+    assert not missing, (
+        f"groups merged by h_metrics but not registered in "
+        f"_PROC_GROUPS (scrape 500s on first tick): {missing}")
+    assert {"resilience", "san"} <= groups
+
+
+# --------------------------------------------------------------- the CLI
+def test_cli_san_json_and_exit_codes(tmp_path):
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubetorch_tpu.cli", "san",
+         "--static-only", "--json"],
+        cwd=str(REPO), env=env, capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert data["findings"] == [] and data["cycles"] == []
+    assert data["locks"] > 20 and data["edges"] >= 2
+    # a seeded inversion makes the CLI exit 1 with the rendered cycle
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubetorch_tpu.cli", "san",
+         "--static-only", "--no-baseline",
+         str(ASSETS / "inversion_static.py")],
+        cwd=str(REPO), env=env, capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode == 1
+    assert "lock-order cycle" in proc.stdout
