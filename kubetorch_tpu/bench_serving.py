@@ -1050,6 +1050,111 @@ def bench_prefix_kv(n_programs: int = 8, prefix_len: int = 64,
     return out
 
 
+def bench_telemetry(frames: int = 200, n_metrics: int = 80,
+                    n_hists: int = 3, n_objectives: int = 4,
+                    dryrun: bool = False) -> dict:
+    """Fleet telemetry plane cost: what the heartbeat piggyback adds to
+    a beat tick (frame build on the pod + ingest at the controller),
+    and what one SLO evaluation sweep costs — both CI-guarded
+    (``tests/test_serving_smoke.py``): the piggyback must stay <3 % of
+    a heartbeat tick, or telemetry is taxing liveness.
+
+    Dryrun and full runs share the shape (pure CPU, in-process
+    FleetStore + SLOEngine at a representative pod profile: ~80 flat
+    metrics + 3 histogram families x 13 buckets, two replicas)."""
+    import time as _time
+
+    from kubetorch_tpu.config import env_float as _env_float
+    from kubetorch_tpu.observability.fleetstore import (
+        FleetStore,
+        build_frame,
+    )
+    from kubetorch_tpu.observability.slo import Objective, SLOEngine
+
+    if dryrun:
+        frames = min(frames, 120)
+    heartbeat_s = _env_float("KT_HEARTBEAT_S")
+    store = FleetStore()
+    objectives = [
+        Objective(service="bench", name=f"slo{i}", kind="latency",
+                  metric="h0", threshold_ms=250.0, objective=0.99)
+        for i in range(max(1, n_objectives - 1))
+    ] + [Objective(service="bench", name="shed", kind="ratio",
+                   bad="engine_sheds_bench_total",
+                   total="engine_calls_bench_total", objective=0.98)]
+    slo = SLOEngine(store, objectives=objectives)
+
+    # representative pod metric surface: counters climb monotonically,
+    # gauges wander, histograms accumulate
+    les = [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+           1.0, 2.5, 10.0, 30.0]
+
+    def pod_state(step, pod_seed):
+        metrics = {}
+        for i in range(n_metrics):
+            name = (f"engine_m{i}_total" if i % 2 == 0
+                    else f"engine_g{i}")
+            metrics[name] = (step * (i + 1) if i % 2 == 0
+                             else (step + pod_seed) % 17)
+        metrics["engine_sheds_bench_total"] = step
+        metrics["engine_calls_bench_total"] = step * 50
+        hists = {}
+        for j in range(n_hists):
+            count = step * 10.0
+            buckets = [count * min(1.0, (k + 1) / len(les))
+                       for k in range(len(les))]
+            hists[f"h{j}"] = {"le": les, "buckets": buckets,
+                              "sum": count * 0.05, "count": count}
+        return metrics, hists
+
+    import json as _json
+
+    build_s = 0.0
+    ingest_s = 0.0
+    bytes_total = 0
+    last_sent = [{}, {}]
+    for step in range(1, frames + 1):
+        for pod in (0, 1):
+            metrics, hists = pod_state(step, pod)
+            t0 = _time.perf_counter()
+            frame = build_frame(metrics, hists,
+                                last_sent=last_sent[pod],
+                                full=(step == 1))
+            build_s += _time.perf_counter() - t0
+            bytes_total += len(_json.dumps(frame))
+            t0 = _time.perf_counter()
+            store.ingest("bench", f"pod-{pod}", frame)
+            ingest_s += _time.perf_counter() - t0
+    n = frames * 2
+    t0 = _time.perf_counter()
+    slo.evaluate()
+    eval_1 = (_time.perf_counter() - t0) * 1e3
+    t0 = _time.perf_counter()
+    reps = 5
+    for _ in range(reps):
+        slo.evaluate()
+    eval_ms = ((_time.perf_counter() - t0) * 1e3) / reps
+    per_frame_s = (build_s + ingest_s) / n
+    out = {
+        "telemetry_frames": n,
+        "telemetry_frame_bytes_avg": round(bytes_total / n, 1),
+        "telemetry_build_us_per_frame": round(build_s / n * 1e6, 2),
+        "telemetry_ingest_us_per_frame": round(ingest_s / n * 1e6, 2),
+        # the acceptance number: pod-side build + controller-side
+        # ingest of ONE frame, as a percentage of one heartbeat tick
+        "telemetry_ingest_overhead_pct": round(
+            per_frame_s / heartbeat_s * 100.0, 4),
+        "slo_eval_ms": round(eval_ms, 3),
+        "slo_eval_first_ms": round(eval_1, 3),
+        "slo_objectives": len(objectives),
+    }
+    assert out["telemetry_ingest_overhead_pct"] < 3.0, (
+        f"telemetry piggyback costs "
+        f"{out['telemetry_ingest_overhead_pct']}% of a heartbeat tick "
+        f"(bound: 3%)")
+    return out
+
+
 def run(dryrun: bool = False, static_tok_s: float = 5673.0) -> dict:
     """Full serving bench. ``dryrun`` (CI smoke) runs only the
     call-tunnel phase at toy sizes — the model phases need a chip-scale
@@ -1061,6 +1166,7 @@ def run(dryrun: bool = False, static_tok_s: float = 5673.0) -> dict:
         out = bench_call_channel(dryrun=True)
         out.update(bench_engine(dryrun=True))
         out.update(bench_prefix_kv(dryrun=True))
+        out.update(bench_telemetry(dryrun=True))
         return out
     out = bench_8b_rolling(static_tok_s=static_tok_s) or {}
     if out:
@@ -1088,6 +1194,8 @@ def run(dryrun: bool = False, static_tok_s: float = 5673.0) -> dict:
             step_ms=out["ms_per_step_device"] * out["steps_per_call"],
             park_step_ms=out["ms_per_step_device"]
             * out["steps_per_call"]))
+        # fleet telemetry plane cost at full-frame count
+        out.update(bench_telemetry())
     return out
 
 

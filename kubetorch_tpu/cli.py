@@ -524,6 +524,174 @@ def trace(service, last, trace_id, out):
                    f"{row['max_ms']:>10}")
 
 
+# ---------------------------------------------------------------- top
+def _top_gather(controller, service, window):
+    """One snapshot of the fleet/SLO state ``ktpu top`` renders: per
+    service, the cross-pod rollup (per-replica rows) + SLO status."""
+    if service:
+        services = [service]
+    else:
+        services = sorted({p.get("service_name", "")
+                           for p in controller.list_pools()} - {""})
+    out = {}
+    for svc in services:
+        entry = {"fleet": None, "slo": []}
+        try:
+            entry["fleet"] = controller.fleet_metrics(svc, window=window)
+        except Exception as exc:  # noqa: BLE001 — render what answered
+            entry["error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            entry["slo"] = (controller.slo_status(svc)
+                            or {}).get("objectives") or []
+        except Exception:  # noqa: BLE001 — SLOs may be unconfigured
+            entry["slo"] = []
+        out[svc] = entry
+    return out
+
+
+def _top_rows(fleet):
+    """Per-replica rows from a fleet rollup: (pod, occupancy, queue,
+    kv blocks, tok/s, ttft p99 ms, status)."""
+    gauges = fleet.get("gauges") or {}
+    counters = fleet.get("counters") or {}
+    hists = fleet.get("histograms") or {}
+
+    def by_pod(family, name, pod):
+        return ((family.get(name) or {}).get("by_pod") or {}).get(pod)
+
+    rows = []
+    for pod, meta in sorted((fleet.get("pods") or {}).items()):
+        active = by_pod(gauges, "engine_active_rows", pod)
+        free = by_pod(gauges, "engine_free_rows", pod)
+        occ = "—"
+        if active is not None and free is not None and active + free > 0:
+            occ = f"{active:g}/{active + free:g}"
+        queue = by_pod(gauges, "engine_queue_depth", pod)
+        kv = by_pod(gauges, "kv_blocks_used", pod)
+        tok_s = by_pod(counters, "engine_tokens_total", pod)
+        p99 = ((hists.get("engine_ttft_seconds") or {})
+               .get("by_pod_p99") or {}).get(pod)
+        if meta.get("stale"):
+            status = f"stale {meta.get('age_s', '?')}s"
+        elif meta.get("last_reset_age_s") is not None \
+                and meta["last_reset_age_s"] < 120:
+            status = f"reset {meta['last_reset_age_s']:.0f}s ago"
+        else:
+            status = "ok"
+        rows.append((pod, occ,
+                     f"{queue:g}" if queue is not None else "—",
+                     f"{kv:g}" if kv is not None else "—",
+                     f"{tok_s:.1f}" if tok_s is not None else "—",
+                     f"{p99 * 1e3:.0f}" if p99 is not None else "—",
+                     status))
+    return rows
+
+
+def _top_render(snapshot, window):
+    lines = []
+    for svc, entry in snapshot.items():
+        slo_bits = []
+        for obj in entry.get("slo") or []:
+            state = "BREACH" if obj.get("breached") else "ok"
+            slo_bits.append(
+                f"{obj.get('name')}={state} "
+                f"burn={obj.get('burn_rate', 0):g}x "
+                f"budget={obj.get('error_budget_remaining', 1):g}")
+        lines.append(f"{svc}  (window {window:g}s)"
+                     + (f"  SLO: {'; '.join(slo_bits)}" if slo_bits
+                        else ""))
+        if entry.get("error"):
+            lines.append(f"  error: {entry['error']}")
+            continue
+        fleet = entry.get("fleet")
+        if not fleet or not fleet.get("pods"):
+            lines.append("  (no telemetry yet)")
+            continue
+        lines.append(f"  {'replica':<28}{'rows':>9}{'queue':>7}"
+                     f"{'kv blk':>8}{'tok/s':>9}{'ttft p99':>10}"
+                     f"  status")
+        for row in _top_rows(fleet):
+            pod, occ, queue, kv, tok_s, p99, status = row
+            lines.append(f"  {pod:<28}{occ:>9}{queue:>7}{kv:>8}"
+                         f"{tok_s:>9}{p99:>10}  {status}")
+    return "\n".join(lines) if lines else "(no services)"
+
+
+@main.command()
+@click.argument("service", required=False)
+@click.option("--once", is_flag=True,
+              help="print one snapshot and exit (default: live view)")
+@click.option("--json", "as_json", is_flag=True,
+              help="machine-readable snapshot (implies --once)")
+@click.option("--interval", type=float, default=2.0,
+              help="refresh interval of the live view (seconds)")
+@click.option("--window", type=float, default=30.0,
+              help="rollup window for rates/quantiles (seconds)")
+def top(service, once, as_json, interval, window):
+    """Live fleet view over the controller's telemetry plane: one row
+    per replica (row occupancy, queue depth, KV blocks, tok/s, TTFT
+    p99) plus each service's SLO burn state. ``--once --json`` is the
+    scripting form."""
+    from kubetorch_tpu.controller.client import ControllerClient
+
+    controller = ControllerClient.maybe()
+    if controller is None:
+        raise click.ClickException(
+            "ktpu top needs a controller (KT_CONTROLLER_URL / "
+            "ktpu config controller_url=http://...)")
+    if as_json:
+        click.echo(json.dumps(_top_gather(controller, service, window),
+                              indent=2))
+        return
+    if once:
+        click.echo(_top_render(_top_gather(controller, service, window),
+                               window))
+        return
+    import time as _time
+
+    try:
+        while True:
+            snapshot = _top_gather(controller, service, window)
+            click.echo("\x1b[2J\x1b[H", nl=False)  # clear + home
+            click.echo(f"ktpu top — {controller.base_url}  "
+                       f"(refresh {interval:g}s, Ctrl-C to exit)")
+            click.echo(_top_render(snapshot, window))
+            _time.sleep(max(0.2, interval))
+    except KeyboardInterrupt:
+        pass
+
+
+# ---------------------------------------------------------------- metrics
+@main.command("metrics")
+@click.option("--gen-docs", is_flag=True,
+              help="Regenerate the metric tables in "
+                   "docs/observability.md from the registry")
+@click.option("--json", "as_json", is_flag=True,
+              help="dump the registry as JSON")
+@click.option("--group", default=None,
+              help="restrict listing to one group")
+def metrics_cmd(gen_docs, as_json, group):
+    """The metric registry: every family the project exports (name,
+    type, help, group — the source of `# HELP` exposition lines and
+    the observability.md tables)."""
+    from kubetorch_tpu.observability import registry
+
+    if gen_docs:
+        path = registry.write_metric_docs()
+        click.echo(f"wrote {path}")
+        return
+    mets = list(registry.iter_metrics(group))
+    if as_json:
+        click.echo(json.dumps(
+            [{"name": m.name, "type": m.type, "help": m.help,
+              "group": m.group} for m in mets], indent=2))
+        return
+    for m in mets:
+        click.echo(f"{m.group:<12}{m.type:<11}kubetorch_{m.name}")
+    click.echo(f"({len(mets)} families; `ktpu metrics --gen-docs` "
+               f"regenerates docs/observability.md tables)")
+
+
 @main.command()
 @click.argument("service")
 @click.option("--pod", type=int, default=None,

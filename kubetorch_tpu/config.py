@@ -443,6 +443,47 @@ _knob("KT_PUSH_TIMEOUT", "float", 5.0,
       "heartbeat POST fallback) so a hung controller cannot delay the "
       "SIGTERM drain.", "observability")
 
+# --- fleet telemetry plane (controller-resident time series) ----------------
+_knob("KT_TELEMETRY_EVERY", "int", 1,
+      "Piggyback a metric delta frame on every Nth liveness heartbeat "
+      "(1 = every beat; 0 disables telemetry emission entirely).",
+      "fleet")
+_knob("KT_TELEMETRY_FULL_EVERY", "int", 20,
+      "Every Nth telemetry frame is a full snapshot instead of a "
+      "changed-keys delta, so a restarted controller converges without "
+      "waiting for every counter to move.", "fleet")
+_knob("KT_FLEET_RAW_S", "float", 120.0,
+      "Seconds of raw (per-frame) samples the controller's fleet store "
+      "retains per (service, pod, metric) before only downsampled "
+      "tiers remain.", "fleet")
+_knob("KT_FLEET_MID_S", "float", 900.0,
+      "Retention of the 10 s downsampled tier.", "fleet")
+_knob("KT_FLEET_RETAIN_S", "float", 3600.0,
+      "Retention of the 1 m downsampled tier — the fleet store's total "
+      "lookback for range queries and slow SLO windows.", "fleet")
+_knob("KT_FLEET_STALE_S", "float", 30.0,
+      "A pod whose last telemetry frame is older than this is marked "
+      "stale: excluded from fleet gauge rollups and flagged in "
+      "/metrics/fleet and the dashboard.", "fleet")
+
+# --- SLO burn-rate engine ---------------------------------------------------
+_knob("KT_SLO", "json", None,
+      "Declarative SLO objectives as a JSON list, e.g. "
+      '[{"service": "svc", "name": "ttft", "kind": "latency", '
+      '"metric": "engine_ttft_seconds", "threshold_ms": 500, '
+      '"objective": 0.99}]; evaluated by the controller\'s burn-rate '
+      "loop (see docs/observability.md).", "slo")
+_knob("KT_SLO_FAST_S", "float", 300.0,
+      "Fast burn-rate window (Google-SRE multi-window: the trigger).",
+      "slo")
+_knob("KT_SLO_SLOW_S", "float", 3600.0,
+      "Slow burn-rate window (the confirmation; clipped to available "
+      "history on a young controller).", "slo")
+_knob("KT_SLO_BURN", "float", 14.4,
+      "Default burn-rate threshold: breach when BOTH windows exceed it "
+      "(14.4 = a 30-day budget gone in 2 days); objectives may "
+      "override per-entry with 'burn_threshold'.", "slo")
+
 # --- data store -------------------------------------------------------------
 _knob("KT_STORE_PORT", "int", 32310,
       "Store server listen port.", "data-store")

@@ -168,6 +168,58 @@ class ControllerClient:
         return self._check(self.client.get(
             f"{self.base_url}/metrics/query/{service}")) or {}
 
+    # ------------------------------------------- fleet telemetry + SLOs
+    def fleet_metrics(self, service: str,
+                      window: float = 60.0) -> Optional[Dict[str, Any]]:
+        """Cross-pod rollups over a trailing window (counter rates,
+        gauge sums over non-stale pods, bucket-merged histogram
+        quantiles, per-pod staleness/reset annotations). None when the
+        controller has never heard of the service."""
+        resp = self.client.get(
+            f"{self.base_url}/metrics/fleet/{service}",
+            params={"window": window})
+        if resp.status_code == 404:
+            return None
+        return self._check(resp)
+
+    def fleet_range(self, service: str, metrics: List[str],
+                    start: Optional[float] = None,
+                    end: Optional[float] = None,
+                    step: float = 10.0) -> Dict[str, Any]:
+        """Aligned fleet series (counters as per-second rates per
+        step, gauges as cross-pod sums at step boundaries)."""
+        params: Dict[str, Any] = {"metrics": ",".join(metrics),
+                                  "step": step}
+        if start is not None:
+            params["start"] = start
+        if end is not None:
+            params["end"] = end
+        return self._check(self.client.get(
+            f"{self.base_url}/metrics/fleet/{service}/range",
+            params=params)) or {}
+
+    def push_telemetry(self, service: str, pod: str,
+                       frames: List[Dict[str, Any]]) -> int:
+        """Batched telemetry frames (the POST fallback pods use when
+        their controller WS is down; tests and sim harnesses too)."""
+        return int((self._check(self.client.post(
+            f"{self.base_url}/telemetry",
+            json={"service": service, "pod": pod, "frames": frames}))
+            or {}).get("ingested", 0))
+
+    def slo_status(self, service: Optional[str] = None) -> Dict[str, Any]:
+        """Last-evaluated SLO status (burn rates, budget remaining,
+        breach state) for all objectives or one service's."""
+        path = f"/slo/{service}" if service else "/slo"
+        return self._check(
+            self.client.get(f"{self.base_url}{path}")) or {}
+
+    def register_slo(self, objective: Dict[str, Any]) -> Dict[str, Any]:
+        """Register one SLO objective at runtime (KT_SLO on the
+        controller covers static config)."""
+        return self._check(self.client.post(
+            f"{self.base_url}/slo", json=objective))
+
     def query_logs(self, labels: Optional[Dict[str, str]] = None,
                    limit: int = 200) -> List[Dict[str, Any]]:
         params: Dict[str, Any] = {"limit": limit, **(labels or {})}

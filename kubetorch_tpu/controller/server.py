@@ -222,6 +222,20 @@ class ControllerServer:
             snapshot = MetricsSnapshot(Path(obs_dir) / "metrics.json")
         self.log_sink = LogSink(persist=persist)
         self.metrics_store = MetricsStore(snapshot=snapshot)
+        # Fleet telemetry plane: pods piggyback metric delta frames on
+        # the heartbeat (WS message or POST /telemetry fallback); the
+        # store retains per-(service, pod, metric) rings with counter-
+        # reset splicing and serves cross-replica rollups — the sensor
+        # layer the autoscaler/fleet router (ROADMAP item 5) reads.
+        from kubetorch_tpu.observability.fleetstore import FleetStore
+        from kubetorch_tpu.observability.slo import SLOEngine
+
+        self.fleet = FleetStore()
+        self.slo = SLOEngine(self.fleet, on_event=self._slo_event)
+        # blind-polling fix: /metrics/query/{service} responses carry
+        # per-pod staleness + counter-reset annotations from the fleet
+        # store ("reset 12 s ago", not a silent rate glitch)
+        self.metrics_store.annotate = self.fleet.pod_annotations
         # Cross-pod trace assembly: pods push span batches (slow-call
         # auto-capture, or ktpu trace pulls + re-posts) and a
         # multi-worker fan-out call renders as ONE tree even though no
@@ -261,6 +275,12 @@ class ControllerServer:
         r.add_delete("/pool/{service}", self.h_teardown_pool)
         r.add_post("/pool/{service}/activity", self.h_activity)
         r.add_post("/heartbeat", self.h_heartbeat)
+        r.add_post("/telemetry", self.h_telemetry)
+        r.add_get("/metrics/fleet/{service}", self.h_fleet)
+        r.add_get("/metrics/fleet/{service}/range", self.h_fleet_range)
+        r.add_get("/slo", self.h_slo)
+        r.add_get("/slo/{service}", self.h_slo)
+        r.add_post("/slo", self.h_slo_register)
         r.add_get("/health/{service}", self.h_gang_health)
         r.add_get("/ws/pods", self.h_ws_pods)
         r.add_post("/traces", self.h_traces_push)
@@ -300,6 +320,10 @@ class ControllerServer:
             # preemptions, gang restarts) join the controller scrape
             *[(name, {}, value)
               for name, value in _prom.resilience_metrics().items()],
+            # fleet rollups (per-service rates/sums/p99s) + slo_* gauges
+            # join the same exposition — one scrape covers the plane
+            *self.fleet.prom_samples(),
+            *self.slo.prom_samples(),
         ]
         app.on_startup.append(self._on_startup)
         app.on_shutdown.append(self._on_shutdown)
@@ -476,6 +500,8 @@ class ControllerServer:
         deleted = self.db.delete_pool(service)
         self.log_sink.drop_stream(service)
         self.metrics_store.drop(service)
+        self.fleet.drop(service)
+        self.slo.drop_service(service)
         # a torn-down gang is not a dead gang: no liveness ghosts, no
         # restart budget carried over to a future service of this name
         self.liveness.forget_service(service)
@@ -528,7 +554,126 @@ class ControllerServer:
             return web.json_response({"ok": True, "state": PREEMPTED})
         prom.record_resilience("heartbeat")
         state = self.liveness.beat(service, pod, info=(body or {}).get("info"))
+        # HTTP beats may carry a telemetry frame inline (same piggyback
+        # contract as the WS message; the batched path is /telemetry)
+        telemetry = (body or {}).get("telemetry")
+        if isinstance(telemetry, dict):
+            self.fleet.ingest(service, pod, telemetry)
         return web.json_response({"ok": True, "state": state})
+
+    # ------------------------------------------------- fleet telemetry
+    async def h_telemetry(self, request):
+        """Batched telemetry ingest (the POST fallback for pods whose
+        controller WS is down): ``{"service", "pod", "frames": [...]}``
+        or a single ``"frame"``. Frames ingest in order; a garbled
+        frame ingests what it can (see FleetStore.ingest)."""
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001
+            return web.json_response({"error": "bad json"}, status=400)
+        service = (body or {}).get("service")
+        pod = (body or {}).get("pod")
+        if not service or not pod:
+            return web.json_response(
+                {"error": "telemetry needs service and pod"}, status=400)
+        frames = (body or {}).get("frames")
+        if not isinstance(frames, list):
+            frame = (body or {}).get("frame")
+            frames = [frame] if isinstance(frame, dict) else []
+        n = 0
+        for frame in frames:
+            if isinstance(frame, dict):
+                n += self.fleet.ingest(service, pod, frame)
+        return web.json_response({"ingested": n, "frames": len(frames)})
+
+    async def h_fleet(self, request):
+        """Cross-pod rollups over a trailing window
+        (``?window=<seconds>``): counter rates/increases, gauge sums
+        over non-stale pods, bucket-merged histogram quantiles, and
+        per-pod staleness/reset annotations."""
+        service = request.match_info["service"]
+        try:
+            window = float(request.query.get("window", 60) or 60)
+        except ValueError:
+            return web.json_response({"error": "bad window"}, status=400)
+        if service not in self.fleet.services() \
+                and self.db.get_pool(service) is None:
+            raise web.HTTPNotFound(text="no such service")
+        return web.json_response(self.fleet.fleet(service,
+                                                  window_s=window))
+
+    async def h_fleet_range(self, request):
+        """Aligned fleet series for ramps: ``?metrics=a,b&start=&end=
+        &step=`` (epoch seconds; start defaults to 5 minutes back,
+        step to 10 s, both clamped to the store's retention)."""
+        service = request.match_info["service"]
+        metrics = [m for m in
+                   (request.query.get("metrics") or "").split(",") if m]
+        if not metrics:
+            return web.json_response(
+                {"error": "metrics= is required (comma-separated)",
+                 "available": self.fleet.metric_names(service)},
+                status=400)
+        try:
+            start = request.query.get("start")
+            end = request.query.get("end")
+            result = self.fleet.range(
+                service, metrics,
+                start=float(start) if start else None,
+                end=float(end) if end else None,
+                step=float(request.query.get("step", 10) or 10))
+        except ValueError:
+            return web.json_response({"error": "bad range params"},
+                                     status=400)
+        return web.json_response(result)
+
+    async def h_slo(self, request):
+        """SLO status (all services, or one with ``/slo/{service}``):
+        last-evaluated burn rates, budget remaining, breach state."""
+        service = request.match_info.get("service")
+        return web.json_response({
+            "objectives": self.slo.status(service),
+            "eval_ms": self.slo.last_eval_ms,
+            "windows": {"fast_s": self.slo.fast_s,
+                        "slow_s": self.slo.slow_s},
+        })
+
+    async def h_slo_register(self, request):
+        """Per-service runtime registration (the KT_SLO env list covers
+        static config): body is one objective dict."""
+        from kubetorch_tpu.observability.slo import Objective
+
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001
+            return web.json_response({"error": "bad json"}, status=400)
+        try:
+            obj = Objective.from_dict(body or {})
+        except (TypeError, ValueError) as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        denied = self._ns_denied(
+            request, (self.db.get_pool(obj.service)
+                      or {}).get("namespace") or "default")
+        if denied is not None:
+            return denied
+        self.slo.register(obj)
+        return web.json_response({"registered": f"{obj.service}/{obj.name}"})
+
+    def _slo_event(self, service: str, name: str, breached: bool,
+                   status: dict):
+        """Breach/recovery transitions land in the log sink next to
+        the resilience events — `ktpu logs -f` shows them live."""
+        if breached:
+            msg = (f"SLO {name} breached: burn {status['burn_rate']}x "
+                   f"(fast {status['window_fast_s']:g}s) / "
+                   f"{status['burn_rate_slow']}x (slow), budget "
+                   f"remaining {status['error_budget_remaining']}")
+        else:
+            msg = (f"SLO {name} recovered: burn {status['burn_rate']}x "
+                   f"below {status['burn_threshold']}x")
+        self._resilience_event(service,
+                               "SloBreach" if breached else "SloRecovered",
+                               msg)
 
     async def h_gang_health(self, request):
         """Gang health for one service: per-pod liveness states + the
@@ -596,6 +741,10 @@ class ControllerServer:
             await asyncio.sleep(interval)
             try:
                 self.liveness.sweep()
+                # SLO burn-rate evaluation rides the same cadence: the
+                # fast window reacts within ~2 sweeps of a regression
+                # landing in the fleet store (e2e-asserted)
+                self.slo.evaluate()
                 # budget decay: a restarted gang that stays healthy for
                 # KT_RESTART_RESET_S earns its restart budget back
                 for service in self.liveness.services():
@@ -709,6 +858,13 @@ class ControllerServer:
 
                     prom.record_resilience("heartbeat")
                     self.liveness.beat(conn.service_name, conn.pod_name)
+                    # telemetry piggyback: the beat's delta frame feeds
+                    # the fleet store (identity from the registration,
+                    # same unforgeability argument as the beat itself)
+                    telemetry = data.get("telemetry")
+                    if isinstance(telemetry, dict):
+                        self.fleet.ingest(conn.service_name,
+                                          conn.pod_name, telemetry)
                 elif mtype == "preempted" and conn is not None:
                     from kubetorch_tpu.resilience.liveness import PREEMPTED
 
@@ -917,6 +1073,8 @@ class ControllerServer:
                         self.db.delete_pool(service)
                         self.log_sink.drop_stream(service)
                         self.metrics_store.drop(service)
+                        self.fleet.drop(service)
+                        self.slo.drop_service(service)
                         try:
                             from kubetorch_tpu.provisioning.backend import (
                                 get_backend,

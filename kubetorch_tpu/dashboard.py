@@ -34,7 +34,11 @@ _PAGE = """<!DOCTYPE html>
 <h1>kubetorch-tpu <span id="ctl" class="warn">connecting…</span></h1>
 <h2>Services</h2>
 <table id="pools"><tr><th>service</th><th>pods</th><th>last activity</th>
-<th>requests</th><th>errors</th><th>TPU HBM</th></tr></table>
+<th>requests</th><th>errors</th><th>TPU HBM</th><th>telemetry</th></tr>
+</table>
+<h2>Fleet &amp; SLOs</h2>
+<table id="fleet"><tr><th>service</th><th>replicas</th><th>tok/s</th>
+<th>TTFT p99</th><th>queue</th><th>KV blocks</th><th>SLO</th></tr></table>
 <h2>Runs</h2>
 <table id="runs"><tr><th>id</th><th>status</th><th>created</th>
 <th>note</th></tr></table>
@@ -70,6 +74,48 @@ async function tick() {
       r.insertCell().textContent = m.device_bytes_in_use
         ? `${fmtB(m.device_bytes_in_use)}/${fmtB(m.device_bytes_limit)}`
         : "—";
+      // per-pod staleness + counter-reset annotations (the fleet
+      // store's view): a restarted replica reads as "reset Ns ago"
+      // instead of a silent rate glitch in the counters
+      const tele = r.insertCell();
+      const anns = Object.entries(p.annotations || {});
+      if (!anns.length) { tele.textContent = "—"; }
+      else {
+        const bits = [];
+        let bad = false;
+        for (const [pod, a] of anns) {
+          if (a.stale) { bits.push(`${pod}: stale ${a.age_s}s`);
+                         bad = true; }
+          else if (a.last_reset_age_s != null && a.last_reset_age_s < 120)
+            { bits.push(`${pod}: reset ${a.last_reset_age_s.toFixed(0)}s`
+                        + ` ago`); bad = true; }
+        }
+        tele.textContent = bits.length ? bits.join("; ") : "fresh";
+        tele.className = bad ? "warn" : "ok";
+      }
+    }
+    const fleetTable = document.getElementById("fleet");
+    while (fleetTable.rows.length > 1) fleetTable.deleteRow(1);
+    for (const f of data.fleet || []) {
+      const r = fleetTable.insertRow();
+      r.insertCell().textContent = f.service;
+      const stale = f.stale_pods ? ` (${f.stale_pods} stale)` : "";
+      r.insertCell().textContent = `${f.pods}${stale}`;
+      r.insertCell().textContent =
+        f.tok_s != null ? f.tok_s.toFixed(1) : "—";
+      r.insertCell().textContent =
+        f.ttft_p99_ms != null ? `${f.ttft_p99_ms.toFixed(0)}ms` : "—";
+      r.insertCell().textContent = f.queue_depth ?? "—";
+      r.insertCell().textContent = f.kv_blocks ?? "—";
+      const slo = r.insertCell();
+      if (!f.slo || !f.slo.length) { slo.textContent = "—"; }
+      else {
+        slo.textContent = f.slo.map(o =>
+          `${o.name}: ${o.breached ? "BREACH" : "ok"} ` +
+          `${o.burn_rate}x burn, ${(o.error_budget_remaining * 100)
+            .toFixed(0)}% budget`).join("; ");
+        slo.className = f.slo.some(o => o.breached) ? "err" : "ok";
+      }
     }
     const runs = document.getElementById("runs");
     while (runs.rows.length > 1) runs.deleteRow(1);
@@ -110,7 +156,7 @@ def build_app(controller) -> web.Application:
 
         def gather():
             out = {"controller": controller.base_url, "version": "?",
-                   "pools": [], "runs": [], "logs": []}
+                   "pools": [], "runs": [], "logs": [], "fleet": []}
             try:
                 health = controller.health()
                 out["version"] = health.get("version", "?")
@@ -121,7 +167,46 @@ def build_app(controller) -> web.Application:
                     service = pool.get("service_name", "")
                     entry = {"service": service,
                              "pods": pool.get("num_pods", ""),
-                             "metrics": {}}
+                             "metrics": {}, "annotations": {}}
+                    try:
+                        # fleet rollup + SLO state for the panel
+                        fleet = controller.fleet_metrics(service,
+                                                         window=30.0)
+                        if fleet and fleet.get("pods"):
+                            entry["annotations"] = fleet["pods"]
+                            gauges = fleet.get("gauges") or {}
+                            counters = fleet.get("counters") or {}
+                            hists = fleet.get("histograms") or {}
+                            ttft = (hists.get("engine_ttft_seconds")
+                                    or {}).get("p99")
+                            row = {
+                                "service": service,
+                                "pods": len(fleet["pods"]),
+                                "stale_pods": sum(
+                                    1 for a in fleet["pods"].values()
+                                    if a.get("stale")),
+                                "tok_s": (counters.get(
+                                    "engine_tokens_total") or {}).get(
+                                        "rate"),
+                                "ttft_p99_ms": (ttft * 1e3
+                                                if ttft is not None
+                                                else None),
+                                "queue_depth": (gauges.get(
+                                    "engine_queue_depth") or {}).get(
+                                        "sum"),
+                                "kv_blocks": (gauges.get(
+                                    "kv_blocks_used") or {}).get("sum"),
+                                "slo": [],
+                            }
+                            try:
+                                row["slo"] = (controller.slo_status(
+                                    service) or {}).get(
+                                        "objectives") or []
+                            except Exception:
+                                row["slo"] = []
+                            out["fleet"].append(row)
+                    except Exception:
+                        pass
                     try:
                         snaps = controller.query_metrics(service)
                         # Aggregate across pods: counters/bytes SUM

@@ -215,6 +215,10 @@ class MetricsStore:
         # service -> pod -> deque[{ts, metrics}]
         self._data: Dict[str, Dict[str, deque]] = {}
         self.snapshot = snapshot
+        # optional annotator (service -> {pod: {...}}): the controller
+        # wires the fleet store's staleness/counter-reset view in so
+        # /metrics/query responses stop being blind latest-snapshots
+        self.annotate: Optional[Any] = None
         if snapshot is not None:
             # Rehydrate the latest sample per pod so TTL-reaper activity
             # state survives a controller restart.
@@ -269,11 +273,30 @@ class MetricsStore:
         return web.json_response({"ok": True})
 
     async def h_query(self, request: web.Request):
+        """Latest snapshot per pod, plus per-pod freshness: ``age_s``
+        (last-push age) on every snapshot and, when the fleet-store
+        annotator is wired, ``telemetry`` staleness/counter-reset
+        annotations — a restarted replica reads as "reset 12 s ago"
+        instead of a silent rate glitch in whatever polls this."""
         service = request.match_info["service"]
+        now = time.time()
+        annotations: Dict[str, Any] = {}
+        if self.annotate is not None:
+            try:
+                annotations = self.annotate(service) or {}
+            except Exception:  # noqa: BLE001 — annotations are additive
+                annotations = {}
+        pods = {}
+        for pod, snap in self.latest(service).items():
+            entry = dict(snap)
+            entry["age_s"] = round(now - snap.get("ts", now), 3)
+            if pod in annotations:
+                entry["telemetry"] = annotations[pod]
+            pods[pod] = entry
         return web.json_response({
             "service": service,
-            "pods": {pod: snap for pod, snap in
-                     self.latest(service).items()},
+            "pods": pods,
+            "annotations": annotations,
             "last_activity": self.last_activity(service),
         })
 

@@ -64,13 +64,42 @@ def _fmt_labels(labels: Dict[str, str]) -> str:
     return "{" + inner + "}"
 
 
-def render(samples: Iterable[Tuple[str, Dict[str, str], Any]],
-           prefix: str = "kubetorch_") -> str:
-    """Render ``(raw_name, labels, value)`` samples to exposition text.
+def _fmt_exemplar(ex: Optional[Dict[str, Any]]) -> str:
+    """OpenMetrics exemplar suffix for a bucket line:
+    `` # {trace_id="..."} value ts``. Dashboards join a histogram's
+    slow buckets straight to ``ktpu trace <svc> --trace-id`` with it."""
+    if not ex or not ex.get("trace_id"):
+        return ""
+    return (f' # {{trace_id="{str(ex["trace_id"]).translate(_LABEL_ESC)}"}}'
+            f' {ex.get("value", 0)} {ex.get("ts", 0)}')
+
+
+def _help_line(name: str) -> Optional[str]:
+    """``# HELP`` text from the metric registry (None when the family
+    is unregistered — ad-hoc names render fine without HELP)."""
+    from kubetorch_tpu.observability import registry
+
+    met = registry.lookup(name)
+    return f"# HELP {name} {met.help}" if met is not None else None
+
+
+def render(samples: Iterable[tuple],
+           prefix: str = "kubetorch_",
+           openmetrics: bool = False) -> str:
+    """Render ``(raw_name, labels, value[, exemplar])`` samples to
+    exposition text.
 
     Non-numeric values are skipped (the JSON snapshots carry strings like
     hostnames); bools count as 0/1. Samples are grouped by metric so the
-    ``# TYPE`` header appears once per family, as the format requires.
+    ``# TYPE`` header appears once per family, as the format requires;
+    families declared in :mod:`~kubetorch_tpu.observability.registry`
+    get a ``# HELP`` line too. An optional 4th tuple element is an
+    OpenMetrics exemplar dict (``{"trace_id", "value", "ts"}``) —
+    recorded on histogram buckets so the dashboard's p99 joins
+    ``ktpu trace`` — emitted ONLY with ``openmetrics=True`` (plus the
+    closing ``# EOF``): the classic 0.0.4 text format treats a mid-line
+    ``#`` as a parse error, and a scraper that negotiated ``text/plain``
+    would reject the whole scrape over one exemplar.
 
     Histogram detection: a ``<base>_sum``/``<base>_count`` family whose
     ``<base>_bucket`` family is present in the same render belongs to a
@@ -81,13 +110,15 @@ def render(samples: Iterable[Tuple[str, Dict[str, str], Any]],
     ``http_request_duration_seconds_sum``) stays a plain counter.
     """
     families: Dict[str, list] = {}
-    for raw, labels, value in samples:
+    for sample in samples:
+        raw, labels, value = sample[0], sample[1], sample[2]
+        exemplar = sample[3] if len(sample) > 3 else None
         if isinstance(value, bool):
             value = int(value)
         if not isinstance(value, (int, float)):
             continue
         families.setdefault(metric_name(raw, prefix), []).append(
-            (labels, value))
+            (labels, value, exemplar))
     hist_bases = {base for base in
                   (_hist_base(name) for name in families)
                   if base is not None and f"{base}_bucket" in families}
@@ -98,19 +129,28 @@ def render(samples: Iterable[Tuple[str, Dict[str, str], Any]],
             continue
         base = _hist_base(name)
         if base in hist_bases:
+            help_line = _help_line(base)
+            if help_line:
+                lines.append(help_line)
             lines.append(f"# TYPE {base} histogram")
             for suffix in _HIST_SUFFIXES:
                 family = f"{base}{suffix}"
-                for labels, value in families.get(family, []):
+                for labels, value, ex in families.get(family, []):
                     lines.append(
-                        f"{family}{_fmt_labels(labels)} {value}")
+                        f"{family}{_fmt_labels(labels)} {value}"
+                        f"{_fmt_exemplar(ex) if openmetrics else ''}")
                 emitted.add(family)
             continue
         kind = ("counter" if name.endswith(_COUNTER_SUFFIXES)
                 else "gauge")
+        help_line = _help_line(name)
+        if help_line:
+            lines.append(help_line)
         lines.append(f"# TYPE {name} {kind}")
-        for labels, value in families[name]:
+        for labels, value, _ in families[name]:
             lines.append(f"{name}{_fmt_labels(labels)} {value}")
+    if openmetrics:
+        lines.append("# EOF")
     return "\n".join(lines) + "\n" if lines else "\n"
 
 
@@ -290,22 +330,59 @@ _SERVING: Dict[str, float] = {
     "serving_worker_exec_seconds_total": 0.0,
     "serving_worker_dispatch_seconds_total": 0.0,
 }
-# stage -> {"sum": float, "count": float, "buckets": [count per le]}
+# stage -> {"sum": float, "count": float, "buckets": [count per le],
+#           "ex": [exemplar|None per le, +Inf last]}
 _HISTS: Dict[str, Dict[str, Any]] = {}
 
 
+def _ambient_trace_id() -> Optional[str]:
+    """Trace id of the ambient span, for histogram exemplars.
+    sys.modules lookup, not an import: the recorder hot path must not
+    pay a first-import, and a process that never traced has no
+    exemplar to give."""
+    import sys as _sys
+
+    tracing = _sys.modules.get("kubetorch_tpu.observability.tracing")
+    if tracing is None:
+        return None
+    try:
+        return tracing.current_trace_id()
+    # ktlint: disable=KT004 -- exemplar capture is best-effort by contract
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _hist_observe(h: Dict[str, Any], buckets, value: float,
+                  trace_id: Optional[str]) -> None:
+    """Shared bucket-increment + exemplar placement (caller holds the
+    family's lock). The exemplar lands in the sample's NATIVE bucket
+    (the first ``le >= value``; overflow lands in the +Inf slot), so
+    the slowest bucket always points at a real slow call."""
+    h["sum"] += value
+    h["count"] += 1
+    native = len(buckets)   # +Inf slot
+    for i, le in enumerate(buckets):
+        if value <= le:
+            h["buckets"][i] += 1
+            native = min(native, i)
+    if trace_id:
+        h["ex"][native] = {"trace_id": trace_id, "value": value,
+                           "ts": time.time()}
+
+
 def record_call_stage(stage: str, seconds: float) -> None:
-    """Fold one stage duration into its histogram (seconds)."""
+    """Fold one stage duration into its histogram (seconds). When an
+    ambient span is active its trace id is recorded as the bucket's
+    OpenMetrics exemplar (rendered by the pod exposition)."""
+    trace_id = _ambient_trace_id()
     with _SERVING_LOCK:
         h = _HISTS.get(stage)
         if h is None:
-            h = _HISTS[stage] = {"sum": 0.0, "count": 0.0,
-                                 "buckets": [0.0] * len(_HIST_BUCKETS)}
-        h["sum"] += seconds
-        h["count"] += 1
-        for i, le in enumerate(_HIST_BUCKETS):
-            if seconds <= le:
-                h["buckets"][i] += 1
+            h = _HISTS[stage] = {
+                "sum": 0.0, "count": 0.0,
+                "buckets": [0.0] * len(_HIST_BUCKETS),
+                "ex": [None] * (len(_HIST_BUCKETS) + 1)}
+        _hist_observe(h, _HIST_BUCKETS, seconds, trace_id)
 
 
 def record_call_stages(stages: Dict[str, float]) -> None:
@@ -371,13 +448,16 @@ def serving_histogram_samples(labels: Optional[Dict[str, str]] = None):
     labels = labels or {}
     with _SERVING_LOCK:
         hists = {s: {"sum": h["sum"], "count": h["count"],
-                     "buckets": list(h["buckets"])}
+                     "buckets": list(h["buckets"]),
+                     "ex": list(h["ex"])}
                  for s, h in _HISTS.items()}
     for stage, h in hists.items():
         base = f"serving_call_{stage}_seconds"
-        for le, count in zip(_HIST_BUCKETS, h["buckets"]):
-            yield f"{base}_bucket", {**labels, "le": repr(le)}, count
-        yield f"{base}_bucket", {**labels, "le": "+Inf"}, h["count"]
+        for i, (le, count) in enumerate(zip(_HIST_BUCKETS, h["buckets"])):
+            yield (f"{base}_bucket", {**labels, "le": repr(le)}, count,
+                   h["ex"][i])
+        yield (f"{base}_bucket", {**labels, "le": "+Inf"}, h["count"],
+               h["ex"][-1])
         yield f"{base}_sum", labels, h["sum"]
         yield f"{base}_count", labels, h["count"]
 
@@ -687,6 +767,104 @@ def san_samples(labels: Optional[Dict[str, str]] = None):
         yield name, labels, value
 
 
+# ------------------------------------------------------------------
+# Named histogram families (fleet telemetry plane). The call-stage
+# recorder above predates this and keeps its dedicated shape; new
+# histogram metrics (engine TTFT, future latency families) record here
+# under their full family name. Snapshots travel: worker processes
+# piggyback theirs on call responses ("hists" group), the pod server
+# merges per-process snapshots (buckets/sum/count SUM across processes,
+# exemplars freshest-wins), renders them on /metrics with exemplars,
+# and ships the merged buckets to the controller in telemetry frames so
+# fleet-level quantiles (TTFT p99 ACROSS replicas) are computable.
+_NHIST_LOCK = threading.Lock()
+_NHISTS: Dict[str, Dict[str, Any]] = {}
+
+_UNSET = object()
+
+
+def record_hist(name: str, value: float, buckets: Optional[tuple] = None,
+                trace_id: Any = _UNSET) -> None:
+    """Observe ``value`` (seconds) into the named histogram family.
+    ``buckets`` fixes the bounds on first use (default: the call-stage
+    1 ms..10 s ladder); ``trace_id`` overrides the ambient span's id as
+    the bucket exemplar (pass ``None`` to suppress)."""
+    if trace_id is _UNSET:
+        trace_id = _ambient_trace_id()
+    with _NHIST_LOCK:
+        h = _NHISTS.get(name)
+        if h is None:
+            le = tuple(buckets) if buckets else _HIST_BUCKETS
+            h = _NHISTS[name] = {
+                "le": le, "sum": 0.0, "count": 0.0,
+                "buckets": [0.0] * len(le),
+                "ex": [None] * (len(le) + 1)}
+        _hist_observe(h, h["le"], float(value), trace_id)
+
+
+def hist_metrics() -> Dict[str, Dict[str, Any]]:
+    """Deep snapshot of this process's named histograms (piggyback /
+    telemetry-frame source): ``{name: {le, buckets, sum, count, ex}}``.
+    Lists are copied — callers may ship them across process or socket
+    boundaries while the recorder keeps counting."""
+    with _NHIST_LOCK:
+        return {name: {"le": list(h["le"]),
+                       "buckets": list(h["buckets"]),
+                       "sum": h["sum"], "count": h["count"],
+                       "ex": list(h["ex"])}
+                for name, h in _NHISTS.items()}
+
+
+def merge_hist_snapshots(snaps) -> Dict[str, Dict[str, Any]]:
+    """Merge per-process histogram snapshots: buckets/sum/count SUM
+    (each process's own counts are monotonic, so the sum is too);
+    exemplars freshest-ts-wins per bucket. Families whose bucket
+    bounds disagree keep the first seen (can only happen across a
+    deploy boundary mid-flight)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for snap in snaps:
+        for name, h in (snap or {}).items():
+            cur = out.get(name)
+            if cur is None:
+                out[name] = {"le": list(h.get("le") or ()),
+                             "buckets": list(h.get("buckets") or ()),
+                             "sum": float(h.get("sum", 0.0)),
+                             "count": float(h.get("count", 0.0)),
+                             "ex": list(h.get("ex")
+                                        or [None] * (len(h.get("le")
+                                                          or ()) + 1))}
+                continue
+            if list(h.get("le") or ()) != cur["le"]:
+                continue
+            cur["sum"] += float(h.get("sum", 0.0))
+            cur["count"] += float(h.get("count", 0.0))
+            for i, b in enumerate(h.get("buckets") or ()):
+                cur["buckets"][i] += float(b)
+            for i, ex in enumerate(h.get("ex") or ()):
+                if ex and (cur["ex"][i] is None
+                           or ex.get("ts", 0) > cur["ex"][i].get("ts", 0)):
+                    cur["ex"][i] = ex
+    return out
+
+
+def hist_samples(hists: Optional[Dict[str, Dict[str, Any]]] = None,
+                 labels: Optional[Dict[str, str]] = None):
+    """Exposition samples (with exemplars) for named-histogram
+    snapshots — pass a merged snapshot (pod server) or None for this
+    process's own families."""
+    labels = labels or {}
+    if hists is None:
+        hists = hist_metrics()
+    for name, h in hists.items():
+        for i, (le, count) in enumerate(zip(h["le"], h["buckets"])):
+            yield (f"{name}_bucket", {**labels, "le": repr(le)}, count,
+                   h["ex"][i] if i < len(h["ex"]) else None)
+        yield (f"{name}_bucket", {**labels, "le": "+Inf"}, h["count"],
+               h["ex"][-1] if h["ex"] else None)
+        yield f"{name}_sum", labels, h["sum"]
+        yield f"{name}_count", labels, h["count"]
+
+
 def wants_prometheus(request) -> bool:
     """Content negotiation for a shared /metrics route: Prometheus sends
     ``Accept: application/openmetrics-text, text/plain;version=0.0.4``;
@@ -700,3 +878,12 @@ def wants_prometheus(request) -> bool:
     if "openmetrics" in accept:
         return True
     return "text/plain" in accept and "application/json" not in accept
+
+
+def wants_openmetrics(request) -> bool:
+    """True when the scraper negotiated the OpenMetrics format (the
+    only exposition flavor where bucket exemplars are legal syntax —
+    a classic text/plain scrape must never see them)."""
+    if request.query.get("format") == "openmetrics":
+        return True
+    return "openmetrics" in request.headers.get("Accept", "")

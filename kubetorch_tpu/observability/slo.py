@@ -1,0 +1,325 @@
+"""SLO objectives + multi-window burn-rate evaluation at the controller.
+
+With the fleet store retaining cross-replica series
+(:mod:`~kubetorch_tpu.observability.fleetstore`), objectives finally
+have something to be evaluated AGAINST. An objective is declarative —
+``KT_SLO`` JSON at controller start, or registered per service at
+runtime (``POST /slo``) — and comes in two kinds:
+
+- ``latency``: a named histogram family (``metric``) + ``threshold_ms``
+  + ``objective`` (the fraction of events that must land under the
+  threshold, e.g. 0.99 for "TTFT p99 ≤ 500 ms"). The error ratio over a
+  window is the interpolated fraction of bucket-merged observations
+  ABOVE the threshold.
+- ``ratio``: counter names — ``bad`` (or ``good``) and ``total`` — +
+  ``objective`` (max good fraction allowed to be violated:
+  objective 0.98 with ``bad=engine_sheds_total`` means "shed-rate
+  ≤ 2 %"; with ``good=...`` the error ratio is ``1 − good/total``,
+  the goodput form).
+
+Burn rate (Google SRE workbook, multi-window multi-burn): over a window
+``W``, ``burn = error_ratio / (1 − objective)`` — 1.0 means the error
+budget would be consumed exactly at the period's natural pace; 14.4
+means a 30-day budget gone in 2 days. The engine evaluates a FAST
+window (``KT_SLO_FAST_S``, default 5 m — the trigger) and a SLOW window
+(``KT_SLO_SLOW_S``, default 1 h — the confirmation, clipped to
+available history on a young controller), and an objective breaches
+when BOTH exceed its threshold; it recovers when the fast window drops
+back under. Transitions emit sink events (next to the resilience
+events) and bump ``slo_breach_total``; gauges join the controller's
+Prometheus scrape.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from kubetorch_tpu.config import env_float, env_json
+
+from kubetorch_tpu.observability.fleetstore import (
+    FleetStore,
+    hist_quantile,
+)
+
+
+@dataclass
+class Objective:
+    service: str
+    name: str
+    kind: str = "latency"            # "latency" | "ratio"
+    metric: str = ""                 # histogram base (latency kind)
+    threshold_ms: float = 0.0        # latency threshold
+    objective: float = 0.99          # target good fraction
+    bad: str = ""                    # bad-events counter (ratio kind)
+    good: str = ""                   # good-events counter (ratio kind)
+    total: str = ""                  # total-events counter (ratio kind)
+    burn_threshold: Optional[float] = None
+    # minimum events in a window before the objective can breach — a
+    # single slow call on an idle service is not an incident
+    min_events: float = 1.0
+
+    def validate(self) -> "Objective":
+        if not self.service or not self.name:
+            raise ValueError("SLO objective needs service and name")
+        if self.kind == "latency":
+            if not self.metric or self.threshold_ms <= 0:
+                raise ValueError(
+                    f"latency objective {self.name!r} needs metric and "
+                    f"threshold_ms")
+        elif self.kind == "ratio":
+            if not self.total or not (self.bad or self.good):
+                raise ValueError(
+                    f"ratio objective {self.name!r} needs total and "
+                    f"bad (or good) counter names")
+        else:
+            raise ValueError(f"unknown SLO kind {self.kind!r}")
+        if not (0.0 < self.objective < 1.0):
+            raise ValueError(
+                f"objective must be in (0, 1), got {self.objective}")
+        return self
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.objective
+
+    @classmethod
+    def from_dict(cls, spec: Dict[str, Any]) -> "Objective":
+        known = set(cls.__dataclass_fields__)
+        return cls(**{k: v for k, v in (spec or {}).items()
+                      if k in known}).validate()
+
+
+def objectives_from_env() -> List[Objective]:
+    """Parse ``KT_SLO`` (a JSON list of objective dicts); a malformed
+    entry raises at controller start — a typo'd SLO silently never
+    evaluating is the failure mode this refuses."""
+    raw = env_json("KT_SLO")
+    if not raw:
+        return []
+    if not isinstance(raw, list):
+        raise ValueError("KT_SLO must be a JSON list of objectives")
+    return [Objective.from_dict(spec) for spec in raw]
+
+
+@dataclass
+class _State:
+    breached: bool = False
+    breaches: int = 0
+    last: Dict[str, Any] = field(default_factory=dict)
+
+
+class SLOEngine:
+    """Evaluates objectives against a :class:`FleetStore` (call
+    :meth:`evaluate` at the controller's resilience sweep cadence)."""
+
+    def __init__(self, store: FleetStore,
+                 objectives: Optional[List[Objective]] = None,
+                 fast_s: Optional[float] = None,
+                 slow_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.time,
+                 on_event: Optional[Callable[..., None]] = None):
+        self.store = store
+        self.fast_s = fast_s if fast_s is not None else \
+            env_float("KT_SLO_FAST_S")
+        self.slow_s = slow_s if slow_s is not None else \
+            env_float("KT_SLO_SLOW_S")
+        self.default_burn = env_float("KT_SLO_BURN")
+        self.clock = clock
+        self.on_event = on_event
+        self._objectives: Dict[tuple, Objective] = {}
+        self._states: Dict[tuple, _State] = {}
+        self._sources: Dict[tuple, str] = {}   # "env" | "runtime"
+        self._started = clock()
+        self.last_eval_ms = 0.0
+        for obj in objectives if objectives is not None \
+                else objectives_from_env():
+            self.register(obj, source="env")
+
+    # ------------------------------------------------------- registry
+    def register(self, obj: Objective, source: str = "runtime") -> None:
+        key = (obj.service, obj.name)
+        self._objectives[key] = obj.validate()
+        self._states.setdefault(key, _State())
+        self._sources[key] = source
+
+    def remove(self, service: str, name: str) -> bool:
+        key = (service, name)
+        self._states.pop(key, None)
+        self._sources.pop(key, None)
+        return self._objectives.pop(key, None) is not None
+
+    def drop_service(self, service: str) -> None:
+        """Teardown hook: runtime-registered objectives go with the
+        service; env-configured (``KT_SLO``) ones survive a redeploy of
+        the same name but their state resets — a torn-down service must
+        not keep reporting a frozen burn/breach on ``/slo`` and the
+        scrape (nor fire a spurious SloRecovered when the empty store
+        evaluates to zero error)."""
+        for key in [k for k in self._objectives if k[0] == service]:
+            if self._sources.get(key) == "env":
+                self._states[key] = _State()
+            else:
+                self.remove(*key)
+
+    def objectives(self, service: Optional[str] = None) -> List[Objective]:
+        return [obj for key, obj in sorted(self._objectives.items())
+                if service is None or obj.service == service]
+
+    # ------------------------------------------------------ evaluation
+    def _error_ratio(self, obj: Objective, roll: dict) -> tuple:
+        """(error_ratio, events) over one rollup window."""
+        if obj.kind == "latency":
+            h = (roll.get("histograms") or {}).get(obj.metric)
+            if not h:
+                return 0.0, 0.0
+            count = float(h.get("count") or 0.0)
+            if count <= 0:
+                return 0.0, 0.0
+            les = [b[0] for b in h["buckets"]]
+            cums = [b[1] for b in h["buckets"]]
+            good = _count_at_or_below(obj.threshold_ms / 1e3, les, cums,
+                                      count)
+            return max(0.0, 1.0 - good / count), count
+        counters = roll.get("counters") or {}
+
+        def inc(name):
+            return float((counters.get(name) or {}).get("increase", 0.0))
+
+        total = inc(obj.total)
+        if total <= 0:
+            return 0.0, 0.0
+        bad = inc(obj.bad) if obj.bad else max(0.0, total - inc(obj.good))
+        return min(1.0, bad / total), total
+
+    def _windows(self, now: float) -> tuple:
+        """(fast_s, slow_s) with the slow window clipped to history a
+        young controller actually has — an hour-long window over 90 s
+        of samples would dilute a real regression 40×."""
+        history = max(1.0, now - self._started)
+        return (min(self.fast_s, history), min(self.slow_s, history))
+
+    def evaluate(self) -> List[dict]:
+        """One sweep over every objective; returns the status list
+        (also served at ``GET /slo``). Emits breach/recovery events on
+        transitions via ``on_event(service, name, breached, status)``."""
+        t0 = time.perf_counter()
+        now = self.clock()
+        fast_s, slow_s = self._windows(now)
+        rollups: Dict[tuple, dict] = {}
+
+        def roll(service, window):
+            key = (service, round(window, 3))
+            if key not in rollups:
+                rollups[key] = self.store.fleet(service, window_s=window,
+                                                now=now)
+            return rollups[key]
+
+        out = []
+        for key, obj in sorted(self._objectives.items()):
+            state = self._states[key]
+            err_fast, n_fast = self._error_ratio(obj, roll(obj.service,
+                                                           fast_s))
+            err_slow, n_slow = self._error_ratio(obj, roll(obj.service,
+                                                           slow_s))
+            burn_fast = err_fast / obj.budget
+            burn_slow = err_slow / obj.budget
+            threshold = (obj.burn_threshold if obj.burn_threshold
+                         is not None else self.default_burn)
+            over = (burn_fast >= threshold and burn_slow >= threshold
+                    and n_fast >= obj.min_events)
+            transition = None
+            if over and not state.breached:
+                state.breached = True
+                state.breaches += 1
+                transition = "breach"
+            elif state.breached and burn_fast < threshold:
+                state.breached = False
+                transition = "recovery"
+            status = {
+                "service": obj.service, "name": obj.name,
+                "kind": obj.kind, "objective": obj.objective,
+                "burn_threshold": threshold,
+                "burn_rate": round(burn_fast, 4),
+                "burn_rate_slow": round(burn_slow, 4),
+                "error_ratio_fast": round(err_fast, 6),
+                "error_ratio_slow": round(err_slow, 6),
+                "events_fast": round(n_fast, 3),
+                "events_slow": round(n_slow, 3),
+                "window_fast_s": fast_s, "window_slow_s": slow_s,
+                "error_budget_remaining": round(
+                    max(0.0, min(1.0, 1.0 - err_slow / obj.budget)), 4),
+                "breached": state.breached,
+                "breach_total": state.breaches,
+                "ts": now,
+            }
+            if obj.kind == "latency":
+                status["metric"] = obj.metric
+                status["threshold_ms"] = obj.threshold_ms
+            state.last = status
+            out.append(status)
+            if transition and self.on_event is not None:
+                self.on_event(obj.service, obj.name,
+                              transition == "breach", status)
+        self.last_eval_ms = round(
+            (time.perf_counter() - t0) * 1e3, 3)
+        return out
+
+    # ---------------------------------------------------------- views
+    def status(self, service: Optional[str] = None) -> List[dict]:
+        """Last evaluated status per objective (objectives never yet
+        evaluated report a skeleton so they are visible, not absent)."""
+        out = []
+        for key, obj in sorted(self._objectives.items()):
+            if service is not None and obj.service != service:
+                continue
+            state = self._states[key]
+            out.append(state.last or {
+                "service": obj.service, "name": obj.name,
+                "kind": obj.kind, "objective": obj.objective,
+                "breached": False, "breach_total": 0,
+                "burn_rate": 0.0, "burn_rate_slow": 0.0,
+                "error_budget_remaining": 1.0})
+        return out
+
+    def describe(self, service: Optional[str] = None) -> List[dict]:
+        return [asdict(obj) for obj in self.objectives(service)]
+
+    def prom_samples(self):
+        """``slo_*`` gauges per objective for the controller scrape."""
+        for status in self.status():
+            labels = {"service": status["service"],
+                      "slo": status["name"]}
+            yield "slo_burn_rate", labels, status.get("burn_rate", 0.0)
+            yield ("slo_burn_rate_slow", labels,
+                   status.get("burn_rate_slow", 0.0))
+            yield ("slo_error_budget_remaining", labels,
+                   status.get("error_budget_remaining", 1.0))
+            yield "slo_breached", labels, int(status.get("breached",
+                                                         False))
+            yield "slo_breach_total", labels, status.get("breach_total",
+                                                         0)
+        yield "slo_eval_ms", {}, self.last_eval_ms
+
+
+def _count_at_or_below(threshold: float, les: List[float],
+                       cums: List[float], count: float) -> float:
+    """Observations ≤ ``threshold`` from cumulative bucket increases,
+    linearly interpolated inside the straddling bucket (the inverse of
+    :func:`~kubetorch_tpu.observability.fleetstore.hist_quantile`)."""
+    if not les:
+        return count
+    prev_le, prev_cum = 0.0, 0.0
+    for le, cum in zip(les, cums):
+        if threshold <= le:
+            if le <= prev_le:
+                return cum
+            frac = (threshold - prev_le) / (le - prev_le)
+            return prev_cum + (cum - prev_cum) * frac
+        prev_le, prev_cum = float(le), float(cum)
+    return count
+
+
+__all__ = ["Objective", "SLOEngine", "objectives_from_env",
+           "hist_quantile"]

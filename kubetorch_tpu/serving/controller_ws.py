@@ -183,10 +183,16 @@ class ControllerWebSocket:
         except RuntimeError:  # called from a worker thread
             asyncio.run_coroutine_threadsafe(_send(), self._loop)
 
-    def notify_heartbeat(self):
+    def notify_heartbeat(self, telemetry: Optional[dict] = None):
         """Liveness beat piggybacked on this WS (resilience/liveness.py:
-        the controller resolves service/pod from the registration)."""
-        self._notify({"type": "heartbeat"})
+        the controller resolves service/pod from the registration).
+        ``telemetry`` rides the same frame as a compact metric delta
+        (fleet telemetry plane — observability/fleetstore.py): one text
+        frame carries liveness AND the pod's changed counters."""
+        payload: dict = {"type": "heartbeat"}
+        if telemetry:
+            payload["telemetry"] = telemetry
+        self._notify(payload)
 
     def notify_preempted(self):
         """Tell the controller this pod is draining after SIGTERM — the

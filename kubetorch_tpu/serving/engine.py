@@ -334,6 +334,10 @@ class DecodeEngine:
         self._submit_t: Dict[int, float] = {}   # rid -> submit stamp,
         #                           popped at first token (feeds the
         #                           TTFT EMA below)
+        # rid -> submitting call's trace id: the first-token tick runs
+        # in the driver thread (no ambient span), so the TTFT histogram
+        # exemplar is captured at submit and carried to the observation
+        self._submit_trace: Dict[int, Optional[str]] = {}
         self._exec_counts: Dict[str, int] = {}
         # seconds-per-row-freed EMA — the admission estimate's clock
         # (same role the session's ema_exec_s plays for call shedding)
@@ -379,6 +383,10 @@ class DecodeEngine:
         its ``prompt`` is ignored (the parked state is the program)."""
         prog = GenerationProgram.from_wire(program)
         sink: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        # exemplar context for the TTFT histogram: the submit runs
+        # under the call's ambient span; first token lands in the
+        # driver thread where no ambient context exists
+        submit_trace = tracing.current_trace_id()
         restored = None
         if prog.session_id is not None:
             with self._wake:
@@ -402,6 +410,7 @@ class DecodeEngine:
                 rids.append(rid)
                 self._sinks[rid] = sink
                 self._submit_t[rid] = now
+                self._submit_trace[rid] = submit_trace
                 if deadline is not None:
                     self._deadlines[rid] = deadline
                 self._restores += 1
@@ -470,6 +479,7 @@ class DecodeEngine:
                         rids.append(rid)
                         self._sinks[rid] = sink
                         self._submit_t[rid] = now
+                        self._submit_trace[rid] = submit_trace
                         if deadline is not None:
                             self._deadlines[rid] = deadline
                         # prefix_pid=pid covers explicit prefix_ids too:
@@ -752,11 +762,25 @@ class DecodeEngine:
                 sink.put((rid, None))
         return parked
 
+    def _record_ttft(self, ttft_s: float, rid: int) -> None:
+        """One TTFT observation into the named-histogram family (with
+        the submit-time trace id as exemplar), behind the same
+        must-never-raise guard as the counters."""
+        try:
+            from kubetorch_tpu.observability.prometheus import record_hist
+
+            record_hist("engine_ttft_seconds", ttft_s,
+                        trace_id=self._submit_trace.pop(rid, None))
+        # ktlint: disable=KT004 -- metrics must never break the driver tick
+        except Exception:  # noqa: BLE001
+            pass
+
     # ------------------------------------------------------------ driver
     def _forget_locked(self, rid: int) -> None:
         self._sinks.pop(rid, None)
         self._deadlines.pop(rid, None)
         self._submit_t.pop(rid, None)
+        self._submit_trace.pop(rid, None)
 
     def _check_session_free_locked(self, session_id: str) -> None:
         if session_id in self._live_sessions:
@@ -1095,8 +1119,15 @@ class DecodeEngine:
                 _record_engine("tokens", len(toks))
                 t_sub = self._submit_t.pop(rid, None)
                 if t_sub is not None:  # this rid's FIRST tokens
+                    ttft = tnow - t_sub
                     self._ema_ttft_s = (0.8 * self._ema_ttft_s
-                                        + 0.2 * (tnow - t_sub))
+                                        + 0.2 * ttft)
+                    # fleet-queryable TTFT distribution: buckets merge
+                    # across replicas at the controller (p99 becomes a
+                    # FLEET number); the submitting call's trace id is
+                    # the bucket exemplar — a slow bucket is one click
+                    # from `ktpu trace`
+                    self._record_ttft(ttft, rid)
             sink = self._sinks.get(rid)
             if sink is not None:
                 sink.put((rid, ([int(t) for t in toks], bool(done))))

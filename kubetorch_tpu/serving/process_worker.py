@@ -144,6 +144,15 @@ def _attach_worker_metrics(agg: Dict[str, int]) -> None:
         if engine.get("engine_generations_total") or \
                 engine.get("engine_steps_total"):
             agg["engine"] = {"pid": os.getpid(), **engine}
+        # named-histogram snapshot (engine TTFT buckets + exemplars):
+        # rides whole, not flattened — the pod server merges bucket
+        # vectors across workers and ships them to the controller in
+        # telemetry frames so fleet-level p99s are computable
+        from kubetorch_tpu.observability.prometheus import hist_metrics
+
+        hists = hist_metrics()
+        if hists:
+            agg["hists"] = {"pid": os.getpid(), "h": hists}
         trace = tracing.trace_metrics()
         if trace.get("trace_spans_total"):
             agg["trace"] = {"pid": os.getpid(), **trace}

@@ -106,6 +106,18 @@ class PodServer:
         # merged under a data_store_ prefix) and "serving" (call-path
         # counters, already serving_*-named).
         self._stats_by_proc: Dict[str, Dict[Any, Dict[str, float]]] = {}
+        # named-histogram snapshots per process (worker piggyback next
+        # to the flat groups): buckets/sum/count SUM across processes,
+        # exemplars freshest-wins — the merged view renders on /metrics
+        # and ships to the controller in telemetry frames
+        self._hists_by_proc: Dict[Any, Dict[str, Any]] = {}
+        # fleet telemetry plane: the delta baseline (values last
+        # shipped), the POST-fallback backlog (bounded — an unreachable
+        # controller must not grow memory), and the frame counter that
+        # schedules periodic full snapshots
+        self._tele_sent: Dict[str, Any] = {}
+        self._tele_backlog: list = []
+        self._tele_frames = 0
         self.ready = False
         self.setup_error: Optional[str] = None
         self.controller_ws = None
@@ -334,17 +346,47 @@ class PodServer:
         session = _aiohttp.ClientSession(
             timeout=_aiohttp.ClientTimeout(
                 total=env_float("KT_PUSH_TIMEOUT")), headers=headers)
+        tele_url = f"{controller_url.rstrip('/')}/telemetry"
+        tele_every = env_int("KT_TELEMETRY_EVERY")
+        beats = 0
         try:
             while not self.terminating:
                 await asyncio.sleep(heartbeat_interval())
                 if self.terminating:
                     return
+                beats += 1
                 corrupt = chaos_mod.maybe(chaos_mod.CORRUPT_HEARTBEAT, pod)
+                # fleet telemetry piggyback: a compact delta frame of
+                # the pod's changed counters/gauges + histogram buckets
+                # rides every KT_TELEMETRY_EVERY-th beat. Frame build
+                # is bench-bounded (<3% of a heartbeat tick,
+                # telemetry_ingest_overhead_pct in bench_serving).
+                telemetry = None
+                if tele_every and beats % tele_every == 0:
+                    try:
+                        telemetry = self._telemetry_frame()
+                    # ktlint: disable=KT004 -- liveness must beat even if telemetry breaks
+                    except Exception:  # noqa: BLE001
+                        telemetry = None
                 ws = self.controller_ws
                 if (not corrupt and ws is not None
                         and getattr(ws, "connected", False)):
-                    ws.notify_heartbeat()
+                    # one WS text frame carries liveness AND metrics;
+                    # the periodic full snapshot (KT_TELEMETRY_FULL_
+                    # EVERY) heals any frame a dying socket swallowed.
+                    # Any POST backlog from an outage is SUPERSEDED the
+                    # moment the WS path resumes — replaying those old
+                    # cumulative values later would read as counter
+                    # steps-DOWN at the controller (false resets)
+                    self._tele_backlog.clear()
+                    ws.notify_heartbeat(telemetry=telemetry)
                     continue
+                if telemetry is not None:
+                    # WS down: batch frames for the POST fallback
+                    # (bounded — oldest deltas drop first; the next
+                    # full snapshot re-converges the controller)
+                    self._tele_backlog.append(telemetry)
+                    del self._tele_backlog[:-30]
                 # a corrupted beat (chaos) ships a payload with no
                 # identity — the controller must reject it AND count it
                 payload = ({"garbage": True} if corrupt
@@ -355,6 +397,19 @@ class PodServer:
                     # the single session exists to avoid)
                     async with session.post(url, json=payload) as resp:
                         await resp.read()
+                    if self._tele_backlog and not corrupt:
+                        async with session.post(tele_url, json={
+                                "service": service, "pod": pod,
+                                "frames": list(self._tele_backlog),
+                        }) as resp:
+                            if resp.status < 400:
+                                self._tele_backlog.clear()
+                            else:
+                                self.metrics[
+                                    "telemetry_send_errors_total"] = (
+                                    self.metrics.get(
+                                        "telemetry_send_errors_total", 0)
+                                    + 1)
                 except Exception:  # noqa: BLE001 — next beat retries
                     self.metrics["heartbeat_send_errors_total"] = (
                         self.metrics.get("heartbeat_send_errors_total", 0)
@@ -545,6 +600,15 @@ class PodServer:
         spans = stats.pop("trace_spans", None)
         if spans:
             tracing.recorder.ingest(spans)
+        hists = stats.pop("hists", None)
+        if hists:
+            # named-histogram snapshot (engine TTFT etc.): keep the
+            # whole per-process snapshot; merged lazily at scrape /
+            # telemetry-frame time
+            pid = hists.get("pid", 0) if isinstance(hists, dict) else 0
+            snap = hists.get("h") if isinstance(hists, dict) else None
+            if isinstance(snap, dict):
+                self._hists_by_proc[pid] = snap
         san_graph = stats.pop("san_graph", None)
         if san_graph:
             # KT_SAN=1: fold the worker's lock-order graph into THIS
@@ -583,15 +647,64 @@ class PodServer:
             else:
                 self.metrics[f"{prefix}{key}"] = snap[key]
 
-    async def h_metrics(self, request):
-        healthy = (self.supervisor.healthy()
-                   if self.supervisor is not None else True)
+    def _merged_hists(self) -> Dict[str, Any]:
+        """This process's named histograms merged with the workers'
+        piggybacked snapshots (buckets/sum/count summed — each
+        process's own counts are monotonic; exemplars freshest-wins)."""
         from kubetorch_tpu.observability import prometheus as prom
 
-        # lazy session GC rides the scrape cadence too — a pod whose
-        # clients vanished without a bye (and that never sees another
-        # connect) must still release detached sessions' retention
-        self._channel_sessions.sweep()
+        return prom.merge_hist_snapshots(
+            [prom.hist_metrics(), *self._hists_by_proc.values()])
+
+    def _telemetry_frame(self, full: bool = False) -> Optional[dict]:
+        """One metric delta frame for the heartbeat piggyback: the
+        pid-merged flat metrics (engine_*/kv_*/serving_*/replay_*/
+        resilience_*/... — FRAME_PREFIXES) plus merged histogram
+        buckets, restricted to keys that CHANGED since the last
+        successful send. Every ``KT_TELEMETRY_FULL_EVERY``-th frame is
+        a full snapshot so a restarted controller converges. When
+        nothing changed the frame is a bare ``{"ts": ...}`` — it still
+        ships, because the fleet store's per-pod freshness clock is the
+        frame arrival: suppressing idle frames would read every idle
+        (but perfectly healthy) replica as stale between full
+        snapshots."""
+        from kubetorch_tpu.observability.fleetstore import build_frame
+
+        # server-process groups (channel lifecycle, replay/admission,
+        # pod-side resilience ticks) normally merge at scrape time —
+        # the frame must not depend on anyone ever scraping this pod
+        self._refresh_server_groups()
+        self._tele_frames += 1
+        every = env_int("KT_TELEMETRY_FULL_EVERY")
+        full = full or self._tele_frames == 1 or (
+            every and self._tele_frames % every == 0)
+        frame = build_frame(self.metrics, self._merged_hists(),
+                            last_sent=self._tele_sent, full=full)
+        n_keys = len(frame.get("m") or {}) + len(frame.get("h") or {})
+        self.metrics["telemetry_frames_sent_total"] = (
+            self.metrics.get("telemetry_frames_sent_total", 0) + 1)
+        if full:
+            self.metrics["telemetry_full_frames_total"] = (
+                self.metrics.get("telemetry_full_frames_total", 0) + 1)
+        self.metrics["telemetry_frame_keys_last"] = n_keys
+        # sync the bookkeeping counters into the delta baseline: they
+        # just changed AFTER the frame was built, and without this
+        # every subsequent "idle" frame would carry exactly them —
+        # they ship on full snapshots instead
+        for key in ("telemetry_frames_sent_total",
+                    "telemetry_full_frames_total",
+                    "telemetry_frame_keys_last"):
+            if key in self.metrics:
+                self._tele_sent[key] = self.metrics[key]
+        return frame
+
+    def _refresh_server_groups(self):
+        """Fold THIS process's metric-group snapshots into
+        ``self.metrics`` (workers piggyback theirs on call responses).
+        Shared by the scrape path and the telemetry frame builder — a
+        pod nobody ever scrapes must still ship its server-side
+        replay/admission/channel/resilience counters on heartbeats."""
+        from kubetorch_tpu.observability import prometheus as prom
 
         # Weight-sync restore decomposition. Worker processes report their
         # counters on the call-response channel (process_worker attaches a
@@ -636,6 +749,17 @@ class PodServer:
         san = prom.san_metrics()
         if any(san.values()):
             self._merge_proc_snapshot("san", "server", san)
+
+    async def h_metrics(self, request):
+        healthy = (self.supervisor.healthy()
+                   if self.supervisor is not None else True)
+        from kubetorch_tpu.observability import prometheus as prom
+
+        # lazy session GC rides the scrape cadence too — a pod whose
+        # clients vanished without a bye (and that never sees another
+        # connect) must still release detached sessions' retention
+        self._channel_sessions.sweep()
+        self._refresh_server_groups()
         data = {**self.metrics, "workers_healthy": healthy}
         if prom.wants_prometheus(request):
             # Prometheus/OpenMetrics scrapers (Accept: text/plain...) get
@@ -646,14 +770,22 @@ class PodServer:
                 "service": self.metadata.get("service_name", ""),
                 "pod": env_str("KT_POD_NAME") or "",
             }
+            # exemplars only on a negotiated OpenMetrics scrape: the
+            # classic text format rejects the whole scrape over one
+            om = prom.wants_openmetrics(request)
             return web.Response(
                 text=prom.render([
                     *prom.flatten_metrics(data, labels),
                     # le-labeled call-stage histograms (the flat dict
                     # above carries only their sums/counts)
                     *prom.serving_histogram_samples(labels),
-                ]),
-                content_type="text/plain", charset="utf-8")
+                    # named histograms (engine TTFT etc.), merged
+                    # across worker processes, exemplars included
+                    *prom.hist_samples(self._merged_hists(), labels),
+                ], openmetrics=om),
+                content_type=("application/openmetrics-text" if om
+                              else "text/plain"),
+                charset="utf-8")
         return web.json_response(data)
 
     async def h_app_status(self, request):

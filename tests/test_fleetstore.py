@@ -1,0 +1,841 @@
+"""Fleet telemetry plane: rollup correctness under churn, SLO burn
+windows, exemplars, registry drift, and an e2e over a live controller
+with two pods streaming delta frames through a seeded restart.
+
+The unit half is clock-injected (no sleeps): counter-reset staircase,
+downsample boundary equivalence, cross-pod histogram bucket-merge,
+stale-pod exclusion, delta-frame semantics, breach + recovery. The e2e
+half drives a controller subprocess exactly the way pods do (batched
+``POST /telemetry`` + a WS heartbeat piggyback) and asserts the
+acceptance criteria end to end, including ``ktpu top --once --json``.
+"""
+
+import asyncio
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import httpx
+import pytest
+
+from kubetorch_tpu.observability.fleetstore import (
+    FleetStore,
+    build_frame,
+    hist_quantile,
+)
+from kubetorch_tpu.observability.slo import Objective, SLOEngine
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.level("unit")
+
+
+def _store(clock, **kw):
+    kw.setdefault("raw_s", 120.0)
+    kw.setdefault("mid_s", 900.0)
+    kw.setdefault("retain_s", 3600.0)
+    kw.setdefault("stale_after_s", 30.0)
+    return FleetStore(clock=lambda: clock[0], **kw)
+
+
+def _frame(ts, m=None, h=None):
+    out = {"ts": ts}
+    if m:
+        out["m"] = m
+    if h:
+        out["h"] = h
+    return out
+
+
+# ------------------------------------------------------------- rollups
+class TestRollups:
+    def test_counter_reset_staircase(self):
+        """A pod restart mid-window steps its counters down; the fleet
+        increase must splice (old incarnation's tail + new one's
+        climb), never go negative, and annotate the reset."""
+        clock = [1000.0]
+        store = _store(clock)
+        # p0 climbs 0..40, restarts (drops to 4), climbs to 24:
+        # true increase = 40 + 24 = 64 from first sample
+        values = [0, 10, 20, 30, 40, 4, 14, 24]
+        for i, v in enumerate(values):
+            clock[0] = 1000.0 + i * 5
+            store.ingest("svc", "p0", _frame(
+                clock[0], m={"engine_tokens_total": v}))
+            store.ingest("svc", "p1", _frame(
+                clock[0], m={"engine_tokens_total": 3 * i}))
+        roll = store.fleet("svc", window_s=clock[0] - 1000.0)
+        entry = roll["counters"]["engine_tokens_total"]
+        assert entry["increase"] == pytest.approx(64 + 21)
+        assert entry["rate"] >= 0
+        assert all(r >= 0 for r in entry["by_pod"].values())
+        assert roll["pods"]["p0"]["resets"] == 1
+        assert roll["pods"]["p1"]["resets"] == 0
+        assert roll["pods"]["p0"]["last_reset_age_s"] is not None
+        assert store.resets_total == 1
+        ann = store.pod_annotations("svc")
+        assert ann["p0"]["resets"] == 1 and not ann["p0"]["stale"]
+
+    def test_multiple_resets_still_monotone(self):
+        clock = [0.0]
+        store = _store(clock)
+        total = 0.0
+        last = None
+        for i, v in enumerate([5, 9, 2, 7, 1, 6]):   # resets at 2, 1
+            clock[0] = float(i)
+            store.ingest("s", "p", _frame(clock[0],
+                                          m={"x_total": float(v)}))
+            if last is not None:
+                total += max(0.0, v - last) if v >= last else v
+            last = v
+        roll = store.fleet("s", window_s=10)
+        # increase from first sample (5): 4 + 7 + 6 = 17
+        assert roll["counters"]["x_total"]["increase"] == \
+            pytest.approx(17.0)
+
+    def test_stale_pod_excluded_from_gauge_rollup(self):
+        clock = [0.0]
+        store = _store(clock, stale_after_s=30.0)
+        store.ingest("svc", "fresh", _frame(0.0,
+                                            m={"engine_free_rows": 4}))
+        store.ingest("svc", "gone", _frame(0.0,
+                                           m={"engine_free_rows": 9}))
+        clock[0] = 10.0
+        store.ingest("svc", "fresh", _frame(10.0,
+                                            m={"engine_free_rows": 6}))
+        clock[0] = 100.0   # "gone" last seen 100 s ago
+        store.ingest("svc", "fresh", _frame(100.0,
+                                            m={"engine_free_rows": 5}))
+        roll = store.fleet("svc", window_s=200)
+        assert roll["pods"]["gone"]["stale"] is True
+        assert roll["pods"]["fresh"]["stale"] is False
+        # stale pod's gauge excluded from the fleet sum, still listed
+        assert roll["gauges"]["engine_free_rows"]["sum"] == 5
+        assert roll["gauges"]["engine_free_rows"]["by_pod"]["gone"] == 9
+
+    def test_downsample_boundary_equivalence(self):
+        """Increases computed from the raw ring vs the 10 s/1 m tiers
+        agree within one sample's worth — the tiers keep last-in-bucket
+        adjusted values, so counter math survives downsampling."""
+        clock = [0.0]
+        # tiny raw retention forces mid/long windows onto the tiers
+        store = _store(clock, raw_s=30.0, mid_s=600.0, retain_s=7200.0)
+        rate = 7.0   # units per second, sampled every 2 s
+        for i in range(0, 1200):
+            clock[0] = i * 2.0
+            store.ingest("s", "p", _frame(
+                clock[0], m={"y_total": rate * clock[0]}))
+        now = clock[0]
+        for window in (20.0, 120.0, 1800.0):
+            roll = store.fleet("s", window_s=window)
+            got = roll["counters"]["y_total"]["increase"]
+            expect = rate * window
+            # one 2 s sample of slack at each window edge, plus one
+            # downsample bucket (60 s tier) for the long window
+            slack = rate * (2.0 + (60.0 if window > 600 else 10.0))
+            assert abs(got - expect) <= slack, (window, got, expect)
+        # raw ring actually pruned (the equivalence wasn't vacuous)
+        state = store._pods["s"]["p"].series["y_total"]
+        assert state.raw[0][0] >= now - 31.0
+        assert len(state.t60) > 10
+
+    def test_histogram_bucket_merge_p99(self):
+        """Fleet p99 comes from MERGED bucket increases: with one fast
+        and one slow replica it must land between the per-pod p99s and
+        match a direct computation over the union."""
+        clock = [0.0]
+        store = _store(clock)
+        les = [0.05, 0.1, 0.25, 0.5, 1.0, 2.5]
+        # fast pod: 200 obs all <= 0.1; slow pod: 100 obs, half >= 0.5
+        for step in (1, 2):
+            clock[0] = step * 5.0
+            n_f = 100.0 * step
+            store.ingest("svc", "fast", _frame(clock[0], h={
+                "engine_ttft_seconds": {
+                    "le": les, "b": [n_f * 0.5, n_f, n_f, n_f, n_f, n_f],
+                    "sum": n_f * 0.07, "count": n_f}}))
+            n_s = 50.0 * step
+            store.ingest("svc", "slow", _frame(clock[0], h={
+                "engine_ttft_seconds": {
+                    "le": les,
+                    "b": [0, 0, n_s * 0.5, n_s * 0.5, n_s * 0.9, n_s],
+                    "sum": n_s * 0.6, "count": n_s}}))
+        roll = store.fleet("svc", window_s=10.0)
+        h = roll["histograms"]["engine_ttft_seconds"]
+        assert h["count"] == pytest.approx(150.0)   # window increases
+        p99_fast = h["by_pod_p99"]["fast"]
+        p99_slow = h["by_pod_p99"]["slow"]
+        assert p99_fast < 0.11 and p99_slow > 0.9
+        assert p99_fast < h["p99"] <= p99_slow
+        # direct union computation over the merged increases
+        merged = [b for _, b in h["buckets"]]
+        assert h["p99"] == pytest.approx(
+            hist_quantile(0.99, les, merged, h["count"]), rel=1e-6)
+
+    def test_range_series_rates(self):
+        clock = [0.0]
+        store = _store(clock)
+        for i in range(13):
+            clock[0] = i * 5.0
+            store.ingest("s", "p", _frame(clock[0], m={
+                "z_total": 10.0 * clock[0],    # 10/s
+                "g": float(i)}))
+        out = store.range("s", ["z_total", "g"], start=0.0, end=60.0,
+                          step=20.0)
+        assert [t for t, _ in out["series"]["z_total"]] == \
+            [20.0, 40.0, 60.0]
+        for _, rate in out["series"]["z_total"][1:]:
+            assert rate == pytest.approx(10.0, rel=0.15)
+        # gauge: cross-pod sum at the boundary (one pod → its value)
+        assert out["series"]["g"][-1][1] == pytest.approx(12.0)
+
+    def test_drop_service(self):
+        clock = [0.0]
+        store = _store(clock)
+        store.ingest("s", "p", _frame(0.0, m={"a_total": 1.0}))
+        assert store.services() == ["s"]
+        store.drop("s")
+        assert store.services() == []
+
+
+# -------------------------------------------------------------- frames
+class TestFrames:
+    def test_delta_and_full_semantics(self):
+        sent = {}
+        m1 = {"engine_a_total": 5, "engine_gauge": 1.0,
+              "unrelated_key": 7, "hostname": "x"}
+        f1 = build_frame(m1, {}, last_sent=sent, full=True)
+        # prefix filter: only the telemetry families ship
+        assert set(f1["m"]) == {"engine_a_total", "engine_gauge"}
+        assert f1.get("full") is True
+        # unchanged second frame ships nothing
+        f2 = build_frame(m1, {}, last_sent=sent)
+        assert "m" not in f2
+        # one key moves -> only it ships
+        m1["engine_gauge"] = 2.0
+        f3 = build_frame(m1, {}, last_sent=sent)
+        assert set(f3["m"]) == {"engine_gauge"}
+
+    def test_hist_ships_on_count_change(self):
+        sent = {}
+        h = {"ttft": {"le": [0.1, 1.0], "buckets": [1, 2], "sum": 0.5,
+                      "count": 2.0}}
+        f1 = build_frame({}, h, last_sent=sent, full=True)
+        assert "ttft" in f1["h"] and f1["h"]["ttft"]["b"] == [1.0, 2.0]
+        f2 = build_frame({}, h, last_sent=sent)
+        assert "h" not in f2
+        h["ttft"]["count"] = 3.0
+        f3 = build_frame({}, h, last_sent=sent)
+        assert f3["h"]["ttft"]["count"] == 3.0
+
+    def test_malformed_frame_ingests_what_it_can(self):
+        clock = [0.0]
+        store = _store(clock)
+        n = store.ingest("s", "p", {
+            "ts": 0.0,
+            "m": {"ok_total": 1.0, "bad": "string", "b2": True},
+            "h": {"broken": {"le": [0.1], "b": [1, 2]},   # len mismatch
+                  "fine": {"le": [0.1], "b": [1], "sum": 0.1,
+                           "count": 1}}})
+        assert n >= 2   # ok_total + the fine histogram's series
+        assert store.ingest("", "p", {"m": {}}) == 0
+        assert store.ingest("s", "p", "garbage") == 0
+
+
+# ----------------------------------------------------------------- SLO
+class TestSLO:
+    def _seed_latency(self, store, clock, service, bad=False, steps=3,
+                      base_count=0.0):
+        les = [0.05, 0.25, 1.0, 2.5]
+        for i in range(1, steps + 1):
+            clock[0] += 1.0
+            n = base_count + 40.0 * i
+            if bad:
+                b = [base_count, base_count, base_count + 4.0 * i, n]
+            else:
+                b = [n * 0.9, n, n, n]
+            store.ingest(service, "p0", _frame(clock[0], h={
+                "engine_ttft_seconds": {"le": les, "b": b,
+                                        "sum": n * 0.1, "count": n}}))
+        return base_count + 40.0 * steps
+
+    def test_burn_breach_and_recovery(self):
+        """Injected latency regression: fast-window burn spikes, the
+        objective breaches (event emitted), then good data + an aged
+        fast window recover it (second event)."""
+        clock = [0.0]
+        store = _store(clock)
+        events = []
+        slo = SLOEngine(
+            store,
+            objectives=[Objective(service="svc", name="ttft",
+                                  kind="latency",
+                                  metric="engine_ttft_seconds",
+                                  threshold_ms=250.0, objective=0.99)],
+            fast_s=10.0, slow_s=60.0, clock=lambda: clock[0],
+            on_event=lambda svc, name, breached, st:
+                events.append((svc, name, breached)))
+        slo._started = -3600.0   # windows not clipped by young history
+        count = self._seed_latency(store, clock, "svc", bad=False)
+        status = slo.evaluate()[0]
+        assert status["burn_rate"] < 14.4 and not status["breached"]
+        assert status["error_budget_remaining"] > 0.5
+        # regression: nearly everything lands above 250 ms
+        self._seed_latency(store, clock, "svc", bad=True,
+                           base_count=count)
+        status = slo.evaluate()[0]
+        assert status["burn_rate"] >= 14.4, status
+        assert status["breached"] and status["breach_total"] == 1
+        assert events == [("svc", "ttft", True)]
+        # recovery: good data again, and the bad samples age out of
+        # the 10 s fast window
+        clock[0] += 9.0
+        self._seed_latency(store, clock, "svc", bad=False,
+                           base_count=count + 120.0)
+        status = slo.evaluate()[0]
+        assert not status["breached"]
+        assert events == [("svc", "ttft", True), ("svc", "ttft", False)]
+        # gauges for the scrape
+        samples = {name: (labels, value)
+                   for name, labels, value in slo.prom_samples()}
+        assert samples["slo_breach_total"][1] == 1
+        assert samples["slo_breached"][1] == 0
+
+    def test_ratio_kind_shed_rate(self):
+        clock = [0.0]
+        store = _store(clock)
+        slo = SLOEngine(
+            store,
+            objectives=[Objective(service="svc", name="shed",
+                                  kind="ratio",
+                                  bad="engine_sheds_total",
+                                  total="engine_generations_total",
+                                  objective=0.98, burn_threshold=2.0)],
+            fast_s=30.0, slow_s=30.0, clock=lambda: clock[0])
+        slo._started = -3600.0
+        for i in range(1, 4):
+            clock[0] += 1.0
+            store.ingest("svc", "p0", _frame(clock[0], m={
+                "engine_generations_total": 100.0 * i,
+                "engine_sheds_total": 10.0 * i}))   # 10% shed
+        status = slo.evaluate()[0]
+        # error ratio 0.1 against a 2% budget -> burn 5x >= 2.0
+        assert status["burn_rate"] == pytest.approx(5.0, rel=0.05)
+        assert status["breached"]
+
+    def test_min_events_guard(self):
+        """One slow event on an idle service must not page."""
+        clock = [0.0]
+        store = _store(clock)
+        slo = SLOEngine(
+            store,
+            objectives=[Objective(service="svc", name="ttft",
+                                  kind="latency",
+                                  metric="engine_ttft_seconds",
+                                  threshold_ms=100.0, objective=0.99,
+                                  min_events=10.0)],
+            fast_s=30.0, slow_s=30.0, clock=lambda: clock[0])
+        slo._started = -3600.0
+        les = [0.05, 2.5]
+        for ts, count in ((1.0, 0.0), (2.0, 2.0)):
+            clock[0] = ts
+            store.ingest("svc", "p0", _frame(ts, h={
+                "engine_ttft_seconds": {"le": les, "b": [0.0, count],
+                                        "sum": count, "count": count}}))
+        status = slo.evaluate()[0]
+        assert status["burn_rate"] >= 14.4   # ratio is terrible...
+        assert not status["breached"]        # ...but 2 events < 10
+
+    def test_drop_service_removes_runtime_resets_env(self):
+        """Teardown: runtime-registered objectives go with the service;
+        env-configured ones survive (a redeploy keeps its SLOs) but
+        their breach state resets — no frozen burn on /slo, no spurious
+        recovery event from the empty store."""
+        clock = [0.0]
+        store = _store(clock)
+        events = []
+        env_obj = Objective(service="svc", name="ttft", kind="latency",
+                            metric="engine_ttft_seconds",
+                            threshold_ms=250.0, objective=0.99)
+        slo = SLOEngine(store, objectives=[], fast_s=30.0, slow_s=30.0,
+                        clock=lambda: clock[0],
+                        on_event=lambda *a: events.append(a))
+        slo._started = -3600.0
+        slo.register(env_obj, source="env")
+        slo.register(Objective(service="svc", name="shed", kind="ratio",
+                               bad="engine_sheds_total",
+                               total="engine_generations_total",
+                               objective=0.98))
+        # breach the env objective, then tear the service down
+        self._seed_latency(store, clock, "svc", bad=True)
+        assert next(s for s in slo.evaluate()
+                    if s["name"] == "ttft")["breached"]
+        slo.drop_service("svc")
+        names = {o.name for o in slo.objectives("svc")}
+        assert names == {"ttft"}           # runtime objective gone
+        status = slo.status("svc")[0]
+        assert status["breached"] is False  # state reset, not frozen
+        n_events = len(events)
+        store.drop("svc")
+        slo.evaluate()                      # empty store, clean state
+        assert len(events) == n_events      # no spurious recovery
+
+    def test_env_objective_parsing(self, monkeypatch):
+        monkeypatch.setenv("KT_SLO", json.dumps([
+            {"service": "a", "name": "ttft", "kind": "latency",
+             "metric": "engine_ttft_seconds", "threshold_ms": 500,
+             "objective": 0.99}]))
+        from kubetorch_tpu.observability.slo import objectives_from_env
+
+        objs = objectives_from_env()
+        assert len(objs) == 1 and objs[0].budget == pytest.approx(0.01)
+        monkeypatch.setenv("KT_SLO", json.dumps(
+            [{"service": "a", "name": "x", "kind": "latency"}]))
+        with pytest.raises(ValueError):
+            objectives_from_env()
+
+    def test_objective_validation(self):
+        with pytest.raises(ValueError):
+            Objective(service="s", name="n", kind="nope").validate()
+        with pytest.raises(ValueError):
+            Objective(service="s", name="n", kind="ratio",
+                      total="t_total", bad="b_total",
+                      objective=1.5).validate()
+
+
+# ---------------------------------------------------- pod frame builder
+class TestPodServerFrames:
+    def test_delta_then_idle_then_full(self):
+        """The pod server's frame builder: first frame full, idle
+        beats ship nothing (the bookkeeping counters must not dirty
+        the delta), a moved counter ships alone, and the periodic
+        full-snapshot cadence re-ships everything."""
+        from kubetorch_tpu.serving.server import PodServer
+
+        srv = PodServer(metadata={"service_name": "svc"})
+        srv.metrics["engine_tokens_total"] = 100.0
+        f1 = srv._telemetry_frame()
+        assert f1 and f1.get("full") is True
+        assert f1["m"]["engine_tokens_total"] == 100.0
+        assert f1["m"]["http_requests_total"] == 0
+        # idle: nothing moved -> a bare ts-only frame STILL ships (the
+        # frame arrival is the fleet store's freshness clock; a
+        # suppressed frame would read a healthy idle replica as stale)
+        f2 = srv._telemetry_frame()
+        assert f2 is not None and "m" not in f2 and "h" not in f2
+        assert f2["ts"] > 0
+        srv.metrics["engine_tokens_total"] = 150.0
+        f3 = srv._telemetry_frame()
+        assert set(f3["m"]) == {"engine_tokens_total"}
+        assert "full" not in f3
+        # explicit full re-ships the whole surface
+        f4 = srv._telemetry_frame(full=True)
+        assert f4["m"]["engine_tokens_total"] == 150.0
+        assert "telemetry_frames_sent_total" in f4["m"]
+        # every frame counts, the idle bare one included — it shipped
+        assert srv.metrics["telemetry_frames_sent_total"] == 4
+
+    def test_worker_hist_merge_rides_frames(self):
+        """A worker's piggybacked named-histogram snapshot merges with
+        the server's own and ships in the telemetry frame. Uses the
+        recorder's real bucket ladder — earlier in-process engine tests
+        may already have seeded the family, and a mismatched ladder is
+        deliberately skipped by the merge."""
+        from kubetorch_tpu.observability import prometheus as prom
+        from kubetorch_tpu.serving.server import PodServer
+
+        les = list(prom._HIST_BUCKETS)
+        n = len(les)
+
+        def snap(count):
+            buckets = [count if le >= 0.1 else count * 0.5
+                       for le in les]
+            return {"engine_ttft_seconds": {
+                "le": list(les), "buckets": buckets,
+                "sum": count * 0.1, "count": count,
+                "ex": [{"trace_id": "t1", "value": 0.05, "ts": 5.0}]
+                      + [None] * n}}
+
+        srv = PodServer(metadata={"service_name": "svc"})
+        own = prom.hist_metrics().get("engine_ttft_seconds",
+                                      {"count": 0.0})["count"]
+        srv._merge_worker_stats({"hists": {"pid": 1234, "h": snap(5.0)}})
+        merged = srv._merged_hists()
+        assert merged["engine_ttft_seconds"]["count"] == \
+            pytest.approx(5.0 + own)
+        frame = srv._telemetry_frame()
+        assert frame["h"]["engine_ttft_seconds"]["count"] >= 5.0
+        # an updated worker snapshot replaces (not double-counts) the
+        # old one
+        srv._merge_worker_stats({"hists": {"pid": 1234, "h": snap(8.0)}})
+        own = prom.hist_metrics().get("engine_ttft_seconds",
+                                      {"count": 0.0})["count"]
+        merged = srv._merged_hists()
+        assert merged["engine_ttft_seconds"]["count"] == \
+            pytest.approx(8.0 + own)
+
+
+# ---------------------------------------------------- exemplars + docs
+class TestRegistryAndExemplars:
+    def test_exemplar_rendered_on_named_hist(self):
+        """Exemplars emit ONLY on a negotiated OpenMetrics render —
+        the classic 0.0.4 text format treats a mid-line `#` as a parse
+        error and a real Prometheus would reject the whole scrape."""
+        from kubetorch_tpu.observability import prometheus as prom
+
+        prom.record_hist("engine_ttft_seconds", 0.3,
+                         trace_id="feedbeef" * 4)
+        samples = list(prom.hist_samples(prom.hist_metrics(),
+                                         {"pod": "p0"}))
+        text = prom.render(samples, openmetrics=True)
+        assert 'le="0.5"' in text
+        assert '# {trace_id="' + "feedbeef" * 4 + '"}' in text
+        assert "# HELP kubetorch_engine_ttft_seconds " in text
+        assert text.rstrip().endswith("# EOF")
+        classic = prom.render(samples)
+        assert "trace_id=" not in classic
+        assert "# EOF" not in classic
+
+    def test_call_stage_exemplar_from_ambient_span(self):
+        from kubetorch_tpu.observability import prometheus as prom
+        from kubetorch_tpu.observability import tracing
+
+        with tracing.span("exemplar.test") as sp:
+            trace_id = sp.span["trace_id"]
+            prom.record_call_stage("device", 0.02)
+        text = prom.render(list(
+            prom.serving_histogram_samples({"pod": "p0"})),
+            openmetrics=True)
+        assert f'# {{trace_id="{trace_id}"}}' in text
+
+    def test_metric_docs_not_drifted(self):
+        """docs/observability.md's tables are generated from the
+        registry; a registry edit without `ktpu metrics --gen-docs`
+        fails here (mirror of the configuration.md drift test)."""
+        from kubetorch_tpu.observability import registry
+
+        on_disk = (REPO / "docs" / "observability.md").read_text()
+        assert registry.splice_metric_tables(on_disk) == on_disk, (
+            "docs/observability.md metric tables are stale — "
+            "regenerate with `ktpu metrics --gen-docs`")
+        # every registry group has a marker in the doc (a new group
+        # silently undocumented is the drift this kills)
+        present = set(registry.doc_groups_in(on_disk))
+        missing = set(registry.GROUP_ORDER) - present
+        assert not missing, f"groups missing from observability.md: " \
+                            f"{sorted(missing)}"
+
+    def test_registry_covers_prometheus_families(self):
+        """Every family the prometheus module actually records must be
+        registered (name drift between code and registry fails here)."""
+        from kubetorch_tpu.observability import prometheus as prom
+        from kubetorch_tpu.observability import registry
+        from kubetorch_tpu.observability.tracing import trace_metrics
+
+        names = set()
+        names.update(f"data_store_{k}" for k in prom.restore_metrics())
+        names.update(f"data_store_{k}" for k in prom.wire_metrics())
+        names.update(k for k in prom.serving_metrics()
+                     if not k.startswith("serving_call_"))
+        names.update(prom.reliability_metrics())
+        names.update(prom.engine_metrics())
+        names.update(prom.resilience_metrics())
+        names.update(prom.san_metrics())
+        names.update(trace_metrics())
+        names.update(f"serving_call_{s}_seconds" for s in
+                     prom.CALL_STAGES)
+        missing = {n for n in names if registry.lookup(n) is None}
+        assert not missing, f"unregistered metric families: " \
+                            f"{sorted(missing)}"
+
+
+# ------------------------------------------------------------------ e2e
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_health(base: str, proc, attempts: int = 300):
+    for _ in range(attempts):
+        if proc.poll() is not None:
+            raise RuntimeError(f"controller exited rc={proc.returncode}")
+        try:
+            if httpx.get(f"{base}/health", timeout=2.0).status_code == 200:
+                return
+        except httpx.HTTPError:
+            pass
+        time.sleep(0.2)
+    raise RuntimeError(f"{base}/health never answered")
+
+
+LES = [0.05, 0.1, 0.25, 0.5, 1.0, 2.5]
+
+
+def _pod_frame(ts, tokens, count, slow=False, rows_active=3.0):
+    """One telemetry frame shaped like a real pod's."""
+    if slow:
+        buckets = [0.0, 0.0, 0.0, count * 0.1, count * 0.5, count]
+    else:
+        buckets = [count * 0.8, count, count, count, count, count]
+    return {
+        "ts": ts,
+        "m": {"engine_tokens_total": tokens,
+              "engine_generations_total": count,
+              "engine_active_rows": rows_active,
+              "engine_free_rows": 8.0 - rows_active,
+              "engine_queue_depth": 2.0,
+              "kv_blocks_used": 40.0},
+        "h": {"engine_ttft_seconds": {
+            "le": LES, "b": buckets, "sum": count * 0.1,
+            "count": count}},
+    }
+
+
+@pytest.mark.level("minimal")
+def test_fleet_e2e_two_pods_restart_breach_and_top(tmp_path, monkeypatch):
+    """Acceptance e2e: two pods stream engine/KV deltas to a live
+    controller → /metrics/fleet returns correct cross-pod rollups
+    through a seeded pod restart (no negative rates); an injected TTFT
+    regression trips the fast-window burn gauge and a breach event
+    within 2 evaluation ticks; `ktpu top --once --json` reflects both;
+    recovery lands after good data; the WS heartbeat piggyback ingests
+    too."""
+    port = _free_port()
+    slo_spec = json.dumps([
+        {"service": "fleetsvc", "name": "ttft", "kind": "latency",
+         "metric": "engine_ttft_seconds", "threshold_ms": 250,
+         "objective": 0.99}])
+    env = {**os.environ,
+           "KT_HEARTBEAT_S": "0.4",     # sweep (= SLO eval) every 0.2 s
+           "KT_SLO": slo_spec,
+           "KT_SLO_FAST_S": "3",
+           "KT_SLO_SLOW_S": "20",
+           "KT_AUTO_RESTART": "0"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.controller.server",
+         "--host", "127.0.0.1", "--port", str(port), "--db", ":memory:"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    url = f"http://127.0.0.1:{port}"
+    try:
+        _wait_health(url, proc)
+        t0 = time.time()
+
+        def push(pod, frame):
+            resp = httpx.post(f"{url}/telemetry",
+                              json={"service": "fleetsvc", "pod": pod,
+                                    "frames": [frame]}, timeout=5.0)
+            assert resp.status_code == 200, resp.text
+            return resp.json()
+
+        # ---- phase A: both pods healthy, counters climbing ----------
+        for i in range(1, 5):
+            now = time.time()
+            push("pod-0", _pod_frame(now, tokens=1000.0 * i,
+                                     count=50.0 * i))
+            push("pod-1", _pod_frame(now, tokens=500.0 * i,
+                                     count=25.0 * i))
+            time.sleep(0.1)
+        # ---- seeded restart: pod-0's counters step DOWN -------------
+        for i in range(1, 4):
+            now = time.time()
+            push("pod-0", _pod_frame(now, tokens=100.0 * i,
+                                     count=5.0 * i))
+            push("pod-1", _pod_frame(now, tokens=500.0 * (4 + i),
+                                     count=25.0 * (4 + i)))
+            time.sleep(0.1)
+        fleet = httpx.get(f"{url}/metrics/fleet/fleetsvc",
+                          params={"window": 30}, timeout=5.0).json()
+        tok = fleet["counters"]["engine_tokens_total"]
+        # pod-0: 1000→4000 then restart 100→300 = 3300; pod-1:
+        # 500→3500 = 3000 (both measured from their first sample)
+        assert tok["increase"] == pytest.approx(6300.0)
+        assert tok["rate"] > 0
+        assert all(r >= 0 for r in tok["by_pod"].values())
+        assert fleet["pods"]["pod-0"]["resets"] >= 1
+        assert fleet["pods"]["pod-1"]["resets"] == 0
+        assert fleet["gauges"]["kv_blocks_used"]["sum"] == 80.0
+        assert fleet["histograms"]["engine_ttft_seconds"]["p99"] < 0.25
+        # the blind-polling fix: /metrics/query carries the annotations
+        httpx.post(f"{url}/metrics/push",
+                   json={"service": "fleetsvc", "pod": "pod-0",
+                         "metrics": {"http_requests_total": 1}},
+                   timeout=5.0)
+        q = httpx.get(f"{url}/metrics/query/fleetsvc", timeout=5.0).json()
+        assert q["annotations"]["pod-0"]["resets"] >= 1
+        assert "age_s" in q["pods"]["pod-0"]
+        # SLO healthy so far (give one eval tick)
+        time.sleep(0.5)
+        slo = httpx.get(f"{url}/slo/fleetsvc", timeout=5.0).json()
+        assert slo["objectives"][0]["breached"] is False
+
+        # ---- phase B: injected TTFT regression ----------------------
+        base0, base1 = 15.0, 175.0
+        for i in range(1, 5):
+            now = time.time()
+            push("pod-0", _pod_frame(now, tokens=300.0 + 10 * i,
+                                     count=base0 + 40.0 * i, slow=True))
+            push("pod-1", _pod_frame(now, tokens=3500.0 + 10 * i,
+                                     count=base1 + 40.0 * i, slow=True))
+            time.sleep(0.1)
+        # breach within 2 evaluation ticks (sweep = 0.2 s; generous
+        # wall budget for a loaded CI host)
+        breach_deadline = time.time() + 3.0
+        breached = None
+        while time.time() < breach_deadline:
+            slo = httpx.get(f"{url}/slo/fleetsvc", timeout=5.0).json()
+            breached = slo["objectives"][0]
+            if breached["breached"]:
+                break
+            time.sleep(0.1)
+        assert breached and breached["breached"], breached
+        assert breached["burn_rate"] >= 14.4
+        # breach event landed in the sink next to resilience events
+        logs = httpx.get(f"{url}/logs/query",
+                         params={"service": "fleetsvc"},
+                         timeout=5.0).json()["entries"]
+        assert any((e.get("labels") or {}).get("reason") == "SloBreach"
+                   for e in logs), logs
+
+        # ---- ktpu top --once --json reflects both -------------------
+        from click.testing import CliRunner
+
+        from kubetorch_tpu.cli import main as cli_main
+
+        monkeypatch.setenv("KT_CONTROLLER_URL", url)
+        result = CliRunner().invoke(
+            cli_main, ["top", "fleetsvc", "--once", "--json"])
+        assert result.exit_code == 0, result.output
+        snapshot = json.loads(result.output)
+        svc = snapshot["fleetsvc"]
+        assert set(svc["fleet"]["pods"]) == {"pod-0", "pod-1"}
+        assert svc["fleet"]["pods"]["pod-0"]["resets"] >= 1
+        assert svc["slo"][0]["breached"] is True
+        # human-rendered form mentions the reset + breach
+        rendered = CliRunner().invoke(
+            cli_main, ["top", "fleetsvc", "--once"])
+        assert rendered.exit_code == 0, rendered.output
+        assert "BREACH" in rendered.output
+
+        # ---- controller exposition joins fleet_* + slo_* ------------
+        text = httpx.get(f"{url}/metrics", timeout=5.0,
+                         headers={"Accept": "text/plain"}).text
+        assert "kubetorch_fleet_engine_tokens_per_s" in text
+        assert 'kubetorch_slo_burn_rate{service="fleetsvc"' in text
+        assert "kubetorch_fleet_resets_total" in text
+
+        # ---- WS heartbeat piggyback (third pod) ---------------------
+        async def ws_beat():
+            import aiohttp
+
+            async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(
+                        total=None, sock_connect=10.0)) as session:
+                async with session.ws_connect(
+                        f"ws://127.0.0.1:{port}/ws/pods",
+                        heartbeat=30.0) as ws:
+                    await ws.send_json({
+                        "type": "register", "pod_name": "pod-ws",
+                        "service_name": "fleetsvc", "url": ""})
+                    await ws.receive_json()   # registered ack
+                    await ws.send_json({
+                        "type": "heartbeat",
+                        "telemetry": _pod_frame(time.time(),
+                                                tokens=1.0, count=1.0)})
+
+        asyncio.run(ws_beat())
+        deadline = time.time() + 3.0
+        while time.time() < deadline:
+            fleet = httpx.get(f"{url}/metrics/fleet/fleetsvc",
+                              params={"window": 60}, timeout=5.0).json()
+            if "pod-ws" in fleet["pods"]:
+                break
+            time.sleep(0.1)
+        assert "pod-ws" in fleet["pods"]
+
+        # ---- phase C: recovery --------------------------------------
+        for i in range(1, 6):
+            now = time.time()
+            push("pod-0", _pod_frame(now, tokens=400.0 + i,
+                                     count=175.0 + 80.0 * i))
+            push("pod-1", _pod_frame(now, tokens=3600.0 + i,
+                                     count=335.0 + 80.0 * i))
+            time.sleep(0.3)
+        # the 3 s fast window must age the bad samples out
+        recover_deadline = time.time() + 6.0
+        recovered = False
+        while time.time() < recover_deadline:
+            slo = httpx.get(f"{url}/slo/fleetsvc", timeout=5.0).json()
+            if not slo["objectives"][0]["breached"]:
+                recovered = True
+                break
+            now = time.time()
+            push("pod-0", _pod_frame(now, tokens=500.0,
+                                     count=575.0 + (now - t0)))
+            time.sleep(0.3)
+        assert recovered, slo
+        logs = httpx.get(f"{url}/logs/query",
+                         params={"service": "fleetsvc"},
+                         timeout=5.0).json()["entries"]
+        assert any((e.get("labels") or {}).get("reason") ==
+                   "SloRecovered" for e in logs)
+    finally:
+        proc.terminate()
+        proc.wait(5)
+
+
+@pytest.mark.level("minimal")
+def test_slo_runtime_registration_and_range(tmp_path):
+    """POST /slo registers an objective at runtime; /metrics/fleet/
+    {service}/range returns aligned series; bad params answer 400."""
+    port = _free_port()
+    env = {**os.environ, "KT_HEARTBEAT_S": "0.4", "KT_AUTO_RESTART": "0"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.controller.server",
+         "--host", "127.0.0.1", "--port", str(port), "--db", ":memory:"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    url = f"http://127.0.0.1:{port}"
+    try:
+        _wait_health(url, proc)
+        resp = httpx.post(f"{url}/slo", json={
+            "service": "svc2", "name": "shed", "kind": "ratio",
+            "bad": "engine_sheds_total",
+            "total": "engine_generations_total",
+            "objective": 0.98, "burn_threshold": 2.0}, timeout=5.0)
+        assert resp.status_code == 200, resp.text
+        assert httpx.post(f"{url}/slo", json={"service": "svc2"},
+                          timeout=5.0).status_code == 400
+        for i in range(1, 5):
+            httpx.post(f"{url}/telemetry", json={
+                "service": "svc2", "pod": "p0", "frames": [{
+                    "ts": time.time(),
+                    "m": {"engine_generations_total": 100.0 * i,
+                          "engine_sheds_total": 20.0 * i}}]},
+                timeout=5.0)
+            time.sleep(0.15)
+        deadline = time.time() + 3.0
+        status = None
+        while time.time() < deadline:
+            status = httpx.get(f"{url}/slo/svc2",
+                               timeout=5.0).json()["objectives"]
+            if status and status[0].get("breached"):
+                break
+            time.sleep(0.1)
+        assert status and status[0]["breached"], status
+        rng = httpx.get(
+            f"{url}/metrics/fleet/svc2/range",
+            params={"metrics": "engine_generations_total", "step": 1},
+            timeout=5.0).json()
+        series = rng["series"]["engine_generations_total"]
+        assert series and all(v >= 0 for _, v in series)
+        assert httpx.get(f"{url}/metrics/fleet/svc2/range",
+                         timeout=5.0).status_code == 400
+        assert httpx.get(f"{url}/metrics/fleet/nosuch",
+                         timeout=5.0).status_code == 404
+    finally:
+        proc.terminate()
+        proc.wait(5)

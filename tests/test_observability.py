@@ -825,13 +825,22 @@ _EXPO_LINE = re.compile(
 def _assert_exposition_parses(text: str):
     names = set()
     for line in text.strip().splitlines():
+        if line.startswith("# HELP "):
+            # registry-sourced HELP text: name + free text
+            assert len(line.split()) >= 3, line
+            continue
+        if line == "# EOF":
+            continue    # OpenMetrics terminator
         if line.startswith("# TYPE "):
             parts = line.split()
             assert len(parts) == 4 and parts[3] in (
                 "gauge", "counter", "histogram"), line
             continue
-        assert _EXPO_LINE.match(line), f"bad exposition line: {line!r}"
-        names.add(line.split("{")[0].split(" ")[0])
+        # OpenMetrics exemplars ride bucket lines as
+        # `... # {trace_id="..."} value ts` — strip before matching
+        sample = line.split(" # ")[0]
+        assert _EXPO_LINE.match(sample), f"bad exposition line: {line!r}"
+        names.add(sample.split("{")[0].split(" ")[0])
     return names
 
 

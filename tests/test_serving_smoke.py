@@ -63,6 +63,15 @@ EXPECTED_KEYS = {
     "kv_park_ms",
     "kv_resume_ttft_ms",
     "kv_resume_ttft_chunks",
+    # fleet telemetry plane (ISSUE 13): what the heartbeat piggyback
+    # costs and what one SLO evaluation sweep costs
+    "telemetry_frames",
+    "telemetry_frame_bytes_avg",
+    "telemetry_build_us_per_frame",
+    "telemetry_ingest_us_per_frame",
+    "telemetry_ingest_overhead_pct",
+    "slo_eval_ms",
+    "slo_objectives",
 }
 
 
@@ -132,5 +141,14 @@ def test_serving_dryrun_metric_keys():
     # chunk (CI headroom: 4), not the prompt's full chunked prefill
     assert out["kv_resume_ttft_chunks"] <= 4.0, out["kv_resume_ttft_chunks"]
     assert out["kv_resume_ttft_ms"] < 0.5 * out["kv_unparked_ttft_ms"]
+    # fleet telemetry plane: the heartbeat piggyback (frame build +
+    # controller ingest) must stay under 3% of a heartbeat tick, and an
+    # SLO evaluation sweep must be cheap enough for the resilience
+    # sweep cadence (bench_telemetry also asserts the 3% bound itself)
+    assert 0 < out["telemetry_ingest_overhead_pct"] < 3.0, (
+        out["telemetry_ingest_overhead_pct"])
+    assert out["telemetry_build_us_per_frame"] > 0
+    assert 0 < out["slo_eval_ms"] < 250.0, out["slo_eval_ms"]
+    assert out["slo_objectives"] >= 1
     # dryrun toy values must never be compared against prior rounds
     assert "rolling_tok_s_tunnel_wall" not in out
