@@ -551,7 +551,7 @@ def _top_gather(controller, service, window):
 
 def _top_rows(fleet):
     """Per-replica rows from a fleet rollup: (pod, occupancy, queue,
-    kv blocks, tok/s, ttft p99 ms, status)."""
+    kv blocks, tok/s, spec accept rate, ttft p99 ms, status)."""
     gauges = fleet.get("gauges") or {}
     counters = fleet.get("counters") or {}
     hists = fleet.get("histograms") or {}
@@ -569,6 +569,9 @@ def _top_rows(fleet):
         queue = by_pod(gauges, "engine_queue_depth", pod)
         kv = by_pod(gauges, "kv_blocks_used", pod)
         tok_s = by_pod(counters, "engine_tokens_total", pod)
+        # speculation: draft acceptance on the pod ("—" on spec-off
+        # engines, which never publish the gauge)
+        acc = by_pod(gauges, "engine_spec_accept_rate", pod)
         p99 = ((hists.get("engine_ttft_seconds") or {})
                .get("by_pod_p99") or {}).get(pod)
         if meta.get("stale"):
@@ -582,6 +585,7 @@ def _top_rows(fleet):
                      f"{queue:g}" if queue is not None else "—",
                      f"{kv:g}" if kv is not None else "—",
                      f"{tok_s:.1f}" if tok_s is not None else "—",
+                     f"{acc * 100:.0f}%" if acc is not None else "—",
                      f"{p99 * 1e3:.0f}" if p99 is not None else "—",
                      status))
     return rows
@@ -608,12 +612,12 @@ def _top_render(snapshot, window):
             lines.append("  (no telemetry yet)")
             continue
         lines.append(f"  {'replica':<28}{'rows':>9}{'queue':>7}"
-                     f"{'kv blk':>8}{'tok/s':>9}{'ttft p99':>10}"
-                     f"  status")
+                     f"{'kv blk':>8}{'tok/s':>9}{'accept':>8}"
+                     f"{'ttft p99':>10}  status")
         for row in _top_rows(fleet):
-            pod, occ, queue, kv, tok_s, p99, status = row
+            pod, occ, queue, kv, tok_s, acc, p99, status = row
             lines.append(f"  {pod:<28}{occ:>9}{queue:>7}{kv:>8}"
-                         f"{tok_s:>9}{p99:>10}  {status}")
+                         f"{tok_s:>9}{acc:>8}{p99:>10}  {status}")
     return "\n".join(lines) if lines else "(no services)"
 
 

@@ -369,6 +369,26 @@ _knob("KT_KV_SESSION_DELTA", "bool", True,
       "ships only its new blocks (per-block leaves + PR-3 delta).",
       "engine-kv")
 
+# --- speculative scheduling (per-row adaptive lookahead in the engine) ------
+_knob("KT_SPEC_K_MAX", "int", 8,
+      "Maximum per-row speculative lookahead (verify-forward width: 1 "
+      "carried token + k-1 prompt-lookup drafts). Each row's k adapts "
+      "between 1 and this via its acceptance EMA; the default for "
+      "RollingGenerator(spec_k=None).", "engine-spec")
+_knob("KT_SPEC_NGRAM", "int", 3,
+      "N-gram length of the prompt-lookup draft matcher (the last N "
+      "context tokens are matched against earlier occurrences).",
+      "engine-spec")
+_knob("KT_SPEC_EMA_ALPHA", "float", 0.25,
+      "Weight of one verify round's acceptance in the per-row EMA that "
+      "drives k adaptation (higher = faster regime tracking, noisier).",
+      "engine-spec")
+_knob("KT_SPEC_OCCUPANCY_THROTTLE", "float", 0.85,
+      "Row occupancy at/above which the engine driver caps every row's "
+      "lookahead at 1 (compute-bound regime: verify width stops being "
+      "free); below it the cap lifts and high-accept rows regrow "
+      "toward KT_SPEC_K_MAX.", "engine-spec")
+
 # --- concurrency sanitizer (kubetorch_tpu/analysis/san.py, `ktpu san`) ------
 _knob("KT_SAN", "bool", False,
       "Enable the runtime concurrency sanitizer: instrument lock "

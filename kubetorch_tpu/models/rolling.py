@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubetorch_tpu.config import env_float, env_int
+from kubetorch_tpu.lookahead import LookaheadState, spec_stats_dict
 from kubetorch_tpu.models import llama
 from kubetorch_tpu.models.configs import LlamaConfig
 from kubetorch_tpu.models.generate import filter_logits
@@ -101,8 +103,9 @@ class RollingGenerator:
                  top_p: Optional[float] = None, seed: int = 0,
                  steps_per_call: int = 8, admit_width: int = 0,
                  adapters=None, adapter_scale: Optional[float] = None,
-                 kv_dtype: str = "bf16", spec_k: int = 0,
-                 spec_ngram: int = 3,
+                 kv_dtype: str = "bf16", spec_k: Optional[int] = 0,
+                 spec_ngram: Optional[int] = None,
+                 spec_ema_alpha: Optional[float] = None,
                  prefill_chunk: Optional[int] = None):
         """``kv_dtype="int8"``: per-vector-quantized grid — halves the
         serving cache's stream and residency, moving the slot ceiling the
@@ -112,19 +115,38 @@ class RollingGenerator:
 
         ``spec_k > 1``: speculative continuous batching — each decode
         "step" becomes a VERIFY ROUND: per-slot prompt-lookup (n-gram)
-        drafts of ``spec_k − 1`` tokens ride one chunk-mode forward of
-        ``spec_k`` tokens, and only each slot's accepted prefix merges
-        into the grid (``models/speculative.py`` machinery, per-slot
-        depths). Greedy output stays token-identical to the plain engine;
-        ``steps_per_call`` then counts rounds per dispatch, so one
-        dispatch can emit up to ``steps_per_call × spec_k`` tokens per
-        slot. Decode is weight-bound below the compute roofline, so at
-        low-to-mid occupancy every accepted draft is nearly free — this
-        is the latency-regime lever vLLM gets from its n-gram speculator.
+        drafts ride one chunk-mode forward, and only each slot's
+        accepted prefix merges into the grid
+        (``models/speculative.py`` machinery, per-slot depths). Greedy
+        output stays token-identical to the plain engine;
+        ``steps_per_call`` then counts rounds per dispatch. Decode is
+        weight-bound below the compute roofline, so at low-to-mid
+        occupancy every accepted draft is nearly free — this is the
+        latency-regime lever vLLM gets from its n-gram speculator.
+
+        ``spec_k`` is the MAXIMUM per-row lookahead (``None`` reads
+        ``KT_SPEC_K_MAX``): each row carries its OWN ``k``, driven by
+        a per-row acceptance-rate EMA (``spec_ema_alpha`` /
+        ``KT_SPEC_EMA_ALPHA``; state machine in
+        ``kubetorch_tpu/lookahead.py``) — high-accept rows grow toward
+        ``spec_k``, random-text rows collapse to ``k = 1`` (plain
+        decode: no drafts offered, no verify FLOPs wasted). Rows at
+        different ``k`` coexist in one dispatch: the forward runs at
+        the power-of-two width covering the widest active row and
+        per-slot masking forced-rejects positions past each row's
+        ``k`` — rejected drafts never merge. ``spec_cap`` /
+        :meth:`set_spec_cap` is the serving scheduler's occupancy
+        throttle (cap 1 = every row clamps to plain decode while the
+        batch is compute-bound).
+
         Composes with the int8 grid (verify reads int8 grid + bf16 chunk;
-        accepted prefixes quantize at the merge) and per-request LoRA
+        accepted prefixes quantize at the merge), per-request LoRA
         (the adapter one-hot rides the verify forward; drafting is
-        model-free). ``temperature > 0`` runs exact per-slot speculative
+        model-free), shared prefixes (the prefix tokens seed the draft
+        haystack), and CHUNKED PREFILL (the haystack seeds when the
+        prompt's last chunk lands and the row activates — a long
+        prompt never stalls the speculating rows around it).
+        ``temperature > 0`` runs exact per-slot speculative
         REJECTION sampling (drafts accepted with probability ``p(draft)``
         under the filtered distribution; rejections draw from the
         residual — the emitted stream is distributed exactly as
@@ -139,7 +161,7 @@ class RollingGenerator:
         prompt never stalls token emission for the live rows. ``None``
         (default) keeps the one-shot bucketed admission path everywhere;
         requests with ``prefix_id`` (their context is mostly
-        pre-computed) and speculative engines keep it regardless."""
+        pre-computed) keep it regardless."""
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
@@ -180,20 +202,20 @@ class RollingGenerator:
         if kv_dtype not in ("bf16", "int8"):
             raise ValueError(f"kv_dtype must be 'bf16' or 'int8', "
                              f"got {kv_dtype!r}")
+        if spec_k is None:
+            spec_k = env_int("KT_SPEC_K_MAX")
         if spec_k < 0 or spec_k == 1:
             raise ValueError("spec_k must be 0 (off) or >= 2")
-        if prefill_chunk is not None and spec_k > 1:
-            # the spec engine seeds a device-resident draft context at
-            # admission; feeding it incrementally is future work
-            raise ValueError("prefill_chunk is not supported with "
-                             "speculative decoding (spec_k > 1)")
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, "
                              f"got {prefill_chunk}")
         self.prefill_chunk = prefill_chunk
         self.kv_quantized = kv_dtype == "int8"
         self.spec_k = spec_k
-        self.spec_ngram = spec_ngram
+        self.spec_ngram = (spec_ngram if spec_ngram is not None
+                           else env_int("KT_SPEC_NGRAM"))
+        self.spec_ema_alpha = (spec_ema_alpha if spec_ema_alpha is not None
+                               else env_float("KT_SPEC_EMA_ALPHA"))
         self.spec = spec_k > 1
         self.cache = llama.init_cache(cfg, max_slots, self.max_len,
                                       quantized=self.kv_quantized)
@@ -217,9 +239,16 @@ class RollingGenerator:
             # acceptance accounting for the serving bench / stats API
             self._spec_rounds = 0
             self._spec_emitted = 0
+            self._spec_drafted = 0
             # sticky: flips True on the first sampled request (see
             # _decode_spec_chunk)
             self._spec_sampling = False
+            # per-row adaptive lookahead: slot -> LookaheadState
+            # (created at admission/activation, dropped with the row);
+            # spec_cap is the serving scheduler's occupancy throttle
+            # (0 = uncapped, 1 = clamp every row to plain decode)
+            self._spec_state: Dict[int, LookaheadState] = {}
+            self.spec_cap = 0
 
         # host bookkeeping
         self._free = list(range(max_slots))
@@ -316,12 +345,35 @@ class RollingGenerator:
     def spec_stats(self) -> Dict[str, float]:
         """Cumulative speculative acceptance: ``tokens_per_pass`` is the
         wall-clock-free speedup bound (each verify pass costs ≈ one
-        plain decode step in the weight-bound regime)."""
+        plain decode step in the weight-bound regime);
+        ``accept_rate`` = accepted drafts / drafts offered, and
+        ``verify_waste`` its complement in positions — the verify FLOPs
+        the per-row adaptation exists to stop spending; ``k_mean`` the
+        live rows' mean lookahead."""
         if not self.spec:
             return {}
-        r, e = self._spec_rounds, self._spec_emitted
-        return {"rounds": r, "emitted": e,
-                "tokens_per_pass": e / r if r else 0.0}
+        return spec_stats_dict(self._spec_rounds, self._spec_emitted,
+                               self._spec_drafted, self.spec_row_ks(),
+                               self.spec_k, self.spec_cap)
+
+    def set_spec_cap(self, cap: int) -> None:
+        """Occupancy throttle (serving scheduler): cap every row's
+        lookahead at ``cap`` (0 = uncapped). Takes effect at the next
+        decode chunk — rows above the cap clamp immediately."""
+        if self.spec:
+            self.spec_cap = max(0, int(cap))
+
+    def spec_row_ks(self) -> List[int]:
+        """Live rows' current per-row lookahead (metrics / bench).
+        Read LOCK-FREE by the serving path's stats/control-frame
+        pollers while the driver thread admits and frees rows, so the
+        dicts are snapshotted (``list()`` is atomic under the GIL) and
+        indexed with ``get`` — a row freed mid-read just drops out."""
+        if not self.spec:
+            return []
+        states = self._spec_state
+        ks = (states.get(s) for s in list(self._slots))
+        return [st.k for st in ks if st is not None]
 
     def submit(self, prompt, max_new_tokens: int = 128,
                temperature: float = 0.0,
@@ -476,6 +528,25 @@ class RollingGenerator:
                 self._win[req.slot, -len(tail):] = tail
             self._slots[req.slot] = req
             activated.append(req.rid)
+        if self.spec and done_reqs:
+            # the chunked-prefill × speculation composition: the draft
+            # haystack seeds when the prompt's LAST chunk lands (the
+            # grid KV extended chunk by chunk; the host has held the
+            # full token sequence all along) — one _ctx_admit dispatch
+            # per activation wave, same two padded widths as admission
+            n = len(done_reqs)
+            n_pad = 1 if n == 1 else self.max_slots
+            rows = np.zeros((n_pad, self._ctx.shape[1]), np.int32)
+            slots = np.full(n_pad, self.max_slots, np.int32)
+            for i, req in enumerate(done_reqs):
+                rows[i, :len(req.prompt)] = req.prompt
+                slots[i] = req.slot
+                self._spec_state[req.slot] = LookaheadState(
+                    self.spec_k, self.spec_cap)
+            with self._mesh_ctx():
+                self._ctx, self._dnt_valid = self._ctx_admit(
+                    self._ctx, self._dnt_valid, jnp.asarray(rows),
+                    jnp.asarray(slots))
         return activated
 
     def evict(self, rid: int) -> bool:
@@ -584,14 +655,17 @@ class RollingGenerator:
         rows too (depth includes the prefix), so the state is
         self-contained: restore needs no prefix registered.
 
+        Speculative rows export their round-carried state too — the
+        device draft context (``spec_ctx``, stale-tail-zeroed like the
+        KV planes), the carried next token, and the row's adaptive
+        lookahead ``k`` + acceptance EMA — so a parked spec session
+        resumes mid-generation with its drafts still landing (greedy
+        resumes stay token-identical: the carried token IS the next
+        emission).
+
         Deliberately scoped: queued / mid-chunked-prefill rows raise
-        (their logits aren't seeded yet — park after the first chunk),
-        and speculative engines raise (their device draft context is
-        round-carried state this export does not capture)."""
-        if self.spec:
-            raise ValueError("speculative engines (spec_k > 1) carry "
-                             "device draft context; row export is not "
-                             "supported")
+        (their logits aren't seeded yet — park after the first
+        chunk)."""
         slot = None
         for s, req in self._slots.items():
             if req.rid == rid:
@@ -629,7 +703,7 @@ class RollingGenerator:
             kv[kk] = {f"{b:05d}": plane[:, b * bt:(b + 1) * bt]
                       for b in range(dend // bt)}
         stop_flat = [t for seq in req.stop for t in seq]
-        return {
+        state = {
             "kv": kv,
             "logits": np.asarray(self._logits[slot]),
             "win": np.asarray(self._win[slot]),
@@ -648,6 +722,25 @@ class RollingGenerator:
                  req.adapter_id, int(self.kv_quantized), bt],
                 np.int64),
         }
+        if self.spec:
+            # round-carried speculation state. The draft haystack ships
+            # explicitly (a prefixed row's prefix tokens live only on
+            # device) at the same block-padded depth as the KV, with
+            # the tail past dpos ZEROED — freed slots keep their ctx
+            # rows, so an un-zeroed export would publish the previous
+            # occupant's tokens (the same cross-tenant hygiene as the
+            # KV planes) and break the delta manifest's byte stability.
+            ctx_row = np.array(self._ctx[slot, :dend], np.int32)
+            ctx_row[dpos:] = 0
+            st = self._spec_state.get(slot) or LookaheadState(
+                self.spec_k, self.spec_cap)
+            state["spec_ctx"] = ctx_row
+            state["spec"] = np.asarray(
+                [int(np.asarray(self._dnt[slot])),
+                 int(bool(np.asarray(self._dnt_valid[slot]))),
+                 st.k], np.int64)
+            state["spec_ema"] = np.asarray([st.ema], np.float32)
+        return state
 
     def import_row(self, state: Dict[str, Any]) -> int:
         """Splice an exported row into a free slot of THIS engine and
@@ -661,10 +754,22 @@ class RollingGenerator:
         of shapes. Returns the NEW rid (rids are engine-local). Sampler
         RNG is engine-global and not part of the export: greedy resumes
         are token-identical to an uninterrupted run; sampled resumes are
-        distribution-correct but draw a fresh key sequence."""
-        if self.spec:
-            raise ValueError("speculative engines (spec_k > 1) do not "
-                             "support row import")
+        distribution-correct but draw a fresh key sequence.
+
+        Speculation: a spec engine restores a spec export's draft
+        context + carried token + lookahead/EMA verbatim (the row keeps
+        drafting where it left off), and accepts a PLAIN export too —
+        the haystack rebuilds from prompt+tokens (a prefixed export's
+        prefix tokens are absent, which only costs draft quality, never
+        correctness) and the first token reads from the exported
+        logits. A plain engine importing a spec export raises: the spec
+        row's next token lives in the carried-token state, not in its
+        (admission-stale) logits."""
+        if "spec" in state and not self.spec:
+            raise ValueError(
+                "state was exported from a speculative engine — its "
+                "next token is round-carried draft state a plain "
+                "engine cannot resume; import into a spec_k > 1 engine")
         if not self._free:
             raise RuntimeError("no free row to import into")
         if set(state["kv"]) != set(self.cache):
@@ -687,7 +792,7 @@ class RollingGenerator:
             raise ValueError(
                 f"imported KV shape {planes['k'].shape} does not fit "
                 f"grid {self.cache['k'].shape} (max_len {self.max_len})")
-        margin = self.steps_per_call
+        margin = self.steps_per_call * (self.spec_k if self.spec else 1)
         if dpos + (max_new - n_emitted) + margin > self.max_len:
             raise ValueError(
                 f"restored depth {dpos} + remaining budget "
@@ -725,6 +830,33 @@ class RollingGenerator:
         if adapter_id >= 0:
             self._slot_onehot[slot, adapter_id] = 1.0
         self._slots[slot] = req
+        if self.spec:
+            Lctx = self._ctx.shape[1]
+            ctx_row = np.zeros(Lctx, np.int32)
+            if "spec" in state:
+                sc = np.asarray(state["spec_ctx"], np.int32)
+                ctx_row[:min(len(sc), Lctx)] = sc[:Lctx]
+                dnt, dnt_ok, k0 = (int(x)
+                                   for x in np.asarray(state["spec"]))
+                ema0 = float(np.asarray(state["spec_ema"]).reshape(-1)[0])
+            else:
+                # plain export: rebuild the haystack grid-aligned to
+                # end at the row's depth (prefix tokens, if any, stay
+                # absent — draft quality only). dnt_ok = 0 routes the
+                # first token through the exported (fresh) logits.
+                seq = req.prompt + req.tokens
+                place = seq[-min(len(seq), dpos):] if seq else []
+                start = dpos - len(place)
+                ctx_row[start:start + len(place)] = place
+                dnt, dnt_ok, k0, ema0 = 0, 0, 0, 1.0
+            with self._mesh_ctx():
+                self._ctx = self._ctx.at[slot].set(jnp.asarray(ctx_row))
+                self._dnt = self._dnt.at[slot].set(jnp.int32(dnt))
+                self._dnt_valid = self._dnt_valid.at[slot].set(
+                    bool(dnt_ok))
+            st = LookaheadState(self.spec_k, self.spec_cap,
+                                k0=k0 or None, ema0=ema0)
+            self._spec_state[slot] = st
         return rid
 
     def warmup(self, prompt_buckets=(16, 64, 128),
@@ -740,12 +872,40 @@ class RollingGenerator:
         mid-traffic); plain engines bake sampling into the one
         executable, so the flag is a no-op there."""
         temp = 1.0 if sampling and self.spec else 0.0
-        for p_pad in sorted(set(_bucket(b) for b in prompt_buckets)):
-            for width in sorted({1, self.max_slots}):
-                for _ in range(width):
-                    self.submit([1] * min(p_pad, self.max_len // 2),
-                                max_new_tokens=1, temperature=temp)
-                self.run()
+        # warmup's garbage drafts must not leak into the acceptance
+        # accounting: accept_rate / tokens_per_pass feed the serving
+        # scheduler's shed pricing and the published engine_spec_*
+        # counters (the same skew class PR 10 fixed for the
+        # prefix-savings ratio) — restore the counters afterwards
+        spec_counts = ((self._spec_rounds, self._spec_emitted,
+                        self._spec_drafted) if self.spec else None)
+        try:
+            for p_pad in sorted(set(_bucket(b) for b in prompt_buckets)):
+                for width in sorted({1, self.max_slots}):
+                    for _ in range(width):
+                        self.submit([1] * min(p_pad, self.max_len // 2),
+                                    max_new_tokens=1, temperature=temp)
+                    self.run()
+            if self.spec:
+                # compile every adaptive dispatch width ({1, 2, 4, ...,
+                # spec_k}): per-row adaptation reaches them mid-traffic
+                # otherwise, paying a cold compile each
+                widths, w = [], 1
+                while w < self.spec_k:
+                    widths.append(w)
+                    w *= 2
+                widths.append(self.spec_k)
+                for w in widths:
+                    self.submit([1, 2], max_new_tokens=1,
+                                temperature=temp)
+                    self.admit()
+                    for st in self._spec_state.values():
+                        st.k = min(w, self.spec_k)
+                    self.run()
+        finally:
+            if spec_counts is not None:
+                (self._spec_rounds, self._spec_emitted,
+                 self._spec_drafted) = spec_counts
 
     # ----------------------------------------------------------- interns
     def _start_chunked(self, req: Request) -> None:
@@ -820,6 +980,8 @@ class RollingGenerator:
                 for i, req in enumerate(group):
                     seq = head + req.prompt
                     rows[i, :len(seq)] = seq
+                    self._spec_state[req.slot] = LookaheadState(
+                        self.spec_k, self.spec_cap)
                 self._ctx, self._dnt_valid = self._ctx_admit(
                     self._ctx, self._dnt_valid, jnp.asarray(rows),
                     jnp.asarray(slots))
@@ -867,8 +1029,17 @@ class RollingGenerator:
 
     def _decode_spec_chunk(self) -> List[Tuple[int, List[int], bool]]:
         """One dispatch = ``steps_per_call`` verify rounds; each round
-        emits 1..spec_k tokens per slot (the accepted draft prefix plus
-        the model's own next token)."""
+        emits 1..k_row tokens per slot (the accepted draft prefix plus
+        the model's own next token).
+
+        Per-row adaptive lookahead: each slot runs at its OWN ``k``
+        (``LookaheadState``). The dispatch width is the power-of-two
+        covering the widest active row (a handful of executables total:
+        {1, 2, 4, ..., spec_k} × sampling flag) and the per-slot ``kk``
+        array masks draft positions past each row's lookahead inside
+        the shared forward — rows at different ``k`` coexist in one
+        chunk-mode dispatch, and an all-collapsed batch (every row at
+        k = 1) dispatches the width-1 forward, i.e. plain decode."""
         # STICKY sampling flag: the first sampled request upgrades the
         # dispatch to the sampling executable and it stays there —
         # flapping between the greedy and sampling executables per
@@ -877,30 +1048,52 @@ class RollingGenerator:
         if not self._spec_sampling and any(
                 self._slots[s].temperature > 0 for s in self._slots):
             self._spec_sampling = True
+        kk = np.ones(self.max_slots, np.int32)
+        for slot in self._slots:
+            st = self._spec_state.get(slot)
+            if st is None:      # imported/hand-driven rows late-create
+                st = self._spec_state[slot] = LookaheadState(
+                    self.spec_k, self.spec_cap)
+            kk[slot] = st.k
+        k_widest = max((int(kk[s]) for s in self._slots), default=1)
+        kd = 1
+        while kd < k_widest:
+            kd *= 2
+        kd = max(1, min(kd, self.spec_k))
         self._rng, key = jax.random.split(self._rng)
         with self._mesh_ctx():
             (self.cache, self._dpos, self._ctx, self._dnt,
              self._dnt_valid, toks, emits) = self._decode_sp(
                 self.params, self.cache, self._logits, self._dpos,
                 self._dactive, self._ctx, self._dnt, self._dnt_valid,
-                jnp.asarray(self._temps), key,
+                jnp.asarray(self._temps), jnp.asarray(kk), key,
                 self._lora(self._slot_onehot),
-                k=self.spec_k, ngram=self.spec_ngram,
+                k=kd, ngram=self.spec_ngram,
                 n_rounds=self.steps_per_call,
                 top_k=self.top_k, top_p=self.top_p,
                 sampling=self._spec_sampling)
-        toks = np.asarray(toks)                # [R, B, k] — the one sync
+        toks = np.asarray(toks)                # [R, B, kd] — the one sync
         emits = np.asarray(emits)              # [R, B]
+        R = toks.shape[0]
         new_by_slot: Dict[int, List[int]] = {}
         for slot in self._slots:
             new: List[int] = []
-            for r in range(toks.shape[0]):
+            for r in range(R):
                 e = int(emits[r, slot])
                 if e:
                     new.extend(int(t) for t in toks[r, slot, :e])
             new_by_slot[slot] = new
-            self._spec_rounds += toks.shape[0]
+            self._spec_rounds += R
             self._spec_emitted += len(new)
+            # fold this chunk's acceptance into the row's EMA, then one
+            # adaptation move (grow/shrink/probe) for the next chunk
+            st = self._spec_state[slot]
+            k_used = int(kk[slot])
+            self._spec_drafted += R * (k_used - 1)
+            for r in range(R):
+                st.observe(int(emits[r, slot]), k_used,
+                           alpha=self.spec_ema_alpha)
+            st.adapt(self.spec_k, self.spec_cap)
         return self._finish_events(new_by_slot)
 
     def _finish_events(self, new_by_slot: Dict[int, List[int]]
@@ -958,6 +1151,8 @@ class RollingGenerator:
         for slot in freed:
             self._win[slot] = -1
             self._penalties[slot] = 1.0
+            if self.spec:
+                self._spec_state.pop(slot, None)
         self._free.extend(freed)
 
     # ------------------------------------------------------------- jitted
@@ -1230,16 +1425,25 @@ class RollingGenerator:
 
     @staticmethod
     def _decode_spec_impl(params, cache, last_logits, pos, active, ctx,
-                          dnt, dnt_valid, temps, key, lora, *, k, ngram,
-                          n_rounds, top_k, top_p, sampling, cfg, rules):
+                          dnt, dnt_valid, temps, kk, key, lora, *, k,
+                          ngram, n_rounds, top_k, top_p, sampling, cfg,
+                          rules):
         """``n_rounds`` speculative verify rounds in one ``lax.scan``.
 
-        Per round and slot: the carried next token plus ``k − 1``
+        Per round and slot: the carried next token plus up to ``k − 1``
         prompt-lookup drafts from the slot's device context run through
         ONE chunk-mode forward at the slot's own depth; the accepted
         prefix merges into the grid with the shared one-hot einsum
         (per-slot variable count — rejected drafts never land, so there
         is no rollback).
+
+        ``kk`` [B]: per-slot lookahead inside the width-``k`` dispatch
+        — draft positions past ``kk − 1`` are forced-rejected (greedy:
+        masked out of the acceptance cumprod; sampled: masked inside
+        ``rejection_accept``, with ``residual_next`` treating
+        ``acc == kk − 1`` as the row's full accept), so each row emits
+        and merges exactly as a ``k = kk`` dispatch would. This is how
+        rows at different adaptive ``k`` share one executable.
 
         Greedy slots (temp 0): a draft survives where it equals the
         model's argmax and the carried token becomes the argmax at the
@@ -1329,15 +1533,19 @@ class RollingGenerator:
                 chunk=chunk, chunk_col=0, chunk_mask=emask, lora=lora)
             g = jnp.argmax(lg, axis=-1).astype(jnp.int32)         # [B, k]
             if k > 1:
-                ok_g = (feed[:, 1:] == g[:, :-1]).astype(jnp.int32)
+                # per-slot lookahead mask: positions past kk-1 are
+                # forced rejects, so acc never exceeds the row's own k
+                ok_g = ((feed[:, 1:] == g[:, :-1])
+                        & (jnp.arange(k - 1)[None, :]
+                           < (kk[:, None] - 1))).astype(jnp.int32)
                 acc = jnp.sum(jnp.cumprod(ok_g, axis=1), axis=1)  # 0..k-1
             else:
                 acc = jnp.zeros((B,), jnp.int32)
             if sampling:
                 # exact per-slot rejection sampling — shared helpers
-                # with the static SpeculativeGenerator
+                # with the static SpeculativeGenerator (kk-masked)
                 probs = _probs(lg)                               # [B,k,V]
-                acc_s = rejection_accept(probs, feed, k_acc, k=k)
+                acc_s = rejection_accept(probs, feed, k_acc, k=k, kk=kk)
                 acc = jnp.where(sampled, acc_s, acc)
             emit = jnp.where(active, 1 + acc, 0)
             cache = llama.merge_chunk_into_grid(cache, chunk, pos, emit)
@@ -1351,7 +1559,8 @@ class RollingGenerator:
             j = jnp.clip(acc, 0, k - 1)
             dnt = jnp.take_along_axis(g, j[:, None], axis=1)[:, 0]
             if sampling:
-                nxt_s = residual_next(probs, feed, acc, k_res, k=k)
+                nxt_s = residual_next(probs, feed, acc, k_res, k=k,
+                                      kk=kk)
                 dnt = jnp.where(sampled, nxt_s, dnt)
             dnt_valid = dnt_valid | active
             return (cache, pos + emit, ctx, dnt, dnt_valid), (feed, emit)

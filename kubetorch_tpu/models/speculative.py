@@ -57,6 +57,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubetorch_tpu.lookahead import LookaheadState  # noqa: F401
+#   (re-exported: the per-row adaptive-lookahead state machine lives in
+#   kubetorch_tpu/lookahead.py — stdlib-only so the jax-free serving
+#   engine can import it — but spec callers reach it from here)
 from kubetorch_tpu.models import llama
 from kubetorch_tpu.models.configs import LlamaConfig
 from kubetorch_tpu.parallel.mesh import use_mesh
@@ -94,13 +98,20 @@ def _ngram_draft(cext: jax.Array, clen: jax.Array, nt: jax.Array,
     return jnp.where(valid, drafts, nt[:, None])
 
 
-def rejection_accept(probs, feed, key, *, k):
+def rejection_accept(probs, feed, key, *, k, kk=None):
     """Speculative rejection acceptance for a point-mass draft: [B]
     accepted-draft count (0..k-1). Draft ``feed[:, i+1]`` is accepted at
     position ``i`` with probability ``p_i(draft)`` under ``probs``
     [B, k, V]; acceptance stops at the first reject (cumprod). Shared by
     the static generator and the rolling engine's sampled spec path —
-    the math must never diverge between them."""
+    the math must never diverge between them.
+
+    ``kk`` [B] (optional): per-row lookahead inside a width-``k``
+    dispatch — positions past ``kk − 1`` drafts are forced-rejected, so
+    a row behaves exactly as if it had been dispatched at its own
+    ``kk`` (the acceptance test never reads its masked positions'
+    draws). The adaptive rolling engine runs rows at different ``k`` in
+    ONE chunk-mode forward this way."""
     B = feed.shape[0]
     if k <= 1:
         return jnp.zeros((B,), jnp.int32)
@@ -108,20 +119,29 @@ def rejection_accept(probs, feed, key, *, k):
         probs[:, :-1], feed[:, 1:, None], axis=2)[..., 0]    # [B, k-1]
     u = jax.random.uniform(key, (B, k - 1))
     ok = u < p_draft
+    if kk is not None:
+        ok = ok & (jnp.arange(k - 1)[None, :] < (kk[:, None] - 1))
     return jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
 
 
-def residual_next(probs, feed, acc, key, *, k):
+def residual_next(probs, feed, acc, key, *, k, kk=None):
     """Exact next-token draw at the acceptance break: the residual
     distribution (the rejected draft's mass removed, renormalized) on a
     rejection, the full break-position distribution on a full accept —
     together with :func:`rejection_accept` this makes the emitted
-    stream distributed exactly as non-speculative sampling."""
+    stream distributed exactly as non-speculative sampling.
+
+    ``kk`` [B] (optional): per-row lookahead inside a width-``k``
+    dispatch. ``acc == kk − 1`` is that row's FULL accept — its next
+    token draws from the unmodified break distribution (the draft at
+    the truncation boundary was never tested, so removing its mass
+    would be wrong), exactly as a ``k = kk`` dispatch would."""
     V = probs.shape[-1]
     j = jnp.clip(acc, 0, k - 1)
     p_j = jnp.take_along_axis(probs, j[:, None, None], axis=1)[:, 0]
     if k > 1:
-        rejected = acc < (k - 1)
+        rejected = (acc < (k - 1) if kk is None
+                    else acc < (kk - 1))
         d_rej = jnp.take_along_axis(
             feed, jnp.clip(acc + 1, 0, k - 1)[:, None], axis=1)[:, 0]
         removed = jnp.where(

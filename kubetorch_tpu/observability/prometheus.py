@@ -578,6 +578,13 @@ _ENGINE: Dict[str, float] = {
     "kv_restores_total": 0.0,
     "kv_offload_bytes_total": 0.0,
     "kv_restore_bytes_total": 0.0,
+    # speculative decoding (ISSUE 14): counters MUST be pre-seeded —
+    # record_engine bumps with `+=`, and the serving path's
+    # must-never-raise guard would swallow the KeyError silently
+    "engine_spec_rounds_total": 0.0,
+    "engine_spec_emitted_total": 0.0,
+    "engine_spec_drafted_total": 0.0,
+    "engine_spec_verify_waste_total": 0.0,
 }
 _ENGINE_EVENTS = {
     "generation": "engine_generations_total",
@@ -596,6 +603,10 @@ _ENGINE_EVENTS = {
     "kv_restore": "kv_restores_total",
     "kv_offload_bytes": "kv_offload_bytes_total",
     "kv_restore_bytes": "kv_restore_bytes_total",
+    "spec_rounds": "engine_spec_rounds_total",
+    "spec_emitted": "engine_spec_emitted_total",
+    "spec_drafted": "engine_spec_drafted_total",
+    "spec_verify_waste": "engine_spec_verify_waste_total",
 }
 _ENGINE_GAUGES = {
     "queue_depth": "engine_queue_depth",
@@ -604,17 +615,22 @@ _ENGINE_GAUGES = {
     "prefilling_rows": "engine_prefilling_rows",
     "kv_blocks_used": "kv_blocks_used",
     "kv_blocks_free": "kv_blocks_free",
+    "spec_accept_rate": "engine_spec_accept_rate",
+    "spec_k_cap": "engine_spec_k_cap",
 }
 
 
 def record_engine(event: str, value: float = 1.0) -> None:
     """Bump a serving-engine counter (``generation`` / ``step`` /
     ``tokens`` / ``admit`` / ``prefill_chunk`` / ``evict`` / ``shed`` /
-    ``tick_error`` / ``device_seconds``, plus the KV-pool events
+    ``tick_error`` / ``device_seconds``, the KV-pool events
     ``prefix_hit`` / ``prefix_miss`` / ``prefix_evict`` /
-    ``kv_offload[_bytes]`` / ``kv_restore[_bytes]``) or set an occupancy
-    gauge (``queue_depth`` / ``active_rows`` / ``free_rows`` /
-    ``prefilling_rows`` / ``kv_blocks_used`` / ``kv_blocks_free``)."""
+    ``kv_offload[_bytes]`` / ``kv_restore[_bytes]``, and the
+    speculation events ``spec_rounds`` / ``spec_emitted`` /
+    ``spec_drafted`` / ``spec_verify_waste``) or set a gauge
+    (``queue_depth`` / ``active_rows`` / ``free_rows`` /
+    ``prefilling_rows`` / ``kv_blocks_used`` / ``kv_blocks_free`` /
+    ``spec_accept_rate`` / ``spec_k_cap``)."""
     with _ENGINE_LOCK:
         counter = _ENGINE_EVENTS.get(event)
         if counter is not None:
@@ -792,14 +808,36 @@ def record_hist(name: str, value: float, buckets: Optional[tuple] = None,
     if trace_id is _UNSET:
         trace_id = _ambient_trace_id()
     with _NHIST_LOCK:
-        h = _NHISTS.get(name)
-        if h is None:
-            le = tuple(buckets) if buckets else _HIST_BUCKETS
-            h = _NHISTS[name] = {
-                "le": le, "sum": 0.0, "count": 0.0,
-                "buckets": [0.0] * len(le),
-                "ex": [None] * (len(le) + 1)}
+        h = _nhist_family_locked(name, buckets)
         _hist_observe(h, h["le"], float(value), trace_id)
+
+
+def _nhist_family_locked(name: str, buckets: Optional[tuple]):
+    """Get-or-create a named histogram family (caller holds
+    ``_NHIST_LOCK``)."""
+    h = _NHISTS.get(name)
+    if h is None:
+        le = tuple(buckets) if buckets else _HIST_BUCKETS
+        h = _NHISTS[name] = {
+            "le": le, "sum": 0.0, "count": 0.0,
+            "buckets": [0.0] * len(le),
+            "ex": [None] * (len(le) + 1)}
+    return h
+
+
+def record_hist_batch(name: str, values,
+                      buckets: Optional[tuple] = None) -> None:
+    """Observe many values into the named histogram under ONE lock
+    acquisition, no exemplars — the driver-tick hot path (per-row
+    lookahead distribution over a full batch, every tick) must not pay
+    a lock round-trip per row."""
+    if not values:
+        return
+    with _NHIST_LOCK:
+        h = _nhist_family_locked(name, buckets)
+        le = h["le"]
+        for v in values:
+            _hist_observe(h, le, float(v), None)
 
 
 def hist_metrics() -> Dict[str, Dict[str, Any]]:

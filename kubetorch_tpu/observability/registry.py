@@ -209,6 +209,29 @@ _m("kv_offload_bytes_total", "counter",
    "re-parks cheap).", "engine")
 _m("kv_restore_bytes_total", "counter",
    "Bytes restored through the streaming path.", "engine")
+_m("engine_spec_rounds_total", "counter",
+   "Speculative verify rounds dispatched (per active row; each round "
+   "replaces one plain decode step).", "engine")
+_m("engine_spec_emitted_total", "counter",
+   "Tokens landed by verify rounds (carried tokens + accepted "
+   "drafts); emitted/rounds is tokens-per-pass.", "engine")
+_m("engine_spec_drafted_total", "counter",
+   "Draft positions offered to verification (per-row lookahead minus "
+   "the carried token, summed over rounds).", "engine")
+_m("engine_spec_verify_waste_total", "counter",
+   "Draft positions verified but rejected — the FLOPs the per-row "
+   "adaptive lookahead exists to stop spending.", "engine")
+_m("engine_spec_accept_rate", "gauge",
+   "Cumulative draft acceptance (accepted / drafted) on this engine.",
+   "engine")
+_m("engine_spec_k_cap", "gauge",
+   "Effective per-row lookahead ceiling: spec_k in the latency "
+   "regime, 1 while the occupancy throttle "
+   "(KT_SPEC_OCCUPANCY_THROTTLE) holds the batch to plain decode.",
+   "engine")
+_m("engine_spec_k", "histogram",
+   "Per-row adaptive lookahead distribution, sampled once per driver "
+   "tick per live row (buckets at the k values themselves).", "engine")
 
 # --- resilience (PR 5) ------------------------------------------------------
 _m("resilience_heartbeats_total", "counter",

@@ -58,6 +58,17 @@ Under the scheduler sits the **paged-KV manager** (ISSUE 11,
   the delta manifest) and a later same-session program restores into a
   free row and resumes mid-generation without re-prefill.
 
+Speculative decoding is a **scheduler citizen** (ISSUE 14): on a
+``spec_k > 1`` engine each row runs at its own adaptive lookahead
+(acceptance-EMA state machine, ``kubetorch_tpu/lookahead.py``) — the
+scheduler's contributions here are the per-tick occupancy throttle
+(``KT_SPEC_OCCUPANCY_THROTTLE``: compute-bound batch → every row caps
+to plain decode; latency regime → high-accept rows regrow), verify
+cost priced into the shed check at each row's current ``k``, prefix
+hits seeding the draft haystack (the old spec gate is gone), chunked
+prefill composing with speculation, and park/resume carrying the
+draft context + acceptance EMA through the store.
+
 The engine publishes ``engine_*`` Prometheus counters/gauges (queue
 depth, active/free rows, steps, sheds — the signal the autoscaler will
 consume) plus the KV manager's ``kv_*``/``prefix_*`` set, and
@@ -85,6 +96,7 @@ from typing import Any, Dict, List, Optional
 
 from kubetorch_tpu.config import env_float, env_int
 from kubetorch_tpu.exceptions import DeadlineExceeded, ServerOverloaded
+from kubetorch_tpu.lookahead import LookaheadState, spec_stats_dict
 from kubetorch_tpu.observability import tracing
 from kubetorch_tpu.serving import kvpool
 from kubetorch_tpu.serving.replay import retry_after_estimate
@@ -95,6 +107,11 @@ def _record_engine(event: str, value: float = 1.0) -> None:
     must-never-raise guard (one shared implementation —
     ``kvpool._record``)."""
     kvpool._record(event, value)
+
+
+# per-row lookahead histogram bounds: k is small and integral, so the
+# buckets are the interesting k values themselves
+_SPEC_K_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0)
 
 
 class GenerationProgram:
@@ -250,8 +267,11 @@ class DecodeEngine:
     ``queued/free_rows/active_rows/prefilling_rows/pending`` counts.
     Prefix sharing additionally uses ``register_prefix/drop_prefix`` and
     the ``prefill_tokens`` counter; session park/restore uses
-    ``export_row/import_row`` (all optional — an engine without them
-    simply serves unshared, unparked).
+    ``export_row/import_row``; speculative engines (``engine.spec``)
+    additionally expose ``spec_stats``/``spec_row_ks``/``set_spec_cap``
+    — the driver tick throttles aggregate lookahead by occupancy and
+    the shed check prices verify waste (all optional — an engine
+    without them simply serves unshared, unparked, unspeculated).
     """
 
     def __init__(self, engine, poll_s: Optional[float] = None,
@@ -260,7 +280,8 @@ class DecodeEngine:
                  stall_s: Optional[float] = None,
                  kv_block_tokens: Optional[int] = None,
                  kv_budget_blocks: Optional[int] = None,
-                 prefix_split: Optional[str] = None):
+                 prefix_split: Optional[str] = None,
+                 spec_throttle: Optional[float] = None):
         self.engine = engine
         self._poll_s = (poll_s if poll_s is not None
                         else env_float("KT_ENGINE_POLL_S"))
@@ -270,6 +291,21 @@ class DecodeEngine:
                              else env_int("KT_ENGINE_MAX_WAITING"))
         self._stall_s = (stall_s if stall_s is not None
                          else env_float("KT_ENGINE_STALL_S"))
+        # speculation as a scheduler citizen: above this occupancy the
+        # batch is compute-bound and verify width stops being free —
+        # the driver tick caps every row's lookahead at 1 (plain
+        # decode); below it the cap lifts and high-accept rows regrow
+        self._spec_throttle = (
+            spec_throttle if spec_throttle is not None
+            else env_float("KT_SPEC_OCCUPANCY_THROTTLE"))
+        self._spec_capped = False
+        self._spec_prev: Dict[str, float] = {}
+        # recent tokens-per-pass (per-tick deltas, EMA): the shed
+        # check's verify-pricing input. The engine's cumulative
+        # tokens_per_pass is lifetime-averaged — after a regime shift
+        # (adversarial hour → extractive traffic) it lags for hours
+        # and would misprice admission exactly when rows are fastest
+        self._spec_tpp_ema: Optional[float] = None
         # Paged-KV manager (serving/kvpool.py): block ledger + prefix
         # cache + session offload. Budget default: 2x the decode grid in
         # blocks — the grid itself plus as much again for shared prefix
@@ -650,6 +686,19 @@ class DecodeEngine:
             "kv_offloads": self._parks,
             "kv_restores": self._restores,
         }
+        if getattr(eng, "spec", False):
+            ss = eng.spec_stats
+            out.update({
+                "spec_rounds": int(ss.get("rounds", 0)),
+                "spec_emitted": int(ss.get("emitted", 0)),
+                "spec_tokens_per_pass": round(
+                    float(ss.get("tokens_per_pass", 0.0)), 4),
+                "spec_accept_rate": round(
+                    float(ss.get("accept_rate", 0.0)), 4),
+                "spec_verify_waste": int(ss.get("verify_waste", 0)),
+                "spec_k_mean": round(float(ss.get("k_mean", 0.0)), 3),
+                "spec_k_cap": int(ss.get("k_cap", 0)),
+            })
         return out
 
     def exec_count(self, tag: str) -> int:
@@ -830,9 +879,12 @@ class DecodeEngine:
         (prefix hits cost only their suffix) before anything is
         submitted or registered."""
         rule = self._kv.split
+        # (speculative engines share prefixes like any other: a prefix
+        # hit splices the KV block AND seeds the row's draft haystack
+        # from the shared tokens — the gate that once excluded them is
+        # gone)
         auto = (rule is not None and prog.prefix_id is None
-                and hasattr(self.engine, "register_prefix")
-                and not getattr(self.engine, "spec", False))
+                and hasattr(self.engine, "register_prefix"))
         plan: List[Dict[str, Any]] = []
         for p in prog.prompts:
             # (naive-token accounting happens at SUBMIT, not here — a
@@ -958,6 +1010,28 @@ class DecodeEngine:
         est_delay = 0.0
         if eng.free_rows < n_new:
             est_delay = (waiting + n_new) * max(0.01, self._ema_row_s)
+            if est_delay and getattr(eng, "spec", False):
+                # price the live rows' verify cost at their CURRENT k:
+                # a row at lookahead k spends k verify positions per
+                # pass but only lands tokens_per_pass of them, so the
+                # batch's effective service rate scales by
+                # tokens_per_pass / k_mean. Well-adapted speculation
+                # (accepts land, or the throttle collapsed k to 1)
+                # prices at ~1x; badly-landing drafts price the queue
+                # slower and shed sooner — verify waste is not free
+                # row-time at the margin. tokens-per-pass comes from
+                # the tick-delta EMA (recent rounds), NOT the engine's
+                # lifetime average: k_mean is instantaneous, and after
+                # a regime shift the cumulative ratio would misprice
+                # admission for hours against rows that adapted in
+                # seconds.
+                ss = eng.spec_stats
+                k_mean = max(1.0, float(ss.get("k_mean") or 1.0))
+                recent = (self._spec_tpp_ema
+                          if self._spec_tpp_ema is not None
+                          else float(ss.get("tokens_per_pass") or 1.0))
+                tpp = min(k_mean, max(1.0, recent))
+                est_delay *= k_mean / tpp
         # KV-block pricing
         need = 0
         new_pfx: Dict[str, int] = {}
@@ -1165,7 +1239,66 @@ class DecodeEngine:
             # lull measured as a minutes-long est_delay → spurious
             # sheds on the next burst)
             self._last_free_t = None
+        self._spec_tick_locked()
         self._publish_gauges()
+
+    def _spec_tick_locked(self) -> None:
+        """Aggregate-lookahead throttle + spec telemetry, once per
+        driver tick. Occupancy ≥ ``KT_SPEC_OCCUPANCY_THROTTLE`` means
+        the batch is compute-bound — verify positions now displace
+        decode FLOPs instead of riding free on the weight stream — so
+        every row's lookahead caps at 1 (k decays to plain decode
+        immediately); when occupancy falls back into the latency
+        regime the cap lifts and per-row EMAs regrow the k's."""
+        eng = self.engine
+        if not getattr(eng, "spec", False):
+            return
+        slots = int(getattr(eng, "max_slots", 0) or 0)
+        if slots and hasattr(eng, "set_spec_cap"):
+            occ = (eng.active_rows + eng.prefilling_rows) / slots
+            capped = occ >= self._spec_throttle
+            if capped != self._spec_capped:
+                self._spec_capped = capped
+                eng.set_spec_cap(1 if capped else 0)
+        ss = getattr(eng, "spec_stats", None) or {}
+        _record_engine("spec_k_cap", float(ss.get("k_cap", 0)))
+        deltas: Dict[str, float] = {}
+        for event, key in (("spec_rounds", "rounds"),
+                           ("spec_emitted", "emitted"),
+                           ("spec_drafted", "drafted"),
+                           ("spec_verify_waste", "verify_waste")):
+            cur = float(ss.get(key, 0.0))
+            d = cur - self._spec_prev.get(key, 0.0)
+            if d > 0:
+                _record_engine(event, d)
+                deltas[key] = d
+            self._spec_prev[key] = cur
+        if deltas.get("rounds"):
+            # recent tokens-per-pass for the shed check's verify
+            # pricing (0.25 ≈ the lookahead EMA's horizon)
+            tick_tpp = deltas.get("emitted", 0.0) / deltas["rounds"]
+            self._spec_tpp_ema = (
+                tick_tpp if self._spec_tpp_ema is None
+                else 0.75 * self._spec_tpp_ema + 0.25 * tick_tpp)
+        _record_engine("spec_accept_rate",
+                       float(ss.get("accept_rate", 0.0)))
+        # per-row lookahead distribution (fleet-mergeable buckets),
+        # only on ticks that actually ran verify rounds — an idle or
+        # stalled batch must not re-sample unchanged k's every poll
+        if deltas.get("rounds"):
+            ks = (eng.spec_row_ks()
+                  if hasattr(eng, "spec_row_ks") else [])
+            if ks:
+                try:
+                    from kubetorch_tpu.observability.prometheus import (
+                        record_hist_batch,
+                    )
+
+                    record_hist_batch("engine_spec_k", ks,
+                                      buckets=_SPEC_K_BUCKETS)
+                # ktlint: disable=KT004 -- metrics must never break the driver tick
+                except Exception:  # noqa: BLE001
+                    pass
 
     def _offload_async(self, session_id: str,
                        state: Dict[str, Any]) -> None:
@@ -1274,7 +1407,10 @@ class SimRollingEngine:
     def __init__(self, max_slots: int = 8, steps_per_call: int = 8,
                  prefill_chunk: Optional[int] = None,
                  step_s: float = 0.0, prefill_s: Optional[float] = None,
-                 max_len: int = 2048):
+                 max_len: int = 2048, spec_k: int = 0,
+                 spec_accept=None, spec_ema_alpha: float = 0.25):
+        if spec_k < 0 or spec_k == 1:
+            raise ValueError("spec_k must be 0 (off) or >= 2")
         self.max_slots = max_slots
         self.max_len = max_len
         self.steps_per_call = steps_per_call
@@ -1294,6 +1430,27 @@ class SimRollingEngine:
         # prompt tokens run through a "prefill" (suffix only for
         # prefixed submits; a registered prefix counts once)
         self.prefill_tokens = 0
+        # speculative surface (mirrors RollingGenerator): each decode
+        # step becomes steps_per_call verify ROUNDS; per-row lookahead
+        # adapts through the shared LookaheadState machine against a
+        # SCRIPTED accept rate (`spec_accept`: float, or
+        # callable(prompt) -> rate — deterministic, so the scheduler /
+        # adaptation / bench logic all run CPU-only). Emission stays
+        # the same pure function of (prompt, index): speculation
+        # changes how many tokens land per chunk, never which — the
+        # spec-on ≡ spec-off byte-identity the greedy engine pins.
+        self.spec_k = int(spec_k)
+        self.spec = self.spec_k > 1
+        self.spec_cap = 0
+        self.spec_ema_alpha = float(spec_ema_alpha)
+        self._spec_accept = spec_accept
+        self._spec_state: Dict[int, Any] = {}   # rid -> LookaheadState
+        self._spec_rounds = 0
+        self._spec_emitted = 0
+        self._spec_drafted = 0
+        # rid -> lookahead at completion (bench convergence probe;
+        # bounded — oldest entries drop)
+        self.spec_k_done: Dict[int, int] = {}
 
     # -------------------------------------------------------- interface
     @staticmethod
@@ -1387,16 +1544,83 @@ class SimRollingEngine:
             time.sleep(self.step_s)
         events = []
         for rid, req in list(self._rows.items()):
-            k = min(self.steps_per_call, req["n"] - req["emitted"])
+            if self.spec:
+                n_new = self._spec_row_step(rid, req)
+            else:
+                n_new = min(self.steps_per_call,
+                            req["n"] - req["emitted"])
             toks = self.expected_tokens(
-                req["prompt"], req["emitted"] + k)[req["emitted"]:]
-            req["emitted"] += k
+                req["prompt"], req["emitted"] + n_new)[req["emitted"]:]
+            req["emitted"] += n_new
             done = req["emitted"] >= req["n"]
             events.append((rid, toks, done))
             if done:
                 self._free.append(req["slot"])
                 del self._rows[rid]
+                st = self._spec_state.pop(rid, None)
+                if st is not None:
+                    if len(self.spec_k_done) >= 4096:
+                        self.spec_k_done.pop(next(iter(self.spec_k_done)))
+                    self.spec_k_done[rid] = st.k
         return events
+
+    # ------------------------------------------------------ spec twin
+    def _accept_rate(self, prompt) -> float:
+        r = self._spec_accept
+        if callable(r):
+            r = r(prompt)
+        return max(0.0, min(1.0, float(r or 0.0)))
+
+    def _spec_row_step(self, rid: int, req: dict) -> int:
+        """One decode step = ``steps_per_call`` verify rounds for this
+        row at its adaptive lookahead: the scripted accept rate feeds a
+        deterministic fractional accumulator (rate × (k−1) drafts land
+        per round on average), the same tokens land that plain decode
+        would (pure function of (prompt, index)), and the shared
+        ``LookaheadState`` observes/adapts exactly as the real engine's
+        host loop does."""
+        st = self._spec_state.get(rid)
+        if st is None:
+            st = self._spec_state[rid] = LookaheadState(
+                self.spec_k, self.spec_cap)
+        rate = self._accept_rate(req["prompt"])
+        emitted = 0
+        k_used = st.k
+        for _ in range(self.steps_per_call):
+            self._spec_rounds += 1
+            self._spec_drafted += k_used - 1
+            req["acc_frac"] = (req.get("acc_frac", 0.0)
+                               + rate * (k_used - 1))
+            a = min(int(req["acc_frac"]), k_used - 1)
+            req["acc_frac"] -= a
+            emit = 1 + a
+            st.observe(emit, k_used, alpha=self.spec_ema_alpha)
+            emitted += emit
+        st.adapt(self.spec_k, self.spec_cap)
+        emitted = min(emitted, req["n"] - req["emitted"])
+        self._spec_emitted += emitted
+        return emitted
+
+    def set_spec_cap(self, cap: int) -> None:
+        if self.spec:
+            self.spec_cap = max(0, int(cap))
+
+    def spec_row_ks(self):
+        # lock-free readers (stats/control frames) race the driver's
+        # admit/free — snapshot, like RollingGenerator.spec_row_ks
+        if not self.spec:
+            return []
+        rows = self._rows
+        return [st.k for rid, st in list(self._spec_state.items())
+                if rid in rows]
+
+    @property
+    def spec_stats(self) -> Dict[str, float]:
+        if not self.spec:
+            return {}
+        return spec_stats_dict(self._spec_rounds, self._spec_emitted,
+                               self._spec_drafted, self.spec_row_ks(),
+                               self.spec_k, self.spec_cap)
 
     def step(self):
         self.admit()
@@ -1421,12 +1645,21 @@ class SimRollingEngine:
         kv = {f"{b:05d}": np.frombuffer(
             hashlib.sha256(f"kv:{seed}:{b}".encode()).digest(),
             np.uint8).reshape(4, 8).copy() for b in range(nblocks)}
-        return {
+        state = {
             "kv": {"k": kv},
             "prompt": np.asarray(req["prompt"], np.int64),
             "scalars": np.asarray(
                 [ctx, req["emitted"], req["n"]], np.int64),
         }
+        if self.spec:
+            # the sim's "draft context" is the lookahead/EMA pair — the
+            # same leaves the real engine parks, so park/resume keeps a
+            # spec session's adaptation state CPU-only too
+            st = self._spec_state.get(rid) or LookaheadState(
+                self.spec_k, self.spec_cap)
+            state["spec"] = np.asarray([0, 0, st.k], np.int64)
+            state["spec_ema"] = np.asarray([st.ema], np.float32)
+        return state
 
     def import_row(self, state: dict) -> int:
         import numpy as np
@@ -1441,6 +1674,11 @@ class SimRollingEngine:
                            "n": scalars[2], "emitted": scalars[1],
                            "consumed": len(prompt), "head": 0,
                            "suffix": 0, "slot": self._free.pop(0)}
+        if self.spec and "spec" in state:
+            k0 = int(np.asarray(state["spec"])[-1])
+            ema0 = float(np.asarray(state["spec_ema"]).reshape(-1)[0])
+            self._spec_state[rid] = LookaheadState(
+                self.spec_k, self.spec_cap, k0=k0 or None, ema0=ema0)
         return rid
 
     def evict(self, rid: int) -> bool:
@@ -1451,6 +1689,7 @@ class SimRollingEngine:
         req = self._prefilling.pop(rid, None) or self._rows.pop(rid, None)
         if req is None:
             return False
+        self._spec_state.pop(rid, None)
         self._free.append(req["slot"])
         return True
 

@@ -177,7 +177,8 @@ class EngineHost:
 
     def __init__(self, max_slots=4, steps_per_call=8, step_ms=2.0,
                  prefill_chunk=16, max_waiting=64, prefix_split=None,
-                 kv_block_tokens=None, kv_budget_blocks=None):
+                 kv_block_tokens=None, kv_budget_blocks=None,
+                 spec_k=0, spec_accept=0.0, spec_throttle=None):
         from kubetorch_tpu.serving.engine import (
             DecodeEngine,
             SimRollingEngine,
@@ -187,12 +188,16 @@ class EngineHost:
             SimRollingEngine(max_slots=int(max_slots),
                              steps_per_call=int(steps_per_call),
                              prefill_chunk=int(prefill_chunk),
-                             step_s=float(step_ms) / 1e3),
+                             step_s=float(step_ms) / 1e3,
+                             spec_k=int(spec_k),
+                             spec_accept=float(spec_accept)),
             max_waiting=int(max_waiting), prefix_split=prefix_split,
             kv_block_tokens=(int(kv_block_tokens)
                              if kv_block_tokens is not None else None),
             kv_budget_blocks=(int(kv_budget_blocks)
-                              if kv_budget_blocks is not None else None))
+                              if kv_budget_blocks is not None else None),
+            spec_throttle=(float(spec_throttle)
+                           if spec_throttle is not None else None))
 
     def generate(self, program, delay_ms=0.0):
         for frame in self._engine.generate(program):

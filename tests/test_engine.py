@@ -389,6 +389,110 @@ def test_control_stats_surface_kv_metrics(enginehost):
         assert "kv_blocks_used" in info["engine"], sorted(info["engine"])
 
 
+# --------------------------------------------- speculative (ISSUE 14)
+@pytest.fixture(scope="module")
+def spechost(_local_state):
+    """EngineHost over a SPECULATIVE sim engine with automatic prefix
+    sharing on — the composition the PR-10 gate used to forbid. The
+    sim's emission stays a pure function of (full prompt, index), so
+    every stream below is byte-asserted against the spec-OFF ground
+    truth by construction."""
+    remote = Cls(root_path=str(ASSETS), import_path="summer",
+                 callable_name="EngineHost", name="spechost",
+                 init_args={"args": [], "kwargs": {
+                     "spec_k": 4, "spec_accept": 0.8,
+                     "prefix_split": "len:16", "prefill_chunk": 16,
+                     "step_ms": 2.0}})
+    remote.to(kt.Compute(cpus="0.1"))
+    yield remote
+    remote.teardown()
+
+
+@pytest.mark.level("minimal")
+def test_spec_prefix_hit_stream_byte_identical_with_partition(spechost):
+    """ISSUE 14 acceptance over a real pod: the full path — admission →
+    chunked prefill → prefix HIT → adaptive spec decode → stream —
+    emits byte-identical to a spec-off engine under greedy, including a
+    mid-stream partition resume (PR-8 replay, exec-count 1). Also pins
+    the removed ``engine.py`` spec×prefix-sharing gate: the second
+    program's prefix must HIT the cache registered by the first."""
+    prefix = list(range(200, 216))               # len:16 split point
+    suffix_a = [61] * 24                         # > prefill_chunk head
+    suffix_b = [62] * 24
+    with spechost.channel(depth=2) as chan:
+        first = list(chan.submit(
+            {"prompt": prefix + suffix_a, "max_new_tokens": 64,
+             "tag": "pfx-a"},
+            method="generate", stream=True, concurrent=True,
+        ).result(timeout=60))
+        assert [t for f in first for t in f["tokens"]] == \
+            SimRollingEngine.expected_tokens(prefix + suffix_a, 64)
+        st0 = chan.call(method="stats")
+        policy = chaos.ChaosPolicy(seed=5, partition=1.0, max_events=1)
+        chaos.install(policy)
+        stream = chan.submit(
+            {"prompt": prefix + suffix_b, "max_new_tokens": 160,
+             "tag": "pfx-b"},
+            kwargs={"delay_ms": 5.0}, method="generate", stream=True,
+            concurrent=True)
+        frames = list(stream.result(timeout=120))
+        chaos.install(None)
+        assert [e[0] for e in policy.events] == ["partition"]
+        assert [t for f in frames for t in f["tokens"]] == \
+            SimRollingEngine.expected_tokens(prefix + suffix_b, 160)
+        assert [f["seq"] for f in frames] == list(range(len(frames)))
+        assert chan.call("pfx-b", method="exec_count") == 1
+        st = chan.call(method="stats")
+        # the second program's prefix HIT (no second prefix prefill),
+        # and the engine actually speculated
+        assert st["prefixes"] == 1
+        assert st["prefill_tokens_executed"] - \
+            st0["prefill_tokens_executed"] == len(suffix_b)
+        assert st["spec_rounds"] > 0
+        assert st["spec_tokens_per_pass"] > 1.0
+
+
+@pytest.mark.level("minimal")
+def test_spec_session_park_resume_over_wire(spechost):
+    """ISSUE 14 × PR 10: a SPECULATIVE session parks mid-stream and a
+    resubmit resumes its stream exactly — the acceptance EMA + draft
+    lookahead ride the store blob (spec composes with park/resume)."""
+    import uuid
+
+    from kubetorch_tpu.serving.engine import program
+
+    sid = f"spec-{uuid.uuid4().hex[:8]}"
+    prompt = [71, 72]
+    n = 600
+    with spechost.channel(depth=2) as chan:
+        stream = chan.submit(
+            program(prompt, session_id=sid, max_new_tokens=n),
+            kwargs={"delay_ms": 5.0}, method="generate", stream=True,
+            concurrent=True, timeout=60.0)
+        got, saw_parked = [], False
+        parked_rows = None
+        for frame in stream:
+            if frame.get("parked"):
+                saw_parked = True
+                break
+            got.extend(frame["tokens"])
+            if parked_rows is None and len(got) >= 8:
+                parked_rows = chan.call(sid, method="park")
+        assert parked_rows == 1 and saw_parked and 0 < len(got) < n
+        st_before = chan.call(method="stats")
+        frames = list(chan.submit(
+            program(prompt, session_id=sid, max_new_tokens=n),
+            method="generate", stream=True, concurrent=True,
+        ).result(timeout=120))
+        rest = [t for f in frames for t in f["tokens"]]
+        assert frames[-1]["done"]
+        assert got + rest == SimRollingEngine.expected_tokens(prompt, n)
+        st = chan.call(method="stats")
+        assert st["restores"] == st_before["restores"] + 1
+        assert st["prefill_tokens_executed"] == \
+            st_before["prefill_tokens_executed"]
+
+
 @pytest.mark.level("minimal")
 def test_program_deadline_rejected_typed_over_wire(enginehost):
     """A program deadline evicts the row server-side mid-stream and the

@@ -927,10 +927,22 @@ def test_chunked_prefill_matches_oneshot_admission(model):
 
 
 @pytest.mark.level("minimal")
-def test_chunked_prefill_rejects_spec_and_bad_chunk(model):
+def test_chunked_prefill_composes_with_spec(model):
+    """ISSUE 14 tentpole: the prefill_chunk × spec_k ctor
+    incompatibility is LIFTED — a long prompt prefills into the grid
+    chunk by chunk and the draft haystack seeds at activation, so the
+    spec stream stays token-identical to the plain engine's. Bad chunk
+    sizes still raise."""
     params, cfg = model
-    with pytest.raises(ValueError):
-        RollingGenerator(params, cfg, max_slots=2, prefill_chunk=8,
-                         spec_k=4)
+    prompt = [(i * 7) % 50 + 2 for i in range(40)]   # > chunk of 16
+    plain = RollingGenerator(params, cfg, max_slots=2)
+    rp = plain.submit(prompt, max_new_tokens=12)
+    out_p = plain.run()[rp]
+    spec = RollingGenerator(params, cfg, max_slots=2, prefill_chunk=16,
+                            spec_k=4, steps_per_call=2)
+    rs = spec.submit(prompt, max_new_tokens=12)
+    out_s = spec.run()[rs]
+    assert out_p == out_s, (out_p, out_s)
+    assert spec.spec_stats["rounds"] > 0
     with pytest.raises(ValueError):
         RollingGenerator(params, cfg, max_slots=2, prefill_chunk=0)

@@ -63,6 +63,20 @@ EXPECTED_KEYS = {
     "kv_park_ms",
     "kv_resume_ttft_ms",
     "kv_resume_ttft_chunks",
+    # speculative scheduling (ISSUE 14): per-row adaptive lookahead —
+    # paired virtual-time Poisson runs, spec off vs on
+    "spec_programs",
+    "spec_k_max_cfg",
+    "spec_tok_s_off",
+    "spec_tok_s_on",
+    "spec_goodput_ratio",
+    "spec_ttft_ms_p99_off",
+    "spec_ttft_ms_p99_on",
+    "spec_accept_rate",
+    "spec_k_p50",
+    "spec_k_p99",
+    "spec_k_high_accept_p50",
+    "spec_k_adversarial_p50",
     # fleet telemetry plane (ISSUE 13): what the heartbeat piggyback
     # costs and what one SLO evaluation sweep costs
     "telemetry_frames",
@@ -141,6 +155,20 @@ def test_serving_dryrun_metric_keys():
     # chunk (CI headroom: 4), not the prompt's full chunked prefill
     assert out["kv_resume_ttft_chunks"] <= 4.0, out["kv_resume_ttft_chunks"]
     assert out["kv_resume_ttft_ms"] < 0.5 * out["kv_unparked_ttft_ms"]
+    # speculative scheduling (ISSUE 14 acceptance): at the same seeded
+    # overload, spec-on goodput beats spec-off at equal-or-better TTFT
+    # p99 (virtual-time phase — deterministic, so the floors are tight),
+    # and per-row adaptive k converges BOTH directions: high-accept
+    # rows hold k > 2, adversarial-random rows settle at k = 1
+    assert out["spec_tok_s_on"] >= out["spec_tok_s_off"], (
+        out["spec_tok_s_on"], out["spec_tok_s_off"])
+    assert out["spec_goodput_ratio"] >= 1.05, out["spec_goodput_ratio"]
+    assert out["spec_ttft_ms_p99_on"] <= out["spec_ttft_ms_p99_off"], (
+        out["spec_ttft_ms_p99_on"], out["spec_ttft_ms_p99_off"])
+    assert out["spec_k_high_accept_p50"] > 2, out["spec_k_high_accept_p50"]
+    assert out["spec_k_adversarial_p50"] <= 1.0, (
+        out["spec_k_adversarial_p50"])
+    assert 0.0 < out["spec_accept_rate"] < 1.0, out["spec_accept_rate"]
     # fleet telemetry plane: the heartbeat piggyback (frame build +
     # controller ingest) must stay under 3% of a heartbeat tick, and an
     # SLO evaluation sweep must be cheap enough for the resilience

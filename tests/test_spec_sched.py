@@ -1,0 +1,571 @@
+"""ISSUE 14: speculative decoding as a scheduler citizen.
+
+Four layers:
+
+1. **Lookahead state machine** (stdlib): per-row k driven by the
+   acceptance EMA — convergence BOTH directions, the k=1 probe path,
+   and the occupancy cap's immediate clamp.
+2. **Sim engine spec surface** (no jax): scripted per-row accept rates
+   drive the same adaptation loop CPU-only; token output stays the
+   pure function of (prompt, index), so spec-on ≡ spec-off identity is
+   byte-assertable; the DecodeEngine occupancy throttle caps and lifts
+   per-row lookahead against live occupancy; the shed check prices
+   verify waste.
+3. **Real rolling engine** (tiny CPU model): greedy token identity
+   through the full composition the ctor used to reject — chunked
+   prefill × speculation × shared prefixes × adaptation — plus
+   park/resume with live draft context (export/import round-trips the
+   haystack, carried token, k and EMA) and the kk-masked rejection
+   helpers' full-accept semantics.
+4. **Engine-path sampled spec**: temperature > 0 programs through the
+   rolling engine's verify rounds reuse ``rejection_accept`` /
+   ``residual_next`` (the shared math) under per-row kk masks.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubetorch_tpu.lookahead import (
+    GROW_AT,
+    PROBE_EVERY,
+    LookaheadState,
+)
+
+ALPHA = 0.25
+
+
+# ------------------------------------------------ 1. state machine
+@pytest.mark.level("unit")
+def test_lookahead_grows_on_accepting_rows():
+    st = LookaheadState(8, k0=2, ema0=0.5)
+    ks = []
+    for _ in range(12):
+        for _ in range(4):                      # 4 rounds per chunk
+            st.observe(st.k, st.k, alpha=ALPHA)   # every draft lands
+        ks.append(st.adapt(8))
+    assert st.k == 8, ks
+    assert st.ema > GROW_AT
+
+
+@pytest.mark.level("unit")
+def test_lookahead_collapses_on_random_rows_and_probes():
+    st = LookaheadState(8)                      # optimistic start: k=8
+    for _ in range(10):
+        for _ in range(4):
+            st.observe(1, st.k, alpha=ALPHA)      # nothing lands
+        st.adapt(8)
+    assert st.k == 1, st.k                      # settled at plain decode
+    # at the floor there is no evidence; after PROBE_EVERY chunks the
+    # machine probes k=2 once...
+    st.floor_chunks = 0
+    for i in range(PROBE_EVERY - 1):
+        assert st.adapt(8) == 1, i
+    assert st.adapt(8) == 2
+    # ...and a still-random row returns to the floor
+    for _ in range(4):
+        st.observe(1, st.k, alpha=ALPHA)
+    for _ in range(4):
+        st.adapt(8)
+    assert st.k == 1
+
+
+@pytest.mark.level("unit")
+def test_lookahead_regrows_from_floor_when_regime_changes():
+    st = LookaheadState(8)
+    for _ in range(16):
+        for _ in range(4):
+            st.observe(1, st.k, alpha=ALPHA)
+        st.adapt(8)
+    assert st.k <= 2        # at the floor (or on a probe chunk)
+    # the conversation turned extractive: the probe's rounds land and
+    # the row climbs back to k_max
+    for _ in range(PROBE_EVERY + 20):
+        st.observe(st.k, st.k, alpha=ALPHA)
+        st.adapt(8)
+    assert st.k == 8
+
+
+@pytest.mark.level("unit")
+def test_lookahead_cap_clamps_immediately_and_lifts():
+    st = LookaheadState(8)                      # k = 8
+    assert st.adapt(8, cap=1) == 1              # throttle bites NOW
+    st.ema = 1.0
+    assert st.adapt(8, cap=1) == 1              # held at the cap
+    for _ in range(12):
+        st.observe(st.k, st.k, alpha=ALPHA)
+        st.adapt(8, cap=0)                      # cap lifted
+    assert st.k == 8
+
+
+# ---------------------------------------------- 2. sim engine surface
+@pytest.mark.level("unit")
+def test_sim_spec_identity_and_per_row_convergence():
+    """Scripted mixed traffic: spec-on emits BYTE-IDENTICAL streams to
+    spec-off (speculation changes pacing, never content) while per-row
+    k converges both directions."""
+    from kubetorch_tpu.serving.engine import SimRollingEngine
+
+    def accept(prompt):
+        return 0.9 if prompt[0] % 2 == 0 else 0.0
+
+    sim = SimRollingEngine(max_slots=4, steps_per_call=8, spec_k=6,
+                           spec_accept=accept)
+    prompts = [[100, 1], [101, 1], [102, 1], [103, 1]]
+    rids = [sim.submit(p, max_new_tokens=96) for p in prompts]
+    out = {}
+    while sim.pending:
+        for rid, toks, done in sim.step():
+            out.setdefault(rid, []).extend(toks)
+    for rid, p in zip(rids, prompts):
+        assert out[rid] == SimRollingEngine.expected_tokens(p, 96)
+    # convergence at completion: extractive rows held k > 2,
+    # adversarial rows settled at the k = 1 floor
+    for rid, p in zip(rids, prompts):
+        k_final = sim.spec_k_done[rid]
+        if p[0] % 2 == 0:
+            assert k_final > 2, (p, k_final)
+        else:
+            assert k_final == 1, (p, k_final)
+    ss = sim.spec_stats
+    assert ss["rounds"] > 0 and 0.0 < ss["accept_rate"] < 1.0
+    assert ss["verify_waste"] > 0                # adversarial rows paid
+    assert ss["tokens_per_pass"] > 1.0
+
+
+@pytest.mark.level("unit")
+def test_sim_spec_occupancy_throttle_caps_and_lifts():
+    """The driver tick is the occupancy throttle: above the threshold
+    every row's lookahead caps at 1 (compute-bound regime), and the cap
+    lifts when occupancy falls back."""
+    from kubetorch_tpu.serving.engine import (
+        DecodeEngine,
+        SimRollingEngine,
+    )
+
+    sim = SimRollingEngine(max_slots=2, steps_per_call=2, spec_k=6,
+                           spec_accept=0.9, step_s=0.01)
+    eng = DecodeEngine(sim, poll_s=0.002, spec_throttle=0.9)
+    try:
+        done = []
+
+        def run(n):
+            try:
+                list(eng.generate({"prompt": [2, n],
+                                   "max_new_tokens": n}))
+                done.append(n)
+            # teardown close() fails the still-live stream typed; the
+            # thread must exit quietly either way
+            except Exception:  # noqa: BLE001
+                pass
+
+        t1 = threading.Thread(target=run, args=(4000,), daemon=True)
+        t2 = threading.Thread(target=run, args=(64,), daemon=True)
+        t1.start()
+        t2.start()
+        # both rows live -> occupancy 1.0 >= 0.9 -> capped at 1
+        deadline = time.time() + 10
+        while sim.spec_cap != 1 and time.time() < deadline:
+            time.sleep(0.005)
+        assert sim.spec_cap == 1, "throttle never capped lookahead"
+        while sim.spec_row_ks() != [1] * 2 and time.time() < deadline:
+            time.sleep(0.005)
+        assert sim.spec_row_ks() == [1, 1], sim.spec_row_ks()
+        t2.join(30)
+        assert done == [64]
+        # one row left -> occupancy 0.5 < 0.9 -> cap lifts, the
+        # high-accept survivor regrows
+        while sim.spec_cap != 0 and time.time() < deadline:
+            time.sleep(0.005)
+        assert sim.spec_cap == 0, "throttle never lifted"
+        while (not any(k > 2 for k in sim.spec_row_ks())
+               and time.time() < deadline):
+            time.sleep(0.005)
+        assert any(k > 2 for k in sim.spec_row_ks()), sim.spec_row_ks()
+    finally:
+        eng.close()
+
+
+@pytest.mark.level("unit")
+def test_spec_counters_reach_prometheus():
+    """The driver tick's delta publisher must land in the process
+    metrics dict: ``record_engine`` bumps counters with ``+=`` behind
+    the serving path's must-never-raise guard, so an event target
+    missing from the ``_ENGINE`` seed is a SILENT KeyError — the k
+    gauges publish while the round/emit/waste counters read 0 forever
+    (the bug the live drive caught). Pins the seed-coverage invariant
+    and the end-to-end publication."""
+    from kubetorch_tpu.observability import prometheus as prom
+    from kubetorch_tpu.serving.engine import (
+        DecodeEngine,
+        SimRollingEngine,
+    )
+
+    # every counter record_engine can bump must be pre-seeded
+    missing = [m for m in prom._ENGINE_EVENTS.values()
+               if m not in prom._ENGINE]
+    assert not missing, missing
+
+    before = prom.engine_metrics()
+    sim = SimRollingEngine(max_slots=2, steps_per_call=4, spec_k=4,
+                           spec_accept=0.5)
+    eng = DecodeEngine(sim, poll_s=0.002)
+    try:
+        out = list(eng.generate({"prompt": [2, 5], "max_new_tokens": 32}))
+        assert sum(len(f["tokens"]) for f in out) == 32
+        deadline = time.time() + 10
+        while (prom.engine_metrics()["engine_spec_rounds_total"]
+               <= before["engine_spec_rounds_total"]
+               and time.time() < deadline):
+            time.sleep(0.005)
+    finally:
+        eng.close()
+    after = prom.engine_metrics()
+    for name in ("engine_spec_rounds_total", "engine_spec_emitted_total",
+                 "engine_spec_drafted_total",
+                 "engine_spec_verify_waste_total"):
+        assert after[name] > before[name], name
+    assert after["engine_spec_accept_rate"] > 0.0
+
+
+@pytest.mark.level("unit")
+def test_shed_check_prices_verify_waste(monkeypatch):
+    """Speculation-aware admission: with rows free slower than they
+    verify (k_mean high, tokens_per_pass ~1 — drafts not landing), the
+    row-free estimate scales by the verify load and the program sheds;
+    the same queue under well-landing speculation admits."""
+    from kubetorch_tpu.exceptions import ServerOverloaded
+    from kubetorch_tpu.serving.engine import (
+        DecodeEngine,
+        SimRollingEngine,
+    )
+
+    monkeypatch.setenv("KT_MAX_QUEUE_DELAY_S", "0.2")
+
+    def make(stats):
+        class Fixed(SimRollingEngine):
+            @property
+            def spec_stats(self):
+                return dict(stats)
+
+        return Fixed(max_slots=1, steps_per_call=8, spec_k=6,
+                     spec_accept=0.0, step_s=0.02)
+
+    base = {"rounds": 100, "emitted": 100, "tokens_per_pass": 1.0,
+            "drafted": 500, "accepted": 0, "accept_rate": 0.0,
+            "verify_waste": 500, "k_mean": 5.0, "k_cap": 6}
+    # wasteful speculation: est_delay x (k_mean / tpp) = 5x -> shed
+    def drain_quietly(engine, prog):
+        try:
+            list(engine.generate(prog))
+        except Exception:  # noqa: BLE001 — teardown fails it typed
+            pass
+
+    sim = make(base)
+    eng = DecodeEngine(sim, poll_s=0.002, max_waiting=0)
+    try:
+        th = threading.Thread(
+            target=drain_quietly,
+            args=(eng, {"prompt": [9, 9], "max_new_tokens": 4000}),
+            daemon=True)
+        th.start()
+        deadline = time.time() + 10
+        while sim.active_rows < 1 and time.time() < deadline:
+            time.sleep(0.005)
+        # (waiting 0 + 1 new) x ema_row_s(0.05) = 0.05s base estimate;
+        # x5 verify factor = 0.25 > 0.2 -> typed shed with retry_after
+        with pytest.raises(ServerOverloaded) as err:
+            next(eng.generate({"prompt": [1], "max_new_tokens": 4}))
+        assert err.value.retry_after
+    finally:
+        eng.close()
+    # efficient speculation (tpp == k_mean): factor 1 -> 0.05 < 0.2 ->
+    # the same program QUEUES instead of shedding. emitted/rounds must
+    # AGREE with tokens_per_pass: the shed check prices from the
+    # driver tick's delta EMA, not the reported lifetime ratio
+    good = dict(base, emitted=500, tokens_per_pass=5.0, accepted=400,
+                accept_rate=0.8, verify_waste=100)
+    sim2 = make(good)
+    eng2 = DecodeEngine(sim2, poll_s=0.002, max_waiting=0)
+    try:
+        th = threading.Thread(
+            target=drain_quietly,
+            args=(eng2, {"prompt": [9, 9], "max_new_tokens": 64}),
+            daemon=True)
+        th.start()
+        deadline = time.time() + 10
+        while sim2.active_rows < 1 and time.time() < deadline:
+            time.sleep(0.005)
+        frames = list(eng2.generate({"prompt": [1],
+                                     "max_new_tokens": 4}))
+        assert frames[-1]["done"]
+    finally:
+        eng2.close()
+
+
+@pytest.mark.level("unit")
+def test_sim_spec_park_resume_keeps_adaptation_state(tmp_path,
+                                                     monkeypatch):
+    """CPU-only park/resume: a parked spec session's lookahead + EMA
+    ride the store blob and resume where they left off — the sim twin
+    of the real engine's draft-context round-trip."""
+    from kubetorch_tpu.data_store import client as client_mod
+    from kubetorch_tpu.serving.engine import (
+        DecodeEngine,
+        SimRollingEngine,
+    )
+
+    monkeypatch.setattr(client_mod, "_LOCAL_STORE", tmp_path)
+    monkeypatch.setattr(client_mod.DataStoreClient, "_default", None)
+    sim = SimRollingEngine(max_slots=2, steps_per_call=4, spec_k=6,
+                           spec_accept=0.9, step_s=0.005)
+    eng = DecodeEngine(sim, poll_s=0.002)
+    prompt = [2, 5]
+    try:
+        got: list = []
+        parked = threading.Event()
+
+        def run_session():
+            for f in eng.generate({"prompt": prompt,
+                                   "max_new_tokens": 512,
+                                   "session_id": "spec-sess"}):
+                if f.get("parked"):
+                    parked.set()
+                    return
+                got.extend(f["tokens"])
+
+        th = threading.Thread(target=run_session, daemon=True)
+        th.start()
+        deadline = time.time() + 20
+        while len(got) < 24 and time.time() < deadline:
+            time.sleep(0.002)
+        # the live row has adapted upward by now (accept 0.9)
+        live_ks = sim.spec_row_ks()
+        assert live_ks and live_ks[0] > 2, live_ks
+        assert eng.park("spec-sess") == 1
+        th.join(10)
+        assert parked.is_set()
+
+        rest: list = []
+        for f in eng.generate({"prompt": prompt, "max_new_tokens": 512,
+                               "session_id": "spec-sess"}):
+            if not rest:
+                # restored row resumes AT its parked lookahead — not
+                # back at the optimistic start with a cleared EMA
+                ks = sim.spec_row_ks()
+                assert ks and ks[0] == live_ks[0], (ks, live_ks)
+            rest.extend(f["tokens"])
+            if len(rest) >= 16:
+                break
+        expect = SimRollingEngine.expected_tokens(
+            prompt, len(got) + len(rest))
+        assert got + rest == expect, "resumed spec stream diverged"
+        assert eng.stats()["spec_rounds"] > 0
+    finally:
+        eng.close()
+
+
+# -------------------------------------------- 3. real rolling engine
+@pytest.fixture(scope="module")
+def model():
+    import jax
+
+    from kubetorch_tpu.models import LlamaConfig, llama
+
+    cfg = LlamaConfig(vocab_size=256, embed_dim=64, n_layers=2,
+                      n_heads=4, n_kv_heads=2, head_dim=16, mlp_dim=128,
+                      remat=False, dtype="float32",
+                      param_dtype="float32", max_seq_len=128)
+    params = llama.init(jax.random.key(0), cfg)
+    return params, cfg
+
+
+@pytest.mark.level("minimal")
+def test_spec_full_composition_token_identity(model):
+    """The tentpole pinned on the real engine: chunked prefill x shared
+    prefix x per-row adaptive speculation, mid-flight, greedy — token
+    streams equal the plain engine's for every request."""
+    from kubetorch_tpu.models.rolling import RollingGenerator
+
+    params, cfg = model
+    prefix = [(i * 3) % 40 + 2 for i in range(12)]
+    long_p = [(i * 7) % 50 + 2 for i in range(40)]    # chunked (>16)
+    outs = {}
+    for name, kw in (("plain", {}),
+                     ("spec", {"spec_k": 4, "steps_per_call": 2,
+                               "prefill_chunk": 16})):
+        eng = RollingGenerator(params, cfg, max_slots=3, **kw)
+        pid = eng.register_prefix(list(prefix))
+        r1 = eng.submit([7, 8, 9], max_new_tokens=10, prefix_id=pid)
+        r2 = eng.submit(list(long_p), max_new_tokens=10)
+        res: dict = {}
+        # a few steps in, a third request joins the live batch
+        for _ in range(2):
+            for rid, toks, _ in eng.step():
+                res.setdefault(rid, []).extend(toks)
+        r3 = eng.submit([5, 4], max_new_tokens=10, prefix_id=pid)
+        for rid, toks in eng.run().items():
+            res.setdefault(rid, []).extend(toks)
+        outs[name] = [res[r1], res[r2], res[r3]]
+        if kw:
+            assert eng.spec_stats["rounds"] > 0
+    assert outs["plain"] == outs["spec"], outs
+
+
+@pytest.mark.level("minimal")
+def test_spec_export_import_resume_identity(model):
+    """Park/resume with LIVE draft context: a spec row exported
+    mid-generation and imported into a fresh same-geometry spec engine
+    continues token-identical to an uninterrupted run, with its
+    lookahead + EMA intact."""
+    from kubetorch_tpu.models.rolling import RollingGenerator
+
+    params, cfg = model
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    ref_eng = RollingGenerator(params, cfg, max_slots=2, spec_k=4,
+                               steps_per_call=1)
+    rr = ref_eng.submit(list(prompt), max_new_tokens=16)
+    ref = ref_eng.run()[rr]
+
+    eng_a = RollingGenerator(params, cfg, max_slots=2, spec_k=4,
+                             steps_per_call=1)
+    ra = eng_a.submit(list(prompt), max_new_tokens=16)
+    eng_a.admit()
+    got = []
+    while len(got) < 6:
+        for _, toks, _ in eng_a.decode_step():
+            got.extend(toks)
+    state = eng_a.export_row(ra, block_tokens=16)
+    assert "spec_ctx" in state and "spec" in state
+    # the carried token + haystack survive; the ctx tail past the
+    # row's depth is zeroed (cross-tenant hygiene, like the KV planes)
+    dpos = int(np.asarray(state["scalars"])[0])
+    assert not np.asarray(state["spec_ctx"])[dpos:].any()
+    eng_a.evict(ra)
+
+    eng_b = RollingGenerator(params, cfg, max_slots=2, spec_k=4,
+                             steps_per_call=1)
+    rb = eng_b.import_row(state)
+    slot_b = eng_b._slots[next(s for s, r in eng_b._slots.items()
+                               if r.rid == rb)].slot
+    st_b = eng_b._spec_state[slot_b]
+    st_a = np.asarray(state["spec"])
+    assert st_b.k == int(st_a[2])               # lookahead survived
+    assert st_b.ema == pytest.approx(
+        float(np.asarray(state["spec_ema"])[0]))
+    rest = []
+    while True:
+        events = eng_b.decode_step()
+        if not events:
+            break
+        for _, toks, done in events:
+            rest.extend(toks)
+        if any(done for _, _, done in events):
+            break
+    assert got + rest == ref, (got, rest, ref)
+
+
+@pytest.mark.level("minimal")
+def test_spec_export_cross_mode(model):
+    """Plain export -> spec engine works (haystack rebuilt, first token
+    from the exported logits — greedy identity holds); spec export ->
+    plain engine raises typed (the next token is round-carried state a
+    plain engine cannot resume)."""
+    from kubetorch_tpu.models.rolling import RollingGenerator
+
+    params, cfg = model
+    prompt = [11, 3, 7, 2]
+    ref_eng = RollingGenerator(params, cfg, max_slots=2)
+    rr = ref_eng.submit(list(prompt), max_new_tokens=12)
+    ref = ref_eng.run()[rr]
+
+    plain = RollingGenerator(params, cfg, max_slots=2)
+    rp = plain.submit(list(prompt), max_new_tokens=12)
+    plain.admit()
+    got = []
+    while len(got) < 4:
+        for _, toks, _ in plain.decode_step():
+            got.extend(toks)
+    state = plain.export_row(rp, block_tokens=16)
+    plain.evict(rp)
+
+    spec = RollingGenerator(params, cfg, max_slots=2, spec_k=4,
+                            steps_per_call=1)
+    spec.import_row(state)
+    rest = []
+    done_f = False
+    while not done_f:
+        for _, toks, done in spec.decode_step():
+            rest.extend(toks)
+            done_f = done_f or done
+    assert got + rest == ref, (got, rest, ref)
+
+    # the reverse direction refuses typed
+    rs = spec.submit(list(prompt), max_new_tokens=12)
+    spec.admit()
+    spec.decode_step()
+    spec_state = spec.export_row(rs, block_tokens=16)
+    plain2 = RollingGenerator(params, cfg, max_slots=2)
+    with pytest.raises(ValueError, match="speculative"):
+        plain2.import_row(spec_state)
+
+
+@pytest.mark.level("minimal")
+def test_kk_masked_rejection_helpers(model):
+    """Per-row kk masking inside a wider dispatch must reproduce the
+    k = kk semantics exactly: acceptance never crosses kk - 1, and a
+    row's FULL accept (acc == kk - 1) draws from the unmodified break
+    distribution — no mass removed for the never-tested boundary
+    draft."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubetorch_tpu.models.speculative import (
+        rejection_accept,
+        residual_next,
+    )
+
+    del model
+    B, k, V = 3, 4, 8
+    feed = jnp.array([[1, 2, 3, 4]] * B, jnp.int32)
+    # point mass ON the draft at every position: the unmasked test
+    # accepts everything it is allowed to
+    probs = jnp.zeros((B, k, V))
+    for i in range(k):
+        tgt = [2, 3, 4, 5][i]
+        probs = probs.at[:, i, tgt].set(1.0)
+    kk = jnp.array([1, 2, 4], jnp.int32)
+    acc = rejection_accept(probs, feed, jax.random.key(0), k=k, kk=kk)
+    # each row's acceptance is exactly its own kk - 1 (full accept)
+    assert list(np.asarray(acc)) == [0, 1, 3]
+    nxt = residual_next(probs, feed, acc, jax.random.key(1), k=k, kk=kk)
+    # full accept at the row's own boundary: the next token draws from
+    # the break position's UNTOUCHED distribution (its point mass at
+    # positions 0/1/3 -> tokens 2/3/5) — no mass removed for the
+    # never-tested boundary draft
+    assert list(np.asarray(nxt)) == [2, 3, 5]
+
+
+@pytest.mark.level("minimal")
+def test_sampled_spec_through_engine_rounds(model):
+    """Satellite: the engine's sampled verify rounds run the shared
+    rejection path (``rejection_accept``/``residual_next``) under
+    per-row kk masks — mixed greedy+sampled traffic through the
+    adaptive engine produces full-length streams and flips the sticky
+    sampling executable."""
+    from kubetorch_tpu.models.rolling import RollingGenerator
+
+    params, cfg = model
+    eng = RollingGenerator(params, cfg, max_slots=4, spec_k=4,
+                           steps_per_call=2, top_k=4, seed=5)
+    r_greedy = eng.submit([2, 4, 6], max_new_tokens=12)
+    r_hot = eng.submit([2, 4, 6], max_new_tokens=12, temperature=0.8)
+    res = eng.run()
+    assert len(res[r_greedy]) == 12 and len(res[r_hot]) == 12
+    assert eng._spec_sampling           # the sampled row upgraded it
+    # greedy rows in a mixed batch stay greedy-identical
+    plain = RollingGenerator(params, cfg, max_slots=4, top_k=4)
+    rp = plain.submit([2, 4, 6], max_new_tokens=12)
+    assert plain.run()[rp] == res[r_greedy]
