@@ -200,6 +200,108 @@ def _simulate_admission(dryrun: bool) -> Dict[str, float]:
             "admission_shed_goodput_ratio": round(ratio, 3)}
 
 
+def _simulate_controller_recovery(dryrun: bool, chaos) -> Dict[str, float]:
+    """Control-plane crash leg (ISSUE 15): a real ControllerServer
+    (durable SQLite + LivenessTracker + RestartPolicy, wired exactly as
+    production wires them) tracks a beating gang; the seeded chaos
+    policy picks the kill beat; the server object is destroyed and a
+    second one rebuilds from the SAME database. Measured: kill →
+    correct gang health under the rebuilt controller's OWN sweep
+    (``controller_recovery_s``), with the rejoin quarantine honored.
+    Asserted: the rebuilt policy consumed ZERO restart attempts for the
+    healthy gang (``controller_restart_spurious_restarts`` — the number
+    the e2e also pins at 0) and the ghost service's pre-crash budget
+    carried over (``controller_restart_budget_carried``)."""
+    import asyncio
+    import tempfile as _tempfile
+
+    from kubetorch_tpu.controller.server import ControllerServer
+    from kubetorch_tpu.resilience.chaos import CONTROLLER_KILL
+
+    hb = 0.02 if dryrun else 0.1
+    grace = 2.5 * hb
+    pods = [f"bench-pod-{i}" for i in range(3 if dryrun else 8)]
+    tmp = Path(_tempfile.mkdtemp(prefix="ktpu-ctl-"))
+    # harness env orchestration (save → override → restore), not a
+    # config read: the ControllerServer under test reads the knob
+    # through the typed accessor
+    old_hb = os.environ.get("KT_HEARTBEAT_S")  # ktlint: disable=KT003 -- env save/restore around the subcomponent under test
+    os.environ["KT_HEARTBEAT_S"] = str(hb)
+    try:
+        db_path = str(tmp / "controller.db")
+        s1 = ControllerServer(db_path, enable_reaper=False,
+                              enable_resilience=False,
+                              rejoin_grace_s=grace)
+        for pod in pods:
+            s1.liveness.beat("bench-gang", pod)
+        s1.liveness.sweep()
+        assert s1.liveness.gang_health("bench-gang")["status"] == "healthy"
+        # a second service burned one restart attempt pre-crash: the
+        # rebuilt controller must see the SAME consumed budget
+        s1.restart_policy.next_delay("bench-ghost")
+        burned = s1.restart_policy.attempts("bench-ghost")
+        # seeded kill moment: beat the gang until the policy says die
+        beat = 0
+        while not chaos.decide(CONTROLLER_KILL, "bench") and beat < 64:
+            beat += 1
+            for pod in pods:
+                s1.liveness.beat("bench-gang", pod)
+        t_kill = time.perf_counter()
+        # bare in-process server: release the log-persist executor
+        # (the aiohttp shutdown hook that normally does this never
+        # runs) — the crash state under test is the SQLite db
+        if s1.log_sink.persist is not None:
+            s1.log_sink.persist.close()
+        del s1                                     # the crash
+
+        s2 = ControllerServer(db_path, enable_reaper=False,
+                              enable_resilience=False,
+                              rejoin_grace_s=grace)
+        assert s2._rejoined, "restart restored nothing — not a rejoin"
+        recovery_s = None
+        deadline = t_kill + 100 * hb
+
+        async def tick():
+            await s2._resilience_tick()
+
+        while time.perf_counter() < deadline:
+            for pod in pods:
+                s2.liveness.beat("bench-gang", pod)
+            asyncio.run(tick())
+            health = s2.liveness.gang_health("bench-gang")
+            if (s2.rejoin_grace_remaining() == 0.0
+                    and health["status"] == "healthy"
+                    and len(health["pods"]) == len(pods)):
+                recovery_s = time.perf_counter() - t_kill
+                break
+            time.sleep(hb / 2)
+        if recovery_s is None:
+            raise RuntimeError(
+                "rebuilt controller never reached correct gang health")
+        spurious = s2.restart_policy.attempts("bench-gang")
+        carried = s2.restart_policy.attempts("bench-ghost")
+        if spurious != 0:
+            raise RuntimeError(
+                f"controller restart consumed {spurious} restart "
+                f"attempts for a healthy gang")
+        if carried != burned:
+            raise RuntimeError(
+                f"restart budget did not carry over: burned {burned}, "
+                f"rebuilt controller sees {carried}")
+        if s2.log_sink.persist is not None:
+            s2.log_sink.persist.close()
+        return {"controller_recovery_s": round(recovery_s, 4),
+                "controller_restart_spurious_restarts": spurious,
+                "controller_restart_budget_carried": carried,
+                "controller_rejoin_grace_s": grace}
+    finally:
+        if old_hb is None:
+            os.environ.pop("KT_HEARTBEAT_S", None)
+        else:
+            os.environ["KT_HEARTBEAT_S"] = old_hb
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _toy_state(dryrun: bool):
     import jax.numpy as jnp
     import numpy as np
@@ -224,6 +326,11 @@ def run(dryrun: bool = False) -> Dict[str, float]:
     out.update(_simulate_detect(dryrun, chaos))
     out.update(_simulate_replay(dryrun))
     out.update(_simulate_admission(dryrun))
+    # control-plane leg: its own policy (same seed) so the seeded
+    # controller-kill draw cannot compete with the worker-kill budget
+    out.update(_simulate_controller_recovery(
+        dryrun, ChaosPolicy(seed=chaos.seed, controller_kill=0.3,
+                            max_events=1)))
 
     base = "/dev/shm" if os.path.isdir("/dev/shm") else None
     tmp = Path(tempfile.mkdtemp(prefix="ktpu-resil-", dir=base))

@@ -525,9 +525,84 @@ def trace(service, last, trace_id, out):
 
 
 # ---------------------------------------------------------------- top
+_TOP_DIRECT_GAUGES = ("engine_active_rows", "engine_free_rows",
+                      "engine_queue_depth", "kv_blocks_used",
+                      "engine_spec_accept_rate")
+
+
+def _top_direct_fleet(service, timeout=2.0):
+    """Fleet-rollup-shaped snapshot polled straight off each pod's
+    /metrics — the fallback when the controller is unreachable (the
+    exact incident `ktpu top` is opened for: is the fleet still
+    serving while the control plane is down?). Gauges come from the
+    pod exposition; rates/quantiles need the controller's history and
+    render as absent. Pods are polled CONCURRENTLY: the fallback runs
+    during incidents, when some pods may be down too — sequential
+    polls would freeze the live view for (down pods × timeout)."""
+    import re as _re
+    from concurrent.futures import ThreadPoolExecutor
+
+    import httpx
+
+    from kubetorch_tpu.provisioning.backend import get_backend
+
+    backend = get_backend()
+    try:
+        known = backend.lookup(service) is not None
+    except Exception:  # noqa: BLE001 — lookup may need infra that is down
+        known = True   # can't disprove the service exists: poll anyway
+    if not known:
+        # surfaced as "no service ..." by the caller; without this, the
+        # k8s backend synthesizes a URL for ANY name and a typo renders
+        # as a perpetually-unreachable pod instead of an error
+        raise KeyError(service)
+    urls = backend.pod_urls(service)
+
+    texts = []
+    if urls:
+        # one shared client (httpx.Client is thread-safe): one
+        # connection pool for the whole snapshot instead of per-pod
+        # clients each paying TCP setup
+        with httpx.Client(timeout=timeout) as client:
+
+            def poll(base):
+                try:
+                    return client.get(
+                        f"{base}/metrics",
+                        headers={"Accept": "text/plain"}).text
+                except httpx.HTTPError:
+                    return None
+
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(urls))) as pool:
+                texts = list(pool.map(poll, urls))
+    pods, gauges = {}, {}
+    for i, (base, text) in enumerate(zip(urls, texts)):
+        pod = f"pod-{i}"
+        if text is None:
+            pods[pod] = {"age_s": None, "stale": True, "resets": 0,
+                         "url": base}
+            continue
+        pods[pod] = {"age_s": 0.0, "stale": False, "resets": 0,
+                     "url": base}
+        for name in _TOP_DIRECT_GAUGES:
+            m = _re.search(
+                rf'^kubetorch_{name}(?:\{{[^}}]*\}})? ([0-9.eE+-]+)',
+                text, _re.MULTILINE)
+            if m:
+                entry = gauges.setdefault(name,
+                                          {"sum": 0.0, "by_pod": {}})
+                entry["by_pod"][pod] = float(m.group(1))
+                entry["sum"] += float(m.group(1))
+    return {"service": service, "pods": pods, "gauges": gauges,
+            "counters": {}, "histograms": {}, "source": "direct-poll"}
+
+
 def _top_gather(controller, service, window):
     """One snapshot of the fleet/SLO state ``ktpu top`` renders: per
     service, the cross-pod rollup (per-replica rows) + SLO status."""
+    import httpx
+
     if service:
         services = [service]
     else:
@@ -538,11 +613,18 @@ def _top_gather(controller, service, window):
         entry = {"fleet": None, "slo": []}
         try:
             entry["fleet"] = controller.fleet_metrics(svc, window=window)
+        except httpx.TransportError:
+            # the controller died mid-gather: let the caller demote the
+            # whole snapshot to the direct pod poll — an error ROW here
+            # would mask the incident the fallback exists for
+            raise
         except Exception as exc:  # noqa: BLE001 — render what answered
             entry["error"] = f"{type(exc).__name__}: {exc}"
         try:
             entry["slo"] = (controller.slo_status(svc)
                             or {}).get("objectives") or []
+        except httpx.TransportError:
+            raise   # mid-gather death: demote, same as fleet_metrics
         except Exception:  # noqa: BLE001 — SLOs may be unconfigured
             entry["slo"] = []
         out[svc] = entry
@@ -575,7 +657,10 @@ def _top_rows(fleet):
         p99 = ((hists.get("engine_ttft_seconds") or {})
                .get("by_pod_p99") or {}).get(pod)
         if meta.get("stale"):
-            status = f"stale {meta.get('age_s', '?')}s"
+            # age_s is None for a pod the direct poll could not reach
+            age = meta.get("age_s")
+            status = ("unreachable" if age is None
+                      else f"stale {age}s")
         elif meta.get("last_reset_age_s") is not None \
                 and meta["last_reset_age_s"] < 120:
             status = f"reset {meta['last_reset_age_s']:.0f}s ago"
@@ -635,30 +720,78 @@ def top(service, once, as_json, interval, window):
     """Live fleet view over the controller's telemetry plane: one row
     per replica (row occupancy, queue depth, KV blocks, tok/s, TTFT
     p99) plus each service's SLO burn state. ``--once --json`` is the
-    scripting form."""
+    scripting form. When the controller is unreachable — the exact
+    incident this command is opened for — it falls back to polling
+    each pod's /metrics directly (same contract as ``ktpu health``)."""
     from kubetorch_tpu.controller.client import ControllerClient
 
     controller = ControllerClient.maybe()
-    if controller is None:
-        raise click.ClickException(
-            "ktpu top needs a controller (KT_CONTROLLER_URL / "
-            "ktpu config controller_url=http://...)")
+
+    def gather():
+        """(snapshot, banner): controller rollups when reachable, else
+        the direct pod poll (needs a service name — without a
+        controller there is nothing that can enumerate services)."""
+        if controller is not None:
+            import httpx
+
+            from kubetorch_tpu.exceptions import KubetorchError
+
+            try:
+                controller.health(check_version=False)
+                # a controller that dies BETWEEN the probe and the
+                # gather is the same incident — TransportError from
+                # either demotes to the direct poll. Anything else
+                # (auth failure, controller 500, a gather bug) surfaces
+                # as the real error — demoting it would send the
+                # operator chasing network config
+                return _top_gather(controller, service, window), None
+            except httpx.TransportError:
+                pass
+            except KubetorchError as exc:
+                # reachable-but-erroring controller: the real error,
+                # cleanly (not a traceback, not a fake "unreachable")
+                raise click.ClickException(str(exc))
+        if not service:
+            raise click.ClickException(
+                "controller unreachable (KT_CONTROLLER_URL / ktpu "
+                "config controller_url=...) and no service named — the "
+                "direct pod-poll fallback needs a service argument")
+        try:
+            fleet = _top_direct_fleet(service)
+        except KeyError:
+            raise click.ClickException(f"no service {service!r}")
+        except RuntimeError as exc:
+            # e.g. the K8s backend outside the cluster with no ingress
+            # configured — pod URLs simply cannot be derived here
+            raise click.ClickException(f"direct poll failed: {exc}")
+        return ({service: {"fleet": fleet, "slo": [],
+                           "source": "direct-poll"}},
+                "controller unreachable — direct poll")
+
     if as_json:
-        click.echo(json.dumps(_top_gather(controller, service, window),
-                              indent=2))
+        snapshot, banner = gather()
+        if banner:
+            for entry in snapshot.values():
+                entry["banner"] = banner
+        click.echo(json.dumps(snapshot, indent=2))
         return
     if once:
-        click.echo(_top_render(_top_gather(controller, service, window),
-                               window))
+        snapshot, banner = gather()
+        if banner:
+            click.echo(f"# {banner}")
+        click.echo(_top_render(snapshot, window))
         return
     import time as _time
 
     try:
         while True:
-            snapshot = _top_gather(controller, service, window)
+            snapshot, banner = gather()
             click.echo("\x1b[2J\x1b[H", nl=False)  # clear + home
-            click.echo(f"ktpu top — {controller.base_url}  "
+            base = controller.base_url if controller else "(no controller)"
+            click.echo(f"ktpu top — {base}  "
                        f"(refresh {interval:g}s, Ctrl-C to exit)")
+            if banner:
+                click.echo(f"# {banner}")
             click.echo(_top_render(snapshot, window))
             _time.sleep(max(0.2, interval))
     except KeyboardInterrupt:

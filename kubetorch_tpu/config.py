@@ -540,7 +540,17 @@ _knob("KT_RESTART_BACKOFF_S", "float", 1.0,
 _knob("KT_RESTART_RESET_S", "float", 300.0,
       "Healthy seconds after which the restart budget resets.", "resilience")
 _knob("KT_CHAOS", "str", "",
-      "Chaos-injection spec, e.g. 'seed=7,kill-worker=0.1'.", "resilience")
+      "Chaos-injection spec, e.g. 'seed=7,kill-worker=0.1'; kinds: "
+      "kill-worker, drop-connection, inject-latency, corrupt-heartbeat, "
+      "partition, slow-pod, controller-kill, ws-flap.", "resilience")
+_knob("KT_REJOIN_GRACE_S", "float", None,
+      "Rejoin quarantine after a controller restart that restored "
+      "durable state: for this many seconds the resilience sweep "
+      "observes but never declares dead and never gang-restarts "
+      "(default 2.5 heartbeat intervals; 0 disables).", "resilience")
+_knob("KT_WS_RECONNECT_MAX_S", "float", 30.0,
+      "Cap of the pod's controller-WebSocket reconnect backoff "
+      "(full-jitter exponential from 1 s).", "resilience")
 
 # --- provisioning -----------------------------------------------------------
 _knob("KT_LOCAL_STATE", "str", "~/.ktpu/local",

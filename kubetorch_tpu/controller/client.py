@@ -111,13 +111,19 @@ class ControllerClient:
 
     # ------------------------------------------------------- resilience
     def heartbeat(self, service_name: str, pod: str,
-                  state: Optional[str] = None) -> Dict[str, Any]:
+                  state: Optional[str] = None,
+                  telemetry: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
         """One liveness beat (``state="preempted"`` is the terminal
         drain report). Pods normally piggyback beats on their controller
-        WS; this is the HTTP path (and what tests/sim harnesses use)."""
+        WS; this is the HTTP path (and what tests/sim harnesses use).
+        ``telemetry`` rides inline exactly like the WS piggyback — one
+        request carries liveness AND a metric delta frame."""
         payload: Dict[str, Any] = {"service": service_name, "pod": pod}
         if state:
             payload["state"] = state
+        if telemetry:
+            payload["telemetry"] = telemetry
         return self._check(self.client.post(
             f"{self.base_url}/heartbeat", json=payload))
 

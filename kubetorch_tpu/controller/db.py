@@ -1,8 +1,17 @@
-"""Controller persistence: SQLite (stdlib) for pools and runs.
+"""Controller persistence: SQLite (stdlib) for pools, runs, and the
+control plane's crash-safety state.
 
 Reference: ``services/kubetorch_controller/core/{models,database}.py``
-(SQLAlchemy + SQLite). Plain sqlite3 here — two tables, no ORM needed.
-"""
+(SQLAlchemy + SQLite). Plain sqlite3 here — no ORM needed.
+
+Beyond pools/runs, three small tables make a controller restart a
+non-event for the fleet (ISSUE 15): ``liveness`` (per-pod last-seen
+state, written on state *transitions*, never per beat), ``service_
+resilience`` (restart-budget attempts + backoff deadlines + the last
+dead-detection record — a crash-looping controller must not hand out
+infinite free restarts), and ``slo_objectives`` (runtime-registered
+objectives, which otherwise exist only in the SLOEngine's memory).
+``controller_meta`` holds restart-surviving counters (rejoins)."""
 
 from __future__ import annotations
 
@@ -41,6 +50,32 @@ CREATE TABLE IF NOT EXISTS runs (
     user TEXT,
     created_at REAL NOT NULL,
     updated_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS liveness (
+    service TEXT NOT NULL,
+    pod TEXT NOT NULL,
+    state TEXT NOT NULL,
+    last_seen REAL NOT NULL,
+    updated_at REAL NOT NULL,
+    PRIMARY KEY (service, pod)
+);
+CREATE TABLE IF NOT EXISTS service_resilience (
+    service TEXT PRIMARY KEY,
+    restart_attempts INTEGER NOT NULL DEFAULT 0,
+    backoff_until REAL,
+    last_detect TEXT,
+    updated_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS slo_objectives (
+    service TEXT NOT NULL,
+    name TEXT NOT NULL,
+    spec TEXT NOT NULL,
+    created_at REAL NOT NULL,
+    PRIMARY KEY (service, name)
+);
+CREATE TABLE IF NOT EXISTS controller_meta (
+    key TEXT PRIMARY KEY,
+    value TEXT NOT NULL
 );
 """
 
@@ -137,6 +172,164 @@ class Database:
                 "DELETE FROM pools WHERE service_name=?", (service_name,))
             self._conn.commit()
             return cur.rowcount > 0
+
+    # ------------------------------------------- crash-safety: liveness
+    def save_liveness(self, service: str, pod: str, state: str,
+                      last_seen: Optional[float] = None) -> None:
+        """Persist one pod's liveness state. Called on state
+        TRANSITIONS only (registration, revival, suspect/dead/
+        preempted) — never per beat, so a healthy fleet costs the
+        controller zero steady-state writes."""
+        now = time.time()
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO liveness (service, pod, state, last_seen, "
+                "updated_at) VALUES (?,?,?,?,?) "
+                "ON CONFLICT(service, pod) DO UPDATE SET state=excluded."
+                "state, last_seen=excluded.last_seen, "
+                "updated_at=excluded.updated_at",
+                (service, pod, state, last_seen or now, now))
+            self._conn.commit()
+
+    def load_liveness(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM liveness ORDER BY service, pod").fetchall()
+        return [dict(r) for r in rows]
+
+    def delete_liveness(self, service: str,
+                        pod: Optional[str] = None) -> None:
+        with self._lock:
+            if pod is None:
+                self._conn.execute(
+                    "DELETE FROM liveness WHERE service=?", (service,))
+            else:
+                self._conn.execute(
+                    "DELETE FROM liveness WHERE service=? AND pod=?",
+                    (service, pod))
+            self._conn.commit()
+
+    # ------------------------------------- crash-safety: restart budget
+    def save_restart_state(self, service: str, attempts: int,
+                           backoff_until: Optional[float] = None) -> None:
+        """Persist a service's restart-budget consumption (+ the backoff
+        deadline the next attempt must wait out). ``attempts == 0`` with
+        no deadline deletes the row — a reset budget leaves no trace."""
+        with self._lock:
+            if attempts <= 0 and not backoff_until:
+                self._conn.execute(
+                    "DELETE FROM service_resilience WHERE service=? AND "
+                    "last_detect IS NULL", (service,))
+                self._conn.execute(
+                    "UPDATE service_resilience SET restart_attempts=0, "
+                    "backoff_until=NULL, updated_at=? WHERE service=?",
+                    (time.time(), service))
+            else:
+                self._conn.execute(
+                    "INSERT INTO service_resilience (service, "
+                    "restart_attempts, backoff_until, updated_at) "
+                    "VALUES (?,?,?,?) ON CONFLICT(service) DO UPDATE SET "
+                    "restart_attempts=excluded.restart_attempts, "
+                    "backoff_until=excluded.backoff_until, "
+                    "updated_at=excluded.updated_at",
+                    (service, int(attempts), backoff_until, time.time()))
+            self._conn.commit()
+
+    def save_last_detect(self, service: str,
+                         record: Dict[str, Any]) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO service_resilience (service, last_detect, "
+                "updated_at) VALUES (?,?,?) ON CONFLICT(service) DO "
+                "UPDATE SET last_detect=excluded.last_detect, "
+                "updated_at=excluded.updated_at",
+                (service, json.dumps(record), time.time()))
+            self._conn.commit()
+
+    def load_restart_states(self) -> Dict[str, Dict[str, Any]]:
+        """service → {attempts, backoff_until, last_detect} for every
+        service with persisted resilience state."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM service_resilience").fetchall()
+        out: Dict[str, Dict[str, Any]] = {}
+        for row in rows:
+            d = dict(row)
+            detect = d.get("last_detect")
+            out[d["service"]] = {
+                "attempts": int(d.get("restart_attempts") or 0),
+                "backoff_until": d.get("backoff_until"),
+                "last_detect": json.loads(detect) if detect else None,
+            }
+        return out
+
+    def clear_restart_state(self, service: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM service_resilience WHERE service=?",
+                (service,))
+            self._conn.commit()
+
+    # --------------------------------------- crash-safety: SLO registry
+    def save_slo(self, service: str, name: str,
+                 spec: Dict[str, Any]) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO slo_objectives (service, name, spec, "
+                "created_at) VALUES (?,?,?,?) ON CONFLICT(service, name) "
+                "DO UPDATE SET spec=excluded.spec",
+                (service, name, json.dumps(spec), time.time()))
+            self._conn.commit()
+
+    def load_slos(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT spec FROM slo_objectives ORDER BY service, "
+                "name").fetchall()
+        out = []
+        for row in rows:
+            try:
+                out.append(json.loads(row["spec"]))
+            except (ValueError, TypeError):
+                continue  # one corrupt row must not block the rest
+        return out
+
+    def delete_slos(self, service: str,
+                    name: Optional[str] = None) -> None:
+        with self._lock:
+            if name is None:
+                self._conn.execute(
+                    "DELETE FROM slo_objectives WHERE service=?",
+                    (service,))
+            else:
+                self._conn.execute(
+                    "DELETE FROM slo_objectives WHERE service=? AND "
+                    "name=?", (service, name))
+            self._conn.commit()
+
+    # --------------------------------------------- crash-safety: meta
+    def bump_meta_counter(self, key: str, by: int = 1) -> int:
+        """Increment a restart-surviving counter; returns the new value
+        (``controller_rejoins_total`` lives here — a process-local
+        Prometheus counter resets with exactly the restart it counts)."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT value FROM controller_meta WHERE key=?",
+                (key,)).fetchone()
+            value = (int(row["value"]) if row else 0) + by
+            self._conn.execute(
+                "INSERT INTO controller_meta (key, value) VALUES (?,?) "
+                "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
+                (key, str(value)))
+            self._conn.commit()
+        return value
+
+    def get_meta(self, key: str, default: str = "") -> str:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT value FROM controller_meta WHERE key=?",
+                (key,)).fetchone()
+        return row["value"] if row else default
 
     # ------------------------------------------------------------- runs
     def create_run(self, run_id: str, **fields: Any) -> Dict[str, Any]:

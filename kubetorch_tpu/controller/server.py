@@ -109,9 +109,15 @@ class PodHub:
         return matched
 
     def remove(self, conn: PodConnection):
-        self.waiting.pop(conn.pod_name, None)
+        """Remove THIS connection only: re-registration is idempotent
+        (a reconnecting pod replaces its entry by name), so a stale
+        half-dead socket's teardown must not evict the replacement that
+        already took the name — exactly the ws-flap shape."""
+        if self.waiting.get(conn.pod_name) is conn:
+            del self.waiting[conn.pod_name]
         pods = self.by_service.get(conn.service_name) or {}
-        pods.pop(conn.pod_name, None)
+        if pods.get(conn.pod_name) is conn:
+            del pods[conn.pod_name]
 
     def pods_of(self, service_name: str) -> List[PodConnection]:
         return list((self.by_service.get(service_name) or {}).values())
@@ -152,7 +158,8 @@ class ControllerServer:
     def __init__(self, db_path: str = ":memory:",
                  enable_reaper: bool = True,
                  reaper_interval: float = 15.0,
-                 enable_resilience: bool = True):
+                 enable_resilience: bool = True,
+                 rejoin_grace_s: Optional[float] = None):
         self.db = Database(db_path)
         self.hub = PodHub()
         self.enable_reaper = enable_reaper
@@ -170,10 +177,23 @@ class ControllerServer:
         self.enable_resilience = enable_resilience
         self.liveness = LivenessTracker(
             on_transition=self._on_liveness_transition)
-        self.restart_policy = RestartPolicy()
+        self.restart_policy = RestartPolicy(
+            persist=self.db.save_restart_state)
         self.restarter = GangRestarter(
             self.restart_policy, on_event=self._resilience_event)
         self.auto_restart = env_bool("KT_AUTO_RESTART")
+        # Rejoin quarantine (ISSUE 15): a controller that restored
+        # durable state is looking at a fleet it hasn't heard from yet —
+        # for KT_REJOIN_GRACE_S (default 2.5 heartbeat intervals) the
+        # resilience sweep observes but never declares dead and never
+        # gang-restarts, so reconnecting pods get time to beat before
+        # anything irreversible happens.
+        grace = (rejoin_grace_s if rejoin_grace_s is not None
+                 else env_float("KT_REJOIN_GRACE_S"))
+        if grace is None:
+            grace = 2.5 * self.liveness.heartbeat_s
+        self.rejoin_grace_s = max(0.0, float(grace))
+        self._started_mono = time.monotonic()
         self._resilience_task: Optional[asyncio.Task] = None
         self._restarting: set = set()
         # strong refs to in-flight restart tasks: the loop only holds
@@ -258,6 +278,59 @@ class ControllerServer:
         self.event_watcher = EventWatcher(
             self.log_sink, k8s_client=k8s,
             list_services=self.db.list_pools)
+        # Crash safety (ISSUE 15): resume from the durable tables — a
+        # controller restart must be a non-event for the fleet. Liveness
+        # entries re-seed the tracker (ages restart from NOW; the rejoin
+        # grace covers the gap), restart budgets + backoff deadlines
+        # carry over (a crash-looping controller hands out zero free
+        # restarts), runtime-registered SLOs re-register, and the last
+        # dead-detection records keep /health answering history.
+        self._rejoined = self._restore_persisted_state()
+        self._rejoins_total = int(
+            self.db.get_meta("controller_rejoins_total", "0") or 0)
+        if self._rejoined:
+            self._rejoins_total = self.db.bump_meta_counter(
+                "controller_rejoins_total")
+
+    def _restore_persisted_state(self) -> bool:
+        """Reload liveness/restart/SLO state from the database; returns
+        True when any prior state existed (this start is a REJOIN, so
+        the quarantine window applies)."""
+        from kubetorch_tpu.observability.slo import Objective
+
+        restored = 0
+        for row in self.db.load_liveness():
+            try:
+                if self.liveness.restore(row["service"], row["pod"],
+                                         row["state"]):
+                    restored += 1
+            except Exception as exc:  # noqa: BLE001 — one bad row must not
+                logger.debug("liveness restore of %r failed: %r",
+                             dict(row), exc)   # block the rest
+        states = self.db.load_restart_states()
+        restored += self.restart_policy.restore(states)
+        for service, state in states.items():
+            detect = state.get("last_detect")
+            if isinstance(detect, dict):
+                self._last_detect[service] = detect
+        for spec in self.db.load_slos():
+            try:
+                self.slo.register(Objective.from_dict(spec),
+                                  source="runtime")
+                restored += 1
+            except Exception as exc:  # noqa: BLE001
+                logger.debug("SLO restore of %r failed: %r", spec, exc)
+        return restored > 0
+
+    def rejoin_grace_remaining(self) -> float:
+        """Seconds left in the rejoin quarantine (0 on a fresh-state
+        controller: with nothing restored there is nothing stale to
+        mis-judge — a dead verdict still needs KT_DEAD_AFTER_MISSES
+        freshly-missed beats)."""
+        if not self._rejoined:
+            return 0.0
+        return max(0.0, self.rejoin_grace_s
+                   - (time.monotonic() - self._started_mono))
 
     # ------------------------------------------------------------- app
     def build_app(self) -> web.Application:
@@ -314,6 +387,12 @@ class ControllerServer:
             ("controller_connected_pods", {},
              sum(len(p) for p in self.hub.by_service.values())),
             ("controller_waiting_pods", {}, len(self.hub.waiting)),
+            # durable rejoin count (controller_meta table — a process-
+            # local counter would reset with exactly the restart it
+            # counts) + the live quarantine window
+            ("controller_rejoins_total", {}, self._rejoins_total),
+            ("controller_rejoin_grace_remaining_s", {},
+             round(self.rejoin_grace_remaining(), 3)),
             ("controller_log_batches_dropped_total", {},
              getattr(self.log_sink.persist, "dropped_batches", 0)),
             # resilience_* counters (heartbeats, suspect/dead transitions,
@@ -503,10 +582,12 @@ class ControllerServer:
         self.fleet.drop(service)
         self.slo.drop_service(service)
         # a torn-down gang is not a dead gang: no liveness ghosts, no
-        # restart budget carried over to a future service of this name
+        # restart budget carried over to a future service of this name —
+        # in memory and in the durable crash-safety tables
         self.liveness.forget_service(service)
         self.restart_policy.reset(service)
         self._last_detect.pop(service, None)
+        self._drop_durable_state(service)
         # Cascading delete: backend resources (reference:
         # helpers/delete_helpers.py).
         try:
@@ -526,6 +607,17 @@ class ControllerServer:
     async def h_activity(self, request):
         self.db.touch_pool(request.match_info["service"])
         return web.json_response({"ok": True})
+
+    def _drop_durable_state(self, service: str) -> None:
+        """Remove a service's crash-safety rows (teardown/reaper): a
+        future service of this name starts with a clean slate."""
+        try:
+            self.db.delete_liveness(service)
+            self.db.clear_restart_state(service)
+            self.db.delete_slos(service)
+        except Exception as exc:  # noqa: BLE001 — teardown must complete
+            logger.debug("durable-state drop for %s failed: %r",
+                         service, exc)
 
     # ------------------------------------------------------- resilience
     async def h_heartbeat(self, request):
@@ -556,10 +648,19 @@ class ControllerServer:
         state = self.liveness.beat(service, pod, info=(body or {}).get("info"))
         # HTTP beats may carry a telemetry frame inline (same piggyback
         # contract as the WS message; the batched path is /telemetry)
+        # same resync hint as the WS registration ack: a fleet store
+        # that has never heard of this pod (fresh start OR controller
+        # restart — the store is process memory) needs a FULL snapshot,
+        # not deltas against nothing; the POST-fallback flush reads
+        # this to decide between replaying its backlog and
+        # snapshotting. Computed BEFORE the inline ingest below — that
+        # frame would mark the pod known and mask the gap it rode in on
+        resync = not self.fleet.knows(service, pod)
         telemetry = (body or {}).get("telemetry")
         if isinstance(telemetry, dict):
             self.fleet.ingest(service, pod, telemetry)
-        return web.json_response({"ok": True, "state": state})
+        return web.json_response({"ok": True, "state": state,
+                                  "resync": resync})
 
     # ------------------------------------------------- fleet telemetry
     async def h_telemetry(self, request):
@@ -657,6 +758,14 @@ class ControllerServer:
         if denied is not None:
             return denied
         self.slo.register(obj)
+        # runtime objectives are durable (ISSUE 15): a controller
+        # restart re-registers them from the table — before this, every
+        # POST /slo silently evaporated with the process
+        try:
+            self.db.save_slo(obj.service, obj.name, body or {})
+        except Exception as exc:  # noqa: BLE001 — registration stands
+            logger.debug("SLO persist for %s/%s failed: %r",
+                         obj.service, obj.name, exc)
         return web.json_response({"registered": f"{obj.service}/{obj.name}"})
 
     def _slo_event(self, service: str, name: str, breached: bool,
@@ -689,13 +798,26 @@ class ControllerServer:
         health["restart_attempts"] = self.restart_policy.attempts(service)
         health["max_restarts"] = self.restart_policy.max_restarts
         health["auto_restart"] = self.auto_restart
+        grace = self.rejoin_grace_remaining()
+        if grace > 0:
+            # rejoin quarantine: verdicts are restored state, not fresh
+            # observation — operators (and the e2e) can tell the window
+            health["rejoin_grace_remaining_s"] = round(grace, 3)
         return web.json_response(health)
 
     def _on_liveness_transition(self, service, pod, old, new):
-        """Every liveness state change: counters + sink events."""
+        """Every liveness state change: counters + sink events + the
+        durable liveness row (transitions only — a steady-state beat
+        never writes; registration, revival, suspect, dead, preempted
+        all do, so a restarted controller resumes knowing the fleet)."""
         from kubetorch_tpu.observability import prometheus as prom
         from kubetorch_tpu.resilience import liveness as lv
 
+        try:
+            self.db.save_liveness(service, pod, new)
+        except Exception as exc:  # noqa: BLE001 — durability is best-effort,
+            logger.debug("liveness persist for %s/%s failed: %r",
+                         service, pod, exc)   # tracking must go on
         if new == lv.SUSPECT:
             prom.record_resilience("suspect")
         elif new == lv.DEAD:
@@ -708,6 +830,12 @@ class ControllerServer:
                 self._last_detect[service] = {"pod": pod,
                                               "detect_s": detect,
                                               "at": time.time()}
+                try:
+                    self.db.save_last_detect(
+                        service, self._last_detect[service])
+                except Exception as exc:  # noqa: BLE001
+                    logger.debug("last-detect persist for %s failed: %r",
+                                 service, exc)
             self._resilience_event(
                 service, "PodDead",
                 f"missed {self.liveness.dead_after} heartbeats"
@@ -740,47 +868,7 @@ class ControllerServer:
         while True:
             await asyncio.sleep(interval)
             try:
-                self.liveness.sweep()
-                # SLO burn-rate evaluation rides the same cadence: the
-                # fast window reacts within ~2 sweeps of a regression
-                # landing in the fleet store (e2e-asserted)
-                self.slo.evaluate()
-                # budget decay: a restarted gang that stays healthy for
-                # KT_RESTART_RESET_S earns its restart budget back
-                for service in self.liveness.services():
-                    health = self.liveness.gang_health(service)
-                    if self.restart_policy.note_health(
-                            service, health["status"] == "healthy"):
-                        self._resilience_event(
-                            service, "RestartBudgetRestored",
-                            f"healthy {self.restart_policy.reset_after_s:g}s"
-                            f" after restart; budget reset")
-                if not self.auto_restart:
-                    continue
-                for service in self.liveness.dead_services():
-                    if service in self._restarting:
-                        continue
-                    pool = self.db.get_pool(service)
-                    if pool is None:
-                        # no pool to restart (torn down / never
-                        # registered): drop the stale liveness state so
-                        # the sweep stops reporting it
-                        self.liveness.forget_service(service)
-                        continue
-                    delay = self.restart_policy.next_delay(service)
-                    if delay is None:
-                        if self.restart_policy.exhausted_once(service):
-                            self._resilience_event(
-                                service, "RestartBudgetExhausted",
-                                f"gang stays down after "
-                                f"{self.restart_policy.max_restarts} "
-                                f"restarts")
-                        continue
-                    self._restarting.add(service)
-                    task = asyncio.create_task(
-                        self._restart_gang(service, pool, delay))
-                    self._restart_tasks.add(task)
-                    task.add_done_callback(self._restart_tasks.discard)
+                await self._resilience_tick()
             except asyncio.CancelledError:
                 raise
             except Exception as exc:  # noqa: BLE001 — sweep must go on
@@ -793,6 +881,60 @@ class ControllerServer:
                     self._resilience_event(
                         "controller", "ResilienceSweepError", key)
                 continue
+
+    async def _resilience_tick(self):
+        """One sweep: liveness aging, SLO evaluation, budget decay,
+        auto-restarts. During the rejoin quarantine (a restarted
+        controller inside KT_REJOIN_GRACE_S of its restored state) the
+        tick OBSERVES — beats still revive, telemetry still ingests,
+        SLOs still evaluate — but never ages a pod toward dead and
+        never launches a gang restart: the restored last-seen stamps
+        are this incarnation's start, not real silence, and acting on
+        them is exactly the restart storm the quarantine prevents."""
+        in_grace = self.rejoin_grace_remaining() > 0.0
+        if not in_grace:
+            self.liveness.sweep()
+        # SLO burn-rate evaluation rides the same cadence: the
+        # fast window reacts within ~2 sweeps of a regression
+        # landing in the fleet store (e2e-asserted)
+        self.slo.evaluate()
+        # budget decay: a restarted gang that stays healthy for
+        # KT_RESTART_RESET_S earns its restart budget back
+        for service in self.liveness.services():
+            health = self.liveness.gang_health(service)
+            if self.restart_policy.note_health(
+                    service, health["status"] == "healthy"):
+                self._resilience_event(
+                    service, "RestartBudgetRestored",
+                    f"healthy {self.restart_policy.reset_after_s:g}s"
+                    f" after restart; budget reset")
+        if not self.auto_restart or in_grace:
+            return
+        for service in self.liveness.dead_services():
+            if service in self._restarting:
+                continue
+            pool = self.db.get_pool(service)
+            if pool is None:
+                # no pool to restart (torn down / never
+                # registered): drop the stale liveness state so
+                # the sweep stops reporting it
+                self.liveness.forget_service(service)
+                self.db.delete_liveness(service)
+                continue
+            delay = self.restart_policy.next_delay(service)
+            if delay is None:
+                if self.restart_policy.exhausted_once(service):
+                    self._resilience_event(
+                        service, "RestartBudgetExhausted",
+                        f"gang stays down after "
+                        f"{self.restart_policy.max_restarts} "
+                        f"restarts")
+                continue
+            self._restarting.add(service)
+            task = asyncio.create_task(
+                self._restart_gang(service, pool, delay))
+            self._restart_tasks.add(task)
+            task.add_done_callback(self._restart_tasks.discard)
 
     async def _restart_gang(self, service, pool, delay: float):
         try:
@@ -812,8 +954,11 @@ class ControllerServer:
             if result.get("ok"):
                 self.db.record_restart(service)
                 # fresh generation: liveness restarts from a clean slate
-                # (pods re-register and beat again)
+                # (pods re-register and beat again) — in memory AND in
+                # the durable table, or a controller crash right after
+                # this restart would resurrect the dead old generation
                 self.liveness.forget_service(service)
+                self.db.delete_liveness(service)
         finally:
             self._restarting.discard(service)
 
@@ -832,10 +977,21 @@ class ControllerServer:
                     conn = PodConnection(ws, data)
                     pool = self.db.get_pool(conn.service_name)
                     self.hub.register(conn, pool is not None)
+                    # resync flag (ISSUE 15): a controller whose fleet
+                    # store has never heard of this pod (fresh start OR
+                    # restart — the store is memory) would ingest delta
+                    # frames against nothing and silently show gaps
+                    # until the next KT_TELEMETRY_FULL_EVERY snapshot;
+                    # the ack tells the pod to ship a FULL snapshot now
+                    resync = bool(
+                        conn.service_name
+                        and not self.fleet.knows(conn.service_name,
+                                                 conn.pod_name))
                     await ws.send_json({
                         "type": "registered",
                         "waiting": pool is None,
                         "metadata": (pool or {}).get("module_meta"),
+                        "resync": resync,
                     })
                 elif mtype == "ack" and conn is not None:
                     fut = conn.acks.get(data.get("reload_id", ""))
@@ -1075,6 +1231,10 @@ class ControllerServer:
                         self.metrics_store.drop(service)
                         self.fleet.drop(service)
                         self.slo.drop_service(service)
+                        self.liveness.forget_service(service)
+                        self.restart_policy.reset(service)
+                        self._last_detect.pop(service, None)
+                        self._drop_durable_state(service)
                         try:
                             from kubetorch_tpu.provisioning.backend import (
                                 get_backend,

@@ -48,7 +48,7 @@ _COUNTER_SUFFIXES = ("_total", "_bucket", "_count", "_sum")
 # by the pod server's frame builder, so pods and docs can't drift.
 FRAME_PREFIXES = ("engine_", "kv_", "prefix_", "serving_", "replay_",
                   "admission_", "resilience_", "http_", "telemetry_",
-                  "trace_")
+                  "trace_", "ws_")
 
 
 def is_counter(name: str) -> bool:
@@ -343,6 +343,13 @@ class FleetStore:
     def pods(self, service: str) -> List[str]:
         with self._lock:
             return sorted(self._pods.get(service) or {})
+
+    def knows(self, service: str, pod: str) -> bool:
+        """Membership test without ``pods``'s sorted copy — this sits
+        on the heartbeat resync-hint path, which the WHOLE fleet hits
+        every beat during a controller outage/recovery."""
+        with self._lock:
+            return pod in (self._pods.get(service) or {})
 
     def drop(self, service: str) -> None:
         """Teardown hook (cascading delete, same contract as

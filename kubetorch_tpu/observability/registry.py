@@ -256,6 +256,17 @@ _m("resilience_last_detect_seconds", "gauge",
    "Last heartbeat to dead verdict, most recent detection.", "resilience")
 _m("resilience_last_restart_seconds", "gauge",
    "Wall time of the most recent successful gang restart.", "resilience")
+_m("ws_reconnects_total", "counter",
+   "Pod controller-WebSocket re-dials after a drop (ws-flap chaos, "
+   "controller restarts; full-jitter backoff capped at "
+   "KT_WS_RECONNECT_MAX_S).", "resilience")
+_m("controller_rejoins_total", "counter",
+   "Controller starts that restored durable crash-safety state "
+   "(persisted in the controller DB — survives the restarts it "
+   "counts).", "resilience")
+_m("controller_rejoin_grace_remaining_s", "gauge",
+   "Seconds left in the rejoin quarantine (sweep observes, never "
+   "declares dead or restarts); 0 outside the window.", "resilience")
 
 # --- tracing (PR 4) ---------------------------------------------------------
 _m("trace_spans_total", "counter",
@@ -293,6 +304,11 @@ _m("telemetry_send_errors_total", "counter",
 _m("telemetry_frame_keys_last", "gauge",
    "Metric keys carried by the most recent frame (delta size).",
    "telemetry")
+_m("telemetry_backlog_dropped_total", "counter",
+   "Outage-backlog delta frames superseded by a full snapshot at POST "
+   "flush when the controller asks for resync (stale deltas against a "
+   "restarted controller's empty store would mis-splice reset "
+   "offsets), plus frames shed past the outage cap.", "telemetry")
 
 # --- fleet telemetry plane: controller side ---------------------------------
 _m("fleet_frames_total", "counter",

@@ -215,9 +215,17 @@ class K8sBackend:
 
     # ------------------------------------------------------------------
     def lookup(self, service_name: str) -> Optional[Dict[str, Any]]:
+        import httpx
+
         controller = self._controller()
         if controller is not None:
-            pool = controller.get_pool(service_name)
+            try:
+                pool = controller.get_pool(service_name)
+            except httpx.TransportError:
+                # controller down must not take lookup with it — the
+                # k8s API below still knows the fleet (this is the
+                # ktpu top/health direct-poll path during an outage)
+                pool = None
             if pool:
                 return {
                     "service_name": service_name,

@@ -52,8 +52,19 @@ PARTITION = "partition"
 # drives admission control (queue-delay shedding) and the drain-timeout
 # bound under a pod that is alive but drowning.
 SLOW_POD = "slow-pod"
+# controller-kill: kill the CONTROL plane mid-flight (ISSUE 15). The
+# data plane must not notice; the harness (bench_resilience's recovery
+# leg, tests/test_controller_crash.py) draws the kill moment from the
+# policy so "when the controller dies" is seeded and reproducible.
+CONTROLLER_KILL = "controller-kill"
+# ws-flap: sever the pod↔controller WebSocket (the liveness/telemetry
+# channel, NOT the data-plane call channel) — drives the reconnect
+# loop's full-jitter backoff, the POST heartbeat fallback, the bounded
+# telemetry backlog, and the controller's idempotent re-registration.
+# Injected in the pod's heartbeat notify path.
+WS_FLAP = "ws-flap"
 KINDS = (KILL_WORKER, DROP_CONNECTION, INJECT_LATENCY, CORRUPT_HEARTBEAT,
-         PARTITION, SLOW_POD)
+         PARTITION, SLOW_POD, CONTROLLER_KILL, WS_FLAP)
 
 
 class ChaosPolicy:
@@ -69,7 +80,8 @@ class ChaosPolicy:
     def __init__(self, seed: int = 0, *, kill_worker: float = 0.0,
                  drop_connection: float = 0.0, inject_latency: float = 0.0,
                  corrupt_heartbeat: float = 0.0, partition: float = 0.0,
-                 slow_pod: float = 0.0, latency_s: float = 0.05,
+                 slow_pod: float = 0.0, controller_kill: float = 0.0,
+                 ws_flap: float = 0.0, latency_s: float = 0.05,
                  max_events: Optional[int] = None):
         self.seed = int(seed)
         self.rates: Dict[str, float] = {
@@ -79,6 +91,8 @@ class ChaosPolicy:
             CORRUPT_HEARTBEAT: float(corrupt_heartbeat),
             PARTITION: float(partition),
             SLOW_POD: float(slow_pod),
+            CONTROLLER_KILL: float(controller_kill),
+            WS_FLAP: float(ws_flap),
         }
         self.latency_s = float(latency_s)
         self.max_events = max_events

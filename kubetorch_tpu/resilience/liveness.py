@@ -125,9 +125,34 @@ class LivenessTracker:
             if info:
                 entry.info = info
             new = entry.state
-        if old not in (None, new):
+        # a FIRST beat (old is None) fires too: registration is a real
+        # transition — the controller persists it so a restarted
+        # controller knows this pod existed (crash safety, ISSUE 15)
+        if old != new:
             self._fire(service, pod, old, new)
         return new
+
+    def restore(self, service: str, pod: str, state: str) -> bool:
+        """Seed one pod entry from persisted state WITHOUT firing a
+        transition (controller restart rejoin). ``last_beat`` is NOW on
+        this tracker's clock — persisted wall stamps are from another
+        process's lifetime, and age-based verdicts must restart from
+        the rejoin (the quarantine window gives live pods time to beat
+        again; truly-gone pods age out normally afterwards). Terminal
+        states (dead/preempted) restore as-is so restart budgets keep
+        meaning something. Returns False when the pod already exists
+        (a beat raced the restore — the beat wins)."""
+        now = self._clock()
+        with self._lock:
+            pods = self._pods.setdefault(service, {})
+            if pod in pods:
+                return False
+            entry = PodLiveness(now)
+            entry.state = state if state in (ALIVE, SUSPECT, DEAD,
+                                             PREEMPTED) else ALIVE
+            entry.beats = 0   # no beat seen by THIS incarnation yet
+            pods[pod] = entry
+            return True
 
     def mark(self, service: str, pod: str, state: str) -> None:
         """Explicit state report (``preempted`` from a draining pod)."""

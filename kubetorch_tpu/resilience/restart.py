@@ -20,12 +20,15 @@ dashboard line, not a silent spin.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
 from kubetorch_tpu.config import env_float, env_int
 from kubetorch_tpu.observability import tracing
+
+logger = logging.getLogger(__name__)
 
 MAX_RESTARTS_ENV = "KT_MAX_RESTARTS"
 BACKOFF_ENV = "KT_RESTART_BACKOFF_S"
@@ -45,12 +48,21 @@ class RestartPolicy:
     ``next_delay(service)`` consumes one attempt and returns the delay to
     wait before provisioning (0 for the first attempt), or None when the
     budget is exhausted — the caller then leaves the gang down and the
-    operator sees it on ``/health`` and the restart counters."""
+    operator sees it on ``/health`` and the restart counters.
+
+    Crash safety (ISSUE 15): pass ``persist(service, attempts,
+    backoff_until)`` to write budget consumption through to durable
+    storage on every change, and ``restore(states)`` to reload it in a
+    fresh controller — without this, every controller restart handed
+    every crash-looping gang a brand-new budget, and a crash-looping
+    CONTROLLER handed out infinite free restarts."""
 
     def __init__(self, max_restarts_n: Optional[int] = None,
                  backoff_s: Optional[float] = None,
                  backoff_max_s: float = 60.0,
-                 reset_after_s: Optional[float] = None):
+                 reset_after_s: Optional[float] = None,
+                 persist: Optional[Callable[[str, int, Optional[float]],
+                                            None]] = None):
         self.max_restarts = (max_restarts_n if max_restarts_n is not None
                              else max_restarts())
         if backoff_s is None:
@@ -60,20 +72,71 @@ class RestartPolicy:
         if reset_after_s is None:
             reset_after_s = env_float(RESET_AFTER_ENV)
         self.reset_after_s = reset_after_s
+        self._persist = persist
         self._attempts: Dict[str, int] = {}
+        self._backoff_until: Dict[str, float] = {}
         self._healthy_since: Dict[str, float] = {}
         self._exhausted_reported: set = set()
         self._lock = threading.Lock()
 
+    def restore(self, states: Dict[str, Dict[str, Any]]) -> int:
+        """Reload persisted budget state (service → {attempts,
+        backoff_until}); returns the number of services restored.
+        Expired backoff deadlines are dropped; consumed attempts are
+        not — they decay only through sustained health."""
+        now = time.time()
+        n = 0
+        with self._lock:
+            for service, state in states.items():
+                attempts = int(state.get("attempts") or 0)
+                until = state.get("backoff_until")
+                if attempts <= 0 and not until:
+                    continue
+                self._attempts[service] = attempts
+                if until and float(until) > now:
+                    self._backoff_until[service] = float(until)
+                n += 1
+        return n
+
+    def _persist_locked_snapshot(self, service: str):
+        """(attempts, backoff_until) to hand to the persist callback
+        AFTER the lock is released (the callback owns its own lock —
+        SQLite's — and calling it under ours would add a lock-order
+        edge for no benefit)."""
+        return (self._attempts.get(service, 0),
+                self._backoff_until.get(service))
+
+    def _do_persist(self, service: str, snapshot) -> None:
+        if self._persist is None:
+            return
+        try:
+            self._persist(service, *snapshot)
+        except Exception as exc:  # noqa: BLE001 — budgets must not block restarts
+            logger.debug("restart-budget persist for %s failed: %r",
+                         service, exc)
+
     def next_delay(self, service: str) -> Optional[float]:
+        now = time.time()
         with self._lock:
             n = self._attempts.get(service, 0)
             if n >= self.max_restarts:
                 return None
             self._attempts[service] = n + 1
-        if n == 0:
-            return 0.0
-        return min(self.backoff_s * (2 ** (n - 1)), self.backoff_max_s)
+            if n == 0:
+                delay = 0.0
+            else:
+                delay = min(self.backoff_s * (2 ** (n - 1)),
+                            self.backoff_max_s)
+            # a restarted controller re-detecting the same dead gang
+            # must serve out the PREVIOUS incarnation's backoff deadline
+            # — without this a crash-looping controller restarts the
+            # gang at its own crash cadence, not the policy's
+            carried = self._backoff_until.get(service, 0.0) - now
+            delay = max(delay, carried, 0.0)
+            self._backoff_until[service] = now + delay
+            snapshot = self._persist_locked_snapshot(service)
+        self._do_persist(service, snapshot)
+        return delay
 
     def attempts(self, service: str) -> int:
         with self._lock:
@@ -111,26 +174,38 @@ class RestartPolicy:
             if now - since < self.reset_after_s:
                 return False
             self._attempts.pop(service, None)
+            self._backoff_until.pop(service, None)
             self._exhausted_reported.discard(service)
             self._healthy_since.pop(service, None)
-            return True
+            snapshot = self._persist_locked_snapshot(service)
+        self._do_persist(service, snapshot)
+        return True
 
     def refund(self, service: str) -> None:
         """Give back one consumed attempt — a restart that was skipped
         (the gang revived during the backoff sleep) must not burn
-        budget."""
+        budget. The backoff deadline set by that attempt goes with it:
+        it belongs to a restart that never happened, and carrying it
+        (in memory or the durable row) would delay the NEXT legitimate
+        restart for no reason."""
         with self._lock:
             n = self._attempts.get(service, 0)
             if n > 0:
                 self._attempts[service] = n - 1
+            self._backoff_until.pop(service, None)
             self._exhausted_reported.discard(service)
+            snapshot = self._persist_locked_snapshot(service)
+        self._do_persist(service, snapshot)
 
     def reset(self, service: str) -> None:
         """Clear the budget (operator action / sustained health)."""
         with self._lock:
             self._attempts.pop(service, None)
+            self._backoff_until.pop(service, None)
             self._healthy_since.pop(service, None)
             self._exhausted_reported.discard(service)
+            snapshot = self._persist_locked_snapshot(service)
+        self._do_persist(service, snapshot)
 
 
 class GangRestarter:

@@ -40,6 +40,7 @@ class ControllerWebSocket:
         self._task: Optional[asyncio.Task] = None
         self._stop = asyncio.Event()
         self.connected = False
+        self.connects = 0    # lifetime dials (1 + reconnects)
         self._ws: Optional[aiohttp.ClientWebSocketResponse] = None
 
     def start(self):
@@ -63,7 +64,14 @@ class ControllerWebSocket:
         return f"http://{host}:{port}"
 
     async def _run(self):
-        """Reconnect loop (reference: _run:411)."""
+        """Reconnect loop (reference: _run:411). Backoff is full-jitter
+        exponential capped at ``KT_WS_RECONNECT_MAX_S``: after a
+        controller restart, EVERY pod in the fleet re-dials at once,
+        and equal-phase retries would re-collide against the recovering
+        controller every round (the same herd argument as retry.py)."""
+        from kubetorch_tpu.config import env_float
+        from kubetorch_tpu.retry import backoff_sleep_s
+
         backoff = 1.0
         token = env_str("KT_CONTROLLER_TOKEN")
         headers = {"Authorization": f"Bearer {token}"} if token else {}
@@ -82,6 +90,14 @@ class ControllerWebSocket:
                         self.connected = True
                         self._ws = ws
                         backoff = 1.0
+                        if self.connects:
+                            # re-dial after a drop: countable from the
+                            # pod side (rides telemetry as ws_* so the
+                            # fleet plane sees reconnect churn too)
+                            metrics = self.pod_server.metrics
+                            metrics["ws_reconnects_total"] = (
+                                metrics.get("ws_reconnects_total", 0) + 1)
+                        self.connects += 1
                         await ws.send_json({
                             "type": "register",
                             "pod_name": self.pod_name,
@@ -104,8 +120,10 @@ class ControllerWebSocket:
             finally:
                 self.connected = False
                 self._ws = None
-            await asyncio.sleep(min(backoff, 30.0))
-            backoff *= 2
+            cap = max(0.1, env_float("KT_WS_RECONNECT_MAX_S"))
+            await asyncio.sleep(
+                max(0.05, backoff_sleep_s(None, min(backoff, cap), cap)))
+            backoff = min(backoff * 2, cap)
 
     async def _listen(self, ws: aiohttp.ClientWebSocketResponse):
         async for msg in ws:
@@ -114,6 +132,14 @@ class ControllerWebSocket:
             data = json.loads(msg.data)
             mtype = data.get("type")
             if mtype == "registered":
+                if data.get("resync"):
+                    # the controller's fleet store has never heard of
+                    # this pod (fresh start or a RESTART — the store is
+                    # process memory): ship a FULL telemetry snapshot
+                    # now, or delta frames land against nothing and the
+                    # fleet view silently gaps until the next scheduled
+                    # full snapshot (KT_TELEMETRY_FULL_EVERY)
+                    await self._send_full_snapshot(ws)
                 metadata = data.get("metadata")
                 # App pods run their command from env and gate readiness on
                 # the app's health check — adopting pool metadata must not
@@ -157,6 +183,21 @@ class ControllerWebSocket:
             except (ConnectionError, RuntimeError):
                 pass
 
+    async def _send_full_snapshot(self, ws):
+        """One heartbeat frame carrying a full telemetry snapshot (the
+        registration ack asked for it — see ``resync`` above)."""
+        try:
+            frame = self.pod_server.request_full_telemetry()
+        except Exception as exc:  # noqa: BLE001 — registration must stand
+            logger.debug("full-snapshot build failed: %r", exc)
+            return
+        if not frame:
+            return
+        try:
+            await ws.send_json({"type": "heartbeat", "telemetry": frame})
+        except (ConnectionError, RuntimeError) as exc:
+            logger.debug("full-snapshot send failed: %r", exc)
+
     async def report_activity(self, ws):
         try:
             await ws.send_json({"type": "activity"})
@@ -189,6 +230,26 @@ class ControllerWebSocket:
         ``telemetry`` rides the same frame as a compact metric delta
         (fleet telemetry plane — observability/fleetstore.py): one text
         frame carries liveness AND the pod's changed counters."""
+        from kubetorch_tpu.resilience import chaos as chaos_mod
+
+        if chaos_mod.maybe(chaos_mod.WS_FLAP, self.pod_name):
+            # sever the control-plane socket instead of beating: drives
+            # the reconnect loop, the POST fallback + bounded backlog,
+            # and the controller's idempotent re-registration — the
+            # beat itself is LOST with the connection, like a real flap
+            ws = self._ws
+            if ws is not None and not ws.closed:
+                async def _flap():
+                    try:
+                        await ws.close()
+                    except Exception as exc:  # noqa: BLE001 — already dead
+                        logger.debug("chaos ws-flap close failed: %r", exc)
+
+                try:
+                    asyncio.get_running_loop().create_task(_flap())
+                except RuntimeError:
+                    asyncio.run_coroutine_threadsafe(_flap(), self._loop)
+            return
         payload: dict = {"type": "heartbeat"}
         if telemetry:
             payload["telemetry"] = telemetry

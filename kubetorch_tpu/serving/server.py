@@ -382,11 +382,22 @@ class PodServer:
                     ws.notify_heartbeat(telemetry=telemetry)
                     continue
                 if telemetry is not None:
-                    # WS down: batch frames for the POST fallback
-                    # (bounded — oldest deltas drop first; the next
-                    # full snapshot re-converges the controller)
+                    # every POST-path frame enters the bounded backlog
+                    # BEFORE anything can fail (the frame build already
+                    # advanced the delta baseline — a frame lost here is
+                    # data the controller never sees until the next full
+                    # snapshot): it leaves only on confirmed delivery or
+                    # superseded by a resync snapshot; cap-shed frames
+                    # count as dropped
                     self._tele_backlog.append(telemetry)
-                    del self._tele_backlog[:-30]
+                    overflow = len(self._tele_backlog) - 30
+                    if overflow > 0:
+                        del self._tele_backlog[:overflow]
+                        self.metrics[
+                            "telemetry_backlog_dropped_total"] = (
+                            self.metrics.get(
+                                "telemetry_backlog_dropped_total", 0)
+                            + overflow)
                 # a corrupted beat (chaos) ships a payload with no
                 # identity — the controller must reject it AND count it
                 payload = ({"garbage": True} if corrupt
@@ -395,22 +406,42 @@ class PodServer:
                     # release the response or the pooled connection never
                     # returns to the session (per-beat TCP churn is what
                     # the single session exists to avoid)
+                    resync = True
                     async with session.post(url, json=payload) as resp:
-                        await resp.read()
-                    if self._tele_backlog and not corrupt:
+                        raw = await resp.read()
+                        if resp.status < 400:
+                            # the beat response carries the controller's
+                            # resync hint (see h_heartbeat); anything
+                            # unparseable reads as "resync" — a full
+                            # snapshot is always SAFE, deltas are not
+                            try:
+                                resync = bool(
+                                    json.loads(raw).get("resync", True))
+                            except (ValueError, TypeError,
+                                    AttributeError):
+                                resync = True
+                    flush = (self._tele_flush_frames(resync)
+                             if not corrupt and self._tele_backlog
+                             else [])
+                    if flush:
                         async with session.post(tele_url, json={
                                 "service": service, "pod": pod,
-                                "frames": list(self._tele_backlog),
+                                "frames": flush,
                         }) as resp:
                             if resp.status < 400:
-                                self._tele_backlog.clear()
+                                # delta replay confirmed delivered (a
+                                # resync flush already cleared — the
+                                # hint re-fires until a full LANDS)
+                                if not resync:
+                                    self._tele_backlog.clear()
                             else:
                                 self.metrics[
                                     "telemetry_send_errors_total"] = (
                                     self.metrics.get(
                                         "telemetry_send_errors_total", 0)
                                     + 1)
-                except Exception:  # noqa: BLE001 — next beat retries
+                except Exception:  # noqa: BLE001 — next beat retries; the
+                    # backlog already holds this beat's frame
                     self.metrics["heartbeat_send_errors_total"] = (
                         self.metrics.get("heartbeat_send_errors_total", 0)
                         + 1)
@@ -697,6 +728,52 @@ class PodServer:
             if key in self.metrics:
                 self._tele_sent[key] = self.metrics[key]
         return frame
+
+    def request_full_telemetry(self) -> Optional[dict]:
+        """A full telemetry snapshot NOW (the controller's registration
+        ack asked for one — its FleetStore has never heard of this pod,
+        so deltas would land against nothing). Also drops any POST
+        backlog: its cumulative content is subsumed by this snapshot,
+        and replaying the stale deltas AFTER it would read as counter
+        steps-down (false resets) at the controller."""
+        if not env_int("KT_TELEMETRY_EVERY"):
+            return None   # telemetry emission disabled
+        self._tele_drop_backlog()
+        return self._telemetry_frame(full=True)
+
+    def _tele_drop_backlog(self) -> int:
+        """Supersede the POST backlog with a full snapshot: clear it
+        and count the discarded deltas (both resync paths — WS ack and
+        POST hint — must tick the same counter or drops undercount)."""
+        dropped = len(self._tele_backlog)
+        if dropped:
+            self._tele_backlog.clear()
+            self.metrics["telemetry_backlog_dropped_total"] = (
+                self.metrics.get("telemetry_backlog_dropped_total", 0)
+                + dropped)
+        return dropped
+
+    def _tele_flush_frames(self, resync: bool) -> list:
+        """The POST-fallback flush body. When the answering controller
+        already KNOWS this pod (``resync`` False from the beat
+        response), the backlog replays in order — deltas carry
+        cumulative values, so an in-order replay against a store that
+        has their history converges exactly; the caller clears the
+        backlog only on CONFIRMED delivery. When it does NOT (fresh or
+        freshly RESTARTED controller — its FleetStore is process
+        memory), replaying the stale deltas would mis-splice reset
+        offsets (any frame the store has newer values than reads as a
+        counter reset, inflating every rate by the pre-restart total):
+        the flush is ONE current full snapshot that subsumes them all,
+        and the superseded deltas are counted in
+        ``telemetry_backlog_dropped_total`` — superseding clears the
+        backlog immediately, because even a LOST snapshot is healed by
+        the hint re-firing on the next beat."""
+        if not resync:
+            return list(self._tele_backlog)
+        self._tele_drop_backlog()
+        frame = self._telemetry_frame(full=True)
+        return [frame] if frame else []
 
     def _refresh_server_groups(self):
         """Fold THIS process's metric-group snapshots into

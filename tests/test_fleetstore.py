@@ -439,6 +439,73 @@ class TestPodServerFrames:
         # every frame counts, the idle bare one included — it shipped
         assert srv.metrics["telemetry_frames_sent_total"] == 4
 
+    def test_backlog_flush_leads_with_full_snapshot(self):
+        """ISSUE 15 satellite: the POST-fallback backlog flush against a
+        possibly-RESTARTED controller must be a full snapshot, not the
+        outage's stale deltas. First, pin the failure mode the fix
+        removes: an empty FleetStore that has already seen newer values
+        (the pod's resync snapshot) reads a replayed stale delta as a
+        counter reset and inflates the monotonic offset — rates
+        double-count the pod's whole pre-outage history. Then assert
+        the fixed flush: one full frame, backlog cleared, drops
+        counted, and a fresh store ingesting it shows zero resets and
+        the current values."""
+        from kubetorch_tpu.serving.server import PodServer
+
+        # --- the mis-splice the fix removes (store-level) ------------
+        clock = [1000.0]
+        store = _store(clock)
+        store.ingest("svc", "p0", _frame(1000.0,
+                                         m={"engine_tokens_total": 500.0}))
+        # stale backlog delta from before the controller restart lands
+        # AFTER the newer snapshot: value steps DOWN -> false reset
+        store.ingest("svc", "p0", _frame(1000.5,
+                                         m={"engine_tokens_total": 300.0}))
+        assert store.resets_total == 1   # the bug shape, demonstrated
+        clock[0] = 1002.0
+        roll = store.fleet("svc", window_s=60.0, now=clock[0])
+        # offset splice inflates the series to 500+300: the window
+        # reports 300 tokens of increase AFTER the snapshot, when the
+        # pod actually produced zero (the 300 is pre-outage history)
+        assert roll["counters"]["engine_tokens_total"][
+            "increase"] == pytest.approx(300.0)
+
+        # --- the fixed pod-side flush --------------------------------
+        srv = PodServer(metadata={"service_name": "svc"})
+        srv.metrics["engine_tokens_total"] = 100.0
+        srv._telemetry_frame()                    # baseline shipped
+        srv.metrics["engine_tokens_total"] = 150.0
+        srv._tele_backlog.append(srv._telemetry_frame())   # outage delta
+        srv.metrics["engine_tokens_total"] = 200.0
+        srv._tele_backlog.append(srv._telemetry_frame())   # outage delta
+        # controller KNOWS the pod (resync False): the backlog replays
+        # in order, nothing dropped — and it SURVIVES until the caller
+        # confirms delivery (a failed flush retries next beat)
+        flush = srv._tele_flush_frames(resync=False)
+        assert len(flush) == 2 and not any(f.get("full") for f in flush)
+        assert len(srv._tele_backlog) == 2
+        assert srv.metrics.get("telemetry_backlog_dropped_total", 0) == 0
+        # restarted controller (resync True): ONE full snapshot
+        # subsumes the backlog, superseded deltas counted as dropped
+        flush = srv._tele_flush_frames(resync=True)
+        assert len(flush) == 1 and flush[0].get("full") is True
+        assert flush[0]["m"]["engine_tokens_total"] == 200.0
+        assert srv._tele_backlog == []
+        assert srv.metrics["telemetry_backlog_dropped_total"] == 2
+        fresh = _store(clock)                    # restarted controller
+        fresh.ingest("svc", "p0", flush[0])
+        assert fresh.resets_total == 0
+        roll = fresh.fleet("svc", window_s=60.0, now=clock[0])
+        by_pod = roll["counters"]["engine_tokens_total"]["by_pod"]
+        assert all(rate >= 0 for rate in by_pod.values())
+        # the resync path (registration ack flag) drops the backlog
+        # too, and ticks the SAME drop counter as the POST-flush path
+        srv._tele_backlog.append({"ts": 1.0})
+        full = srv.request_full_telemetry()
+        assert full and full.get("full") is True
+        assert srv._tele_backlog == []
+        assert srv.metrics["telemetry_backlog_dropped_total"] == 3
+
     def test_worker_hist_merge_rides_frames(self):
         """A worker's piggybacked named-histogram snapshot merges with
         the server's own and ships in the telemetry frame. Uses the

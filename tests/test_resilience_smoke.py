@@ -23,6 +23,11 @@ EXPECTED_KEYS = {
     "admission_shed_goodput_ratio",
     "admission_baseline_goodput",
     "admission_shed_goodput",
+    # ISSUE 15 control-plane crash-safety leg
+    "controller_recovery_s",
+    "controller_restart_spurious_restarts",
+    "controller_restart_budget_carried",
+    "controller_rejoin_grace_s",
 }
 
 
@@ -55,3 +60,12 @@ def test_resilience_dryrun_metric_keys():
     # admission acceptance: 429-shedding goodput strictly beats the
     # timeout-collapse baseline at 2× queue capacity
     assert out["admission_shed_goodput_ratio"] > 1.0, out
+    # control-plane crash safety (ISSUE 15): a controller kill+rebuild
+    # must reach correct gang health (bounded by the rejoin grace plus
+    # a few sweep intervals; absolute slack absorbs CI jitter at the
+    # smoke's 20 ms heartbeat) with ZERO restart attempts consumed for
+    # the healthy gang, and pre-crash budget consumption carried over
+    assert out["controller_restart_spurious_restarts"] == 0, out
+    assert out["controller_restart_budget_carried"] >= 1, out
+    assert 0 < out["controller_recovery_s"] <= (
+        out["controller_rejoin_grace_s"] + max(4 * hb, 2.0)), out
