@@ -1284,6 +1284,210 @@ def bench_telemetry(frames: int = 200, n_metrics: int = 80,
     return out
 
 
+# ---------------------------------------------------------------------
+# Multi-tenant LoRA phase (ISSUE 16): device-resident adapter pool with
+# O(1) per-row gather select. The numbers the smoke test guards:
+#
+# - lora_tok_s_ratio_8_adapters   delivered tok/s with 8 concurrent
+#     tenants (one adapter per program) vs the same offered load on ONE
+#     adapter — the scheduler's per-tenant surcharge (name resolution,
+#     refcounting, per-adapter telemetry) must stay under 10%
+# - lora_cold_load_hidden_ratio   decode wall time undisturbed vs with
+#     a cold-adapter load storm mid-stream — background fetches +
+#     driver-tick installs must not stall live rows
+# - lora_select_overhead_pct      jax micro-bench of the gather select:
+#     compiled cost at a 1-slot vs KT_LORA_SLOTS-wide adapter axis.
+#     The gather reads each row's OWN rank-r factors, so the cost is
+#     FLAT in the slot count (the one-hot einsum it replaced streamed
+#     every slot's factors through the matmul, growing linearly)
+
+
+def bench_lora(n_adapters: int = 8, programs: int = 8,
+               max_new: int = 64, step_ms: float = 3.0,
+               load_ms: float = 40.0, dryrun: bool = False) -> dict:
+    import threading
+
+    from kubetorch_tpu.exceptions import ServerOverloaded
+    from kubetorch_tpu.serving.adapterpool import AdapterPool
+    from kubetorch_tpu.serving.engine import (
+        DecodeEngine,
+        SimRollingEngine,
+    )
+
+    if dryrun:
+        n_adapters, programs, max_new = 8, 8, 64
+        step_ms, load_ms = 3.0, 40.0
+    out: dict = {"lora_adapters": n_adapters,
+                 "lora_slots_cfg": n_adapters}
+
+    # ---- phase 1+2: engine throughput under the pool -----------------
+    sim = SimRollingEngine(max_slots=programs, adapter_slots=n_adapters,
+                           steps_per_call=8, step_s=step_ms / 1e3)
+
+    def loader(name):
+        time.sleep(load_ms / 1e3)
+        return {"adapter": name}
+
+    pool = AdapterPool(n_adapters, loader, sim.load_adapter_slot,
+                       load_ema_alpha=0.5, load_seed_s=load_ms / 1e3)
+    eng = DecodeEngine(sim, poll_s=0.002, adapter_pool=pool)
+
+    def until_resident(fn, timeout=30.0):
+        deadline = time.time() + timeout
+        while True:
+            try:
+                return fn()
+            except ServerOverloaded:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.005)
+
+    import contextvars as _cv
+
+    def run_phase(names):
+        """All ``programs`` rows concurrently, program i on
+        names[i % len(names)] — identical offered load across phases,
+        only the tenant fan-out differs."""
+        results: dict = {}
+
+        def drain(i):
+            prompt = [100 + i, 7, 3]
+            frames = until_resident(lambda: list(eng.generate(
+                {"prompt": prompt, "max_new_tokens": max_new,
+                 "adapter": names[i % len(names)]})))
+            results[i] = [t for f in frames for t in f["tokens"]]
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(
+            target=_cv.copy_context().run, args=(drain, i))
+            for i in range(programs)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(60)
+        wall = time.perf_counter() - t0
+        for i in range(programs):
+            expect = SimRollingEngine.expected_tokens([100 + i, 7, 3],
+                                                      max_new)
+            assert results.get(i) == expect, f"lora stream {i} diverged"
+        return programs * max_new / wall
+
+    try:
+        names = [f"tenant-{i}" for i in range(n_adapters)]
+        # warm every tenant resident first: phase 1 measures the STEADY
+        # state surcharge, not cold-load latency (phase 2 measures that)
+        for nm in names:
+            until_resident(lambda nm=nm: list(eng.generate(
+                {"prompt": [1], "max_new_tokens": 1, "adapter": nm})))
+        # best-of-2 per phase: the phases are symmetric, so scheduler
+        # jitter (CI neighbors) is the only difference between runs
+        tok_s_single = max(run_phase(names[:1]) for _ in range(2))
+        tok_s_multi = max(run_phase(names) for _ in range(2))
+        ratio = tok_s_multi / tok_s_single
+        out.update({
+            "lora_tok_s_single": round(tok_s_single, 1),
+            "lora_tok_s_8_adapters": round(tok_s_multi, 1),
+            "lora_tok_s_ratio_8_adapters": round(ratio, 4),
+        })
+
+        # ---- cold loads hidden behind decode -------------------------
+        long_new = max_new * 3
+        cold_prompt = [9, 9, 9]
+        expect = SimRollingEngine.expected_tokens(cold_prompt, long_new)
+
+        def long_decode(disturb):
+            got: list = []
+            fired = False
+            t0 = time.perf_counter()
+            for f in eng.generate({"prompt": cold_prompt,
+                                   "max_new_tokens": long_new,
+                                   "adapter": "tenant-0"}):
+                got.extend(f["tokens"])
+                if disturb and not fired and got:
+                    fired = True
+                    # cold-adapter storm mid-stream: each sheds typed
+                    # (load_ms fetch runs in the background) and LRU-
+                    # evicts a cold resident at its driver-tick install
+                    for nm in ("cold-a", "cold-b", "cold-c"):
+                        try:
+                            list(eng.generate(
+                                {"prompt": [1], "max_new_tokens": 1,
+                                 "adapter": nm}))
+                        except ServerOverloaded:
+                            pass
+            wall = time.perf_counter() - t0
+            assert got == expect, "cold-load phase stream diverged"
+            return wall
+
+        base_wall = min(long_decode(False) for _ in range(2))
+        storm_wall = long_decode(True)
+        out["lora_cold_load_hidden_ratio"] = round(
+            base_wall / storm_wall, 4)
+        # the storm's fetches must actually have happened for the
+        # number to mean anything
+        assert pool.loads >= n_adapters + 1, pool.stats()
+    finally:
+        eng.close()
+
+    # ---- phase 3: gather-select cost, flat in the slot count ---------
+    import jax
+    import jax.numpy as jnp
+
+    B, K, r, N = 8, 64, 8, 64
+
+    def select(h, a, b, slots):
+        # mirrors llama._lora_apply: per-row gather of rank-r factors
+        sel = jnp.maximum(slots, 0)
+        ag = jnp.take(a, sel, axis=0).astype(jnp.float32)
+        bg = jnp.take(b, sel, axis=0).astype(jnp.float32)
+        z = jnp.einsum("btk,bkr->btr", h.astype(jnp.float32), ag)
+        d = jnp.einsum("btr,brn->btn", z, bg)
+        return jnp.where((slots >= 0)[:, None, None], d, 0.0)
+
+    def measure(n_slots):
+        h = jnp.ones((B, 1, K), jnp.float32)
+        a = jnp.ones((n_slots, K, r), jnp.float32)
+        b = jnp.ones((n_slots, r, N), jnp.float32)
+        slots = jnp.arange(B, dtype=jnp.int32) % n_slots
+        fn = jax.jit(select)
+        compiled = fn.lower(h, a, b, slots).compile()
+        cost = None
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            cost = float(ca.get("flops", 0.0)) or None
+        except Exception:
+            cost = None
+        if cost is not None:
+            return cost, "flops"
+        fn(h, a, b, slots).block_until_ready()     # warm
+        t0 = time.perf_counter()
+        reps = 200
+        for _ in range(reps):
+            fn(h, a, b, slots).block_until_ready()
+        return (time.perf_counter() - t0) / reps, "seconds"
+
+    one, unit = measure(1)
+    wide, _ = measure(n_adapters)
+    overhead = (wide - one) / one * 100.0
+    out.update({
+        "lora_select_cost_unit": unit,
+        "lora_select_cost_1_slot": round(one, 9),
+        "lora_select_cost_8_slots": round(wide, 9),
+        "lora_select_overhead_pct": round(overhead, 3),
+    })
+    # FLAT: widening the adapter axis 1 → n must not grow the select's
+    # compiled FLOPs at all (exact with cost_analysis); the timing
+    # fallback gets CI headroom but still catches an O(n_slots) select
+    bound = 1.0 if unit == "flops" else 30.0
+    assert overhead < bound, (
+        f"gather select cost grew {overhead:.1f}% ({unit}) from 1 to "
+        f"{n_adapters} adapter slots — the select is scaling with pool "
+        f"occupancy again (one-hot regression)")
+    return out
+
+
 def run(dryrun: bool = False, static_tok_s: float = 5673.0) -> dict:
     """Full serving bench. ``dryrun`` (CI smoke) runs only the
     call-tunnel phase at toy sizes — the model phases need a chip-scale
@@ -1297,6 +1501,7 @@ def run(dryrun: bool = False, static_tok_s: float = 5673.0) -> dict:
         out.update(bench_prefix_kv(dryrun=True))
         out.update(bench_engine_spec(dryrun=True))
         out.update(bench_telemetry(dryrun=True))
+        out.update(bench_lora(dryrun=True))
         return out
     out = bench_8b_rolling(static_tok_s=static_tok_s) or {}
     if out:
@@ -1332,6 +1537,11 @@ def run(dryrun: bool = False, static_tok_s: float = 5673.0) -> dict:
             step_ms=out["ms_per_step_device"] * out["steps_per_call"]))
         # fleet telemetry plane cost at full-frame count
         out.update(bench_telemetry())
+        # multi-tenant LoRA phase at the measured per-chunk device time:
+        # the per-tenant surcharge and cold-load shadowing compose with
+        # phase 1's device truth like the other engine phases
+        out.update(bench_lora(
+            step_ms=out["ms_per_step_device"] * out["steps_per_call"]))
     return out
 
 

@@ -676,6 +676,43 @@ def _top_rows(fleet):
     return rows
 
 
+def _top_adapter_rows(fleet):
+    """Per-tenant LoRA rows from a fleet rollup: (adapter, tok/s,
+    generations, sheds, ttft p99 ms). Adapters surface through the
+    dynamic ``engine_adapter__<name>_*`` families — a service with no
+    adapter pool simply renders no section."""
+    import re as _re
+
+    counters = fleet.get("counters") or {}
+    hists = fleet.get("histograms") or {}
+    fam_re = _re.compile(
+        r"^engine_adapter__(.+)_(tokens|generations|sheds)_total$")
+    per = {}
+    for name, entry in counters.items():
+        m = fam_re.match(name)
+        if m:
+            per.setdefault(m.group(1), {})[m.group(2)] = entry
+    rows = []
+    for aname in sorted(per):
+        fam = per[aname]
+
+        def num(kind, field):
+            return (fam.get(kind) or {}).get(field)
+
+        tok_s = num("tokens", "rate")
+        gens = num("generations", "increase")
+        sheds = num("sheds", "increase")
+        h = hists.get(f"engine_adapter__{aname}_ttft_seconds") or {}
+        p99 = h.get("p99")
+        rows.append((
+            aname,
+            f"{tok_s:.1f}" if tok_s is not None else "—",
+            f"{gens:g}" if gens is not None else "—",
+            f"{sheds:g}" if sheds is not None else "—",
+            f"{p99 * 1e3:.0f}" if p99 is not None else "—"))
+    return rows
+
+
 def _top_render(snapshot, window):
     lines = []
     for svc, entry in snapshot.items():
@@ -703,6 +740,13 @@ def _top_render(snapshot, window):
             pod, occ, queue, kv, tok_s, acc, p99, status = row
             lines.append(f"  {pod:<28}{occ:>9}{queue:>7}{kv:>8}"
                          f"{tok_s:>9}{acc:>8}{p99:>10}  {status}")
+        arows = _top_adapter_rows(fleet)
+        if arows:
+            lines.append(f"  {'adapter':<28}{'tok/s':>9}{'gens':>7}"
+                         f"{'sheds':>7}{'ttft p99':>10}")
+            for aname, tok_s, gens, sheds, p99 in arows:
+                lines.append(f"  {aname:<28}{tok_s:>9}{gens:>7}"
+                             f"{sheds:>7}{p99:>10}")
     return "\n".join(lines) if lines else "(no services)"
 
 

@@ -369,6 +369,24 @@ _knob("KT_KV_SESSION_DELTA", "bool", True,
       "ships only its new blocks (per-block leaves + PR-3 delta).",
       "engine-kv")
 
+# --- multi-tenant LoRA serving (device-resident adapter pool) ---------------
+_knob("KT_LORA_SLOTS", "int", 0,
+      "Fixed adapter-axis width of the serving engine's stacked LoRA "
+      "tree (0 = off: the axis is exactly the ctor adapters). A fixed "
+      "width is what lets the AdapterPool hot-load/evict named "
+      "adapters into slots without recompiling any serving "
+      "executable; the per-row gather select's cost is flat in this.",
+      "engine-lora")
+_knob("KT_LORA_LOAD_EMA_ALPHA", "float", 0.3,
+      "Weight of one measured adapter load (store fetch + device "
+      "write) in the pool's load-time EMA — the Retry-After a "
+      "residency-miss shed quotes while the cold adapter loads.",
+      "engine-lora")
+_knob("KT_LORA_LOAD_S", "float", 0.2,
+      "Seed estimate for the adapter load-time EMA before any load "
+      "has been measured (the first cold miss's Retry-After).",
+      "engine-lora")
+
 # --- speculative scheduling (per-row adaptive lookahead in the engine) ------
 _knob("KT_SPEC_K_MAX", "int", 8,
       "Maximum per-row speculative lookahead (verify-forward width: 1 "

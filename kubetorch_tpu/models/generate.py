@@ -251,16 +251,15 @@ class Generator:
             if len(ids) != B:
                 raise ValueError(
                     f"adapter_ids has {len(ids)} entries for {B} prompts")
-            onehot = np.zeros((B, self.n_adapters), np.float32)
+            slots = np.full(B, -1, np.int32)
             for i, a in enumerate(ids):
                 if not -1 <= a < self.n_adapters:
                     raise ValueError(
                         f"adapter id {a} out of range "
                         f"({self.n_adapters} adapters; -1 = base)")
-                if a >= 0:
-                    onehot[i, a] = 1.0
+                slots[i] = a
             lora = {"adapters": self.adapters,
-                    "onehot": jnp.asarray(onehot),
+                    "slots": jnp.asarray(slots),
                     "scale": float(self.adapter_scale)}
         elif adapter_ids is not None:
             raise ValueError("adapter_ids passed but Generator has no "

@@ -828,23 +828,30 @@ def _block_cached_chunk(x, layer, li, sin, cos, gk_all, gv_all, ek_all,
 def _lora_apply(h, lctx, name):
     """Per-slot batched low-rank delta for multi-adapter serving.
 
-    ``lctx = (lora_layer, onehot [B, n_adapters], scale)`` — the layer's
-    stacked adapters ride the decode scan's xs (``forward_cached``), the
-    one-hot selects each sequence's adapter. Cost is negligible against
-    the base weight stream: both einsums are rank-r with the adapter axis
-    collapsed by the mask (≈0.5 ms/step at 8B shapes with 4 adapters).
+    ``lctx = (lora_layer, slots [B] int32, scale)`` — the layer's
+    stacked adapters ride the decode scan's xs (``forward_cached``);
+    ``slots`` indexes each sequence's adapter along the stacked axis
+    (−1 = base model). GATHER select, not a one-hot matmul: each row
+    reads exactly its own rank-r factors (`jnp.take` along the adapter
+    axis), so the select cost is O(rank) per row no matter how many
+    adapters are resident — the one-hot einsum it replaced streamed
+    ALL n adapters' factors through the MXU every step, growing
+    linearly with pool occupancy. Base rows gather slot 0 (the index
+    must stay in range) and mask their delta to zero.
     Returns 0 when the target isn't adapted — additions fold away.
     """
     if lctx is None:
         return 0
-    lora_layer, onehot, scale = lctx
+    lora_layer, slots, scale = lctx
     ab = lora_layer.get(name)
     if ab is None:
         return 0
-    z = jnp.einsum("btk,nkr->btnr", h.astype(jnp.float32),
-                   ab["a"].astype(jnp.float32))
-    z = z * onehot.astype(jnp.float32)[:, None, :, None]
-    d = jnp.einsum("btnr,nrm->btm", z, ab["b"].astype(jnp.float32))
+    sel = jnp.maximum(slots, 0)
+    a = jnp.take(ab["a"], sel, axis=0).astype(jnp.float32)   # [B, K, r]
+    b = jnp.take(ab["b"], sel, axis=0).astype(jnp.float32)   # [B, r, N]
+    z = jnp.einsum("btk,bkr->btr", h.astype(jnp.float32), a)
+    d = jnp.einsum("btr,brn->btn", z, b)
+    d = jnp.where((slots >= 0)[:, None, None], d, 0.0)
     return (d * scale).astype(h.dtype)
 
 
@@ -1013,15 +1020,16 @@ def forward_cached(
     sin, cos = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
     n_layers = cache["k"].shape[0]
     # multi-adapter serving: lora = {"adapters": {name: {"a": [L,n,K,r],
-    # "b": [L,n,r,N]}}, "onehot": [B, n], "scale": float}; the stacked
-    # adapter tree rides each layer scan's xs and _lora_apply adds the
-    # per-slot delta at every adapted projection.
+    # "b": [L,n,r,N]}}, "slots": [B] int32 (−1 = base), "scale": float};
+    # the stacked adapter tree rides each layer scan's xs and
+    # _lora_apply gathers the per-slot delta at every adapted
+    # projection (select cost independent of n).
     ltree = lora["adapters"] if lora is not None else None
 
     def lctx_of(lslice):
         if lora is None:
             return None
-        return (lslice, lora["onehot"], lora["scale"])
+        return (lslice, lora["slots"], lora["scale"])
 
     if "ks" in cache and chunk is not None:
         # quantized READ-ONLY grid + bf16 chunk (rolling decode at int8

@@ -119,7 +119,7 @@ def stack_adapters(adapters, lcfg: LoraConfig,
 
     Returns ``{name: {"a": [L, n, K, r], "b": [L, n, r, N]}}`` — layer-
     major so the tree rides the decode layer scan as xs, adapter axis
-    second for the per-slot one-hot select (llama._lora_apply).
+    second for the per-slot gather select (llama._lora_apply).
 
     ``layer_names``: the serving layer dict's weight names. When the
     model was fused for decode (``quant.fuse_decode_layers``:
@@ -179,6 +179,31 @@ def stack_adapters(adapters, lcfg: LoraConfig,
             ro += r
             co += w
         out[fused_name] = {"a": a, "b": btot}
+    return out
+
+
+def pad_adapter_slots(stacked: Dict[str, Any],
+                      n_slots: int) -> Dict[str, Any]:
+    """Grow a stacked tree's adapter axis to a FIXED ``n_slots`` width
+    (zero-filled tail slots).
+
+    A fixed axis is what lets an adapter pool hot-load/evict without
+    ever recompiling the serving executables: the gather select indexes
+    into the same ``[L, n_slots, ...]`` buffers regardless of which
+    slots are occupied, and a zero slot is exactly a zero delta
+    (``b == 0`` ⇒ the slot serves the base model until a load writes
+    it). Raises when the tree already exceeds ``n_slots``."""
+    out: Dict[str, Any] = {}
+    for name, ab in stacked.items():
+        n = ab["a"].shape[1]
+        if n > n_slots:
+            raise ValueError(
+                f"stacked tree already holds {n} adapters; cannot pad "
+                f"to {n_slots} slots (raise KT_LORA_SLOTS)")
+        out[name] = {
+            k: jnp.pad(v, [(0, n_slots - n) if i == 1 else (0, 0)
+                           for i in range(v.ndim)])
+            for k, v in ab.items()}
     return out
 
 

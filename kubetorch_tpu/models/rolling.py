@@ -103,6 +103,7 @@ class RollingGenerator:
                  top_p: Optional[float] = None, seed: int = 0,
                  steps_per_call: int = 8, admit_width: int = 0,
                  adapters=None, adapter_scale: Optional[float] = None,
+                 lora_slots: Optional[int] = None,
                  kv_dtype: str = "bf16", spec_k: Optional[int] = 0,
                  spec_ngram: Optional[int] = None,
                  spec_ema_alpha: Optional[float] = None,
@@ -141,7 +142,7 @@ class RollingGenerator:
 
         Composes with the int8 grid (verify reads int8 grid + bf16 chunk;
         accepted prefixes quantize at the merge), per-request LoRA
-        (the adapter one-hot rides the verify forward; drafting is
+        (the adapter index rides the verify forward; drafting is
         model-free), shared prefixes (the prefix tokens seed the draft
         haystack), and CHUNKED PREFILL (the haystack seeds when the
         prompt's last chunk lands and the row activates — a long
@@ -180,12 +181,24 @@ class RollingGenerator:
         self.top_p = top_p
         self.steps_per_call = max(1, steps_per_call)
         self._rng = jax.random.key(seed)
-        # multi-adapter serving (models/lora.py stack_adapters): per-slot
-        # one-hot rides every prefill/decode call; zero row = base model
-        self.adapters = adapters
+        # multi-adapter serving (models/lora.py stack_adapters): a
+        # per-slot adapter INDEX rides every prefill/decode call
+        # (−1 = base model); llama._lora_apply gathers each row's own
+        # rank-r factors, so select cost is flat in the adapter count.
+        # ``lora_slots`` (default KT_LORA_SLOTS; 0 = off) pads the
+        # stacked tree's adapter axis to a FIXED width so an adapter
+        # pool can hot-load/evict slots without recompiling.
         if adapters is not None and adapter_scale is None:
             raise ValueError("adapters need adapter_scale "
                              "(= LoraConfig.scale used in training)")
+        if adapters is not None:
+            if lora_slots is None:
+                lora_slots = env_int("KT_LORA_SLOTS")
+            if lora_slots:
+                from kubetorch_tpu.models.lora import pad_adapter_slots
+
+                adapters = pad_adapter_slots(adapters, lora_slots)
+        self.adapters = adapters
         self.adapter_scale = adapter_scale
         self.n_adapters = (next(iter(adapters.values()))["a"].shape[1]
                            if adapters is not None else 0)
@@ -195,8 +208,7 @@ class RollingGenerator:
             # fail fast on fused/unfused target mismatch (a missing
             # target silently contributes a zero delta inside the model)
             validate_adapter_targets(adapters, params["layers"])
-        self._slot_onehot = np.zeros((max_slots, max(self.n_adapters, 1)),
-                                     np.float32)
+        self._slot_adapter = np.full(max_slots, -1, np.int32)
 
         # device-resident decode state
         if kv_dtype not in ("bf16", "int8"):
@@ -296,6 +308,22 @@ class RollingGenerator:
         self._prefill_ext = jax.jit(
             partial(self._prefill_extend_impl, cfg=cfg, rules=self.rules),
             static_argnames=("C",), donate_argnums=(1, 2, 3, 4))
+        if self.adapters is not None:
+            # hot-load: write ONE adapter's factors into a slot of the
+            # stacked tree. The slot index is a traced scalar and the
+            # destination donates, so the pool loads/evicts with a
+            # single compile and zero extra HBM residency — the fixed
+            # adapter axis (lora_slots) is what keeps every serving
+            # executable valid across loads.
+            def _adapter_write_impl(dst, src, idx):
+                return jax.tree_util.tree_map(
+                    lambda d, s: jax.lax.dynamic_update_slice(
+                        d, s.astype(d.dtype),
+                        (0, idx) + (0,) * (d.ndim - 2)),
+                    dst, src)
+
+            self._adapter_write = jax.jit(_adapter_write_impl,
+                                          donate_argnums=(0,))
         if self.spec:
             self._decode_sp = jax.jit(
                 partial(self._decode_spec_impl, cfg=cfg, rules=self.rules),
@@ -374,6 +402,34 @@ class RollingGenerator:
         states = self._spec_state
         ks = (states.get(s) for s in list(self._slots))
         return [st.k for st in ks if st is not None]
+
+    def load_adapter_slot(self, slot: int, adapter) -> None:
+        """Hot-load one adapter into slot ``slot`` of the resident
+        stacked tree (``serving/adapterpool.py``'s device-apply hook).
+        ``adapter`` is a single-adapter stacked tree —
+        ``stack_adapters([tree], lcfg, layer_names=params["layers"])``,
+        i.e. ``{name: {"a": [L, 1, K, r], "b": [L, 1, r, N]}}`` with
+        the same targets as the engine's tree. One dynamic-index
+        ``dynamic_update_slice`` per leaf under a single compiled
+        executable (the slot index is traced, the destination donates) —
+        load/evict never recompiles, and rows decoding under OTHER
+        slots are untouched: the gather select reads only each row's
+        own slot. The caller must never overwrite a slot with live
+        rows — the engine does not refcount slots (the pool does)."""
+        if self.adapters is None:
+            raise ValueError(
+                "engine has no adapter tree (construct with adapters=)")
+        if not 0 <= slot < self.n_adapters:
+            raise ValueError(f"adapter slot {slot} out of range "
+                             f"({self.n_adapters} slots)")
+        if set(adapter) != set(self.adapters):
+            raise ValueError(
+                f"adapter targets {sorted(adapter)} do not match the "
+                f"engine tree's {sorted(self.adapters)} — stack with "
+                f"the same layer_names")
+        with self._mesh_ctx():
+            self.adapters = self._adapter_write(
+                self.adapters, adapter, jnp.int32(slot))
 
     def submit(self, prompt, max_new_tokens: int = 128,
                temperature: float = 0.0,
@@ -514,7 +570,7 @@ class RollingGenerator:
              self._dactive) = self._prefill_ext(
                 self.params, self.cache, self._logits, self._dpos,
                 self._dactive, jnp.asarray(feed), jnp.asarray(counts),
-                jnp.asarray(finals), self._lora(self._slot_onehot), C=C)
+                jnp.asarray(finals), self._lora(self._slot_adapter), C=C)
         activated: List[int] = []
         for req in done_reqs:
             del self._prefilling[req.slot]
@@ -611,13 +667,11 @@ class RollingGenerator:
         p_pad = _bucket(len(tokens))
         toks = np.zeros((1, p_pad), np.int32)
         toks[0, :len(tokens)] = tokens
-        oh = np.zeros((1, max(self.n_adapters, 1)), np.float32)
-        if adapter_id >= 0:
-            oh[0, adapter_id] = 1.0
+        idx = np.full(1, adapter_id, np.int32)
         with self._mesh_ctx():
             planes, logits = self._prefix_fill(
                 self.params, jnp.asarray(toks),
-                jnp.int32(len(tokens)), self._lora(oh), p_pad=p_pad)
+                jnp.int32(len(tokens)), self._lora(idx), p_pad=p_pad)
         pid = self._next_prefix_id
         self._next_prefix_id += 1
         self._prefixes[pid] = {
@@ -826,9 +880,7 @@ class RollingGenerator:
         self._temps[slot] = temp
         self._penalties[slot] = penalty
         self._win[slot] = np.asarray(state["win"], np.int32)
-        self._slot_onehot[slot] = 0.0
-        if adapter_id >= 0:
-            self._slot_onehot[slot, adapter_id] = 1.0
+        self._slot_adapter[slot] = adapter_id
         self._slots[slot] = req
         if self.spec:
             Lctx = self._ctx.shape[1]
@@ -912,12 +964,10 @@ class RollingGenerator:
         """Claim the row for a chunked prefill. No dispatch here: the
         row's ``dpos`` is already 0 (rows reset on free/evict) and its
         grid rows are rewritten from position 0 by the chunk forwards.
-        Only the lora one-hot must be live during prefill — the chunk
-        forwards run under it."""
+        Only the slot's adapter index must be live during prefill — the
+        chunk forwards run under it."""
         req.consumed = 0
-        self._slot_onehot[req.slot] = 0.0
-        if req.adapter_id >= 0:
-            self._slot_onehot[req.slot, req.adapter_id] = 1.0
+        self._slot_adapter[req.slot] = req.adapter_id
         self._prefilling[req.slot] = req
         self.prefill_tokens += len(req.prompt)
 
@@ -933,16 +983,14 @@ class RollingGenerator:
         toks = np.zeros((n_pad, p_pad), np.int32)
         lens = np.ones(n_pad, np.int32)
         slots = np.full(n_pad, self.max_slots, np.int32)  # OOB → dropped
-        oh = np.zeros((n_pad, max(self.n_adapters, 1)), np.float32)
+        idx = np.full(n_pad, -1, np.int32)
         for i, req in enumerate(group):
             toks[i, :len(req.prompt)] = req.prompt
             lens[i] = len(req.prompt)
             slots[i] = req.slot
-            self._slot_onehot[req.slot] = 0.0
             aid = getattr(req, "adapter_id", -1)
-            if aid >= 0:
-                oh[i, aid] = 1.0
-                self._slot_onehot[req.slot, aid] = 1.0
+            idx[i] = aid
+            self._slot_adapter[req.slot] = aid
             self._temps[req.slot] = req.temperature
             self._penalties[req.slot] = req.repetition_penalty
             W = self._win.shape[1]
@@ -958,7 +1006,7 @@ class RollingGenerator:
                  self._dactive) = self._prefill(
                     self.params, self.cache, self._logits, self._dpos,
                     self._dactive, jnp.asarray(toks), jnp.asarray(lens),
-                    jnp.asarray(slots), self._lora(oh),
+                    jnp.asarray(slots), self._lora(idx),
                     p_pad=p_pad)
             else:
                 pfx = self._prefixes[prefix_id]
@@ -967,8 +1015,8 @@ class RollingGenerator:
                     self.params, self.cache, self._logits, self._dpos,
                     self._dactive, pfx["planes"],
                     jnp.int32(pfx["len"]), jnp.asarray(toks),
-                    jnp.asarray(lens), jnp.asarray(slots), self._lora(oh),
-                    p_pad=p_pad)
+                    jnp.asarray(lens), jnp.asarray(slots),
+                    self._lora(idx), p_pad=p_pad)
             if self.spec:
                 # seed the draft haystack: the full token context (shared
                 # prefix + prompt) per admitted slot. One extra tiny
@@ -986,13 +1034,13 @@ class RollingGenerator:
                     self._ctx, self._dnt_valid, jnp.asarray(rows),
                     jnp.asarray(slots))
 
-    def _lora(self, onehot_np):
+    def _lora(self, slots_np):
         """None when no adapters — the hot path must not pay a
-        host->device onehot upload it would discard."""
+        host->device index upload it would discard."""
         if self.adapters is None:
             return None
         return {"adapters": self.adapters,
-                "onehot": jnp.asarray(onehot_np),
+                "slots": jnp.asarray(slots_np, dtype=jnp.int32),
                 "scale": float(self.adapter_scale)}
 
     def _mesh_ctx(self):
@@ -1010,7 +1058,7 @@ class RollingGenerator:
                 self.params, self.cache, self._logits, self._dpos,
                 self._dactive, jnp.asarray(self._temps),
                 jnp.asarray(self._penalties), jnp.asarray(self._win), key,
-                self._lora(self._slot_onehot),
+                self._lora(self._slot_adapter),
                 top_k=self.top_k, top_p=self.top_p,
                 n_steps=self.steps_per_call)
         toks = np.asarray(toks)                       # [K, B] — the one sync
@@ -1067,7 +1115,7 @@ class RollingGenerator:
                 self.params, self.cache, self._logits, self._dpos,
                 self._dactive, self._ctx, self._dnt, self._dnt_valid,
                 jnp.asarray(self._temps), jnp.asarray(kk), key,
-                self._lora(self._slot_onehot),
+                self._lora(self._slot_adapter),
                 k=kd, ngram=self.spec_ngram,
                 n_rounds=self.steps_per_call,
                 top_k=self.top_k, top_p=self.top_p,
@@ -1147,7 +1195,7 @@ class RollingGenerator:
         mask = jnp.asarray(mask)
         self._dactive = jnp.where(mask, False, self._dactive)
         self._dpos = jnp.where(mask, 0, self._dpos)
-        self._slot_onehot[freed] = 0.0
+        self._slot_adapter[freed] = -1
         for slot in freed:
             self._win[slot] = -1
             self._penalties[slot] = 1.0
@@ -1228,7 +1276,7 @@ class RollingGenerator:
         into the grid (this forward runs at the prefix's own padded
         width, so low bits can differ from a full-prompt admission).
         ``lora``: adapter-bound prefixes forward under the owning
-        adapter's one-hot."""
+        adapter's slot index."""
         positions = jnp.arange(p_pad)[None, :]
         m = jnp.arange(p_pad)[None, None, :]
         mask = (m <= positions[:, :, None]) & (m < prefix_len)

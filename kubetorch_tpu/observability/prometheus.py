@@ -585,6 +585,14 @@ _ENGINE: Dict[str, float] = {
     "engine_spec_emitted_total": 0.0,
     "engine_spec_drafted_total": 0.0,
     "engine_spec_verify_waste_total": 0.0,
+    # adapter pool (serving/adapterpool.py): aggregate load/evict
+    # traffic + residency gauge. The PER-adapter (per-tenant) series
+    # live in the dynamic _ADAPTER store below, not here — this dict's
+    # keys must stay a closed set (the metric registry covers it 1:1).
+    "engine_adapter_loads_total": 0.0,
+    "engine_adapter_load_seconds_total": 0.0,
+    "engine_adapter_evictions_total": 0.0,
+    "engine_adapter_resident": 0.0,
 }
 _ENGINE_EVENTS = {
     "generation": "engine_generations_total",
@@ -607,6 +615,9 @@ _ENGINE_EVENTS = {
     "spec_emitted": "engine_spec_emitted_total",
     "spec_drafted": "engine_spec_drafted_total",
     "spec_verify_waste": "engine_spec_verify_waste_total",
+    "adapter_load": "engine_adapter_loads_total",
+    "adapter_load_seconds": "engine_adapter_load_seconds_total",
+    "adapter_evict": "engine_adapter_evictions_total",
 }
 _ENGINE_GAUGES = {
     "queue_depth": "engine_queue_depth",
@@ -617,6 +628,7 @@ _ENGINE_GAUGES = {
     "kv_blocks_free": "kv_blocks_free",
     "spec_accept_rate": "engine_spec_accept_rate",
     "spec_k_cap": "engine_spec_k_cap",
+    "adapter_resident_set": "engine_adapter_resident",
 }
 
 
@@ -627,10 +639,12 @@ def record_engine(event: str, value: float = 1.0) -> None:
     ``prefix_hit`` / ``prefix_miss`` / ``prefix_evict`` /
     ``kv_offload[_bytes]`` / ``kv_restore[_bytes]``, and the
     speculation events ``spec_rounds`` / ``spec_emitted`` /
-    ``spec_drafted`` / ``spec_verify_waste``) or set a gauge
+    ``spec_drafted`` / ``spec_verify_waste``, and the adapter-pool
+    events ``adapter_load`` / ``adapter_load_seconds`` /
+    ``adapter_evict``) or set a gauge
     (``queue_depth`` / ``active_rows`` / ``free_rows`` /
     ``prefilling_rows`` / ``kv_blocks_used`` / ``kv_blocks_free`` /
-    ``spec_accept_rate`` / ``spec_k_cap``)."""
+    ``spec_accept_rate`` / ``spec_k_cap`` / ``adapter_resident_set``)."""
     with _ENGINE_LOCK:
         counter = _ENGINE_EVENTS.get(event)
         if counter is not None:
@@ -651,6 +665,76 @@ def engine_samples(labels: Optional[Dict[str, str]] = None):
     """Exposition samples for the serving-engine counters."""
     labels = labels or {}
     for name, value in engine_metrics().items():
+        yield name, labels, value
+
+
+# ------------------------------------------------------------------
+# Per-adapter (per-tenant) serving series (multi-tenant LoRA serving,
+# serving/adapterpool.py + DecodeEngine). DYNAMIC families — one set per
+# adapter NAME, materialized on first traffic — so they live in their
+# own store, not _ENGINE (whose key set is closed and registry-covered
+# 1:1). Naming: ``engine_adapter__<name>_<kind>`` with the adapter name
+# sanitized to ``[A-Za-z0-9_]`` and placed BEFORE the type suffix, so
+# the fleet store's ``endswith("_total")`` counter detection and the
+# ``engine_`` telemetry-frame prefix both apply unchanged. Bounded: at
+# _ADAPTER_MAX distinct adapters the oldest family set is dropped (a
+# controller must not OOM because a tenant id space is unbounded).
+_ADAPTER_LOCK = threading.Lock()
+_ADAPTER: Dict[str, Dict[str, float]] = {}   # name -> {series: value}
+_ADAPTER_MAX = 512
+_ADAPTER_EVENTS = {
+    "tokens": "tokens_total",
+    "generations": "generations_total",
+    "shed": "sheds_total",
+}
+_ADAPTER_SAFE = re.compile(r"[^A-Za-z0-9_]")
+
+
+def adapter_series(adapter: str, kind: str) -> str:
+    """Full series name for one adapter's ``kind`` (e.g.
+    ``tokens_total``, ``ttft_seconds``). Two names that sanitize
+    identically share series — pick adapter names accordingly."""
+    return f"engine_adapter__{_ADAPTER_SAFE.sub('_', adapter)}_{kind}"
+
+
+def record_adapter(adapter: str, event: str, value: float = 1.0) -> None:
+    """Bump a per-adapter counter (``tokens`` / ``generations`` /
+    ``shed``) for the named adapter."""
+    kind = _ADAPTER_EVENTS.get(event)
+    if kind is None:
+        return
+    with _ADAPTER_LOCK:
+        fam = _ADAPTER.get(adapter)
+        if fam is None:
+            if len(_ADAPTER) >= _ADAPTER_MAX:
+                _ADAPTER.pop(next(iter(_ADAPTER)))
+            fam = _ADAPTER[adapter] = {
+                adapter_series(adapter, k): 0.0
+                for k in _ADAPTER_EVENTS.values()}
+        fam[adapter_series(adapter, kind)] += value
+
+
+def adapter_metrics() -> Dict[str, float]:
+    """Flat snapshot of every adapter's series (full names — every key
+    ends in ``_total``, so cross-process merges sum them like any other
+    counter group)."""
+    with _ADAPTER_LOCK:
+        out: Dict[str, float] = {}
+        for fam in _ADAPTER.values():
+            out.update(fam)
+        return out
+
+
+def adapter_names() -> list:
+    """Adapter names with recorded traffic in this process."""
+    with _ADAPTER_LOCK:
+        return list(_ADAPTER)
+
+
+def adapter_samples(labels: Optional[Dict[str, str]] = None):
+    """Exposition samples for the per-adapter counters."""
+    labels = labels or {}
+    for name, value in adapter_metrics().items():
         yield name, labels, value
 
 

@@ -233,6 +233,21 @@ _m("engine_spec_k", "histogram",
    "Per-row adaptive lookahead distribution, sampled once per driver "
    "tick per live row (buckets at the k values themselves).", "engine")
 
+# --- multi-tenant LoRA adapter pool (this PR) -------------------------------
+_m("engine_adapter_loads_total", "counter",
+   "Named adapters installed into a device slot (background fetch + "
+   "one dynamic-slice write at the driver-tick boundary).", "adapter")
+_m("engine_adapter_load_seconds_total", "counter",
+   "Summed adapter load wall time (fetch + device apply) — feeds the "
+   "Retry-After EMA residency-miss sheds quote.", "adapter")
+_m("engine_adapter_evictions_total", "counter",
+   "Cold (refcount-0) adapters LRU-evicted from their slot to make "
+   "room; the engine drops that adapter's prefix-cache entries with "
+   "it.", "adapter")
+_m("engine_adapter_resident", "gauge",
+   "Named adapters currently resident across the KT_LORA_SLOTS device "
+   "slots.", "adapter")
+
 # --- resilience (PR 5) ------------------------------------------------------
 _m("resilience_heartbeats_total", "counter",
    "Liveness beats accepted (WS + HTTP).", "resilience")
@@ -346,7 +361,8 @@ _m("slo_eval_ms", "gauge",
 
 # keep the doc groups in a stable, narrative-matching order
 GROUP_ORDER = ("restore", "wire", "serving", "reliability", "engine",
-               "resilience", "san", "trace", "telemetry", "fleet", "slo")
+               "adapter", "resilience", "san", "trace", "telemetry",
+               "fleet", "slo")
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
 

@@ -1,0 +1,289 @@
+"""Device-resident LoRA adapter pool — named adapters over fixed slots.
+
+Sibling of :mod:`kubetorch_tpu.serving.kvpool`: a host-side policy
+object the serving engine mutates under its scheduler lock. The
+device-side truth is ``RollingGenerator``'s stacked adapter tree with a
+FIXED ``KT_LORA_SLOTS`` adapter axis — this pool decides *which named
+adapter occupies which slot*, refcounts slots by live rows, LRU-evicts
+cold ones, and hides cold loads behind running decode:
+
+- ``request(name)`` on a non-resident adapter kicks a background fetch
+  (``loader``, typically a :func:`models.lora.fetch_adapters` closure —
+  delta-manifest aware, so a re-load of a retrained adapter ships only
+  its changed leaves) and returns ``None``; the engine sheds the
+  program typed-retryable with a Retry-After from the pool's load-time
+  EMA. Decoding rows never wait on a cold adapter.
+- ``admit_ready()`` runs at the driver-tick boundary: staged host trees
+  install into free (or LRU-evicted cold) slots via ``apply_fn`` — one
+  dynamic-slice device write (``RollingGenerator.load_adapter_slot``),
+  never a recompile.
+
+Locking: slot/refcount state is engine-lock territory (every public
+method except the loader thread body assumes the caller holds the
+engine scheduler lock). The fetch handoff (``_loading``/``_staged``/
+EMA) has its own tiny ``_stage_lock`` so the loader thread never needs
+the engine lock; ``admit_ready`` nests engine lock → stage lock, the
+loader thread takes only the stage lock — one fixed order, no cycle.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from kubetorch_tpu.config import env_float
+
+__all__ = ["AdapterPool"]
+
+
+def _record(event: str, value: float = 1.0) -> None:
+    # metrics must never take the serving path down (kvpool's guard)
+    try:
+        from kubetorch_tpu.observability import prometheus as prom
+
+        prom.record_engine(event, value)
+    # ktlint: disable=KT004 -- metrics must never break the serving path
+    except Exception:  # noqa: BLE001
+        pass
+
+
+class AdapterPool:
+    """Named-adapter residency over ``n_slots`` fixed device slots.
+
+    ``loader(name) -> host tree``: fetch one adapter in single-slot
+    stacked layout (``{target: {"a": [L, 1, K, r], "b": [L, 1, r, N]}}``).
+    Runs on a background thread — it must not touch engine state.
+
+    ``apply_fn(slot, tree)``: write the tree into the device slot.
+    Called from ``admit_ready()`` only (the engine's driver tick), so
+    device mutation stays on the thread that owns the engine.
+    """
+
+    def __init__(self, n_slots: int,
+                 loader: Callable[[str], Any],
+                 apply_fn: Callable[[int, Any], None],
+                 clock: Callable[[], float] = time.monotonic,
+                 load_ema_alpha: Optional[float] = None,
+                 load_seed_s: Optional[float] = None,
+                 on_evict: Optional[Callable[[str, int], None]] = None):
+        if n_slots < 1:
+            raise ValueError(f"adapter pool needs >= 1 slot, "
+                             f"got {n_slots}")
+        self.n_slots = int(n_slots)
+        self._loader = loader
+        self._apply = apply_fn
+        self._clock = clock
+        self._alpha = (load_ema_alpha if load_ema_alpha is not None
+                       else env_float("KT_LORA_LOAD_EMA_ALPHA"))
+        self._ema_load_s = (load_seed_s if load_seed_s is not None
+                            else env_float("KT_LORA_LOAD_S"))
+        # called as on_evict(name, slot) whenever a resident adapter
+        # leaves its slot (LRU or explicit) — under the same lock the
+        # mutating call holds. The engine hangs its name-keyed
+        # prefix-cache invalidation here (a re-loaded adapter may land
+        # in a DIFFERENT slot; entries from the old residency epoch
+        # must go with it). Assignable after construction.
+        self.on_evict = on_evict
+        # engine-lock state: slot occupancy + row refcounts + LRU clock
+        self._slot_name: List[Optional[str]] = [None] * self.n_slots
+        self._by_name: Dict[str, int] = {}
+        self._refs: Dict[str, int] = {}
+        self._last_used: Dict[str, float] = {}
+        # stage-lock state: in-flight fetches and their results
+        self._stage_lock = threading.Lock()
+        self._loading: Dict[str, float] = {}    # name -> fetch start
+        self._staged: Dict[str, tuple] = {}     # name -> (tree, fetch_s)
+        self._failed: Dict[str, str] = {}       # name -> error (sticky
+        #                                         until the next request)
+        # counters (host-side mirror of the engine_adapter_* series)
+        self.loads = 0
+        self.evictions = 0
+        self.misses = 0
+
+    # ------------------------------------------------------ resolution
+    def slot_of(self, name: str) -> Optional[int]:
+        """Resident slot of ``name`` (no refcount change), else None."""
+        return self._by_name.get(name)
+
+    def resident(self) -> Dict[str, int]:
+        """Snapshot: name -> slot for every resident adapter."""
+        return dict(self._by_name)
+
+    def acquire(self, name: str) -> int:
+        """Pin ``name`` for one live row; returns its slot. The engine
+        calls this per admitted row and :meth:`release` when the row
+        frees — a pinned adapter is never evicted out from under a
+        decoding row."""
+        slot = self._by_name.get(name)
+        if slot is None:
+            raise KeyError(f"adapter {name!r} is not resident")
+        self._refs[name] = self._refs.get(name, 0) + 1
+        self._last_used[name] = self._clock()
+        return slot
+
+    def release(self, name: str) -> None:
+        n = self._refs.get(name, 0) - 1
+        if n <= 0:
+            self._refs.pop(name, None)
+        else:
+            self._refs[name] = n
+        self._last_used[name] = self._clock()
+
+    # --------------------------------------------------------- loading
+    def request(self, name: str) -> Optional[int]:
+        """Resolve ``name`` to a slot, or start bringing it resident.
+
+        Resident → its slot. Otherwise ``None`` after ensuring a fetch
+        is underway (at most one per name): the engine sheds the
+        program with ``retry_after=load_eta(name)`` and live rows keep
+        decoding — the load happens entirely off the driver tick."""
+        slot = self._by_name.get(name)
+        if slot is not None:
+            self._last_used[name] = self._clock()
+            return slot
+        self.misses += 1
+        with self._stage_lock:
+            self._failed.pop(name, None)
+            if name in self._loading or name in self._staged:
+                return None
+            self._loading[name] = self._clock()
+        ctx = contextvars.copy_context()
+        t = threading.Thread(target=ctx.run, args=(self._load, name),
+                             name=f"kt-adapter-load-{name}", daemon=True)
+        t.start()
+        return None
+
+    def _load(self, name: str) -> None:
+        t0 = self._clock()
+        try:
+            tree = self._loader(name)
+        except Exception as e:  # ktlint: disable=KT004 — the fetch
+            # thread must never die silently; the error surfaces as a
+            # typed shed on the next request for this name
+            with self._stage_lock:
+                self._loading.pop(name, None)
+                self._failed[name] = f"{type(e).__name__}: {e}"
+            return
+        with self._stage_lock:
+            self._loading.pop(name, None)
+            self._staged[name] = (tree, self._clock() - t0)
+
+    def load_error(self, name: str) -> Optional[str]:
+        """Last fetch failure for ``name`` (cleared by the next
+        :meth:`request`), so the engine can type the shed as
+        non-retryable instead of quoting a Retry-After forever."""
+        with self._stage_lock:
+            return self._failed.get(name)
+
+    def load_eta(self, name: Optional[str] = None) -> float:
+        """Expected seconds until a cold adapter could serve — the
+        Retry-After a residency-miss shed quotes. For an in-flight
+        fetch: the EMA minus elapsed (floored); otherwise the EMA."""
+        eta = self._ema_load_s
+        if name is not None:
+            with self._stage_lock:
+                t0 = self._loading.get(name)
+            if t0 is not None:
+                eta = self._ema_load_s - (self._clock() - t0)
+        return max(0.05, eta)
+
+    def has_staged(self) -> bool:
+        """True when a finished background fetch awaits its driver-tick
+        install — the engine counts this as pending work so an IDLE
+        engine (no live rows) still ticks and installs; otherwise a
+        shed tenant's retries would find the adapter staged-but-never-
+        resident forever."""
+        with self._stage_lock:
+            return bool(self._staged)
+
+    def admit_ready(self) -> List[str]:
+        """Install every staged adapter whose slot can be found — free
+        first, else evict the least-recently-used COLD resident
+        (refs == 0). Called at the driver-tick boundary (engine lock
+        held): the device write is one compiled dynamic-slice per
+        adapter. Staged trees with no placeable slot stay staged.
+        Returns the names that became resident."""
+        with self._stage_lock:
+            if not self._staged:
+                return []
+            ready = list(self._staged.items())
+        installed: List[str] = []
+        for name, (tree, fetch_s) in ready:
+            if name in self._by_name:       # raced duplicate request
+                with self._stage_lock:
+                    self._staged.pop(name, None)
+                continue
+            slot = self._place_slot()
+            if slot is None:
+                continue                    # every slot pinned — wait
+            t0 = self._clock()
+            self._apply(slot, tree)
+            total_s = fetch_s + (self._clock() - t0)
+            with self._stage_lock:
+                self._staged.pop(name, None)
+                self._ema_load_s = ((1 - self._alpha) * self._ema_load_s
+                                    + self._alpha * total_s)
+            self._slot_name[slot] = name
+            self._by_name[name] = slot
+            self._last_used[name] = self._clock()
+            self.loads += 1
+            installed.append(name)
+            _record("adapter_load")
+            _record("adapter_load_seconds", total_s)
+        if installed:
+            _record("adapter_resident_set", len(self._by_name))
+        return installed
+
+    def _place_slot(self) -> Optional[int]:
+        try:
+            return self._slot_name.index(None)
+        except ValueError:
+            pass
+        # LRU over cold residents only — a pinned slot is feeding live
+        # rows and must never be rewritten under them
+        cold = [(self._last_used.get(n, 0.0), n)
+                for n, s in self._by_name.items()
+                if self._refs.get(n, 0) == 0]
+        if not cold:
+            return None
+        _, victim = min(cold)
+        return self._evict_slot(victim)
+
+    def evict(self, name: str) -> bool:
+        """Explicitly drop a COLD resident adapter (tests / admin API).
+        Returns False when absent or pinned by live rows."""
+        if name not in self._by_name or self._refs.get(name, 0) > 0:
+            return False
+        self._evict_slot(name)
+        _record("adapter_resident_set", len(self._by_name))
+        return True
+
+    def _evict_slot(self, name: str) -> int:
+        slot = self._by_name.pop(name)
+        self._slot_name[slot] = None
+        self._last_used.pop(name, None)
+        self.evictions += 1
+        _record("adapter_evict")
+        if self.on_evict is not None:
+            self.on_evict(name, slot)
+        return slot
+
+    # ----------------------------------------------------------- stats
+    def stats(self) -> Dict[str, Any]:
+        with self._stage_lock:
+            loading = len(self._loading)
+            staged = len(self._staged)
+        return {
+            "slots": self.n_slots,
+            "resident": len(self._by_name),
+            "pinned": sum(1 for n in self._by_name
+                          if self._refs.get(n, 0) > 0),
+            "loading": loading,
+            "staged": staged,
+            "loads": self.loads,
+            "evictions": self.evictions,
+            "misses": self.misses,
+            "load_ema_s": self._ema_load_s,
+        }

@@ -109,6 +109,35 @@ def _record_engine(event: str, value: float = 1.0) -> None:
     kvpool._record(event, value)
 
 
+def _record_adapter(adapter: str, event: str, value: float = 1.0) -> None:
+    """Per-adapter (per-tenant) series — tokens/generations/sheds keyed
+    by adapter NAME in the dynamic adapter store, behind the same
+    must-never-raise guard."""
+    try:
+        from kubetorch_tpu.observability import prometheus as prom
+
+        prom.record_adapter(adapter, event, value)
+    # ktlint: disable=KT004 -- metrics must never break the serving path
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _encode_adapter_name(name: str):
+    """Adapter-name binding as a store-safe array leaf: a parked
+    session's state blob must carry WHICH named adapter its KV was
+    computed under (slot ints do not survive pool evict/reload — the
+    name is the stable identity)."""
+    import numpy as np
+
+    return np.frombuffer(name.encode("utf-8"), dtype=np.uint8).copy()
+
+
+def _decode_adapter_name(leaf) -> str:
+    import numpy as np
+
+    return np.asarray(leaf, dtype=np.uint8).tobytes().decode("utf-8")
+
+
 # per-row lookahead histogram bounds: k is small and integral, so the
 # buckets are the interesting k values themselves
 _SPEC_K_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0)
@@ -125,6 +154,7 @@ class GenerationProgram:
          "stop": [[13, 10]],           # optional stop token sequences
          "repetition_penalty": 1.0,
          "adapter_id": -1,
+         "adapter": "tenant-a",        # optional pool-managed NAME
          "prefix_id": None,
          "deadline_s": 30.0,           # optional whole-program budget
          "tag": "req-abc"}             # optional idempotency/debug tag
@@ -133,19 +163,29 @@ class GenerationProgram:
     reason the channel's ``timeout_s`` is: an absolute client timestamp
     would break under clock skew. The engine stamps the absolute
     deadline on its own clock at submit.
+
+    ``adapter`` vs ``adapter_id``: ``adapter`` is a stable NAME the
+    engine's :class:`~kubetorch_tpu.serving.adapterpool.AdapterPool`
+    resolves to a device slot at admission (and loads in the
+    background on a miss); ``adapter_id`` is the raw slot int for
+    directly-driven engines with a ctor-frozen stacked tree. A program
+    sets at most one — slots recycle under the pool, so clients must
+    never address pool-managed adapters by slot.
     """
 
     def __init__(self, prompts: List[List[int]], max_new_tokens: int,
                  temperature: float, stop, repetition_penalty: float,
                  adapter_id: int, prefix_id: Optional[int],
                  deadline_s: Optional[float], tag: Optional[str],
-                 session_id: Optional[str] = None):
+                 session_id: Optional[str] = None,
+                 adapter: Optional[str] = None):
         self.prompts = prompts
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
         self.stop = stop
         self.repetition_penalty = repetition_penalty
         self.adapter_id = adapter_id
+        self.adapter = adapter
         self.prefix_id = prefix_id
         self.deadline_s = deadline_s
         self.tag = tag
@@ -180,6 +220,16 @@ class GenerationProgram:
                 # program has no well-defined park state
                 raise ValueError("session_id programs must carry exactly "
                                  "one prompt")
+        adapter = obj.get("adapter")
+        if adapter is not None:
+            if not isinstance(adapter, str) or not adapter:
+                raise ValueError(
+                    f"adapter must be a non-empty string name, "
+                    f"got {adapter!r}")
+            if int(obj.get("adapter_id", -1)) != -1:
+                raise ValueError(
+                    "pass adapter= (pool-managed name) or adapter_id= "
+                    "(raw slot), not both")
         return cls(
             prompts=prompts,
             max_new_tokens=int(obj.get("max_new_tokens", 128)),
@@ -190,7 +240,8 @@ class GenerationProgram:
             prefix_id=obj.get("prefix_id"),
             deadline_s=deadline_s,
             tag=obj.get("tag"),
-            session_id=session_id)
+            session_id=session_id,
+            adapter=adapter)
 
     def submit_kwargs(self) -> Dict[str, Any]:
         return {"max_new_tokens": self.max_new_tokens,
@@ -204,6 +255,7 @@ def program(prompt: Optional[List[int]] = None, *,
             max_new_tokens: int = 128, temperature: float = 0.0,
             stop: Optional[List[List[int]]] = None,
             repetition_penalty: float = 1.0, adapter_id: int = -1,
+            adapter: Optional[str] = None,
             prefix_id: Optional[int] = None,
             session_id: Optional[str] = None,
             deadline_s: Optional[float] = None,
@@ -230,6 +282,8 @@ def program(prompt: Optional[List[int]] = None, *,
         obj["prompts"] = [[int(t) for t in p] for p in prompts]
     if stop is not None:
         obj["stop"] = [[int(t) for t in s] for s in stop]
+    if adapter is not None:
+        obj["adapter"] = str(adapter)
     if prefix_id is not None:
         obj["prefix_id"] = int(prefix_id)
     if session_id is not None:
@@ -281,8 +335,20 @@ class DecodeEngine:
                  kv_block_tokens: Optional[int] = None,
                  kv_budget_blocks: Optional[int] = None,
                  prefix_split: Optional[str] = None,
-                 spec_throttle: Optional[float] = None):
+                 spec_throttle: Optional[float] = None,
+                 adapter_pool=None):
         self.engine = engine
+        # Named-adapter residency (serving/adapterpool.py): programs
+        # carry a stable adapter NAME, resolved to a device slot at
+        # admission; cold adapters fetch in the background and install
+        # at the driver-tick boundary (admit_ready). None → raw
+        # adapter_id slots only. The evict hook drops the departing
+        # adapter's name-keyed prefix entries — their device KV is HBM
+        # rent for a tenant no longer resident, and a reload may land
+        # in a different slot anyway.
+        self._adapter_pool = adapter_pool
+        if adapter_pool is not None:
+            adapter_pool.on_evict = self._adapter_evicted_locked
         self._poll_s = (poll_s if poll_s is not None
                         else env_float("KT_ENGINE_POLL_S"))
         self._admit_rows = (admit_rows if admit_rows is not None
@@ -458,6 +524,11 @@ class DecodeEngine:
                     # have registered the session since the pre-fetch
                     # check released the lock
                     self._check_session_free_locked(prog.session_id)
+                # named adapter → device slot BEFORE pricing: a
+                # residency miss sheds typed here (background fetch
+                # kicked, Retry-After from the pool's load-time EMA)
+                # without touching the prefix cache or the ledger
+                adapter_slot = self._resolve_adapter_locked(prog)
                 plan = self._plan_locked(prog)
                 self._shed_check_locked(prog, plan)
                 # protect the WHOLE plan's prefixes from make-room
@@ -468,12 +539,16 @@ class DecodeEngine:
                 protect = {item["entry"].pid for item in plan
                            if item["entry"] is not None}
                 try:
+                    device_adapter = (adapter_slot
+                                      if adapter_slot is not None
+                                      else prog.adapter_id)
                     for item in plan:
                         pid = prog.prefix_id
                         if item["prefix"]:
                             pid, registered = self._ensure_prefix_locked(
-                                item["prefix"], prog.adapter_id,
-                                item["key"], frozenset(protect))
+                                item["prefix"], device_adapter,
+                                item["key"], frozenset(protect),
+                                adapter=prog.adapter)
                             if registered:
                                 # this program's miss ran the prefix
                                 # fill — count it against ITS naive
@@ -490,6 +565,8 @@ class DecodeEngine:
                                   else item["prefix"] + item["suffix"])
                         kwargs = dict(prog.submit_kwargs())
                         kwargs["prefix_id"] = pid
+                        if adapter_slot is not None:
+                            kwargs["adapter_id"] = adapter_slot
                         row_tokens = min(
                             len(suffix) + prog.max_new_tokens,
                             self._row_cap_tokens)
@@ -525,7 +602,14 @@ class DecodeEngine:
                             rid, row_tokens, prefix_pid=pid)
                         self._rid_meta[rid] = {
                             "blocks": blocks,
-                            "session": prog.session_id}
+                            "session": prog.session_id,
+                            "adapter": prog.adapter}
+                        if prog.adapter is not None:
+                            # one pool ref per live row: a pinned
+                            # adapter is never LRU-evicted out from
+                            # under a decoding row (released in
+                            # _release_locked — the single free path)
+                            self._adapter_pool.acquire(prog.adapter)
                         if prog.session_id is not None:
                             self._live_sessions.add(prog.session_id)
                             self._bump_session_seq_locked(
@@ -601,14 +685,20 @@ class DecodeEngine:
                         self._release_locked(rid)
                         _record_engine("evict")
 
-    def register_prefix(self, tokens, adapter_id: int = -1) -> int:
+    def register_prefix(self, tokens, adapter_id: int = -1,
+                        adapter: Optional[str] = None) -> int:
         """Explicit client-facing prefix registration, BUDGET-ACCOUNTED:
         the block ledger charges it, cold prefixes make way for it, and
         it is LRU-evictable like an auto-split registration — an
         explicit surface that bypassed the pool would grow device prefix
         planes the shed check can't see and reintroduce the HBM OOM the
         budget exists to prevent. Content-deduplicated: re-registering
-        the same tokens+adapter returns the cached pid."""
+        the same tokens+adapter returns the cached pid.
+
+        ``adapter`` (a pool-managed NAME) keys the cache entry by name
+        and fills the device KV under the adapter's CURRENT slot —
+        shedding typed-retryable when the adapter is not yet resident
+        (the fetch runs in the background, like a named submit)."""
         tokens = [int(t) for t in tokens]
         if not tokens:
             raise ValueError("prefix needs >= 1 token")
@@ -616,8 +706,12 @@ class DecodeEngine:
             raise ValueError(
                 f"{type(self.engine).__name__} does not support "
                 f"prefix registration")
-        key = kvpool.prefix_key(tokens, adapter_id)
         with self._wake:
+            device_id = int(adapter_id)
+            if adapter is not None:
+                device_id = self._resolve_adapter_name_locked(adapter)
+            ident = adapter if adapter is not None else int(adapter_id)
+            key = kvpool.prefix_key(tokens, ident)
             need = self._kv.row_cost(len(tokens))
             if self._kv.ledger.budget and need > self._kv.ledger.budget:
                 raise ValueError(
@@ -626,7 +720,7 @@ class DecodeEngine:
                     f"{self._kv.ledger.budget}-block budget "
                     f"(KT_KV_HBM_BUDGET); not retryable")
             pid, _registered = self._ensure_prefix_locked(
-                tokens, int(adapter_id), key)
+                tokens, device_id, key, adapter=adapter)
             if pid is None:
                 max_delay = env_float("KT_MAX_QUEUE_DELAY_S")
                 raise ServerOverloaded(
@@ -686,6 +780,18 @@ class DecodeEngine:
             "kv_offloads": self._parks,
             "kv_restores": self._restores,
         }
+        if self._adapter_pool is not None:
+            ps = self._adapter_pool.stats()
+            out.update({
+                "adapter_slots": ps["slots"],
+                "adapter_resident": ps["resident"],
+                "adapter_pinned": ps["pinned"],
+                "adapter_loading": ps["loading"],
+                "adapter_loads": ps["loads"],
+                "adapter_evictions": ps["evictions"],
+                "adapter_misses": ps["misses"],
+                "adapter_load_ema_s": round(ps["load_ema_s"], 4),
+            })
         if getattr(eng, "spec", False):
             ss = eng.spec_stats
             out.update({
@@ -750,6 +856,12 @@ class DecodeEngine:
                         rid, block_tokens=self._kv.block_tokens)
                 except (KeyError, ValueError):
                     continue          # queued / mid-prefill / exported
+                aname = (self._rid_meta.get(rid) or {}).get("adapter")
+                if aname is not None:
+                    # the blob carries the NAME (slots recycle; the
+                    # restore re-resolves and rewrites the slot int)
+                    state = dict(state)
+                    state["adapter_name"] = _encode_adapter_name(aname)
                 self.engine.evict(rid)
                 sink = self._sinks.get(rid)
                 self._release_locked(rid)
@@ -811,15 +923,24 @@ class DecodeEngine:
                 sink.put((rid, None))
         return parked
 
-    def _record_ttft(self, ttft_s: float, rid: int) -> None:
+    def _record_ttft(self, ttft_s: float, rid: int,
+                     adapter: Optional[str] = None) -> None:
         """One TTFT observation into the named-histogram family (with
         the submit-time trace id as exemplar), behind the same
-        must-never-raise guard as the counters."""
+        must-never-raise guard as the counters. Named-adapter rows
+        ALSO land in their per-adapter family — the per-tenant p99 the
+        adapter SLO objectives burn against."""
         try:
-            from kubetorch_tpu.observability.prometheus import record_hist
+            from kubetorch_tpu.observability.prometheus import (
+                adapter_series,
+                record_hist,
+            )
 
             record_hist("engine_ttft_seconds", ttft_s,
                         trace_id=self._submit_trace.pop(rid, None))
+            if adapter is not None:
+                record_hist(adapter_series(adapter, "ttft_seconds"),
+                            ttft_s)
         # ktlint: disable=KT004 -- metrics must never break the driver tick
         except Exception:  # noqa: BLE001
             pass
@@ -871,7 +992,66 @@ class DecodeEngine:
         meta = self._rid_meta.pop(rid, None)
         if meta and meta.get("session"):
             self._live_sessions.discard(meta["session"])
+        if (meta and meta.get("adapter") is not None
+                and self._adapter_pool is not None):
+            self._adapter_pool.release(meta["adapter"])
         self._kv.release_row(rid)
+
+    def _adapter_evicted_locked(self, name: str, slot: int) -> None:
+        """Pool eviction hook (same lock hold as the evicting call):
+        drop the departing adapter's name-keyed prefix entries and free
+        their device KV. Live rows pin the adapter in the pool, so
+        every entry here is cold by construction."""
+        del slot
+        for entry in self._kv.prefixes.remove_by_adapter(name):
+            try:
+                self.engine.drop_prefix(entry.pid)
+            # ktlint: disable=KT004 -- ledger already dropped it; a failed device free must not block the evict
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _resolve_adapter_name_locked(self, name: str) -> int:
+        """Adapter NAME → resident device slot, or shed.
+
+        Not resident → ensure a background fetch is underway and raise
+        a typed retryable :class:`ServerOverloaded` whose Retry-After
+        comes from the pool's load-time EMA (minus fetch time already
+        elapsed) — decoding rows never wait on a cold adapter's store
+        fetch. A sticky fetch failure surfaces as a non-retryable
+        ``ValueError`` (and the re-request behind it starts a fresh
+        fetch, so a transient store fault self-heals)."""
+        pool = self._adapter_pool
+        if pool is None:
+            raise ValueError(
+                f"program names adapter {name!r} but the engine has no "
+                f"adapter pool (construct DecodeEngine with "
+                f"adapter_pool=)")
+        err = pool.load_error(name)
+        if err is not None:
+            pool.request(name)      # clears the sticky error; refetches
+            raise ValueError(
+                f"adapter {name!r} failed to load: {err} (a fresh "
+                f"fetch was started)")
+        slot = pool.request(name)
+        if slot is not None:
+            return slot
+        retry_after = pool.load_eta(name)
+        _record_engine("shed")
+        _record_adapter(name, "shed")
+        tracing.record_span(
+            "server.shed", 0.0,
+            attrs={"transport": "engine", "adapter": name,
+                   "reason": "adapter_cold",
+                   "retry_after_s": retry_after})
+        raise ServerOverloaded(
+            f"adapter {name!r} is not resident (load in flight in the "
+            f"background)", retry_after=retry_after)
+
+    def _resolve_adapter_locked(
+            self, prog: GenerationProgram) -> Optional[int]:
+        if prog.adapter is None:
+            return None
+        return self._resolve_adapter_name_locked(prog.adapter)
 
     def _plan_locked(self, prog: GenerationProgram) -> List[Dict[str, Any]]:
         """Split each prompt by the pool's prefix rule and annotate with
@@ -885,13 +1065,18 @@ class DecodeEngine:
         # gone)
         auto = (rule is not None and prog.prefix_id is None
                 and hasattr(self.engine, "register_prefix"))
+        # cache identity: the stable NAME for pool-managed adapters
+        # (slots recycle across evict/load cycles — see
+        # kvpool.prefix_key), the raw slot int otherwise
+        ident = (prog.adapter if prog.adapter is not None
+                 else prog.adapter_id)
         plan: List[Dict[str, Any]] = []
         for p in prog.prompts:
             # (naive-token accounting happens at SUBMIT, not here — a
             # shed-and-retried program must not count twice)
             prefix, suffix = (kvpool.split_prompt(p, rule) if auto
                               else ([], list(p)))
-            key = (kvpool.prefix_key(prefix, prog.adapter_id)
+            key = (kvpool.prefix_key(prefix, ident)
                    if prefix else None)
             # peek, not lookup: planning must not bump the hit count or
             # LRU position — only the admission path's lookup does
@@ -922,8 +1107,8 @@ class DecodeEngine:
 
     def _ensure_prefix_locked(self, prefix: List[int], adapter_id: int,
                               key: str,
-                              protect: frozenset = frozenset()
-                              ) -> tuple:
+                              protect: frozenset = frozenset(),
+                              adapter: Optional[str] = None) -> tuple:
         """Hit → ``(pid, False)``. Miss → LRU-evict cold prefixes
         (never ``protect``) to make room under the budget, prefill the
         prefix ONCE (``engine.prefix_fill`` span), register it in the
@@ -945,7 +1130,12 @@ class DecodeEngine:
             "engine.prefix_fill", time.perf_counter() - t0,
             attrs={"tokens": len(prefix), "adapter_id": adapter_id})
         _record_engine("prefix_miss")
-        self._kv.prefixes.insert(key, pid, len(prefix), adapter_id)
+        # the cache entry binds to the stable identity (name when pool-
+        # managed) — the device fill above used the CURRENT slot, but
+        # the entry must outlive slot assignments only for its own name
+        self._kv.prefixes.insert(
+            key, pid, len(prefix),
+            adapter if adapter is not None else adapter_id)
         return pid, True
 
     def _restore_locked(self, prog: GenerationProgram,
@@ -953,7 +1143,34 @@ class DecodeEngine:
         """Splice a parked session's fetched state into a free row. No
         free row / no block headroom → typed ``ServerOverloaded`` (the
         parked blob stays put; the client retries after ``retry_after``)
-        — a restore must never evict a LIVE row to make room."""
+        — a restore must never evict a LIVE row to make room.
+
+        A state blob parked under a NAMED adapter carries the name
+        binding (``adapter_name`` leaf): the adapter must be resident
+        before the import — a miss kicks the pool load and sheds typed
+        (blob stays parked; the retry converges once the load lands) —
+        and the exported slot int is REWRITTEN to the adapter's current
+        slot, which may differ from the one it parked under."""
+        binding = state.pop("adapter_name", None)
+        name = (_decode_adapter_name(binding) if binding is not None
+                else None)
+        if name is None:
+            name = prog.adapter
+        elif prog.adapter is not None and prog.adapter != name:
+            raise ValueError(
+                f"session {prog.session_id} parked under adapter "
+                f"{name!r}; the resume names {prog.adapter!r} — a "
+                f"session's adapter binding is fixed at park")
+        slot = None
+        if name is not None:
+            slot = self._resolve_adapter_name_locked(name)
+            import numpy as np
+
+            sc = np.asarray(state["scalars"])
+            if sc.ndim == 1 and sc.shape[0] > 3:
+                sc = np.array(sc)
+                sc[3] = slot
+                state["scalars"] = sc
         ctx, emitted, max_new = kvpool.state_summary(state)
         need = self._kv.row_cost(min(ctx + (max_new - emitted),
                                      self._row_cap_tokens))
@@ -972,6 +1189,8 @@ class DecodeEngine:
                 max(self._ema_block_s, self._ema_row_s),
                 cap_s=max_delay)
             _record_engine("shed")
+            if name is not None:
+                _record_adapter(name, "shed")
             raise ServerOverloaded(
                 f"no free row/blocks to restore session "
                 f"{prog.session_id} into ({need} blocks needed)",
@@ -981,7 +1200,10 @@ class DecodeEngine:
         blocks = self._kv.reserve_row(
             rid, min(ctx + (max_new - emitted), self._row_cap_tokens))
         self._rid_meta[rid] = {"blocks": blocks,
-                               "session": prog.session_id}
+                               "session": prog.session_id,
+                               "adapter": name}
+        if name is not None:
+            self._adapter_pool.acquire(name)
         self._live_sessions.add(prog.session_id)
         self._bump_session_seq_locked(prog.session_id)
         return rid
@@ -1068,6 +1290,8 @@ class DecodeEngine:
             retry_after = retry_after_estimate(
                 max(short, waiting + n_new), 1, ema, cap_s=max_delay)
             _record_engine("shed")
+            if prog.adapter is not None:
+                _record_adapter(prog.adapter, "shed")
             tracing.record_span(
                 "server.shed", 0.0,
                 attrs={"transport": "engine", "queue_depth": waiting,
@@ -1085,6 +1309,11 @@ class DecodeEngine:
                 retry_after=retry_after)
 
     def _work_pending_locked(self) -> bool:
+        # a finished adapter fetch is driver work even with zero live
+        # rows: its install happens at the tick boundary, and the shed
+        # tenant's retries stay cold until it runs
+        if self._adapter_pool is not None and self._adapter_pool.has_staged():
+            return True
         return bool(self.engine.pending)
 
     def _drive(self) -> None:
@@ -1121,7 +1350,8 @@ class DecodeEngine:
         # ---- deadline eviction (row-granular) ------------------------
         for rid, dl in list(self._deadlines.items()):
             if now > dl:
-                session = (self._rid_meta.get(rid) or {}).get("session")
+                meta = self._rid_meta.get(rid) or {}
+                session = meta.get("session")
                 state = None
                 if session is not None and hasattr(eng, "export_row"):
                     # a deadlined SESSION row parks instead of burning:
@@ -1135,6 +1365,10 @@ class DecodeEngine:
                             rid, block_tokens=self._kv.block_tokens)
                     except (KeyError, ValueError):
                         state = None
+                if state is not None and meta.get("adapter") is not None:
+                    state = dict(state)
+                    state["adapter_name"] = _encode_adapter_name(
+                        meta["adapter"])
                 eng.evict(rid)
                 sink = self._sinks.get(rid)
                 self._release_locked(rid)
@@ -1152,6 +1386,14 @@ class DecodeEngine:
                         + (f" (session {session} parking in background)"
                            if state is not None else ""),
                         deadline=dl)))
+        # ---- cold-adapter installs (finished background fetches) -----
+        if self._adapter_pool is not None:
+            t0 = time.perf_counter()
+            installed = self._adapter_pool.admit_ready()
+            if installed:
+                tracing.record_span(
+                    "engine.adapter_admit", time.perf_counter() - t0,
+                    attrs={"adapters": len(installed)})
         # ---- per-row admission into the live batch -------------------
         t0 = time.perf_counter()
         admitted = eng.admit(self._admit_rows or None)
@@ -1189,8 +1431,13 @@ class DecodeEngine:
         tnow = time.perf_counter()
         for rid, toks, done in events:
             self._tokens += len(toks)
+            aname = (self._rid_meta.get(rid) or {}).get("adapter")
             if toks:
                 _record_engine("tokens", len(toks))
+                if aname is not None:
+                    # per-tenant throughput: the fleet plane rolls the
+                    # name-keyed counter into an adapter tok/s series
+                    _record_adapter(aname, "tokens", len(toks))
                 t_sub = self._submit_t.pop(rid, None)
                 if t_sub is not None:  # this rid's FIRST tokens
                     ttft = tnow - t_sub
@@ -1201,12 +1448,14 @@ class DecodeEngine:
                     # FLEET number); the submitting call's trace id is
                     # the bucket exemplar — a slow bucket is one click
                     # from `ktpu trace`
-                    self._record_ttft(ttft, rid)
+                    self._record_ttft(ttft, rid, adapter=aname)
             sink = self._sinks.get(rid)
             if sink is not None:
                 sink.put((rid, ([int(t) for t in toks], bool(done))))
             if done:
                 freed += 1
+                if aname is not None:
+                    _record_adapter(aname, "generations")
                 meta = self._rid_meta.get(rid) or {}
                 blocks_freed += meta.get("blocks", 0)
                 if (meta.get("session")
@@ -1408,10 +1657,17 @@ class SimRollingEngine:
                  prefill_chunk: Optional[int] = None,
                  step_s: float = 0.0, prefill_s: Optional[float] = None,
                  max_len: int = 2048, spec_k: int = 0,
-                 spec_accept=None, spec_ema_alpha: float = 0.25):
+                 spec_accept=None, spec_ema_alpha: float = 0.25,
+                 adapter_slots: int = 0, adapter_write_s: float = 0.0):
         if spec_k < 0 or spec_k == 1:
             raise ValueError("spec_k must be 0 (off) or >= 2")
         self.max_slots = max_slots
+        # named-adapter twin surface: `adapter_slots` fixed device
+        # slots an AdapterPool installs into via load_adapter_slot
+        # (adapter_write_s models the dynamic-slice device write)
+        self.adapter_slots = int(adapter_slots)
+        self.adapter_write_s = float(adapter_write_s)
+        self._adapter_names: Dict[int, Any] = {}   # slot -> loaded tree
         self.max_len = max_len
         self.steps_per_call = steps_per_call
         self.prefill_chunk = prefill_chunk
@@ -1463,6 +1719,23 @@ class SimRollingEngine:
         return [int.from_bytes(
             hashlib.sha256(f"{seed}:{i}".encode()).digest()[:4],
             "little") % 32000 for i in range(n)]
+
+    def load_adapter_slot(self, slot: int, adapter: Any) -> None:
+        """Host twin of ``RollingGenerator.load_adapter_slot``: record
+        the write (``adapter`` is whatever the pool's loader produced —
+        the sim never reads it) and charge the simulated device-write
+        time. The CPU bench's cold-load-hidden probe needs the write to
+        cost wall time while decode keeps stepping — the real engine's
+        shape exactly."""
+        if not self.adapter_slots:
+            raise ValueError("sim engine has no adapter slots "
+                             "(construct with adapter_slots=)")
+        if not 0 <= int(slot) < self.adapter_slots:
+            raise ValueError(f"adapter slot {slot} out of range "
+                             f"({self.adapter_slots} slots)")
+        if self.adapter_write_s:
+            time.sleep(self.adapter_write_s)
+        self._adapter_names[int(slot)] = adapter
 
     def register_prefix(self, tokens, adapter_id: int = -1) -> int:
         pid = self._next_prefix_id

@@ -145,12 +145,22 @@ def split_prompt(prompt: Sequence[int],
     return prompt[:idx], prompt[idx:]
 
 
-def prefix_key(tokens: Sequence[int], adapter_id: int = -1) -> str:
-    """Content hash of a prefix. Keyed per adapter: prefix KV is
-    weight-dependent, so the same tokens under two adapters are two
-    cache entries (mirrors ``register_prefix``'s adapter binding)."""
+def prefix_key(tokens: Sequence[int], adapter: Any = -1) -> str:
+    """Content hash of a prefix. Keyed per adapter IDENTITY: prefix KV
+    is weight-dependent, so the same tokens under two adapters are two
+    cache entries. ``adapter`` is a stable NAME (str) for pool-managed
+    adapters and the raw slot int for directly-driven engines — named
+    adapters must NOT key by slot: the pool recycles slots (cold
+    adapters LRU-evict and the slot reloads with another tenant's
+    weights), and a slot-keyed entry would splice tenant A's prefix KV
+    under tenant B's rows after one evict/load cycle."""
     h = hashlib.sha256()
-    h.update(f"a{int(adapter_id)}:".encode())
+    # distinct domains: a tenant NAMED "0" must not collide with raw
+    # slot 0 (a ctor-frozen engine's adapter_id)
+    if isinstance(adapter, str):
+        h.update(f"an{adapter}:".encode())
+    else:
+        h.update(f"a{int(adapter)}:".encode())
     h.update(b",".join(str(int(t)).encode() for t in tokens))
     return h.hexdigest()
 
@@ -218,12 +228,12 @@ class PrefixEntry:
                  "last_used", "hits")
 
     def __init__(self, key: str, pid: int, tokens: int, blocks: int,
-                 adapter_id: int):
+                 adapter_id: Any):
         self.key = key
         self.pid = pid            # engine-level prefix id (register_prefix)
         self.tokens = tokens
         self.blocks = blocks
-        self.adapter_id = adapter_id
+        self.adapter_id = adapter_id   # name (str) or raw slot (int)
         self.refs = 0             # live rows decoding under this prefix
         self.last_used = time.monotonic()
         self.hits = 0
@@ -258,7 +268,7 @@ class PrefixCache:
         return entry
 
     def insert(self, key: str, pid: int, tokens: int,
-               adapter_id: int) -> PrefixEntry:
+               adapter_id: Any) -> PrefixEntry:
         blocks = blocks_for(tokens, self._ledger.block_tokens)
         entry = PrefixEntry(key, pid, tokens, blocks, adapter_id)
         self._entries[key] = entry
@@ -291,6 +301,25 @@ class PrefixCache:
         del self._by_pid[entry.pid]
         self._ledger.drop_prefix(entry.blocks)
         return entry
+
+    def remove_by_adapter(self, adapter: Any) -> List[PrefixEntry]:
+        """Drop every COLD entry keyed under ``adapter`` — run when the
+        adapter pool evicts a named adapter: its name-keyed entries can
+        never hit again until a reload, so their device KV blocks are
+        HBM rent for a tenant that is no longer resident. Pinned
+        entries are skipped defensively (a live row under the adapter
+        also pins the adapter in the pool, so eviction should never see
+        one). Returns the dropped entries — the caller frees their
+        device blocks."""
+        dropped: List[PrefixEntry] = []
+        for entry in [e for e in self._entries.values()
+                      if e.adapter_id == adapter]:
+            if entry.refs:
+                continue
+            self.remove(entry.pid)
+            _record("prefix_evict")
+            dropped.append(entry)
+        return dropped
 
     def evict_for(self, needed_blocks: int,
                   protect: frozenset = frozenset()) -> List[PrefixEntry]:

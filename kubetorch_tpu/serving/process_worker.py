@@ -144,6 +144,14 @@ def _attach_worker_metrics(agg: Dict[str, int]) -> None:
         if engine.get("engine_generations_total") or \
                 engine.get("engine_steps_total"):
             agg["engine"] = {"pid": os.getpid(), **engine}
+        # per-adapter tenant counters (dynamic families — one set per
+        # named LoRA adapter): all keys end _total so the pod server's
+        # cross-worker sum treats them like any other counter group
+        from kubetorch_tpu.observability.prometheus import adapter_metrics
+
+        adapters = adapter_metrics()
+        if adapters:
+            agg["adapter"] = {"pid": os.getpid(), **adapters}
         # named-histogram snapshot (engine TTFT buckets + exemplars):
         # rides whole, not flattened — the pod server merges bucket
         # vectors across workers and ships them to the controller in

@@ -619,7 +619,11 @@ class PodServer:
                     # emergency-checkpoint tick turned every /metrics
                     # scrape into a 500 (KeyError) for the rest of the
                     # drain window — exactly when operators look
-                    "resilience": "", "san": ""}
+                    "resilience": "", "san": "",
+                    # per-adapter LoRA tenant counters (dynamic
+                    # engine_adapter__<name>_* families) — flat _total
+                    # keys, summed across workers like any group
+                    "adapter": ""}
 
     def _merge_worker_stats(self, stats: Dict[str, Any]):
         """Fold a worker's per-call stats dict into pod metrics. Plain

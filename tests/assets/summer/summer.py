@@ -178,26 +178,46 @@ class EngineHost:
     def __init__(self, max_slots=4, steps_per_call=8, step_ms=2.0,
                  prefill_chunk=16, max_waiting=64, prefix_split=None,
                  kv_block_tokens=None, kv_budget_blocks=None,
-                 spec_k=0, spec_accept=0.0, spec_throttle=None):
+                 spec_k=0, spec_accept=0.0, spec_throttle=None,
+                 lora_slots=0, adapter_load_ms=0.0):
         from kubetorch_tpu.serving.engine import (
             DecodeEngine,
             SimRollingEngine,
         )
 
+        sim = SimRollingEngine(max_slots=int(max_slots),
+                               steps_per_call=int(steps_per_call),
+                               prefill_chunk=int(prefill_chunk),
+                               step_s=float(step_ms) / 1e3,
+                               spec_k=int(spec_k),
+                               spec_accept=float(spec_accept),
+                               adapter_slots=int(lora_slots))
+        pool = None
+        if int(lora_slots):
+            # named-adapter pool over the sim's device-twin slots: the
+            # loader sleeps adapter_load_ms so cold-load sheds and the
+            # background-fetch path are drivable over the wire
+            from kubetorch_tpu.serving.adapterpool import AdapterPool
+
+            def loader(name, _ms=float(adapter_load_ms)):
+                import time
+
+                if _ms:
+                    time.sleep(_ms / 1e3)
+                return {"adapter": name}
+
+            pool = AdapterPool(int(lora_slots), loader,
+                               sim.load_adapter_slot)
         self._engine = DecodeEngine(
-            SimRollingEngine(max_slots=int(max_slots),
-                             steps_per_call=int(steps_per_call),
-                             prefill_chunk=int(prefill_chunk),
-                             step_s=float(step_ms) / 1e3,
-                             spec_k=int(spec_k),
-                             spec_accept=float(spec_accept)),
+            sim,
             max_waiting=int(max_waiting), prefix_split=prefix_split,
             kv_block_tokens=(int(kv_block_tokens)
                              if kv_block_tokens is not None else None),
             kv_budget_blocks=(int(kv_budget_blocks)
                               if kv_budget_blocks is not None else None),
             spec_throttle=(float(spec_throttle)
-                           if spec_throttle is not None else None))
+                           if spec_throttle is not None else None),
+            adapter_pool=pool)
 
     def generate(self, program, delay_ms=0.0):
         for frame in self._engine.generate(program):
@@ -216,11 +236,12 @@ class EngineHost:
     def exec_count(self, tag):
         return self._engine.exec_count(tag)
 
-    def register_prefix(self, tokens, adapter_id=-1):
+    def register_prefix(self, tokens, adapter_id=-1, adapter=None):
         """Client surface for explicit prefix ids over the wire —
         through the DecodeEngine so the KV ledger accounts the block."""
         return int(self._engine.register_prefix(
-            [int(t) for t in tokens], adapter_id=int(adapter_id)))
+            [int(t) for t in tokens], adapter_id=int(adapter_id),
+            adapter=adapter))
 
     def park(self, session_id):
         return self._engine.park(session_id)

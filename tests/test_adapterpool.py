@@ -1,0 +1,537 @@
+"""PR 16: multi-tenant LoRA serving — device-resident adapter pool.
+
+Three layers, all CPU:
+
+1. **Pool policy units**: clock-injected :class:`AdapterPool` — miss →
+   background fetch → driver-tick install, LRU eviction of COLD
+   residents only, sticky load errors, Retry-After ETA floors.
+2. **Engine integration** (DecodeEngine over SimRollingEngine): a
+   residency miss sheds typed with a Retry-After while the load runs in
+   the background; prefix cache entries are keyed by adapter NAME and
+   die with the adapter's eviction (slot recycling must never serve one
+   tenant's prefix KV to another); park/evict-adapter/resume round-trips
+   byte-identical with the name binding riding the state blob.
+3. **Tenant telemetry + SLO**: per-adapter counters flow through
+   telemetry frames, and a per-adapter SLO objective breaches
+   independently of the fleet-wide one.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubetorch_tpu.exceptions import ServerOverloaded
+from kubetorch_tpu.serving.adapterpool import AdapterPool
+from kubetorch_tpu.serving.engine import (
+    DecodeEngine,
+    GenerationProgram,
+    SimRollingEngine,
+)
+
+pytestmark = pytest.mark.level("unit")
+
+
+def _wait(cond, timeout=10.0, what="condition"):
+    deadline = time.time() + timeout
+    while not cond():
+        assert time.time() < deadline, f"timed out waiting for {what}"
+        time.sleep(0.005)
+
+
+def _until_resident(fn, timeout=15.0):
+    """Retry ``fn`` through residency-miss sheds — the client loop a
+    typed Retry-After asks for."""
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return fn()
+        except ServerOverloaded as exc:
+            assert exc.retry_after and exc.retry_after > 0
+            assert time.time() < deadline, "adapter never became resident"
+            time.sleep(0.01)
+
+
+@pytest.fixture()
+def local_store(tmp_path, monkeypatch):
+    from kubetorch_tpu.data_store import client as client_mod
+
+    root = tmp_path / "store"
+    monkeypatch.setenv("KT_LOCAL_STORE", str(root))
+    monkeypatch.setattr(client_mod, "_LOCAL_STORE", root)
+    monkeypatch.setattr(client_mod.DataStoreClient, "_default", None)
+    yield root
+
+
+# ------------------------------------------------------- pool policy
+def test_pool_miss_load_install_and_lru():
+    t = [0.0]
+    applied = []
+    evicted = []
+    pool = AdapterPool(2, lambda n: f"tree-{n}",
+                       lambda s, tr: applied.append((s, tr)),
+                       clock=lambda: t[0], load_ema_alpha=0.5,
+                       load_seed_s=0.2,
+                       on_evict=lambda n, s: evicted.append((n, s)))
+    assert pool.slot_of("a") is None
+    assert pool.request("a") is None          # miss → background fetch
+    assert pool.misses == 1
+    _wait(lambda: pool.stats()["staged"] == 1, what="staged fetch")
+    assert pool.has_staged()
+    assert pool.slot_of("a") is None          # staged ≠ resident
+    assert pool.admit_ready() == ["a"]
+    assert applied == [(0, "tree-a")]
+    assert pool.request("a") == 0 and pool.misses == 1
+    # frozen clock ⇒ measured load time 0 ⇒ EMA halves toward 0
+    assert pool.stats()["load_ema_s"] == pytest.approx(0.1)
+    assert pool.acquire("a") == 0             # pin for a live row
+    with pytest.raises(KeyError, match="not resident"):
+        pool.acquire("ghost")
+    pool.request("b")
+    _wait(lambda: pool.stats()["staged"] == 1, what="staged fetch")
+    assert pool.admit_ready() == ["b"]        # free slot 1, no evict
+    assert pool.resident() == {"a": 0, "b": 1}
+    # every slot pinned: a staged adapter WAITS (never rip weights out
+    # from under a decoding row)
+    pool.acquire("b")
+    pool.request("c")
+    _wait(lambda: pool.stats()["staged"] == 1, what="staged fetch")
+    assert pool.admit_ready() == []
+    assert pool.stats()["staged"] == 1 and evicted == []
+    # b goes cold first → it is the LRU victim; the on_evict hook sees
+    # the (name, slot) so the engine can drop name-keyed prefixes
+    t[0] = 1.0
+    pool.release("b")
+    t[0] = 2.0
+    pool.release("a")
+    assert pool.admit_ready() == ["c"]
+    assert evicted == [("b", 1)]
+    assert pool.resident() == {"a": 0, "c": 1}
+    assert pool.evictions == 1 and pool.loads == 3
+    # explicit evict refuses a pinned adapter, drops a cold one
+    pool.acquire("a")
+    assert pool.evict("a") is False
+    pool.release("a")
+    assert pool.evict("a") is True
+    assert evicted[-1] == ("a", 0)
+
+
+def test_pool_load_failure_is_sticky_until_next_request():
+    fail = {"on": True}
+
+    def loader(name):
+        if fail["on"]:
+            raise RuntimeError("store down")
+        return "tree"
+
+    pool = AdapterPool(1, loader, lambda s, tr: None,
+                       load_ema_alpha=0.5, load_seed_s=0.2)
+    assert pool.request("x") is None
+    _wait(lambda: pool.load_error("x"), what="sticky load error")
+    assert "RuntimeError: store down" in pool.load_error("x")
+    fail["on"] = False
+    assert pool.request("x") is None          # clears error, refetches
+    _wait(lambda: pool.stats()["staged"] == 1, what="staged refetch")
+    assert pool.load_error("x") is None
+    assert pool.admit_ready() == ["x"]
+    assert pool.slot_of("x") == 0
+
+
+def test_pool_load_eta_tracks_inflight_and_floors():
+    gate = threading.Event()
+    t = [0.0]
+    pool = AdapterPool(1, lambda n: gate.wait(10) and "tr",
+                       lambda s, tr: None, clock=lambda: t[0],
+                       load_ema_alpha=0.5, load_seed_s=0.3)
+    assert pool.load_eta() == pytest.approx(0.3)
+    pool.request("x")
+    t[0] = 0.1                                # 0.1s into the fetch
+    assert pool.load_eta("x") == pytest.approx(0.2)
+    t[0] = 5.0                                # overdue: floor, never <= 0
+    assert pool.load_eta("x") == pytest.approx(0.05)
+    gate.set()
+
+
+# ------------------------------------------- engine integration (sim)
+def _mk_engine(pool_slots=2, sim_slots=2, load_delay=0.0, **sim_kw):
+    sim_kw.setdefault("steps_per_call", 4)
+    sim_kw.setdefault("step_s", 0.002)
+    sim = SimRollingEngine(max_slots=sim_slots,
+                           adapter_slots=pool_slots, **sim_kw)
+
+    def loader(name):
+        if load_delay:
+            time.sleep(load_delay)
+        return {"adapter": name}
+
+    pool = AdapterPool(pool_slots, loader, sim.load_adapter_slot,
+                       load_ema_alpha=0.5, load_seed_s=0.1)
+    eng = DecodeEngine(sim, poll_s=0.002, adapter_pool=pool)
+    return eng, sim, pool
+
+
+def test_program_adapter_wire_validation():
+    prog = GenerationProgram.from_wire(
+        {"prompt": [1, 2], "max_new_tokens": 4, "adapter": "tenant-a"})
+    assert prog.adapter == "tenant-a" and prog.adapter_id == -1
+    with pytest.raises(ValueError, match="non-empty string name"):
+        GenerationProgram.from_wire(
+            {"prompt": [1], "max_new_tokens": 2, "adapter": ""})
+    with pytest.raises(ValueError, match="not both"):
+        GenerationProgram.from_wire(
+            {"prompt": [1], "max_new_tokens": 2, "adapter": "a",
+             "adapter_id": 1})
+
+
+def test_named_adapter_without_pool_fails_typed():
+    eng = DecodeEngine(SimRollingEngine(max_slots=2, steps_per_call=2,
+                                        step_s=0.001), poll_s=0.002)
+    try:
+        with pytest.raises(ValueError, match="no adapter pool"):
+            list(eng.generate({"prompt": [1], "max_new_tokens": 2,
+                               "adapter": "tenant-a"}))
+    finally:
+        eng.close()
+
+
+def test_residency_miss_sheds_typed_then_serves():
+    eng, sim, pool = _mk_engine(load_delay=0.05)
+    try:
+        from kubetorch_tpu.observability import prometheus as prom
+
+        tok_key = prom.adapter_series("tenant-a", "tokens_total")
+        shed_key = prom.adapter_series("tenant-a", "sheds_total")
+        toks0 = prom.adapter_metrics().get(tok_key, 0.0)
+        sheds0 = prom.adapter_metrics().get(shed_key, 0.0)
+        prompt = [1, 2, 3]
+        prog = {"prompt": prompt, "max_new_tokens": 8,
+                "adapter": "tenant-a"}
+        # cold adapter: the FIRST submit sheds typed with a Retry-After
+        # from the pool's load-time EMA — it never blocks the driver
+        with pytest.raises(ServerOverloaded) as err:
+            list(eng.generate(prog))
+        assert err.value.retry_after and err.value.retry_after > 0
+        frames = _until_resident(lambda: list(eng.generate(prog)))
+        toks = [t for f in frames for t in f["tokens"]]
+        assert toks == SimRollingEngine.expected_tokens(prompt, 8)
+        st = eng.stats()
+        assert st["adapter_resident"] == 1
+        assert st["adapter_loads"] == 1
+        assert st["adapter_misses"] >= 1
+        assert st["adapter_slots"] == 2
+        # per-tenant telemetry: tokens + sheds landed in the NAME-keyed
+        # dynamic families, TTFT in the per-adapter histogram
+        m = prom.adapter_metrics()
+        assert m[tok_key] - toks0 == len(toks)
+        assert m[shed_key] - sheds0 >= 1
+        assert any(k.startswith("engine_adapter__tenant_a_ttft_seconds")
+                   for k in prom.hist_metrics())
+        # ... and the fleet plane carries them (engine_ frame prefix)
+        from kubetorch_tpu.observability.fleetstore import build_frame
+
+        frame = build_frame(prom.adapter_metrics(), {}, last_sent={},
+                            full=True)
+        assert frame["m"].get(tok_key) == m[tok_key]
+    finally:
+        eng.close()
+
+
+def test_prefix_entries_die_with_adapter_eviction():
+    """Satellite regression: prefix KV is keyed by adapter NAME. With
+    one pool slot, tenant-b displaces tenant-a; tenant-a's cached
+    prefix must not survive into the recycled slot — neither serving
+    tenant-b (cross-tenant KV) nor a reloaded tenant-a (stale epoch)."""
+    eng, sim, pool = _mk_engine(pool_slots=1, sim_slots=2)
+    try:
+        tokens = [5, 6, 7, 8]
+        pid_a = _until_resident(
+            lambda: eng.register_prefix(tokens, adapter="tenant-a"))
+        # idempotent re-register: same NAME + tokens → cached pid
+        assert eng.register_prefix(tokens, adapter="tenant-a") == pid_a
+        fill_a = sim.prefill_tokens
+        # tenant-b displaces tenant-a from the single slot
+        pid_b = _until_resident(
+            lambda: eng.register_prefix(tokens, adapter="tenant-b"))
+        assert pool.resident() == {"tenant-b": 0}
+        assert pid_b != pid_a, "tenant-b served tenant-a's prefix KV"
+        assert sim.prefill_tokens > fill_a, \
+            "tenant-b's prefix was never prefilled under its own weights"
+        # a reloaded tenant-a re-fills too — its old entry died with
+        # the eviction (the slot's device KV now holds other weights)
+        fill_b = sim.prefill_tokens
+        pid_a2 = _until_resident(
+            lambda: eng.register_prefix(tokens, adapter="tenant-a"))
+        assert pid_a2 != pid_a
+        assert sim.prefill_tokens > fill_b
+        assert eng.stats()["adapter_evictions"] >= 2
+    finally:
+        eng.close()
+
+
+def test_park_evict_adapter_resume_byte_identical(local_store):
+    """Satellite: export/import carries the adapter NAME binding. A
+    session parks under tenant-a, tenant-a is LRU-evicted (slot
+    recycled to tenant-b), and the resume — naming tenant-a — first
+    sheds typed (non-resident ⇒ pool load), then continues the token
+    stream byte-identical once the reload lands."""
+    prompt = [3, 1, 4, 1, 5]
+    n = 120
+    expected = SimRollingEngine.expected_tokens(prompt, n)
+    eng, sim, pool = _mk_engine(pool_slots=1, sim_slots=2, step_s=0.01)
+    try:
+        prog = {"prompt": prompt, "max_new_tokens": n,
+                "session_id": "sess-lora", "adapter": "tenant-a"}
+        first_half: list = []
+        parked = threading.Event()
+
+        def run_first():
+            # the shed surfaces on iteration (generate() is lazy), and
+            # only at admission — before any token lands
+            deadline = time.time() + 15
+            while True:
+                try:
+                    for f in eng.generate(prog):
+                        if f.get("parked"):
+                            parked.set()
+                            return
+                        first_half.extend(f["tokens"])
+                    return
+                except ServerOverloaded:
+                    assert time.time() < deadline
+                    time.sleep(0.01)
+
+        th = threading.Thread(target=run_first)
+        th.start()
+        _wait(lambda: first_half, what="tokens before park")
+        assert eng.park("sess-lora") == 1
+        th.join(10)
+        assert parked.is_set()
+        assert 0 < len(first_half) < n
+        # the parked row released its pin: tenant-b can now displace
+        # tenant-a from the single slot
+        _until_resident(lambda: list(eng.generate(
+            {"prompt": [9, 9], "max_new_tokens": 4,
+             "adapter": "tenant-b"})))
+        assert pool.resident() == {"tenant-b": 0}
+        # resume under the WRONG name refuses — the binding rode the blob
+        with pytest.raises(ValueError, match="fixed at park"):
+            list(eng.generate({**prog, "adapter": "tenant-b"}))
+        # resume under tenant-a: sheds while cold, then continues the
+        # stream byte-identical (no re-prefill — restore, not replay)
+        prefill_before = sim.prefill_tokens
+        frames = _until_resident(lambda: list(eng.generate(prog)))
+        second_half = [t for f in frames for t in f["tokens"]]
+        assert frames[-1]["done"]
+        assert first_half + second_half == expected
+        assert sim.prefill_tokens == prefill_before, \
+            "resume re-ran prompt prefill"
+        assert pool.resident() == {"tenant-a": 0}
+    finally:
+        eng.close()
+
+
+def test_adapter_pin_survives_lru_pressure():
+    """A decoding row pins its adapter: staged loads must WAIT rather
+    than evict it mid-stream, and the pin releases with the row."""
+    eng, sim, pool = _mk_engine(pool_slots=1, sim_slots=2, step_s=0.01)
+    try:
+        _until_resident(lambda: list(eng.generate(
+            {"prompt": [1], "max_new_tokens": 2, "adapter": "tenant-a"})))
+        holder = {}
+
+        def start_stream():
+            g = eng.generate({"prompt": [2, 2], "max_new_tokens": 4000,
+                              "adapter": "tenant-a"})
+            first = next(g)             # sheds surface on iteration
+            holder["gen"] = g
+            return first
+
+        assert _until_resident(start_stream)["tokens"]
+        with pytest.raises(ServerOverloaded):
+            list(eng.generate({"prompt": [3], "max_new_tokens": 2,
+                               "adapter": "tenant-b"}))
+        # the fetch finishes but cannot place: tenant-a stays resident
+        _wait(lambda: pool.stats()["staged"] == 1, what="staged tenant-b")
+        time.sleep(0.05)                # a few ticks of admit_ready
+        assert pool.resident() == {"tenant-a": 0}
+        assert eng.stats()["adapter_pinned"] == 1
+        holder["gen"].close()
+    finally:
+        eng.close()
+
+
+# ------------------------------------- real model (jax) identity
+@pytest.fixture(scope="module")
+def rmodel():
+    import jax
+
+    from kubetorch_tpu.models import LlamaConfig, llama
+
+    cfg = LlamaConfig(vocab_size=256, embed_dim=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, head_dim=16, mlp_dim=128, remat=False,
+                      dtype="float32", param_dtype="float32",
+                      max_seq_len=128)
+    return llama.init(jax.random.key(0), cfg), cfg
+
+
+@pytest.mark.level("minimal")
+def test_real_model_dynamic_pool_matches_frozen_engine(rmodel, local_store):
+    """Acceptance: a program decoded under adapter k through the DYNAMIC
+    pool (empty at ctor; named adapters hot-loaded into fixed slots)
+    streams byte-identical to the same program on a ctor-FROZEN stacked
+    engine — including through a prefix hit and a park/resume
+    (mid-stream partition through the store). The pool's per-slot
+    dynamic-slice write plus the gather select must be invisible in the
+    tokens; only residency timing (the typed sheds) may differ."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubetorch_tpu.models import lora as lora_mod
+    from kubetorch_tpu.models.lora import LoraConfig, stack_adapters
+    from kubetorch_tpu.models.rolling import RollingGenerator
+
+    params, cfg = rmodel
+    lcfg = LoraConfig(rank=4, alpha=8.0)
+
+    def noisy(key):
+        ad = lora_mod.init(key, params, lcfg)
+        ks = jax.random.split(key, len(ad))
+        for k, name in zip(ks, sorted(ad)):
+            ad[name]["b"] = (jax.random.normal(
+                k, ad[name]["b"].shape, jnp.float32) * 0.2
+            ).astype(ad[name]["b"].dtype)
+        return ad
+
+    ads = {"tenant-a": noisy(jax.random.key(40)),
+           "tenant-b": noisy(jax.random.key(41))}
+
+    # ground truth: both adapters baked in at construction, addressed
+    # by raw slot int — the pre-pool serving path
+    frozen = RollingGenerator(
+        params, cfg, max_slots=2, max_len=96, steps_per_call=4,
+        adapters=stack_adapters([ads["tenant-a"], ads["tenant-b"]], lcfg),
+        adapter_scale=lcfg.scale)
+    eng_f = DecodeEngine(frozen, poll_s=0.002)
+
+    # dynamic: ctor sees only a ZERO adapter (zero delta = base model)
+    # padded to the fixed slot width; real weights arrive exclusively
+    # through the pool's background fetch + load_adapter_slot write
+    dyn = RollingGenerator(
+        params, cfg, max_slots=2, max_len=96, steps_per_call=4,
+        adapters=stack_adapters([lora_mod.init(jax.random.key(9),
+                                               params, lcfg)], lcfg),
+        adapter_scale=lcfg.scale, lora_slots=2)
+    pool = AdapterPool(2, lambda name: stack_adapters([ads[name]], lcfg),
+                       dyn.load_adapter_slot,
+                       load_ema_alpha=0.5, load_seed_s=0.05)
+    eng_d = DecodeEngine(dyn, poll_s=0.002, adapter_pool=pool)
+
+    prompt = [3, 7, 11, 2]
+    n = 16
+    try:
+        def run_f(**kw):
+            return [t for f in eng_f.generate(
+                {"prompt": prompt, "max_new_tokens": n, **kw})
+                for t in f["tokens"]]
+
+        def run_d(name, **kw):
+            return _until_resident(lambda: [
+                t for f in eng_d.generate(
+                    {"prompt": prompt, "max_new_tokens": n,
+                     "adapter": name, **kw})
+                for t in f["tokens"]])
+
+        expect_a, expect_b = run_f(adapter_id=0), run_f(adapter_id=1)
+        base = run_f()
+        assert expect_a != base, "adapter 0 never steered the stream"
+        # named decode through the pool == frozen slots, per tenant
+        assert run_d("tenant-a") == expect_a
+        assert run_d("tenant-b") == expect_b
+        assert pool.resident() == {"tenant-a": 0, "tenant-b": 1}
+
+        # --- through a prefix hit: registered under the NAME on the
+        # dynamic engine, under the raw slot on the frozen one
+        prefix = [5, 6, 7, 8, 9, 10]
+        suffix = [12, 13]
+        full = {"prompt": prefix + suffix, "max_new_tokens": n}
+        expect_px = [t for f in eng_f.generate({**full, "adapter_id": 0})
+                     for t in f["tokens"]]
+        pid_f = eng_f.register_prefix(prefix, adapter_id=0)
+        pid_d = _until_resident(
+            lambda: eng_d.register_prefix(prefix, adapter="tenant-a"))
+        hit_f = [t for f in eng_f.generate(
+            {"prompt": suffix, "max_new_tokens": n, "prefix_id": pid_f,
+             "adapter_id": 0}) for t in f["tokens"]]
+        hit_d = _until_resident(lambda: [t for f in eng_d.generate(
+            {"prompt": suffix, "max_new_tokens": n, "prefix_id": pid_d,
+             "adapter": "tenant-a"}) for t in f["tokens"]])
+        assert hit_f == expect_px, "frozen prefix hit diverged"
+        assert hit_d == expect_px, "dynamic-pool prefix hit diverged"
+
+        # --- through a park/resume: partition the stream mid-flight,
+        # round-trip the row's KV through the real store, continue
+        sid = "sess-real-lora"
+        prog = {"prompt": prompt, "max_new_tokens": n,
+                "session_id": sid, "adapter": "tenant-a"}
+
+        def start():
+            g = eng_d.generate(prog)
+            return g, next(g)           # sheds surface on iteration
+
+        g, first = _until_resident(start)
+        first_half = list(first["tokens"])
+        assert eng_d.park(sid) == 1
+        for f in g:
+            if f.get("parked"):
+                break
+            first_half.extend(f["tokens"])
+        assert 0 < len(first_half) < n
+        frames = _until_resident(lambda: list(eng_d.generate(prog)))
+        second_half = [t for f in frames for t in f["tokens"]]
+        assert frames[-1]["done"]
+        assert first_half + second_half == expect_a
+    finally:
+        eng_f.close()
+        eng_d.close()
+
+
+# -------------------------------------------- fleet SLO (per tenant)
+def test_per_adapter_slo_breaches_independently_of_fleet():
+    """Acceptance: a per-adapter SLO objective (selectors over the
+    dynamic engine_adapter__<name>_* families) burns and breaches on
+    ONE tenant's shed-rate while the fleet-wide objective — the same
+    window, the same pods — stays green."""
+    from kubetorch_tpu.observability.fleetstore import FleetStore
+    from kubetorch_tpu.observability.slo import Objective, SLOEngine
+
+    clock = [0.0]
+    store = FleetStore(raw_s=120.0, mid_s=900.0, retain_s=3600.0,
+                       stale_after_s=30.0, clock=lambda: clock[0])
+    slo = SLOEngine(
+        store,
+        objectives=[
+            Objective(service="svc", name="tenant-a-shed", kind="ratio",
+                      bad="engine_adapter__tenant_a_sheds_total",
+                      total="engine_adapter__tenant_a_generations_total",
+                      objective=0.98, burn_threshold=2.0),
+            Objective(service="svc", name="fleet-shed", kind="ratio",
+                      bad="engine_sheds_total",
+                      total="engine_generations_total",
+                      objective=0.98, burn_threshold=2.0),
+        ],
+        fast_s=30.0, slow_s=30.0, clock=lambda: clock[0])
+    slo._started = -3600.0
+    for i in range(1, 4):
+        clock[0] += 1.0
+        store.ingest("svc", "p0", {"ts": clock[0], "m": {
+            # tenant-a: 50% of its submissions shed (cold-adapter storm)
+            "engine_adapter__tenant_a_generations_total": 20.0 * i,
+            "engine_adapter__tenant_a_sheds_total": 10.0 * i,
+            # fleet-wide: those 10 sheds drown in 10k generations
+            "engine_generations_total": 10000.0 * i,
+            "engine_sheds_total": 10.0 * i}})
+    by_name = {s["name"]: s for s in slo.evaluate()}
+    assert by_name["tenant-a-shed"]["breached"]
+    assert by_name["tenant-a-shed"]["burn_rate"] >= 2.0
+    assert not by_name["fleet-shed"]["breached"]
+    assert by_name["fleet-shed"]["burn_rate"] < 1.0

@@ -74,14 +74,45 @@ def test_split_rules():
 
 @pytest.mark.level("unit")
 def test_prefix_key_is_content_and_adapter_bound():
-    a = kvpool.prefix_key([1, 2, 3], adapter_id=-1)
-    assert a == kvpool.prefix_key([1, 2, 3], adapter_id=-1)
-    assert a != kvpool.prefix_key([1, 2, 4], adapter_id=-1)
+    a = kvpool.prefix_key([1, 2, 3], adapter=-1)
+    assert a == kvpool.prefix_key([1, 2, 3], adapter=-1)
+    assert a != kvpool.prefix_key([1, 2, 4], adapter=-1)
     # prefix KV is weight-dependent: same tokens, different adapter →
     # different cache entry
-    assert a != kvpool.prefix_key([1, 2, 3], adapter_id=0)
+    assert a != kvpool.prefix_key([1, 2, 3], adapter=0)
     # no concatenation ambiguity
     assert kvpool.prefix_key([12, 3]) != kvpool.prefix_key([1, 23])
+
+
+@pytest.mark.level("unit")
+def test_prefix_key_by_adapter_name_not_slot():
+    # pool-managed adapters key the prefix cache by NAME: a slot int is
+    # recycled across evict/load cycles, so a slot-keyed entry would
+    # serve one tenant's prefix KV to whichever adapter lands in the
+    # slot next. Names never collide with raw-slot keys either.
+    k = kvpool.prefix_key([1, 2, 3], adapter="tenant-a")
+    assert k == kvpool.prefix_key([1, 2, 3], adapter="tenant-a")
+    assert k != kvpool.prefix_key([1, 2, 3], adapter="tenant-b")
+    for slot in (-1, 0, 1):
+        assert k != kvpool.prefix_key([1, 2, 3], adapter=slot)
+    # a name that LOOKS like a slot int still keys separately from it
+    assert kvpool.prefix_key([1, 2, 3], adapter="0") != \
+        kvpool.prefix_key([1, 2, 3], adapter=0)
+    # remove_by_adapter drops only matching-identity COLD entries
+    ledger = kvpool.KVBlockLedger(budget_blocks=10, block_tokens=4)
+    cache = kvpool.PrefixCache(ledger)
+    cache.insert("ka", pid=0, tokens=4, adapter_id="tenant-a")
+    cache.insert("kb", pid=1, tokens=4, adapter_id="tenant-b")
+    cache.insert("ks", pid=2, tokens=4, adapter_id=3)
+    dropped = cache.remove_by_adapter("tenant-a")
+    assert [d.pid for d in dropped] == [0]
+    assert cache.peek("ka") is None
+    assert cache.peek("kb") is not None and cache.peek("ks") is not None
+    # pinned entries survive (a live row is mid-decode on that prefix)
+    eb = cache.peek("kb")
+    cache.acquire(eb)
+    assert cache.remove_by_adapter("tenant-b") == []
+    assert cache.peek("kb") is not None
 
 
 @pytest.mark.level("unit")

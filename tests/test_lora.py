@@ -138,11 +138,11 @@ def test_multi_adapter_prefill_logits_match_merged(cfg, params):
     mask = jnp.broadcast_to(
         jnp.arange(M)[None, None, :] <= jnp.arange(P)[None, :, None],
         (B, P, M))
-    onehot = jnp.asarray([[1.0, 0.0], [0.0, 1.0], [0.0, 0.0]])
+    slots = jnp.asarray([0, 1, -1], jnp.int32)
     cache = llama.init_cache(cfg, B, M)
     got, _ = llama.forward_cached(
         params, toks, positions, cache, 0, mask, cfg,
-        lora={"adapters": stacked, "onehot": onehot, "scale": lcfg.scale})
+        lora={"adapters": stacked, "slots": slots, "scale": lcfg.scale})
     # row 0 ≡ merged adapter 0, row 1 ≡ merged adapter 1, row 2 ≡ base
     for row, ref_params in ((0, lora_mod.merge(params, ads[0], lcfg)),
                             (1, lora_mod.merge(params, ads[1], lcfg)),
@@ -170,7 +170,7 @@ def test_multi_adapter_generate_per_request(cfg, params):
     out = gen.generate(prompts, max_new_tokens=6, temperature=0.0,
                        adapter_ids=[0, 1, -1])
     # the base row must be token-identical to a no-adapter Generator
-    # (zero one-hot makes the delta exactly zero)
+    # (the −1 index masks the delta to exactly zero)
     base = Generator(params, cfg).generate([prompts[2]], max_new_tokens=6,
                                            temperature=0.0)
     assert out[2] == base[0]
@@ -339,3 +339,97 @@ def test_rolling_negative_adapter_id_rejected(cfg, params):
         eng.submit([1, 2], adapter_id=-5)
     # -1 = base model stays valid
     eng.submit([1, 2], max_new_tokens=2, adapter_id=-1)
+
+
+def test_fused_stack_block_diagonal_matches_unfused_math(cfg, params):
+    """PR 16 satellite: the fused serving layout (A concat on the rank
+    axis, B block-diagonal over the concatenated output) is
+    ALGEBRAICALLY the per-target deltas laid side by side — per slot,
+    per layer, to float32 exactness."""
+    from kubetorch_tpu.models.lora import stack_adapters
+    from kubetorch_tpu.models.quant import FUSE_GROUPS
+
+    lcfg = LoraConfig(rank=3, alpha=6.0)
+    ads = [_noisy_adapters(jax.random.key(i + 50), params, lcfg, 0.1)
+           for i in range(3)]
+    unfused = stack_adapters(ads, lcfg)
+    fused = stack_adapters(
+        ads, lcfg, layer_names={"wqkv", "wgu", "wo", "w_down"})
+    assert set(fused) == {"wqkv", "wgu", "wo", "w_down"}
+    for fused_name, members in FUSE_GROUPS:
+        fa = fused[fused_name]["a"].astype(jnp.float32)
+        fb = fused[fused_name]["b"].astype(jnp.float32)
+        # [L, n, K, sum(N)] delta through the fused factors
+        got = jnp.einsum("lnkr,lnrm->lnkm", fa, fb)
+        want = jnp.concatenate(
+            [jnp.einsum("lnkr,lnrm->lnkm",
+                        unfused[m]["a"].astype(jnp.float32),
+                        unfused[m]["b"].astype(jnp.float32))
+             for m in members], axis=-1)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+    # untouched targets pass through identical
+    np.testing.assert_array_equal(np.asarray(fused["wo"]["a"]),
+                                  np.asarray(unfused["wo"]["a"]))
+
+
+def test_validate_adapter_targets_messages_pinned(cfg, params):
+    """The fail-fast messages engines rely on are API: the fused-tree
+    hint must name stack_adapters(..., layer_names=) and the plain miss
+    must list what the layer dict has."""
+    from kubetorch_tpu.models.lora import validate_adapter_targets
+
+    layers_fused = {"wqkv": 1, "wgu": 1, "wo": 1, "w_down": 1}
+    with pytest.raises(ValueError) as err:
+        validate_adapter_targets(
+            {"wq": {}, "wk": {}, "wv": {}, "wo": {}}, layers_fused)
+    msg = str(err.value)
+    assert "adapter targets ['wk', 'wq', 'wv'] are absent" in msg
+    assert "FUSED weights ['wqkv']" in msg
+    assert "stack_adapters(..., layer_names=params['layers'])" in msg
+    with pytest.raises(ValueError) as err2:
+        validate_adapter_targets({"nope": {}}, {"wq": 1, "wo": 1})
+    assert ("adapter targets ['nope'] not found in the serving layer "
+            "dict (have ['wo', 'wq'])") in str(err2.value)
+    # full coverage: silent success
+    validate_adapter_targets(
+        {"wqkv": {}, "wgu": {}, "wo": {}}, layers_fused)
+
+
+def test_stack_partial_fuse_message_pinned(cfg, params):
+    from kubetorch_tpu.models.lora import stack_adapters
+
+    lcfg = LoraConfig(rank=2, targets=("wq", "wv", "wo"))
+    ads = [lora_mod.init(jax.random.key(0), params, lcfg)]
+    with pytest.raises(ValueError) as err:
+        stack_adapters(ads, lcfg, layer_names={"wqkv", "wo"})
+    msg = str(err.value)
+    assert "cover all of ('wq', 'wk', 'wv') or none" in msg
+    assert "have ('wq', 'wv')" in msg
+    assert "serve unfused" in msg
+
+
+def test_pad_adapter_slots_fixed_axis(cfg, params):
+    """PR 16: the pool's fixed-axis contract — padded tail slots are
+    exact zero deltas (serve the base model), and over-padding an
+    already-wider tree refuses with the KT_LORA_SLOTS hint."""
+    from kubetorch_tpu.models.generate import Generator
+    from kubetorch_tpu.models.lora import pad_adapter_slots, stack_adapters
+
+    lcfg = LoraConfig(rank=2, alpha=4.0)
+    ads = [_noisy_adapters(jax.random.key(60), params, lcfg, 0.2)]
+    padded = pad_adapter_slots(stack_adapters(ads, lcfg), 4)
+    assert all(ab["a"].shape[1] == 4 and ab["b"].shape[1] == 4
+               for ab in padded.values())
+    gen = Generator(params, cfg, adapters=padded,
+                    adapter_scale=lcfg.scale)
+    prompt = [3, 7, 11]
+    out = gen.generate([prompt] * 3, max_new_tokens=6, temperature=0.0,
+                       adapter_ids=[0, 2, -1])
+    base = Generator(params, cfg).generate([prompt], max_new_tokens=6,
+                                           temperature=0.0)
+    assert out[1] == base[0]          # zero-padded slot == base model
+    assert out[2] == base[0]
+    assert out[0] != base[0]          # the loaded slot still steers
+    with pytest.raises(ValueError, match="raise KT_LORA_SLOTS"):
+        pad_adapter_slots(padded, 2)
