@@ -1488,6 +1488,266 @@ def bench_lora(n_adapters: int = 8, programs: int = 8,
     return out
 
 
+def bench_disagg(n_programs: int = 64, step_ms: float = 3.0,
+                 prefill_ms: float = 12.0, prompt_tokens: int = 64,
+                 prefill_chunk: int = 32, max_new: int = 384,
+                 batch: int = 4, steps_per_call: int = 8,
+                 handoff_chunks: float = 2.0, load: float = 1.0,
+                 ttft_slo_ms: float = 250.0,
+                 dryrun: bool = False) -> dict:
+    """Disaggregated prefill/decode vs equal-chip monolithic, in
+    VIRTUAL time (the bench_engine_spec pattern: hand-driven ticks,
+    seeded arrivals, deterministic on any host).
+
+    Two chips per side. Monolithic: two mixed pods, join-least-pending
+    routing, each tick pays its prefill chunks (compute-bound: charged
+    per prefilling row) PLUS one decode chunk (bandwidth-bound: flat
+    ``step_ms`` regardless of occupancy — the batched-step shape the
+    sim pins). Disagg: one prefill pod that exports each row the tick
+    its prefill lands (real ``export_row`` state dicts — the same tree
+    the store ships) and frees the slot, one decode pod that imports
+    off the wire and never pays a prefill charge. The handoff costs
+    ``handoff_chunks`` decode chunks of wire latency and is fully
+    overlapped with the prefill pod's next rows (measured, not
+    assumed: the overlap ratio below is busy-interval arithmetic).
+    The decode pod hosts no prefill activations, so its freed HBM
+    carries 2x the KV row pool — the memory-budget specialization
+    that lets the decode tier consolidate the fleet's decode into
+    one full-batch bandwidth-bound loop.
+
+    Goodput is SLO-attainment goodput (the DistServe definition):
+    tokens from requests that met BOTH the TTFT SLO and the p95
+    inter-chunk-gap SLO, per second of wall. That is the number the
+    tentpole moves — interleaved prefill inflates the monolithic
+    fleet's inter-token gaps (a 4x-cost prefill chunk stalls the whole
+    decode batch) and its slot hold times (rows decode 5x slower, the
+    queue spirals), while the decode tier's cadence stays one chunk
+    per ``step_ms``.
+    """
+    import collections
+    import random
+
+    from kubetorch_tpu.serving.engine import SimRollingEngine
+
+    if dryrun:
+        n_programs, step_ms, prefill_ms = 64, 3.0, 12.0
+        prompt_tokens, prefill_chunk, max_new = 64, 32, 384
+        batch, steps_per_call, handoff_chunks = 4, 8, 2.0
+        load, ttft_slo_ms = 1.0, 250.0
+    assert prompt_tokens > prefill_chunk, "prompts must need prefill"
+
+    handoff_ms = handoff_chunks * step_ms
+    tpot_slo_ms = 2.0 * step_ms + 0.5      # p95 inter-chunk gap bound
+    pf_chunks = -(-prompt_tokens // prefill_chunk)
+    pf_req_ms = pf_chunks * prefill_ms
+    lam = load / pf_req_ms                 # overload the prefill tier
+    rnd = random.Random(17)
+    arrive, prompts, t_acc = [], [], 0.0
+    for i in range(n_programs):
+        t_acc += rnd.expovariate(lam)
+        arrive.append(t_acc)
+        prompts.append([200 + i] + [7] * (prompt_tokens - 1))
+
+    def tree_bytes(tree):
+        if isinstance(tree, dict):
+            return sum(tree_bytes(v) for v in tree.values())
+        return int(getattr(tree, "nbytes", 0))
+
+    class Pod:
+        def __init__(self, slots=batch):
+            self.eng = SimRollingEngine(
+                max_slots=slots, steps_per_call=steps_per_call,
+                prefill_chunk=prefill_chunk, step_s=0.0)
+            self.clock = 0.0
+            self.busy = []                 # device-busy (t0, t1) spans
+            self.rid2idx = {}
+            self.decode_ticks = 0
+            self.decode_tokens = 0
+
+    class Trace:
+        def __init__(self):
+            self.chunk_t = collections.defaultdict(list)
+            self.got = collections.defaultdict(list)
+            self.done_at = {}
+
+        def record(self, pod, events):
+            pod.decode_ticks += 1
+            for rid, toks, done in events:
+                idx = pod.rid2idx[rid]
+                if toks:
+                    self.got[idx].extend(toks)
+                    self.chunk_t[idx].append(pod.clock)
+                    pod.decode_tokens += len(toks)
+                if done:
+                    self.done_at[idx] = pod.clock
+
+        def summarize(self):
+            for idx in range(n_programs):
+                expect = SimRollingEngine.expected_tokens(
+                    prompts[idx], max_new)
+                assert self.got[idx] == expect, \
+                    f"stream {idx} diverged from the monolithic truth"
+            ttft = [self.chunk_t[i][0] - arrive[i]
+                    for i in range(n_programs)]
+            wall_ms = max(self.done_at.values()) - arrive[0]
+            ok_tok = 0
+            for idx in range(n_programs):
+                ct = self.chunk_t[idx]
+                gaps = [b - a for a, b in zip(ct, ct[1:])]
+                if (ttft[idx] <= ttft_slo_ms
+                        and _pct(gaps, 95) <= tpot_slo_ms):
+                    ok_tok += max_new
+            return {"ttft_p99": _pct(ttft, 99), "wall_ms": wall_ms,
+                    "tok_s": n_programs * max_new / (wall_ms / 1e3),
+                    "goodput": ok_tok / (wall_ms / 1e3)}
+
+    def mixed_tick(pod, trace):
+        t0 = pod.clock
+        # chunked prefill runs ONE request at a time (the real
+        # engine's dispatch shape): run-to-completion FIFO, not a
+        # co-prefill batch that finishes every row late
+        if not pod.eng.prefilling_rows:
+            pod.eng.admit(max_rows=1)
+        n_pf = pod.eng.prefilling_rows
+        if n_pf:
+            pod.eng.prefill_step()
+            pod.clock += prefill_ms * n_pf
+        if pod.eng.active_rows:
+            events = pod.eng.decode_step()
+            pod.clock += step_ms
+            trace.record(pod, events)
+        if pod.clock > t0:
+            pod.busy.append((t0, pod.clock))
+
+    def run_monolithic():
+        pods, trace, i = [Pod(), Pod()], Trace(), 0
+        while len(trace.done_at) < n_programs:
+            working = [p for p in pods if p.eng.pending]
+            front = min((p.clock for p in working), default=None)
+            while i < n_programs and (front is None
+                                      or arrive[i] <= front):
+                p = min(pods, key=lambda q: (q.eng.pending, q.clock))
+                p.clock = max(p.clock, arrive[i])
+                p.rid2idx[p.eng.submit(
+                    prompts[i], max_new_tokens=max_new)] = i
+                i += 1
+                working = [q for q in pods if q.eng.pending]
+                front = min(q.clock for q in working)
+            mixed_tick(min(working, key=lambda q: q.clock), trace)
+        return trace.summarize()
+
+    def run_disagg():
+        # same chip, different memory budget: a decode-only pod hosts
+        # no prefill activations, so the freed HBM doubles its KV row
+        # pool — the consolidation that makes the decode tier's batch
+        # (and its bandwidth utilization) worth specializing for
+        pf, dc, trace, i = Pod(), Pod(slots=2 * batch), Trace(), 0
+        handoffs = collections.deque()     # (ready_ms, idx, state)
+        exports = []                       # (t_export, wire_bytes)
+        while len(trace.done_at) < n_programs:
+            # the prefill pod is an independent device: an idle pod
+            # starts the next arrival at the arrival's own timestamp,
+            # not at whatever the decode pod is doing
+            while i < n_programs and (arrive[i] <= pf.clock
+                                      or not pf.eng.pending):
+                if not pf.eng.pending:
+                    pf.clock = max(pf.clock, arrive[i])
+                pf.rid2idx[pf.eng.submit(
+                    prompts[i], max_new_tokens=max_new)] = i
+                i += 1
+            # an idle decode pod waits for the wire, not for prefill
+            if (not dc.eng.active_rows and handoffs
+                    and handoffs[0][0] > dc.clock):
+                dc.clock = handoffs[0][0]
+            can_pf = bool(pf.eng.pending)
+            can_dc = bool(dc.eng.active_rows) or (
+                handoffs and handoffs[0][0] <= dc.clock
+                and dc.eng.free_rows)
+            if can_pf and (not can_dc or pf.clock <= dc.clock):
+                t0 = pf.clock
+                if not pf.eng.prefilling_rows:
+                    pf.eng.admit(max_rows=1)
+                n_pf = pf.eng.prefilling_rows
+                activated = []
+                if n_pf:
+                    activated = pf.eng.prefill_step()
+                    pf.clock += prefill_ms * n_pf
+                for rid in activated:
+                    # export the finished row and free the slot NOW —
+                    # the publish overlaps the next rows' prefill
+                    idx = pf.rid2idx.pop(rid)
+                    state = pf.eng.export_row(rid, block_tokens=16)
+                    pf.eng.evict(rid)
+                    handoffs.append(
+                        (pf.clock + handoff_ms, idx, state))
+                    exports.append((pf.clock, tree_bytes(state)))
+                if pf.clock > t0:
+                    pf.busy.append((t0, pf.clock))
+            elif can_dc:
+                t0 = dc.clock
+                while (handoffs and handoffs[0][0] <= dc.clock
+                       and dc.eng.free_rows):
+                    _, idx, state = handoffs.popleft()
+                    dc.rid2idx[dc.eng.import_row(
+                        state, block_tokens=16)] = idx
+                if dc.eng.active_rows:
+                    events = dc.eng.decode_step()
+                    dc.clock += step_ms
+                    trace.record(dc, events)
+                if dc.clock > t0:
+                    dc.busy.append((t0, dc.clock))
+            elif i < n_programs:
+                pf.clock = max(pf.clock, arrive[i])
+            else:
+                raise AssertionError("disagg sim stalled")
+        # overlap: wire time covered by prefill-pod device activity
+        olap = total = 0.0
+        for t_e, _ in exports:
+            total += handoff_ms
+            for b0, b1 in pf.busy:
+                if b1 <= t_e:
+                    continue
+                if b0 >= t_e + handoff_ms:
+                    break
+                olap += min(b1, t_e + handoff_ms) - max(b0, t_e)
+        out = trace.summarize()
+        out["overlap"] = olap / total if total else 0.0
+        out["bytes"] = _median([b for _, b in exports])
+        out["mbu"] = dc.decode_tokens / (
+            dc.decode_ticks * 2 * batch * steps_per_call)
+        return out
+
+    mono = run_monolithic()
+    dis = run_disagg()
+    out = {
+        "disagg_programs": n_programs,
+        "disagg_handoff_chunks": round(handoff_chunks, 2),
+        "disagg_handoff_bytes_p50": dis["bytes"],
+        "disagg_handoff_overlap_ratio": round(dis["overlap"], 4),
+        "disagg_ttft_p99_ms": round(dis["ttft_p99"], 1),
+        "disagg_ttft_p99_ms_mono": round(mono["ttft_p99"], 1),
+        "disagg_ttft_p99_ms_vs_monolithic": round(
+            dis["ttft_p99"] / mono["ttft_p99"], 4),
+        "disagg_tok_s": round(dis["tok_s"], 1),
+        "disagg_tok_s_mono": round(mono["tok_s"], 1),
+        "disagg_goodput_tok_s": round(dis["goodput"], 1),
+        "disagg_goodput_tok_s_mono": round(mono["goodput"], 1),
+        "disagg_goodput_ratio": round(
+            dis["goodput"] / max(mono["goodput"], 1.0), 4),
+        "disagg_decode_mbu_proxy": round(dis["mbu"], 4),
+    }
+    # the ISSUE 17 acceptance shape, asserted here so a full bench run
+    # fails loudly too (the smoke test re-asserts on dryrun output):
+    # at equal chip count the disaggregated fleet must win BOTH tails —
+    # SLO goodput AND TTFT p99 — with the handoff under a few decode
+    # chunks and genuinely overlapped with the next rows' prefill
+    assert out["disagg_goodput_ratio"] > 1.0, out
+    assert out["disagg_ttft_p99_ms_vs_monolithic"] < 1.0, out
+    assert out["disagg_handoff_chunks"] <= 3.0, out
+    assert out["disagg_handoff_overlap_ratio"] >= 0.5, out
+    return out
+
+
 def run(dryrun: bool = False, static_tok_s: float = 5673.0) -> dict:
     """Full serving bench. ``dryrun`` (CI smoke) runs only the
     call-tunnel phase at toy sizes — the model phases need a chip-scale
@@ -1502,6 +1762,7 @@ def run(dryrun: bool = False, static_tok_s: float = 5673.0) -> dict:
         out.update(bench_engine_spec(dryrun=True))
         out.update(bench_telemetry(dryrun=True))
         out.update(bench_lora(dryrun=True))
+        out.update(bench_disagg(dryrun=True))
         return out
     out = bench_8b_rolling(static_tok_s=static_tok_s) or {}
     if out:
@@ -1542,6 +1803,10 @@ def run(dryrun: bool = False, static_tok_s: float = 5673.0) -> dict:
         # phase 1's device truth like the other engine phases
         out.update(bench_lora(
             step_ms=out["ms_per_step_device"] * out["steps_per_call"]))
+        # disaggregation phase at the measured per-chunk device time
+        # (prefill chunks charged at the compute-bound 4x multiple)
+        step = out["ms_per_step_device"] * out["steps_per_call"]
+        out.update(bench_disagg(step_ms=step, prefill_ms=4.0 * step))
     return out
 
 

@@ -632,8 +632,8 @@ def _top_gather(controller, service, window):
 
 
 def _top_rows(fleet):
-    """Per-replica rows from a fleet rollup: (pod, occupancy, queue,
-    kv blocks, tok/s, spec accept rate, ttft p99 ms, status)."""
+    """Per-replica rows from a fleet rollup: (pod, tier, occupancy,
+    queue, kv blocks, tok/s, spec accept rate, ttft p99 ms, status)."""
     gauges = fleet.get("gauges") or {}
     counters = fleet.get("counters") or {}
     hists = fleet.get("histograms") or {}
@@ -648,6 +648,11 @@ def _top_rows(fleet):
         occ = "—"
         if active is not None and free is not None and active + free > 0:
             occ = f"{active:g}/{active + free:g}"
+        # disaggregated tier (engine_phase: 0=prefill 1=decode 2=mixed;
+        # "—" for a pod that never published the gauge)
+        phase = by_pod(gauges, "engine_phase", pod)
+        tier = ({0: "prefill", 1: "decode", 2: "mixed"}.get(int(phase))
+                if phase is not None else None) or "—"
         queue = by_pod(gauges, "engine_queue_depth", pod)
         kv = by_pod(gauges, "kv_blocks_used", pod)
         tok_s = by_pod(counters, "engine_tokens_total", pod)
@@ -666,7 +671,7 @@ def _top_rows(fleet):
             status = f"reset {meta['last_reset_age_s']:.0f}s ago"
         else:
             status = "ok"
-        rows.append((pod, occ,
+        rows.append((pod, tier, occ,
                      f"{queue:g}" if queue is not None else "—",
                      f"{kv:g}" if kv is not None else "—",
                      f"{tok_s:.1f}" if tok_s is not None else "—",
@@ -733,12 +738,12 @@ def _top_render(snapshot, window):
         if not fleet or not fleet.get("pods"):
             lines.append("  (no telemetry yet)")
             continue
-        lines.append(f"  {'replica':<28}{'rows':>9}{'queue':>7}"
-                     f"{'kv blk':>8}{'tok/s':>9}{'accept':>8}"
-                     f"{'ttft p99':>10}  status")
+        lines.append(f"  {'replica':<28}{'tier':>9}{'rows':>9}"
+                     f"{'queue':>7}{'kv blk':>8}{'tok/s':>9}"
+                     f"{'accept':>8}{'ttft p99':>10}  status")
         for row in _top_rows(fleet):
-            pod, occ, queue, kv, tok_s, acc, p99, status = row
-            lines.append(f"  {pod:<28}{occ:>9}{queue:>7}{kv:>8}"
+            pod, tier, occ, queue, kv, tok_s, acc, p99, status = row
+            lines.append(f"  {pod:<28}{tier:>9}{occ:>9}{queue:>7}{kv:>8}"
                          f"{tok_s:>9}{acc:>8}{p99:>10}  {status}")
         arows = _top_adapter_rows(fleet)
         if arows:

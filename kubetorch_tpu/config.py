@@ -369,6 +369,30 @@ _knob("KT_KV_SESSION_DELTA", "bool", True,
       "ships only its new blocks (per-block leaves + PR-3 delta).",
       "engine-kv")
 
+# --- disaggregated prefill/decode (phase tiers + KV handoff) ----------------
+_knob("KT_DISAGG_PHASE", "str", "mixed",
+      "Serving tier this pod's DecodeEngine runs as: 'prefill' (admit/"
+      "prefill only; every program must carry handoff= and its row is "
+      "exported to the decode tier), 'decode' (imports exported rows "
+      "and streams; still runs suffix prefills so prefix-cache hits "
+      "stay tier-local), or 'mixed' (monolithic).", "engine-disagg")
+_knob("KT_HANDOFF_PREFIX", "str", "kv/handoffs",
+      "Store key prefix exported handoff rows are published under.",
+      "engine-disagg")
+_knob("KT_HANDOFF_CODEC", "str", "auto",
+      "Wire codec for prefill→decode row handoff. 'auto' branches on "
+      "the grid: int8 KV grids ship their (q, scale) pairs raw "
+      "(bit-exact at half size); bf16/f32 grids take the int8 wire "
+      "codec (~2-4x fewer bytes). 'raw' forces exactness everywhere; "
+      "zlib/zstd compress losslessly.", "engine-disagg")
+_knob("KT_HANDOFF_TIMEOUT_S", "float", 30.0,
+      "Seconds the decode-side import polls for the prefill pod's "
+      "export to land before falling back to monolithic same-pod "
+      "decode (the program still carries its prompt).", "engine-disagg")
+_knob("KT_HANDOFF_POLL_S", "float", 0.01,
+      "Decode-side poll interval while waiting for an in-flight "
+      "handoff export.", "engine-disagg")
+
 # --- multi-tenant LoRA serving (device-resident adapter pool) ---------------
 _knob("KT_LORA_SLOTS", "int", 0,
       "Fixed adapter-axis width of the serving engine's stacked LoRA "

@@ -204,6 +204,28 @@ class ControllerClient:
             f"{self.base_url}/metrics/fleet/{service}/range",
             params=params)) or {}
 
+    def route_generate(self, service: str, *,
+                       prefix_hit: bool = False,
+                       exclude: Optional[List[str]] = None,
+                       handoff_id: Optional[str] = None
+                       ) -> Dict[str, Any]:
+        """Phase-aware routing for one generation program (ISSUE 17):
+        asks the controller which pod(s) should run it. → ``{"mode":
+        "disagg", "prefill": pod, "decode": pod, "handoff_id": ...}``,
+        ``{"mode": "decode-only", ...}`` (full-prefix hit: the KV
+        already lives on the decode tier), or ``{"mode": "monolithic",
+        "pod": ...}``. Pass ``exclude`` + the prior ``handoff_id`` to
+        re-route an exported row after a decode-pod drop — the blob is
+        still in the store and the id must not change."""
+        body: Dict[str, Any] = {"service": service,
+                                "prefix_hit": bool(prefix_hit)}
+        if exclude:
+            body["exclude"] = list(exclude)
+        if handoff_id is not None:
+            body["handoff_id"] = handoff_id
+        return self._check(self.client.post(
+            f"{self.base_url}/route/generate", json=body))
+
     def push_telemetry(self, service: str, pod: str,
                        frames: List[Dict[str, Any]]) -> int:
         """Batched telemetry frames (the POST fallback pods use when

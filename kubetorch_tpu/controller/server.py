@@ -351,6 +351,7 @@ class ControllerServer:
         r.add_post("/telemetry", self.h_telemetry)
         r.add_get("/metrics/fleet/{service}", self.h_fleet)
         r.add_get("/metrics/fleet/{service}/range", self.h_fleet_range)
+        r.add_post("/route/generate", self.h_route_generate)
         r.add_get("/slo", self.h_slo)
         r.add_get("/slo/{service}", self.h_slo)
         r.add_post("/slo", self.h_slo_register)
@@ -702,6 +703,89 @@ class ControllerServer:
             raise web.HTTPNotFound(text="no such service")
         return web.json_response(self.fleet.fleet(service,
                                                   window_s=window))
+
+    async def h_route_generate(self, request):
+        """Phase-aware routing for disaggregated prefill/decode
+        (ISSUE 17). Body: ``{"service", "prefix_hit": bool,
+        "exclude": [pods], "handoff_id": optional}``. The controller
+        only BROKERS the pairing — the prefill pod pushes the exported
+        row directly at the decode pod's store endpoint; no row bytes
+        transit here.
+
+        Routing rules, off the fleet rollup's ``engine_phase`` /
+        ``engine_row_eta_seconds`` / ``engine_queue_depth`` by-pod
+        gauges (stale and excluded pods never routable):
+
+        - ``prefix_hit`` + a decode tier → ``decode-only``: a
+          full-prefix hit's KV already lives tier-local on the decode
+          pod — skipping the prefill tier beats shipping a row whose
+          blocks are already there. Target: earliest expected row-free
+          time (PR 14's speculation-aware pricing, gauged by the
+          engine).
+        - a prefill AND a decode tier → ``disagg``: prefill target by
+          shallowest queue (prefill is compute-bound: queue depth IS
+          its backlog), decode target by earliest row-free ETA.
+        - otherwise → ``monolithic`` to the min-ETA mixed pod (or any
+          live pod) — also the re-route fallback when chaos/drop took
+          the decode tier out (``exclude``): the exported blob is still
+          in the store, and a mixed pod can import it.
+        """
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001
+            return web.json_response({"error": "bad json"}, status=400)
+        service = (body or {}).get("service")
+        if not service:
+            return web.json_response(
+                {"error": "route needs service"}, status=400)
+        prefix_hit = bool((body or {}).get("prefix_hit"))
+        exclude = set((body or {}).get("exclude") or [])
+        # the handoff id is minted HERE (idempotent echo on re-routes):
+        # prefill and decode pod must agree on the store key before
+        # either has seen the program
+        hid = ((body or {}).get("handoff_id")
+               or "h-" + uuid.uuid4().hex[:16])
+        fleet = self.fleet.fleet(service)
+        gauges = fleet.get("gauges") or {}
+        pods_meta = fleet.get("pods") or {}
+
+        def by_pod(name) -> Dict[str, float]:
+            return (gauges.get(name) or {}).get("by_pod") or {}
+
+        phase = by_pod("engine_phase")
+        eta = by_pod("engine_row_eta_seconds")
+        queue = by_pod("engine_queue_depth")
+        live = [p for p, m in sorted(pods_meta.items())
+                if p not in exclude and not m.get("stale")]
+        prefill = [p for p in live if phase.get(p) == 0]
+        decode = [p for p in live if phase.get(p) == 1]
+        mixed = [p for p in live if phase.get(p) not in (0, 1)]
+
+        def eta_key(p):
+            return (float(eta.get(p, 0.0)), p)
+
+        def queue_key(p):
+            return (float(queue.get(p, 0.0)), p)
+
+        if prefix_hit and decode:
+            return web.json_response(
+                {"mode": "decode-only",
+                 "decode": min(decode, key=eta_key),
+                 "handoff_id": hid})
+        if prefill and decode:
+            return web.json_response(
+                {"mode": "disagg",
+                 "prefill": min(prefill, key=queue_key),
+                 "decode": min(decode, key=eta_key),
+                 "handoff_id": hid})
+        pool = mixed or live
+        if not pool:
+            return web.json_response(
+                {"error": f"no routable pods for {service}"},
+                status=503)
+        return web.json_response(
+            {"mode": "monolithic", "pod": min(pool, key=eta_key),
+             "handoff_id": hid})
 
     async def h_fleet_range(self, request):
         """Aligned fleet series for ramps: ``?metrics=a,b&start=&end=

@@ -552,7 +552,8 @@ def _record_publish(stats: Dict[str, float]) -> None:
 
 
 def put_arrays(key: str, tree: Any, codec: Optional[str] = None,
-               delta: Optional[bool] = None) -> str:
+               delta: Optional[bool] = None,
+               store_url: Optional[str] = None) -> str:
     """Publish a pytree of arrays (params, state dicts) under ``key``.
 
     ``codec`` (None → ``KT_WIRE_CODEC`` → ``raw``) picks the wire codec:
@@ -569,12 +570,18 @@ def put_arrays(key: str, tree: Any, codec: Optional[str] = None,
     frozen-backbone update is kilobytes, not gigabytes. A store that no
     longer holds the expected base (404/409) silently degrades to a full
     publish; :func:`last_publish_stats` reports the decomposition.
+
+    ``store_url`` overrides the destination store for this one publish
+    (direct pod-to-pod push: a prefill pod PUTs an exported row at the
+    *decode* pod's store endpoint instead of its own default store).
     """
     from kubetorch_tpu.data_store.client import DataStoreClient
 
     codec = codec_mod.resolve_codec(codec)
     delta = codec_mod.delta_enabled(delta)
-    backend = DataStoreClient.default()._backend()
+    client = (DataStoreClient(store_url) if store_url
+              else DataStoreClient.default())
+    backend = client._backend()
     with tracing.span("store.put_arrays",
                       attrs={"key": key, "codec": codec,
                              "delta": bool(delta)}):

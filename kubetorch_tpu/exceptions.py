@@ -163,6 +163,26 @@ class ServerOverloaded(KubetorchError):
         self.retry_after = retry_after
 
 
+class KVGeometryMismatch(KubetorchError):
+    """An exported row's KV state names a grid geometry (block size,
+    ``max_len``, LoRA slot-axis width) that the importing engine does not
+    match. Splicing anyway would write blocks past the importer's planes
+    or bind the row to a nonexistent adapter slot — corrupt state, not a
+    recoverable shed — so the import refuses typed, naming BOTH
+    geometries and the mismatched axis. Raised by
+    ``RollingGenerator.import_row`` / ``SimRollingEngine.import_row``
+    during disaggregated handoff or park/resume across heterogeneous
+    tiers; not retryable (re-route the row to a same-geometry pod)."""
+
+    def __init__(self, message: str, axis: str = "",
+                 exported: Optional[Dict[str, int]] = None,
+                 importer: Optional[Dict[str, int]] = None):
+        super().__init__(message)
+        self.axis = axis
+        self.exported = exported or {}
+        self.importer = importer or {}
+
+
 class ReplayExpired(KubetorchError):
     """An idempotent replay named a call the server once saw but whose
     retained result has been evicted (``KT_RESULT_RETAIN`` ring) or
@@ -219,7 +239,7 @@ for _exc in (
     ImagePullError, PodContainerError, VersionMismatchError, QuorumTimeoutError,
     WorkerMembershipChanged, XlaRuntimeSurfacedError, RsyncError, DataStoreError,
     StoreUnconfigured, RemoteException, DeadlineExceeded, ServerOverloaded,
-    ReplayExpired, CircuitOpenError,
+    ReplayExpired, CircuitOpenError, KVGeometryMismatch,
 ):
     register_exception(_exc)
 

@@ -775,6 +775,12 @@ class RollingGenerator:
                 [dpos, len(req.tokens), req.max_new_tokens,
                  req.adapter_id, int(self.kv_quantized), bt],
                 np.int64),
+            # grid geometry the row was exported under — import_row on
+            # another engine refuses typed when any axis differs
+            # (cross-tier handoff must never splice into a mismatched
+            # grid): [block_tokens, max_len, lora_slots]
+            "geom": np.asarray([bt, self.max_len, self.n_adapters],
+                               np.int64),
         }
         if self.spec:
             # round-carried speculation state. The draft haystack ships
@@ -796,7 +802,42 @@ class RollingGenerator:
             state["spec_ema"] = np.asarray([st.ema], np.float32)
         return state
 
-    def import_row(self, state: Dict[str, Any]) -> int:
+    def _check_geometry(self, state: Dict[str, Any],
+                        expect_block_tokens: "int | None") -> None:
+        """Typed cross-geometry guard: an exported row names the grid
+        geometry it left (``geom`` leaf: block size, max_len, LoRA
+        slot-axis width); importing into an engine that differs on ANY
+        axis raises :class:`KVGeometryMismatch` naming both geometries
+        instead of splicing corrupt state. States without the leaf
+        (pre-geometry exports) keep the legacy shape-fit checks only."""
+        geom = state.get("geom")
+        if geom is None:
+            return
+        from kubetorch_tpu.exceptions import KVGeometryMismatch
+
+        g = [int(x) for x in np.asarray(geom).reshape(-1)]
+        exported = {"block_tokens": g[0], "max_len": g[1],
+                    "lora_slots": g[2] if len(g) > 2 else 0}
+        importer = {"block_tokens": (int(expect_block_tokens)
+                                     if expect_block_tokens else g[0]),
+                    "max_len": int(self.max_len),
+                    "lora_slots": int(self.n_adapters)}
+        for axis in ("block_tokens", "max_len", "lora_slots"):
+            if exported[axis] != importer[axis]:
+                raise KVGeometryMismatch(
+                    f"cannot import row: exported geometry "
+                    f"(block_tokens={exported['block_tokens']}, "
+                    f"max_len={exported['max_len']}, "
+                    f"lora_slots={exported['lora_slots']}) does not "
+                    f"match importing engine geometry "
+                    f"(block_tokens={importer['block_tokens']}, "
+                    f"max_len={importer['max_len']}, "
+                    f"lora_slots={importer['lora_slots']}): "
+                    f"{axis} mismatch",
+                    axis=axis, exported=exported, importer=importer)
+
+    def import_row(self, state: Dict[str, Any],
+                   block_tokens: "int | None" = None) -> int:
         """Splice an exported row into a free slot of THIS engine and
         resume decoding it — the restore half of :meth:`export_row`
         (same grid geometry required: layer/head/dim AND ``kv_dtype``
@@ -824,6 +865,7 @@ class RollingGenerator:
                 "state was exported from a speculative engine — its "
                 "next token is round-carried draft state a plain "
                 "engine cannot resume; import into a spec_k > 1 engine")
+        self._check_geometry(state, block_tokens)
         if not self._free:
             raise RuntimeError("no free row to import into")
         if set(state["kv"]) != set(self.cache):

@@ -593,6 +593,16 @@ _ENGINE: Dict[str, float] = {
     "engine_adapter_load_seconds_total": 0.0,
     "engine_adapter_evictions_total": 0.0,
     "engine_adapter_resident": 0.0,
+    # disaggregated prefill/decode (ISSUE 17): handoff traffic counters
+    # + the phase/ETA gauges the controller's phase routing reads off
+    # the fleet rollup. engine_phase pre-seeds to 2 ("mixed"): a pod
+    # whose engine never published is monolithic, not a prefill tier.
+    "handoff_exports_total": 0.0,
+    "handoff_imports_total": 0.0,
+    "handoff_bytes_total": 0.0,
+    "handoff_seconds_total": 0.0,
+    "engine_phase": 2.0,
+    "engine_row_eta_seconds": 0.0,
 }
 _ENGINE_EVENTS = {
     "generation": "engine_generations_total",
@@ -618,6 +628,10 @@ _ENGINE_EVENTS = {
     "adapter_load": "engine_adapter_loads_total",
     "adapter_load_seconds": "engine_adapter_load_seconds_total",
     "adapter_evict": "engine_adapter_evictions_total",
+    "handoff_export": "handoff_exports_total",
+    "handoff_import": "handoff_imports_total",
+    "handoff_bytes": "handoff_bytes_total",
+    "handoff_seconds": "handoff_seconds_total",
 }
 _ENGINE_GAUGES = {
     "queue_depth": "engine_queue_depth",
@@ -629,6 +643,8 @@ _ENGINE_GAUGES = {
     "spec_accept_rate": "engine_spec_accept_rate",
     "spec_k_cap": "engine_spec_k_cap",
     "adapter_resident_set": "engine_adapter_resident",
+    "phase": "engine_phase",
+    "row_eta_seconds": "engine_row_eta_seconds",
 }
 
 
@@ -639,12 +655,15 @@ def record_engine(event: str, value: float = 1.0) -> None:
     ``prefix_hit`` / ``prefix_miss`` / ``prefix_evict`` /
     ``kv_offload[_bytes]`` / ``kv_restore[_bytes]``, and the
     speculation events ``spec_rounds`` / ``spec_emitted`` /
-    ``spec_drafted`` / ``spec_verify_waste``, and the adapter-pool
+    ``spec_drafted`` / ``spec_verify_waste``, the adapter-pool
     events ``adapter_load`` / ``adapter_load_seconds`` /
-    ``adapter_evict``) or set a gauge
+    ``adapter_evict``, and the disaggregation events
+    ``handoff_export`` / ``handoff_import`` / ``handoff_bytes`` /
+    ``handoff_seconds``) or set a gauge
     (``queue_depth`` / ``active_rows`` / ``free_rows`` /
     ``prefilling_rows`` / ``kv_blocks_used`` / ``kv_blocks_free`` /
-    ``spec_accept_rate`` / ``spec_k_cap`` / ``adapter_resident_set``)."""
+    ``spec_accept_rate`` / ``spec_k_cap`` / ``adapter_resident_set`` /
+    ``phase`` / ``row_eta_seconds``)."""
     with _ENGINE_LOCK:
         counter = _ENGINE_EVENTS.get(event)
         if counter is not None:

@@ -232,6 +232,28 @@ _m("engine_spec_k_cap", "gauge",
 _m("engine_spec_k", "histogram",
    "Per-row adaptive lookahead distribution, sampled once per driver "
    "tick per live row (buckets at the k values themselves).", "engine")
+_m("handoff_exports_total", "counter",
+   "Prefilled rows exported to the decode tier (disaggregated "
+   "prefill/decode handoff; published in the background so the wire "
+   "time overlaps the next program's prefill).", "engine")
+_m("handoff_imports_total", "counter",
+   "Exported rows imported into a free row on this (decode-tier) "
+   "engine and streamed without re-prefill.", "engine")
+_m("handoff_bytes_total", "counter",
+   "Wire bytes published by handoff exports (int8 grids ship (q, "
+   "scale) raw; bf16 grids take the int8 wire codec).", "engine")
+_m("handoff_seconds_total", "counter",
+   "Summed handoff export wall time (device slice + publish) — "
+   "handoff latency over imports is the per-row handoff cost.",
+   "engine")
+_m("engine_phase", "gauge",
+   "Serving tier this engine runs as: 0 = prefill, 1 = decode, 2 = "
+   "mixed (KT_DISAGG_PHASE) — the controller's phase-routing key.",
+   "engine")
+_m("engine_row_eta_seconds", "gauge",
+   "Earliest expected row-free time (0 with a free row; else queue "
+   "depth x the row-free EMA, repriced by live speculation state) — "
+   "the decode-tier routing currency.", "engine")
 
 # --- multi-tenant LoRA adapter pool (this PR) -------------------------------
 _m("engine_adapter_loads_total", "counter",

@@ -63,8 +63,15 @@ CONTROLLER_KILL = "controller-kill"
 # telemetry backlog, and the controller's idempotent re-registration.
 # Injected in the pod's heartbeat notify path.
 WS_FLAP = "ws-flap"
+# handoff-drop: a decode pod dies mid-handoff (ISSUE 17) — the prefill
+# pod's exported row never imports on the paired pod. Injected in the
+# decode-side handoff await (DecodeEngine._await_handoff), keyed by
+# handoff id: the first paired pod raises typed-retryable, and the
+# caller re-routes the import to another decode pod (the blob is still
+# in the store) or falls back to monolithic same-pod decode.
+HANDOFF_DROP = "handoff-drop"
 KINDS = (KILL_WORKER, DROP_CONNECTION, INJECT_LATENCY, CORRUPT_HEARTBEAT,
-         PARTITION, SLOW_POD, CONTROLLER_KILL, WS_FLAP)
+         PARTITION, SLOW_POD, CONTROLLER_KILL, WS_FLAP, HANDOFF_DROP)
 
 
 class ChaosPolicy:
@@ -81,7 +88,8 @@ class ChaosPolicy:
                  drop_connection: float = 0.0, inject_latency: float = 0.0,
                  corrupt_heartbeat: float = 0.0, partition: float = 0.0,
                  slow_pod: float = 0.0, controller_kill: float = 0.0,
-                 ws_flap: float = 0.0, latency_s: float = 0.05,
+                 ws_flap: float = 0.0, handoff_drop: float = 0.0,
+                 latency_s: float = 0.05,
                  max_events: Optional[int] = None):
         self.seed = int(seed)
         self.rates: Dict[str, float] = {
@@ -93,6 +101,7 @@ class ChaosPolicy:
             SLOW_POD: float(slow_pod),
             CONTROLLER_KILL: float(controller_kill),
             WS_FLAP: float(ws_flap),
+            HANDOFF_DROP: float(handoff_drop),
         }
         self.latency_s = float(latency_s)
         self.max_events = max_events

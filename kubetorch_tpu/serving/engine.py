@@ -94,7 +94,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from kubetorch_tpu.config import env_float, env_int
+from kubetorch_tpu.config import env_float, env_int, env_str
 from kubetorch_tpu.exceptions import DeadlineExceeded, ServerOverloaded
 from kubetorch_tpu.lookahead import LookaheadState, spec_stats_dict
 from kubetorch_tpu.observability import tracing
@@ -142,6 +142,10 @@ def _decode_adapter_name(leaf) -> str:
 # buckets are the interesting k values themselves
 _SPEC_K_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0)
 
+# engine_phase gauge encoding (fleet-mergeable: the controller routes
+# on the by-pod values, so the mapping is part of the wire contract)
+_PHASE_CODE = {"prefill": 0, "decode": 1, "mixed": 2}
+
 
 class GenerationProgram:
     """Validated form of the JSON generation program a client submits.
@@ -178,7 +182,9 @@ class GenerationProgram:
                  adapter_id: int, prefix_id: Optional[int],
                  deadline_s: Optional[float], tag: Optional[str],
                  session_id: Optional[str] = None,
-                 adapter: Optional[str] = None):
+                 adapter: Optional[str] = None,
+                 handoff: Optional[Dict[str, Any]] = None,
+                 handoff_id: Optional[str] = None):
         self.prompts = prompts
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
@@ -190,6 +196,15 @@ class GenerationProgram:
         self.deadline_s = deadline_s
         self.tag = tag
         self.session_id = session_id
+        # disaggregated prefill/decode (ISSUE 17): ``handoff`` (prefill
+        # side) = {"id": ..., "store_url": optional} — prefill the row,
+        # export it under the handoff id (direct-push at store_url when
+        # given) and END the stream with a handoff frame, zero tokens
+        # emitted locally. ``handoff_id`` (decode side) = import the
+        # exported row and stream its tokens; the prompt travels too so
+        # a lost handoff can fall back to monolithic same-pod decode.
+        self.handoff = handoff
+        self.handoff_id = handoff_id
 
     @classmethod
     def from_wire(cls, obj: Any) -> "GenerationProgram":
@@ -230,6 +245,35 @@ class GenerationProgram:
                 raise ValueError(
                     "pass adapter= (pool-managed name) or adapter_id= "
                     "(raw slot), not both")
+        handoff = obj.get("handoff")
+        handoff_id = obj.get("handoff_id")
+        if handoff is not None and handoff_id is not None:
+            raise ValueError(
+                "pass handoff= (prefill side: export the row) or "
+                "handoff_id= (decode side: import it), not both")
+        if (handoff is not None or handoff_id is not None):
+            if session_id is not None:
+                # a handoff row's lifecycle is one-shot relay, not a
+                # parkable conversation — the two id namespaces must
+                # not alias
+                raise ValueError(
+                    "handoff programs cannot also carry session_id")
+            if len(prompts) != 1:
+                raise ValueError(
+                    "handoff programs must carry exactly one prompt "
+                    "(one exported row per handoff id)")
+        if handoff is not None:
+            if not isinstance(handoff, dict) or "id" not in handoff:
+                raise ValueError(
+                    "handoff must be a dict with at least {'id': ...}")
+            kvpool.check_handoff_id(handoff["id"])
+            url = handoff.get("store_url")
+            if url is not None and (not isinstance(url, str) or not url):
+                raise ValueError(
+                    "handoff['store_url'] must be a non-empty string "
+                    "(the decode pod's store endpoint)")
+        if handoff_id is not None:
+            kvpool.check_handoff_id(handoff_id)
         return cls(
             prompts=prompts,
             max_new_tokens=int(obj.get("max_new_tokens", 128)),
@@ -241,7 +285,9 @@ class GenerationProgram:
             deadline_s=deadline_s,
             tag=obj.get("tag"),
             session_id=session_id,
-            adapter=adapter)
+            adapter=adapter,
+            handoff=handoff,
+            handoff_id=handoff_id)
 
     def submit_kwargs(self) -> Dict[str, Any]:
         return {"max_new_tokens": self.max_new_tokens,
@@ -259,7 +305,9 @@ def program(prompt: Optional[List[int]] = None, *,
             prefix_id: Optional[int] = None,
             session_id: Optional[str] = None,
             deadline_s: Optional[float] = None,
-            tag: Optional[str] = None) -> Dict[str, Any]:
+            tag: Optional[str] = None,
+            handoff: Optional[Dict[str, Any]] = None,
+            handoff_id: Optional[str] = None) -> Dict[str, Any]:
     """Client-side builder for the ``generate`` wire dict — the API that
     actually SETS ``prefix_id`` / ``session_id`` (the wire fields
     existed; nothing on the client wrote them)::
@@ -292,6 +340,10 @@ def program(prompt: Optional[List[int]] = None, *,
         obj["deadline_s"] = float(deadline_s)
     if tag is not None:
         obj["tag"] = str(tag)
+    if handoff is not None:
+        obj["handoff"] = dict(handoff)
+    if handoff_id is not None:
+        obj["handoff_id"] = str(handoff_id)
     GenerationProgram.from_wire(obj)
     return obj
 
@@ -336,8 +388,23 @@ class DecodeEngine:
                  kv_budget_blocks: Optional[int] = None,
                  prefix_split: Optional[str] = None,
                  spec_throttle: Optional[float] = None,
-                 adapter_pool=None):
+                 adapter_pool=None,
+                 phase: Optional[str] = None):
         self.engine = engine
+        # disaggregated serving tier (ISSUE 17): "prefill" pods run
+        # admit/prefill only and EXPORT every row (programs must carry
+        # handoff=); "decode" pods import exported rows and stream —
+        # but still run suffix prefills, so prefix-cache hits stay
+        # tier-local; "mixed" (default) is the monolithic engine.
+        phase = (phase if phase is not None
+                 else (env_str("KT_DISAGG_PHASE") or "mixed"))
+        if phase not in _PHASE_CODE:
+            raise ValueError(
+                f"phase must be one of {sorted(_PHASE_CODE)}, "
+                f"got {phase!r} (KT_DISAGG_PHASE)")
+        self._phase = phase
+        self._handoffs = 0          # rows exported to the decode tier
+        self._handoff_imports = 0   # rows imported from the prefill tier
         # Named-adapter residency (serving/adapterpool.py): programs
         # carry a stable adapter NAME, resolved to a device slot at
         # admission; cold adapters fetch in the background and install
@@ -454,6 +521,9 @@ class DecodeEngine:
         self._parks = 0
         self._restores = 0
         self._stop = False
+        # the phase gauge must be visible BEFORE any traffic: the
+        # controller's phase routing reads it to classify an idle tier
+        self._publish_gauges()
         # copy_context: driver-thread spans/log lines keep the ids of
         # whatever context built the engine
         self._driver = threading.Thread(
@@ -484,12 +554,30 @@ class DecodeEngine:
         streaming path into a free row and resumes mid-generation —
         its ``prompt`` is ignored (the parked state is the program)."""
         prog = GenerationProgram.from_wire(program)
+        if self._phase == "prefill" and prog.handoff is None:
+            raise ValueError(
+                "this engine is a prefill-tier pod "
+                "(KT_DISAGG_PHASE=prefill): programs must carry "
+                "handoff= — decode runs on the decode tier")
         sink: "_queue.SimpleQueue" = _queue.SimpleQueue()
         # exemplar context for the TTFT histogram: the submit runs
         # under the call's ambient span; first token lands in the
         # driver thread where no ambient context exists
         submit_trace = tracing.current_trace_id()
         restored = None
+        handoff_state = None
+        if (prog.handoff_id is not None
+                and hasattr(self.engine, "import_row")):
+            # store fetch OUTSIDE the scheduler lock (same reasoning as
+            # the session restore): poll until the prefill pod's export
+            # lands or KT_HANDOFF_TIMEOUT_S passes — a timeout falls
+            # back to monolithic same-pod decode (the program still
+            # carries its prompt, so nothing is lost but the recompute)
+            handoff_state = self._await_handoff(prog.handoff_id)
+            if handoff_state is None:
+                tracing.record_span(
+                    "kv.handoff_fallback", 0.0,
+                    attrs={"handoff": prog.handoff_id})
         if prog.session_id is not None:
             with self._wake:
                 self._check_session_free_locked(prog.session_id)
@@ -518,6 +606,19 @@ class DecodeEngine:
                 self._restores += 1
                 # the blob is still in the store: completion must drop it
                 self._note_parked_locked(prog.session_id)
+            elif handoff_state is not None:
+                rid = self._restore_locked(prog, handoff_state,
+                                           handoff=True)
+                rids.append(rid)
+                self._sinks[rid] = sink
+                self._submit_t[rid] = now
+                self._submit_trace[rid] = submit_trace
+                if deadline is not None:
+                    self._deadlines[rid] = deadline
+                self._handoff_imports += 1
+                # the blob is a one-shot relay buffer — spliced in, it
+                # is garbage (and would shadow a reused id)
+                self._drop_handoff_async(prog.handoff_id)
             else:
                 if prog.session_id is not None:
                     # re-check under THIS lock hold: a racing retry may
@@ -603,7 +704,10 @@ class DecodeEngine:
                         self._rid_meta[rid] = {
                             "blocks": blocks,
                             "session": prog.session_id,
-                            "adapter": prog.adapter}
+                            "adapter": prog.adapter,
+                            "handoff": (dict(prog.handoff)
+                                        if prog.handoff is not None
+                                        else None)}
                         if prog.adapter is not None:
                             # one pool ref per live row: a pinned
                             # adapter is never LRU-evicted out from
@@ -661,6 +765,20 @@ class DecodeEngine:
                     frame = {"i": index_of[rid], "rid": rid, "seq": seq,
                              "tokens": [], "done": False, "parked": True,
                              "session_id": prog.session_id}
+                    seq += 1
+                    yield frame
+                    continue
+                if isinstance(payload, dict):
+                    # the row was HANDED OFF to the decode tier: its
+                    # exported state is durable at the paired pod (the
+                    # sentinel arrives only after the publish landed —
+                    # the park discipline); the prefill-side stream ends
+                    # with a handoff frame, zero tokens emitted locally
+                    live.discard(rid)
+                    frame = {"i": index_of[rid], "rid": rid, "seq": seq,
+                             "tokens": [], "done": False,
+                             "handoff": True,
+                             "handoff_id": payload["handoff"]}
                     seq += 1
                     yield frame
                     continue
@@ -774,6 +892,12 @@ class DecodeEngine:
             if self._prefill_naive else 0.0,
             "parks": self._parks,
             "restores": self._restores,
+            # disaggregated tier identity + handoff traffic + the
+            # controller's routing currency
+            "phase": self._phase,
+            "handoff_exports": self._handoffs,
+            "handoff_imports": self._handoff_imports,
+            "row_eta_s": round(self._row_eta_locked(), 4),
             **self._kv.stats(),
             # one source of truth for the offload/restore counts (the
             # pool carries no counters of its own)
@@ -1139,18 +1263,23 @@ class DecodeEngine:
         return pid, True
 
     def _restore_locked(self, prog: GenerationProgram,
-                        state: Dict[str, Any]) -> int:
-        """Splice a parked session's fetched state into a free row. No
-        free row / no block headroom → typed ``ServerOverloaded`` (the
-        parked blob stays put; the client retries after ``retry_after``)
-        — a restore must never evict a LIVE row to make room.
+                        state: Dict[str, Any],
+                        handoff: bool = False) -> int:
+        """Splice a parked session's (or, with ``handoff=True``, an
+        exported handoff row's) fetched state into a free row. No free
+        row / no block headroom → typed ``ServerOverloaded`` (the blob
+        stays put; the client retries after ``retry_after``) — a
+        restore must never evict a LIVE row to make room.
 
-        A state blob parked under a NAMED adapter carries the name
+        A state blob exported under a NAMED adapter carries the name
         binding (``adapter_name`` leaf): the adapter must be resident
         before the import — a miss kicks the pool load and sheds typed
-        (blob stays parked; the retry converges once the load lands) —
+        (blob stays put; the retry converges once the load lands) —
         and the exported slot int is REWRITTEN to the adapter's current
-        slot, which may differ from the one it parked under."""
+        slot, which may differ from the one it was exported under
+        (cross-pod, the slots are unrelated by construction)."""
+        what = (f"handoff {prog.handoff_id}" if handoff
+                else f"session {prog.session_id}")
         binding = state.pop("adapter_name", None)
         name = (_decode_adapter_name(binding) if binding is not None
                 else None)
@@ -1158,9 +1287,10 @@ class DecodeEngine:
             name = prog.adapter
         elif prog.adapter is not None and prog.adapter != name:
             raise ValueError(
-                f"session {prog.session_id} parked under adapter "
-                f"{name!r}; the resume names {prog.adapter!r} — a "
-                f"session's adapter binding is fixed at park")
+                f"{what} was exported under adapter {name!r}; the "
+                f"resume names {prog.adapter!r} — a row's adapter "
+                f"binding is fixed at "
+                f"{'export' if handoff else 'park'}")
         slot = None
         if name is not None:
             slot = self._resolve_adapter_name_locked(name)
@@ -1178,7 +1308,7 @@ class DecodeEngine:
             # structural: no amount of waiting frees enough blocks — a
             # retryable shed here would loop forever
             raise ValueError(
-                f"restored session {prog.session_id} needs {need} KV "
+                f"restored {what} needs {need} KV "
                 f"blocks — more than the whole {self._kv.ledger.budget}-"
                 f"block budget (KT_KV_HBM_BUDGET)")
         max_delay = env_float("KT_MAX_QUEUE_DELAY_S")
@@ -1192,11 +1322,15 @@ class DecodeEngine:
             if name is not None:
                 _record_adapter(name, "shed")
             raise ServerOverloaded(
-                f"no free row/blocks to restore session "
-                f"{prog.session_id} into ({need} blocks needed)",
+                f"no free row/blocks to restore "
+                f"{what} into ({need} blocks needed)",
                 retry_after=retry_after)
-        self._check_session_free_locked(prog.session_id)
-        rid = self.engine.import_row(state)
+        if not handoff:
+            self._check_session_free_locked(prog.session_id)
+        # block_tokens travels so the engine's geometry guard can refuse
+        # typed on a block-size mismatch (cross-tier heterogeneity)
+        rid = self.engine.import_row(
+            state, block_tokens=self._kv.block_tokens)
         blocks = self._kv.reserve_row(
             rid, min(ctx + (max_new - emitted), self._row_cap_tokens))
         self._rid_meta[rid] = {"blocks": blocks,
@@ -1204,8 +1338,9 @@ class DecodeEngine:
                                "adapter": name}
         if name is not None:
             self._adapter_pool.acquire(name)
-        self._live_sessions.add(prog.session_id)
-        self._bump_session_seq_locked(prog.session_id)
+        if not handoff:
+            self._live_sessions.add(prog.session_id)
+            self._bump_session_seq_locked(prog.session_id)
         return rid
 
     def _shed_check_locked(self, prog: GenerationProgram,
@@ -1412,9 +1547,14 @@ class DecodeEngine:
             tracing.record_span(
                 "engine.prefill", time.perf_counter() - t0,
                 attrs={"rows": eng.prefilling_rows})
+        # ---- handoff exports (disaggregated prefill tier) ------------
+        # BEFORE the decode step: a handoff row must ship with zero
+        # locally-emitted tokens, and the export-publish runs in the
+        # background so row N's wire time overlaps row N+1's prefill
+        self._handoff_scan_locked()
         # ---- one decode chunk ----------------------------------------
         t0 = time.perf_counter()
-        events = eng.decode_step()
+        events = eng.decode_step() if self._phase != "prefill" else []
         dt = time.perf_counter() - t0
         if events:
             self._steps += 1
@@ -1628,6 +1768,127 @@ class DecodeEngine:
             target=contextvars.copy_context().run, args=(_drop,),
             name="kt-kv-drop", daemon=True).start()
 
+    def _handoff_scan_locked(self) -> None:
+        """Export every decode-active row that carries a handoff
+        binding: slice its state off the device, evict the row, and
+        publish in the BACKGROUND (one short-lived thread per export —
+        the driver tick must not block on wire time, and the next
+        program's prefill runs while the publish is in flight: that
+        overlap is the pipelining the bench asserts). The stream's
+        handoff sentinel is delivered only after the publish lands —
+        the same durable-then-sentinel discipline as park()."""
+        if not hasattr(self.engine, "export_row"):
+            return
+        for rid, meta in list(self._rid_meta.items()):
+            ho = meta.get("handoff")
+            if not ho:
+                continue
+            try:
+                state = self.engine.export_row(
+                    rid, block_tokens=self._kv.block_tokens)
+            except (KeyError, ValueError):
+                continue          # queued / mid-prefill — next tick
+            if meta.get("adapter") is not None:
+                # the blob carries the NAME (cross-pod, slot ints are
+                # unrelated; the decode pod re-resolves and rewrites)
+                state = dict(state)
+                state["adapter_name"] = _encode_adapter_name(
+                    meta["adapter"])
+            self.engine.evict(rid)
+            sink = self._sinks.get(rid)
+            self._release_locked(rid)
+            self._handoff_async(rid, dict(ho), state, sink)
+
+    def _handoff_async(self, rid: int, ho: Dict[str, Any],
+                       state: Dict[str, Any], sink) -> None:
+        quantized = bool(getattr(self.engine, "kv_quantized", False))
+
+        def _push():
+            try:
+                kvpool.offload_handoff(ho["id"], state, quantized,
+                                       store_url=ho.get("store_url"))
+            # ktlint: disable=KT004 -- reported to the stream; the row is
+            # gone either way and the client must not wait on a decode
+            # pod that will never see the blob
+            except Exception as exc:  # noqa: BLE001
+                _record_engine("tick_error")
+                if sink is not None:
+                    sink.put((rid, RuntimeError(
+                        f"handoff {ho['id']} failed to publish: {exc}")))
+                return
+            with self._wake:
+                self._handoffs += 1
+            if sink is not None:
+                # sentinel only AFTER the blob is durable at the decode
+                # pod: when the client sees {'handoff': True}, the
+                # import cannot lose state
+                sink.put((rid, {"handoff": ho["id"]}))
+
+        threading.Thread(
+            target=contextvars.copy_context().run, args=(_push,),
+            name="kt-kv-handoff", daemon=True).start()
+
+    def _await_handoff(self, handoff_id: str) -> Optional[Dict[str, Any]]:
+        """Decode-side poll for the prefill pod's export. The chaos
+        hook (``KT_CHAOS=handoff-drop``) simulates THIS pod dying
+        mid-handoff: a typed retryable raise the caller re-routes (the
+        exported blob is still in the store — another decode pod, or
+        the monolithic fallback, picks it up)."""
+        from kubetorch_tpu.resilience import chaos
+
+        if chaos.maybe(chaos.HANDOFF_DROP, handoff_id):
+            _record_engine("shed")
+            raise ServerOverloaded(
+                f"decode pod dropped mid-handoff of {handoff_id} "
+                f"(chaos) — re-route the import",
+                retry_after=0.0)
+        timeout = env_float("KT_HANDOFF_TIMEOUT_S")
+        poll = max(0.0005, env_float("KT_HANDOFF_POLL_S"))
+        deadline = time.perf_counter() + max(0.0, timeout)
+        while True:
+            state = kvpool.restore_handoff(handoff_id)
+            if state is not None:
+                return state
+            if time.perf_counter() >= deadline:
+                return None
+            time.sleep(poll)
+
+    def _drop_handoff_async(self, handoff_id: str) -> None:
+        """Invalidate an imported handoff blob (store I/O off the
+        serving path; best-effort — a failed delete only costs store
+        rent until the key is reused or GC'd)."""
+
+        def _drop():
+            try:
+                kvpool.drop_handoff(handoff_id)
+            # ktlint: disable=KT004 -- best-effort invalidation
+            except Exception:  # noqa: BLE001
+                pass
+
+        threading.Thread(
+            target=contextvars.copy_context().run, args=(_drop,),
+            name="kt-kv-drop", daemon=True).start()
+
+    def _row_eta_locked(self) -> float:
+        """Earliest expected row-free time, the decode-tier routing
+        currency (gauged as ``engine_row_eta_seconds``): 0 with a free
+        row, else queue depth against the row-free EMA, repriced by the
+        live batch's speculation state exactly as the shed check prices
+        admission — a decode pod whose drafts are landing frees rows
+        faster than its raw EMA says."""
+        eng = self.engine
+        if eng.free_rows > 0:
+            return 0.0
+        eta = (int(eng.queued) + 1) * max(0.01, self._ema_row_s)
+        if getattr(eng, "spec", False):
+            ss = eng.spec_stats
+            k_mean = max(1.0, float(ss.get("k_mean") or 1.0))
+            recent = (self._spec_tpp_ema
+                      if self._spec_tpp_ema is not None
+                      else float(ss.get("tokens_per_pass") or 1.0))
+            eta *= k_mean / min(k_mean, max(1.0, recent))
+        return eta
+
     def _publish_gauges(self) -> None:
         eng = self.engine
         _record_engine("queue_depth", float(eng.queued))
@@ -1637,6 +1898,8 @@ class DecodeEngine:
         _record_engine("kv_blocks_used", float(self._kv.used_blocks))
         if self._kv.ledger.budget:
             _record_engine("kv_blocks_free", float(self._kv.free_blocks))
+        _record_engine("phase", float(_PHASE_CODE[self._phase]))
+        _record_engine("row_eta_seconds", self._row_eta_locked())
 
 
 class SimRollingEngine:
@@ -1923,6 +2186,10 @@ class SimRollingEngine:
             "prompt": np.asarray(req["prompt"], np.int64),
             "scalars": np.asarray(
                 [ctx, req["emitted"], req["n"]], np.int64),
+            # the real engine's geometry leaf (import refuses typed on
+            # any axis mismatch): [block_tokens, max_len, lora_slots]
+            "geom": np.asarray([bt, self.max_len, self.adapter_slots],
+                               np.int64),
         }
         if self.spec:
             # the sim's "draft context" is the lookahead/EMA pair — the
@@ -1934,9 +2201,34 @@ class SimRollingEngine:
             state["spec_ema"] = np.asarray([st.ema], np.float32)
         return state
 
-    def import_row(self, state: dict) -> int:
+    def import_row(self, state: dict,
+                   block_tokens: Optional[int] = None) -> int:
         import numpy as np
 
+        geom = state.get("geom")
+        if geom is not None:
+            from kubetorch_tpu.exceptions import KVGeometryMismatch
+
+            g = [int(x) for x in np.asarray(geom).reshape(-1)]
+            exported = {"block_tokens": g[0], "max_len": g[1],
+                        "lora_slots": g[2] if len(g) > 2 else 0}
+            importer = {"block_tokens": (int(block_tokens)
+                                         if block_tokens else g[0]),
+                        "max_len": int(self.max_len),
+                        "lora_slots": int(self.adapter_slots)}
+            for axis in ("block_tokens", "max_len", "lora_slots"):
+                if exported[axis] != importer[axis]:
+                    raise KVGeometryMismatch(
+                        f"cannot import row: exported geometry "
+                        f"(block_tokens={exported['block_tokens']}, "
+                        f"max_len={exported['max_len']}, "
+                        f"lora_slots={exported['lora_slots']}) does "
+                        f"not match importing engine geometry "
+                        f"(block_tokens={importer['block_tokens']}, "
+                        f"max_len={importer['max_len']}, "
+                        f"lora_slots={importer['lora_slots']}): "
+                        f"{axis} mismatch",
+                        axis=axis, exported=exported, importer=importer)
         if not self._free:
             raise RuntimeError("no free row to import into")
         scalars = [int(x) for x in np.asarray(state["scalars"])]

@@ -509,6 +509,123 @@ def drop_session(session_id: str) -> bool:
     return dropped
 
 
+# ------------------------------------------------- disaggregated handoff
+def check_handoff_id(handoff_id: str) -> str:
+    """Handoff ids become store keys — same key hygiene as session ids."""
+    if not isinstance(handoff_id, str) or not _SAFE_SESSION.fullmatch(
+            handoff_id):
+        raise ValueError(
+            f"handoff_id {handoff_id!r} must match "
+            f"[A-Za-z0-9][A-Za-z0-9._-]{{0,127}}")
+    return handoff_id
+
+
+def handoff_key(handoff_id: str) -> str:
+    prefix = (env_str("KT_HANDOFF_PREFIX") or "kv/handoffs").strip("/")
+    return f"{prefix}/{check_handoff_id(handoff_id)}"
+
+
+def handoff_codec(quantized: bool) -> str:
+    """Codec for a prefill→decode handoff. Unlike park/resume (same grid
+    both sides, exactness default), handoff is a hot-path transfer whose
+    latency must hide under a few decode chunks, so ``auto`` branches on
+    the grid: an int8 KV grid's export is already ``(q, scale)`` pairs —
+    ship raw for a BIT-EXACT handoff at half size — while a bf16/f32
+    grid takes the int8 wire codec (~2-4x fewer bytes; its KV planes are
+    re-derivable activations, not weights). ``KT_HANDOFF_CODEC=raw``
+    opts a bf16 grid back into exactness at full wire size."""
+    codec = (env_str("KT_HANDOFF_CODEC") or "auto").strip().lower()
+    if codec == "auto":
+        return "raw" if quantized else "int8"
+    return codec
+
+
+def offload_handoff(handoff_id: str, state: Dict[str, Any],
+                    quantized: bool = False,
+                    store_url: Optional[str] = None) -> str:
+    """Ship one prefilled row to the decode tier: publish its exported
+    state tree under the handoff key (+ JSON schema sidecar, arrays
+    first so a visible schema implies its arrays landed). ``store_url``
+    is the direct pod-to-pod path — the prefill pod PUTs straight at the
+    decode pod's store endpoint so the row never detours through the
+    central store. ``delta=False`` always: a handoff is one-shot (no
+    prior version to delta against) and the manifest bookkeeping would
+    leak keys that are dropped seconds later."""
+    import json
+
+    from kubetorch_tpu.data_store.client import DataStoreClient
+    from kubetorch_tpu.data_store.device_transfer import put_arrays
+
+    key = handoff_key(handoff_id)
+    ctx, emitted, _ = state_summary(state)
+    t0 = time.perf_counter()
+    with tracing.span("kv.handoff_export",
+                      attrs={"handoff": handoff_id, "ctx_tokens": ctx,
+                             "emitted": emitted}):
+        put_arrays(key, state, codec=handoff_codec(quantized),
+                   delta=False, store_url=store_url)
+        client = (DataStoreClient(store_url) if store_url
+                  else DataStoreClient.default())
+        client._backend().put_blob(
+            f"{key}.schema", json.dumps(_schema_of(state)).encode())
+    _record("handoff_export")
+    try:
+        from kubetorch_tpu.data_store.device_transfer import (
+            last_publish_stats,
+        )
+
+        _record("handoff_bytes",
+                float(last_publish_stats().get("wire_bytes", 0)))
+    # ktlint: disable=KT004 -- byte accounting is best-effort
+    except Exception:  # noqa: BLE001
+        pass
+    _record("handoff_seconds", time.perf_counter() - t0)
+    tracing.record_span("kv.handoff_wall", time.perf_counter() - t0,
+                        attrs={"handoff": handoff_id})
+    return key
+
+
+def restore_handoff(handoff_id: str) -> Optional[Dict[str, Any]]:
+    """Fetch an exported row on the decode side. → None while the
+    export is still in flight (or was dropped) — the poller retries
+    until ``KT_HANDOFF_TIMEOUT_S``, then falls back to a monolithic
+    same-pod prefill."""
+    import json
+
+    from kubetorch_tpu.data_store.client import DataStoreClient
+    from kubetorch_tpu.data_store.device_transfer import get_arrays
+    from kubetorch_tpu.exceptions import DataStoreError
+
+    key = handoff_key(handoff_id)
+    with tracing.span("kv.handoff_import", attrs={"handoff": handoff_id}):
+        try:
+            template = json.loads(DataStoreClient.default()._backend()
+                                  .get_blob(f"{key}.schema"))
+            state = get_arrays(key, template=template, streaming=None)
+        except (DataStoreError, ValueError, OSError):
+            # export not landed yet, or dropped — caller polls/falls back
+            return None
+    _record("handoff_import")
+    return state
+
+
+def drop_handoff(handoff_id: str) -> bool:
+    """Delete an imported handoff blob + schema — run as soon as the
+    decode pod has spliced the row in (the blob is a one-shot relay
+    buffer, not durable state; a stale one would shadow a reused id)."""
+    from kubetorch_tpu.data_store.client import DataStoreClient
+    from kubetorch_tpu.exceptions import DataStoreError
+
+    key = handoff_key(handoff_id)
+    dropped = False
+    for k in (key, f"{key}.schema"):
+        try:
+            dropped = bool(DataStoreClient.default().delete(k)) or dropped
+        except DataStoreError:
+            pass
+    return dropped
+
+
 def _tree_leaves(tree: Any):
     if isinstance(tree, dict):
         for v in tree.values():

@@ -330,6 +330,75 @@ def test_park_evict_adapter_resume_byte_identical(local_store):
         eng.close()
 
 
+def test_cross_pod_handoff_adapter_binding(local_store):
+    """ISSUE 17 satellite: an ``adapter=name`` program prefilled on the
+    prefill tier hands its row off WITH the name binding — the decode
+    pod must trigger/await that adapter's residency before the import
+    (typed shed + background load, never a blocking fetch), resolve
+    the slot exactly once at the splice (no mid-decode slot rewrite),
+    refuse a resume under any other name, and stream byte-identical
+    with no re-prefill."""
+    prompt = [2, 7, 1, 8]
+    n = 24
+    hid = "h-lora-xpod"
+    expected = SimRollingEngine.expected_tokens(prompt, n)
+    # prefill pod — cold too: the first submit sheds until tenant-a
+    # residency lands, then prefills and exports under the name
+    sim_pf = SimRollingEngine(max_slots=2, steps_per_call=4,
+                              step_s=0.001, adapter_slots=2)
+    pool_pf = AdapterPool(2, lambda name: {"adapter": name},
+                          sim_pf.load_adapter_slot,
+                          load_ema_alpha=0.5, load_seed_s=0.1)
+    pf = DecodeEngine(sim_pf, poll_s=0.002, adapter_pool=pool_pf,
+                      phase="prefill")
+    # decode pod — same geometry (adapter_slots is the lora_slots
+    # geometry axis) but cold for tenant-a; device slot writes are
+    # counted so "no mid-decode rewrite" is assertable
+    sim_dc = SimRollingEngine(max_slots=2, steps_per_call=4,
+                              step_s=0.001, adapter_slots=2)
+    writes: list = []
+
+    def counted_write(slot, tree):
+        writes.append(int(slot))
+        sim_dc.load_adapter_slot(slot, tree)
+
+    pool_dc = AdapterPool(2, lambda name: {"adapter": name},
+                          counted_write,
+                          load_ema_alpha=0.5, load_seed_s=0.1)
+    dc = DecodeEngine(sim_dc, poll_s=0.002, adapter_pool=pool_dc,
+                      phase="decode")
+    try:
+        frames = _until_resident(lambda: list(pf.generate(
+            {"prompt": prompt, "max_new_tokens": n,
+             "adapter": "tenant-a", "handoff": {"id": hid}})))
+        assert frames[-1]["handoff_id"] == hid
+        assert all(f["tokens"] == [] for f in frames)
+        assert sim_pf.prefill_tokens == len(prompt)
+        # resume under the WRONG name refuses — the binding rode the
+        # blob (and the refusal leaves the blob importable)
+        prog_dc = {"prompt": prompt, "max_new_tokens": n,
+                   "handoff_id": hid, "adapter": "tenant-a"}
+        with pytest.raises(ValueError, match="fixed at export"):
+            list(dc.generate({**prog_dc, "adapter": "tenant-b"}))
+        # cold decode pod: the import sheds typed UNTIL residency —
+        # the splice must never run ahead of the adapter
+        with pytest.raises(ServerOverloaded) as err:
+            list(dc.generate(prog_dc))
+        assert err.value.retry_after and err.value.retry_after > 0
+        assert not writes or pool_dc.resident()  # load in flight
+        frames = _until_resident(lambda: list(dc.generate(prog_dc)))
+        toks = [t for f in frames for t in f["tokens"]]
+        assert toks == expected
+        assert sim_dc.prefill_tokens == 0, "decode pod re-ran prefill"
+        # residency was installed ONCE, before the import, and the
+        # slot never rewrote mid-decode
+        assert writes == [pool_dc.resident()["tenant-a"]]
+        assert dc.stats()["handoff_imports"] == 1
+    finally:
+        pf.close()
+        dc.close()
+
+
 def test_adapter_pin_survives_lru_pressure():
     """A decoding row pins its adapter: staged loads must WAIT rather
     than evict it mid-stream, and the pin releases with the row."""

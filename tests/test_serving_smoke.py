@@ -90,6 +90,22 @@ EXPECTED_KEYS = {
     "lora_select_cost_1_slot",
     "lora_select_cost_8_slots",
     "lora_select_overhead_pct",
+    # disaggregated prefill/decode (ISSUE 17): equal-chip paired
+    # virtual-time overload — tier split + block-granular KV handoff
+    # versus the monolithic mixed fleet
+    "disagg_programs",
+    "disagg_handoff_chunks",
+    "disagg_handoff_bytes_p50",
+    "disagg_handoff_overlap_ratio",
+    "disagg_ttft_p99_ms",
+    "disagg_ttft_p99_ms_mono",
+    "disagg_ttft_p99_ms_vs_monolithic",
+    "disagg_tok_s",
+    "disagg_tok_s_mono",
+    "disagg_goodput_tok_s",
+    "disagg_goodput_tok_s_mono",
+    "disagg_goodput_ratio",
+    "disagg_decode_mbu_proxy",
     # fleet telemetry plane (ISSUE 13): what the heartbeat piggyback
     # costs and what one SLO evaluation sweep costs
     "telemetry_frames",
@@ -196,6 +212,23 @@ def test_serving_dryrun_metric_keys():
     assert out["lora_select_overhead_pct"] < bound, (
         out["lora_select_overhead_pct"], out["lora_select_cost_unit"])
     assert out["lora_tok_s_single"] > 0
+    # disaggregated prefill/decode (ISSUE 17 acceptance): at equal chip
+    # count the specialized fleet wins BOTH tails — SLO goodput (the
+    # monolithic fleet's interleaved prefill inflates inter-token gaps
+    # and slot hold times) AND TTFT p99 — with the KV handoff under 3
+    # decode chunks of wire latency and genuinely overlapped with the
+    # prefill pod's next rows. Virtual-time phase: deterministic, so
+    # the floors carry only modest headroom below the measured point.
+    assert out["disagg_goodput_ratio"] >= 2.0, out["disagg_goodput_ratio"]
+    assert out["disagg_ttft_p99_ms_vs_monolithic"] <= 0.8, (
+        out["disagg_ttft_p99_ms_vs_monolithic"])
+    assert out["disagg_handoff_chunks"] <= 3.0, out["disagg_handoff_chunks"]
+    assert out["disagg_handoff_overlap_ratio"] >= 0.5, (
+        out["disagg_handoff_overlap_ratio"])
+    assert out["disagg_decode_mbu_proxy"] >= 0.3, (
+        out["disagg_decode_mbu_proxy"])
+    assert out["disagg_handoff_bytes_p50"] > 0
+    assert out["disagg_tok_s"] > 0 and out["disagg_tok_s_mono"] > 0
     # fleet telemetry plane: the heartbeat piggyback (frame build +
     # controller ingest) must stay under 3% of a heartbeat tick, and an
     # SLO evaluation sweep must be cheap enough for the resilience
