@@ -528,6 +528,164 @@ def bench_codec(store: "_Store", total_mb: float = 64.0,
     return out
 
 
+def bench_collectives(store: "_Store", steps: int = 20,
+                      n_grad_elems: int = 1 << 22,
+                      reps: int = REPS) -> Dict[str, float]:
+    """The PR-18 train-plane wire diet, measured end to end: the int8
+    dcn ring's bytes-on-wire reduction vs the f32 schedule (floor >= 2x,
+    smoke-asserted), the f32-vs-int8 ``Trainer.step`` loss-trajectory
+    delta on a dcn=2 mesh, the block-quantize/dequantize kernel rates
+    that bound the ring's compute tax, and the delta-aware broadcast's
+    patch bytes vs the full blob. The mesh parts need >= 2 (even) jax
+    devices — CI's virtual 8-CPU mesh or real hardware; on a 1-device
+    host only the kernel + broadcast rows are emitted."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubetorch_tpu.models.quant import block_dequantize, block_quantize
+    from kubetorch_tpu.observability.prometheus import record_collective
+    from kubetorch_tpu.parallel import MeshSpec
+    from kubetorch_tpu.parallel import collectives as coll
+
+    out: Dict[str, float] = {}
+    block = coll.dcn_block()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(n_grad_elems), jnp.float32)
+    mb = x.nbytes / 1e6
+
+    # codec kernel rates (jitted, sync'd) — the compute the ring spends
+    # to earn its wire reduction; fed into the live counters so the
+    # quant/dequant seconds totals are exercised the same way the
+    # trainer feeds the byte counters
+    qfn = jax.jit(lambda v: block_quantize(v, block))
+    q, s = jax.block_until_ready(qfn(x))
+    dfn = jax.jit(lambda q, s: block_dequantize(q, s, block))
+    jax.block_until_ready(dfn(q, s))
+    quant = [_timed(lambda: jax.block_until_ready(qfn(x)))
+             for _ in range(reps)]
+    dequant = [_timed(lambda: jax.block_until_ready(dfn(q, s)))
+               for _ in range(reps)]
+    _spread(quant, "coll_quant_MBps", out, scale=mb, invert=True)
+    _spread(dequant, "coll_dequant_MBps", out, scale=mb, invert=True)
+    record_collective({"quant_s": sum(quant), "dequant_s": sum(dequant)})
+
+    ndev = jax.device_count()
+    if ndev >= 2 and ndev % 2 == 0:
+        mesh = MeshSpec(dcn=2, fsdp=ndev // 2).build()
+        stacked = {"g": x.reshape(2, -1)}
+        summed, stats = coll.dcn_ring_allreduce(stacked, mesh,
+                                                block=block, seed=1)
+        want = np.asarray(x.reshape(2, -1).sum(axis=0))
+        got = np.asarray(summed["g"])
+        out["coll_ring_rel_err"] = round(
+            float(np.abs(got - want).max() / np.abs(want).max()), 5)
+        out["coll_dcn_wire_reduction"] = round(stats.reduction, 2)
+        record_collective({"dcn_bytes": stats.wire_bytes,
+                           "dcn_raw_bytes": stats.raw_bytes})
+
+        # f32 vs int8 loss trajectories through the real Trainer — the
+        # quantized ring must be invisible in training quality. Uses the
+        # same tiny config as tests/test_collectives.py so CI shares the
+        # persistent XLA compile cache.
+        import optax
+
+        from kubetorch_tpu.models import LlamaConfig
+        from kubetorch_tpu.training.trainer import Trainer
+
+        cfg = LlamaConfig(vocab_size=512, embed_dim=64, n_layers=2,
+                          n_heads=4, n_kv_heads=4, head_dim=16,
+                          mlp_dim=128)
+        brng = np.random.default_rng(0)
+        B, S = 8, 32
+        batches = []
+        for _ in range(steps):
+            toks = brng.integers(0, cfg.vocab_size, (B, S + 1))
+            batches.append(
+                {"inputs": jnp.asarray(toks[:, :-1], jnp.int32),
+                 "targets": jnp.asarray(toks[:, 1:], jnp.int32)})
+        prev_codec = os.environ.get("KT_COLL_DCN_CODEC")  # ktlint: disable=KT003 -- save/restore of raw env state, not a config read
+        losses = {}
+        try:
+            for codec in ("f32", "int8"):
+                os.environ["KT_COLL_DCN_CODEC"] = codec  # ktlint: disable=KT003 -- bench toggles the knob per run
+                tmesh = MeshSpec(dcn=2, fsdp=ndev // 2).build()
+                tr = Trainer(cfg, tmesh, optimizer=optax.adamw(1e-3),
+                             seed=0)
+                losses[codec] = np.asarray(
+                    [float(jax.device_get(tr.step(b)["loss"]))
+                     for b in batches])
+        finally:
+            if prev_codec is None:
+                os.environ.pop("KT_COLL_DCN_CODEC", None)  # ktlint: disable=KT003
+            else:
+                os.environ["KT_COLL_DCN_CODEC"] = prev_codec  # ktlint: disable=KT003
+        out["coll_loss_equiv_delta"] = round(
+            float(np.abs(losses["f32"] - losses["int8"]).max()), 5)
+        out["coll_loss_equiv_steps"] = steps
+    return out
+
+
+def bench_delta_broadcast(store: "_Store",
+                          tree_elems: int = 65536) -> Dict[str, float]:
+    """Changed-leaf broadcast: re-fetch a re-put 6-leaf tree with one
+    changed leaf and measure store egress for the patch vs the full
+    blob — the delta fetch must ship a fraction of the bytes."""
+    import numpy as np
+
+    from kubetorch_tpu.data_store import device_transfer as dt
+    from kubetorch_tpu.data_store.client import DataStoreClient
+    from kubetorch_tpu.data_store.http_store import HttpStoreBackend
+    from kubetorch_tpu.data_store.types import BroadcastWindow
+
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    cache = Path(tempfile.mkdtemp(prefix="ktpu-delta-bcast-", dir=base))
+    prev_env = {k: os.environ.get(k)  # ktlint: disable=KT003 -- save/restore of raw env state, not a config read
+                for k in ("KT_STORE_URL", "KT_WIRE_DELTA")}
+    prev_default = DataStoreClient._default
+    os.environ["KT_STORE_URL"] = store.url
+    os.environ["KT_WIRE_DELTA"] = "1"
+    DataStoreClient._default = None
+    out: Dict[str, float] = {}
+    try:
+        tree = {f"w{i}": np.random.default_rng(i)
+                .standard_normal(tree_elems).astype(np.float32)
+                for i in range(6)}
+        dt.put_arrays("bench/coll-delta", tree)
+        backend = HttpStoreBackend(store.url)
+
+        def fetch():
+            window = BroadcastWindow(world_size=1, fanout=1, timeout=60,
+                                     serve=False, cache_root=str(cache))
+            return bytes(backend.get_blob("bench/coll-delta",
+                                          broadcast=window))
+
+        out0 = store.stats()["bytes_out"]
+        full = fetch()
+        out["bcast_delta_full_mb"] = round(
+            (store.stats()["bytes_out"] - out0) / 1e6, 3)
+
+        tree["w3"] = tree["w3"] + 1.0  # one changed leaf of six
+        dt.put_arrays("bench/coll-delta", tree)
+        out0 = store.stats()["bytes_out"]
+        patched = fetch()
+        out["bcast_delta_wire_mb"] = round(
+            (store.stats()["bytes_out"] - out0) / 1e6, 3)
+        if patched == full:
+            raise AssertionError("delta re-fetch returned stale bytes")
+        if patched != bytes(backend.get_blob("bench/coll-delta")):
+            raise AssertionError("spliced bytes differ from store blob")
+    finally:
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        DataStoreClient._default = prev_default
+        shutil.rmtree(cache, ignore_errors=True)
+    return out
+
+
 def _prior_round_dataplane():
     """The newest BENCH_r*.json's dataplane block (+ its round number;
     empty/-1 if none) — the baseline for the >20% regression flags."""
@@ -578,6 +736,11 @@ def run(dryrun: bool = False) -> Dict[str, float]:
                                  reps=reps))
         out.update(bench_codec(store, total_mb=(8 if dryrun else 64),
                                reps=reps))
+        out.update(bench_collectives(
+            store, steps=(6 if dryrun else 20),
+            n_grad_elems=(1 << 20 if dryrun else 1 << 22), reps=reps))
+        out.update(bench_delta_broadcast(
+            store, tree_elems=(4096 if dryrun else 65536)))
     finally:
         if store is not None:
             store.close()

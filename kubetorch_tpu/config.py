@@ -565,6 +565,16 @@ _knob("KT_RESTORE_CACHE", "str", "~/.ktpu/restore_cache",
 _knob("KT_PEER_CACHE", "str", "~/.ktpu/peer_cache",
       "Directory of the broadcast peer cache.", "data-store")
 
+# --- collectives ------------------------------------------------------------
+_knob("KT_COLL_DCN_CODEC", "str", "f32",
+      "Cross-slice (dcn) gradient allreduce codec: f32 keeps XLA's "
+      "implicit full-precision allreduce; int8 routes the dcn hop "
+      "through the block-quantized ring (parallel/collectives.py).",
+      "collectives")
+_knob("KT_COLL_BLOCK", "int", 256,
+      "Elements per float32 scale in the int8 dcn ring (wire overhead "
+      "is 4/block bytes per element).", "collectives")
+
 # --- resilience -------------------------------------------------------------
 _knob("KT_HEARTBEAT_S", "float", 5.0,
       "Pod liveness heartbeat interval (min 0.01).", "resilience")

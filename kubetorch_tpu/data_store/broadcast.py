@@ -139,6 +139,129 @@ def peer_cache_candidates(key: str, cache_root=None) -> list:
     return out
 
 
+def _delta_splice_into_cache(backend, key: str, cache_root: Path,
+                             cache_name: str, patch_remote: str,
+                             patch_cache: Optional[str] = None
+                             ) -> Optional[Path]:
+    """Delta-aware broadcast fetch: when the source holds a patch
+    sidecar whose named base is a previous ``.bv*`` fan-out (or the
+    plain-key publish) in OUR cache, pull only the changed leaves and
+    splice the rest from the local base. Returns the cached path, or
+    None — no patch / no matching base / lost the local claim — and the
+    caller takes the full streaming fetch.
+
+    The splice claim-files exactly like :func:`_stream_blob_into_cache`:
+    output bytes land in a fetcher-private ``.part-<pid>-<uuid>`` file
+    with the shared ``<name>.part`` symlink claiming it, so a
+    crash-mid-splice leaves only claim debris (reaped by the sweep) and
+    ``peer_cache_candidates`` — which skips anything ``.part`` — can
+    never hand a half-spliced file to the next delta fetch as a base.
+
+    ``patch_cache``: cache the patch bytes under this name after a
+    successful splice so our :class:`PeerServer` can serve the
+    version-scoped patch to children — the delta propagates down the
+    broadcast tree instead of degrading to full fetches below rank 0."""
+    from kubetorch_tpu.data_store import codec as codec_mod
+
+    local = cache_root / cache_name
+    try:
+        local.parent.mkdir(parents=True, exist_ok=True)
+    except OSError:
+        return None
+    candidates = [p for p in peer_cache_candidates(key, cache_root)
+                  if p.name != cache_name]
+    if not candidates:
+        return None
+    part = local.with_name(
+        f"{local.name}.part-{os.getpid()}-{uuid.uuid4().hex[:6]}")
+    claim = local.with_name(local.name + ".part")
+    try:
+        os.symlink(part.name, claim)
+    except (FileExistsError, OSError):
+        # another local fetcher owns this version; the streaming path
+        # knows how to wait on (and steal) its claim
+        return None
+    try:
+        buf = bytearray()
+        plan = base = None
+        it = None
+        try:
+            if hasattr(backend, "get_blob_stream"):
+                it = backend.get_blob_stream(patch_remote,
+                                             chunk_bytes=256 << 10)
+            else:
+                it = iter([backend.get_blob(patch_remote)])
+            for chunk in it:
+                buf += chunk
+                if plan is None and len(buf) >= 16:
+                    if bytes(buf[:8]) != codec_mod.MAGIC_DELTA:
+                        return None
+                    plan_len = int.from_bytes(buf[8:16], "little")
+                    if len(buf) < 16 + plan_len:
+                        continue
+                    plan, _ = codec_mod.parse_delta_plan(buf)
+                    data_bytes = sum(op[1] for op in plan["ops"]
+                                     if op[0] == 0)
+                    if data_bytes > plan["new_len"] * 0.5:
+                        # mostly-changed: stream the full blob instead
+                        # of buffering a near-full-size patch in RAM
+                        return None
+                    base = next(
+                        (p for p in candidates
+                         if p.stat().st_size == plan["base_len"]
+                         and codec_mod.blob_header_digest(p)
+                         == plan["base_hdr_digest"]), None)
+                    if base is None:
+                        return None  # wrong generation: abort download
+            if plan is None or base is None:
+                return None
+        except (DataStoreError, OSError, ValueError):
+            return None  # no sidecar (full put) or corrupt patch
+        finally:
+            if it is not None:
+                getattr(it, "close", lambda: None)()
+        try:
+            codec_mod.splice_delta(bytes(buf), base, part)
+            os.replace(part, local)
+        except (codec_mod.DeltaMismatch, ValueError, OSError):
+            return None
+        if patch_cache is not None:
+            pub = cache_root / patch_cache
+            tmp = pub.with_name(
+                f".{pub.name}.{os.getpid()}-{uuid.uuid4().hex[:6]}.tmp")
+            try:
+                tmp.write_bytes(buf)
+                os.replace(tmp, pub)
+            except OSError:
+                tmp.unlink(missing_ok=True)
+        # superseded versions (and their patches) are spent: the file
+        # just spliced is the next round's base
+        base_name = (cache_root / key).name
+        for pat in (f"{base_name}.bv*",
+                    f"{base_name}{codec_mod.BLOB_DELTA_SUFFIX}.bv*"):
+            for old in local.parent.glob(pat):
+                keep = (local.name, patch_cache
+                        and Path(patch_cache).name)
+                if old.name not in keep and ".part" not in old.name:
+                    old.unlink(missing_ok=True)
+        from kubetorch_tpu.observability.prometheus import (
+            record_bcast_delta,
+        )
+
+        record_bcast_delta({
+            "leaves_skipped": (plan.get("leaves_total", 0)
+                               - plan.get("leaves_sent", 0)),
+            "bytes_saved": plan["new_len"] - len(buf)})
+        return local
+    finally:
+        part.unlink(missing_ok=True)
+        try:  # release the claim only if it still points at OUR part
+            if os.readlink(claim) == part.name:
+                claim.unlink(missing_ok=True)
+        except OSError:
+            pass
+
+
 def _stream_blob_into_cache(backend, key: str, cache_root: Path,
                             wait_parent: bool = False,
                             cache_name: Optional[str] = None,
@@ -389,11 +512,31 @@ def _fetch_into_cache(backend, key: str, cache_root: Path,
     a version per request, so readers never see a half-synced tree)."""
     from kubetorch_tpu.data_store.sync import DEFAULT_EXCLUDES
 
+    from kubetorch_tpu.data_store import codec as codec_mod
+    from kubetorch_tpu.data_store.types import BLOB_DELTA_SUFFIX
+
     excludes = DEFAULT_EXCLUDES if excludes is None else excludes
     local = cache_root / key
     manifest_resp = backend._request(
         "GET", backend._url(f"/tree/{key}/manifest"))
     if manifest_resp.status_code == 404:
+        if blob_cache_name is not None and codec_mod.delta_enabled(None):
+            # Changed-leaf path: the patch names its base by content
+            # (header digest + length), so a splice from a previous
+            # ``.bv*`` fan-out is byte-exact or refused. Peers serve the
+            # version-scoped patch name (we cache it after splicing);
+            # the central store serves the plain sidecar. A re-put
+            # racing the store fetch re-keys the group anyway, the same
+            # invalidation the full-fetch version header leans on.
+            vsuffix = blob_cache_name[len(key):]  # ".bv{N}"
+            patch_cache = f"{key}{BLOB_DELTA_SUFFIX}{vsuffix}"
+            patch_remote = (patch_cache if blob_remote_name is not None
+                            else key + BLOB_DELTA_SUFFIX)
+            spliced = _delta_splice_into_cache(
+                backend, key, cache_root, blob_cache_name,
+                patch_remote, patch_cache=patch_cache)
+            if spliced is not None:
+                return spliced, False
         local = _stream_blob_into_cache(backend, key, cache_root,
                                         wait_parent=wait_parent,
                                         cache_name=blob_cache_name,
@@ -442,7 +585,39 @@ def _sweep_stale_trees(cache_root: Path, grace: float = 120.0,
     marker records when it was first seen unreferenced, so in-flight
     requests against the old version can drain before the bytes go away.
     ``tmp-``-prefixed stages (fetch in progress) are exempt unless older
-    than ``tmp_grace`` (an orphan from a crashed fetcher)."""
+    than ``tmp_grace`` (an orphan from a crashed fetcher).
+
+    Blob-side debris gets the same treatment: a fetcher or delta
+    splicer that crashed mid-write leaves its private ``.part-*`` file
+    (plus ``.size`` sidecar) and possibly the shared ``.part`` claim
+    symlink behind. Both are invisible to ``peer_cache_candidates`` (a
+    half-written file must never become a splice base), but without the
+    reap the claim debris would make every later fetcher of that name
+    sit out a full stall-detect before stealing."""
+    now = time.time()
+    for dirpath, dirnames, filenames in os.walk(cache_root,
+                                                followlinks=False):
+        if Path(dirpath) == cache_root and ".trees" in dirnames:
+            dirnames.remove(".trees")
+        for name in filenames:
+            if ".part" not in name:
+                continue
+            p = Path(dirpath) / name
+            try:
+                if p.is_symlink() and name.endswith(".part"):
+                    # dangling claim: target part file gone (writer
+                    # crashed after cleanup started) — age-gate on the
+                    # link itself; a live claimant's part may lag the
+                    # claim by the request round-trip, never by hours
+                    target = p.parent / os.readlink(p)
+                    if (not target.exists()
+                            and now - p.lstat().st_mtime > tmp_grace):
+                        p.unlink(missing_ok=True)
+                elif (p.is_file()
+                        and now - p.stat().st_mtime > tmp_grace):
+                    p.unlink(missing_ok=True)
+            except OSError:
+                continue
     trees = cache_root / ".trees"
     if not trees.is_dir():
         return

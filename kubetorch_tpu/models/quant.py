@@ -36,12 +36,74 @@ QUANT_KEYS: Sequence[str] = (
 )
 
 
+# --- shared absmax/127 rounding core ---------------------------------------
+# One int8 quantization implementation for the three call sites that used
+# to carry their own copy: the serving weight quantizer below (per-output-
+# channel scales), the 8-bit Adam moments (training/quant_opt.py, per-block
+# scales), and the quantized dcn allreduce (parallel/collectives.py, per-
+# block scales + stochastic rounding). Scale *derivation* stays per-site —
+# weight quantization floors absmax at 1e-8, the block paths map absmax==0
+# to scale 1.0 — because changing either would silently move bits under
+# checkpoints and optimizer state already in the wild.
+
+
+def quantize_with_scale(x: jax.Array, scale: jax.Array,
+                        key: Optional[jax.Array] = None) -> jax.Array:
+    """``clip(round(x / scale), ±127)`` as int8 — the shared rounding core.
+
+    ``key``: switch round-to-nearest to *stochastic* rounding
+    (``floor(y + u)``, ``u ~ U[0, 1)``): E[q·scale] == x exactly, which
+    kills the accumulation bias nearest-rounding builds up when the same
+    values are re-quantized every hop of a reduction (EQuARX)."""
+    y = x / scale
+    if key is None:
+        q = jnp.round(y)
+    else:
+        q = jnp.floor(y + jax.random.uniform(key, y.shape, jnp.float32))
+    return jnp.clip(q, -127, 127).astype(jnp.int8)
+
+
+def block_shape(shape, block: int) -> int:
+    """Effective block length along the last axis: ``block`` when it
+    divides the axis, else the whole axis (tiny or indivisible)."""
+    last = shape[-1] if shape else 1
+    if last >= block and last % block == 0:
+        return block
+    return last
+
+
+def block_quantize(x: jax.Array, block: int,
+                   key: Optional[jax.Array] = None):
+    """x [..., n] → (int8 [..., n], f32 scales [..., n//b]) with
+    per-block absmax/127 scales along the last axis (zero blocks get
+    scale 1.0). ``key`` enables stochastic rounding (see
+    :func:`quantize_with_scale`)."""
+    b = block_shape(x.shape, block)
+    if x.ndim == 0:
+        q, s = block_quantize(x[None], block, key)
+        return q[0], s[0]
+    blocks = x.reshape(x.shape[:-1] + (x.shape[-1] // b, b))
+    absmax = jnp.max(jnp.abs(blocks), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = quantize_with_scale(blocks, scale[..., None], key)
+    return q.reshape(x.shape), scale.astype(jnp.float32)
+
+
+def block_dequantize(q: jax.Array, scale: jax.Array, block: int):
+    """Inverse of :func:`block_quantize` into float32."""
+    b = block_shape(q.shape, block)
+    if q.ndim == 0:
+        return block_dequantize(q[None], scale[None], block)[0]
+    blocks = q.reshape(q.shape[:-1] + (q.shape[-1] // b, b))
+    return (blocks.astype(jnp.float32) * scale[..., None]).reshape(q.shape)
+
+
 def _quantize_leaf(w: jax.Array):
     """→ (int8 weights, per-output-channel scale in w.dtype)."""
     absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
     scale = jnp.maximum(absmax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
-    return q.astype(jnp.int8), scale.astype(w.dtype)
+    q = quantize_with_scale(w.astype(jnp.float32), scale)
+    return q, scale.astype(w.dtype)
 
 
 # The decode-layout fuse groups — single source of truth shared by

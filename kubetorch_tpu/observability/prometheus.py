@@ -302,6 +302,71 @@ def wire_samples(labels: Optional[Dict[str, str]] = None):
 
 
 # ------------------------------------------------------------------
+# Train-plane collectives + delta broadcast (parallel/collectives.py,
+# data_store/broadcast.py). Process-local like the wire counters.
+# coll_dcn_* decomposes the quantized cross-slice gradient allreduce:
+# bytes actually crossing the dcn links vs what the same ring schedule
+# would move in f32 (raw), plus the quantize/dequantize seconds the
+# compression costs (benches time the jitted kernels; the trainer
+# records the static per-step byte accounting). bcast_delta_* counts
+# what the changed-leaf broadcast path avoided fetching.
+_COLL_LOCK = threading.Lock()
+_COLL: Dict[str, float] = {
+    "coll_dcn_bytes_total": 0.0,
+    "coll_dcn_raw_bytes_total": 0.0,
+    "coll_dcn_quant_seconds_total": 0.0,
+    "coll_dcn_dequant_seconds_total": 0.0,
+    "bcast_delta_leaves_skipped_total": 0.0,
+    "bcast_delta_bytes_saved_total": 0.0,
+}
+
+
+def record_collective(stats: Dict[str, float]) -> None:
+    """Fold one dcn allreduce's byte/time decomposition into the
+    counters. Accepted keys: dcn_bytes, dcn_raw_bytes, quant_s,
+    dequant_s."""
+    mapping = {
+        "dcn_bytes": "coll_dcn_bytes_total",
+        "dcn_raw_bytes": "coll_dcn_raw_bytes_total",
+        "quant_s": "coll_dcn_quant_seconds_total",
+        "dequant_s": "coll_dcn_dequant_seconds_total",
+    }
+    with _COLL_LOCK:
+        for key, counter in mapping.items():
+            value = stats.get(key, 0)
+            if isinstance(value, (int, float)) and value > 0:
+                _COLL[counter] += float(value)
+
+
+def record_bcast_delta(stats: Dict[str, float]) -> None:
+    """Fold one delta-spliced broadcast fetch into the counters.
+    Accepted keys: leaves_skipped, bytes_saved."""
+    mapping = {
+        "leaves_skipped": "bcast_delta_leaves_skipped_total",
+        "bytes_saved": "bcast_delta_bytes_saved_total",
+    }
+    with _COLL_LOCK:
+        for key, counter in mapping.items():
+            value = stats.get(key, 0)
+            if isinstance(value, (int, float)) and value > 0:
+                _COLL[counter] += float(value)
+
+
+def coll_metrics() -> Dict[str, float]:
+    """Snapshot of the collectives + delta-broadcast counters."""
+    with _COLL_LOCK:
+        return dict(_COLL)
+
+
+def coll_samples(labels: Optional[Dict[str, str]] = None):
+    """Exposition samples for the collectives counters (plain names —
+    the train plane is not a ``data_store_`` family)."""
+    labels = labels or {}
+    for name, value in coll_metrics().items():
+        yield name, labels, value
+
+
+# ------------------------------------------------------------------
 # Serving call-path decomposition (persistent pipelined call channel,
 # serving/channel.py ↔ PodServer.h_channel). Process-local, like the
 # restore counters above: the pod-server process records server-side

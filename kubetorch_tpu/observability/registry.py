@@ -270,6 +270,27 @@ _m("engine_adapter_resident", "gauge",
    "Named adapters currently resident across the KT_LORA_SLOTS device "
    "slots.", "adapter")
 
+# --- quantized collectives + delta broadcast (this PR) ----------------------
+_m("coll_dcn_bytes_total", "counter",
+   "Bytes crossing the dcn links for quantized gradient allreduces "
+   "(int8 payloads + per-block f32 scales, both ring phases).",
+   "collectives")
+_m("coll_dcn_raw_bytes_total", "counter",
+   "Bytes the same ring schedule would have moved in f32 — the gap "
+   "over coll_dcn_bytes_total is DCN wire saved.", "collectives")
+_m("coll_dcn_quant_seconds_total", "counter",
+   "Device time spent block-quantizing ring payloads (benchmarked "
+   "kernel time; the compression's compute cost).", "collectives")
+_m("coll_dcn_dequant_seconds_total", "counter",
+   "Device time spent dequantizing received ring payloads into the "
+   "f32 accumulator.", "collectives")
+_m("bcast_delta_leaves_skipped_total", "counter",
+   "Unchanged leaves the delta-aware broadcast spliced from the local "
+   "peer-cache base instead of fetching.", "collectives")
+_m("bcast_delta_bytes_saved_total", "counter",
+   "Bytes the delta-aware broadcast avoided moving (full blob size "
+   "minus patch size, per spliced fetch).", "collectives")
+
 # --- resilience (PR 5) ------------------------------------------------------
 _m("resilience_heartbeats_total", "counter",
    "Liveness beats accepted (WS + HTTP).", "resilience")
@@ -382,9 +403,9 @@ _m("slo_eval_ms", "gauge",
 
 
 # keep the doc groups in a stable, narrative-matching order
-GROUP_ORDER = ("restore", "wire", "serving", "reliability", "engine",
-               "adapter", "resilience", "san", "trace", "telemetry",
-               "fleet", "slo")
+GROUP_ORDER = ("restore", "wire", "collectives", "serving", "reliability",
+               "engine", "adapter", "resilience", "san", "trace",
+               "telemetry", "fleet", "slo")
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
 

@@ -132,6 +132,14 @@ def _attach_worker_metrics(agg: Dict[str, int]) -> None:
         wire = wire_metrics()
         if any(wire.values()):
             agg["data_store"] = {"pid": os.getpid(), **wire}
+        # quantized dcn allreduce + delta-broadcast counters: trainers
+        # run in worker processes, so without the piggyback the pod's
+        # coll_* family would stay zero forever
+        from kubetorch_tpu.observability.prometheus import coll_metrics
+
+        coll = coll_metrics()
+        if any(coll.values()):
+            agg["coll"] = {"pid": os.getpid(), **coll}
         serving = {k: v for k, v in serving_metrics().items()
                    if k.startswith("serving_worker_") and v}
         if serving:

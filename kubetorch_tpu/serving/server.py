@@ -623,7 +623,10 @@ class PodServer:
                     # per-adapter LoRA tenant counters (dynamic
                     # engine_adapter__<name>_* families) — flat _total
                     # keys, summed across workers like any group
-                    "adapter": ""}
+                    "adapter": "",
+                    # quantized dcn allreduce + delta broadcast (train
+                    # plane runs in workers; counters piggyback)
+                    "coll": ""}
 
     def _merge_worker_stats(self, stats: Dict[str, Any]):
         """Fold a worker's per-call stats dict into pod metrics. Plain
@@ -801,6 +804,13 @@ class PodServer:
         wire = prom.wire_metrics()
         if any(wire.values()):
             self._merge_proc_snapshot("data_store", "server", wire)
+        # Quantized-collective + delta-broadcast counters: the training
+        # plane usually runs in worker processes (piggybacked pid-tagged
+        # like the wire counters), but app-mode trainers record in this
+        # process directly.
+        coll = prom.coll_metrics()
+        if any(coll.values()):
+            self._merge_proc_snapshot("coll", "server", coll)
         # Serving call-path counters: the server process records channel
         # lifecycle + server-side stage totals; worker processes piggyback
         # their own serving_worker_* counters on call responses (merged

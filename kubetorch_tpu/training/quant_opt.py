@@ -39,6 +39,12 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from kubetorch_tpu.models.quant import (
+    block_dequantize,
+    block_quantize,
+    block_shape,
+)
+
 
 class _QMoment(NamedTuple):
     q: Any          # int8, param-shaped
@@ -51,33 +57,13 @@ class ScaleByQuantAdamState(NamedTuple):
     nu: Any         # pytree of _QMoment (sqrt-scale)
 
 
-def _block_shape(shape, block):
-    last = shape[-1] if shape else 1
-    if last >= block and last % block == 0:
-        return block
-    return last  # whole-axis scale (tiny or indivisible trailing axis)
-
-
-def _quantize(x, block):
-    """x [..., n] f32 → (int8 [..., n], f32 scales [..., n//b])."""
-    b = _block_shape(x.shape, block)
-    if x.ndim == 0:
-        x = x[None]
-        q, s = _quantize(x, block)
-        return q[0], s[0]
-    blocks = x.reshape(x.shape[:-1] + (x.shape[-1] // b, b))
-    absmax = jnp.max(jnp.abs(blocks), axis=-1)
-    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(blocks / scale[..., None]), -127, 127)
-    return q.reshape(x.shape).astype(jnp.int8), scale.astype(jnp.float32)
-
-
-def _dequantize(q, scale, block):
-    b = _block_shape(q.shape, block)
-    if q.ndim == 0:
-        return _dequantize(q[None], scale[None], block)[0]
-    blocks = q.reshape(q.shape[:-1] + (q.shape[-1] // b, b))
-    return (blocks.astype(jnp.float32) * scale[..., None]).reshape(q.shape)
+# The block quantize/dequantize math lives in models/quant.py now, shared
+# with the serving weight quantizer and the quantized dcn allreduce
+# (parallel/collectives.py). These aliases keep this module's historical
+# names — optimizer state produced before the refactor is bit-identical.
+_block_shape = block_shape
+_quantize = block_quantize
+_dequantize = block_dequantize
 
 
 def scale_by_quant_adam(b1: float = 0.9, b2: float = 0.95,

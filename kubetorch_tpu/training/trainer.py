@@ -23,6 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from kubetorch_tpu.models.configs import LlamaConfig
 from kubetorch_tpu.models import llama
+from kubetorch_tpu.parallel import collectives
 from kubetorch_tpu.parallel.mesh import use_mesh
 from kubetorch_tpu.parallel.sharding import ShardingRules, named_sharding
 
@@ -174,8 +175,25 @@ def make_train_step(
         return ((loss_sum * inv, aux),
                 jax.tree.map(lambda g: g * inv, grads))
 
+    # Quantized cross-slice gradient sync (KT_COLL_DCN_CODEC=int8 on a
+    # dcn>1 mesh): per-slice grads over a dcn-split batch, int8 ring
+    # over the dcn axis (parallel/collectives.py). The gate is
+    # Python-level, resolved when the step is built — the default f32
+    # codec and every dcn=1 mesh trace exactly the graph they trace
+    # today, byte-identical lowering included.
+    dcn_sync = None
+    if (mesh is not None and mesh.shape.get("dcn", 1) > 1
+            and collectives.dcn_codec() == "int8"):
+        dcn_sync = collectives.make_dcn_synced_grads(compute_grads, mesh)
+
     def train_step(state: TrainState, batch: Dict[str, jax.Array]):
-        (loss, aux), grads = compute_grads(state["params"], batch)
+        if dcn_sync is not None:
+            # the step counter seeds the stochastic rounding: fresh
+            # noise every step, deterministic across retraces
+            (loss, aux), grads = dcn_sync(
+                state["params"], batch, state["step"])
+        else:
+            (loss, aux), grads = compute_grads(state["params"], batch)
         updates, new_opt = optimizer.update(
             grads, state["opt_state"], state["params"])
         new_params = optax.apply_updates(state["params"], updates)
@@ -232,6 +250,18 @@ class Trainer:
             self._step = make_train_step(cfg, self.optimizer, self.rules,
                                          loss_fn=loss_fn, mesh=mesh,
                                          accum_steps=accum_steps)
+        # When the quantized dcn ring is active, its per-step bytes are
+        # static (the schedule is shape-determined) — account them once
+        # here, fold into the coll_* counters per step.
+        self._coll_stats = None
+        if (mesh.shape.get("dcn", 1) > 1
+                and collectives.dcn_codec() == "int8"):
+            n_params = sum(
+                x.size for x in jax.tree.leaves(self.state["params"]))
+            n_dcn = int(mesh.shape["dcn"])
+            ici = mesh.devices.size // n_dcn
+            self._coll_stats = collectives.dcn_wire_stats(
+                n_params, n_dcn, ici, collectives.dcn_block())
 
     @classmethod
     def lora(
@@ -383,6 +413,13 @@ class Trainer:
         with use_mesh(self.mesh):
             self.state, metrics = self._step(self.state, batch)
         self._step_count += 1
+        if self._coll_stats is not None:
+            from kubetorch_tpu.observability.prometheus import (
+                record_collective,
+            )
+
+            record_collective({"dcn_bytes": self._coll_stats.wire_bytes,
+                               "dcn_raw_bytes": self._coll_stats.raw_bytes})
         if (self.checkpoint is not None and self._ckpt_every
                 and self._step_count % self._ckpt_every == 0):
             # async save: Orbax writes in the background; the emergency

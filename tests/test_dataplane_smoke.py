@@ -45,6 +45,15 @@ EXPECTED_KEYS = {
     "delta_publish_leaves_skipped",
     "delta_fetch_wire_mb",
     "delta_fetch_hit",
+    # quantized dcn collectives + delta-aware broadcast (train plane)
+    "coll_quant_MBps",
+    "coll_dequant_MBps",
+    "coll_ring_rel_err",
+    "coll_dcn_wire_reduction",
+    "coll_loss_equiv_delta",
+    "coll_loss_equiv_steps",
+    "bcast_delta_full_mb",
+    "bcast_delta_wire_mb",
     # distributed tracing instruments the restore/publish paths above
     "trace_span_count",
     "trace_overhead_us_per_span",
@@ -72,6 +81,14 @@ def test_dataplane_dryrun_metric_keys():
     assert out["delta_publish_update_pct"] < 1.0
     assert out["delta_publish_leaves_skipped"] > 0
     assert out["delta_fetch_hit"] == 1.0
+    # train-plane collectives floors: the int8 dcn ring must at least
+    # halve bytes-on-wire vs the f32 schedule, train indistinguishably
+    # from f32 (loss-trajectory bound), and the delta broadcast must
+    # ship a strict fraction of the full blob for a 1-of-6-leaf change
+    assert out["coll_dcn_wire_reduction"] >= 2.0
+    assert out["coll_loss_equiv_delta"] < 0.05
+    assert out["coll_quant_MBps"] > 0 and out["coll_dequant_MBps"] > 0
+    assert 0 < out["bcast_delta_wire_mb"] < 0.5 * out["bcast_delta_full_mb"]
     # the dataplane paths must actually record spans (fetch/decode/
     # device_put per restore, put/get per publish) at a sane per-span
     # cost — a silently un-instrumented path would zero the count

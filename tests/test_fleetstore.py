@@ -606,6 +606,7 @@ class TestRegistryAndExemplars:
         names = set()
         names.update(f"data_store_{k}" for k in prom.restore_metrics())
         names.update(f"data_store_{k}" for k in prom.wire_metrics())
+        names.update(prom.coll_metrics())
         names.update(k for k in prom.serving_metrics()
                      if not k.startswith("serving_call_"))
         names.update(prom.reliability_metrics())
