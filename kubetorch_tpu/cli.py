@@ -524,10 +524,83 @@ def trace(service, last, trace_id, out):
                    f"{row['max_ms']:>10}")
 
 
+@main.command()
+@click.argument("service")
+@click.option("--last", type=int, default=512,
+              help="newest N flight records per engine process")
+@click.option("-o", "--out", default="flight.json",
+              help="output file (Chrome trace_event JSON — opens "
+                   "directly in ui.perfetto.dev)")
+@click.option("--raw", is_flag=True,
+              help="write the merged raw records instead of Perfetto")
+def flight(service, last, out, raw):
+    """Fetch the engine flight recorders (per-driver-tick black box)
+    from a deployed service and write one Perfetto file.
+
+    Every engine appends one record per driver tick (host/device tick
+    decomposition, admits/tokens/spec/evictions, queue + KV headroom,
+    MFU/MBU, live trace ids); this pulls each pod's ``GET /_flight``,
+    merges the rings, and emits counter tracks plus per-tick instants
+    whose ``trace_ids`` args join against ``ktpu trace`` spans — a
+    stall is one click from the ticks that produced it."""
+    import httpx
+
+    from kubetorch_tpu.observability import flight as _flight
+    from kubetorch_tpu.provisioning.backend import get_backend
+
+    try:
+        urls = get_backend().pod_urls(service)
+    except KeyError:
+        raise click.ClickException(f"no service {service!r}")
+    if not urls:
+        raise click.ClickException(f"no pods for service {service!r}")
+    groups = []
+    with httpx.Client(timeout=30.0) as client:
+        for i, base in enumerate(urls):
+            try:
+                resp = client.get(f"{base}/_flight",
+                                  params={"last": str(max(1, last))})
+                resp.raise_for_status()
+            except httpx.HTTPError as exc:
+                click.echo(f"# pod {base}: flight fetch failed ({exc})",
+                           err=True)
+                continue
+            body = resp.json()
+            pod = body.get("pod") or f"pod-{i}"
+            for pid, records in (body.get("procs") or {}).items():
+                groups.append((f"{pod}/{pid}", records))
+    merged = _flight.merge_procs(groups)
+    total = sum(len(v) for v in merged.values())
+    if not total:
+        raise click.ClickException(
+            "no flight records found — is the recorder disabled "
+            "(KT_FLIGHT_DISABLE=1), or has no engine ticked yet?")
+    if raw:
+        Path(out).write_text(json.dumps({"procs": merged}))
+    else:
+        Path(out).write_text(json.dumps(_flight.to_perfetto(merged)))
+    click.echo(f"{total} flight records across {len(merged)} engine "
+               f"process(es) → {out}"
+               + ("" if raw else "  (open in https://ui.perfetto.dev)"))
+    for label in sorted(merged):
+        rows = merged[label]
+        if not rows:
+            continue
+        dev = sum(r.get("device_s") or 0.0 for r in rows)
+        tick = sum(r.get("tick_s") or 0.0 for r in rows)
+        toks = sum(r.get("decode_tokens") or 0 for r in rows)
+        mfu = [r["mfu"] for r in rows if r.get("mfu") is not None]
+        click.echo(
+            f"  {label}: {len(rows)} ticks, {toks} tokens, "
+            f"device {dev:.2f}s / wall {tick:.2f}s"
+            + (f", mfu~{sum(mfu) / len(mfu) * 100:.0f}%" if mfu else ""))
+
+
 # ---------------------------------------------------------------- top
 _TOP_DIRECT_GAUGES = ("engine_active_rows", "engine_free_rows",
                       "engine_queue_depth", "kv_blocks_used",
-                      "engine_spec_accept_rate")
+                      "engine_spec_accept_rate", "engine_mfu",
+                      "engine_mbu", "hbm_used_bytes")
 
 
 def _top_direct_fleet(service, timeout=2.0):
@@ -633,7 +706,8 @@ def _top_gather(controller, service, window):
 
 def _top_rows(fleet):
     """Per-replica rows from a fleet rollup: (pod, tier, occupancy,
-    queue, kv blocks, tok/s, spec accept rate, ttft p99 ms, status)."""
+    queue, kv blocks, tok/s, spec accept rate, mfu, mbu, hbm, ttft
+    p99 ms, status)."""
     gauges = fleet.get("gauges") or {}
     counters = fleet.get("counters") or {}
     hists = fleet.get("histograms") or {}
@@ -659,6 +733,11 @@ def _top_rows(fleet):
         # speculation: draft acceptance on the pod ("—" on spec-off
         # engines, which never publish the gauge)
         acc = by_pod(gauges, "engine_spec_accept_rate", pod)
+        # device-truth utilization (absent — "—", not 0 — on pods whose
+        # engine has no known chip peaks or no device backend)
+        mfu = by_pod(gauges, "engine_mfu", pod)
+        mbu = by_pod(gauges, "engine_mbu", pod)
+        hbm = by_pod(gauges, "hbm_used_bytes", pod)
         p99 = ((hists.get("engine_ttft_seconds") or {})
                .get("by_pod_p99") or {}).get(pod)
         if meta.get("stale"):
@@ -676,6 +755,9 @@ def _top_rows(fleet):
                      f"{kv:g}" if kv is not None else "—",
                      f"{tok_s:.1f}" if tok_s is not None else "—",
                      f"{acc * 100:.0f}%" if acc is not None else "—",
+                     f"{mfu * 100:.0f}%" if mfu is not None else "—",
+                     f"{mbu * 100:.0f}%" if mbu is not None else "—",
+                     f"{hbm / 2 ** 30:.1f}G" if hbm is not None else "—",
                      f"{p99 * 1e3:.0f}" if p99 is not None else "—",
                      status))
     return rows
@@ -740,11 +822,14 @@ def _top_render(snapshot, window):
             continue
         lines.append(f"  {'replica':<28}{'tier':>9}{'rows':>9}"
                      f"{'queue':>7}{'kv blk':>8}{'tok/s':>9}"
-                     f"{'accept':>8}{'ttft p99':>10}  status")
+                     f"{'accept':>8}{'mfu':>6}{'mbu':>6}{'hbm':>8}"
+                     f"{'ttft p99':>10}  status")
         for row in _top_rows(fleet):
-            pod, tier, occ, queue, kv, tok_s, acc, p99, status = row
+            (pod, tier, occ, queue, kv, tok_s, acc, mfu, mbu, hbm,
+             p99, status) = row
             lines.append(f"  {pod:<28}{tier:>9}{occ:>9}{queue:>7}{kv:>8}"
-                         f"{tok_s:>9}{acc:>8}{p99:>10}  {status}")
+                         f"{tok_s:>9}{acc:>8}{mfu:>6}{mbu:>6}{hbm:>8}"
+                         f"{p99:>10}  {status}")
         arows = _top_adapter_rows(fleet)
         if arows:
             lines.append(f"  {'adapter':<28}{'tok/s':>9}{'gens':>7}"

@@ -504,6 +504,16 @@ _knob("KT_PUSH_TIMEOUT", "float", 5.0,
       "Bound on background pushes to the controller (trace slow-push, "
       "heartbeat POST fallback) so a hung controller cannot delay the "
       "SIGTERM drain.", "observability")
+_knob("KT_FLIGHT_RING", "int", 2048,
+      "Capacity of the engine flight recorder's per-tick ring buffer "
+      "(one record per driver tick).", "observability")
+_knob("KT_FLIGHT_DIR", "str", None,
+      "Directory the flight recorder dumps per-process rings "
+      "(flight-<pid>.json) into on preemption/teardown, next to the "
+      "sanitizer reports; subprocess pods inherit it. Unset = no dump.",
+      "observability")
+_knob("KT_FLIGHT_DISABLE", "bool", False,
+      "Disable the engine flight recorder entirely.", "observability")
 
 # --- fleet telemetry plane (controller-resident time series) ----------------
 _knob("KT_TELEMETRY_EVERY", "int", 1,

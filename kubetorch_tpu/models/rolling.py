@@ -39,6 +39,7 @@ import numpy as np
 
 from kubetorch_tpu.config import env_float, env_int
 from kubetorch_tpu.lookahead import LookaheadState, spec_stats_dict
+from kubetorch_tpu.observability import devstats
 from kubetorch_tpu.models import llama
 from kubetorch_tpu.models.configs import LlamaConfig
 from kubetorch_tpu.models.generate import filter_logits
@@ -288,6 +289,15 @@ class RollingGenerator:
         # prefix-sharing savings ratio
         self.prefill_tokens = 0
 
+        # Device-truth utilization accounting: every jitted dispatch
+        # below routes through this accumulator, which captures each
+        # executable's cost_analysis() once per (kind, static-shape
+        # key) — mixed spec-k widths attribute to the right executable
+        # — and counts per-dispatch FLOPs/HBM bytes for the engine's
+        # MFU/MBU gauges.
+        self._devstats = devstats.ExecutableCosts()
+        self._devstats_peaks: Any = "unset"
+
         # Donation matters doubly here: the cache grid is the largest
         # buffer in the server and every call rewrites it — aliasing
         # in/out keeps updates in place (and off any remote-dispatch wire).
@@ -360,6 +370,20 @@ class RollingGenerator:
     @property
     def free_rows(self) -> int:
         return len(self._free)
+
+    def devstats_snapshot(self) -> Dict[str, float]:
+        """Cumulative compiler-truth dispatch costs (FLOPs / HBM bytes
+        / dispatch count) — the MFU/MBU numerators. Same surface as
+        ``SimRollingEngine.devstats_snapshot``."""
+        return self._devstats.snapshot()
+
+    def devstats_peaks(self) -> Optional[Tuple[float, float]]:
+        """(peak_flops, peak_bytes_per_s) for this process's device, or
+        None on CPU/unknown hardware — the engine then publishes no
+        MFU/MBU gauge (absent, not zero). Cached after first read."""
+        if self._devstats_peaks == "unset":
+            self._devstats_peaks = devstats.device_peaks()
+        return self._devstats_peaks
 
     @property
     def active_rows(self) -> int:
@@ -567,7 +591,8 @@ class RollingGenerator:
                 done_reqs.append(req)
         with self._mesh_ctx():
             (self.cache, self._logits, self._dpos,
-             self._dactive) = self._prefill_ext(
+             self._dactive) = self._devstats.call(
+                "prefill_ext", C, self._prefill_ext,
                 self.params, self.cache, self._logits, self._dpos,
                 self._dactive, jnp.asarray(feed), jnp.asarray(counts),
                 jnp.asarray(finals), self._lora(self._slot_adapter), C=C)
@@ -669,7 +694,8 @@ class RollingGenerator:
         toks[0, :len(tokens)] = tokens
         idx = np.full(1, adapter_id, np.int32)
         with self._mesh_ctx():
-            planes, logits = self._prefix_fill(
+            planes, logits = self._devstats.call(
+                "prefix_fill", p_pad, self._prefix_fill,
                 self.params, jnp.asarray(toks),
                 jnp.int32(len(tokens)), self._lora(idx), p_pad=p_pad)
         pid = self._next_prefix_id
@@ -1045,7 +1071,8 @@ class RollingGenerator:
         with self._mesh_ctx():
             if prefix_id is None:
                 (self.cache, self._logits, self._dpos,
-                 self._dactive) = self._prefill(
+                 self._dactive) = self._devstats.call(
+                    "prefill", (n_pad, p_pad), self._prefill,
                     self.params, self.cache, self._logits, self._dpos,
                     self._dactive, jnp.asarray(toks), jnp.asarray(lens),
                     jnp.asarray(slots), self._lora(idx),
@@ -1053,7 +1080,8 @@ class RollingGenerator:
             else:
                 pfx = self._prefixes[prefix_id]
                 (self.cache, self._logits, self._dpos,
-                 self._dactive) = self._prefill_px(
+                 self._dactive) = self._devstats.call(
+                    "prefill_px", (n_pad, p_pad), self._prefill_px,
                     self.params, self.cache, self._logits, self._dpos,
                     self._dactive, pfx["planes"],
                     jnp.int32(pfx["len"]), jnp.asarray(toks),
@@ -1096,7 +1124,9 @@ class RollingGenerator:
     def _decode_chunk(self) -> List[Tuple[int, List[int], bool]]:
         self._rng, key = jax.random.split(self._rng)
         with self._mesh_ctx():
-            (self.cache, self._logits, self._dpos, toks) = self._decode(
+            (self.cache, self._logits, self._dpos,
+             toks) = self._devstats.call(
+                "decode", self.steps_per_call, self._decode,
                 self.params, self.cache, self._logits, self._dpos,
                 self._dactive, jnp.asarray(self._temps),
                 jnp.asarray(self._penalties), jnp.asarray(self._win), key,
@@ -1153,7 +1183,8 @@ class RollingGenerator:
         self._rng, key = jax.random.split(self._rng)
         with self._mesh_ctx():
             (self.cache, self._dpos, self._ctx, self._dnt,
-             self._dnt_valid, toks, emits) = self._decode_sp(
+             self._dnt_valid, toks, emits) = self._devstats.call(
+                "decode_spec", (kd, self._spec_sampling), self._decode_sp,
                 self.params, self.cache, self._logits, self._dpos,
                 self._dactive, self._ctx, self._dnt, self._dnt_valid,
                 jnp.asarray(self._temps), jnp.asarray(kk), key,

@@ -48,7 +48,7 @@ _COUNTER_SUFFIXES = ("_total", "_bucket", "_count", "_sum")
 # by the pod server's frame builder, so pods and docs can't drift.
 FRAME_PREFIXES = ("engine_", "kv_", "prefix_", "serving_", "replay_",
                   "admission_", "resilience_", "http_", "telemetry_",
-                  "trace_", "ws_")
+                  "trace_", "ws_", "hbm_")
 
 
 def is_counter(name: str) -> bool:

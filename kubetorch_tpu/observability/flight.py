@@ -1,0 +1,257 @@
+"""Engine flight recorder: a fixed-size, allocation-light ring of
+per-driver-tick records — the black box an operator reads after a
+stall, preemption, or crash.
+
+Every ``DecodeEngine`` driver tick appends one record: tick sequence,
+wall+monotonic stamps, the host-vs-device decomposition of the tick,
+what the scheduler did (admits, prefill chunks, decode tokens, spec
+rounds/accepts, evictions, parks, handoffs, sheds), how loaded it was
+(queue depth, active rows, KV blocks free), the utilization the
+devstats plane computed (MFU/MBU), and the trace ids of the programs
+live in the batch — so a tick in the flight log is one join away from
+its PR-4 spans.
+
+Appends are hot-path (one per tick, under the driver lock) and cheap:
+one tuple write into a preallocated ring slot — no dict churn, no I/O.
+Record dicts are only materialized at snapshot/dump time.
+
+Lifecycle mirrors the sanitizer reports: each process owns one
+module-level recorder (sized by ``KT_FLIGHT_RING``, killed by
+``KT_FLIGHT_DISABLE``); on preemption/emergency the pod server dumps
+``flight-<pid>.json`` into ``KT_FLIGHT_DIR`` next to the san reports —
+including the rings its workers piggybacked up, since workers die with
+the pod's ``os._exit`` and cannot dump their own. On demand the same
+data serves through ``GET /_flight`` and the channel ``flight`` control
+op; ``ktpu flight <svc>`` merges rings fleet-wide into a Perfetto file.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from kubetorch_tpu.config import env_bool, env_int, env_str
+
+# One flat schema, positional in the ring (dicts materialize at
+# snapshot time). ``seq`` is assigned by the recorder; everything else
+# is the appender's. ``trace_ids`` is a tuple of the trace ids live in
+# the batch at tick time (bounded), the join key against PR-4 spans.
+FIELDS: Tuple[str, ...] = (
+    "seq", "t_wall", "t_mono", "tick_s", "device_s", "host_s",
+    "admits", "prefill_chunks", "prefill_tokens", "decode_tokens",
+    "spec_rounds", "spec_accepted", "evictions", "parks", "handoffs",
+    "sheds", "queue_depth", "active_rows", "kv_blocks_free",
+    "mfu", "mbu", "trace_ids",
+)
+_N_VALUES = len(FIELDS) - 1   # appender supplies everything but seq
+
+# Counter tracks the Perfetto export draws, in render order. Each is a
+# "C" event series named after the field; None values (e.g. mfu before
+# peaks are known) simply skip that sample — absent, not zero.
+COUNTER_TRACKS: Tuple[str, ...] = (
+    "mfu", "mbu", "active_rows", "queue_depth", "kv_blocks_free",
+    "decode_tokens",
+)
+
+
+class FlightRecorder:
+    """Preallocated ring of per-tick records.
+
+    ``append`` takes the :data:`FIELDS` values *after* ``seq`` as
+    positional arguments and writes one tuple into the ring slot —
+    deliberately no kwargs, no dict: the driver tick calls this at
+    device-step rate and the whole point of the recorder is to cost
+    (asserted) <1% of a tick.
+    """
+
+    def __init__(self, capacity: int = 2048) -> None:
+        self.capacity = max(16, int(capacity))
+        self._buf: List[Optional[tuple]] = [None] * self.capacity
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    @property
+    def seq(self) -> int:
+        """Total records ever appended (next record's seq)."""
+        return self._seq
+
+    def append(self, *values) -> None:
+        if len(values) != _N_VALUES:
+            raise ValueError(
+                f"flight record takes {_N_VALUES} values, got {len(values)}")
+        with self._lock:
+            self._buf[self._seq % self.capacity] = (self._seq, *values)
+            self._seq += 1
+
+    def snapshot(self, since_seq: int = -1,
+                 limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Records with ``seq > since_seq`` (oldest first) as dicts,
+        optionally capped to the NEWEST ``limit`` records."""
+        with self._lock:
+            seq = self._seq
+            start = max(0, seq - self.capacity, since_seq + 1)
+            if limit is not None:
+                start = max(start, seq - int(limit))
+            rows = [self._buf[i % self.capacity] for i in range(start, seq)]
+        return [dict(zip(FIELDS, row)) for row in rows if row is not None]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf = [None] * self.capacity
+            self._seq = 0
+
+
+# ------------------------------------------------------------------
+# Per-process registry: one recorder per process (the engine driver and
+# the worker piggyback share it), plus a ship cursor so piggybacked
+# increments don't resend the whole ring on every call response.
+_REG_LOCK = threading.Lock()
+_RECORDER: Optional[FlightRecorder] = None
+_SHIPPED_SEQ = -1
+
+
+def enabled() -> bool:
+    return not env_bool("KT_FLIGHT_DISABLE")
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    """This process's recorder (created on first use), or None when
+    ``KT_FLIGHT_DISABLE`` is set."""
+    global _RECORDER
+    if not enabled():
+        return None
+    if _RECORDER is None:
+        with _REG_LOCK:
+            if _RECORDER is None:
+                _RECORDER = FlightRecorder(env_int("KT_FLIGHT_RING"))
+    return _RECORDER
+
+
+def reset() -> None:
+    """Drop the process recorder + ship cursor (tests)."""
+    global _RECORDER, _SHIPPED_SEQ
+    with _REG_LOCK:
+        _RECORDER = None
+        _SHIPPED_SEQ = -1
+
+
+def incremental(limit: int = 256) -> Optional[List[Dict[str, Any]]]:
+    """Records appended since the last ship (the worker->pod piggyback),
+    capped to the newest ``limit``; None when nothing new. Advances the
+    cursor — each record ships at most once."""
+    global _SHIPPED_SEQ
+    rec = _RECORDER
+    if rec is None or rec.seq == 0:
+        return None
+    with _REG_LOCK:
+        since = _SHIPPED_SEQ
+        if rec.seq <= since + 1:
+            return None
+        rows = rec.snapshot(since_seq=since, limit=limit)
+        if rows:
+            _SHIPPED_SEQ = rows[-1]["seq"]
+    return rows or None
+
+
+def dump_report(out_dir: str,
+                by_proc: Optional[Dict[Any, List[dict]]] = None,
+                ) -> Optional[Path]:
+    """Write ``flight-<pid>.json`` into ``out_dir``: this process's
+    ring plus any piggybacked worker rings (``by_proc``). Best-effort —
+    this runs on the preemption/emergency exit path, which must never
+    fail on its own observability."""
+    try:
+        rec = _RECORDER
+        own = rec.snapshot() if rec is not None else []
+        procs = {str(k): list(v) for k, v in (by_proc or {}).items()}
+        if not own and not procs:
+            return None
+        out = Path(out_dir)
+        out.mkdir(parents=True, exist_ok=True)
+        path = out / f"flight-{os.getpid()}.json"
+        path.write_text(json.dumps({
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "wall": time.time(),
+            "records": own,
+            "procs": procs,
+        }, sort_keys=True) + "\n")
+        return path
+    except Exception:  # ktlint: disable=KT004 -- exit path, best-effort
+        return None
+
+
+def maybe_dump(by_proc: Optional[Dict[Any, List[dict]]] = None,
+               ) -> Optional[Path]:
+    """``dump_report`` into ``KT_FLIGHT_DIR`` when set, else no-op."""
+    out = env_str("KT_FLIGHT_DIR")
+    if not out:
+        return None
+    return dump_report(out, by_proc=by_proc)
+
+
+# ------------------------------------------------------------------
+# Merge + Perfetto export (the `ktpu flight` path).
+
+def merge_procs(groups: Iterable[Tuple[Any, Iterable[dict]]],
+                ) -> Dict[str, List[dict]]:
+    """Normalize (proc-label, records) pairs into a per-proc map with
+    records ordered and deduped by seq — ring increments may overlap
+    across control-op polls."""
+    merged: Dict[str, List[dict]] = {}
+    for label, rows in groups:
+        by_seq: Dict[int, dict] = {
+            int(r["seq"]): r for r in merged.get(str(label), [])
+            if isinstance(r, dict) and "seq" in r}
+        for r in rows or []:
+            if isinstance(r, dict) and "seq" in r:
+                by_seq[int(r["seq"])] = r
+        merged[str(label)] = [by_seq[s] for s in sorted(by_seq)]
+    return merged
+
+
+def to_perfetto(records_by_proc: Dict[Any, List[dict]],
+                extra_events: Optional[List[dict]] = None) -> Dict[str, Any]:
+    """Chrome/Perfetto ``trace_event`` JSON: one Perfetto process per
+    flight ring, :data:`COUNTER_TRACKS` as "C" counter series, and one
+    instant event per tick whose args carry ``seq``, the host/device
+    decomposition, and the live ``trace_ids`` — the same ids PR-4 spans
+    (``ktpu trace`` / ``tracing.to_trace_events``) carry, so loading
+    both (or passing spans via ``extra_events``) stitches a stalled
+    tick to the calls it was serving."""
+    events: List[dict] = []
+    for n, label in enumerate(sorted(records_by_proc), start=1):
+        events.append({"ph": "M", "name": "process_name", "pid": n,
+                       "tid": 0, "args": {"name": f"flight/{label}"}})
+        events.append({"ph": "M", "name": "thread_name", "pid": n,
+                       "tid": 1, "args": {"name": "engine-driver"}})
+        for rec in records_by_proc[label]:
+            if not isinstance(rec, dict):
+                continue
+            ts = float(rec.get("t_wall", 0.0)) * 1e6
+            for track in COUNTER_TRACKS:
+                value = rec.get(track)
+                if value is None:
+                    continue
+                events.append({"ph": "C", "name": track, "cat": "flight",
+                               "pid": n, "tid": 0, "ts": ts,
+                               "args": {track: float(value)}})
+            events.append({
+                "ph": "i", "s": "t", "name": "tick", "cat": "flight",
+                "pid": n, "tid": 1, "ts": ts,
+                "args": {
+                    "seq": rec.get("seq"),
+                    "tick_s": rec.get("tick_s"),
+                    "device_s": rec.get("device_s"),
+                    "host_s": rec.get("host_s"),
+                    "decode_tokens": rec.get("decode_tokens"),
+                    "trace_ids": list(rec.get("trace_ids") or ()),
+                },
+            })
+    if extra_events:
+        events.extend(extra_events)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

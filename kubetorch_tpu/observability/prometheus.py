@@ -710,6 +710,15 @@ _ENGINE_GAUGES = {
     "adapter_resident_set": "engine_adapter_resident",
     "phase": "engine_phase",
     "row_eta_seconds": "engine_row_eta_seconds",
+    # device-truth utilization plane (observability/devstats.py): like
+    # kv_blocks_free these are deliberately NOT pre-seeded — MFU/MBU
+    # only exist once hardware peaks are known (a 0.0 seed on a CPU
+    # pod would scrape as "idle accelerator"), and the HBM gauges only
+    # once a device backend reports memory stats
+    "mfu": "engine_mfu",
+    "mbu": "engine_mbu",
+    "hbm_used_bytes": "hbm_used_bytes",
+    "hbm_limit_bytes": "hbm_limit_bytes",
 }
 
 
@@ -728,7 +737,8 @@ def record_engine(event: str, value: float = 1.0) -> None:
     (``queue_depth`` / ``active_rows`` / ``free_rows`` /
     ``prefilling_rows`` / ``kv_blocks_used`` / ``kv_blocks_free`` /
     ``spec_accept_rate`` / ``spec_k_cap`` / ``adapter_resident_set`` /
-    ``phase`` / ``row_eta_seconds``)."""
+    ``phase`` / ``row_eta_seconds`` / ``mfu`` / ``mbu`` /
+    ``hbm_used_bytes`` / ``hbm_limit_bytes``)."""
     with _ENGINE_LOCK:
         counter = _ENGINE_EVENTS.get(event)
         if counter is not None:

@@ -254,6 +254,21 @@ _m("engine_row_eta_seconds", "gauge",
    "Earliest expected row-free time (0 with a free row; else queue "
    "depth x the row-free EMA, repriced by live speculation state) — "
    "the decode-tier routing currency.", "engine")
+_m("engine_mfu", "gauge",
+   "Model FLOPs utilization over the last gauge window: compiled-"
+   "executable FLOPs (cost_analysis) over measured dispatch wall x "
+   "peak FLOP/s. Only published when the chip's peaks are known.",
+   "engine")
+_m("engine_mbu", "gauge",
+   "HBM-bandwidth utilization over the last gauge window: executable "
+   "bytes-accessed over measured dispatch wall x peak HBM bytes/s. "
+   "Only published when the chip's peaks are known.", "engine")
+_m("hbm_used_bytes", "gauge",
+   "Accelerator memory in use, summed over this engine's local "
+   "devices (absent on CPU-only pods — absent, not zero).", "engine")
+_m("hbm_limit_bytes", "gauge",
+   "Accelerator memory capacity, summed over local devices (absent "
+   "on CPU-only pods).", "engine")
 
 # --- multi-tenant LoRA adapter pool (this PR) -------------------------------
 _m("engine_adapter_loads_total", "counter",

@@ -92,12 +92,12 @@ import hashlib
 import queue as _queue
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from kubetorch_tpu.config import env_float, env_int, env_str
 from kubetorch_tpu.exceptions import DeadlineExceeded, ServerOverloaded
 from kubetorch_tpu.lookahead import LookaheadState, spec_stats_dict
-from kubetorch_tpu.observability import tracing
+from kubetorch_tpu.observability import devstats, flight, tracing
 from kubetorch_tpu.serving import kvpool
 from kubetorch_tpu.serving.replay import retry_after_estimate
 
@@ -507,6 +507,10 @@ class DecodeEngine:
         # in the driver thread (no ambient span), so the TTFT histogram
         # exemplar is captured at submit and carried to the observation
         self._submit_trace: Dict[int, Optional[str]] = {}
+        # rid -> trace id for the row's WHOLE residency (the TTFT map
+        # above is consumed at first token): the flight recorder stamps
+        # each tick with the trace ids live in the batch
+        self._row_trace: Dict[int, Optional[str]] = {}
         self._exec_counts: Dict[str, int] = {}
         # seconds-per-row-freed EMA — the admission estimate's clock
         # (same role the session's ema_exec_s plays for call shedding)
@@ -516,10 +520,25 @@ class DecodeEngine:
         self._steps = 0
         self._tokens = 0
         self._device_s = 0.0
+        self._prefill_s = 0.0
         self._prefill_chunks = 0
         self._admitted = 0
         self._parks = 0
         self._restores = 0
+        self._evictions = 0
+        self._sheds = 0
+        # --- device-truth utilization + flight recorder ---------------
+        # MFU/MBU window state: (flops_total, bytes_total, measured
+        # dispatch wall) at the last gauge publish; gauges are the
+        # window's delta ratios against the chip peaks. None until the
+        # generator exposes a devstats surface AND peaks are known.
+        self._util_prev = (0.0, 0.0, 0.0)
+        self._mfu: Optional[float] = None
+        self._mbu: Optional[float] = None
+        self._hbm_t = 0.0            # last memory_stats poll (monotonic)
+        # per-tick black box: one record per driver tick, None when
+        # KT_FLIGHT_DISABLE is set
+        self._flight = flight.get_recorder()
         self._stop = False
         # the phase gauge must be visible BEFORE any traffic: the
         # controller's phase routing reads it to classify an idle tier
@@ -601,6 +620,7 @@ class DecodeEngine:
                 self._sinks[rid] = sink
                 self._submit_t[rid] = now
                 self._submit_trace[rid] = submit_trace
+                self._row_trace[rid] = submit_trace
                 if deadline is not None:
                     self._deadlines[rid] = deadline
                 self._restores += 1
@@ -613,6 +633,7 @@ class DecodeEngine:
                 self._sinks[rid] = sink
                 self._submit_t[rid] = now
                 self._submit_trace[rid] = submit_trace
+                self._row_trace[rid] = submit_trace
                 if deadline is not None:
                     self._deadlines[rid] = deadline
                 self._handoff_imports += 1
@@ -694,6 +715,7 @@ class DecodeEngine:
                         self._sinks[rid] = sink
                         self._submit_t[rid] = now
                         self._submit_trace[rid] = submit_trace
+                        self._row_trace[rid] = submit_trace
                         if deadline is not None:
                             self._deadlines[rid] = deadline
                         # prefix_pid=pid covers explicit prefix_ids too:
@@ -801,6 +823,7 @@ class DecodeEngine:
                     for rid in live:
                         self.engine.evict(rid)
                         self._release_locked(rid)
+                        self._evictions += 1
                         _record_engine("evict")
 
     def register_prefix(self, tokens, adapter_id: int = -1,
@@ -904,6 +927,23 @@ class DecodeEngine:
             "kv_offloads": self._parks,
             "kv_restores": self._restores,
         }
+        # device-truth utilization + flight-recorder state (both
+        # conditional — absent means "plane not active here")
+        if self._mfu is not None:
+            out["mfu"] = round(self._mfu, 4)
+            out["mbu"] = round(self._mbu, 4)
+        if self._flight is not None:
+            out["flight_seq"] = self._flight.seq
+        snap_fn = getattr(eng, "devstats_snapshot", None)
+        if snap_fn is not None:
+            try:
+                snap = snap_fn()
+                out["devstats_flops_total"] = snap["flops_total"]
+                out["devstats_bytes_total"] = snap["bytes_total"]
+                out["devstats_dispatches"] = int(snap["dispatches_total"])
+            # ktlint: disable=KT004 -- stats are advisory; never fail a control frame
+            except Exception:  # noqa: BLE001
+                pass
         if self._adapter_pool is not None:
             ps = self._adapter_pool.stats()
             out.update({
@@ -1075,6 +1115,7 @@ class DecodeEngine:
         self._deadlines.pop(rid, None)
         self._submit_t.pop(rid, None)
         self._submit_trace.pop(rid, None)
+        self._row_trace.pop(rid, None)
 
     def _check_session_free_locked(self, session_id: str) -> None:
         if session_id in self._live_sessions:
@@ -1160,6 +1201,7 @@ class DecodeEngine:
         if slot is not None:
             return slot
         retry_after = pool.load_eta(name)
+        self._sheds += 1
         _record_engine("shed")
         _record_adapter(name, "shed")
         tracing.record_span(
@@ -1318,6 +1360,7 @@ class DecodeEngine:
                 max(1, need), 1,
                 max(self._ema_block_s, self._ema_row_s),
                 cap_s=max_delay)
+            self._sheds += 1
             _record_engine("shed")
             if name is not None:
                 _record_adapter(name, "shed")
@@ -1424,6 +1467,7 @@ class DecodeEngine:
             ema = self._ema_block_s if short else self._ema_row_s
             retry_after = retry_after_estimate(
                 max(short, waiting + n_new), 1, ema, cap_s=max_delay)
+            self._sheds += 1
             _record_engine("shed")
             if prog.adapter is not None:
                 _record_adapter(prog.adapter, "shed")
@@ -1481,6 +1525,15 @@ class DecodeEngine:
 
     def _tick_locked(self) -> None:
         eng = self.engine
+        tick_t0 = time.perf_counter()
+        # flight-record baseline: per-tick deltas of the cumulative
+        # scheduler counters (cheap tuple of ints, taken before any
+        # tick work so the record covers exactly this tick)
+        fl_prev = (self._admitted, self._prefill_chunks, self._evictions,
+                   self._parks, self._handoffs + self._handoff_imports,
+                   self._sheds, getattr(eng, "prefill_tokens", 0),
+                   getattr(eng, "_spec_rounds", 0),
+                   getattr(eng, "_spec_emitted", 0))
         now = time.time()
         # ---- deadline eviction (row-granular) ------------------------
         for rid, dl in list(self._deadlines.items()):
@@ -1507,6 +1560,7 @@ class DecodeEngine:
                 eng.evict(rid)
                 sink = self._sinks.get(rid)
                 self._release_locked(rid)
+                self._evictions += 1
                 _record_engine("evict")
                 if state is not None:
                     self._offload_async(session, state)
@@ -1540,12 +1594,15 @@ class DecodeEngine:
                 attrs={"rows": admitted})
         # ---- one chunked-prefill dispatch, interleaved ---------------
         t0 = time.perf_counter()
+        prefill_dt = 0.0
         if eng.prefilling_rows:
             eng.prefill_step()
+            prefill_dt = time.perf_counter() - t0
+            self._prefill_s += prefill_dt
             self._prefill_chunks += 1
             _record_engine("prefill_chunk")
             tracing.record_span(
-                "engine.prefill", time.perf_counter() - t0,
+                "engine.prefill", prefill_dt,
                 attrs={"rows": eng.prefilling_rows})
         # ---- handoff exports (disaggregated prefill tier) ------------
         # BEFORE the decode step: a handoff row must ship with zero
@@ -1630,6 +1687,43 @@ class DecodeEngine:
             self._last_free_t = None
         self._spec_tick_locked()
         self._publish_gauges()
+        self._flight_append_locked(
+            tick_t0, fl_prev, prefill_dt + (dt if events else 0.0),
+            sum(len(t) for _, t, _ in events))
+
+    def _flight_append_locked(self, tick_t0: float, prev: tuple,
+                              device_dt: float,
+                              decode_tokens: int) -> None:
+        """One flight record for the tick that just ran: stamps, the
+        host/device decomposition, per-tick scheduler deltas, load, the
+        devstats window's MFU/MBU, and the live programs' trace ids —
+        the join key against PR-4 spans. One ring-slot tuple write;
+        asserted <1% of a driver tick by the dryrun bench."""
+        fl = self._flight
+        if fl is None:
+            return
+        try:
+            eng = self.engine
+            a0, p0, e0, k0, h0, s0, pt0, sr0, se0 = prev
+            tick_s = time.perf_counter() - tick_t0
+            trace_ids = tuple(sorted(
+                {t for t in self._row_trace.values() if t}))[:8]
+            fl.append(
+                time.time(), time.monotonic(), tick_s, device_dt,
+                max(0.0, tick_s - device_dt),
+                self._admitted - a0, self._prefill_chunks - p0,
+                getattr(eng, "prefill_tokens", 0) - pt0, decode_tokens,
+                getattr(eng, "_spec_rounds", 0) - sr0,
+                getattr(eng, "_spec_emitted", 0) - se0,
+                self._evictions - e0, self._parks - k0,
+                self._handoffs + self._handoff_imports - h0,
+                self._sheds - s0, int(eng.queued), int(eng.active_rows),
+                (float(self._kv.free_blocks) if self._kv.ledger.budget
+                 else None),
+                self._mfu, self._mbu, trace_ids)
+        # ktlint: disable=KT004 -- the black box must never fail the tick it records
+        except Exception:  # noqa: BLE001
+            pass
 
     def _spec_tick_locked(self) -> None:
         """Aggregate-lookahead throttle + spec telemetry, once per
@@ -1837,6 +1931,7 @@ class DecodeEngine:
         from kubetorch_tpu.resilience import chaos
 
         if chaos.maybe(chaos.HANDOFF_DROP, handoff_id):
+            self._sheds += 1
             _record_engine("shed")
             raise ServerOverloaded(
                 f"decode pod dropped mid-handoff of {handoff_id} "
@@ -1900,6 +1995,41 @@ class DecodeEngine:
             _record_engine("kv_blocks_free", float(self._kv.free_blocks))
         _record_engine("phase", float(_PHASE_CODE[self._phase]))
         _record_engine("row_eta_seconds", self._row_eta_locked())
+        self._publish_utilization()
+
+    def _publish_utilization(self) -> None:
+        """Window MFU/MBU off the generator's devstats surface + HBM
+        occupancy off ``memory_stats()``. All three gauge families are
+        conditional (absent, not zero): no devstats surface, unknown
+        chip peaks, or an empty measurement window publish nothing."""
+        eng = self.engine
+        snap_fn = getattr(eng, "devstats_snapshot", None)
+        peaks_fn = getattr(eng, "devstats_peaks", None)
+        if snap_fn is not None and peaks_fn is not None:
+            try:
+                snap = snap_fn()
+                peaks = peaks_fn()
+                wall = self._device_s + self._prefill_s
+                f0, b0, w0 = self._util_prev
+                util = devstats.utilization(
+                    snap["flops_total"] - f0, snap["bytes_total"] - b0,
+                    wall - w0, peaks)
+                if util is not None:
+                    self._mfu, self._mbu = util
+                    self._util_prev = (snap["flops_total"],
+                                       snap["bytes_total"], wall)
+                    _record_engine("mfu", self._mfu)
+                    _record_engine("mbu", self._mbu)
+            # ktlint: disable=KT004 -- utilization is best-effort; the driver tick must survive it
+            except Exception:  # noqa: BLE001
+                pass
+        now = time.monotonic()
+        if now - self._hbm_t >= 0.5:      # memory_stats at ~2 Hz, not
+            self._hbm_t = now             # per-tick — it's a runtime RPC
+            hbm = devstats.hbm_stats()
+            if hbm is not None:
+                _record_engine("hbm_used_bytes", hbm["hbm_used_bytes"])
+                _record_engine("hbm_limit_bytes", hbm["hbm_limit_bytes"])
 
 
 class SimRollingEngine:
@@ -1970,6 +2100,16 @@ class SimRollingEngine:
         # rid -> lookahead at completion (bench convergence probe;
         # bounded — oldest entries drop)
         self.spec_k_done: Dict[int, int] = {}
+        # device-truth twin (observability/devstats.py): nominal
+        # per-token FLOPs / per-dispatch HBM bytes plus settable "chip"
+        # peaks, so the MFU/MBU plane (gauges -> flight records ->
+        # `ktpu top` columns) runs CPU-only and deterministically.
+        # Defaults model a ~1B-param bf16 model on a nominal chip.
+        self.sim_flops_per_token = 2.0e9
+        self.sim_bytes_per_dispatch = 2.0e9
+        self.peak_flops = 100e12
+        self.peak_bw = 1.0e12
+        self._devstats = devstats.AnalyticCosts()
 
     # -------------------------------------------------------- interface
     @staticmethod
@@ -2064,13 +2204,18 @@ class SimRollingEngine:
         if self.prefill_s:
             time.sleep(self.prefill_s)
         activated = []
+        chunk_toks = 0
         for rid, req in list(self._prefilling.items()):
+            before = req["consumed"]
             req["consumed"] = min(len(req["prompt"]),
                                   req["consumed"] + self.prefill_chunk)
+            chunk_toks += req["consumed"] - before
             if req["consumed"] >= len(req["prompt"]):
                 del self._prefilling[rid]
                 self._rows[rid] = req
                 activated.append(rid)
+        self._devstats.count(chunk_toks * self.sim_flops_per_token,
+                             self.sim_bytes_per_dispatch)
         return activated
 
     def decode_step(self):
@@ -2098,7 +2243,18 @@ class SimRollingEngine:
                     if len(self.spec_k_done) >= 4096:
                         self.spec_k_done.pop(next(iter(self.spec_k_done)))
                     self.spec_k_done[rid] = st.k
+        self._devstats.count(
+            sum(len(t) for _, t, _ in events) * self.sim_flops_per_token,
+            self.sim_bytes_per_dispatch)
         return events
+
+    def devstats_snapshot(self) -> Dict[str, float]:
+        """Same surface as ``RollingGenerator.devstats_snapshot`` —
+        analytic costs instead of compiled ``cost_analysis()``."""
+        return self._devstats.snapshot()
+
+    def devstats_peaks(self) -> Tuple[float, float]:
+        return (self.peak_flops, self.peak_bw)
 
     # ------------------------------------------------------ spec twin
     def _accept_rate(self, prompt) -> float:

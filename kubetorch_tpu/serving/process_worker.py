@@ -183,6 +183,16 @@ def _attach_worker_metrics(agg: Dict[str, int]) -> None:
             graph = san.snapshot_graph_if_changed()
             if graph is not None:
                 agg["san_graph"] = graph
+        # engine flight recorder: ship the per-tick records appended
+        # since the last call response (ring increments, each record at
+        # most once). Same rationale as the san graph — the worker dies
+        # with the pod's os._exit, so the pod keeps the merged rings
+        # and serves /_flight + dumps flight-<pid>.json from them.
+        fl = sys.modules.get("kubetorch_tpu.observability.flight")
+        if fl is not None:
+            records = fl.incremental()
+            if records:
+                agg["flight"] = {"pid": os.getpid(), "records": records}
     # ktlint: disable=KT004 -- metrics piggyback must never break a call
     except Exception:
         pass

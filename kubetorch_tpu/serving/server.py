@@ -111,6 +111,12 @@ class PodServer:
         # exemplars freshest-wins — the merged view renders on /metrics
         # and ships to the controller in telemetry frames
         self._hists_by_proc: Dict[Any, Dict[str, Any]] = {}
+        # engine flight-recorder rings per worker process (piggybacked
+        # increments, deduped by seq, bounded per proc): the pod is the
+        # export surface (/_flight, the "flight" control op) and the
+        # dump site (flight-<pid>.json on preemption) — workers die
+        # with the pod's os._exit and cannot dump their own rings
+        self._flight_by_proc: Dict[Any, List[dict]] = {}
         # fleet telemetry plane: the delta baseline (values last
         # shipped), the POST-fallback backlog (bounded — an unreachable
         # controller must not grow memory), and the frame counter that
@@ -163,6 +169,7 @@ class PodServer:
         app.router.add_get("/ready", self.h_ready)
         app.router.add_get("/metrics", self.h_metrics)
         app.router.add_get("/_trace", self.h_trace)
+        app.router.add_get("/_flight", self.h_flight)
         app.router.add_get("/app/status", self.h_app_status)
         app.router.add_get("/_channel", self.h_channel)
         app.router.add_post("/_reload", self.h_reload)
@@ -458,10 +465,13 @@ class PodServer:
         if self.terminating:
             return
         self.terminating = True
-        # dump the sanitizer graph NOW, not after the drain: the grace
-        # backstop may os._exit mid-drain and the graph is already
-        # complete at SIGTERM time (the write is milliseconds)
+        # dump the sanitizer graph and the flight rings NOW, not after
+        # the drain: the grace backstop may os._exit mid-drain and both
+        # are already complete at SIGTERM time (the writes are
+        # milliseconds). The flight dump is the black box this record
+        # exists for — the ticks leading INTO the preemption.
         self._dump_san_report()
+        self._dump_flight_report()
         loop = asyncio.get_event_loop()
         from kubetorch_tpu.resilience.preemption import PreemptionHandler
 
@@ -493,6 +503,35 @@ class PodServer:
         # ktlint: disable=KT004 -- exit path: the dump is best-effort
         except Exception:  # noqa: BLE001
             pass
+
+    def _dump_flight_report(self):
+        """Write ``flight-<pid>.json`` (this process's ring + the
+        workers' piggybacked rings) into ``KT_FLIGHT_DIR`` on every
+        deliberate exit path — the per-tick black box an operator reads
+        after a preemption or stall. No-op when the knob is unset."""
+        try:
+            from kubetorch_tpu.observability import flight
+
+            flight.maybe_dump(by_proc=self._flight_by_proc)
+        # ktlint: disable=KT004 -- exit path: the dump is best-effort
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _merged_flight(self, limit: Optional[int] = None
+                       ) -> Dict[str, List[dict]]:
+        """Per-proc flight records: the workers' piggybacked rings plus
+        this process's own recorder (in-process engines, e.g. tests)."""
+        from kubetorch_tpu.observability import flight
+
+        groups = [(pid, rows) for pid, rows in
+                  self._flight_by_proc.items()]
+        rec = flight.get_recorder()
+        if rec is not None and rec.seq:
+            groups.append((os.getpid(), rec.snapshot()))
+        merged = flight.merge_procs(groups)
+        if limit is not None:
+            merged = {k: v[-int(limit):] for k, v in merged.items()}
+        return merged
 
     async def _start_app_cmd(self):
         cmd = self.metadata.get("app_cmd")
@@ -647,6 +686,25 @@ class PodServer:
             snap = hists.get("h") if isinstance(hists, dict) else None
             if isinstance(snap, dict):
                 self._hists_by_proc[pid] = snap
+        flight_inc = stats.pop("flight", None)
+        if flight_inc:
+            # flight-ring increments (worker piggyback): extend the
+            # per-proc merged ring, deduped by seq, bounded to the ring
+            # capacity's order so a chatty worker can't grow pod memory
+            try:
+                pid = flight_inc.get("pid", 0)
+                rows = flight_inc.get("records") or []
+                have = self._flight_by_proc.get(pid) or []
+                by_seq = {int(r["seq"]): r for r in have
+                          if isinstance(r, dict) and "seq" in r}
+                for r in rows:
+                    if isinstance(r, dict) and "seq" in r:
+                        by_seq[int(r["seq"])] = r
+                self._flight_by_proc[pid] = [
+                    by_seq[s] for s in sorted(by_seq)][-4096:]
+            # ktlint: disable=KT004 -- observability piggyback must never break a call
+            except Exception:  # noqa: BLE001
+                pass
         san_graph = stats.pop("san_graph", None)
         if san_graph:
             # KT_SAN=1: fold the worker's lock-order graph into THIS
@@ -911,6 +969,30 @@ class PodServer:
             return web.json_response({"spans": spans})
         return web.json_response(tracing.to_trace_events(spans))
 
+    async def h_flight(self, request):
+        """Export the engine flight rings (per-tick black box): the
+        worker processes' piggybacked records merged with this
+        process's own recorder. Default: ``{"procs": {pid:
+        [records...]}}`` — what ``ktpu flight`` merges fleet-wide.
+        ``?format=perfetto`` returns a ui.perfetto.dev-loadable
+        trace_event file (counter tracks + per-tick instants carrying
+        the live trace ids); ``?last=N`` caps each proc's records to
+        the newest N."""
+        last = request.query.get("last")
+        limit: Optional[int] = None
+        if last:
+            try:
+                limit = max(1, int(last))
+            except ValueError:
+                limit = None
+        merged = self._merged_flight(limit=limit)
+        if request.query.get("format") == "perfetto":
+            from kubetorch_tpu.observability import flight
+
+            return web.json_response(flight.to_perfetto(merged))
+        return web.json_response({"pod": env_str("KT_POD_NAME") or "",
+                                  "procs": merged})
+
     async def h_reload(self, request):
         """Controller push-reload: new metadata (+ freshly synced code)."""
         try:
@@ -937,6 +1019,7 @@ class PodServer:
 
     async def h_teardown(self, request):
         self._dump_san_report()
+        self._dump_flight_report()
         asyncio.get_event_loop().call_later(0.2, os._exit, 0)
         return web.json_response({"terminating": True})
 
@@ -1525,9 +1608,19 @@ class PodServer:
             **session.describe(),
         }
         engine = {k: v for k, v in self.metrics.items()
-                  if k.startswith(("engine_", "kv_", "prefix_"))}
+                  if k.startswith(("engine_", "kv_", "prefix_",
+                                   "hbm_"))}
         if engine:
             info["engine"] = engine
+        if info["op"] == "flight":
+            # flight control op: the per-tick rings out-of-band — the
+            # same records /_flight serves, reachable over an already-
+            # open channel (no second HTTP connection needed)
+            try:
+                limit = int(header.get("last") or 512)
+            except (TypeError, ValueError):
+                limit = 512
+            info["flight"] = self._merged_flight(limit=max(1, limit))
         async with session.send_lock:
             await ws.send_bytes(frames.pack_envelope(
                 {"kind": "result", "ser": "json",

@@ -28,6 +28,10 @@ EXPECTED_KEYS = {
     "controller_restart_spurious_restarts",
     "controller_restart_budget_carried",
     "controller_rejoin_grace_s",
+    # ISSUE 19 flight-recorder preemption-dump leg
+    "flight_dump_ok",
+    "flight_dump_records",
+    "flight_dump_s",
 }
 
 
@@ -69,3 +73,7 @@ def test_resilience_dryrun_metric_keys():
     assert out["controller_restart_budget_carried"] >= 1, out
     assert 0 < out["controller_recovery_s"] <= (
         out["controller_rejoin_grace_s"] + max(4 * hb, 2.0)), out
+    # flight recorder (ISSUE 19): the preemption dump must exist, parse,
+    # and carry the driver ticks the sim engine just ran
+    assert out["flight_dump_ok"] == 1.0, out
+    assert out["flight_dump_records"] > 0, out

@@ -51,6 +51,9 @@ EXPECTED_KEYS = {
     "engine_poisson_goodput_ratio",
     "engine_prefill_interleave_ok",
     "engine_admit_to_first_token_chunks",
+    # flight recorder (ISSUE 19): the per-tick black box must cost well
+    # under 1% of a working driver tick
+    "flight_overhead_pct",
     # paged KV + prefix cache (ISSUE 11): prefill tokens saved by
     # automatic prefix sharing, and park→resume TTFT in decode chunks
     "prefix_kv_programs",
@@ -164,6 +167,9 @@ def test_serving_dryrun_metric_keys():
         f"{out['engine_admit_to_first_token_chunks']} ticks for an "
         f"8-chunk prompt")
     assert out["engine_dispatch_ms_per_chunk"] < out["engine_step_ms_cfg"]
+    # flight recorder (ISSUE 19): one ring append per driver tick must
+    # stay under 1% of a working tick's wall
+    assert 0 <= out["flight_overhead_pct"] < 1.0, out["flight_overhead_pct"]
     # CI floor (the full bench asserts the 0.9 acceptance bar itself;
     # a loaded CI host gets headroom)
     assert out["engine_tunnel_ratio"] > 0.5, out["engine_tunnel_ratio"]
