@@ -700,6 +700,16 @@ def _top_gather(controller, service, window):
             raise   # mid-gather death: demote, same as fleet_metrics
         except Exception:  # noqa: BLE001 — SLOs may be unconfigured
             entry["slo"] = []
+        try:
+            # desired/actual replica view from the fleet scaler
+            # (ISSUE 20); an older controller without /scale renders
+            # no replica column rather than an error row
+            entry["scale"] = ((controller.scaler_status(svc)
+                               or {}).get("services") or {}).get(svc)
+        except httpx.TransportError:
+            raise
+        except Exception:  # noqa: BLE001
+            entry["scale"] = None
         out[svc] = entry
     return out
 
@@ -810,7 +820,21 @@ def _top_render(snapshot, window):
                 f"{obj.get('name')}={state} "
                 f"burn={obj.get('burn_rate', 0):g}x "
                 f"budget={obj.get('error_budget_remaining', 1):g}")
-        lines.append(f"{svc}  (window {window:g}s)"
+        scale_bits = ""
+        sc = entry.get("scale") or {}
+        if sc.get("desired") is not None or sc.get("actual") is not None:
+            actual = sc.get("actual")
+            desired = sc.get("desired")
+            scale_bits = (f"  replicas: "
+                          f"{actual if actual is not None else '?'}"
+                          f"/{desired if desired is not None else '?'}"
+                          f" desired")
+            if sc.get("override") is not None:
+                scale_bits += f" (pinned {sc['override']})"
+            if (sc.get("cooldown_remaining_s") or 0) > 0:
+                scale_bits += (f" (cooldown "
+                               f"{sc['cooldown_remaining_s']:g}s)")
+        lines.append(f"{svc}  (window {window:g}s){scale_bits}"
                      + (f"  SLO: {'; '.join(slo_bits)}" if slo_bits
                         else ""))
         if entry.get("error"):
@@ -1329,12 +1353,64 @@ def port_forward(service, port, target_port):
 
 @main.command()
 @click.argument("service")
-@click.argument("replicas", type=int)
-def scale(service, replicas):
-    """Scale a deployed service to N replicas."""
+@click.argument("replicas", type=int, required=False)
+@click.option("--auto", "auto", is_flag=True,
+              help="clear the manual override and hand the service "
+                   "back to the automatic scaler")
+def scale(service, replicas, auto):
+    """Scale a deployed service to N replicas.
+
+    With a reachable controller this writes a DURABLE manual-override
+    row (the fleet scaler enforces the pin — across controller
+    restarts — until ``ktpu scale <svc> --auto`` clears it) and
+    actuates through the service's provisioning backend. Without one
+    it falls back to the pre-ISSUE-20 behavior: a direct Deployment
+    replica merge-patch against the cluster."""
+    import httpx
+
     from kubetorch_tpu.controller.client import ControllerClient
+    from kubetorch_tpu.exceptions import KubetorchError
 
     controller = ControllerClient.maybe()
+    if auto:
+        if replicas is not None:
+            raise click.ClickException(
+                "--auto takes no replica count (it clears the pin)")
+        if controller is None:
+            raise click.ClickException(
+                "--auto needs a controller (the override row lives "
+                "there; KT_CONTROLLER_URL / ktpu config "
+                "controller_url=...)")
+        result = controller.scale_auto(service)
+        if result.get("cleared"):
+            click.echo(f"{service}: override cleared"
+                       + ("" if result.get("auto")
+                          else " (automatic scaling is off — "
+                               "KT_SCALE_ENABLE=1 on the controller "
+                               "turns the loop on)"))
+        else:
+            click.echo(f"{service}: no override was set")
+        return
+    if replicas is None:
+        raise click.ClickException("replica count required (or --auto)")
+    if controller is not None:
+        try:
+            controller.scale(service, replicas)
+            click.echo(f"scaled {service} to {replicas} (durable "
+                       f"override; `ktpu scale {service} --auto` "
+                       f"resumes autoscaling)")
+            return
+        except httpx.TransportError:
+            click.echo("# controller unreachable — falling back to a "
+                       "direct replica patch", err=True)
+            controller = None
+        except KubetorchError as exc:
+            # a pool the controller never registered (deployed
+            # out-of-band) still has a Deployment to patch; real
+            # controller errors surface
+            if "404" not in str(exc):
+                raise click.ClickException(str(exc))
+            controller = None  # fall through to the direct patch
     # merge-patch: touch only replicas (a server-side apply under the
     # deploy path's fieldManager would prune the rest of the spec).
     from kubetorch_tpu.config import get_config

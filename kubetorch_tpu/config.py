@@ -604,7 +604,8 @@ _knob("KT_RESTART_RESET_S", "float", 300.0,
 _knob("KT_CHAOS", "str", "",
       "Chaos-injection spec, e.g. 'seed=7,kill-worker=0.1'; kinds: "
       "kill-worker, drop-connection, inject-latency, corrupt-heartbeat, "
-      "partition, slow-pod, controller-kill, ws-flap.", "resilience")
+      "partition, slow-pod, controller-kill, ws-flap, handoff-drop, "
+      "scale-storm, pod-lag.", "resilience")
 _knob("KT_REJOIN_GRACE_S", "float", None,
       "Rejoin quarantine after a controller restart that restored "
       "durable state: for this many seconds the resilience sweep "
@@ -613,6 +614,36 @@ _knob("KT_REJOIN_GRACE_S", "float", None,
 _knob("KT_WS_RECONNECT_MAX_S", "float", 30.0,
       "Cap of the pod's controller-WebSocket reconnect backoff "
       "(full-jitter exponential from 1 s).", "resilience")
+
+# --- fleet autoscaler (controller-side scale loop, provisioning/scaler.py) --
+_knob("KT_SCALE_ENABLE", "bool", False,
+      "Run the controller-side fleet scaler: per service (and disagg "
+      "tier) compute desired replicas from fleet-rolled queue depth, "
+      "row occupancy, KV pressure, and SLO burn, and actuate through "
+      "the provisioning backend. Off = AutoscalingConfig stays "
+      "annotation-only (the pre-ISSUE-20 behavior).", "scaler")
+_knob("KT_SCALE_TARGET_OCCUPANCY", "float", 0.75,
+      "Row-occupancy setpoint the scaler sizes the fleet for: desired "
+      "= ceil(demand rows / (rows per pod x this)). Lower = more "
+      "headroom per replica.", "scaler")
+_knob("KT_SCALE_HYSTERESIS", "float", 0.1,
+      "Deadband around the occupancy setpoint: the scaler only acts "
+      "when measured occupancy leaves [target*(1-h), target*(1+h)], so "
+      "load noise near the setpoint never flaps the fleet.", "scaler")
+_knob("KT_SCALE_COOLDOWN_S", "float", 60.0,
+      "Seconds after any actuated scale decision during which further "
+      "scale-DOWNs (and direction reversals) for that service are "
+      "suppressed. Persisted durably: a restarted controller keeps "
+      "honoring an in-flight cooldown.", "scaler")
+_knob("KT_SCALE_COLD_START_BUDGET_S", "float", 30.0,
+      "Per-service cold-start-to-first-token budget: after a scale-up, "
+      "further scale-ups are suppressed until the new replicas report "
+      "in or this budget elapses (prevents over-provisioning while "
+      "pods are still provisioning+restoring); also the Retry-After a "
+      "scale-from-zero parked route quotes.", "scaler")
+_knob("KT_SCALE_EVAL_WINDOW_S", "float", 30.0,
+      "Fleet-rollup window the scaler reads its signals (queue depth, "
+      "occupancy, KV pressure, shed rate) over.", "scaler")
 
 # --- provisioning -----------------------------------------------------------
 _knob("KT_LOCAL_STATE", "str", "~/.ktpu/local",

@@ -226,6 +226,30 @@ class ControllerClient:
         return self._check(self.client.post(
             f"{self.base_url}/route/generate", json=body))
 
+    # ---------------------------------------------------------- scaling
+    def scale(self, service: str, replicas: int) -> Dict[str, Any]:
+        """Pin a service's replica count (``ktpu scale``): a durable
+        manual-override row on the controller plus immediate backend
+        actuation. The pin survives controller restarts and wins over
+        the automatic scaler until ``scale_auto`` clears it."""
+        return self._check(self.client.post(
+            f"{self.base_url}/scale/{service}",
+            json={"replicas": int(replicas)})) or {}
+
+    def scale_auto(self, service: str) -> Dict[str, Any]:
+        """Clear the manual override (``ktpu scale <svc> --auto``) and
+        hand the service back to the automatic loop."""
+        return self._check(self.client.delete(
+            f"{self.base_url}/scale/{service}")) or {}
+
+    def scaler_status(self, service: Optional[str] = None
+                      ) -> Dict[str, Any]:
+        """Scaler view: desired/actual replicas, override pins,
+        cooldown windows, recent decisions."""
+        path = f"/scale/{service}" if service else "/scale"
+        return self._check(
+            self.client.get(f"{self.base_url}{path}")) or {}
+
     def push_telemetry(self, service: str, pod: str,
                        frames: List[Dict[str, Any]]) -> int:
         """Batched telemetry frames (the POST fallback pods use when

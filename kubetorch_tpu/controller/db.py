@@ -77,6 +77,29 @@ CREATE TABLE IF NOT EXISTS controller_meta (
     key TEXT PRIMARY KEY,
     value TEXT NOT NULL
 );
+CREATE TABLE IF NOT EXISTS scaler_state (
+    service TEXT PRIMARY KEY,
+    desired INTEGER NOT NULL,
+    cooldown_until REAL,
+    settle_until REAL,
+    last_direction INTEGER NOT NULL DEFAULT 0,
+    last_reason TEXT,
+    updated_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS scale_overrides (
+    service TEXT PRIMARY KEY,
+    replicas INTEGER NOT NULL,
+    created_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS scale_decisions (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    service TEXT NOT NULL,
+    ts REAL NOT NULL,
+    from_replicas INTEGER NOT NULL,
+    to_replicas INTEGER NOT NULL,
+    reason TEXT,
+    kind TEXT NOT NULL DEFAULT 'auto'
+);
 """
 
 
@@ -306,6 +329,109 @@ class Database:
                     "DELETE FROM slo_objectives WHERE service=? AND "
                     "name=?", (service, name))
             self._conn.commit()
+
+    # -------------------------------------- crash-safety: fleet scaler
+    def save_scaler_state(self, service: str, desired: int,
+                          cooldown_until: Optional[float] = None,
+                          settle_until: Optional[float] = None,
+                          last_direction: int = 0,
+                          last_reason: str = "") -> None:
+        """Persist one service's scaler runtime state (desired replica
+        count + flap-guard deadlines). Written on every actuated
+        decision — a restarted controller must neither forget an
+        in-flight cooldown nor re-derive a different desired count and
+        flap the fleet."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO scaler_state (service, desired, "
+                "cooldown_until, settle_until, last_direction, "
+                "last_reason, updated_at) VALUES (?,?,?,?,?,?,?) "
+                "ON CONFLICT(service) DO UPDATE SET "
+                "desired=excluded.desired, "
+                "cooldown_until=excluded.cooldown_until, "
+                "settle_until=excluded.settle_until, "
+                "last_direction=excluded.last_direction, "
+                "last_reason=excluded.last_reason, "
+                "updated_at=excluded.updated_at",
+                (service, int(desired), cooldown_until, settle_until,
+                 int(last_direction), last_reason, time.time()))
+            self._conn.commit()
+
+    def load_scaler_states(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM scaler_state").fetchall()
+        return {r["service"]: dict(r) for r in rows}
+
+    def clear_scaler_state(self, service: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM scaler_state WHERE service=?", (service,))
+            self._conn.execute(
+                "DELETE FROM scale_overrides WHERE service=?", (service,))
+            self._conn.execute(
+                "DELETE FROM scale_decisions WHERE service=?", (service,))
+            self._conn.commit()
+
+    def set_scale_override(self, service: str, replicas: int) -> None:
+        """Durable manual override (``ktpu scale <svc> <n>``): the
+        scaler pins the service at this count until the override is
+        cleared (``ktpu scale <svc> --auto``)."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO scale_overrides (service, replicas, "
+                "created_at) VALUES (?,?,?) ON CONFLICT(service) DO "
+                "UPDATE SET replicas=excluded.replicas, "
+                "created_at=excluded.created_at",
+                (service, int(replicas), time.time()))
+            self._conn.commit()
+
+    def get_scale_override(self, service: str) -> Optional[int]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT replicas FROM scale_overrides WHERE service=?",
+                (service,)).fetchone()
+        return int(row["replicas"]) if row else None
+
+    def load_scale_overrides(self) -> Dict[str, int]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM scale_overrides").fetchall()
+        return {r["service"]: int(r["replicas"]) for r in rows}
+
+    def clear_scale_override(self, service: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM scale_overrides WHERE service=?", (service,))
+            self._conn.commit()
+            return cur.rowcount > 0
+
+    def record_scale_decision(self, service: str, from_replicas: int,
+                              to_replicas: int, reason: str,
+                              kind: str = "auto",
+                              ts: Optional[float] = None) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO scale_decisions (service, ts, "
+                "from_replicas, to_replicas, reason, kind) "
+                "VALUES (?,?,?,?,?,?)",
+                (service, ts if ts is not None else time.time(),
+                 int(from_replicas), int(to_replicas), reason, kind))
+            self._conn.commit()
+
+    def load_scale_decisions(self, service: Optional[str] = None,
+                             limit: int = 100) -> List[Dict[str, Any]]:
+        with self._lock:
+            if service is None:
+                rows = self._conn.execute(
+                    "SELECT * FROM scale_decisions ORDER BY id DESC "
+                    "LIMIT ?", (limit,)).fetchall()
+            else:
+                rows = self._conn.execute(
+                    "SELECT * FROM scale_decisions WHERE service=? "
+                    "ORDER BY id DESC LIMIT ?",
+                    (service, limit)).fetchall()
+        return [dict(r) for r in rows]
 
     # --------------------------------------------- crash-safety: meta
     def bump_meta_counter(self, key: str, by: int = 1) -> int:

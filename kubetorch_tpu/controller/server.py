@@ -252,6 +252,25 @@ class ControllerServer:
 
         self.fleet = FleetStore()
         self.slo = SLOEngine(self.fleet, on_event=self._slo_event)
+        # Fleet autoscaler (ISSUE 20): the loop that closes ROADMAP
+        # item 5 — reads the fleet rollups + SLO burn above, decides
+        # per-service (per-tier) replica counts, actuates through the
+        # provisioning backend, and persists every decision/cooldown in
+        # the controller DB so a restart resumes instead of flapping.
+        # The scaler OBJECT always exists (ktpu scale's manual override
+        # routes through it); only the automatic tick is gated on
+        # KT_SCALE_ENABLE.
+        from kubetorch_tpu.controller.router import RouterStats
+        from kubetorch_tpu.provisioning.scaler import FleetScaler
+
+        self.scale_enable = env_bool("KT_SCALE_ENABLE")
+        self.scaler = FleetScaler(
+            self.db, self.fleet, slo=self.slo,
+            restart_policy=self.restart_policy,
+            grace_remaining=self.rejoin_grace_remaining,
+            on_event=self._resilience_event,
+            actuate_in_thread=True)
+        self.router_stats = RouterStats()
         # blind-polling fix: /metrics/query/{service} responses carry
         # per-pod staleness + counter-reset annotations from the fleet
         # store ("reset 12 s ago", not a silent rate glitch)
@@ -320,6 +339,13 @@ class ControllerServer:
                 restored += 1
             except Exception as exc:  # noqa: BLE001
                 logger.debug("SLO restore of %r failed: %r", spec, exc)
+        try:
+            # restored scaler state is a rejoin too: remembered desired
+            # replica counts must sit out the quarantine before the
+            # scale loop acts on a fleet this incarnation never measured
+            restored += len(self.db.load_scaler_states())
+        except Exception as exc:  # noqa: BLE001
+            logger.debug("scaler state count failed: %r", exc)
         return restored > 0
 
     def rejoin_grace_remaining(self) -> float:
@@ -352,6 +378,10 @@ class ControllerServer:
         r.add_get("/metrics/fleet/{service}", self.h_fleet)
         r.add_get("/metrics/fleet/{service}/range", self.h_fleet_range)
         r.add_post("/route/generate", self.h_route_generate)
+        r.add_get("/scale", self.h_scale_status)
+        r.add_get("/scale/{service}", self.h_scale_status)
+        r.add_post("/scale/{service}", self.h_scale)
+        r.add_delete("/scale/{service}", self.h_scale_auto)
         r.add_get("/slo", self.h_slo)
         r.add_get("/slo/{service}", self.h_slo)
         r.add_post("/slo", self.h_slo_register)
@@ -404,6 +434,10 @@ class ControllerServer:
             # join the same exposition — one scrape covers the plane
             *self.fleet.prom_samples(),
             *self.slo.prom_samples(),
+            # scaler_* decision/flap/cold-start counters and router_*
+            # dispatch counters — the autoscaling loop's own telemetry
+            *self.scaler.prom_samples(),
+            *self.router_stats.prom_samples(),
         ]
         app.on_startup.append(self._on_startup)
         app.on_shutdown.append(self._on_shutdown)
@@ -587,6 +621,7 @@ class ControllerServer:
         # in memory and in the durable crash-safety tables
         self.liveness.forget_service(service)
         self.restart_policy.reset(service)
+        self.scaler.drop(service)
         self._last_detect.pop(service, None)
         self._drop_durable_state(service)
         # Cascading delete: backend resources (reference:
@@ -616,6 +651,7 @@ class ControllerServer:
             self.db.delete_liveness(service)
             self.db.clear_restart_state(service)
             self.db.delete_slos(service)
+            self.db.clear_scaler_state(service)
         except Exception as exc:  # noqa: BLE001 — teardown must complete
             logger.debug("durable-state drop for %s failed: %r",
                          service, exc)
@@ -729,7 +765,18 @@ class ControllerServer:
           live pod) — also the re-route fallback when chaos/drop took
           the decode tier out (``exclude``): the exported blob is still
           in the store, and a mixed pod can import it.
+
+        ISSUE 20 lifts the selection policy into
+        ``controller.router.select_route`` (pure, bench-testable) and
+        adds two fleet behaviors here: per-pod admission sheds become
+        router-visible backpressure (a shedding pod is deprioritized
+        within its tier), and a routable-pod MISS on an autoscaled
+        service parks the program — 202 + ``Retry-After`` — behind a
+        scale-from-zero ask instead of erroring. Non-autoscaled
+        services keep the 503.
         """
+        from kubetorch_tpu.controller.router import select_route
+
         try:
             body = await request.json()
         except Exception:  # noqa: BLE001
@@ -745,47 +792,84 @@ class ControllerServer:
         # either has seen the program
         hid = ((body or {}).get("handoff_id")
                or "h-" + uuid.uuid4().hex[:16])
-        fleet = self.fleet.fleet(service)
-        gauges = fleet.get("gauges") or {}
-        pods_meta = fleet.get("pods") or {}
-
-        def by_pod(name) -> Dict[str, float]:
-            return (gauges.get(name) or {}).get("by_pod") or {}
-
-        phase = by_pod("engine_phase")
-        eta = by_pod("engine_row_eta_seconds")
-        queue = by_pod("engine_queue_depth")
-        live = [p for p, m in sorted(pods_meta.items())
-                if p not in exclude and not m.get("stale")]
-        prefill = [p for p in live if phase.get(p) == 0]
-        decode = [p for p in live if phase.get(p) == 1]
-        mixed = [p for p in live if phase.get(p) not in (0, 1)]
-
-        def eta_key(p):
-            return (float(eta.get(p, 0.0)), p)
-
-        def queue_key(p):
-            return (float(queue.get(p, 0.0)), p)
-
-        if prefix_hit and decode:
-            return web.json_response(
-                {"mode": "decode-only",
-                 "decode": min(decode, key=eta_key),
-                 "handoff_id": hid})
-        if prefill and decode:
-            return web.json_response(
-                {"mode": "disagg",
-                 "prefill": min(prefill, key=queue_key),
-                 "decode": min(decode, key=eta_key),
-                 "handoff_id": hid})
-        pool = mixed or live
-        if not pool:
-            return web.json_response(
-                {"error": f"no routable pods for {service}"},
-                status=503)
+        route = select_route(self.fleet.fleet(service),
+                             prefix_hit=prefix_hit, exclude=exclude,
+                             stats=self.router_stats)
+        if route is not None:
+            route["handoff_id"] = hid
+            return web.json_response(route)
+        if self.scale_enable and self.db.get_pool(service) is not None:
+            ask = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self.scaler.request_capacity(service))
+            if ask.get("ok"):
+                self.router_stats.parked_total += 1
+                retry = float(ask.get("retry_after_s")
+                              or self.scaler.cold_start_budget_s)
+                return web.json_response(
+                    {"mode": "parked", "handoff_id": hid,
+                     "desired": ask.get("desired"),
+                     "retry_after_s": retry},
+                    status=202,
+                    headers={"Retry-After": str(max(1, int(retry)))})
         return web.json_response(
-            {"mode": "monolithic", "pod": min(pool, key=eta_key),
-             "handoff_id": hid})
+            {"error": f"no routable pods for {service}"},
+            status=503)
+
+    # ---------------------------------------------------------- scaling
+    async def h_scale(self, request):
+        """Operator scale pin (``ktpu scale <svc> <n>`` when the
+        controller is reachable): body ``{"replicas": n}`` writes a
+        durable manual-override row and actuates immediately through
+        the service's provisioning backend. The pin outlives controller
+        restarts and wins over the automatic loop until ``ktpu scale
+        <svc> --auto`` (DELETE) clears it."""
+        service = request.match_info["service"]
+        pool = self.db.get_pool(service)
+        if pool is None:
+            raise web.HTTPNotFound(text="no such pool")
+        denied = self._ns_denied(request,
+                                 pool.get("namespace") or "default")
+        if denied is not None:
+            return denied
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001
+            return web.json_response({"error": "bad json"}, status=400)
+        replicas = (body or {}).get("replicas")
+        if not isinstance(replicas, int) or replicas < 0:
+            return web.json_response(
+                {"error": "replicas must be a non-negative integer"},
+                status=400)
+        result = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.scaler.set_override(service, replicas,
+                                                   pool))
+        return web.json_response(result)
+
+    async def h_scale_auto(self, request):
+        """``ktpu scale <svc> --auto``: clear the manual override and
+        hand the service back to the automatic loop."""
+        service = request.match_info["service"]
+        pool = self.db.get_pool(service)
+        denied = self._ns_denied(
+            request, (pool or {}).get("namespace") or "default")
+        if denied is not None:
+            return denied
+        cleared = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.scaler.clear_override(service))
+        return web.json_response({"cleared": cleared,
+                                  "auto": self.scale_enable})
+
+    async def h_scale_status(self, request):
+        """Scaler view (all services or one): desired/actual replicas,
+        override pins, cooldown/settle windows, recent decisions —
+        what ``ktpu top`` joins into its replica columns."""
+        service = request.match_info.get("service")
+        return web.json_response({
+            "enabled": self.scale_enable,
+            "services": self.scaler.status(service),
+            "decisions": self.db.load_scale_decisions(service,
+                                                      limit=20),
+        })
 
     async def h_fleet_range(self, request):
         """Aligned fleet series for ramps: ``?metrics=a,b&start=&end=
@@ -992,6 +1076,15 @@ class ControllerServer:
                     service, "RestartBudgetRestored",
                     f"healthy {self.restart_policy.reset_after_s:g}s"
                     f" after restart; budget reset")
+        # fleet scaler rides the same cadence (KT_SCALE_ENABLE), but
+        # never inside the rejoin quarantine: restored last-seen stamps
+        # make every pod look silent, and scaling on that is the same
+        # storm the quarantine exists to prevent. The tick itself runs
+        # in an executor (SQLite + rollup reads); slow backend
+        # actuation detaches into its own thread inside the scaler.
+        if self.scale_enable and not in_grace:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.scaler.tick)
         if not self.auto_restart or in_grace:
             return
         for service in self.liveness.dead_services():
@@ -1317,6 +1410,7 @@ class ControllerServer:
                         self.slo.drop_service(service)
                         self.liveness.forget_service(service)
                         self.restart_policy.reset(service)
+                        self.scaler.drop(service)
                         self._last_detect.pop(service, None)
                         self._drop_durable_state(service)
                         try:

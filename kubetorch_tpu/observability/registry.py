@@ -416,11 +416,65 @@ _m("slo_breach_total", "counter",
 _m("slo_eval_ms", "gauge",
    "Wall milliseconds of the most recent SLO evaluation sweep.", "slo")
 
+# --- fleet scaler (ISSUE 20): the closed autoscaling loop -------------------
+_m("scaler_decisions_total", "counter",
+   "Actuated scale decisions (every one is also a durable "
+   "scale_decisions row).", "scaler")
+_m("scaler_scale_ups_total", "counter",
+   "Decisions that grew a service's replica count.", "scaler")
+_m("scaler_scale_downs_total", "counter",
+   "Decisions that shrank a service's replica count.", "scaler")
+_m("scaler_flaps_total", "counter",
+   "ACTUATED direction reversals inside the cooldown window (only a "
+   "manual override can cause one; the flap guard blocks auto "
+   "decisions).", "scaler")
+_m("scaler_blocked_total", "counter",
+   "Decisions withheld by a guard: rejoin quarantine, restart "
+   "backoff, scale-down cooldown, flap guard, or an open cold-start "
+   "settle window.", "scaler")
+_m("scaler_reconciles_total", "counter",
+   "Idempotent backend re-issues of a recorded desired count after "
+   "the fleet drifted (no new decision row).", "scaler")
+_m("scaler_cold_starts_total", "counter",
+   "Scale-ups that settled (actual reached target).", "scaler")
+_m("scaler_cold_starts_over_budget_total", "counter",
+   "Scale-ups that settled past — or never settled inside — "
+   "KT_SCALE_COLD_START_BUDGET_S.", "scaler")
+_m("scaler_overrides_active", "gauge",
+   "Services pinned by a durable manual override "
+   "(`ktpu scale <svc> <n>`).", "scaler")
+_m("scaler_desired_replicas", "gauge",
+   "The scaler's recorded desired replica count, per service.",
+   "scaler")
+_m("scaler_actual_replicas", "gauge",
+   "Observed live replicas (non-stale telemetry pods, or the "
+   "backend's count), per service.", "scaler")
+_m("scaler_cooldown_remaining_s", "gauge",
+   "Seconds left in the per-service scale-down cooldown (0 when "
+   "closed).", "scaler")
+_m("scaler_cold_start_seconds", "gauge",
+   "Wall seconds the most recent scale-up took to settle, per "
+   "service.", "scaler")
+
+# --- fleet router (ISSUE 20): controller-side route selection ---------------
+_m("router_routes_total", "counter",
+   "Routes handed out by POST /route/generate, labeled by mode "
+   "(monolithic | disagg | decode-only).", "router")
+_m("router_parked_total", "counter",
+   "Programs parked behind a scale-from-zero capacity ask (202 + "
+   "Retry-After) instead of erroring.", "router")
+_m("router_unroutable_total", "counter",
+   "Route misses with no live candidate pods (503, or a park on "
+   "autoscaled services).", "router")
+_m("router_backpressure_skips_total", "counter",
+   "Candidate pods deprioritized because their admission gate was "
+   "shedding during the rollup window.", "router")
+
 
 # keep the doc groups in a stable, narrative-matching order
 GROUP_ORDER = ("restore", "wire", "collectives", "serving", "reliability",
                "engine", "adapter", "resilience", "san", "trace",
-               "telemetry", "fleet", "slo")
+               "telemetry", "fleet", "slo", "scaler", "router")
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
 

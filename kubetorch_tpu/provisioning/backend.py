@@ -306,6 +306,98 @@ class LocalBackend:
         )
         return {"restarted": len(new.get("pods") or [])}
 
+    def scale(self, service_name: str, replicas: int,
+              launch_timeout: int = 120) -> Dict[str, Any]:
+        """Resize a service IN PLACE: spawn additional pod-server
+        subprocesses past the current set, or reap the highest-index
+        pods down to ``replicas``. Unlike ``launch``/``restart`` the
+        surviving pods are untouched — the fleet scaler's actuation
+        must not replace a serving replica set to grow it.
+
+        ``scale(0)`` reaps every pod but KEEPS the service record: the
+        scale-from-zero path relaunches from it. Distributed gangs
+        refuse — a gang's size is its topology; use restart."""
+        record = self.lookup(service_name)
+        if record is None:
+            raise KeyError(f"no local service {service_name!r}")
+        from kubetorch_tpu.resources.compute.compute import Compute
+
+        compute_dict = record.get("compute") or {}
+        if Compute.from_dict(compute_dict).distributed is not None:
+            raise ValueError(
+                f"{service_name} is a distributed gang — its size is its "
+                f"topology; scale via a redeploy, not the replica knob")
+        replicas = max(0, int(replicas))
+        pods = list(record.get("pods") or [])
+        current = len(pods)
+        if replicas == current:
+            return {"replicas": current}
+        if replicas < current:
+            for pod in pods[replicas:]:
+                _kill_tree(pod["pid"])
+            record["pods"] = pods[:replicas]
+            self._record_path(service_name).write_text(
+                json.dumps(record, indent=2))
+            return {"replicas": replicas, "reaped": current - replicas}
+
+        service_dir = self._service_dir(service_name)
+        service_dir.mkdir(parents=True, exist_ok=True)
+        module_env = dict(record.get("module_env") or {})
+        controller_url = (record.get("controller_url")
+                          or env_str("KT_CONTROLLER_URL"))
+        if controller_url:
+            # same re-injection as restart(): the scaler runs inside the
+            # controller, whose own env has no KT_CONTROLLER_URL
+            module_env.setdefault("KT_CONTROLLER_URL", controller_url)
+        pkg_root = str(Path(__file__).resolve().parents[2])
+        python_path = os.environ.get("PYTHONPATH", "")
+        if pkg_root not in python_path.split(os.pathsep):
+            python_path = (f"{pkg_root}{os.pathsep}{python_path}"
+                           if python_path else pkg_root)
+        base_env = dict(os.environ)
+        if not compute_dict.get("tpus"):
+            base_env["JAX_PLATFORMS"] = "cpu"
+            stub = str(Path(__file__).resolve().parent / "_cpu_site")
+            if stub not in python_path.split(os.pathsep):
+                python_path = f"{stub}{os.pathsep}{python_path}"
+        next_index = max((p["index"] for p in pods), default=-1) + 1
+        launch_id = record.get("launch_id", "")
+        new_ports = [free_port() for _ in range(replicas - current)]
+        local_ips = ",".join(
+            f"127.0.0.1:{p['port']}" for p in pods
+        ) or ",".join(f"127.0.0.1:{p}" for p in new_ports)
+        new_pods = []
+        for offset, port in enumerate(new_ports):
+            index = next_index + offset
+            env = {
+                **base_env,
+                **module_env,
+                "PYTHONPATH": python_path,
+                "KT_SERVICE_NAME": service_name,
+                "KT_SERVER_PORT": str(port),
+                "KT_REPLICA_INDEX": str(index),
+                "KT_POD_NAME": f"{service_name}-{index}",
+                "KT_LAUNCH_ID": launch_id,
+                "LOCAL_IPS": local_ips,
+            }
+            log_path = service_dir / f"pod-{index}.log"
+            log_file = open(log_path, "ab")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "kubetorch_tpu.serving.server",
+                 "--host", "127.0.0.1", "--port", str(port)],
+                env=env, stdout=log_file, stderr=subprocess.STDOUT,
+                start_new_session=True)
+            log_file.close()
+            new_pods.append({"pid": proc.pid, "port": port, "index": index,
+                             "log": str(log_path)})
+        record["pods"] = pods + new_pods
+        self._record_path(service_name).write_text(
+            json.dumps(record, indent=2))
+        self._wait_ready(
+            ServiceRecord({"service_name": service_name, "pods": new_pods}),
+            launch_timeout, launch_id)
+        return {"replicas": replicas, "launched": len(new_pods)}
+
     def teardown(self, service_name: str, quiet: bool = False) -> bool:
         record = self.lookup(service_name)
         if record is None:

@@ -319,6 +319,27 @@ class K8sBackend:
                          exclude_terminating=True)
         return {"restarted": deleted or compute.num_pods}
 
+    def scale(self, service_name: str, replicas: int,
+              namespace: str = "") -> Dict[str, Any]:
+        """Resize the service's Deployment via a replica merge-patch —
+        the ``ktpu scale`` patch lifted into the backend so the fleet
+        scaler actuates through the same seam as the CLI. Routed
+        through the controller's /apply when one is configured (client
+        without cluster credentials); applied directly otherwise (the
+        scaler runs IN the controller, which has no KT_CONTROLLER_URL
+        pointing at itself)."""
+        patch = {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": service_name,
+                         "namespace": namespace or get_config().namespace},
+            "spec": {"replicas": max(0, int(replicas))},
+        }
+        controller = self._controller()
+        if controller is not None:
+            return controller.apply(patch, patch="merge")
+        return {"applied": self.client.patch(patch)}
+
     def teardown(self, service_name: str, quiet: bool = False) -> bool:
         found = False
         workload_kinds = {"Deployment": "apps/v1",

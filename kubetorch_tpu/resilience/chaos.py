@@ -70,8 +70,20 @@ WS_FLAP = "ws-flap"
 # caller re-routes the import to another decode pod (the blob is still
 # in the store) or falls back to monolithic same-pod decode.
 HANDOFF_DROP = "handoff-drop"
+# scale-storm: a seeded offered-load spike mid-trace (ISSUE 20) — the
+# fleet simulator multiplies its arrival rate while the policy says the
+# storm is on, driving the scaler's ramp/cooldown machinery through a
+# burst it did not forecast. Keyed by trace-tick context so the storm
+# window is reproducible.
+SCALE_STORM = "scale-storm"
+# pod-lag: a slow-provisioning replica — the scaler asked for a pod and
+# the backend takes much longer than the modeled cold start to deliver
+# it. Drawn per new pod name; drives the cold-start-budget guard (no
+# repeated scale-ups while replicas are still warming).
+POD_LAG = "pod-lag"
 KINDS = (KILL_WORKER, DROP_CONNECTION, INJECT_LATENCY, CORRUPT_HEARTBEAT,
-         PARTITION, SLOW_POD, CONTROLLER_KILL, WS_FLAP, HANDOFF_DROP)
+         PARTITION, SLOW_POD, CONTROLLER_KILL, WS_FLAP, HANDOFF_DROP,
+         SCALE_STORM, POD_LAG)
 
 
 class ChaosPolicy:
@@ -89,6 +101,7 @@ class ChaosPolicy:
                  corrupt_heartbeat: float = 0.0, partition: float = 0.0,
                  slow_pod: float = 0.0, controller_kill: float = 0.0,
                  ws_flap: float = 0.0, handoff_drop: float = 0.0,
+                 scale_storm: float = 0.0, pod_lag: float = 0.0,
                  latency_s: float = 0.05,
                  max_events: Optional[int] = None):
         self.seed = int(seed)
@@ -102,6 +115,8 @@ class ChaosPolicy:
             CONTROLLER_KILL: float(controller_kill),
             WS_FLAP: float(ws_flap),
             HANDOFF_DROP: float(handoff_drop),
+            SCALE_STORM: float(scale_storm),
+            POD_LAG: float(pod_lag),
         }
         self.latency_s = float(latency_s)
         self.max_events = max_events

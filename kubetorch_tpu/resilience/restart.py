@@ -142,6 +142,17 @@ class RestartPolicy:
         with self._lock:
             return self._attempts.get(service, 0)
 
+    def backoff_remaining(self, service: str,
+                          now: Optional[float] = None) -> float:
+        """Seconds left on the service's restart-backoff deadline —
+        read-only (unlike ``next_delay``, consumes nothing). The fleet
+        scaler refuses to resize a gang the restart machinery is still
+        backing off on: resizing mid-backoff would race the pending
+        gang restart for the same replica set."""
+        now = time.time() if now is None else now
+        with self._lock:
+            return max(0.0, self._backoff_until.get(service, 0.0) - now)
+
     def exhausted(self, service: str) -> bool:
         with self._lock:
             return self._attempts.get(service, 0) >= self.max_restarts

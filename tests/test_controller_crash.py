@@ -381,6 +381,184 @@ def test_ws_flap_reconnect_and_resync(tmp_path):
         proc.wait(5)
 
 
+# ------------------------------------------- ISSUE 20: scale-churn e2e
+@pytest.mark.level("minimal")
+def test_scale_churn_survives_controller_kill(tmp_path, monkeypatch):
+    """The autoscaling half of the crash-safety story: a seeded
+    scale-storm chaos draw ramps demand, the scaler actuates through
+    the backend, the controller dies mid-ramp, and the restarted
+    scaler resumes from its durable decisions — quarantined during the
+    rejoin grace, then holding steady-state demand with ZERO spurious
+    scale events before continuing the ramp."""
+    from kubetorch_tpu.controller.server import ControllerServer
+    from kubetorch_tpu.resilience.chaos import SCALE_STORM, ChaosPolicy
+
+    svc = "churn-svc"
+    monkeypatch.setenv("KT_SCALE_ENABLE", "1")
+    monkeypatch.setenv("KT_SCALE_COOLDOWN_S", "0.5")
+    monkeypatch.setenv("KT_SCALE_COLD_START_BUDGET_S", "1.0")
+    monkeypatch.setenv("KT_AUTO_RESTART", "0")
+    db_path = str(tmp_path / "ctl.db")
+
+    calls = []
+
+    class FakeBackend:
+        name = "fake"
+
+        def scale(self, service, replicas):
+            calls.append((service, int(replicas)))
+            return {"replicas": int(replicas)}
+
+    def wire(server):
+        server.scaler._backend_for = lambda name: FakeBackend()
+        server.scaler.actuate_in_thread = False   # deterministic
+
+    def feed(server, pods, active, free, queue):
+        for name in pods:
+            server.fleet.ingest(svc, name, {"ts": time.time(), "m": {
+                "engine_phase": 2, "engine_active_rows": active,
+                "engine_free_rows": free, "engine_queue_depth": queue,
+            }, "full": True})
+
+    s1 = ControllerServer(db_path, enable_reaper=False,
+                          enable_resilience=False)
+    assert s1.scale_enable is True
+    wire(s1)
+    s1.db.upsert_pool(svc, namespace="default", backend="fake",
+                      compute={"autoscaling": {
+                          "min_scale": 0, "max_scale": 6,
+                          "metric": "concurrency"}})
+    # the seeded scale-storm chaos kind drives the ramp: a hit triples
+    # the offered queue depth exactly as in bench_fleet's trace
+    storm = ChaosPolicy(seed=5, scale_storm=1.0, pod_lag=1.0)
+    queue = 4 * (3 if storm.decide(SCALE_STORM, "block-0") else 1)
+    feed(s1, ["p0"], active=4, free=4, queue=queue)
+    asyncio.run(s1._resilience_tick())
+    # 16 demand rows over 8 rows/pod at 0.75 occupancy → 3 replicas
+    assert calls == [(svc, 3)]
+    assert len(s1.db.load_scale_decisions(svc)) == 1
+    assert s1.scaler.flaps_total == 0
+    if s1.log_sink.persist is not None:
+        s1.log_sink.persist.close()
+    del s1                                        # the mid-ramp crash
+
+    s2 = ControllerServer(db_path, enable_reaper=False,
+                          enable_resilience=False, rejoin_grace_s=0.3)
+    wire(s2)
+    # restored scaler state alone makes this a REJOIN: desired count is
+    # back, and the quarantine gates the scale loop
+    assert s2._rejoined is True
+    assert s2.scaler.status(svc)[svc]["desired"] == 3
+    feed(s2, ["p0", "p1", "p2"], active=5, free=3, queue=0)
+    asyncio.run(s2._resilience_tick())            # inside the grace
+    assert len(s2.db.load_scale_decisions(svc)) == 1, \
+        "scaler acted inside the rejoin quarantine"
+
+    time.sleep(0.35)                              # grace expires
+    # steady state at the restored count: 15 demand rows over 24
+    # capacity wants exactly the 3 replicas the old controller chose
+    feed(s2, ["p0", "p1", "p2"], active=5, free=3, queue=0)
+    asyncio.run(s2._resilience_tick())
+    assert len(s2.db.load_scale_decisions(svc)) == 1, \
+        "restarted scaler minted a spurious decision at steady state"
+    assert calls == [(svc, 3)]
+
+    # the next storm block resumes the ramp on the NEW controller
+    queue = 4 * (3 if storm.decide(SCALE_STORM, "block-1") else 1)
+    feed(s2, ["p0", "p1", "p2"], active=5, free=3, queue=queue)
+    asyncio.run(s2._resilience_tick())
+    rows = s2.db.load_scale_decisions(svc)
+    assert len(rows) == 2 and rows[0]["to_replicas"] == 6  # max_scale
+    assert calls[-1] == (svc, 6)
+    assert s2.scaler.flaps_total == 0
+    if s2.log_sink.persist is not None:
+        s2.log_sink.persist.close()
+
+
+@pytest.mark.level("minimal")
+def test_scale_endpoints_and_cli_override(tmp_path, monkeypatch):
+    """`ktpu scale <svc> <n>` routes through the controller's durable
+    override row when one is reachable; `ktpu scale <svc> --auto`
+    clears it; GET /scale answers the desired/actual view `ktpu top`
+    renders."""
+    from click.testing import CliRunner
+
+    from kubetorch_tpu.cli import main as cli_main
+
+    port = _free_port()
+    url = f"http://127.0.0.1:{port}"
+    env = {**os.environ, "KT_AUTO_RESTART": "0"}
+    env.pop("KT_CHAOS", None)
+    env.pop("KT_SCALE_ENABLE", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.controller.server",
+         "--host", "127.0.0.1", "--port", str(port), "--db",
+         str(tmp_path / "ctl.db")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        _wait_http(f"{url}/health", proc)
+        httpx.post(f"{url}/pool", json={
+            "service_name": "pinsvc", "backend": "local",
+            "module_meta": {"name": "pinsvc"}, "broadcast": False,
+        }, timeout=5.0).raise_for_status()
+        monkeypatch.setenv("KT_CONTROLLER_URL", url)
+
+        result = CliRunner().invoke(cli_main, ["scale", "pinsvc", "2"])
+        assert result.exit_code == 0, result.output
+        assert "durable override" in result.output
+        status = httpx.get(f"{url}/scale/pinsvc", timeout=5.0).json()
+        assert status["enabled"] is False          # loop off, pin on
+        assert status["services"]["pinsvc"]["override"] == 2
+        assert status["decisions"][0]["kind"] == "override"
+        # an unknown service 404s instead of minting rows
+        bad = httpx.post(f"{url}/scale/no-such",
+                         json={"replicas": 1}, timeout=5.0)
+        assert bad.status_code == 404
+        # a bad body 400s
+        bad = httpx.post(f"{url}/scale/pinsvc",
+                         json={"replicas": "many"}, timeout=5.0)
+        assert bad.status_code == 400
+
+        result = CliRunner().invoke(cli_main,
+                                    ["scale", "pinsvc", "--auto"])
+        assert result.exit_code == 0, result.output
+        assert "override cleared" in result.output
+        status = httpx.get(f"{url}/scale/pinsvc", timeout=5.0).json()
+        assert status["services"]["pinsvc"]["override"] is None
+        # clearing twice is a no-op, not an error
+        result = CliRunner().invoke(cli_main,
+                                    ["scale", "pinsvc", "--auto"])
+        assert result.exit_code == 0, result.output
+        assert "no override was set" in result.output
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+@pytest.mark.level("unit")
+def test_top_render_replica_column():
+    """`ktpu top` shows the scaler's desired/actual/pin view on the
+    service header line (ISSUE 20 satellite)."""
+    from kubetorch_tpu.cli import _top_render
+
+    snapshot = {"svc": {
+        "fleet": {"pods": {}}, "slo": [],
+        "scale": {"desired": 4, "actual": 2, "override": 4,
+                  "cooldown_remaining_s": 12.0},
+    }}
+    out = _top_render(snapshot, 60.0)
+    assert "replicas: 2/4 desired" in out
+    assert "(pinned 4)" in out
+    assert "(cooldown 12s)" in out
+    # no scaler view (older controller): header renders without it
+    bare = _top_render({"svc": {"fleet": {"pods": {}}, "slo": [],
+                                "scale": None}}, 60.0)
+    assert "replicas" not in bare
+
+
 # ------------------------------------------------------------------ e2e
 @pytest.fixture()
 def local_state(tmp_path_factory):

@@ -1,0 +1,409 @@
+"""FleetScaler + fleet router unit coverage: AutoscalingConfig clamps
+flow into live scaling decisions, scale-to-zero grace is honored,
+crash-resume restores the flap-guard clock from durable rows, manual
+overrides round-trip, and ``select_route`` routes by earliest ETA with
+shed-aware backpressure."""
+
+import pytest
+
+from kubetorch_tpu.controller.db import Database
+from kubetorch_tpu.controller.router import RouterStats, select_route
+from kubetorch_tpu.observability.fleetstore import FleetStore
+from kubetorch_tpu.provisioning.scaler import (FleetScaler,
+                                               autoscaling_from_pool)
+from kubetorch_tpu.resilience.chaos import POD_LAG, SCALE_STORM, ChaosPolicy
+
+SVC = "svc-a"
+
+
+class Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def now(self) -> float:
+        return self.t
+
+
+class FakeBackend:
+    name = "fake"
+
+    def __init__(self):
+        self.calls = []
+
+    def scale(self, service, replicas):
+        self.calls.append((service, int(replicas)))
+        return {"replicas": int(replicas)}
+
+
+def mk_db(autoscaling=None):
+    db = Database(":memory:")
+    compute = {"autoscaling": autoscaling} if autoscaling else {}
+    db.upsert_pool(SVC, namespace="default", backend="fake",
+                   compute=compute)
+    return db
+
+
+def mk_scaler(db, clock, backend, **kw):
+    fleet = kw.pop("fleet", None) or FleetStore(stale_after_s=5.0,
+                                                clock=clock.now)
+    scaler = FleetScaler(
+        db, fleet, backend_for=lambda name: backend, clock=clock.now,
+        target_occupancy=0.75, hysteresis=0.1, cooldown_s=10.0,
+        cold_start_budget_s=20.0, eval_window_s=30.0, **kw)
+    return scaler, fleet
+
+
+def feed(fleet, clock, pods, active=0, free=8, queue=0, phase=2):
+    for name in pods:
+        fleet.ingest(SVC, name, {"ts": clock.now(), "m": {
+            "engine_phase": phase,
+            "engine_active_rows": active,
+            "engine_free_rows": free,
+            "engine_queue_depth": queue,
+        }, "full": True})
+
+
+# --------------------------------------------------------- config plumbing
+@pytest.mark.level("unit")
+def test_autoscaling_from_pool_round_trip():
+    cfg = autoscaling_from_pool({"compute": {"autoscaling": {
+        "min_scale": 2, "max_scale": 5, "initial_scale": 3,
+        "metric": "rps", "scale_to_zero_grace": "90s"}}})
+    assert cfg.min_scale == 2 and cfg.max_scale == 5
+    assert cfg.initial_scale == 3 and cfg.metric == "rps"
+    assert cfg.scale_to_zero_grace == "90s"
+    assert autoscaling_from_pool({"compute": {}}) is None
+    assert autoscaling_from_pool({}) is None
+    # an invalid metric must not crash the control loop
+    assert autoscaling_from_pool({"compute": {"autoscaling": {
+        "metric": "nope"}}}) is None
+
+
+@pytest.mark.level("unit")
+def test_max_scale_clamps_live_decision():
+    clock, backend = Clock(), FakeBackend()
+    db = mk_db({"min_scale": 0, "max_scale": 4, "metric": "concurrency"})
+    scaler, fleet = mk_scaler(db, clock, backend)
+    # 1 live pod, 36 rows of demand over 8 rows/pod at 0.75 target
+    # occupancy wants 6 replicas — max_scale must cap it at 4
+    feed(fleet, clock, ["p0"], active=6, free=2, queue=30)
+    decisions = scaler.tick(actuals={SVC: 1})
+    assert [(d["from"], d["to"]) for d in decisions] == [(1, 4)]
+    assert backend.calls == [(SVC, 4)]
+
+
+@pytest.mark.level("unit")
+def test_min_scale_floors_scale_down():
+    clock, backend = Clock(), FakeBackend()
+    db = mk_db({"min_scale": 2, "max_scale": 4, "metric": "concurrency"})
+    scaler, fleet = mk_scaler(db, clock, backend)
+    feed(fleet, clock, ["p0"], active=6, free=2, queue=30)
+    scaler.tick(actuals={SVC: 1})           # up to max_scale=4
+    clock.t += 30.0                         # clear cooldown + flap guard
+    feed(fleet, clock, ["p0", "p1", "p2", "p3"], active=0, free=8)
+    decisions = scaler.tick(actuals={SVC: 4})
+    # zero demand wants 0 replicas; min_scale floors the reap at 2
+    assert [(d["from"], d["to"]) for d in decisions] == [(4, 2)]
+    assert backend.calls[-1] == (SVC, 2)
+
+
+@pytest.mark.level("unit")
+def test_initial_scale_without_telemetry():
+    clock, backend = Clock(), FakeBackend()
+    db = mk_db({"min_scale": 0, "max_scale": 8, "initial_scale": 3,
+                "metric": "concurrency"})
+    scaler, _ = mk_scaler(db, clock, backend)
+    decisions = scaler.tick(actuals={SVC: 0})
+    assert [(d["from"], d["to"]) for d in decisions] == [(0, 3)]
+    assert decisions[0]["reason"] == "initial-scale"
+
+
+@pytest.mark.level("unit")
+def test_scale_to_zero_waits_for_grace():
+    clock, backend = Clock(), FakeBackend()
+    db = mk_db({"min_scale": 0, "max_scale": 4, "metric": "concurrency",
+                "scale_to_zero_grace": "30s"})
+    scaler, fleet = mk_scaler(db, clock, backend)
+    feed(fleet, clock, ["p0"], active=0, free=8)
+    assert scaler.tick(actuals={SVC: 1}) == []   # idle 0s < grace: hold
+    assert "grace" in scaler.last_reason[SVC]
+    clock.t += 15.0
+    feed(fleet, clock, ["p0"], active=0, free=8)
+    assert scaler.tick(actuals={SVC: 1}) == []   # idle 15s < 30s: hold
+    clock.t += 16.0
+    feed(fleet, clock, ["p0"], active=0, free=8)
+    decisions = scaler.tick(actuals={SVC: 1})    # idle 31s >= 30s: reap
+    assert [(d["from"], d["to"]) for d in decisions] == [(1, 0)]
+    assert backend.calls == [(SVC, 0)]
+
+
+@pytest.mark.level("unit")
+def test_hysteresis_deadband_holds():
+    clock, backend = Clock(), FakeBackend()
+    db = mk_db({"min_scale": 0, "max_scale": 8, "metric": "concurrency"})
+    scaler, fleet = mk_scaler(db, clock, backend)
+    # 2 pods, 13 demand over 16 rows: occupancy 0.81 is above the 0.75
+    # setpoint but inside the +10% band (0.825) — must hold, not flap
+    feed(fleet, clock, ["p0", "p1"], active=6, free=2, queue=0)
+    fleet.ingest(SVC, "p1", {"ts": clock.now(), "m": {
+        "engine_phase": 2, "engine_active_rows": 7,
+        "engine_free_rows": 1, "engine_queue_depth": 0}, "full": True})
+    assert scaler.tick(actuals={SVC: 2}) == []
+    assert backend.calls == []
+
+
+# ------------------------------------------------------------ flap guards
+@pytest.mark.level("unit")
+def test_flap_guard_blocks_immediate_reversal():
+    clock, backend = Clock(), FakeBackend()
+    db = mk_db({"min_scale": 0, "max_scale": 8, "metric": "concurrency"})
+    scaler, fleet = mk_scaler(db, clock, backend)
+    feed(fleet, clock, ["p0"], active=6, free=2, queue=30)
+    scaler.tick(actuals={SVC: 1})                 # up
+    clock.t += 2.0
+    feed(fleet, clock, ["p0", "p1", "p2", "p3", "p4", "p5"],
+         active=0, free=8)
+    assert scaler.tick(actuals={SVC: 6}) == []    # reversal inside window
+    assert "flap guard" in scaler.last_reason[SVC]
+    assert scaler.flaps_total == 0                # blocked, not actuated
+    assert len(backend.calls) == 1
+
+
+@pytest.mark.level("unit")
+def test_crash_resume_restores_flap_clock():
+    """A restarted controller must keep holding a reversal the old one
+    was holding: the flap-guard clock is restored from the append-only
+    decision log, desired + deadlines from the scaler_state row."""
+    clock, backend = Clock(), FakeBackend()
+    db = mk_db({"min_scale": 0, "max_scale": 8, "metric": "concurrency"})
+    scaler, fleet = mk_scaler(db, clock, backend)
+    feed(fleet, clock, ["p0"], active=6, free=2, queue=30)
+    scaler.tick(actuals={SVC: 1})                 # up: 1 -> 6
+    assert len(db.load_scale_decisions(SVC)) == 1
+
+    clock.t += 2.0   # kill + restart inside the flap window
+    scaler2, _ = mk_scaler(db, clock, backend, fleet=fleet)
+    assert scaler2.status(SVC)[SVC]["desired"] == 6
+    feed(fleet, clock, ["p0", "p1", "p2", "p3", "p4", "p5"],
+         active=0, free=8)
+    assert scaler2.tick(actuals={SVC: 6}) == []
+    assert "flap guard" in scaler2.last_reason[SVC]
+    # the durable record shows ONE decision — the kill minted nothing
+    assert len(db.load_scale_decisions(SVC)) == 1
+
+
+@pytest.mark.level("unit")
+def test_scale_down_cooldown_blocks_second_down():
+    clock, backend = Clock(), FakeBackend()
+    db = mk_db({"min_scale": 0, "max_scale": 8, "metric": "concurrency"})
+    scaler, fleet = mk_scaler(db, clock, backend)
+    # 20 demand rows over 32 capacity: occupancy 0.625 is below the
+    # low band (0.675), wants ceil(20/6) = 4 replicas
+    feed(fleet, clock, ["p0", "p1", "p2", "p3"], active=5, free=3,
+         queue=0)
+    scaler._desired[SVC] = 6                      # pretend prior state
+    decisions = scaler.tick(actuals={SVC: 4})     # down: 6 -> 4
+    assert [(d["from"], d["to"]) for d in decisions] == [(6, 4)]
+    clock.t += 3.0                                # still inside cooldown
+    feed(fleet, clock, ["p0", "p1"], active=0, free=8)
+    assert scaler.tick(actuals={SVC: 2}) == []
+    assert "cooldown" in scaler.last_reason[SVC]
+
+
+# -------------------------------------------------------------- overrides
+@pytest.mark.level("unit")
+def test_manual_override_round_trip():
+    clock, backend = Clock(), FakeBackend()
+    db = mk_db({"min_scale": 0, "max_scale": 4, "metric": "concurrency"})
+    scaler, fleet = mk_scaler(db, clock, backend)
+    out = scaler.set_override(SVC, 6)
+    assert out["changed"] and backend.calls == [(SVC, 6)]
+    rows = db.load_scale_decisions(SVC)
+    assert rows[0]["kind"] == "override"
+    # overrides pin HARDER than max_scale and survive a restart
+    scaler2, fleet2 = mk_scaler(db, clock, backend)
+    assert scaler2.status(SVC)[SVC]["override"] == 6
+    # the pin wins over telemetry on every tick
+    feed(fleet2, clock, ["p0"], active=0, free=8)
+    assert scaler2.tick(actuals={SVC: 6}) == []   # already at the pin
+    assert scaler2.clear_override(SVC) is True
+    assert db.get_scale_override(SVC) is None
+
+
+@pytest.mark.level("unit")
+def test_override_manages_service_without_autoscaling():
+    clock, backend = Clock(), FakeBackend()
+    db = mk_db(None)                              # no autoscaling config
+    scaler, fleet = mk_scaler(db, clock, backend)
+    assert scaler.tick(actuals={SVC: 1}) == []    # unmanaged: untouched
+    scaler.set_override(SVC, 3)
+    assert backend.calls == [(SVC, 3)]
+
+
+# -------------------------------------------------------- scale-from-zero
+@pytest.mark.level("unit")
+def test_request_capacity_idempotent():
+    clock, backend = Clock(), FakeBackend()
+    db = mk_db({"min_scale": 0, "max_scale": 4, "metric": "concurrency"})
+    scaler, _ = mk_scaler(db, clock, backend)
+    ask = scaler.request_capacity(SVC)
+    assert ask["ok"] and ask["desired"] == 1
+    assert ask["retry_after_s"] == 20.0
+    assert len(db.load_scale_decisions(SVC)) == 1
+    # repeated parks while the cold start is in flight never stack
+    for _ in range(5):
+        again = scaler.request_capacity(SVC)
+        assert again["ok"]
+    assert len(db.load_scale_decisions(SVC)) == 1
+    assert backend.calls == [(SVC, 1)]
+
+
+@pytest.mark.level("unit")
+def test_request_capacity_refuses_unmanaged():
+    clock, backend = Clock(), FakeBackend()
+    db = mk_db(None)
+    scaler, _ = mk_scaler(db, clock, backend)
+    assert scaler.request_capacity(SVC)["ok"] is False
+    assert scaler.request_capacity("no-such")["ok"] is False
+
+
+# ------------------------------------------------------- resilience gates
+@pytest.mark.level("unit")
+def test_rejoin_grace_blocks_scaling():
+    clock, backend = Clock(), FakeBackend()
+    db = mk_db({"min_scale": 0, "max_scale": 8, "metric": "concurrency"})
+    scaler, fleet = mk_scaler(db, clock, backend,
+                              grace_remaining=lambda: 5.0)
+    feed(fleet, clock, ["p0"], active=6, free=2, queue=30)
+    assert scaler.tick(actuals={SVC: 1}) == []
+    assert "quarantine" in scaler.last_reason[SVC]
+    assert backend.calls == []
+
+
+@pytest.mark.level("unit")
+def test_restart_backoff_blocks_scaling():
+    class Policy:
+        def backoff_remaining(self, service, now=None):
+            return 7.5
+
+    clock, backend = Clock(), FakeBackend()
+    db = mk_db({"min_scale": 0, "max_scale": 8, "metric": "concurrency"})
+    scaler, fleet = mk_scaler(db, clock, backend,
+                              restart_policy=Policy())
+    feed(fleet, clock, ["p0"], active=6, free=2, queue=30)
+    assert scaler.tick(actuals={SVC: 1}) == []
+    assert "backoff" in scaler.last_reason[SVC]
+
+
+# ------------------------------------------------------------ fleet router
+def _rollup(pods, phase=None, eta=None, queue=None, sheds=None):
+    phase, eta, queue = phase or {}, eta or {}, queue or {}
+    rollup = {
+        "pods": {p: {"stale": False} for p in pods},
+        "gauges": {
+            "engine_phase": {"by_pod": {p: phase.get(p, 2)
+                                        for p in pods}},
+            "engine_row_eta_seconds": {"by_pod": {p: eta.get(p, 0.0)
+                                                  for p in pods}},
+            "engine_queue_depth": {"by_pod": {p: queue.get(p, 0.0)
+                                              for p in pods}},
+        },
+    }
+    if sheds:
+        rollup["counters"] = {
+            "engine_sheds_total": {"by_pod": dict(sheds)}}
+    return rollup
+
+
+@pytest.mark.level("unit")
+def test_select_route_monolithic_min_eta():
+    stats = RouterStats()
+    route = select_route(_rollup(["a", "b"], eta={"a": 5.0, "b": 1.0}),
+                         stats=stats)
+    assert route == {"mode": "monolithic", "pod": "b"}
+    assert stats.by_mode == {"monolithic": 1}
+
+
+@pytest.mark.level("unit")
+def test_select_route_disagg_and_prefix_hit():
+    rollup = _rollup(["pf", "dc0", "dc1"],
+                     phase={"pf": 0, "dc0": 1, "dc1": 1},
+                     eta={"dc0": 4.0, "dc1": 2.0})
+    route = select_route(rollup)
+    assert route["mode"] == "disagg"
+    assert route["prefill"] == "pf" and route["decode"] == "dc1"
+    # prefix hit skips prefill entirely: decode-only to min ETA
+    hit = select_route(rollup, prefix_hit=True)
+    assert hit == {"mode": "decode-only", "decode": "dc1"}
+
+
+@pytest.mark.level("unit")
+def test_select_route_none_when_unroutable():
+    stats = RouterStats()
+    assert select_route({"pods": {}}, stats=stats) is None
+    assert select_route(_rollup(["a"]), exclude=["a"],
+                        stats=stats) is None
+    assert stats.unroutable_total == 2
+
+
+@pytest.mark.level("unit")
+def test_select_route_backpressure_prefers_clear_pods():
+    stats = RouterStats()
+    # "a" has the better ETA but is actively shedding admissions — the
+    # router must deprioritize it while "b"'s gate is open
+    rollup = _rollup(["a", "b"], eta={"a": 1.0, "b": 9.0},
+                     sheds={"a": 3.0})
+    assert select_route(rollup, stats=stats)["pod"] == "b"
+    assert stats.backpressure_skips_total == 1
+    # ...but a fully-shedding fleet stays routable (backpressure is a
+    # hint, not death)
+    both = _rollup(["a", "b"], eta={"a": 1.0, "b": 9.0},
+                   sheds={"a": 3.0, "b": 3.0})
+    assert select_route(both, stats=stats)["pod"] == "a"
+
+
+@pytest.mark.level("unit")
+def test_router_stats_prom_samples():
+    stats = RouterStats()
+    stats.note("monolithic")
+    stats.parked_total += 2
+    names = {name for name, _, _ in stats.prom_samples()}
+    assert names == {"router_parked_total", "router_unroutable_total",
+                     "router_backpressure_skips_total",
+                     "router_routes_total"}
+
+
+# ------------------------------------------------------------ chaos kinds
+@pytest.mark.level("unit")
+def test_chaos_scale_storm_and_pod_lag_kinds():
+    always = ChaosPolicy(seed=3, scale_storm=1.0, pod_lag=1.0)
+    assert always.decide(SCALE_STORM, "block-0")
+    assert always.decide(POD_LAG, "pod-0")
+    never = ChaosPolicy(seed=3)
+    assert not never.decide(SCALE_STORM, "block-0")
+    assert not never.decide(POD_LAG, "pod-0")
+    # seeded determinism: two same-seed policies agree draw for draw
+    a = ChaosPolicy(seed=11, pod_lag=0.5)
+    b = ChaosPolicy(seed=11, pod_lag=0.5)
+    draws = [f"pod-{i}" for i in range(32)]
+    assert ([a.decide(POD_LAG, d) for d in draws]
+            == [b.decide(POD_LAG, d) for d in draws])
+
+
+# ------------------------------------------------------------- exposition
+@pytest.mark.level("unit")
+def test_scaler_prom_samples_families():
+    clock, backend = Clock(), FakeBackend()
+    db = mk_db({"min_scale": 0, "max_scale": 4, "metric": "concurrency"})
+    scaler, fleet = mk_scaler(db, clock, backend)
+    feed(fleet, clock, ["p0"], active=6, free=2, queue=30)
+    scaler.tick(actuals={SVC: 1})
+    names = {name for name, _, _ in scaler.prom_samples()}
+    assert {"scaler_decisions_total", "scaler_scale_ups_total",
+            "scaler_scale_downs_total", "scaler_flaps_total",
+            "scaler_blocked_total", "scaler_reconciles_total",
+            "scaler_cold_starts_total",
+            "scaler_cold_starts_over_budget_total",
+            "scaler_overrides_active", "scaler_desired_replicas",
+            "scaler_actual_replicas",
+            "scaler_cooldown_remaining_s"} <= names
